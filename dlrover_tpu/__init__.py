@@ -1,0 +1,23 @@
+"""dlrover_tpu: a TPU-native elastic distributed-training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of DLRover (reference:
+Major-333/dlrover): per-job master control plane (rendezvous, dynamic data
+sharding, auto-scaling, fault diagnosis), per-host elastic agents that
+bootstrap ``jax.distributed``, and a GSPMD/``pjit`` parallelism library in
+place of DDP/FSDP/TP wrapper stacks.
+
+Package layout:
+  common/    shared types: node model, status flow, config, wire messages
+  rpc/       codegen-free gRPC transport (JSON-framed dataclass messages)
+  master/    per-job master: job manager, rendezvous, sharding, monitors
+  agent/     per-host elastic agent: master client, rendezvous handler
+  trainer/   user-facing training API (ElasticTrainer, tpurun CLI)
+  parallel/  mesh planning, sharding rules, strategy, accelerate API
+  ops/       Pallas kernels: flash attention, ring attention, MoE
+  models/    model family: llama, gpt2, moe, deepfm, mnist
+  checkpoint/ async Orbax elastic checkpointing
+  diagnosis/ hang detection, profiling, failure classification
+  native/    C++ host-side pieces (shm batch transport)
+"""
+
+__version__ = "0.1.0"

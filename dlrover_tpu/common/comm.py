@@ -1,0 +1,353 @@
+"""Wire messages between agents and the master.
+
+Role parity: ``dlrover/proto/elastic_training.proto`` (~30 rpcs). Every
+message here is a registered dataclass (see ``serialize.message``); the
+master exposes exactly two unary rpcs — ``get`` (query) and ``report``
+(fire-and-forget-ish state push) — and dispatches on message type, which is
+the shape the reference's servicer converges to as well.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.serialize import message
+
+# --------------------------------------------------------------------------
+# envelope
+# --------------------------------------------------------------------------
+
+
+@message
+class BaseRequest:
+    node_id: int = -1
+    node_type: str = ""
+
+
+@message
+class Response:
+    success: bool = True
+    reason: str = ""
+    data: Optional[object] = None
+
+
+# --------------------------------------------------------------------------
+# data sharding
+# --------------------------------------------------------------------------
+
+
+@message
+class DatasetShardParams:
+    """Registers a dataset with the master's task manager."""
+
+    dataset_name: str = ""
+    dataset_size: int = 0
+    batch_size: int = 0
+    num_epochs: int = 1
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    storage_type: str = "table"  # table | text | stream
+    task_type: str = "training"  # training | evaluation
+
+
+@message
+class TaskRequest:
+    dataset_name: str = ""
+    node_id: int = -1
+
+
+@message
+class Shard:
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: Optional[List[int]] = None
+
+
+@message
+class Task:
+    task_id: int = -1
+    task_type: str = ""
+    shard: Optional[Shard] = None
+    epoch: int = 0
+
+    @property
+    def exists(self) -> bool:
+        return self.task_id >= 0
+
+
+@message
+class TaskResult:
+    dataset_name: str = ""
+    task_id: int = -1
+    err_message: str = ""
+    node_id: int = -1
+
+
+@message
+class ShardCheckpointRequest:
+    dataset_name: str = ""
+
+
+@message
+class ShardCheckpoint:
+    dataset_name: str = ""
+    content: str = ""  # JSON blob owned by the dataset manager
+
+
+# --------------------------------------------------------------------------
+# rendezvous
+# --------------------------------------------------------------------------
+
+
+@message
+class RendezvousParams:
+    """Pushed once by node rank 0 before joining."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: float = 30.0
+    node_unit: int = 1  # world size must be a multiple (TPU slice hosts)
+    rdzv_name: str = ""
+
+
+@message
+class JoinRendezvousRequest:
+    node_rank: int = -1
+    local_world_size: int = 1
+    rdzv_name: str = ""
+    node_id: int = -1
+    slice_index: int = 0
+
+
+@message
+class CommWorldRequest:
+    rdzv_name: str = ""
+    node_rank: int = -1
+
+
+@message
+class CommWorld:
+    """The agreed world for one rendezvous round.
+
+    ``world`` maps node_rank -> local_world_size (number of JAX processes the
+    host will start). ``coordinator_addr`` is the jax.distributed coordinator
+    (host of the smallest participating node rank) — the TPU analogue of the
+    reference handing out the c10d store address.
+    """
+
+    rdzv_name: str = ""
+    round: int = 0
+    group: int = 0
+    world: Optional[Dict[int, int]] = None
+    coordinator_addr: str = ""
+
+
+@message
+class WaitingNodeNumRequest:
+    rdzv_name: str = ""
+
+
+@message
+class NetworkReadyRequest:
+    pass
+
+
+@message
+class NetworkCheckResult:
+    node_rank: int = -1
+    normal: bool = True
+    elapsed_time: float = 0.0
+
+
+@message
+class StragglerExistRequest:
+    pass
+
+
+@message
+class RendezvousState:
+    round: int = 0
+    waiting_num: int = 0
+
+
+# --------------------------------------------------------------------------
+# kv store / sync
+# --------------------------------------------------------------------------
+
+
+@message
+class KVStoreSetRequest:
+    key: str = ""
+    value: str = ""  # base64 when binary
+
+
+@message
+class KVStoreGetRequest:
+    key: str = ""
+
+
+@message
+class KVStoreValue:
+    key: str = ""
+    value: str = ""
+    found: bool = False
+
+
+@message
+class KVStoreAddRequest:
+    key: str = ""
+    amount: int = 0
+
+
+@message
+class SyncJoinRequest:
+    sync_name: str = ""
+    node_rank: int = -1
+
+
+@message
+class SyncFinishRequest:
+    sync_name: str = ""
+
+
+@message
+class BarrierRequest:
+    barrier_name: str = ""
+    notify: bool = False
+
+
+# --------------------------------------------------------------------------
+# failures / monitoring
+# --------------------------------------------------------------------------
+
+
+@message
+class NodeFailure:
+    node_id: int = -1
+    node_rank: int = -1
+    restart_count: int = 0
+    error_data: str = ""
+    level: str = "process"  # TrainingExceptionLevel
+
+
+@message
+class ResourceStats:
+    node_id: int = -1
+    node_type: str = ""
+    cpu_percent: float = 0.0
+    memory_mb: int = 0
+    chips: int = 0
+    duty_cycle: float = 0.0  # accelerator busy fraction, if known
+
+
+@message
+class GlobalStep:
+    step: int = 0
+    timestamp: float = 0.0
+    elapsed_time_per_step: float = 0.0
+
+
+@message
+class NodeHeartbeat:
+    node_id: int = -1
+    timestamp: float = 0.0
+
+
+@message
+class NodeStatusReport:
+    node_id: int = -1
+    node_type: str = ""
+    status: str = ""
+
+
+@message
+class DatasetMetric:
+    dataset_name: str = ""
+    dataset_size: int = 0
+    storage_type: str = ""
+
+
+@message
+class ModelInfo:
+    num_params: int = 0
+    flops_per_step: float = 0.0
+    hidden_size: int = 0
+    num_layers: int = 0
+    seq_len: int = 0
+
+
+@message
+class ParallelConfig:
+    """Mesh/partition decisions the master can push to agents at runtime."""
+
+    mesh_shape: Optional[Dict[str, int]] = None
+    remat_policy: str = ""
+    grad_accum_steps: int = 1
+    restart: bool = False
+
+
+@message
+class ParallelConfigRequest:
+    node_id: int = -1
+
+
+# --------------------------------------------------------------------------
+# PS-strategy parity (elastic PS cluster versioning)
+# --------------------------------------------------------------------------
+
+
+@message
+class ClusterVersionRequest:
+    task_type: str = ""
+    task_id: int = 0
+    version_type: str = "global"  # global | local | restored
+
+
+@message
+class ClusterVersion:
+    version: int = 0
+
+
+@message
+class ClusterVersionUpdate:
+    task_type: str = ""
+    task_id: int = 0
+    version_type: str = "global"
+    version: int = 0
+
+
+@message
+class QueryPsNodesRequest:
+    pass
+
+
+@message
+class PsNodes:
+    addrs: Optional[List[str]] = None
+    ready: bool = False
+    new_ps_ready: bool = False
+
+
+# --------------------------------------------------------------------------
+# job control
+# --------------------------------------------------------------------------
+
+
+@message
+class JobExitRequest:
+    node_id: int = -1
+    success: bool = True
+    reason: str = ""
+
+
+@message
+class ScaleRequest:
+    """Manual scaling hook (the reference's user-submitted ScalePlan CR)."""
+
+    worker_num: int = 0
+
+
+def is_message(obj) -> bool:
+    return dataclasses.is_dataclass(obj) and not isinstance(obj, type)

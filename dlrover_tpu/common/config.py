@@ -1,0 +1,88 @@
+"""Global tunables singleton.
+
+Role parity: ``dlrover/python/common/global_context.py`` — one process-wide
+``Context`` with named knobs (timeouts, thresholds, feature gates), each
+overridable from the environment (``DLROVER_TPU_<UPPER_NAME>``) or at runtime
+(e.g. by a cluster-level optimizer service).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+
+class Context:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        # control-loop cadences (seconds)
+        self.master_service_timeout = 600
+        self.seconds_to_wait_failed_ps = 600
+        self.train_speed_record_num = 50
+        self.seconds_for_stable_worker_count = 60
+        self.seconds_interval_to_optimize = 30
+        self.seconds_interval_to_report = 15
+        self.seconds_to_start_autoscale_worker = 90
+        self.step_to_adjust_worker = 200
+        self.seconds_to_timeout_task = 1800
+        self.hang_cpu_usage_percentage = 0.05
+        self.hang_detection_secs = 1800
+        self.heartbeat_timeout_secs = 300
+        # rendezvous
+        self.rdzv_timeout_secs = 600
+        self.rdzv_round_wait_secs = 3
+        self.network_check_timeout_secs = 300
+        # relaunch policy
+        self.relaunch_on_worker_failure = 3
+        self.max_relaunch_count = 5
+        self.relaunch_always = False
+        # elasticity
+        self.auto_scale_enabled = True
+        self.dynamic_sharding_enabled = True
+        # optimizer
+        self.oom_memory_factor = 2.0
+        self.optimize_worker_cpu_threshold = 0.8
+        # checkpoint
+        self.ckpt_async = True
+        self.ckpt_host_staging = True
+        self._apply_env_overrides()
+
+    def _apply_env_overrides(self):
+        for name, val in vars(self).items():
+            if name.startswith("_"):
+                continue
+            env = os.environ.get("DLROVER_TPU_" + name.upper())
+            if env is None:
+                continue
+            try:
+                if isinstance(val, bool):
+                    setattr(self, name, env.lower() in ("1", "true", "yes"))
+                elif isinstance(val, int):
+                    setattr(self, name, int(env))
+                elif isinstance(val, float):
+                    setattr(self, name, float(env))
+                else:
+                    setattr(self, name, env)
+            except ValueError:
+                pass
+
+    def set_params(self, params: Dict[str, Any]):
+        """Runtime override (the reference's ``set_params_from_brain``)."""
+        for k, v in params.items():
+            if hasattr(self, k) and not k.startswith("_"):
+                setattr(self, k, v)
+
+    @classmethod
+    def singleton_instance(cls) -> "Context":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+
+def get_context() -> Context:
+    return Context.singleton_instance()

@@ -1,0 +1,131 @@
+"""Shared enums and constants for the control plane.
+
+Role parity: ``dlrover/python/common/constants.py`` in the reference (node
+types, statuses, distribution strategies, rendezvous names, env-var contract).
+Values are our own; TPU-specific notions (slices, ICI) are first-class.
+"""
+
+from __future__ import annotations
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "k8s"
+    RAY = "ray"
+
+
+class NodeType:
+    MASTER = "master"
+    WORKER = "worker"
+    CHIEF = "chief"
+    PS = "ps"
+    EVALUATOR = "evaluator"
+
+
+class NodeStatus:
+    INITIAL = "Initial"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    DELETED = "Deleted"
+    BREAKDOWN = "Breakdown"  # failed the network/ICI health check
+    UNKNOWN = "Unknown"
+
+    @classmethod
+    def end_states(cls):
+        return {cls.SUCCEEDED, cls.FAILED, cls.DELETED}
+
+
+class NodeEventType:
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+class NodeExitReason:
+    SUCCEEDED = "Succeeded"
+    KILLED = "Killed"
+    OOM = "OOMKilled"
+    FATAL_ERROR = "FatalError"
+    HARDWARE_ERROR = "HardwareError"  # TPU chip / ICI link failure
+    PREEMPTED = "Preempted"
+    UNKNOWN_ERROR = "UnknownError"
+
+
+class JobExitReason:
+    SUCCEEDED = "Succeeded"
+    CODE_ERROR = "CodeError"
+    NODE_OOM_ERROR = "NodeOOMError"
+    NODE_ERROR = "NodeError"
+    RDZV_TIMEOUT_ERROR = "RendezvousTimeoutError"
+    HANG_ERROR = "HangError"
+    UNKNOWN_ERROR = "UnknownError"
+
+
+class DistributionStrategy:
+    """How the training processes coordinate.
+
+    SPMD is the TPU-native analogue of the reference's "AllreduceStrategy"
+    (one program, XLA collectives over ICI/DCN); PS is kept for parity with
+    the reference's parameter-server jobs; LOCAL is single-process.
+    """
+
+    SPMD = "spmd"
+    PS = "ps"
+    LOCAL = "local"
+
+
+class RendezvousName:
+    TRAINING = "elastic-training"
+    NETWORK_CHECK = "network-check"
+
+
+class JobStage:
+    CREATE = "create"
+    WORKER_INITIAL = "worker_initial"
+    RUNNING = "running"
+    STOPPING = "stopping"
+
+
+class TrainingExceptionLevel:
+    PROCESS_ERROR = "process"
+    NODE_ERROR = "node"
+    RDZV_ERROR = "rdzv"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class NodeEnv:
+    """Env-var contract between agent and training processes."""
+
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    NODE_TYPE = "DLROVER_TPU_NODE_TYPE"
+    AUTO_MONITOR_WORKLOAD = "DLROVER_TPU_AUTO_MONITOR"
+    # Handed to each training process at (re-)rendezvous:
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR_ADDR"
+    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
+    NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
+    RESTART_ROUND = "DLROVER_TPU_RESTART_ROUND"
+
+
+class DefaultValues:
+    SERVICE_PORT = 0  # 0 = pick a free port
+    RELAUNCH_ON_WORKER_FAILURE = 3
+    MAX_RELAUNCH_COUNT = 5
+    SECONDS_TO_START_AUTOSCALE_WORKER = 90
+    RDZV_TIMEOUT_SECS = 600
+    NETWORK_CHECK_TIMEOUT_SECS = 300
+    MONITOR_INTERVAL_SECS = 5.0
+    REPORT_RESOURCE_INTERVAL_SECS = 15.0
+
+
+class GraftPlatform:
+    """Accelerator platform tags used by resource descriptions."""
+
+    TPU = "tpu"
+    CPU = "cpu"

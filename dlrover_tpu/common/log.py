@@ -1,0 +1,27 @@
+"""Shared logger (role parity: ``dlrover/python/common/log.py``)."""
+
+import logging
+import os
+import sys
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def _build_logger() -> logging.Logger:
+    logger = logging.getLogger("dlrover_tpu")
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("DLROVER_TPU_LOG_LEVEL", "INFO"))
+        logger.propagate = False
+    return logger
+
+
+default_logger = _build_logger()
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    if not name:
+        return default_logger
+    return default_logger.getChild(name)

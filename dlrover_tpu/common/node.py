@@ -1,0 +1,174 @@
+"""Node model for the control plane.
+
+Role parity: ``dlrover/python/common/node.py`` (``Node``, ``NodeResource``,
+``NodeGroupResource``) — the master's in-memory picture of every node in a
+job, plus the resource quantities the optimizer/scaler act on.
+
+TPU-first: a node is a *host* in a TPU slice; its accelerator resource is a
+(platform, chip-count, topology) triple rather than a GPU count, and nodes
+carry a ``slice_index`` so rendezvous can keep worlds whole-slice
+(``node_unit`` semantics in the reference, ``rdzv_manager.py:118-120``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+
+
+@dataclass
+class AcceleratorResource:
+    """Accelerator attached to one host."""
+
+    platform: str = "tpu"  # "tpu" | "cpu"
+    chips: int = 0  # chips attached to this host (v5p: 4 per host)
+    topology: str = ""  # e.g. "2x2x4" for the slice this host belongs to
+
+
+@dataclass
+class NodeResource:
+    """CPU is in cores, memory in MiB (matching the reference's units)."""
+
+    cpu: float = 0.0
+    memory: int = 0
+    accelerator: AcceleratorResource = field(default_factory=AcceleratorResource)
+    priority: str = ""
+
+    def to_dict(self) -> Dict:
+        return {
+            "cpu": self.cpu,
+            "memory": self.memory,
+            "chips": self.accelerator.chips,
+            "platform": self.accelerator.platform,
+        }
+
+    @classmethod
+    def resource_str(cls, res: "NodeResource") -> str:
+        return f"cpu={res.cpu},mem={res.memory}Mi,chips={res.accelerator.chips}"
+
+
+@dataclass
+class NodeGroupResource:
+    """Resource request for a homogeneous group of nodes (e.g. all workers)."""
+
+    count: int = 0
+    node_resource: NodeResource = field(default_factory=NodeResource)
+
+    def update(self, count: Optional[int] = None, cpu: Optional[float] = None,
+               memory: Optional[int] = None):
+        if count is not None and count > 0:
+            self.count = count
+        if cpu is not None and cpu > 0:
+            self.node_resource.cpu = cpu
+        if memory is not None and memory > 0:
+            self.node_resource.memory = memory
+
+
+class Node:
+    """One host of a job, with lifecycle state.
+
+    The master mutates these objects from watcher events and agent reports;
+    the job manager reads them to decide relaunch/scale actions.
+    """
+
+    def __init__(
+        self,
+        node_type: str = NodeType.WORKER,
+        node_id: int = 0,
+        rank_index: Optional[int] = None,
+        name: str = "",
+        status: str = NodeStatus.INITIAL,
+        config_resource: Optional[NodeResource] = None,
+        max_relaunch_count: int = 3,
+        relaunchable: bool = True,
+        critical: bool = False,
+        slice_index: int = 0,
+        service_addr: str = "",
+    ):
+        self.type = node_type
+        self.id = node_id
+        self.rank_index = rank_index if rank_index is not None else node_id
+        self.name = name or f"{node_type}-{node_id}"
+        self.status = status
+        self.config_resource = config_resource or NodeResource()
+        self.used_resource = NodeResource()
+        self.max_relaunch_count = max_relaunch_count
+        self.relaunch_count = 0
+        self.relaunchable = relaunchable
+        self.critical = critical
+        self.slice_index = slice_index
+        self.service_addr = service_addr
+
+        self.exit_reason: str = ""
+        self.is_released = False
+        self.create_time: Optional[float] = None
+        self.start_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.heartbeat_time: float = 0.0
+        self.start_hang_time: float = 0.0
+        self.reported_status: str = ""
+        self.restart_training = False
+        self.migrated = False
+        self.paral_config: Dict = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def update_status(self, status: str):
+        if status and status != NodeStatus.UNKNOWN:
+            self.status = status
+            if status == NodeStatus.RUNNING and self.start_time is None:
+                self.start_time = time.time()
+            if status in NodeStatus.end_states() and self.finish_time is None:
+                self.finish_time = time.time()
+
+    def inc_relaunch_count(self):
+        self.relaunch_count += 1
+
+    def exited(self) -> bool:
+        return self.status in NodeStatus.end_states()
+
+    def is_unrecoverable_failure(self) -> bool:
+        """Failures that relaunching this node cannot fix."""
+        if self.relaunch_count >= self.max_relaunch_count:
+            return True
+        if self.exit_reason == NodeExitReason.FATAL_ERROR:
+            return True
+        return False
+
+    def update_reported_status(self, status: str):
+        self.reported_status = status
+
+    def update_resource_usage(self, cpu: float, memory: int):
+        self.used_resource.cpu = cpu
+        self.used_resource.memory = memory
+
+    def update_heartbeat(self, ts: Optional[float] = None):
+        self.heartbeat_time = ts if ts is not None else time.time()
+
+    def get_relaunch_node(self, new_id: int) -> "Node":
+        """Build the replacement node the scaler should create."""
+        node = Node(
+            node_type=self.type,
+            node_id=new_id,
+            rank_index=self.rank_index,
+            status=NodeStatus.INITIAL,
+            config_resource=self.config_resource,
+            max_relaunch_count=self.max_relaunch_count,
+            critical=self.critical,
+            slice_index=self.slice_index,
+        )
+        node.relaunch_count = self.relaunch_count + 1
+        return node
+
+    def __repr__(self):
+        return (
+            f"Node({self.type}-{self.id} rank={self.rank_index} "
+            f"status={self.status} relaunch={self.relaunch_count})"
+        )

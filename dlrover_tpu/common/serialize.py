@@ -1,0 +1,73 @@
+"""Dataclass <-> JSON wire codec.
+
+The reference speaks protobuf (``dlrover/proto/elastic_training.proto``); we
+frame registered ``@dataclass`` messages as JSON instead, which keeps the
+control plane free of a codegen step while staying debuggable on the wire.
+Only registered message classes deserialize — unknown types raise — so the
+surface is closed like a .proto file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+_MESSAGE_REGISTRY: Dict[str, Type] = {}
+
+
+def message(cls):
+    """Class decorator: make a dataclass a wire message."""
+    cls = dataclasses.dataclass(cls)
+    _MESSAGE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def registered_messages() -> Dict[str, Type]:
+    return dict(_MESSAGE_REGISTRY)
+
+
+def _encode_value(value: Any) -> Any:
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out = {"__type__": type(value).__name__}
+        for f in dataclasses.fields(value):
+            out[f.name] = _encode_value(getattr(value, f.name))
+        return out
+    if isinstance(value, dict):
+        # JSON keys must be strings; tag int-keyed dicts so they round-trip
+        # (rendezvous worlds are {node_rank: local_world_size}).
+        if value and all(isinstance(k, int) for k in value):
+            return {"__intkeys__": {str(k): _encode_value(v) for k, v in value.items()}}
+        return {str(k): _encode_value(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_encode_value(v) for v in value]
+    return value
+
+
+def _decode_value(value: Any) -> Any:
+    if isinstance(value, dict):
+        if "__intkeys__" in value:
+            return {int(k): _decode_value(v) for k, v in value["__intkeys__"].items()}
+        if "__type__" in value:
+            name = value["__type__"]
+            cls = _MESSAGE_REGISTRY.get(name)
+            if cls is None:
+                raise ValueError(f"unknown wire message type: {name}")
+            kwargs = {
+                f.name: _decode_value(value[f.name])
+                for f in dataclasses.fields(cls)
+                if f.name in value
+            }
+            return cls(**kwargs)
+        return {k: _decode_value(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_decode_value(v) for v in value]
+    return value
+
+
+def dumps(msg: Any) -> bytes:
+    return json.dumps(_encode_value(msg), separators=(",", ":")).encode("utf-8")
+
+
+def loads(data: bytes) -> Any:
+    return _decode_value(json.loads(data.decode("utf-8")))

@@ -1,0 +1,60 @@
+"""Node status state machine.
+
+Role parity: ``dlrover/python/master/node/status_flow.py`` — a transition
+table that tells the job manager which (from, to) edges are legal and whether
+an edge should trigger a relaunch decision.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dlrover_tpu.common.constants import NodeStatus
+
+
+@dataclass(frozen=True)
+class NodeStateFlow:
+    from_status: str
+    to_status: str
+    should_relaunch: bool
+
+
+ALLOWED_TRANSITIONS = [
+    NodeStateFlow(NodeStatus.INITIAL, NodeStatus.PENDING, False),
+    NodeStateFlow(NodeStatus.INITIAL, NodeStatus.RUNNING, False),
+    NodeStateFlow(NodeStatus.INITIAL, NodeStatus.FAILED, True),
+    NodeStateFlow(NodeStatus.INITIAL, NodeStatus.DELETED, True),
+    NodeStateFlow(NodeStatus.PENDING, NodeStatus.RUNNING, False),
+    NodeStateFlow(NodeStatus.PENDING, NodeStatus.SUCCEEDED, False),
+    NodeStateFlow(NodeStatus.PENDING, NodeStatus.FAILED, True),
+    NodeStateFlow(NodeStatus.PENDING, NodeStatus.DELETED, True),
+    NodeStateFlow(NodeStatus.RUNNING, NodeStatus.SUCCEEDED, False),
+    NodeStateFlow(NodeStatus.RUNNING, NodeStatus.FAILED, True),
+    NodeStateFlow(NodeStatus.RUNNING, NodeStatus.DELETED, True),
+    NodeStateFlow(NodeStatus.RUNNING, NodeStatus.BREAKDOWN, True),
+    NodeStateFlow(NodeStatus.BREAKDOWN, NodeStatus.DELETED, True),
+    NodeStateFlow(NodeStatus.SUCCEEDED, NodeStatus.DELETED, False),
+    NodeStateFlow(NodeStatus.FAILED, NodeStatus.DELETED, False),
+]
+
+_TRANSITION_INDEX = {
+    (t.from_status, t.to_status): t for t in ALLOWED_TRANSITIONS
+}
+
+
+def get_node_state_flow(from_status: str, to_status: str):
+    """Return the flow for a transition, or None if it is not allowed.
+
+    Same-status events are ignored (None); arriving at DELETED from an
+    unknown intermediate state is always allowed (pods can vanish from any
+    state) and triggers a relaunch decision unless the node already ended.
+    """
+    if from_status == to_status:
+        return None
+    flow = _TRANSITION_INDEX.get((from_status, to_status))
+    if flow is not None:
+        return flow
+    if to_status == NodeStatus.DELETED:
+        ended = from_status in NodeStatus.end_states()
+        return NodeStateFlow(from_status, to_status, not ended)
+    return None

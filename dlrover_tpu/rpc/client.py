@@ -1,0 +1,81 @@
+"""Retrying gRPC client channel for master RPCs.
+
+Role parity: the stub + ``retry_grpc_request`` decorator of
+``dlrover/python/elastic_agent/master_client.py:28-48``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Optional
+
+import grpc
+
+from dlrover_tpu.common import serialize
+from dlrover_tpu.common.comm import Response
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.rpc.server import SERVICE_NAME
+
+logger = get_logger("rpc.client")
+
+
+def retry_rpc(retries: int = 5, backoff: float = 1.0):
+    """Retry transient RPC failures with linear backoff."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            last_exc: Optional[Exception] = None
+            for i in range(retries):
+                try:
+                    return fn(*args, **kwargs)
+                except grpc.RpcError as e:
+                    last_exc = e
+                    logger.warning(
+                        "rpc %s failed (%s), retry %d/%d",
+                        fn.__name__, e.code(), i + 1, retries,
+                    )
+                    time.sleep(backoff * (i + 1))
+            raise last_exc  # type: ignore[misc]
+
+        return wrapped
+
+    return decorator
+
+
+class RpcChannel:
+    """A thin two-method channel: ``get(msg)`` and ``report(msg)``."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self.addr = addr
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(
+            addr,
+            options=[
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ("grpc.enable_retries", 1),
+            ],
+        )
+        self._get = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/get",
+            request_serializer=serialize.dumps,
+            response_deserializer=serialize.loads,
+        )
+        self._report = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/report",
+            request_serializer=serialize.dumps,
+            response_deserializer=serialize.loads,
+        )
+
+    @retry_rpc()
+    def get(self, msg: Any) -> Any:
+        return self._get(msg, timeout=self._timeout)
+
+    @retry_rpc()
+    def report(self, msg: Any) -> Response:
+        return self._report(msg, timeout=self._timeout)
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,81 @@
+"""Codegen-free gRPC server for the master.
+
+Role parity: the gRPC plumbing of ``dlrover/python/master/servicer.py``
+(``create_master_service``). Instead of protoc-generated stubs we register a
+generic handler for a two-method service:
+
+  /dlrover_tpu.Master/get     — request message -> response message
+  /dlrover_tpu.Master/report  — request message -> Response(success)
+
+Both carry JSON-framed dataclass messages (``common.serialize``).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional, Tuple
+
+import grpc
+
+from dlrover_tpu.common import serialize
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("rpc.server")
+
+SERVICE_NAME = "dlrover_tpu.Master"
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, servicer):
+        self._servicer = servicer
+        self._methods = {
+            f"/{SERVICE_NAME}/get": servicer.get,
+            f"/{SERVICE_NAME}/report": servicer.report,
+        }
+
+    def service(self, handler_call_details):
+        method = self._methods.get(handler_call_details.method)
+        if method is None:
+            return None
+
+        def behavior(request, context):
+            return method(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            behavior,
+            request_deserializer=serialize.loads,
+            response_serializer=serialize.dumps,
+        )
+
+
+def build_server(
+    servicer,
+    port: int = 0,
+    max_workers: int = 64,
+    host: str = "0.0.0.0",
+) -> Tuple[grpc.Server, int]:
+    """Create (not start) a server; returns (server, bound_port)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+        ],
+    )
+    server.add_generic_rpc_handlers((_GenericHandler(servicer),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise RuntimeError(f"cannot bind master service on port {port}")
+    return server, bound
+
+
+def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
+    """Cheap reachability probe (the reference telnets the master addr)."""
+    import socket
+
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)), timeout):
+            return True
+    except (OSError, ValueError):
+        return False

@@ -1,0 +1,16 @@
+"""Test environment: force the JAX CPU backend with 8 virtual devices.
+
+Multi-chip semantics (meshes, collectives, shardings) are exercised on a
+virtual CPU mesh, mirroring the reference's gloo-on-CPU test strategy
+(`atorch/atorch/tests/test_utils.py`). Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")

@@ -1,0 +1,114 @@
+"""Unit tests for the common core: node model, state flow, codec, config."""
+
+import os
+
+import pytest
+
+from dlrover_tpu.common import comm, serialize
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import AcceleratorResource, Node, NodeGroupResource, NodeResource
+from dlrover_tpu.common.status_flow import get_node_state_flow
+
+
+class TestStatusFlow:
+    def test_allowed_transitions(self):
+        flow = get_node_state_flow(NodeStatus.PENDING, NodeStatus.RUNNING)
+        assert flow is not None and not flow.should_relaunch
+        flow = get_node_state_flow(NodeStatus.RUNNING, NodeStatus.FAILED)
+        assert flow is not None and flow.should_relaunch
+
+    def test_same_status_ignored(self):
+        assert get_node_state_flow(NodeStatus.RUNNING, NodeStatus.RUNNING) is None
+
+    def test_deleted_from_anywhere(self):
+        flow = get_node_state_flow(NodeStatus.BREAKDOWN, NodeStatus.DELETED)
+        assert flow is not None and flow.should_relaunch
+        flow = get_node_state_flow(NodeStatus.SUCCEEDED, NodeStatus.DELETED)
+        assert flow is not None and not flow.should_relaunch
+
+    def test_illegal_transition(self):
+        assert get_node_state_flow(NodeStatus.FAILED, NodeStatus.RUNNING) is None
+
+
+class TestNode:
+    def test_lifecycle(self):
+        node = Node(NodeType.WORKER, 3, max_relaunch_count=2)
+        node.update_status(NodeStatus.RUNNING)
+        assert node.start_time is not None
+        assert not node.exited()
+        node.update_status(NodeStatus.FAILED)
+        assert node.exited()
+
+    def test_unrecoverable(self):
+        node = Node(NodeType.WORKER, 0, max_relaunch_count=1)
+        assert not node.is_unrecoverable_failure()
+        node.inc_relaunch_count()
+        assert node.is_unrecoverable_failure()
+        node2 = Node(NodeType.WORKER, 1)
+        node2.exit_reason = NodeExitReason.FATAL_ERROR
+        assert node2.is_unrecoverable_failure()
+
+    def test_relaunch_clone(self):
+        node = Node(NodeType.WORKER, 0, rank_index=7, slice_index=1)
+        clone = node.get_relaunch_node(new_id=10)
+        assert clone.id == 10
+        assert clone.rank_index == 7
+        assert clone.slice_index == 1
+        assert clone.relaunch_count == 1
+
+    def test_group_resource_update(self):
+        group = NodeGroupResource(
+            2, NodeResource(4.0, 8192, AcceleratorResource("tpu", 4, "2x2x1"))
+        )
+        group.update(count=4, memory=16384)
+        assert group.count == 4
+        assert group.node_resource.memory == 16384
+        assert group.node_resource.cpu == 4.0
+
+
+class TestSerialize:
+    def test_roundtrip_nested(self):
+        task = comm.Task(
+            task_id=5,
+            task_type="training",
+            shard=comm.Shard(name="ds", start=100, end=200),
+            epoch=2,
+        )
+        restored = serialize.loads(serialize.dumps(task))
+        assert restored == task
+        assert restored.shard.end == 200
+
+    def test_roundtrip_int_keyed_world(self):
+        world = comm.CommWorld(round=3, world={0: 4, 2: 4, 5: 4})
+        restored = serialize.loads(serialize.dumps(world))
+        assert restored.world == {0: 4, 2: 4, 5: 4}
+        assert all(isinstance(k, int) for k in restored.world)
+
+    def test_response_with_payload(self):
+        resp = comm.Response(data=comm.KVStoreValue(key="k", value="v", found=True))
+        restored = serialize.loads(serialize.dumps(resp))
+        assert restored.data.found
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError):
+            serialize.loads(b'{"__type__": "Evil", "x": 1}')
+
+
+class TestContext:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_RDZV_TIMEOUT_SECS", "42")
+        monkeypatch.setenv("DLROVER_TPU_AUTO_SCALE_ENABLED", "false")
+        ctx = Context()
+        assert ctx.rdzv_timeout_secs == 42
+        assert ctx.auto_scale_enabled is False
+
+    def test_runtime_override(self):
+        ctx = Context()
+        ctx.set_params({"hang_detection_secs": 60, "_private": 1, "nope": 2})
+        assert ctx.hang_detection_secs == 60
+        assert not hasattr(ctx, "nope")
