@@ -1,0 +1,250 @@
+"""Typed client for every master RPC.
+
+Role parity: ``dlrover/python/elastic_agent/master_client.py:51-487`` — the
+one object agents/trainers use to talk to the master, with retries, plus the
+process-wide singleton built from the ``DLROVER_TPU_MASTER_ADDR`` env var.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.rpc.client import RpcChannel
+
+logger = get_logger("agent.client")
+
+
+class MasterClient:
+    def __init__(self, addr: str, node_id: int = 0,
+                 node_type: str = "worker", timeout: float = 30.0):
+        self.addr = addr
+        self.node_id = node_id
+        self.node_type = node_type
+        self._channel = RpcChannel(addr, timeout=timeout)
+
+    # -- data sharding ------------------------------------------------------
+
+    def report_dataset_shard_params(self, **kwargs) -> comm.Response:
+        return self._channel.report(comm.DatasetShardParams(**kwargs))
+
+    def get_task(self, dataset_name: str) -> comm.Task:
+        return self._channel.get(
+            comm.TaskRequest(dataset_name=dataset_name, node_id=self.node_id)
+        )
+
+    def report_task_result(self, dataset_name: str, task_id: int,
+                           err_message: str = "") -> comm.Response:
+        return self._channel.report(comm.TaskResult(
+            dataset_name=dataset_name, task_id=task_id,
+            err_message=err_message, node_id=self.node_id,
+        ))
+
+    def report_batch_done(self, dataset_name: str,
+                          record_count: int) -> comm.Response:
+        return self._channel.report(comm.BatchDoneReport(
+            dataset_name=dataset_name, node_id=self.node_id,
+            record_count=record_count,
+        ))
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self._channel.get(
+            comm.ShardCheckpointRequest(dataset_name=dataset_name)
+        )
+        return resp.content
+
+    def report_shard_checkpoint(self, dataset_name: str,
+                                content: str) -> comm.Response:
+        return self._channel.report(comm.ShardCheckpoint(
+            dataset_name=dataset_name, content=content
+        ))
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def report_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float, node_unit: int,
+                           rdzv_name: str = "") -> comm.Response:
+        return self._channel.report(comm.RendezvousParams(
+            min_nodes=min_nodes, max_nodes=max_nodes,
+            waiting_timeout=waiting_timeout, node_unit=node_unit,
+            rdzv_name=rdzv_name,
+        ))
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int,
+                        rdzv_name: str = RendezvousName.TRAINING,
+                        addr: str = "", slice_index: int = 0) -> int:
+        resp = self._channel.report(comm.JoinRendezvousRequest(
+            node_rank=node_rank, local_world_size=local_world_size,
+            rdzv_name=rdzv_name, node_id=self.node_id, addr=addr,
+            slice_index=slice_index,
+        ))
+        if resp.data is not None:
+            return resp.data.round
+        return 0
+
+    def get_comm_world(
+        self, rdzv_name: str = RendezvousName.TRAINING, node_rank: int = -1
+    ) -> comm.CommWorld:
+        return self._channel.get(comm.CommWorldRequest(
+            rdzv_name=rdzv_name, node_rank=node_rank
+        ))
+
+    def num_nodes_waiting(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> int:
+        state = self._channel.get(
+            comm.WaitingNodeNumRequest(rdzv_name=rdzv_name)
+        )
+        return state.waiting_num
+
+    def network_ready(self) -> Tuple[bool, str]:
+        resp = self._channel.get(comm.NetworkReadyRequest())
+        return resp.success, resp.reason
+
+    def report_network_check_result(self, node_rank: int, normal: bool,
+                                    elapsed: float = 0.0) -> comm.Response:
+        return self._channel.report(comm.NetworkCheckResult(
+            node_rank=node_rank, normal=normal, elapsed_time=elapsed
+        ))
+
+    def straggler_ranks(self) -> List[int]:
+        resp = self._channel.get(comm.StragglerExistRequest())
+        if not resp.reason:
+            return []
+        return [int(r) for r in resp.reason.split(",")]
+
+    # -- kv / sync ----------------------------------------------------------
+
+    def kv_store_set(self, key: str, value: str) -> comm.Response:
+        return self._channel.report(
+            comm.KVStoreSetRequest(key=key, value=value)
+        )
+
+    def kv_store_get(self, key: str) -> Optional[str]:
+        val = self._channel.get(comm.KVStoreGetRequest(key=key))
+        return val.value if val.found else None
+
+    def kv_store_add(self, key: str, amount: int) -> int:
+        val = self._channel.get(
+            comm.KVStoreAddRequest(key=key, amount=amount)
+        )
+        return int(val.value)
+
+    def join_sync(self, sync_name: str, node_rank: int) -> bool:
+        return self._channel.report(comm.SyncJoinRequest(
+            sync_name=sync_name, node_rank=node_rank
+        )).success
+
+    def sync_finished(self, sync_name: str) -> bool:
+        return self._channel.get(
+            comm.SyncJoinRequest(sync_name=sync_name)
+        ).success
+
+    def barrier(self, barrier_name: str, notify: bool = False) -> bool:
+        if notify:
+            return self._channel.report(comm.BarrierRequest(
+                barrier_name=barrier_name, notify=True
+            )).success
+        return self._channel.get(
+            comm.BarrierRequest(barrier_name=barrier_name)
+        ).success
+
+    # -- monitoring / failures ---------------------------------------------
+
+    def report_failure(self, node_rank: int, restart_count: int,
+                       error_data: str, level: str) -> comm.Response:
+        return self._channel.report(comm.NodeFailure(
+            node_id=self.node_id, node_rank=node_rank,
+            restart_count=restart_count, error_data=error_data, level=level,
+        ))
+
+    def report_resource(self, cpu_percent: float, memory_mb: int,
+                        chips: int = 0, duty_cycle: float = 0.0):
+        return self._channel.report(comm.ResourceStats(
+            node_id=self.node_id, node_type=self.node_type,
+            cpu_percent=cpu_percent, memory_mb=memory_mb, chips=chips,
+            duty_cycle=duty_cycle,
+        ))
+
+    def report_global_step(self, step: int,
+                           elapsed_per_step: float = 0.0) -> comm.Response:
+        return self._channel.report(comm.GlobalStep(
+            step=step, timestamp=time.time(),
+            elapsed_time_per_step=elapsed_per_step,
+        ))
+
+    def report_heartbeat(self) -> comm.Response:
+        return self._channel.report(comm.NodeHeartbeat(
+            node_id=self.node_id, timestamp=time.time()
+        ))
+
+    def report_node_status(self, status: str) -> comm.Response:
+        return self._channel.report(comm.NodeStatusReport(
+            node_id=self.node_id, node_type=self.node_type, status=status
+        ))
+
+    def report_model_info(self, info: comm.ModelInfo) -> comm.Response:
+        return self._channel.report(info)
+
+    # -- PS parity ----------------------------------------------------------
+
+    def get_cluster_version(self, version_type: str, task_type: str,
+                            task_id: int) -> int:
+        resp = self._channel.get(comm.ClusterVersionRequest(
+            task_type=task_type, task_id=task_id, version_type=version_type
+        ))
+        return resp.version
+
+    def update_cluster_version(self, version_type: str, version: int,
+                               task_type: str, task_id: int):
+        return self._channel.report(comm.ClusterVersionUpdate(
+            task_type=task_type, task_id=task_id,
+            version_type=version_type, version=version,
+        ))
+
+    def query_ps_nodes(self) -> comm.PsNodes:
+        return self._channel.get(comm.QueryPsNodesRequest())
+
+    # -- parallel config / job control --------------------------------------
+
+    def get_parallel_config(self) -> comm.ParallelConfig:
+        return self._channel.get(
+            comm.ParallelConfigRequest(node_id=self.node_id)
+        )
+
+    def report_parallel_config(self, cfg: comm.ParallelConfig):
+        return self._channel.report(cfg)
+
+    def report_job_exit(self, success: bool, reason: str = "") -> comm.Response:
+        return self._channel.report(comm.JobExitRequest(
+            node_id=self.node_id, success=success, reason=reason
+        ))
+
+    def close(self):
+        self._channel.close()
+
+
+_GLOBAL_CLIENT: Optional[MasterClient] = None
+
+
+def build_master_client(addr: Optional[str] = None, node_id: int = 0,
+                        node_type: str = "worker") -> Optional[MasterClient]:
+    """Build (and cache) the process-wide client from env if addr omitted."""
+    global _GLOBAL_CLIENT
+    addr = addr or os.environ.get(NodeEnv.MASTER_ADDR, "")
+    if not addr:
+        return None
+    _GLOBAL_CLIENT = MasterClient(
+        addr,
+        node_id=int(os.environ.get(NodeEnv.NODE_ID, node_id)),
+        node_type=node_type,
+    )
+    return _GLOBAL_CLIENT
+
+
+def global_master_client() -> Optional[MasterClient]:
+    return _GLOBAL_CLIENT

@@ -86,6 +86,13 @@ class TaskResult:
 
 
 @message
+class BatchDoneReport:
+    dataset_name: str = ""
+    node_id: int = -1
+    record_count: int = 0
+
+
+@message
 class ShardCheckpointRequest:
     dataset_name: str = ""
 
@@ -119,6 +126,7 @@ class JoinRendezvousRequest:
     rdzv_name: str = ""
     node_id: int = -1
     slice_index: int = 0
+    addr: str = ""  # host addr usable as jax.distributed coordinator
 
 
 @message

@@ -67,7 +67,12 @@ class Context:
                 else:
                     setattr(self, name, env)
             except ValueError:
-                pass
+                import logging
+
+                logging.getLogger("dlrover_tpu").warning(
+                    "ignoring malformed env override DLROVER_TPU_%s=%r",
+                    name.upper(), env,
+                )
 
     def set_params(self, params: Dict[str, Any]):
         """Runtime override (the reference's ``set_params_from_brain``)."""

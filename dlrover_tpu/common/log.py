@@ -13,7 +13,10 @@ def _build_logger() -> logging.Logger:
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter(_FORMAT))
         logger.addHandler(handler)
-        logger.setLevel(os.environ.get("DLROVER_TPU_LOG_LEVEL", "INFO"))
+        level = os.environ.get("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
+        if level not in logging._nameToLevel:
+            level = "INFO"
+        logger.setLevel(level)
         logger.propagate = False
     return logger
 

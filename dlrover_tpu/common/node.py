@@ -12,6 +12,7 @@ carry a ``slice_index`` so rendezvous can keep worlds whole-slice
 
 from __future__ import annotations
 
+import copy
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
@@ -159,7 +160,7 @@ class Node:
             node_id=new_id,
             rank_index=self.rank_index,
             status=NodeStatus.INITIAL,
-            config_resource=self.config_resource,
+            config_resource=copy.deepcopy(self.config_resource),
             max_relaunch_count=self.max_relaunch_count,
             critical=self.critical,
             slice_index=self.slice_index,

@@ -29,7 +29,13 @@ def registered_messages() -> Dict[str, Type]:
 
 def _encode_value(value: Any) -> Any:
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
-        out = {"__type__": type(value).__name__}
+        name = type(value).__name__
+        if _MESSAGE_REGISTRY.get(name) is not type(value):
+            raise ValueError(
+                f"{name} is not a registered wire message; decorate it with "
+                "@serialize.message to send it"
+            )
+        out = {"__type__": name}
         for f in dataclasses.fields(value):
             out[f.name] = _encode_value(getattr(value, f.name))
         return out

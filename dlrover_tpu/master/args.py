@@ -1,0 +1,29 @@
+"""Master CLI arguments (role parity: ``dlrover/python/master/args.py``)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_master_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="dlrover_tpu job master")
+    parser.add_argument(
+        "--platform", default="local", choices=["local", "k8s", "ray"],
+        help="scheduling platform hosting the job nodes",
+    )
+    parser.add_argument("--job_name", default="dlrover-tpu-job")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument(
+        "--port", type=int, default=0,
+        help="RPC port (0 picks a free port, printed on stdout)",
+    )
+    parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument(
+        "--timeout", type=float, default=0.0,
+        help="exit with failure if the job outlives this many seconds (0=off)",
+    )
+    return parser
+
+
+def parse_master_args(argv=None):
+    return build_master_parser().parse_args(argv)

@@ -1,0 +1,48 @@
+"""Elastic PS cluster-version handshake.
+
+Role parity: ``dlrover/python/master/elastic_training/elastic_ps.py`` — for
+parameter-server jobs, workers/PS negotiate a monotonically increasing
+cluster version so every process agrees which PS membership it is running
+against after a migration or scale event.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class ElasticPsService:
+    GLOBAL = "global"
+    LOCAL = "local"
+    RESTORED = "restored"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._global_version = 0
+        self._node_versions: Dict[str, Dict[int, Dict[str, int]]] = {}
+
+    def inc_global_cluster_version(self):
+        with self._lock:
+            self._global_version += 1
+
+    def get_cluster_version(self, version_type: str, task_type: str,
+                            task_id: int) -> int:
+        with self._lock:
+            if version_type == self.GLOBAL:
+                return self._global_version
+            return (
+                self._node_versions.get(task_type, {})
+                .get(task_id, {})
+                .get(version_type, 0)
+            )
+
+    def update_cluster_version(self, version_type: str, version: int,
+                               task_type: str, task_id: int):
+        with self._lock:
+            if version_type == self.GLOBAL:
+                self._global_version = version
+                return
+            self._node_versions.setdefault(task_type, {}).setdefault(
+                task_id, {}
+            )[version_type] = version

@@ -1,0 +1,45 @@
+"""Master-hosted KV store.
+
+Role parity: ``dlrover/python/master/elastic_training/kv_store_service.py``.
+Agents use it as a tiny coordination store scoped per rendezvous round
+(prefix keys); training processes bootstrap jax.distributed from the
+coordinator address instead, so this store stays off the hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class KVStoreService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, str] = {}
+
+    def set(self, key: str, value: str):
+        with self._lock:
+            self._store[key] = value
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._store.get(key)
+
+    def add(self, key: str, amount: int) -> int:
+        """Atomic counter add; returns the new value."""
+        with self._lock:
+            val = int(self._store.get(key, "0")) + amount
+            self._store[key] = str(val)
+            return val
+
+    def delete(self, key: str):
+        with self._lock:
+            self._store.pop(key, None)
+
+    def clear(self, prefix: str = ""):
+        with self._lock:
+            if not prefix:
+                self._store.clear()
+            else:
+                for k in [k for k in self._store if k.startswith(prefix)]:
+                    del self._store[k]

@@ -1,0 +1,367 @@
+"""Master-side rendezvous for elastic SPMD training.
+
+Role parity: ``dlrover/python/master/elastic_training/rdzv_manager.py:52-388``
+(ElasticTrainingRendezvousManager, NetworkCheckRendezvousManager). Semantics
+preserved: a waiting pool completes a round when all max_nodes arrived, or
+min_nodes arrived and the waiting timeout passed (rounded down to a multiple
+of ``node_unit``); agents poll ``get_comm_world`` until their rank appears.
+
+TPU-first differences:
+  * The world handout includes a **jax.distributed coordinator address** (the
+    host of the smallest participating rank) — workers bootstrap XLA's
+    coordination service from it, in place of the reference handing out a
+    torch c10d store.
+  * ``node_unit`` is the number of hosts per TPU slice: worlds are trimmed to
+    whole slices so every ICI domain is either fully in or fully out.
+  * The network check is an ICI/DCN allgather probe; its 2-round paired
+    diagnosis grouping (suspects paired with known-good nodes in round 1) is
+    kept intact, as it is topology-agnostic.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from abc import ABC, abstractmethod
+from threading import Lock
+from typing import Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("master.rdzv")
+
+_ctx = get_context()
+
+
+class RendezvousParameters:
+    def __init__(self, min_nodes: int = 0, max_nodes: int = 0,
+                 waiting_timeout: float = 30.0):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.waiting_timeout = waiting_timeout
+
+
+class WaitingNode:
+    """A node sitting in the rendezvous waiting pool."""
+
+    def __init__(self, rank: int, local_world_size: int, node_id: int = -1,
+                 addr: str = "", slice_index: int = 0):
+        self.rank = rank
+        self.local_world_size = local_world_size
+        self.node_id = node_id
+        self.addr = addr
+        self.slice_index = slice_index
+
+
+class RendezvousManager(ABC):
+    def __init__(self):
+        self._lock = Lock()
+        self._name = ""
+        self._alive_nodes: Set[int] = set()
+        self._waiting_nodes: Dict[int, WaitingNode] = {}
+        self._rdzv_nodes: Dict[int, WaitingNode] = {}
+        self._latest_rdzv_ranks: List[int] = []
+        self._rdzv_params = RendezvousParameters()
+        self._node_unit = 1
+        self._rdzv_round = 0
+        self._lastcall_time = 0.0
+
+    # -- node lifecycle hooks (called by the job manager) -------------------
+
+    def add_alive_node(self, node_id: int):
+        with self._lock:
+            self._alive_nodes.add(node_id)
+
+    def remove_alive_node(self, node_id: int):
+        with self._lock:
+            self._alive_nodes.discard(node_id)
+            for rank, wn in list(self._waiting_nodes.items()):
+                if wn.node_id == node_id:
+                    self._waiting_nodes.pop(rank, None)
+
+    def update_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float, node_unit: int):
+        with self._lock:
+            self._rdzv_params.min_nodes = min_nodes
+            self._rdzv_params.max_nodes = max_nodes
+            self._rdzv_params.waiting_timeout = waiting_timeout
+            self._node_unit = max(1, node_unit)
+            logger.info(
+                "%s rdzv params: min=%d max=%d timeout=%.1f node_unit=%d",
+                self._name, min_nodes, max_nodes, waiting_timeout, node_unit,
+            )
+
+    def rdzv_params_set(self) -> bool:
+        return self._rdzv_params.max_nodes > 0
+
+    # -- the rendezvous protocol -------------------------------------------
+
+    def join_rendezvous(self, rank: int, local_world_size: int,
+                        node_id: int = -1, addr: str = "",
+                        slice_index: int = 0) -> int:
+        """Add a node to the waiting pool; returns the current round."""
+        with self._lock:
+            if rank not in self._waiting_nodes:
+                self._waiting_nodes[rank] = WaitingNode(
+                    rank, local_world_size, node_id, addr, slice_index
+                )
+                self._on_join()
+                self._rdzv_nodes = {}
+                self._lastcall_time = time.time()
+                logger.info(
+                    "%s: rank %d joined; waiting=%s", self._name, rank,
+                    sorted(self._waiting_nodes),
+                )
+        return self._rdzv_round
+
+    def _on_join(self):
+        """Subclass hook invoked (under lock) when a new node joins."""
+
+    def _check_rdzv_completed(self) -> bool:
+        """Complete the round if possible; moves waiting -> rdzv nodes.
+
+        Completion rule (reference ``_check_rdzv_completed:106``): everyone
+        arrived, or >= min_nodes arrived and no new join for waiting_timeout.
+        The admitted set is the lowest ``k*node_unit`` ranks so TPU slices
+        stay whole.
+        """
+        waiting_num = len(self._waiting_nodes)
+        if waiting_num == 0 or not self.rdzv_params_set():
+            return False
+        completed = False
+        if waiting_num >= self._rdzv_params.max_nodes:
+            completed = True
+            waiting_num = self._rdzv_params.max_nodes
+        else:
+            elapsed = time.time() - self._lastcall_time
+            if (
+                waiting_num >= self._rdzv_params.min_nodes
+                and elapsed >= self._rdzv_params.waiting_timeout
+            ):
+                completed = True
+        if not completed:
+            return False
+        waiting_num = (waiting_num // self._node_unit) * self._node_unit
+        if waiting_num < max(1, self._rdzv_params.min_nodes):
+            return False
+        admitted = sorted(self._waiting_nodes)[:waiting_num]
+        self._rdzv_nodes = {r: self._waiting_nodes[r] for r in admitted}
+        self._latest_rdzv_ranks = admitted
+        for r in admitted:
+            self._waiting_nodes.pop(r)
+        self._lastcall_time = 0.0
+        logger.info(
+            "%s: round %d completed with ranks %s",
+            self._name, self._rdzv_round, admitted,
+        )
+        return True
+
+    def world_dict(self) -> Dict[int, int]:
+        return {r: wn.local_world_size for r, wn in self._rdzv_nodes.items()}
+
+    def coordinator_addr(self) -> str:
+        """Host of the smallest rank in the completed world."""
+        if not self._rdzv_nodes:
+            return ""
+        return self._rdzv_nodes[min(self._rdzv_nodes)].addr
+
+    def num_nodes_waiting(self) -> int:
+        """Nonzero tells agents to restart workers into a new world.
+
+        A *re-joining* node (was in the last completed world) always forces
+        a restart; brand-new nodes only once a whole node_unit (slice) of
+        them is available (reference ``num_nodes_waiting:169``).
+        """
+        with self._lock:
+            if any(
+                r in self._latest_rdzv_ranks for r in self._waiting_nodes
+            ):
+                return len(self._waiting_nodes)
+            if len(self._waiting_nodes) >= self._node_unit:
+                return len(self._waiting_nodes)
+            return 0
+
+    def not_joined_rdzv_nodes(self) -> List[int]:
+        with self._lock:
+            if not self._rdzv_nodes:
+                return []
+            joined = {wn.node_id for wn in self._rdzv_nodes.values()}
+            return [n for n in self._alive_nodes if n not in joined]
+
+    @property
+    def rdzv_round(self) -> int:
+        return self._rdzv_round
+
+    @abstractmethod
+    def get_comm_world(self, rank: int) -> Tuple[int, int, Dict[int, int], str]:
+        """Returns (round, group, world, coordinator_addr)."""
+
+    @abstractmethod
+    def report_network_check_result(self, rank: int, normal: bool,
+                                    elapsed: float = 0.0):
+        ...
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """The rendezvous agents use to (re)build the training world."""
+
+    def __init__(self):
+        super().__init__()
+        self._name = RendezvousName.TRAINING
+
+    def get_comm_world(self, rank: int) -> Tuple[int, int, Dict[int, int], str]:
+        with self._lock:
+            if not self._rdzv_nodes:
+                if self._check_rdzv_completed():
+                    self._rdzv_round += 1
+            return (
+                self._rdzv_round,
+                0,
+                self.world_dict(),
+                self.coordinator_addr(),
+            )
+
+    def report_network_check_result(self, rank, normal, elapsed=0.0):
+        pass
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Paired-allgather fault localization over ICI/DCN.
+
+    Two probe rounds per check (reference ``NetworkCheckRendezvousManager``):
+      round 0: nodes paired (i, i+1); each pair runs an allgather probe.
+      round 1: each suspect from round 0 is re-paired with a known-good
+               node; a node failing both rounds is the faulty one.
+    """
+
+    CHECK_ROUNDS = 2
+
+    def __init__(self):
+        super().__init__()
+        self._name = RendezvousName.NETWORK_CHECK
+        self._node_status: Dict[int, bool] = {}
+        self._node_times: Dict[int, float] = {}
+        self._reported_nodes: Set[int] = set()
+        self._node_groups: List[Dict[int, int]] = []
+
+    def _on_join(self):
+        self._node_groups = []
+
+    def get_comm_world(self, rank: int) -> Tuple[int, int, Dict[int, int], str]:
+        with self._lock:
+            if not self._node_groups:
+                if self._check_rdzv_completed():
+                    self._node_groups = self._group_nodes(self._rdzv_round)
+                    logger.info(
+                        "network-check round %d groups: %s",
+                        self._rdzv_round, self._node_groups,
+                    )
+                    if self._rdzv_round % self.CHECK_ROUNDS == 0:
+                        self._node_status = {}
+                        self._node_times = {}
+                    self._reported_nodes = set()
+                    self._rdzv_round += 1
+            for i, group in enumerate(self._node_groups):
+                if rank in group:
+                    addr = ""
+                    if self._rdzv_nodes:
+                        addr = self._rdzv_nodes[min(group)].addr
+                    return self._rdzv_round, i, group, addr
+            return self._rdzv_round, 0, self.world_dict(), self.coordinator_addr()
+
+    def _group_nodes(self, rdzv_round: int) -> List[Dict[int, int]]:
+        rdzv_round = rdzv_round % self.CHECK_ROUNDS
+        groups: List[Dict[int, int]] = []
+        world = self.world_dict()
+        if rdzv_round == 0:
+            group: Dict[int, int] = {}
+            for r in sorted(world):
+                group[r] = world[r]
+                if len(group) == 2:
+                    groups.append(group)
+                    group = {}
+            if group:
+                if groups:
+                    groups[-1].update(group)
+                else:
+                    groups.append(group)
+        else:
+            suspects = [r for r, ok in self._node_status.items() if not ok]
+            normals = [r for r, ok in self._node_status.items() if ok]
+            if len(suspects) > len(normals):
+                # cannot pair every suspect with a good node; whole-fabric
+                # problem — leave groups empty so the check fails loudly.
+                logger.warning(
+                    "network-check: %d suspects > %d normal nodes",
+                    len(suspects), len(normals),
+                )
+                return groups
+            for i, suspect in enumerate(suspects):
+                groups.append({
+                    suspect: world.get(suspect, 1),
+                    normals[i]: world.get(normals[i], 1),
+                })
+            rest = {
+                r: world.get(r, 1) for r in normals[len(suspects):]
+            }
+            if rest:
+                groups.append(rest)
+        return groups
+
+    def join_rendezvous(self, rank, local_world_size, node_id=-1, addr="",
+                        slice_index=0) -> int:
+        return super().join_rendezvous(
+            rank, local_world_size, node_id, addr, slice_index
+        )
+
+    def report_network_check_result(self, rank: int, normal: bool,
+                                    elapsed: float = 0.0):
+        with self._lock:
+            self._reported_nodes.add(rank)
+            self._node_status[rank] = self._node_status.get(rank, False) or normal
+            if elapsed:
+                self._node_times[rank] = elapsed
+            if len(self._reported_nodes) == len(self._rdzv_nodes):
+                logger.info(
+                    "network-check statuses after round %d: %s",
+                    self._rdzv_round, self._node_status,
+                )
+
+    def network_check_success(self) -> Tuple[bool, str]:
+        """(success, reason); reason is WAITING_NODE while reports pending."""
+        with self._lock:
+            if len(self._reported_nodes) < len(self._rdzv_nodes) or not \
+                    self._rdzv_nodes:
+                return False, "waiting"
+            success = bool(self._node_status) and all(
+                self._node_status.values()
+            )
+            if success:
+                # snap the round forward to a multiple of CHECK_ROUNDS so the
+                # next check starts a fresh 2-round cycle.
+                self._rdzv_round = (
+                    math.ceil(self._rdzv_round / self.CHECK_ROUNDS)
+                    * self.CHECK_ROUNDS
+                )
+                return True, ""
+            return False, "node-failure"
+
+    def abnormal_nodes(self) -> List[int]:
+        with self._lock:
+            return [r for r, ok in self._node_status.items() if not ok]
+
+    def straggler_nodes(self, slow_factor: float = 2.0) -> List[int]:
+        """Ranks whose probe time exceeds slow_factor x median."""
+        with self._lock:
+            if len(self._node_times) < 2:
+                return []
+            times = sorted(self._node_times.values())
+            median = times[len(times) // 2]
+            if median <= 0:
+                return []
+            return [
+                r for r, t in self._node_times.items()
+                if t > slow_factor * median
+            ]

@@ -1,0 +1,58 @@
+"""Named barriers/joins across workers.
+
+Role parity: ``dlrover/python/master/elastic_training/sync_service.py`` —
+used by failover flows that need all live workers to reach a point before
+the job proceeds (e.g. PS cluster refresh, coordinated restart).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Set
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("master.sync")
+
+
+class SyncService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._syncs: Dict[str, Set[int]] = {}
+        self._finished_syncs: Set[str] = set()
+        self._barriers: Set[str] = set()
+        self._expected_count = 0
+
+    def set_expected_count(self, count: int):
+        with self._lock:
+            self._expected_count = count
+
+    def join_sync(self, sync_name: str, node_rank: int) -> bool:
+        """A worker joins a named sync point; True once all have joined."""
+        with self._lock:
+            members = self._syncs.setdefault(sync_name, set())
+            members.add(node_rank)
+            if self._expected_count and len(members) >= self._expected_count:
+                self._finished_syncs.add(sync_name)
+            return sync_name in self._finished_syncs
+
+    def sync_finished(self, sync_name: str) -> bool:
+        with self._lock:
+            return sync_name in self._finished_syncs
+
+    def force_finish(self, sync_name: str):
+        with self._lock:
+            self._finished_syncs.add(sync_name)
+
+    def notify_barrier(self, barrier_name: str):
+        with self._lock:
+            self._barriers.add(barrier_name)
+
+    def barrier_reached(self, barrier_name: str) -> bool:
+        with self._lock:
+            return barrier_name in self._barriers
+
+    def remove_exited_worker(self, node_rank: int):
+        with self._lock:
+            for members in self._syncs.values():
+                members.discard(node_rank)

@@ -1,0 +1,86 @@
+"""In-process job master for standalone mode and tests.
+
+Role parity: ``dlrover/python/master/local_master.py`` — the master without
+any cluster scheduler: rendezvous, data sharding, speed monitoring and the
+RPC server, driving training on the local host (or N simulated agents in
+tests). The distributed master (``dist_master.py``) adds node lifecycle
+management and auto-scaling on top.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+from dlrover_tpu.master.elastic_training.kv_store import KVStoreService
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.rpc.server import build_server
+
+logger = get_logger("master.local")
+
+
+class LocalJobMaster:
+    def __init__(self, port: int = 0, job_name: str = "local"):
+        self.job_name = job_name
+        self.speed_monitor = SpeedMonitor()
+        self.task_manager = TaskManager(self.speed_monitor)
+        self.rdzv_managers: Dict[str, RendezvousManager] = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.elastic_ps_service = ElasticPsService()
+        self.servicer = MasterServicer(
+            task_manager=self.task_manager,
+            rdzv_managers=self.rdzv_managers,
+            speed_monitor=self.speed_monitor,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            elastic_ps_service=self.elastic_ps_service,
+        )
+        self._server, self.port = build_server(self.servicer, port=port)
+        self.addr = f"127.0.0.1:{self.port}"
+        self._stopped = threading.Event()
+
+    def prepare(self):
+        self._server.start()
+        self.task_manager.start()
+        logger.info("local master serving at %s", self.addr)
+
+    def run(self, poll_secs: float = 1.0) -> int:
+        """Block until the job exits; returns an exit code."""
+        try:
+            while not self._stopped.is_set():
+                if self.servicer.job_exit_requested:
+                    ok = self.servicer.job_success
+                    logger.info("job exit requested (success=%s)", ok)
+                    return 0 if ok else 1
+                time.sleep(poll_secs)
+            return 0
+        finally:
+            self.stop()
+
+    def stop(self):
+        self._stopped.set()
+        self.task_manager.stop()
+        self._server.stop(grace=1)
+
+
+def start_local_master(port: int = 0) -> LocalJobMaster:
+    """Boot a ready-to-serve local master (the tests' entry point)."""
+    master = LocalJobMaster(port=port)
+    master.prepare()
+    return master

@@ -1,0 +1,57 @@
+"""Master process entry point.
+
+Role parity: ``dlrover/python/master/main.py`` — parse args, build the
+master for the platform, serve. Prints ``DLROVER_TPU_MASTER_ADDR=<addr>`` on
+stdout once serving so a parent (the standalone launcher) can scrape it.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.args import parse_master_args
+from dlrover_tpu.master.local_master import LocalJobMaster
+
+logger = get_logger("master.main")
+
+
+def run(args) -> int:
+    if args.platform == "local":
+        master = LocalJobMaster(port=args.port, job_name=args.job_name)
+    else:
+        # the distributed (k8s/ray) master composes a job manager + scaler on
+        # top of the local master's services; built in dist_master.py.
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+
+        master = DistributedJobMaster(
+            port=args.port, job_name=args.job_name, platform=args.platform,
+            node_num=args.node_num,
+        )
+    master.prepare()
+    print(f"DLROVER_TPU_MASTER_ADDR={master.addr}", flush=True)
+    if args.timeout > 0:
+        deadline = time.time() + args.timeout
+
+        def _watchdog():
+            while time.time() < deadline:
+                time.sleep(1)
+                if master.servicer.job_exit_requested:
+                    return
+            logger.error("master timeout after %.0fs", args.timeout)
+            master.servicer.job_success = False
+            master.servicer.job_exit_requested = True
+
+        import threading
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+    return master.run()
+
+
+def main(argv=None) -> int:
+    return run(parse_master_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Training speed tracking on the master.
+
+Role parity: ``dlrover/python/master/monitor/speed_monitor.py:43-193`` —
+global-step reports become a steps/s series; the auto-scaler asks it whether
+the current worker membership has run long enough to be judged
+(``worker_adjustment_finished``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.config import get_context
+
+
+class SpeedMonitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        ctx = get_context()
+        self._max_records = ctx.train_speed_record_num
+        # (timestamp, global_step) samples
+        self._global_step_records: Deque[Tuple[float, int]] = deque(
+            maxlen=self._max_records
+        )
+        self._global_step = 0
+        self._init_time = time.time()
+        self._start_training_time: Optional[float] = None
+        self._sample_count = 0
+        self._completed_records = 0
+        self._running_workers: Set[int] = set()
+        self._worker_adjust_time = time.time()
+        self._max_worker_num = 0
+
+    # -- step reports -------------------------------------------------------
+
+    def collect_global_step(self, step: int, timestamp: Optional[float] = None):
+        with self._lock:
+            if self._start_training_time is None:
+                self._start_training_time = time.time()
+            ts = timestamp or time.time()
+            self._global_step = max(self._global_step, step)
+            self._global_step_records.append((ts, step))
+            self._sample_count += 1
+
+    def mark_task_completed(self, record_count: int):
+        with self._lock:
+            self._completed_records += record_count
+
+    @property
+    def completed_global_step(self) -> int:
+        return self._global_step
+
+    @property
+    def sample_count(self) -> int:
+        return self._sample_count
+
+    def running_speed(self) -> float:
+        """steps/s over the recorded window (0 if not enough samples)."""
+        with self._lock:
+            if len(self._global_step_records) < 2:
+                return 0.0
+            (t0, s0) = self._global_step_records[0]
+            (t1, s1) = self._global_step_records[-1]
+            if t1 <= t0:
+                return 0.0
+            return (s1 - s0) / (t1 - t0)
+
+    # -- worker membership --------------------------------------------------
+
+    def add_running_worker(self, node_id: int):
+        with self._lock:
+            self._running_workers.add(node_id)
+            self._worker_adjust_time = time.time()
+            self._max_worker_num = max(
+                self._max_worker_num, len(self._running_workers)
+            )
+
+    def remove_running_worker(self, node_id: int):
+        with self._lock:
+            self._running_workers.discard(node_id)
+            self._worker_adjust_time = time.time()
+
+    @property
+    def running_workers(self) -> Set[int]:
+        return set(self._running_workers)
+
+    def worker_adjustment_finished(self) -> bool:
+        """Membership stable long enough for a fair speed judgement."""
+        ctx = get_context()
+        with self._lock:
+            return (
+                time.time() - self._worker_adjust_time
+                >= ctx.seconds_for_stable_worker_count
+            )
+
+    def reset_running_speed_monitor(self):
+        with self._lock:
+            self._global_step_records.clear()

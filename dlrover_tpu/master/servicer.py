@@ -1,0 +1,378 @@
+"""The master's RPC surface.
+
+Role parity: ``dlrover/python/master/servicer.py:62-525`` — one servicer
+implementing every master rpc (task dispatch, shard params, rendezvous,
+kv-store, failure reports, network check, resource reports, global step, PS
+queries). Here requests are typed dataclass messages dispatched by type to
+the owning manager; ``get`` answers queries, ``report`` ingests state.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+from dlrover_tpu.master.elastic_training.kv_store import KVStoreService
+from dlrover_tpu.master.elastic_training.rdzv_manager import RendezvousManager
+from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.shard.task_manager import TaskManager
+
+logger = get_logger("master.servicer")
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_manager: Optional[TaskManager] = None,
+        rdzv_managers: Optional[Dict[str, RendezvousManager]] = None,
+        speed_monitor: Optional[SpeedMonitor] = None,
+        kv_store: Optional[KVStoreService] = None,
+        sync_service: Optional[SyncService] = None,
+        elastic_ps_service: Optional[ElasticPsService] = None,
+        job_manager=None,
+        metric_collector=None,
+    ):
+        self._task_manager = task_manager
+        self._rdzv_managers = rdzv_managers or {}
+        self._speed_monitor = speed_monitor
+        self._kv_store = kv_store or KVStoreService()
+        self._sync_service = sync_service or SyncService()
+        self._elastic_ps_service = elastic_ps_service
+        self._job_manager = job_manager
+        self._metric_collector = metric_collector
+        self._parallel_configs: Dict[int, comm.ParallelConfig] = {}
+        self.job_exit_requested = False
+        self.job_success: Optional[bool] = None
+
+        self._get_handlers = {
+            comm.TaskRequest: self._get_task,
+            comm.ShardCheckpointRequest: self._get_shard_checkpoint,
+            comm.CommWorldRequest: self._get_comm_world,
+            comm.WaitingNodeNumRequest: self._num_nodes_waiting,
+            comm.NetworkReadyRequest: self._network_ready,
+            comm.StragglerExistRequest: self._straggler_exist,
+            comm.KVStoreGetRequest: self._kv_get,
+            comm.KVStoreAddRequest: self._kv_add,
+            comm.BarrierRequest: self._barrier_query,
+            comm.SyncJoinRequest: self._sync_query,
+            comm.ClusterVersionRequest: self._get_cluster_version,
+            comm.QueryPsNodesRequest: self._query_ps_nodes,
+            comm.ParallelConfigRequest: self._get_parallel_config,
+        }
+        self._report_handlers = {
+            comm.DatasetShardParams: self._new_dataset,
+            comm.TaskResult: self._report_task_result,
+            comm.BatchDoneReport: self._report_batch_done,
+            comm.RendezvousParams: self._set_rdzv_params,
+            comm.JoinRendezvousRequest: self._join_rendezvous,
+            comm.NetworkCheckResult: self._report_network_result,
+            comm.KVStoreSetRequest: self._kv_set,
+            comm.SyncJoinRequest: self._sync_join,
+            comm.SyncFinishRequest: self._sync_finish,
+            comm.BarrierRequest: self._barrier_notify,
+            comm.NodeFailure: self._report_failure,
+            comm.ResourceStats: self._report_resource,
+            comm.GlobalStep: self._report_global_step,
+            comm.ShardCheckpoint: self._restore_shard_checkpoint,
+            comm.NodeHeartbeat: self._report_heartbeat,
+            comm.NodeStatusReport: self._report_node_status,
+            comm.ClusterVersionUpdate: self._update_cluster_version,
+            comm.DatasetMetric: self._collect_dataset_metric,
+            comm.ModelInfo: self._collect_model_info,
+            comm.JobExitRequest: self._request_job_exit,
+            comm.ParallelConfig: self._set_parallel_config,
+        }
+
+    # -- entry points (bound to the two-method gRPC service) ----------------
+
+    def get(self, request, context=None):
+        handler = self._get_handlers.get(type(request))
+        if handler is None:
+            return comm.Response(
+                success=False, reason=f"no get handler: {type(request).__name__}"
+            )
+        return handler(request)
+
+    def report(self, request, context=None):
+        handler = self._report_handlers.get(type(request))
+        if handler is None:
+            return comm.Response(
+                success=False,
+                reason=f"no report handler: {type(request).__name__}",
+            )
+        return handler(request)
+
+    # -- data sharding ------------------------------------------------------
+
+    def _new_dataset(self, req: comm.DatasetShardParams):
+        if self._task_manager is None:
+            return comm.Response(success=False, reason="no task manager")
+        self._task_manager.new_dataset(
+            req.dataset_name, req.dataset_size, req.batch_size,
+            req.num_epochs, req.shuffle, req.num_minibatches_per_shard,
+            req.storage_type, req.task_type,
+        )
+        if self._metric_collector is not None:
+            self._metric_collector.collect_dataset_metric(
+                req.dataset_name, req.dataset_size, req.storage_type
+            )
+        return comm.Response(success=True)
+
+    def _get_task(self, req: comm.TaskRequest):
+        if self._task_manager is None:
+            return comm.Task(task_id=-1)
+        task = self._task_manager.get_dataset_task(
+            req.node_id, req.dataset_name
+        )
+        if task.task_id < 0:
+            return comm.Task(task_id=-1)
+        return comm.Task(
+            task_id=task.task_id,
+            task_type=task.task_type,
+            shard=comm.Shard(
+                name=task.shard.name, start=task.shard.start,
+                end=task.shard.end, record_indices=task.shard.record_indices,
+            ),
+            epoch=task.epoch,
+        )
+
+    def _report_task_result(self, req: comm.TaskResult):
+        self._task_manager.report_dataset_task(
+            req.dataset_name, req.task_id, success=not req.err_message
+        )
+        return comm.Response(success=True)
+
+    def _report_batch_done(self, req: comm.BatchDoneReport):
+        completed = self._task_manager.report_batch_done(
+            req.dataset_name, req.node_id, req.record_count
+        )
+        for tid in completed:
+            self._task_manager.report_dataset_task(
+                req.dataset_name, tid, success=True
+            )
+        return comm.Response(success=True)
+
+    def _get_shard_checkpoint(self, req: comm.ShardCheckpointRequest):
+        content = self._task_manager.get_shard_checkpoint(req.dataset_name)
+        return comm.ShardCheckpoint(
+            dataset_name=req.dataset_name, content=content
+        )
+
+    def _restore_shard_checkpoint(self, req: comm.ShardCheckpoint):
+        self._task_manager.restore_shard_checkpoint(
+            req.dataset_name, req.content
+        )
+        return comm.Response(success=True)
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def _manager(self, name: str) -> Optional[RendezvousManager]:
+        return self._rdzv_managers.get(name)
+
+    def _set_rdzv_params(self, req: comm.RendezvousParams):
+        targets = (
+            [req.rdzv_name] if req.rdzv_name else list(self._rdzv_managers)
+        )
+        for name in targets:
+            mgr = self._manager(name)
+            if mgr is not None:
+                mgr.update_rdzv_params(
+                    req.min_nodes, req.max_nodes, req.waiting_timeout,
+                    req.node_unit,
+                )
+        return comm.Response(success=True)
+
+    def _join_rendezvous(self, req: comm.JoinRendezvousRequest):
+        mgr = self._manager(req.rdzv_name or RendezvousName.TRAINING)
+        if mgr is None:
+            return comm.Response(success=False, reason="unknown rendezvous")
+        rdzv_round = mgr.join_rendezvous(
+            req.node_rank, req.local_world_size, node_id=req.node_id,
+            addr=req.addr, slice_index=req.slice_index,
+        )
+        return comm.Response(
+            success=True, data=comm.RendezvousState(round=rdzv_round)
+        )
+
+    def _get_comm_world(self, req: comm.CommWorldRequest):
+        mgr = self._manager(req.rdzv_name or RendezvousName.TRAINING)
+        if mgr is None:
+            return comm.CommWorld(rdzv_name=req.rdzv_name)
+        rdzv_round, group, world, coord = mgr.get_comm_world(req.node_rank)
+        return comm.CommWorld(
+            rdzv_name=req.rdzv_name, round=rdzv_round, group=group,
+            world=world, coordinator_addr=coord,
+        )
+
+    def _num_nodes_waiting(self, req: comm.WaitingNodeNumRequest):
+        mgr = self._manager(req.rdzv_name or RendezvousName.TRAINING)
+        waiting = mgr.num_nodes_waiting() if mgr else 0
+        return comm.RendezvousState(
+            round=mgr.rdzv_round if mgr else 0, waiting_num=waiting
+        )
+
+    def _report_network_result(self, req: comm.NetworkCheckResult):
+        mgr = self._manager(RendezvousName.NETWORK_CHECK)
+        if mgr is not None:
+            mgr.report_network_check_result(
+                req.node_rank, req.normal, req.elapsed_time
+            )
+        return comm.Response(success=True)
+
+    def _network_ready(self, req: comm.NetworkReadyRequest):
+        mgr = self._manager(RendezvousName.NETWORK_CHECK)
+        if mgr is None:
+            return comm.Response(success=True)
+        success, reason = mgr.network_check_success()
+        return comm.Response(success=success, reason=reason)
+
+    def _straggler_exist(self, req: comm.StragglerExistRequest):
+        mgr = self._manager(RendezvousName.NETWORK_CHECK)
+        stragglers = mgr.straggler_nodes() if mgr else []
+        return comm.Response(
+            success=bool(stragglers),
+            reason=",".join(str(s) for s in stragglers),
+        )
+
+    # -- kv store / sync ----------------------------------------------------
+
+    def _kv_set(self, req: comm.KVStoreSetRequest):
+        self._kv_store.set(req.key, req.value)
+        return comm.Response(success=True)
+
+    def _kv_get(self, req: comm.KVStoreGetRequest):
+        value = self._kv_store.get(req.key)
+        return comm.KVStoreValue(
+            key=req.key, value=value or "", found=value is not None
+        )
+
+    def _kv_add(self, req: comm.KVStoreAddRequest):
+        value = self._kv_store.add(req.key, req.amount)
+        return comm.KVStoreValue(key=req.key, value=str(value), found=True)
+
+    def _sync_join(self, req: comm.SyncJoinRequest):
+        done = self._sync_service.join_sync(req.sync_name, req.node_rank)
+        return comm.Response(success=done)
+
+    def _sync_query(self, req: comm.SyncJoinRequest):
+        return comm.Response(
+            success=self._sync_service.sync_finished(req.sync_name)
+        )
+
+    def _sync_finish(self, req: comm.SyncFinishRequest):
+        self._sync_service.force_finish(req.sync_name)
+        return comm.Response(success=True)
+
+    def _barrier_notify(self, req: comm.BarrierRequest):
+        self._sync_service.notify_barrier(req.barrier_name)
+        return comm.Response(success=True)
+
+    def _barrier_query(self, req: comm.BarrierRequest):
+        return comm.Response(
+            success=self._sync_service.barrier_reached(req.barrier_name)
+        )
+
+    # -- failures / monitoring ---------------------------------------------
+
+    def _report_failure(self, req: comm.NodeFailure):
+        logger.warning(
+            "node %d (rank %d) failure level=%s restart=%d: %s",
+            req.node_id, req.node_rank, req.level, req.restart_count,
+            req.error_data[:512],
+        )
+        if self._job_manager is not None:
+            self._job_manager.handle_training_failure(
+                req.node_id, req.restart_count, req.error_data, req.level
+            )
+        return comm.Response(success=True)
+
+    def _report_resource(self, req: comm.ResourceStats):
+        if self._job_manager is not None:
+            self._job_manager.update_node_resource_usage(
+                req.node_type, req.node_id, req.cpu_percent, req.memory_mb
+            )
+        return comm.Response(success=True)
+
+    def _report_global_step(self, req: comm.GlobalStep):
+        if self._speed_monitor is not None:
+            self._speed_monitor.collect_global_step(
+                req.step, req.timestamp or time.time()
+            )
+        return comm.Response(success=True)
+
+    def _report_heartbeat(self, req: comm.NodeHeartbeat):
+        if self._job_manager is not None:
+            self._job_manager.collect_node_heartbeat(
+                req.node_id, req.timestamp or time.time()
+            )
+        return comm.Response(success=True)
+
+    def _report_node_status(self, req: comm.NodeStatusReport):
+        if self._job_manager is not None:
+            self._job_manager.update_node_reported_status(
+                req.node_type, req.node_id, req.status
+            )
+        return comm.Response(success=True)
+
+    # -- PS parity ----------------------------------------------------------
+
+    def _get_cluster_version(self, req: comm.ClusterVersionRequest):
+        if self._elastic_ps_service is None:
+            return comm.ClusterVersion(version=0)
+        version = self._elastic_ps_service.get_cluster_version(
+            req.version_type, req.task_type, req.task_id
+        )
+        return comm.ClusterVersion(version=version)
+
+    def _update_cluster_version(self, req: comm.ClusterVersionUpdate):
+        if self._elastic_ps_service is not None:
+            self._elastic_ps_service.update_cluster_version(
+                req.version_type, req.version, req.task_type, req.task_id
+            )
+        return comm.Response(success=True)
+
+    def _query_ps_nodes(self, req: comm.QueryPsNodesRequest):
+        if self._job_manager is None or not hasattr(
+            self._job_manager, "get_ps_addrs"
+        ):
+            return comm.PsNodes(addrs=[], ready=False)
+        addrs = self._job_manager.get_ps_addrs()
+        return comm.PsNodes(addrs=addrs, ready=bool(addrs))
+
+    # -- stats / parallel config / job control ------------------------------
+
+    def _collect_dataset_metric(self, req: comm.DatasetMetric):
+        if self._metric_collector is not None:
+            self._metric_collector.collect_dataset_metric(
+                req.dataset_name, req.dataset_size, req.storage_type
+            )
+        return comm.Response(success=True)
+
+    def _collect_model_info(self, req: comm.ModelInfo):
+        if self._metric_collector is not None:
+            self._metric_collector.collect_model_info(req)
+        return comm.Response(success=True)
+
+    def _set_parallel_config(self, req: comm.ParallelConfig):
+        # master-pushed config applies to all nodes (node_id -1 = broadcast)
+        self._parallel_configs[-1] = req
+        return comm.Response(success=True)
+
+    def _get_parallel_config(self, req: comm.ParallelConfigRequest):
+        cfg = self._parallel_configs.get(req.node_id) or \
+            self._parallel_configs.get(-1)
+        return cfg or comm.ParallelConfig()
+
+    def _request_job_exit(self, req: comm.JobExitRequest):
+        self.job_exit_requested = True
+        self.job_success = req.success
+        logger.info(
+            "job exit requested by node %d: success=%s reason=%s",
+            req.node_id, req.success, req.reason,
+        )
+        return comm.Response(success=True)

@@ -1,0 +1,186 @@
+"""Todo/doing shard queues for one dataset.
+
+Role parity: ``dlrover/python/master/shard/batch_dataset_manager.py:29-203``:
+pop a shard to a worker (todo -> doing), complete it by reported record
+counts, recover shards of dead/slow workers back to todo, and
+checkpoint/restore the whole queue state so a restarted job resumes
+mid-epoch without re-reading consumed data.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter, Shard
+
+logger = get_logger("master.shard")
+
+
+@dataclass
+class DoingTask:
+    task: "Task"
+    node_id: int
+    start_time: float
+
+
+@dataclass
+class Task:
+    task_id: int
+    task_type: str
+    shard: Shard
+    epoch: int = 0
+
+    @classmethod
+    def create_invalid(cls) -> "Task":
+        return cls(-1, "", Shard("", 0, 0))
+
+
+class BatchDatasetManager:
+    def __init__(self, splitter: DatasetSplitter, task_type: str = "training"):
+        self._splitter = splitter
+        self._task_type = task_type
+        self.todo: Deque[Task] = deque()
+        self.doing: Dict[int, DoingTask] = {}
+        self._task_id_seq = 0
+        self._completed_step = 0
+        self._reported_records: Dict[int, int] = {}
+        self._epoch_checkpoint_restored = False
+
+    @property
+    def dataset_name(self) -> str:
+        return self._splitter.dataset_name
+
+    def get_task(self, node_id: int) -> Task:
+        """Pop a task for a worker, refilling from the splitter per epoch."""
+        if not self.todo and not self._splitter.epoch_finished():
+            self._create_epoch_tasks()
+        if not self.todo:
+            return Task.create_invalid()
+        task = self.todo.popleft()
+        self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+        return task
+
+    def _create_epoch_tasks(self):
+        shards = self._splitter.create_shards()
+        for shard in shards:
+            self.todo.append(
+                Task(self._task_id_seq, self._task_type, shard,
+                     epoch=self._splitter.epoch)
+            )
+            self._task_id_seq += 1
+
+    def report_task_status(self, task_id: int, success: bool) -> Tuple[bool, Task]:
+        """Worker finished (or failed) a task; failure requeues the shard."""
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return False, Task.create_invalid()
+        if not success:
+            logger.info(
+                "dataset %s: task %d failed, requeueing shard [%d, %d)",
+                self.dataset_name, task_id, doing.task.shard.start,
+                doing.task.shard.end,
+            )
+            self.todo.appendleft(doing.task)
+        return success, doing.task
+
+    def report_batch_done(self, node_id: int, record_count: int,
+                          task_ids: Optional[List[int]] = None) -> List[int]:
+        """Credit consumed records against this worker's doing tasks;
+        returns the task ids completed by this report."""
+        completed = []
+        candidates = task_ids or [
+            tid for tid, d in self.doing.items() if d.node_id == node_id
+        ]
+        remaining = record_count
+        for tid in sorted(candidates):
+            doing = self.doing.get(tid)
+            if doing is None:
+                continue
+            credited = self._reported_records.get(tid, 0) + remaining
+            if credited >= doing.task.shard.size:
+                remaining = credited - doing.task.shard.size
+                self._reported_records.pop(tid, None)
+                self.doing.pop(tid)
+                completed.append(tid)
+            else:
+                self._reported_records[tid] = credited
+                remaining = 0
+            if remaining <= 0:
+                break
+        return completed
+
+    def recover_tasks(self, node_id: int):
+        """Requeue every doing task of a dead worker."""
+        requeued = []
+        for tid, doing in list(self.doing.items()):
+            if doing.node_id == node_id:
+                self.doing.pop(tid)
+                self._reported_records.pop(tid, None)
+                self.todo.appendleft(doing.task)
+                requeued.append(tid)
+        if requeued:
+            logger.info(
+                "dataset %s: recovered tasks %s of node %d",
+                self.dataset_name, requeued, node_id,
+            )
+
+    def recover_timeout_tasks(self, timeout_secs: float) -> List[int]:
+        now = time.time()
+        recovered = []
+        for tid, doing in list(self.doing.items()):
+            if now - doing.start_time > timeout_secs:
+                self.doing.pop(tid)
+                self.todo.appendleft(doing.task)
+                recovered.append(tid)
+        return recovered
+
+    def completed(self) -> bool:
+        return (
+            self._splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def checkpoint(self) -> str:
+        """Serialize undone work: doing shards go back in front of todo."""
+        shards = [
+            [d.task.shard.start, d.task.shard.end]
+            for d in self.doing.values()
+        ] + [[t.shard.start, t.shard.end] for t in self.todo]
+        return json.dumps({
+            "dataset_name": self.dataset_name,
+            "todo": shards,
+            "epoch": self._splitter.epoch,
+        })
+
+    def restore_checkpoint(self, content: str):
+        state = json.loads(content)
+        if state.get("dataset_name") != self.dataset_name:
+            raise ValueError(
+                f"checkpoint is for {state.get('dataset_name')}, "
+                f"not {self.dataset_name}"
+            )
+        self._splitter.epoch = state.get("epoch", 0)
+        self.todo.clear()
+        self.doing.clear()
+        for start, end in state.get("todo", []):
+            self.todo.append(
+                Task(
+                    self._task_id_seq,
+                    self._task_type,
+                    Shard(self.dataset_name, start, end),
+                    epoch=self._splitter.epoch,
+                )
+            )
+            self._task_id_seq += 1
+        logger.info(
+            "dataset %s: restored %d pending shards at epoch %d",
+            self.dataset_name, len(self.todo), self._splitter.epoch,
+        )

@@ -1,0 +1,160 @@
+"""Dataset index-space splitters.
+
+Role parity: ``dlrover/python/master/shard/dataset_splitter.py:90-481``
+(TableDatasetSplitter, TextDatasetSplitter, StreamingDatasetSplitter). A
+shard is a [start, end) range of ``batch_size * num_minibatches_per_shard``
+records; splitters hand the task manager one epoch of shards at a time.
+
+On TPU the consumer is a per-host input pipeline (grain/tf.data style
+index sampling): each host maps its shard range to host-local batches that
+feed ``jax.device_put`` onto its chips, so the master stays off the
+per-batch path exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import random
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("master.shard")
+
+
+@dataclass
+class Shard:
+    name: str
+    start: int
+    end: int
+    record_indices: Optional[List[int]] = None
+
+    @property
+    def size(self) -> int:
+        return self.end - self.start
+
+
+class DatasetSplitter(ABC):
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = max(1, shard_size)
+        self.num_epochs = max(1, num_epochs)
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> List[Shard]:
+        """Produce the next epoch's shards (advances the epoch counter)."""
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+    @staticmethod
+    def create(
+        dataset_name: str,
+        dataset_size: int,
+        batch_size: int,
+        num_epochs: int,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+    ) -> "DatasetSplitter":
+        shard_size = batch_size * max(1, num_minibatches_per_shard)
+        if storage_type == "text":
+            return TextDatasetSplitter(
+                dataset_name, dataset_size, shard_size, num_epochs, shuffle
+            )
+        if storage_type == "stream":
+            return StreamingDatasetSplitter(
+                dataset_name, dataset_size, shard_size, num_epochs
+            )
+        return TableDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Range shards over a record-addressable table."""
+
+    def __init__(self, dataset_name, dataset_size, shard_size, num_epochs,
+                 shuffle: bool = False):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+
+    def create_shards(self) -> List[Shard]:
+        if self.epoch_finished():
+            return []
+        shards = [
+            Shard(self.dataset_name, start, min(start + self.shard_size,
+                                                self.dataset_size))
+            for start in range(0, self.dataset_size, self.shard_size)
+        ]
+        if self.shuffle:
+            random.shuffle(shards)
+        self.epoch += 1
+        logger.info(
+            "dataset %s: epoch %d/%d, %d shards of %d records",
+            self.dataset_name, self.epoch, self.num_epochs, len(shards),
+            self.shard_size,
+        )
+        return shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards carrying explicit (optionally shuffled) record indices,
+    for line-addressable text files."""
+
+    def __init__(self, dataset_name, dataset_size, shard_size, num_epochs,
+                 shuffle: bool = False):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+
+    def create_shards(self) -> List[Shard]:
+        if self.epoch_finished():
+            return []
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            random.shuffle(indices)
+        shards = []
+        for start in range(0, self.dataset_size, self.shard_size):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(self.dataset_name, start, end,
+                      record_indices=indices[start:end])
+            )
+        self.epoch += 1
+        return shards
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Unbounded stream: grow the index space as data arrives.
+
+    ``dataset_size`` is the currently-known frontier; ``add_records`` extends
+    it (the reference's PartitionOffsets-based variant,
+    ``dataset_splitter.py:359``). Epochs do not apply — the splitter is
+    exhausted only when marked finished.
+    """
+
+    def __init__(self, dataset_name, dataset_size, shard_size, num_epochs=1):
+        super().__init__(dataset_name, dataset_size, shard_size, 1)
+        self._frontier = 0
+        self._finished = False
+
+    def add_records(self, count: int):
+        self.dataset_size += count
+
+    def mark_finished(self):
+        self._finished = True
+
+    def epoch_finished(self) -> bool:
+        return self._finished and self._frontier >= self.dataset_size
+
+    def create_shards(self) -> List[Shard]:
+        shards = []
+        while self._frontier < self.dataset_size:
+            end = min(self._frontier + self.shard_size, self.dataset_size)
+            shards.append(Shard(self.dataset_name, self._frontier, end))
+            self._frontier = end
+        return shards

@@ -1,0 +1,159 @@
+"""Dataset task dispatch across all registered datasets.
+
+Role parity: ``dlrover/python/master/shard/task_manager.py:36-284`` — owns a
+BatchDatasetManager per dataset, re-assigns shards of failed workers
+(TaskRescheduleCallback path) and of timed-out workers (straggler
+mitigation), and surfaces training speed to the SpeedMonitor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.shard.batch_dataset_manager import (
+    BatchDatasetManager,
+    Task,
+)
+from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter
+
+logger = get_logger("master.task")
+
+
+class TaskManager:
+    def __init__(self, speed_monitor=None):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._speed_monitor = speed_monitor
+        self._worker_start_task_time: Dict[int, float] = {}
+        self._task_timeout_callbacks: List[Callable[[int], None]] = []
+        self._stop = threading.Event()
+        self._timeout_thread: Optional[threading.Thread] = None
+
+    # -- dataset registry ---------------------------------------------------
+
+    def new_dataset(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        batch_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+        task_type: str = "training",
+    ):
+        with self._lock:
+            if dataset_name in self._datasets:
+                return
+            splitter = DatasetSplitter.create(
+                dataset_name, dataset_size, batch_size, num_epochs,
+                shuffle, num_minibatches_per_shard, storage_type,
+            )
+            self._datasets[dataset_name] = BatchDatasetManager(
+                splitter, task_type
+            )
+            logger.info(
+                "registered dataset %s: size=%d batch=%d epochs=%d type=%s",
+                dataset_name, dataset_size, batch_size, num_epochs,
+                storage_type,
+            )
+
+    def get_dataset(self, name: str) -> Optional[BatchDatasetManager]:
+        return self._datasets.get(name)
+
+    def reset_dataset(self, name: str):
+        with self._lock:
+            self._datasets.pop(name, None)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def get_dataset_task(self, node_id: int, dataset_name: str) -> Task:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            if dataset is None:
+                return Task.create_invalid()
+            task = dataset.get_task(node_id)
+            if task.task_id >= 0:
+                self._worker_start_task_time[node_id] = time.time()
+            return task
+
+    def report_dataset_task(self, dataset_name: str, task_id: int,
+                            success: bool):
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            if dataset is None:
+                return
+            ok, task = dataset.report_task_status(task_id, success)
+            if ok and self._speed_monitor is not None and \
+                    task.task_type == "training":
+                self._speed_monitor.mark_task_completed(task.shard.size)
+
+    def report_batch_done(self, dataset_name: str, node_id: int,
+                          record_count: int) -> List[int]:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            if dataset is None:
+                return []
+            return dataset.report_batch_done(node_id, record_count)
+
+    def finished(self) -> bool:
+        """All registered training datasets consumed."""
+        with self._lock:
+            training = [
+                d for d in self._datasets.values()
+                if d._task_type == "training"
+            ]
+            return bool(training) and all(d.completed() for d in training)
+
+    # -- failure/straggler recovery ----------------------------------------
+
+    def recover_tasks(self, node_id: int):
+        with self._lock:
+            for dataset in self._datasets.values():
+                dataset.recover_tasks(node_id)
+
+    def set_task_timeout_callback(self, cb: Callable[[int], None]):
+        self._task_timeout_callbacks.append(cb)
+
+    def start(self):
+        if self._timeout_thread is None:
+            self._timeout_thread = threading.Thread(
+                target=self._monitor_timeout_tasks,
+                name="task-timeout-monitor",
+                daemon=True,
+            )
+            self._timeout_thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _monitor_timeout_tasks(self):
+        ctx = get_context()
+        while not self._stop.wait(30):
+            with self._lock:
+                for dataset in self._datasets.values():
+                    recovered = dataset.recover_timeout_tasks(
+                        ctx.seconds_to_timeout_task
+                    )
+                    if recovered:
+                        logger.warning(
+                            "dataset %s: tasks %s timed out and were "
+                            "requeued", dataset.dataset_name, recovered,
+                        )
+
+    # -- shard checkpoint ---------------------------------------------------
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            return dataset.checkpoint() if dataset else ""
+
+    def restore_shard_checkpoint(self, dataset_name: str, content: str):
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            if dataset and content:
+                dataset.restore_checkpoint(content)

@@ -20,24 +20,31 @@ from dlrover_tpu.rpc.server import SERVICE_NAME
 logger = get_logger("rpc.client")
 
 
+_TRANSIENT_CODES = {
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+}
+
+
 def retry_rpc(retries: int = 5, backoff: float = 1.0):
-    """Retry transient RPC failures with linear backoff."""
+    """Retry transient RPC failures with linear backoff; non-transient
+    codes (bad method, serialization errors, ...) raise immediately."""
 
     def decorator(fn):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
-            last_exc: Optional[Exception] = None
             for i in range(retries):
                 try:
                     return fn(*args, **kwargs)
                 except grpc.RpcError as e:
-                    last_exc = e
+                    if e.code() not in _TRANSIENT_CODES or i == retries - 1:
+                        raise
                     logger.warning(
                         "rpc %s failed (%s), retry %d/%d",
                         fn.__name__, e.code(), i + 1, retries,
                     )
                     time.sleep(backoff * (i + 1))
-            raise last_exc  # type: ignore[misc]
 
         return wrapped
 

@@ -1,0 +1,96 @@
+"""Integration: LocalJobMaster + MasterClient over real gRPC.
+
+Mirrors the reference's pattern of booting a real in-process master and
+driving it with real clients (``test_elastic_training_agent.py:33-35``).
+"""
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.master.local_master import start_local_master
+
+
+@pytest.fixture(scope="module")
+def master():
+    m = start_local_master()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(master.addr, node_id=0)
+    yield c
+    c.close()
+
+
+def test_dataset_flow_over_rpc(master, client):
+    client.report_dataset_shard_params(
+        dataset_name="rpc_ds", dataset_size=12, batch_size=3,
+        num_epochs=1, num_minibatches_per_shard=2,
+    )
+    task = client.get_task("rpc_ds")
+    assert task.task_id >= 0
+    assert task.shard.end - task.shard.start == 6
+    client.report_task_result("rpc_ds", task.task_id)
+    task2 = client.get_task("rpc_ds")
+    client.report_batch_done("rpc_ds", 6)
+    task3 = client.get_task("rpc_ds")
+    assert task3.task_id < 0  # exhausted
+
+
+def test_rendezvous_flow_over_rpc(master):
+    clients = [MasterClient(master.addr, node_id=i) for i in range(2)]
+    try:
+        clients[0].report_rdzv_params(
+            min_nodes=2, max_nodes=2, waiting_timeout=30.0, node_unit=1,
+            rdzv_name=RendezvousName.TRAINING,
+        )
+        for i, c in enumerate(clients):
+            c.join_rendezvous(i, 4, addr=f"host{i}:2222")
+        world = clients[1].get_comm_world(node_rank=1)
+        assert world.world == {0: 4, 1: 4}
+        assert world.coordinator_addr == "host0:2222"
+        assert clients[0].num_nodes_waiting() == 0
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_kv_and_sync_over_rpc(master, client):
+    client.kv_store_set("ckpt_step", "100")
+    assert client.kv_store_get("ckpt_step") == "100"
+    assert client.kv_store_get("missing") is None
+    assert client.kv_store_add("counter", 5) == 5
+    assert client.kv_store_add("counter", 2) == 7
+
+    master.sync_service.set_expected_count(1)
+    assert client.join_sync("epoch-end", 0)
+    assert client.sync_finished("epoch-end")
+    assert not client.barrier("b1")
+    client.barrier("b1", notify=True)
+    assert client.barrier("b1")
+
+
+def test_monitor_reports_over_rpc(master, client):
+    client.report_global_step(10)
+    client.report_global_step(20)
+    assert master.speed_monitor.completed_global_step == 20
+    client.report_resource(cpu_percent=50.0, memory_mb=1024)
+    client.report_heartbeat()  # no job manager on local master: must not fail
+
+
+def test_cluster_version_over_rpc(master, client):
+    assert client.get_cluster_version("global", "worker", 0) == 0
+    client.update_cluster_version("global", 3, "worker", 0)
+    assert client.get_cluster_version("global", "worker", 0) == 3
+    client.update_cluster_version("local", 2, "worker", 1)
+    assert client.get_cluster_version("local", "worker", 1) == 2
+
+
+def test_job_exit_over_rpc(master, client):
+    assert not master.servicer.job_exit_requested
+    client.report_job_exit(success=True, reason="all done")
+    assert master.servicer.job_exit_requested
+    assert master.servicer.job_success

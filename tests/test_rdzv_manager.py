@@ -1,0 +1,130 @@
+"""In-memory tests of the rendezvous managers (reference test model:
+``dlrover/python/tests/test_rdzv_manager.py``)."""
+
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+
+
+def make_training_mgr(min_nodes, max_nodes, timeout=60.0, node_unit=1):
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes, max_nodes, timeout, node_unit)
+    return mgr
+
+
+class TestElasticTrainingRendezvous:
+    def test_all_nodes_complete_round(self):
+        mgr = make_training_mgr(2, 3)
+        for rank in range(3):
+            r = mgr.join_rendezvous(rank, 4, node_id=rank,
+                                    addr=f"10.0.0.{rank}:1234")
+            assert r == 0
+        rdzv_round, group, world, coord = mgr.get_comm_world(0)
+        assert world == {0: 4, 1: 4, 2: 4}
+        assert coord == "10.0.0.0:1234"
+        assert rdzv_round == 1  # round advanced on completion
+
+    def test_no_completion_below_min(self):
+        mgr = make_training_mgr(2, 4, timeout=60.0)
+        mgr.join_rendezvous(0, 4)
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert world == {}
+
+    def test_timeout_completion_with_min_nodes(self):
+        mgr = make_training_mgr(2, 4, timeout=0.0)
+        mgr.join_rendezvous(0, 4, addr="h0:1")
+        mgr.join_rendezvous(1, 4, addr="h1:1")
+        mgr.join_rendezvous(2, 4, addr="h2:1")
+        _, _, world, coord = mgr.get_comm_world(0)
+        assert world == {0: 4, 1: 4, 2: 4}
+        assert coord == "h0:1"
+
+    def test_node_unit_rounds_down_to_whole_slices(self):
+        # 2 hosts per slice: 5 waiting nodes -> world of 4
+        mgr = make_training_mgr(2, 8, timeout=0.0, node_unit=2)
+        for rank in range(5):
+            mgr.join_rendezvous(rank, 4, addr=f"h{rank}:1")
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert sorted(world) == [0, 1, 2, 3]
+        # the leftover node is still waiting for the next round
+        assert mgr.num_nodes_waiting() in (0, 1)
+
+    def test_num_nodes_waiting_restart_semantics(self):
+        mgr = make_training_mgr(2, 2, timeout=0.0, node_unit=2)
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        mgr.get_comm_world(0)
+        assert mgr.num_nodes_waiting() == 0
+        # a node from the last world re-joins => immediate restart signal
+        mgr.join_rendezvous(1, 4)
+        assert mgr.num_nodes_waiting() == 1
+
+    def test_remove_alive_node_drops_waiting(self):
+        mgr = make_training_mgr(2, 4)
+        mgr.join_rendezvous(0, 4, node_id=10)
+        mgr.join_rendezvous(1, 4, node_id=11)
+        mgr.remove_alive_node(11)
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert world == {}
+
+
+class TestNetworkCheckRendezvous:
+    def _join_all(self, mgr, n):
+        for rank in range(n):
+            mgr.join_rendezvous(rank, 4, node_id=rank, addr=f"h{rank}:1")
+
+    def test_pairs_round0(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(4, 4, 10.0, 1)
+        self._join_all(mgr, 4)
+        _, group0, world0, _ = mgr.get_comm_world(0)
+        _, group2, world2, _ = mgr.get_comm_world(2)
+        assert world0 == {0: 4, 1: 4}
+        assert world2 == {2: 4, 3: 4}
+        assert group0 != group2
+
+    def test_fault_localization_two_rounds(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(4, 4, 10.0, 1)
+        self._join_all(mgr, 4)
+        for rank in range(4):
+            mgr.get_comm_world(rank)
+        # round 0: pair (0,1) fails (node 1 is bad), pair (2,3) passes
+        mgr.report_network_check_result(0, False)
+        mgr.report_network_check_result(1, False)
+        mgr.report_network_check_result(2, True)
+        mgr.report_network_check_result(3, True)
+        ok, reason = mgr.network_check_success()
+        assert not ok and reason == "node-failure"
+        # round 1: suspects (0, 1) each paired with a good node
+        self._join_all(mgr, 4)
+        _, _, g0, _ = mgr.get_comm_world(0)
+        assert 0 in g0 and len(g0) == 2 and 1 not in g0
+        # 0 passes when paired with a good node; 1 still fails
+        mgr.report_network_check_result(0, True)
+        mgr.report_network_check_result(1, False)
+        mgr.report_network_check_result(2, True)
+        mgr.report_network_check_result(3, True)
+        ok, _ = mgr.network_check_success()
+        assert not ok
+        assert mgr.abnormal_nodes() == [1]
+
+    def test_all_normal_check_succeeds(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(2, 2, 10.0, 1)
+        self._join_all(mgr, 2)
+        mgr.get_comm_world(0)
+        mgr.report_network_check_result(0, True, elapsed=1.0)
+        mgr.report_network_check_result(1, True, elapsed=1.1)
+        ok, reason = mgr.network_check_success()
+        assert ok and reason == ""
+
+    def test_straggler_detection(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(4, 4, 10.0, 1)
+        self._join_all(mgr, 4)
+        mgr.get_comm_world(0)
+        for rank, t in [(0, 1.0), (1, 1.1), (2, 0.9), (3, 9.0)]:
+            mgr.report_network_check_result(rank, True, elapsed=t)
+        assert mgr.straggler_nodes() == [3]

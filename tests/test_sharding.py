@@ -1,0 +1,143 @@
+"""Dynamic data sharding: splitters, queues, recovery, checkpoint."""
+
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.shard.batch_dataset_manager import BatchDatasetManager
+from dlrover_tpu.master.shard.dataset_splitter import (
+    DatasetSplitter,
+    StreamingDatasetSplitter,
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+)
+from dlrover_tpu.master.shard.task_manager import TaskManager
+
+
+class TestSplitters:
+    def test_table_splitter_epochs(self):
+        sp = TableDatasetSplitter("ds", 100, shard_size=32, num_epochs=2)
+        shards = sp.create_shards()
+        assert [s.size for s in shards] == [32, 32, 32, 4]
+        assert not sp.epoch_finished()
+        sp.create_shards()
+        assert sp.epoch_finished()
+        assert sp.create_shards() == []
+
+    def test_text_splitter_indices(self):
+        sp = TextDatasetSplitter("ds", 10, shard_size=4, num_epochs=1,
+                                 shuffle=True)
+        shards = sp.create_shards()
+        all_indices = sorted(
+            i for s in shards for i in s.record_indices
+        )
+        assert all_indices == list(range(10))
+
+    def test_streaming_splitter_grows(self):
+        sp = StreamingDatasetSplitter("ds", 10, shard_size=5)
+        assert len(sp.create_shards()) == 2
+        sp.add_records(7)
+        shards = sp.create_shards()
+        assert [s.size for s in shards] == [5, 2]
+        assert not sp.epoch_finished()
+        sp.mark_finished()
+        assert sp.epoch_finished()
+
+    def test_factory(self):
+        sp = DatasetSplitter.create("d", 10, 2, 1, storage_type="text",
+                                    num_minibatches_per_shard=3)
+        assert isinstance(sp, TextDatasetSplitter)
+        assert sp.shard_size == 6
+
+
+class TestBatchDatasetManager:
+    def _manager(self, size=20, shard=5, epochs=1):
+        sp = TableDatasetSplitter("ds", size, shard, epochs)
+        return BatchDatasetManager(sp)
+
+    def test_dispatch_and_complete(self):
+        m = self._manager()
+        t0 = m.get_task(node_id=0)
+        t1 = m.get_task(node_id=1)
+        assert t0.task_id != t1.task_id
+        assert len(m.doing) == 2
+        ok, task = m.report_task_status(t0.task_id, success=True)
+        assert ok and task.shard.size == 5
+        assert t0.task_id not in m.doing
+
+    def test_failure_requeues_front(self):
+        m = self._manager()
+        t0 = m.get_task(0)
+        m.report_task_status(t0.task_id, success=False)
+        again = m.get_task(0)
+        assert again.shard.start == t0.shard.start
+
+    def test_batch_done_completes_by_record_count(self):
+        m = self._manager(size=10, shard=5)
+        t0 = m.get_task(0)
+        assert m.report_batch_done(0, 3) == []
+        completed = m.report_batch_done(0, 2)
+        assert completed == [t0.task_id]
+
+    def test_dead_worker_recovery(self):
+        m = self._manager()
+        t0 = m.get_task(0)
+        m.get_task(1)
+        m.recover_tasks(0)
+        assert all(d.node_id != 0 for d in m.doing.values())
+        assert any(t.task_id == t0.task_id for t in m.todo)
+
+    def test_completed(self):
+        m = self._manager(size=5, shard=5)
+        t = m.get_task(0)
+        assert not m.completed()
+        m.report_task_status(t.task_id, True)
+        assert m.completed()
+
+    def test_checkpoint_roundtrip(self):
+        m = self._manager(size=20, shard=5)
+        t = m.get_task(0)  # one doing
+        ckpt = m.checkpoint()
+        # a fresh manager on a restarted master
+        m2 = self._manager(size=20, shard=5)
+        m2.restore_checkpoint(ckpt)
+        # all 4 shards pending again (doing shard included)
+        starts = sorted(t.shard.start for t in m2.todo)
+        assert starts == [0, 5, 10, 15]
+        assert t.shard.start in starts
+
+
+class TestTaskManager:
+    def test_end_to_end_dataset_flow(self):
+        sm = SpeedMonitor()
+        tm = TaskManager(speed_monitor=sm)
+        tm.new_dataset("train", dataset_size=12, batch_size=3,
+                       num_epochs=1, num_minibatches_per_shard=2)
+        served = 0
+        while True:
+            task = tm.get_dataset_task(0, "train")
+            if task.task_id < 0:
+                break
+            served += 1
+            tm.report_dataset_task("train", task.task_id, success=True)
+        assert served == 2  # 12 records / (3*2) per shard
+        assert tm.finished()
+
+    def test_recover_on_node_failure(self):
+        tm = TaskManager()
+        tm.new_dataset("train", 12, 3, num_minibatches_per_shard=2)
+        t = tm.get_dataset_task(5, "train")
+        assert t.task_id >= 0
+        tm.recover_tasks(5)
+        t2 = tm.get_dataset_task(6, "train")
+        assert t2.shard.start == t.shard.start
+
+    def test_shard_checkpoint_through_manager(self):
+        tm = TaskManager()
+        tm.new_dataset("train", 12, 3, num_minibatches_per_shard=2)
+        tm.get_dataset_task(0, "train")
+        ckpt = tm.get_shard_checkpoint("train")
+        tm2 = TaskManager()
+        tm2.new_dataset("train", 12, 3, num_minibatches_per_shard=2)
+        tm2.restore_shard_checkpoint("train", ckpt)
+        count = 0
+        while tm2.get_dataset_task(0, "train").task_id >= 0:
+            count += 1
+        assert count == 2
