@@ -111,6 +111,10 @@ class MasterClient:
             node_rank=node_rank, normal=normal, elapsed_time=elapsed
         ))
 
+    def abnormal_ranks(self) -> List[int]:
+        resp = self._channel.get(comm.AbnormalNodesRequest())
+        return list(resp.ranks or [])
+
     def straggler_ranks(self) -> List[int]:
         resp = self._channel.get(comm.StragglerExistRequest())
         if not resp.reason:

@@ -1,0 +1,63 @@
+"""Per-host resource usage reporter.
+
+Role parity: ``dlrover/python/elastic_agent/monitor/resource.py:86-184`` —
+a daemon thread sampling host CPU/memory (and accelerator duty where
+available) and pushing it to the master, feeding hang detection and the
+resource optimizer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("agent.resource")
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover - psutil ships in the image
+    psutil = None
+
+
+def current_process_usage() -> tuple:
+    """(cpu_percent, memory_mb) of this host."""
+    if psutil is None:
+        return 0.0, 0
+    cpu = psutil.cpu_percent(interval=None) / 100.0
+    mem_mb = int(psutil.virtual_memory().used / (1024 * 1024))
+    return cpu, mem_mb
+
+
+class ResourceMonitor:
+    def __init__(self, master_client: Optional[MasterClient],
+                 chips: int = 0):
+        self._client = master_client
+        self._chips = chips
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._client is None or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        ctx = get_context()
+        while not self._stop.wait(ctx.seconds_interval_to_report):
+            try:
+                cpu, mem_mb = current_process_usage()
+                self._client.report_resource(
+                    cpu_percent=cpu, memory_mb=mem_mb, chips=self._chips
+                )
+            except Exception as e:
+                logger.debug("resource report failed: %s", e)

@@ -1,0 +1,169 @@
+"""Agent-side rendezvous against the master.
+
+Role parity: ``MasterRendezvousHandler`` in
+``dlrover/python/elastic_agent/torch/training.py:75-212``, retargeted at
+JAX: instead of building a torch c10d store, the completed world is turned
+into ``jax.distributed.initialize`` coordinates — (coordinator_addr,
+num_processes, process_id_base) — that the agent injects into its worker
+processes' environment.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("agent.rdzv")
+
+
+class RendezvousTimeoutError(Exception):
+    pass
+
+
+@dataclass
+class RendezvousInfo:
+    """Everything a host needs to start its slice of the SPMD world."""
+
+    round: int = 0
+    world: Dict[int, int] = field(default_factory=dict)
+    group_rank: int = 0  # this node's index in the sorted world
+    group_world_size: int = 0  # number of nodes in the world
+    process_id_base: int = 0  # first global process id on this host
+    local_world_size: int = 0
+    num_processes: int = 0  # total jax processes across the world
+    coordinator_addr: str = ""  # host:port for jax.distributed
+
+
+def free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host or "", 0))
+        return s.getsockname()[1]
+
+
+def reserve_port(host: str = "") -> socket.socket:
+    """Bind (and keep) a socket on a free port; the caller closes it just
+    before the real user of the port binds, shrinking the reuse race from
+    the whole rendezvous wait down to milliseconds."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host or "", 0))
+    return s
+
+
+def local_host_ip() -> str:
+    """Best-effort routable IP of this host (falls back to loopback)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 53))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+class MasterRendezvousHandler:
+    def __init__(
+        self,
+        master_client: MasterClient,
+        node_rank: int,
+        rdzv_name: str = RendezvousName.TRAINING,
+        local_world_size: int = 1,
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+        host_ip: Optional[str] = None,
+        poll_interval: float = 0.5,
+    ):
+        self._client = master_client
+        self.node_rank = node_rank
+        self.rdzv_name = rdzv_name
+        self.local_world_size = local_world_size
+        self._min_nodes = min_nodes
+        self._max_nodes = max_nodes
+        self._waiting_timeout = waiting_timeout
+        self._node_unit = node_unit
+        self._host_ip = host_ip if host_ip is not None else local_host_ip()
+        self._poll_interval = poll_interval
+        self._reserved_sock: Optional[socket.socket] = None
+
+    def release_coordinator_port(self):
+        """Free the reserved port right before the coordinator binds it."""
+        if self._reserved_sock is not None:
+            try:
+                self._reserved_sock.close()
+            finally:
+                self._reserved_sock = None
+
+    def _push_params_once(self):
+        # rank 0 owns the rendezvous parameters (reference :99-105)
+        if self.node_rank == 0:
+            self._client.report_rdzv_params(
+                self._min_nodes, self._max_nodes, self._waiting_timeout,
+                self._node_unit, self.rdzv_name,
+            )
+
+    def next_rendezvous(self, timeout: Optional[float] = None) -> RendezvousInfo:
+        """Join and block-poll until this node is in a completed world."""
+        ctx = get_context()
+        timeout = timeout or ctx.rdzv_timeout_secs
+        self._push_params_once()
+        # a fresh coordination port per round avoids bind clashes with the
+        # previous round's (possibly lingering) coordinator service; it is
+        # held open until the workers spawn (release_coordinator_port).
+        self.release_coordinator_port()
+        self._reserved_sock = reserve_port()
+        coord_port = self._reserved_sock.getsockname()[1]
+        addr = f"{self._host_ip}:{coord_port}"
+        self._client.join_rendezvous(
+            self.node_rank, self.local_world_size,
+            rdzv_name=self.rdzv_name, addr=addr,
+        )
+        deadline = time.time() + timeout
+        while True:
+            world_msg = self._client.get_comm_world(
+                self.rdzv_name, self.node_rank
+            )
+            world = world_msg.world or {}
+            if self.node_rank in world:
+                return self._build_info(world_msg.round, world,
+                                        world_msg.coordinator_addr)
+            if time.time() > deadline:
+                raise RendezvousTimeoutError(
+                    f"{self.rdzv_name}: rank {self.node_rank} not admitted "
+                    f"within {timeout}s (world={world})"
+                )
+            time.sleep(self._poll_interval)
+
+    def _build_info(self, rdzv_round: int, world: Dict[int, int],
+                    coordinator_addr: str) -> RendezvousInfo:
+        ranks = sorted(world)
+        group_rank = ranks.index(self.node_rank)
+        process_id_base = sum(world[r] for r in ranks[:group_rank])
+        info = RendezvousInfo(
+            round=rdzv_round,
+            world=world,
+            group_rank=group_rank,
+            group_world_size=len(ranks),
+            process_id_base=process_id_base,
+            local_world_size=world[self.node_rank],
+            num_processes=sum(world.values()),
+            coordinator_addr=coordinator_addr,
+        )
+        logger.info(
+            "%s round %d: node %d -> group_rank=%d procs [%d, %d) of %d, "
+            "coordinator=%s", self.rdzv_name, rdzv_round, self.node_rank,
+            group_rank, process_id_base,
+            process_id_base + info.local_world_size, info.num_processes,
+            coordinator_addr,
+        )
+        return info
+
+    def num_nodes_waiting(self) -> int:
+        return self._client.num_nodes_waiting(self.rdzv_name)

@@ -1,0 +1,113 @@
+"""Worker-side dynamic shard consumption.
+
+Role parity: ``dlrover/python/elastic_agent/sharding/client.py:31-337``
+(ShardingClient / IndexShardingClient): fetch shards from the master, credit
+consumed batches back so tasks complete by record count, and surface shard
+checkpoints for mid-epoch resume.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Iterator, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("agent.sharding")
+
+
+class ShardingClient:
+    """One per (worker, dataset): the worker's window into the master's
+    todo/doing queues."""
+
+    def __init__(
+        self,
+        master_client: MasterClient,
+        dataset_name: str,
+        batch_size: int,
+        dataset_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+        task_type: str = "training",
+    ):
+        self._client = master_client
+        self.dataset_name = dataset_name
+        self.batch_size = batch_size
+        self._lock = threading.Lock()
+        self._pending_batch_count = 0
+        self._current_task: Optional[comm.Task] = None
+        self._client.report_dataset_shard_params(
+            dataset_name=dataset_name,
+            dataset_size=dataset_size,
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            storage_type=storage_type,
+            task_type=task_type,
+        )
+
+    def fetch_shard(self) -> Optional[comm.Shard]:
+        """Next shard, or None when the dataset is exhausted."""
+        task = self._client.get_task(self.dataset_name)
+        if task is None or task.task_id < 0:
+            return None
+        self._current_task = task
+        return task.shard
+
+    def report_batch_done(self, batch_count: int = 1):
+        """Credit consumed batches; flushed to the master per batch group
+        (cheap: one rpc per batch, still shard-granular on the master)."""
+        with self._lock:
+            self._pending_batch_count += batch_count
+            records = self._pending_batch_count * self.batch_size
+            self._pending_batch_count = 0
+        if records:
+            self._client.report_batch_done(self.dataset_name, records)
+
+    def report_task_done(self, err_message: str = ""):
+        if self._current_task is not None:
+            self._client.report_task_result(
+                self.dataset_name, self._current_task.task_id, err_message
+            )
+            self._current_task = None
+
+    def get_shard_checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def restore_shard_checkpoint(self, content: str):
+        self._client.report_shard_checkpoint(self.dataset_name, content)
+
+
+class IndexShardingClient(ShardingClient):
+    """Streams record indices out of fetched shards — the piece an
+    index-based sampler/dataloader plugs into (the reference's
+    ``IndexShardingClient:249``)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._indices: Deque[int] = deque()
+
+    def fetch_record_index(self) -> Optional[int]:
+        with self._lock:
+            if not self._indices:
+                shard = self.fetch_shard()
+                if shard is None:
+                    return None
+                if shard.record_indices:
+                    self._indices.extend(shard.record_indices)
+                else:
+                    self._indices.extend(range(shard.start, shard.end))
+            return self._indices.popleft()
+
+    def record_indices(self) -> Iterator[int]:
+        while True:
+            idx = self.fetch_record_index()
+            if idx is None:
+                return
+            yield idx

@@ -1,0 +1,257 @@
+"""The per-host elastic agent.
+
+Role parity: ``ElasticTrainingAgent`` + ``NetworkCheckElasticAgent`` in
+``dlrover/python/elastic_agent/torch/training.py:215-767``: rendezvous
+through the master, spawn the host's training processes, monitor them,
+report failures, restart on failure or membership change, and (optionally)
+run the paired network check before training starts.
+
+TPU retarget: a "worker restart" hands new ``jax.distributed`` coordinates
+to fresh processes — XLA recompiles for the new topology (compile caches
+make this fast); the master's ``node_unit`` keeps every world a whole
+number of slices.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.rendezvous import (
+    MasterRendezvousHandler,
+    RendezvousInfo,
+    RendezvousTimeoutError,
+)
+from dlrover_tpu.agent.worker_group import (
+    WorkerGroup,
+    WorkerGroupState,
+    WorkerSpec,
+)
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import (
+    NodeStatus,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("agent.training")
+
+
+@dataclass
+class AgentConfig:
+    node_rank: int = 0
+    node_id: int = 0
+    nproc_per_node: int = 1
+    min_nodes: int = 1
+    max_nodes: int = 1
+    node_unit: int = 1
+    max_restarts: int = 3
+    monitor_interval: float = 2.0
+    rdzv_waiting_timeout: float = 30.0
+    network_check: bool = False
+    probe_platform: str = ""  # '' = process default (tpu in prod, cpu tests)
+
+
+class ElasticTrainingAgent:
+    def __init__(self, config: AgentConfig, spec: WorkerSpec,
+                 master_client: MasterClient,
+                 host_ip: Optional[str] = None):
+        self._config = config
+        self._client = master_client
+        self._worker_group = WorkerGroup(spec)
+        self._rdzv_handler = MasterRendezvousHandler(
+            master_client,
+            config.node_rank,
+            RendezvousName.TRAINING,
+            local_world_size=config.nproc_per_node,
+            min_nodes=config.min_nodes,
+            max_nodes=config.max_nodes,
+            waiting_timeout=config.rdzv_waiting_timeout,
+            node_unit=config.node_unit,
+            host_ip=host_ip,
+        )
+        self._remaining_restarts = config.max_restarts
+        self._host_ip = host_ip
+        self.last_rdzv: Optional[RendezvousInfo] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> int:
+        self._client.report_node_status(NodeStatus.RUNNING)
+        try:
+            if self._config.network_check:
+                ok = NetworkCheckAgent(
+                    self._config, self._client, self._host_ip
+                ).run()
+                if not ok:
+                    logger.error("network check failed on this node")
+                    self._client.report_node_status(NodeStatus.BREAKDOWN)
+                    return 1
+            self._initialize_workers()
+            return self._invoke_run()
+        finally:
+            self._worker_group.stop()
+
+    def _initialize_workers(self):
+        rdzv = self._rdzv_handler.next_rendezvous()
+        self.last_rdzv = rdzv
+        self._rdzv_handler.release_coordinator_port()
+        self._worker_group.start(
+            rdzv, self._client.addr, self._config.node_id
+        )
+
+    def _restart_workers(self):
+        logger.info("restarting workers into a new rendezvous round")
+        self._worker_group.stop()
+        self._worker_group.restart_count_up()
+        self._initialize_workers()
+
+    def _invoke_run(self) -> int:
+        """The agent monitor loop (reference ``_invoke_run:365``)."""
+        while True:
+            time.sleep(self._config.monitor_interval)
+            self._client.report_heartbeat()
+            state = self._worker_group.monitor()
+            if state == WorkerGroupState.SUCCEEDED:
+                logger.info("all workers finished successfully")
+                self._client.report_node_status(NodeStatus.SUCCEEDED)
+                return 0
+            if state == WorkerGroupState.FAILED:
+                self._report_failure()
+                if self._remaining_restarts > 0:
+                    self._remaining_restarts -= 1
+                    self._restart_workers()
+                    continue
+                logger.error("restart budget exhausted; giving up")
+                self._client.report_node_status(NodeStatus.FAILED)
+                return 1
+            # healthy: check whether membership changed (new/rejoined nodes
+            # waiting) and restart into a bigger/smaller world if so.
+            if self._membership_changed():
+                self._restart_workers()
+
+    def _membership_changed(self) -> bool:
+        try:
+            return self._rdzv_handler.num_nodes_waiting() > 0
+        except Exception:
+            return False
+
+    def _report_failure(self):
+        for failure in self._worker_group.failures():
+            logger.error(
+                "worker local_rank=%d exited with code %d",
+                failure.local_rank, failure.exit_code,
+            )
+            self._client.report_failure(
+                node_rank=self._config.node_rank,
+                restart_count=self._worker_group.restart_round,
+                error_data=(
+                    f"local_rank={failure.local_rank} "
+                    f"exit_code={failure.exit_code}"
+                ),
+                level=TrainingExceptionLevel.PROCESS_ERROR,
+            )
+
+
+class NetworkCheckAgent:
+    """Runs the 2-round paired probe before training starts.
+
+    Role parity: ``NetworkCheckElasticAgent.run`` (reference ``:618-654``).
+    Each round: join the NETWORK_CHECK rendezvous, receive a probe group,
+    run the probe subprocess over that group, report (normal, elapsed).
+    After both rounds the master's diagnosis decides.
+    """
+
+    CHECK_ROUNDS = 2
+
+    def __init__(self, config: AgentConfig, master_client: MasterClient,
+                 host_ip: Optional[str] = None):
+        self._config = config
+        self._client = master_client
+        self._handler = MasterRendezvousHandler(
+            master_client,
+            config.node_rank,
+            RendezvousName.NETWORK_CHECK,
+            local_world_size=1,  # one probe process per host
+            min_nodes=config.min_nodes,
+            max_nodes=config.max_nodes,
+            waiting_timeout=config.rdzv_waiting_timeout,
+            node_unit=1,
+            host_ip=host_ip,
+        )
+
+    def run(self) -> bool:
+        ctx = get_context()
+        for _ in range(self.CHECK_ROUNDS):
+            try:
+                group = self._handler.next_rendezvous(
+                    timeout=ctx.network_check_timeout_secs
+                )
+            except RendezvousTimeoutError:
+                # not admitted to this check round: we are outside the
+                # world, so do NOT report a result (it would corrupt the
+                # master's per-round accounting); the node stays suspect.
+                logger.warning("not admitted to network-check round")
+                return False
+            self._handler.release_coordinator_port()
+            normal, elapsed = self._run_probe(group)
+            self._client.report_network_check_result(
+                self._config.node_rank, normal, elapsed
+            )
+            self._wait_round_reported(group)
+        deadline = time.time() + ctx.network_check_timeout_secs
+        while time.time() < deadline:
+            success, reason = self._client.network_ready()
+            if success:
+                return self._config.node_rank not in set(
+                    self._abnormal_ranks()
+                )
+            if reason != "waiting":
+                break
+            time.sleep(1.0)
+        return self._config.node_rank not in set(self._abnormal_ranks())
+
+    def _abnormal_ranks(self) -> List[int]:
+        """Ranks the master's 2-round diagnosis marks as failed."""
+        try:
+            return self._client.abnormal_ranks()
+        except Exception:
+            return []
+
+    def _run_probe(self, group: RendezvousInfo) -> tuple:
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.agent.network_probe",
+            "--coordinator", group.coordinator_addr,
+            "--process_id", str(group.group_rank),
+            "--num_processes", str(group.group_world_size),
+        ]
+        if self._config.probe_platform:
+            cmd += ["--platform", self._config.probe_platform]
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, timeout=120, text=True
+            )
+            elapsed = time.time() - t0
+            if proc.returncode != 0:
+                logger.warning("probe failed: %s", proc.stderr[-2000:])
+                return False, elapsed
+            return True, elapsed
+        except subprocess.TimeoutExpired:
+            return False, time.time() - t0
+
+    def _wait_round_reported(self, group: RendezvousInfo,
+                             timeout: float = 60.0):
+        """Block until every node in the group reported, so rounds don't
+        overlap (cheap poll against the master)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            success, reason = self._client.network_ready()
+            if reason != "waiting":
+                return
+            time.sleep(0.5)

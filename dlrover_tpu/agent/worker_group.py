@@ -1,0 +1,166 @@
+"""Local worker process group: spawn/monitor/kill the per-host JAX
+training processes.
+
+Role parity: the subprocess-management half of torch's LocalElasticAgent as
+used in ``dlrover/python/elastic_agent/torch/training.py`` (PContext spawn +
+``_monitor_workers``). One process per local chip-group; each gets the
+jax.distributed coordinates in its environment.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Sequence
+
+from dlrover_tpu.agent.rendezvous import RendezvousInfo
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("agent.workers")
+
+
+class WorkerGroupState(str, Enum):
+    INIT = "INIT"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+@dataclass
+class WorkerSpec:
+    """What to run on this host."""
+
+    entrypoint: str  # a python script path or executable
+    args: Sequence[str] = field(default_factory=tuple)
+    nproc_per_node: int = 1
+    env: Dict[str, str] = field(default_factory=dict)
+    redirect_output: Optional[str] = None  # directory for per-rank logs
+
+
+@dataclass
+class WorkerFailure:
+    local_rank: int
+    exit_code: int
+    log_tail: str = ""
+
+
+class WorkerGroup:
+    def __init__(self, spec: WorkerSpec):
+        self.spec = spec
+        self._procs: List[subprocess.Popen] = []
+        self._log_files: List = []
+        self.state = WorkerGroupState.INIT
+        self.restart_round = 0
+
+    def start(self, rdzv: RendezvousInfo, master_addr: str, node_id: int):
+        """Spawn ``nproc_per_node`` processes with SPMD coordinates."""
+        if self.spec.nproc_per_node < 1:
+            raise ValueError(
+                f"nproc_per_node must be >= 1, got {self.spec.nproc_per_node}"
+            )
+        self.stop()
+        self._procs = []
+        self._log_files = []
+        for local_rank in range(self.spec.nproc_per_node):
+            env = dict(os.environ)
+            env.update(self.spec.env)
+            env.update({
+                NodeEnv.MASTER_ADDR: master_addr,
+                NodeEnv.NODE_ID: str(node_id),
+                NodeEnv.NODE_RANK: str(rdzv.group_rank),
+                NodeEnv.NODE_NUM: str(rdzv.group_world_size),
+                NodeEnv.COORDINATOR_ADDR: rdzv.coordinator_addr,
+                NodeEnv.PROCESS_ID: str(rdzv.process_id_base + local_rank),
+                NodeEnv.NUM_PROCESSES: str(rdzv.num_processes),
+                NodeEnv.RESTART_ROUND: str(self.restart_round),
+                "LOCAL_RANK": str(local_rank),
+                "LOCAL_WORLD_SIZE": str(self.spec.nproc_per_node),
+            })
+            cmd = self._build_cmd()
+            stdout = stderr = None
+            if self.spec.redirect_output:
+                os.makedirs(self.spec.redirect_output, exist_ok=True)
+                path = os.path.join(
+                    self.spec.redirect_output,
+                    f"worker_{rdzv.process_id_base + local_rank}"
+                    f"_r{self.restart_round}.log",
+                )
+                f = open(path, "ab")
+                self._log_files.append(f)
+                stdout = stderr = f
+            proc = subprocess.Popen(
+                cmd, env=env, stdout=stdout, stderr=stderr,
+                start_new_session=True,
+            )
+            self._procs.append(proc)
+        self.state = WorkerGroupState.RUNNING
+        logger.info(
+            "spawned %d workers (restart round %d): %s",
+            len(self._procs), self.restart_round, self._build_cmd(),
+        )
+
+    def _build_cmd(self) -> List[str]:
+        entry = self.spec.entrypoint
+        if entry.endswith(".py"):
+            return [sys.executable, "-u", entry, *self.spec.args]
+        return [entry, *self.spec.args]
+
+    def monitor(self) -> WorkerGroupState:
+        """Poll subprocess states; FAILED wins over SUCCEEDED."""
+        if self.state not in (WorkerGroupState.RUNNING,):
+            return self.state
+        if not self._procs:  # never started: nothing ran, nothing succeeded
+            self.state = WorkerGroupState.FAILED
+            return self.state
+        codes = [p.poll() for p in self._procs]
+        if any(c is not None and c != 0 for c in codes):
+            self.state = WorkerGroupState.FAILED
+        elif all(c == 0 for c in codes):
+            self.state = WorkerGroupState.SUCCEEDED
+        return self.state
+
+    def failures(self) -> List[WorkerFailure]:
+        out = []
+        for i, p in enumerate(self._procs):
+            code = p.poll()
+            if code is not None and code != 0:
+                out.append(WorkerFailure(local_rank=i, exit_code=code))
+        return out
+
+    def stop(self, grace_secs: float = 5.0):
+        """Terminate the whole process group of every worker."""
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + grace_secs
+        for p in self._procs:
+            remaining = max(0.1, deadline - time.time())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.wait()
+        for f in self._log_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._log_files = []
+        if self._procs:
+            self.state = WorkerGroupState.STOPPED
+
+    def restart_count_up(self):
+        self.restart_round += 1

@@ -175,6 +175,16 @@ class StragglerExistRequest:
 
 
 @message
+class AbnormalNodesRequest:
+    pass
+
+
+@message
+class NodeRankList:
+    ranks: Optional[List[int]] = None
+
+
+@message
 class RendezvousState:
     round: int = 0
     waiting_num: int = 0

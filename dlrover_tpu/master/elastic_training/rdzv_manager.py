@@ -319,6 +319,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     def report_network_check_result(self, rank: int, normal: bool,
                                     elapsed: float = 0.0):
         with self._lock:
+            if self._rdzv_nodes and rank not in self._rdzv_nodes:
+                logger.warning(
+                    "ignoring network-check report from rank %d outside "
+                    "the current probe world %s", rank,
+                    sorted(self._rdzv_nodes),
+                )
+                return
             self._reported_nodes.add(rank)
             self._node_status[rank] = self._node_status.get(rank, False) or normal
             if elapsed:

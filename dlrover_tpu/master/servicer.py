@@ -56,6 +56,7 @@ class MasterServicer:
             comm.WaitingNodeNumRequest: self._num_nodes_waiting,
             comm.NetworkReadyRequest: self._network_ready,
             comm.StragglerExistRequest: self._straggler_exist,
+            comm.AbnormalNodesRequest: self._abnormal_nodes,
             comm.KVStoreGetRequest: self._kv_get,
             comm.KVStoreAddRequest: self._kv_add,
             comm.BarrierRequest: self._barrier_query,
@@ -230,6 +231,11 @@ class MasterServicer:
             return comm.Response(success=True)
         success, reason = mgr.network_check_success()
         return comm.Response(success=success, reason=reason)
+
+    def _abnormal_nodes(self, req: comm.AbnormalNodesRequest):
+        mgr = self._manager(RendezvousName.NETWORK_CHECK)
+        ranks = mgr.abnormal_nodes() if mgr else []
+        return comm.NodeRankList(ranks=ranks)
 
     def _straggler_exist(self, req: comm.StragglerExistRequest):
         mgr = self._manager(RendezvousName.NETWORK_CHECK)

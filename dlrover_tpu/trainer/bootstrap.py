@@ -1,0 +1,86 @@
+"""Training-process bootstrap: env contract -> jax.distributed.
+
+The agent hands every worker process its SPMD coordinates via environment
+variables (``NodeEnv``); calling :func:`init_worker` inside the training
+script wires them into ``jax.distributed.initialize`` — the TPU-native
+replacement for the reference wiring torch's c10d store through the master
+(``elastic_agent/torch/master_kv_store.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import (
+    MasterClient,
+    build_master_client,
+)
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("trainer.bootstrap")
+
+
+@dataclass
+class WorkerContext:
+    process_id: int
+    num_processes: int
+    node_rank: int
+    node_num: int
+    local_rank: int
+    local_world_size: int
+    restart_round: int
+    coordinator_addr: str
+    master_client: Optional[MasterClient]
+
+    @property
+    def is_chief(self) -> bool:
+        return self.process_id == 0
+
+
+def init_worker(platform: Optional[str] = None,
+                cpu_collectives: str = "gloo") -> WorkerContext:
+    """Initialize distributed JAX from the agent's env contract.
+
+    ``platform``: force a jax platform (tests pass "cpu"); None keeps the
+    process default (TPU in production).
+    """
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu" and cpu_collectives:
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", cpu_collectives
+                )
+            except Exception:
+                pass
+
+    process_id = int(os.environ.get(NodeEnv.PROCESS_ID, "0"))
+    num_processes = int(os.environ.get(NodeEnv.NUM_PROCESSES, "1"))
+    coordinator = os.environ.get(NodeEnv.COORDINATOR_ADDR, "")
+    ctx = WorkerContext(
+        process_id=process_id,
+        num_processes=num_processes,
+        node_rank=int(os.environ.get(NodeEnv.NODE_RANK, "0")),
+        node_num=int(os.environ.get(NodeEnv.NODE_NUM, "1")),
+        local_rank=int(os.environ.get("LOCAL_RANK", "0")),
+        local_world_size=int(os.environ.get("LOCAL_WORLD_SIZE", "1")),
+        restart_round=int(os.environ.get(NodeEnv.RESTART_ROUND, "0")),
+        coordinator_addr=coordinator,
+        master_client=build_master_client(),
+    )
+    if num_processes > 1 and coordinator:
+        logger.info(
+            "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+            coordinator, num_processes, process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return ctx

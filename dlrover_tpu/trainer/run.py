@@ -1,0 +1,176 @@
+"""``tpurun`` — the elastic launcher CLI.
+
+Role parity: ``dlrover-run`` (``dlrover/trainer/torch/elastic_run.py``):
+torchrun-flavoured flags, ``--standalone`` boots a local master subprocess,
+and if no master is reachable the launcher degrades to running the script
+directly (the reference falls back to vanilla torchrun).
+
+Usage:
+    tpurun --standalone --nproc_per_node 4 train.py --lr 3e-4
+    tpurun --nnodes 2:4 --node_unit 2 --network-check train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import select
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.monitor.resource import ResourceMonitor
+from dlrover_tpu.agent.training_agent import (
+    AgentConfig,
+    ElasticTrainingAgent,
+)
+from dlrover_tpu.agent.worker_group import WorkerSpec
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.rpc.server import addr_connectable
+
+logger = get_logger("trainer.run")
+
+
+def parse_nnodes(value: str) -> Tuple[int, int]:
+    """"2" -> (2,2); "1:4" -> (1,4)."""
+    if ":" in value:
+        lo, hi = value.split(":", 1)
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpurun", description="dlrover_tpu elastic launcher"
+    )
+    p.add_argument("--nnodes", default="1",
+                   help="node count or MIN:MAX for elasticity")
+    p.add_argument("--nproc_per_node", default="auto",
+                   help="JAX processes per host ('auto' = 1)")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get(NodeEnv.NODE_RANK, "0")))
+    p.add_argument("--node_unit", type=int, default=1,
+                   help="hosts per TPU slice; worlds stay a multiple")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--standalone", action="store_true",
+                   help="boot a local master subprocess")
+    p.add_argument("--master_addr",
+                   default=os.environ.get(NodeEnv.MASTER_ADDR, ""))
+    p.add_argument("--network-check", dest="network_check",
+                   action="store_true",
+                   help="run the paired allgather probe before training")
+    p.add_argument("--probe_platform", default="",
+                   help="jax platform for the chip probe (tests: cpu)")
+    p.add_argument("--rdzv_waiting_timeout", type=float, default=30.0)
+    p.add_argument("--monitor_interval", type=float, default=2.0)
+    p.add_argument("--log_dir", default="",
+                   help="redirect per-worker stdout/err to this directory")
+    p.add_argument("entrypoint", help="training script or executable")
+    p.add_argument("args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _launch_local_master(timeout: float = 30.0) -> Tuple[subprocess.Popen, str]:
+    """Spawn ``python -m dlrover_tpu.master.main`` and scrape its addr."""
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "dlrover_tpu.master.main",
+         "--platform", "local"],
+        stdout=subprocess.PIPE, stderr=None, text=True,
+    )
+    deadline = time.time() + timeout
+    addr = ""
+    while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not ready:
+            if proc.poll() is not None:
+                raise RuntimeError("local master exited during startup")
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError("local master exited during startup")
+            time.sleep(0.1)
+            continue
+        m = re.match(r"DLROVER_TPU_MASTER_ADDR=(\S+)", line)
+        if m:
+            addr = m.group(1)
+            break
+    if not addr:
+        proc.terminate()
+        raise RuntimeError("local master did not report its address")
+    logger.info("standalone master at %s", addr)
+    return proc, addr
+
+
+def _run_without_master(args, script_args: List[str]) -> int:
+    """Degraded mode: exec the entrypoint directly (reference falls back to
+    torchrun when no master is reachable, ``elastic_run.py:154-171``)."""
+    logger.warning("no master reachable; running entrypoint directly")
+    cmd = (
+        [sys.executable, "-u", args.entrypoint]
+        if args.entrypoint.endswith(".py") else [args.entrypoint]
+    )
+    return subprocess.call(cmd + script_args)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    script_args = list(args.args)
+    if script_args and script_args[0] == "--":
+        script_args = script_args[1:]  # strip only the leading separator
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    nproc = 1 if args.nproc_per_node == "auto" else int(args.nproc_per_node)
+    if nproc < 1:
+        print("tpurun: --nproc_per_node must be >= 1", file=sys.stderr)
+        return 2
+
+    master_proc = None
+    addr = args.master_addr
+    try:
+        if args.standalone:
+            master_proc, addr = _launch_local_master()
+        if not addr or not addr_connectable(addr):
+            return _run_without_master(args, script_args)
+
+        os.environ[NodeEnv.MASTER_ADDR] = addr
+        client = MasterClient(addr, node_id=args.node_rank)
+        config = AgentConfig(
+            node_rank=args.node_rank,
+            node_id=args.node_rank,
+            nproc_per_node=nproc,
+            min_nodes=min_nodes,
+            max_nodes=max_nodes,
+            node_unit=args.node_unit,
+            max_restarts=args.max_restarts,
+            monitor_interval=args.monitor_interval,
+            rdzv_waiting_timeout=args.rdzv_waiting_timeout,
+            network_check=args.network_check,
+            probe_platform=args.probe_platform,
+        )
+        spec = WorkerSpec(
+            entrypoint=args.entrypoint,
+            args=tuple(script_args),
+            nproc_per_node=nproc,
+            redirect_output=args.log_dir or None,
+        )
+        monitor = ResourceMonitor(client)
+        monitor.start()
+        agent = ElasticTrainingAgent(config, spec, client)
+        rc = agent.run()
+        if args.standalone and args.node_rank == 0:
+            client.report_job_exit(success=(rc == 0))
+        monitor.stop()
+        return rc
+    finally:
+        if master_proc is not None:
+            time.sleep(0.2)
+            master_proc.terminate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,3 +14,10 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
+
+# The environment's sitecustomize force-registers an experimental TPU
+# platform ('axon') that overrides JAX_PLATFORMS; an explicit config update
+# after import is the only reliable way to pin the CPU backend.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,48 @@
+"""E2E test worker: bootstrap distributed JAX from the agent env contract,
+run a real cross-process collective, and consume dynamic shards."""
+
+import sys
+
+from dlrover_tpu.trainer.bootstrap import init_worker
+
+
+def main() -> int:
+    ctx = init_worker(platform="cpu")
+    import jax
+    import jax.numpy as jnp
+
+    if ctx.num_processes > 1:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(
+            jnp.ones(1) * ctx.process_id
+        )
+        assert gathered.shape[0] == ctx.num_processes, gathered.shape
+        assert float(gathered.sum()) == sum(range(ctx.num_processes))
+
+    client = ctx.master_client
+    if client is not None:
+        from dlrover_tpu.agent.sharding_client import ShardingClient
+
+        sharding = ShardingClient(
+            client, "e2e_ds", batch_size=2, dataset_size=8, num_epochs=1,
+            num_minibatches_per_shard=2,
+        )
+        consumed = 0
+        if ctx.is_chief:  # chief consumes; others train on broadcast data
+            while True:
+                shard = sharding.fetch_shard()
+                if shard is None:
+                    break
+                consumed += shard.end - shard.start
+                sharding.report_batch_done(
+                    (shard.end - shard.start) // 2
+                )
+            assert consumed == 8, consumed
+            client.report_global_step(consumed // 2)
+    print(f"worker {ctx.process_id}/{ctx.num_processes} done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

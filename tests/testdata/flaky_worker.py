@@ -1,0 +1,14 @@
+"""E2E test worker that fails on its first launch and succeeds after the
+agent restarts it (exercises the failure -> report -> re-rendezvous path)."""
+
+import os
+import sys
+
+from dlrover_tpu.common.constants import NodeEnv
+
+restart_round = int(os.environ.get(NodeEnv.RESTART_ROUND, "0"))
+if restart_round == 0:
+    print("flaky worker: failing on purpose (round 0)", flush=True)
+    sys.exit(3)
+print(f"flaky worker: succeeding on round {restart_round}", flush=True)
+sys.exit(0)
