@@ -1,0 +1,149 @@
+"""Dryrun profiling + strategy search.
+
+Role parity: atorch's acceleration engine — ``dry_runner/dry_runner.py``
+(timed profile steps), ``auto/engine/executor.py`` + ``sg_algo`` (candidate
+generation and scoring). The TPU version scores candidates by compiling the
+real train step (XLA cost analysis gives FLOPs/bytes for free) and timing a
+few steps; the search space is the mesh-factorization catalog from
+``mesh.candidate_plans``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.accelerate import AccelerateResult, accelerate
+from dlrover_tpu.parallel.mesh import MeshPlan, candidate_plans
+from dlrover_tpu.parallel.strategy import Strategy
+
+logger = get_logger("parallel.tune")
+
+
+@dataclass
+class DryrunReport:
+    strategy: Strategy
+    compile_time_s: float = 0.0
+    step_time_s: float = 0.0
+    flops_per_step: float = 0.0
+    peak_memory_bytes: int = 0
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return not self.error
+
+    @property
+    def device_flops_per_s(self) -> float:
+        if self.step_time_s <= 0:
+            return 0.0
+        return self.flops_per_step / self.step_time_s
+
+
+def dryrun(result: AccelerateResult, example_batch, rng=None,
+           warmup_steps: int = 1, profile_steps: int = 3) -> DryrunReport:
+    """Compile + a few timed steps (``ATORCH_DRYRUN_*`` parity)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    report = DryrunReport(strategy=result.strategy)
+    try:
+        state = result.init_fn(rng)
+        batch = result.shard_batch(example_batch)
+
+        t0 = time.time()
+        lowered = result.train_step.lower(state, batch, rng)
+        compiled = lowered.compile()
+        report.compile_time_s = time.time() - t0
+
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            report.flops_per_step = float(cost.get("flops", 0.0))
+        except Exception:
+            pass
+        try:
+            mem = compiled.memory_analysis()
+            report.peak_memory_bytes = int(
+                getattr(mem, "temp_size_in_bytes", 0)
+                + getattr(mem, "argument_size_in_bytes", 0)
+            )
+        except Exception:
+            pass
+
+        for _ in range(warmup_steps):
+            state, _metrics = compiled(state, batch, rng)
+        jax.block_until_ready(state)
+        t0 = time.time()
+        for _ in range(profile_steps):
+            state, _metrics = compiled(state, batch, rng)
+        jax.block_until_ready(state)
+        report.step_time_s = (time.time() - t0) / max(1, profile_steps)
+    except Exception as e:  # candidate infeasible (OOM, bad factorization)
+        report.error = f"{type(e).__name__}: {e}"
+        logger.info("dryrun failed for %s: %s",
+                    report.strategy.mesh, report.error[:200])
+    return report
+
+
+def search_strategy(
+    init_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    example_batch,
+    base_strategy: Optional[Strategy] = None,
+    candidates: Optional[Sequence[MeshPlan]] = None,
+    devices: Optional[Sequence] = None,
+    max_candidates: int = 8,
+    profile_steps: int = 3,
+) -> tuple:
+    """Try candidate meshes; return (best_strategy, all_reports).
+
+    The reference's engine distributes ANALYSE/TUNE/DRYRUN tasks over
+    ranks; here every candidate compiles against the same devices, so the
+    loop is local and the winning strategy is broadcast via the master's
+    ParallelConfig push instead.
+    """
+    base = base_strategy or Strategy()
+    n_devices = len(devices) if devices is not None else jax.device_count()
+    plans = list(candidates) if candidates is not None else candidate_plans(
+        n_devices
+    )
+    if len(plans) > max_candidates:
+        logger.info(
+            "search: truncating %d candidates to %d (dropped: %s)",
+            len(plans), max_candidates,
+            [p.axis_sizes() for p in plans[max_candidates:]],
+        )
+        plans = plans[:max_candidates]
+    reports: List[DryrunReport] = []
+    for plan in plans:
+        strategy = dataclasses.replace(base, mesh=plan)
+        try:
+            result = accelerate(
+                init_fn, loss_fn, optimizer, example_batch,
+                strategy=strategy, devices=devices,
+            )
+        except Exception as e:
+            reports.append(DryrunReport(strategy=strategy,
+                                        error=f"{type(e).__name__}: {e}"))
+            continue
+        reports.append(
+            dryrun(result, example_batch, profile_steps=profile_steps)
+        )
+    viable = [r for r in reports if r.ok and r.step_time_s > 0]
+    if not viable:
+        raise RuntimeError(
+            "no viable strategy found; errors: "
+            + "; ".join(r.error[:100] for r in reports)
+        )
+    best = min(viable, key=lambda r: r.step_time_s)
+    logger.info(
+        "search: best mesh %s at %.4fs/step over %d candidates",
+        best.strategy.mesh, best.step_time_s, len(reports),
+    )
+    return best.strategy, reports

@@ -1,0 +1,173 @@
+"""Parameter/activation sharding rules: regex path -> PartitionSpec.
+
+Role parity: the *declarative* replacement for atorch's wrapper stack —
+``modules_registry.py`` (shardable-op -> sharded-op map driving automatic
+TP), ``zero_optimization.py`` (FSDP wrapping) and the MIP planner's output.
+On TPU all of those collapse into: every parameter gets a
+``NamedSharding``, and XLA's SPMD partitioner inserts the collectives.
+
+Rule grammar (first match wins):
+  (r"attention/(q|k|v)_proj/kernel", ("embed", "tensor"))   explicit spec
+  (r".*", FSDP_AUTO)                                        shard largest
+                                                            divisible dim
+                                                            on the fsdp axis
+Axis-name tokens in specs are *mesh* axis names; None replicates that dim.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("parallel.rules")
+
+FSDP_AUTO = "FSDP_AUTO"
+REPLICATED = "REPLICATED"
+
+SpecLike = Union[str, Tuple, None]
+Rule = Tuple[str, SpecLike]
+
+
+def _flatten_with_paths(tree) -> List[Tuple[str, Any]]:
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        name = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        out.append((name, leaf))
+    return out
+
+
+def _auto_fsdp_spec(shape: Sequence[int], mesh_axis_sizes: Dict[str, int],
+                    fsdp_axis: str = "fsdp") -> Tuple:
+    """Shard the largest dim divisible by the fsdp axis size; replicate if
+    nothing divides (small params aren't worth scattering)."""
+    size = mesh_axis_sizes.get(fsdp_axis, 1)
+    if size <= 1 or not shape:
+        return tuple(None for _ in shape)
+    best_dim, best_len = -1, 0
+    for i, d in enumerate(shape):
+        if d % size == 0 and d > best_len:
+            best_dim, best_len = i, d
+    spec = [None] * len(shape)
+    if best_dim >= 0:
+        spec[best_dim] = fsdp_axis
+    return tuple(spec)
+
+
+def _normalize_spec(spec: SpecLike, shape: Sequence[int],
+                    mesh_axis_sizes: Dict[str, int]) -> Tuple:
+    if spec == FSDP_AUTO:
+        return _auto_fsdp_spec(shape, mesh_axis_sizes)
+    if spec in (REPLICATED, None):
+        return tuple(None for _ in shape)
+    if isinstance(spec, str):
+        raise ValueError(
+            f"string spec {spec!r} is ambiguous: use FSDP_AUTO, REPLICATED "
+            "or a tuple like (None, 'fsdp')"
+        )
+    # tuple spec: trim/validate against rank and axis divisibility
+    spec = tuple(spec)
+    if len(spec) > len(shape):
+        spec = spec[: len(shape)]
+    out = []
+    for dim, names in zip(shape, spec):
+        if names is None:
+            out.append(None)
+            continue
+        names_t = (names,) if isinstance(names, str) else tuple(names)
+        total = 1
+        for n in names_t:
+            total *= mesh_axis_sizes.get(n, 1)
+        if total <= 1 or dim % total != 0:
+            out.append(None)  # axis collapsed or indivisible: replicate
+        else:
+            out.append(names if isinstance(names, str) else names_t)
+    out += [None] * (len(shape) - len(out))
+    return tuple(out)
+
+
+class ShardingRules:
+    def __init__(self, rules: Optional[List[Rule]] = None,
+                 default: SpecLike = FSDP_AUTO):
+        self.rules = list(rules or [])
+        self.default = default
+
+    def spec_for(self, path: str, shape: Sequence[int],
+                 mesh_axis_sizes: Dict[str, int]) -> Tuple:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return _normalize_spec(spec, shape, mesh_axis_sizes)
+        return _normalize_spec(self.default, shape, mesh_axis_sizes)
+
+    def tree_shardings(self, mesh, tree_shapes):
+        """Map a pytree of ShapeDtypeStruct/arrays -> NamedShardings."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+        flat = _flatten_with_paths(tree_shapes)
+        specs = {}
+        for path, leaf in flat:
+            shape = getattr(leaf, "shape", ())
+            specs[path] = self.spec_for(path, shape, axis_sizes)
+
+        def to_sharding(path_leaf):
+            path, leaf = path_leaf
+            return NamedSharding(mesh, PartitionSpec(*specs[path]))
+
+        shardings = [to_sharding(pl) for pl in flat]
+        treedef = jax.tree_util.tree_structure(tree_shapes)
+        return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def batch_sharding(mesh, spec_axes=(("data", "fsdp"),)):
+    """NamedSharding for input batches: leading (batch) dim split across
+    the data-parallel axes."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec_axes))
+
+
+# -- canonical rule sets ----------------------------------------------------
+
+def llama_rules() -> ShardingRules:
+    """Megatron-style TP + FSDP for llama-family transformers.
+
+    Parity map (atorch -> here):
+      ColumnParallelLinear (layers.py:380)  -> kernel last dim on "tensor"
+      RowParallelLinear    (layers.py:227)  -> kernel first dim on "tensor"
+      VocabParallelEmbedding (layers.py:540)-> embedding vocab dim sharded
+    """
+    return ShardingRules(rules=[
+        # attention: q/k/v are column-parallel, o is row-parallel
+        (r"(q_proj|k_proj|v_proj)/kernel$", (None, "tensor")),
+        (r"o_proj/kernel$", ("tensor", None)),
+        # mlp: up/gate column-parallel, down row-parallel
+        (r"(gate_proj|up_proj)/kernel$", (None, "tensor")),
+        (r"down_proj/kernel$", ("tensor", None)),
+        # embeddings / head: vocab-parallel
+        (r"embed_tokens/embedding$", ("tensor", "fsdp")),
+        (r"lm_head/kernel$", ("fsdp", "tensor")),
+        # norms replicate
+        (r"(norm|ln)[^/]*/(scale|bias)$", REPLICATED),
+        (r".*", FSDP_AUTO),
+    ])
+
+
+def moe_rules() -> ShardingRules:
+    """Expert-parallel MoE: expert weight blocks sharded on the expert
+    (data x fsdp) submesh; router replicated."""
+    rules = llama_rules().rules
+    return ShardingRules(rules=[
+        # leading dim = experts, sharded over the (data x fsdp) submesh
+        (r"experts/.*kernel$", (("data", "fsdp"), None, "tensor")),
+        (r"router/kernel$", REPLICATED),
+        *rules,
+    ])

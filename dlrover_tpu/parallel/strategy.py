@@ -1,0 +1,113 @@
+"""The acceleration strategy object.
+
+Role parity: atorch's strategy — an ordered list of optimization methods
+(``atorch/atorch/auto/strategy.py``, picklable, re-fit to the world size by
+``adjust_strategy``). On TPU the whole wrapper catalog (DDP/ZeRO/FSDP/TP/
+AMP/checkpointing) collapses into four declarative knobs:
+
+  mesh      : how devices are arranged        (parallel_mode/zero/tp/pp)
+  rules     : where tensors live on the mesh  (fsdp wrap policy, tp plan)
+  remat     : what activations to save        (checkpoint_optimization)
+  dtypes    : what precision to compute in    (amp/half optimization)
+
+plus ``grad_accum_steps`` — the elasticity lever that keeps the global
+batch fixed when the world shrinks (``trainer/torch/elastic.py:387-401``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.sharding_rules import (
+    ShardingRules,
+    llama_rules,
+    moe_rules,
+)
+
+RULE_SETS = {
+    "fsdp": lambda: ShardingRules(),
+    "llama": llama_rules,
+    "moe": moe_rules,
+}
+
+
+@dataclass
+class DtypePolicy:
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    output_dtype: str = "float32"
+
+
+@dataclass
+class Strategy:
+    mesh: MeshPlan = field(default_factory=MeshPlan)
+    rule_set: str = "fsdp"
+    remat_policy: str = ""  # "", "full", "dots_saveable", "nothing_saveable"
+    dtypes: DtypePolicy = field(default_factory=DtypePolicy)
+    grad_accum_steps: int = 1
+    # global batch row count; accelerate() validates the example batch
+    # against it and adjust_to_world keeps accum a divisor of it.
+    # 0 = derived from the example batch at accelerate() time.
+    global_batch_size: int = 0
+
+    def rules(self) -> ShardingRules:
+        factory = RULE_SETS.get(self.rule_set)
+        if factory is None:
+            raise ValueError(
+                f"unknown rule set {self.rule_set!r}; "
+                f"have {sorted(RULE_SETS)}"
+            )
+        return factory()
+
+    # -- elasticity ---------------------------------------------------------
+
+    def adjust_to_world(self, num_devices: int,
+                        prev_num_devices: Optional[int] = None) -> "Strategy":
+        """Re-fit after a membership change, keeping the global batch fixed.
+
+        The DP degree changes with the world; grad_accum_steps scales
+        inversely so batch_per_device * dp * accum stays constant
+        (ElasticTrainer semantics, ``elastic.py:387-401``).
+        """
+        new_mesh = self.mesh.adjust_to_world(num_devices)
+        accum = self.grad_accum_steps
+        if prev_num_devices and prev_num_devices != num_devices:
+            old_dp = max(1, self.mesh.adjust_to_world(prev_num_devices).dp_degree)
+            new_dp = max(1, new_mesh.dp_degree)
+            accum = max(1, round(self.grad_accum_steps * old_dp / new_dp))
+            if self.global_batch_size > 0:
+                # accum must divide the per-step batch or the microbatch
+                # reshape in accelerate() fails: snap to the nearest
+                # divisor of the global batch.
+                divisors = [
+                    d for d in range(1, self.global_batch_size + 1)
+                    if self.global_batch_size % d == 0
+                ]
+                accum = min(divisors, key=lambda d: abs(d - accum))
+        return dataclasses.replace(self, mesh=new_mesh,
+                                   grad_accum_steps=accum)
+
+    # -- persistence (reference strategies are picklable; ours are JSON) ----
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Strategy":
+        raw = json.loads(text)
+        raw["mesh"] = MeshPlan(**raw.get("mesh", {}))
+        raw["dtypes"] = DtypePolicy(**raw.get("dtypes", {}))
+        return cls(**raw)
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "Strategy":
+        with open(path) as f:
+            return cls.from_json(f.read())
