@@ -1,0 +1,149 @@
+"""Mixture-of-Experts: router, capacity-based dispatch, expert parallelism.
+
+Role parity: ``atorch/atorch/modules/moe/moe_layer.py:22-565`` (expert
+process groups + ``_AllToAll`` autograd + ``Experts``) and
+``switch_gating.py:24-195`` (top-1 gating with capacity and load-balance
+aux loss). TPU-first: dispatch/combine are one-hot einsums over a
+[tokens, experts, capacity] tensor; with expert weights sharded on the
+expert submesh and tokens on the data axes, XLA lowers those einsums to the
+all-to-all — no hand-written autograd collective is needed.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class MoEConfig:
+    num_experts: int
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    top_k: int = 1  # 1 = switch routing, 2 = gshard-style
+    aux_loss_weight: float = 0.01
+    router_jitter: float = 0.0  # multiplicative logit noise during training
+
+
+def _capacity(num_tokens: int, num_experts: int, factor: float) -> int:
+    return max(1, int(math.ceil(num_tokens * factor / num_experts)))
+
+
+def router_dispatch(
+    logits: jax.Array,  # [T, E]
+    capacity: int,
+    top_k: int = 1,
+    rng: Optional[jax.Array] = None,
+    jitter: float = 0.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compute (dispatch_mask [T,E,C], combine_weights [T,E,C], aux_loss).
+
+    Switch-style: each token goes to its top-k experts, subject to a
+    per-expert capacity; overflowing tokens are dropped (their combine
+    weight is zero, so the residual path carries them).
+    """
+    t, e = logits.shape
+    if rng is not None and jitter > 0.0:
+        noise = jax.random.uniform(
+            rng, logits.shape, minval=1.0 - jitter, maxval=1.0 + jitter
+        )
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    remaining = probs
+    expert_fill = jnp.zeros((e,), jnp.int32)
+    total_onehot = jnp.zeros((t, e), jnp.float32)
+
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
+        # position of each token within its expert's queue (arrival order)
+        pos_in_expert = (
+            jnp.cumsum(onehot, axis=0) - onehot
+        ) * onehot  # [T, E]
+        pos_in_expert = pos_in_expert + expert_fill[None, :] * onehot
+        within = (pos_in_expert < capacity).astype(jnp.float32) * onehot
+        pos = pos_in_expert.sum(axis=-1).astype(jnp.int32)  # [T]
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        gate = (probs * onehot).sum(axis=-1, keepdims=True)  # [T,1]
+        # `within` is already zero for dropped/over-capacity tokens
+        dispatch = dispatch + within[:, :, None] * pos_oh[:, None, :]
+        combine = combine + (
+            gate[:, :, None] * within[:, :, None] * pos_oh[:, None, :]
+        )
+        expert_fill = expert_fill + within.sum(axis=0).astype(jnp.int32)
+        total_onehot = total_onehot + onehot
+        remaining = remaining * (1.0 - onehot)
+
+    # load-balance auxiliary loss (switch transformer eq. 4)
+    frac_tokens = total_onehot.mean(axis=0)  # [E]
+    frac_probs = probs.mean(axis=0)  # [E]
+    aux_loss = e * jnp.sum(frac_tokens * frac_probs) / max(1, top_k)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(
+    params: dict,
+    x: jax.Array,  # [B, S, D]
+    config: MoEConfig,
+    activation: Callable = jax.nn.gelu,
+    train: bool = True,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Switch-FFN block. params:
+      router/kernel: [D, E]
+      experts/up/kernel:   [E, D, F]
+      experts/down/kernel: [E, F, D]
+    Returns (output [B,S,D], aux_loss scalar).
+    """
+    b, s, d = x.shape
+    t = b * s
+    xt = x.reshape(t, d)
+    logits = xt @ params["router"]["kernel"]  # [T, E]
+    factor = config.capacity_factor if train else config.eval_capacity_factor
+    capacity = _capacity(t, config.num_experts, factor)
+    dispatch, combine, aux = router_dispatch(
+        logits, capacity, config.top_k, rng,
+        config.router_jitter if train else 0.0,
+    )
+    # all-to-all #1: tokens -> expert queues (XLA inserts the collective
+    # when experts are mesh-sharded)
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(x.dtype), xt
+    )  # [E, C, D]
+    h = activation(jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["experts"]["up"]["kernel"]
+    ))
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", h, params["experts"]["down"]["kernel"]
+    )  # [E, C, D]
+    # all-to-all #2: expert queues -> tokens
+    out = jnp.einsum(
+        "tec,ecd->td", combine.astype(x.dtype), expert_out
+    )
+    return out.reshape(b, s, d), aux.astype(jnp.float32)
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, num_experts: int,
+                    dtype=jnp.float32) -> dict:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    return {
+        "router": {
+            "kernel": jax.random.normal(k1, (d_model, num_experts),
+                                        dtype) * scale_in,
+        },
+        "experts": {
+            "up": {"kernel": jax.random.normal(
+                k2, (num_experts, d_model, d_ff), dtype) * scale_in},
+            "down": {"kernel": jax.random.normal(
+                k3, (num_experts, d_ff, d_model), dtype) * scale_out},
+        },
+    }

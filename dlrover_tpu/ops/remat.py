@@ -1,0 +1,37 @@
+"""Rematerialization policies.
+
+Role parity: ``atorch/auto/opt_lib/checkpoint_optimization.py`` (activation
+checkpointing by module class) — on TPU this is ``jax.checkpoint`` with a
+policy choosing what stays in HBM. The catalog maps the reference's
+module-granular choices onto XLA-granular ones.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+def apply_remat(fn: Callable, policy: str = "dots_saveable",
+                prevent_cse: bool = True) -> Callable:
+    """Wrap a block function with a remat policy.
+
+    ``policy`` is "none" (no remat), "full" (save nothing), or any
+    ``jax.checkpoint_policies`` attribute name — "dots_saveable" (keep MXU
+    outputs, recompute elementwise — the usual TPU sweet spot),
+    "nothing_saveable", "dots_with_no_batch_dims_saveable", ...
+    """
+    if not policy or policy == "none":
+        return fn
+    if policy == "full":
+        return jax.checkpoint(fn, prevent_cse=prevent_cse)
+    policy_fn = getattr(jax.checkpoint_policies, policy, None)
+    if not callable(policy_fn):
+        available = sorted(
+            n for n in dir(jax.checkpoint_policies) if not n.startswith("_")
+        )
+        raise ValueError(
+            f"unknown remat policy {policy!r}; have 'none', 'full' or one "
+            f"of {available}"
+        )
+    return jax.checkpoint(fn, policy=policy_fn, prevent_cse=prevent_cse)
