@@ -1,0 +1,147 @@
+"""GPT-2 decoder (the nanoGPT-parity model, BASELINE config #2).
+
+Functional init/apply in the same style as ``models.llama``: scan over
+stacked layers, learned positional embeddings, pre-LN blocks, GELU MLP,
+weight-tied LM head (nanoGPT convention).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.models.losses import masked_lm_loss
+from dlrover_tpu.ops.attention_ref import mha_reference
+from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.remat import apply_remat
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # nanoGPT pads 50257 up for tiling
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ln_eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat_policy: str = "dots_saveable"
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def gpt2_124m(**overrides) -> GPT2Config:
+    return replace(GPT2Config(), **overrides)
+
+
+def gpt2_tiny(**overrides) -> GPT2Config:
+    return replace(
+        GPT2Config(vocab_size=256, hidden_size=64, num_layers=2,
+                   num_heads=4, max_seq_len=128,
+                   compute_dtype=jnp.float32, use_flash=False),
+        **overrides,
+    )
+
+
+def init(rng: jax.Array, config: GPT2Config) -> Dict:
+    c = config
+    dt = c.param_dtype
+    keys = iter(jax.random.split(rng, 12))
+    l, d = c.num_layers, c.hidden_size
+    std = 0.02
+
+    def normal(key, shape, scale=std):
+        return jax.random.normal(key, shape, dt) * scale
+
+    return {
+        "embed_tokens": {"embedding": normal(next(keys), (c.vocab_size, d))},
+        "embed_pos": {"embedding": normal(next(keys), (c.max_seq_len, d))},
+        "layers": {
+            "ln_1": {"scale": jnp.ones((l, d), dt),
+                     "bias": jnp.zeros((l, d), dt)},
+            "q_proj": {"kernel": normal(next(keys), (l, d, d))},
+            "k_proj": {"kernel": normal(next(keys), (l, d, d))},
+            "v_proj": {"kernel": normal(next(keys), (l, d, d))},
+            # gpt2 residual-scaled init
+            "o_proj": {"kernel": normal(next(keys), (l, d, d),
+                                        std / math.sqrt(2 * l))},
+            "ln_2": {"scale": jnp.ones((l, d), dt),
+                     "bias": jnp.zeros((l, d), dt)},
+            "up_proj": {"kernel": normal(next(keys), (l, d, 4 * d)),
+                        "bias": jnp.zeros((l, 4 * d), dt)},
+            "down_proj": {"kernel": normal(next(keys), (l, 4 * d, d),
+                                           std / math.sqrt(2 * l)),
+                          "bias": jnp.zeros((l, d), dt)},
+        },
+        "ln_f": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    return ((xf - mean) * lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+def apply(params: Dict, input_ids: jax.Array, config: GPT2Config,
+          rng: Optional[jax.Array] = None) -> jax.Array:
+    """Returns logits [B, S, V] (f32); LM head tied to token embedding."""
+    c = config
+    b, s = input_ids.shape
+    x = params["embed_tokens"]["embedding"][input_ids]
+    x = x + params["embed_pos"]["embedding"][:s][None]
+    x = x.astype(c.compute_dtype)
+
+    def _block(x, layer):
+        h = _layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"],
+                        c.ln_eps)
+        q = (h @ layer["q_proj"]["kernel"]).reshape(b, s, c.num_heads,
+                                                    c.head_dim)
+        k = (h @ layer["k_proj"]["kernel"]).reshape(b, s, c.num_heads,
+                                                    c.head_dim)
+        v = (h @ layer["v_proj"]["kernel"]).reshape(b, s, c.num_heads,
+                                                    c.head_dim)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if c.use_flash:
+            attn = flash_attention(q, k, v, True)
+        else:
+            attn = mha_reference(q, k, v, causal=True)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
+        x = x + attn @ layer["o_proj"]["kernel"]
+        h = _layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"],
+                        c.ln_eps)
+        h = jax.nn.gelu(h @ layer["up_proj"]["kernel"]
+                        + layer["up_proj"]["bias"])
+        x = x + h @ layer["down_proj"]["kernel"] + layer["down_proj"]["bias"]
+        return x, None
+
+    block = apply_remat(_block, c.remat_policy)
+    x, _ = lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                    c.ln_eps)
+    logits = x @ params["embed_tokens"]["embedding"].astype(
+        c.compute_dtype).T
+    return logits.astype(jnp.float32)
+
+
+def make_init_fn(config: GPT2Config):
+    return partial(init, config=config)
+
+
+def make_loss_fn(config: GPT2Config):
+    def loss_fn(params, batch, rng):
+        logits = apply(params, batch["input_ids"], config, rng)
+        return masked_lm_loss(logits, batch["labels"]), {}
+
+    return loss_fn

@@ -1,0 +1,26 @@
+"""Shared loss functions for the model family."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100  # HF convention: masked label positions
+
+
+def masked_lm_loss(logits: jax.Array, labels: jax.Array,
+                   z_loss_weight: float = 0.0) -> jax.Array:
+    """Causal-LM cross entropy with ``IGNORE_INDEX`` masking and optional
+    z-loss regularization on the logsumexp."""
+    mask = (labels != IGNORE_INDEX).astype(jnp.float32)
+    labels_safe = jnp.where(labels == IGNORE_INDEX, 0, labels)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logprobs, labels_safe[..., None], axis=-1
+    )[..., 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    if z_loss_weight > 0.0:
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        loss = loss + z_loss_weight * ((z ** 2) * mask).sum() / denom
+    return loss

@@ -1,0 +1,61 @@
+"""MNIST CNN (BASELINE config #1: the elastic-agent smoke-test model,
+parity with ``/root/reference/examples/pytorch/mnist``)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init(rng: jax.Array, num_classes: int = 10) -> Dict:
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    return {
+        "conv1": {"kernel": jax.random.normal(k1, (3, 3, 1, 16)) * 0.1},
+        "conv2": {"kernel": jax.random.normal(k2, (3, 3, 16, 32)) * 0.1},
+        "dense1": {"kernel": jax.random.normal(k3, (7 * 7 * 32, 128)) * 0.02,
+                   "bias": jnp.zeros((128,))},
+        "dense2": {"kernel": jax.random.normal(k4, (128, num_classes)) * 0.02,
+                   "bias": jnp.zeros((num_classes,))},
+    }
+
+
+def _conv(x, kernel, stride=1):
+    return lax.conv_general_dilated(
+        x, kernel, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def apply(params: Dict, images: jax.Array) -> jax.Array:
+    """images: [B, 28, 28, 1] -> logits [B, 10]."""
+    x = jax.nn.relu(_conv(images, params["conv1"]["kernel"]))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                          "VALID")
+    x = jax.nn.relu(_conv(x, params["conv2"]["kernel"]))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                          "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense1"]["kernel"] + params["dense1"]["bias"])
+    return x @ params["dense2"]["kernel"] + params["dense2"]["bias"]
+
+
+def make_init_fn():
+    return partial(init)
+
+
+def make_loss_fn():
+    def loss_fn(params, batch, rng):
+        logits = apply(params, batch["image"])
+        import optax
+
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = (logits.argmax(-1) == batch["label"]).mean()
+        return loss, {"accuracy": acc}
+
+    return loss_fn

@@ -71,10 +71,14 @@ def _normalize_spec(spec: SpecLike, shape: Sequence[int],
             f"string spec {spec!r} is ambiguous: use FSDP_AUTO, REPLICATED "
             "or a tuple like (None, 'fsdp')"
         )
-    # tuple spec: trim/validate against rank and axis divisibility
+    # tuple spec: rank must match exactly (rank-mismatched rules never
+    # bind — see spec_for — so this is an internal invariant)
     spec = tuple(spec)
-    if len(spec) > len(shape):
-        spec = spec[: len(shape)]
+    if len(spec) != len(shape):
+        raise ValueError(
+            f"spec {spec} has rank {len(spec)} but tensor has rank "
+            f"{len(shape)}"
+        )
     out = []
     for dim, names in zip(shape, spec):
         if names is None:
@@ -88,7 +92,6 @@ def _normalize_spec(spec: SpecLike, shape: Sequence[int],
             out.append(None)  # axis collapsed or indivisible: replicate
         else:
             out.append(names if isinstance(names, str) else names_t)
-    out += [None] * (len(shape) - len(out))
     return tuple(out)
 
 
@@ -101,8 +104,14 @@ class ShardingRules:
     def spec_for(self, path: str, shape: Sequence[int],
                  mesh_axis_sizes: Dict[str, int]) -> Tuple:
         for pattern, spec in self.rules:
-            if re.search(pattern, path):
-                return _normalize_spec(spec, shape, mesh_axis_sizes)
+            if not re.search(pattern, path):
+                continue
+            # a tuple spec only binds at its exact rank; rank-mismatched
+            # rules fall through (lets stacked [L, ...] and unstacked
+            # variants of the same param coexist in one rule list)
+            if isinstance(spec, (tuple, list)) and len(spec) != len(shape):
+                continue
+            return _normalize_spec(spec, shape, mesh_axis_sizes)
         return _normalize_spec(self.default, shape, mesh_axis_sizes)
 
     def tree_shardings(self, mesh, tree_shapes):
@@ -146,10 +155,22 @@ def llama_rules() -> ShardingRules:
       VocabParallelEmbedding (layers.py:540)-> embedding vocab dim sharded
     """
     return ShardingRules(rules=[
-        # attention: q/k/v are column-parallel, o is row-parallel
+        # scan-stacked layer params carry a leading layer dim (fsdp-sharded
+        # where divisible gives ZeRO-3-style param scatter for free)
+        (r"layers/.*(q_proj|k_proj|v_proj)/kernel$",
+         ("fsdp", None, "tensor")),
+        (r"layers/.*o_proj/kernel$", ("fsdp", "tensor", None)),
+        (r"layers/.*(gate_proj|up_proj)/kernel$", ("fsdp", None, "tensor")),
+        (r"layers/.*down_proj/kernel$", ("fsdp", "tensor", None)),
+        # MoE blocks: experts over the (data x fsdp) submesh
+        (r"layers/.*experts/up/kernel$",
+         (None, ("data", "fsdp"), None, "tensor")),
+        (r"layers/.*experts/down/kernel$",
+         (None, ("data", "fsdp"), "tensor", None)),
+        (r"layers/.*router/kernel$", REPLICATED),
+        # unstacked variants (per-layer module trees)
         (r"(q_proj|k_proj|v_proj)/kernel$", (None, "tensor")),
         (r"o_proj/kernel$", ("tensor", None)),
-        # mlp: up/gate column-parallel, down row-parallel
         (r"(gate_proj|up_proj)/kernel$", (None, "tensor")),
         (r"down_proj/kernel$", ("tensor", None)),
         # embeddings / head: vocab-parallel
