@@ -1,0 +1,171 @@
+"""Headline benchmark: Llama-family pretraining MFU on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is measured MFU / 0.45 (the BASELINE.json Llama-2-7B MFU
+target for v5p-32, applied per-chip here since the harness exposes one
+chip; multi-chip scaling is validated separately via __graft_entry__.
+dryrun_multichip).
+
+Env knobs:
+  BENCH_PLATFORM=cpu     run the benchmark logic on CPU (smoke test)
+  BENCH_STEPS=N          timed steps (default 10)
+  BENCH_PRESET=tiny|1b   model size (default: fit to the chip)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+MFU_TARGET = 0.45
+
+# peak bf16 FLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v5p": 459e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v6 lite": 918e12,  # v6e/trillium
+    "TPU v6e": 918e12,
+    "cpu": 5e11,  # nominal, for smoke runs only
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for name, flops in PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return flops
+    return PEAK_FLOPS.get("cpu", 5e11)
+
+
+def _pick_config(platform: str, preset: str):
+    from dlrover_tpu.models import llama
+    import jax.numpy as jnp
+
+    if preset == "tiny" or platform == "cpu":
+        cfg = llama.llama_tiny(
+            num_layers=2, max_seq_len=128,
+            use_flash=False,
+        )
+        return cfg, 4, 128
+    # ~1.3B-param llama sized for a single 16GB chip with bf16 params
+    cfg = llama.llama2_7b(
+        hidden_size=2048,
+        intermediate_size=5504,
+        num_layers=16,
+        num_heads=16,
+        num_kv_heads=16,
+        max_seq_len=2048,
+        param_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16,
+        remat_policy="dots_saveable",
+        use_flash=True,
+    )
+    return cfg, 4, 2048
+
+
+def main() -> int:
+    platform_override = os.environ.get("BENCH_PLATFORM", "")
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    preset = os.environ.get("BENCH_PRESET", "")
+
+    import jax
+
+    if platform_override:
+        jax.config.update("jax_platforms", platform_override)
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print(json.dumps({
+            "metric": "llama_pretrain_mfu", "value": 0.0, "unit": "mfu",
+            "vs_baseline": 0.0, "error": f"no devices: {e}"[:200],
+        }))
+        return 1
+
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.accelerate import accelerate
+    from dlrover_tpu.parallel.mesh import MeshPlan
+    from dlrover_tpu.parallel.strategy import Strategy
+
+    platform = devices[0].platform
+    config, batch_size, seq_len = _pick_config(
+        platform_override or platform, preset
+    )
+
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, config.vocab_size, size=(batch_size, seq_len + 1))
+    batch = {
+        "input_ids": jnp.asarray(ids[:, :-1]),
+        "labels": jnp.asarray(ids[:, 1:]),
+    }
+
+    n_dev = len(devices)
+    result = accelerate(
+        llama.make_init_fn(config),
+        llama.make_loss_fn(config),
+        optax.adafactor(1e-3),
+        batch,
+        strategy=Strategy(
+            mesh=MeshPlan(data=1, fsdp=n_dev),
+            rule_set="llama",
+            remat_policy=config.remat_policy,
+        ),
+        devices=devices,
+    )
+    state = result.init_fn(jax.random.PRNGKey(0))
+    sharded = result.shard_batch(batch)
+
+    t0 = time.time()
+    state, metrics = result.train_step(state, sharded, jax.random.PRNGKey(0))
+    jax.block_until_ready(state)
+    compile_and_first_step = time.time() - t0
+
+    t0 = time.time()
+    for i in range(steps):
+        state, metrics = result.train_step(
+            state, sharded, jax.random.PRNGKey(i + 1)
+        )
+    jax.block_until_ready(state)
+    step_time = (time.time() - t0) / steps
+
+    tokens_per_step = batch_size * seq_len
+    # 6N forward+backward FLOPs per token + causal attention term
+    n_params = llama.param_count(config)
+    attn_flops_tok = (
+        12 * config.num_layers * config.hidden_size * seq_len * 0.5
+    )
+    flops_per_step = (6.0 * n_params + attn_flops_tok) * tokens_per_step
+    achieved = flops_per_step / step_time
+    peak = _peak_flops(devices[0]) * n_dev
+    mfu = achieved / peak
+
+    result_line = {
+        "metric": "llama_pretrain_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu",
+        "vs_baseline": round(mfu / MFU_TARGET, 4),
+        "detail": {
+            "device_kind": devices[0].device_kind,
+            "n_devices": n_dev,
+            "params": n_params,
+            "tokens_per_s": round(tokens_per_step / step_time, 1),
+            "step_time_s": round(step_time, 4),
+            "compile_plus_first_step_s": round(compile_and_first_step, 1),
+            "final_loss": float(jax.device_get(metrics["loss"])),
+        },
+    }
+    print(json.dumps(result_line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

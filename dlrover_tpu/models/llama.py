@@ -34,7 +34,10 @@ from dlrover_tpu.ops import moe as moe_ops
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention
 from dlrover_tpu.ops.remat import apply_remat
-from dlrover_tpu.ops.ring_attention import ring_attention_local
+from dlrover_tpu.ops.ring_attention import (
+    ring_attention,
+    ring_attention_local,
+)
 
 
 @dataclass(frozen=True)
@@ -52,7 +55,11 @@ class LlamaConfig:
     compute_dtype: Any = jnp.bfloat16
     remat_policy: str = "dots_saveable"
     use_flash: bool = True  # pallas kernel on TPU; reference otherwise
-    seq_axis: Optional[str] = None  # e.g. "seq" => ring attention
+    # sequence parallelism: set seq_axis="seq" and pass the Mesh to run
+    # ring attention (shard_map) inside the jitted GSPMD program; with
+    # mesh=None the model must itself be running under shard_map.
+    seq_axis: Optional[str] = None
+    mesh: Any = None
     # MoE (0 = dense)
     num_experts: int = 0
     moe_top_k: int = 1
@@ -180,7 +187,12 @@ def _attention_block(x, layer, config: LlamaConfig, positions):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
-    if c.seq_axis:
+    if c.seq_axis and c.mesh is not None:
+        out = ring_attention(
+            q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
+            batch_axes=("data", "fsdp"), head_axis="tensor",
+        )
+    elif c.seq_axis:
         out = ring_attention_local(q, k, v, axis_name=c.seq_axis,
                                    causal=True)
     elif c.use_flash:
