@@ -31,6 +31,7 @@ class Context:
         self.hang_cpu_usage_percentage = 0.05
         self.hang_detection_secs = 1800
         self.heartbeat_timeout_secs = 300
+        self.seconds_to_wait_pending_pod = 900
         # rendezvous
         self.rdzv_timeout_secs = 600
         self.rdzv_round_wait_secs = 3

@@ -109,7 +109,9 @@ class Node:
 
         self.exit_reason: str = ""
         self.is_released = False
-        self.create_time: Optional[float] = None
+        # When the master materialized this node object; the pending-timeout
+        # early-stop check measures from here.
+        self.create_time: Optional[float] = time.time()
         self.start_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.heartbeat_time: float = 0.0

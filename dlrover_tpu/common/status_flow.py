@@ -22,6 +22,7 @@ class NodeStateFlow:
 ALLOWED_TRANSITIONS = [
     NodeStateFlow(NodeStatus.INITIAL, NodeStatus.PENDING, False),
     NodeStateFlow(NodeStatus.INITIAL, NodeStatus.RUNNING, False),
+    NodeStateFlow(NodeStatus.INITIAL, NodeStatus.SUCCEEDED, False),
     NodeStateFlow(NodeStatus.INITIAL, NodeStatus.FAILED, True),
     NodeStateFlow(NodeStatus.INITIAL, NodeStatus.DELETED, True),
     NodeStateFlow(NodeStatus.PENDING, NodeStatus.RUNNING, False),

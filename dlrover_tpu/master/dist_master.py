@@ -1,0 +1,216 @@
+"""The distributed job master: node lifecycle + services + main loop.
+
+Role parity: ``dlrover/python/master/dist_master.py``
+(``DistributedJobMaster``) — composes the JobManager (node lifecycle over a
+scaler/watcher pair), TaskManager (data shards), both rendezvous managers,
+SpeedMonitor, JobMetricCollector, ElasticPsService, SyncService and the RPC
+servicer; then runs a 30 s control loop checking completion / early stop /
+hang, and starts auto-scaling once speed samples exist.
+
+TPU-first: the same master drives local subprocesses (standalone, tests)
+or k8s pods (production) purely through the Scaler/NodeWatcher seam.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import (
+    DistributionStrategy,
+    JobExitReason,
+    PlatformType,
+    RendezvousName,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+from dlrover_tpu.master.elastic_training.kv_store import KVStoreService
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node.event_callback import (
+    AllReduceNodeHandlingCallback,
+    TaskRescheduleCallback,
+)
+from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+from dlrover_tpu.master.node.job_manager import DistributedJobManager
+from dlrover_tpu.master.resource.job_optimizer import JobResourceOptimizer
+from dlrover_tpu.master.scaler.base_scaler import Scaler
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+from dlrover_tpu.master.watcher.base_watcher import NodeWatcher
+from dlrover_tpu.rpc.server import build_server
+from dlrover_tpu.scheduler.job import JobArgs, local_job_args
+from dlrover_tpu.scheduler.local import LocalProcessBackend
+
+logger = get_logger("master.dist")
+
+
+class DistributedJobMaster:
+    def __init__(
+        self,
+        port: int = 0,
+        job_name: str = "job",
+        platform: str = PlatformType.LOCAL,
+        node_num: int = 1,
+        job_args: Optional[JobArgs] = None,
+        scaler: Optional[Scaler] = None,
+        watcher: Optional[NodeWatcher] = None,
+    ):
+        self.job_args = job_args or local_job_args(
+            job_name=job_name, node_num=node_num
+        )
+        self.job_name = self.job_args.job_name
+
+        # Services shared with the local master.
+        self.speed_monitor = SpeedMonitor()
+        self.task_manager = TaskManager(self.speed_monitor)
+        self.rdzv_managers: Dict[str, RendezvousManager] = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.elastic_ps_service = ElasticPsService()
+        self.metric_collector = JobMetricCollector(self.job_name)
+
+        # Node lifecycle plumbing.
+        scaler, watcher = self._build_backend(platform, scaler, watcher)
+        self.job_optimizer = JobResourceOptimizer(self.job_args)
+        callbacks = [
+            TaskRescheduleCallback(self.task_manager),
+            AllReduceNodeHandlingCallback(self),
+        ]
+        self.job_manager = DistributedJobManager(
+            job_args=self.job_args,
+            scaler=scaler,
+            watcher=watcher,
+            job_optimizer=self.job_optimizer,
+            node_event_callbacks=callbacks,
+        )
+        self.job_auto_scaler = JobAutoScaler(
+            self.job_manager, self.job_optimizer, self.speed_monitor
+        )
+
+        self.servicer = MasterServicer(
+            task_manager=self.task_manager,
+            rdzv_managers=self.rdzv_managers,
+            speed_monitor=self.speed_monitor,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            elastic_ps_service=self.elastic_ps_service,
+            job_manager=self.job_manager,
+            metric_collector=self.metric_collector,
+        )
+        self._server, self.port = build_server(self.servicer, port=port)
+        self.addr = f"127.0.0.1:{self.port}"
+        self._stopped = threading.Event()
+        self._exit_reason = ""
+        self._ctx = get_context()
+
+    def _build_backend(self, platform, scaler, watcher):
+        if scaler is not None and watcher is not None:
+            return scaler, watcher
+        if platform == PlatformType.LOCAL:
+            from dlrover_tpu.master.scaler.process_scaler import LocalProcessScaler
+            from dlrover_tpu.master.watcher.process_watcher import (
+                LocalProcessWatcher,
+            )
+
+            backend = LocalProcessBackend()
+            # Address isn't known before build_server; patched in prepare().
+            self._local_backend = backend
+            return (
+                scaler or LocalProcessScaler(self.job_name, backend, ""),
+                watcher or LocalProcessWatcher(backend),
+            )
+        if platform == PlatformType.KUBERNETES:
+            from dlrover_tpu.master.scaler.pod_scaler import PodScaler
+            from dlrover_tpu.master.watcher.k8s_watcher import PodWatcher
+            from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+            client = K8sClient.singleton_instance(self.job_args.namespace)
+            return (
+                scaler or PodScaler(self.job_name, client, ""),
+                watcher or PodWatcher(self.job_name, client),
+            )
+        raise ValueError(f"unsupported platform: {platform}")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def prepare(self):
+        scaler = self.job_manager._scaler
+        if hasattr(scaler, "_master_addr") and not scaler._master_addr:
+            scaler._master_addr = self.addr
+        self._server.start()
+        self.task_manager.start()
+        self.task_manager.set_task_timeout_callback(self.job_manager.remove_worker)
+        self.job_manager.start()
+        logger.info("distributed master serving at %s", self.addr)
+
+    def request_stop(self, success: bool, reason: str = ""):
+        self.servicer.job_success = success
+        self.servicer.job_exit_requested = True
+        self._exit_reason = reason
+
+    def run(self) -> int:
+        """Main control loop (reference: dist_master.py:165-214)."""
+        try:
+            while not self._stopped.is_set():
+                if self.servicer.job_exit_requested:
+                    ok = bool(self.servicer.job_success)
+                    logger.info(
+                        "job exiting: success=%s reason=%s", ok, self._exit_reason
+                    )
+                    return 0 if ok else 1
+
+                if self.job_manager.all_workers_exited():
+                    ok = self.job_manager.all_workers_succeeded()
+                    self.request_stop(
+                        success=ok,
+                        reason=JobExitReason.SUCCEEDED if ok
+                        else JobExitReason.NODE_ERROR,
+                    )
+                    continue
+
+                if self.job_manager.should_early_stop():
+                    self.request_stop(
+                        success=False, reason=JobExitReason.RDZV_TIMEOUT_ERROR
+                    )
+                    continue
+
+                hung = self.job_manager.detect_hung_nodes()
+                if hung and self.task_manager.finished():
+                    self.request_stop(
+                        success=True, reason=JobExitReason.SUCCEEDED
+                    )
+                    continue
+
+                if (
+                    self.speed_monitor.sample_count
+                    >= 3
+                    and not self.job_auto_scaler.started
+                ):
+                    self.job_auto_scaler.start_auto_scaling()
+
+                self.metric_collector.collect_runtime_stats(
+                    self.speed_monitor, self.job_manager.get_job_nodes()
+                )
+                self._stopped.wait(self._ctx.seconds_interval_to_report)
+            return 0
+        finally:
+            self.stop()
+
+    def stop(self):
+        self._stopped.set()
+        self.job_auto_scaler.stop()
+        self.job_manager.stop()
+        self.task_manager.stop()
+        self._server.stop(grace=1)

@@ -1,0 +1,114 @@
+"""Hooks fired by the job manager on node lifecycle edges.
+
+Role parity: ``dlrover/python/master/node/event_callback.py``
+(``NodeEventCallback``, ``TaskRescheduleCallback``,
+``AllReduceNodeHandlingCallback``) — decouples node lifecycle from the
+subsystems that care about it (data sharding recovery, rendezvous liveness,
+speed monitoring, job completion).
+"""
+
+from __future__ import annotations
+
+from abc import ABC
+from typing import Optional
+
+from dlrover_tpu.common.constants import (
+    JobExitReason,
+    NodeExitReason,
+    NodeType,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node
+
+logger = get_logger("node.callback")
+
+
+class NodeEventCallback(ABC):
+    def on_node_started(self, node: Node, cluster_context):
+        ...
+
+    def on_node_succeeded(self, node: Node, cluster_context):
+        ...
+
+    def on_node_failed(self, node: Node, cluster_context):
+        ...
+
+    def on_node_deleted(self, node: Node, cluster_context):
+        ...
+
+
+class ClusterContext:
+    """What callbacks get to see of the master (reference: ClusterContext)."""
+
+    def __init__(self, job_manager):
+        self.job_manager = job_manager
+
+
+class TaskRescheduleCallback(NodeEventCallback):
+    """Re-queue the data shards a dead worker was holding."""
+
+    def __init__(self, task_manager):
+        self._task_manager = task_manager
+
+    def on_node_failed(self, node: Node, cluster_context):
+        if node.rank_index is not None:
+            self._task_manager.recover_tasks(node.rank_index)
+
+    def on_node_deleted(self, node: Node, cluster_context):
+        if node.rank_index is not None:
+            self._task_manager.recover_tasks(node.rank_index)
+
+
+class AllReduceNodeHandlingCallback(NodeEventCallback):
+    """SPMD-job bookkeeping: rendezvous liveness, speed monitor, job exit.
+
+    Role parity: ``event_callback.py:209`` — on start, the node becomes a
+    rendezvous candidate; on exit it is removed from the waiting/alive pools
+    so the next round forms without it; total failure (no relaunch budget)
+    ends the job.
+    """
+
+    def __init__(self, master):
+        self._master = master
+
+    @property
+    def _speed_monitor(self):
+        return getattr(self._master, "speed_monitor", None)
+
+    def on_node_started(self, node: Node, cluster_context):
+        if node.type == NodeType.WORKER:
+            for manager in self._master.rdzv_managers.values():
+                manager.add_alive_node(node.rank_index)
+
+    def on_node_succeeded(self, node: Node, cluster_context):
+        self._remove_from_rdzv(node)
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_running_worker(node.rank_index)
+        job_manager = cluster_context.job_manager
+        if job_manager.all_critical_node_success():
+            self._master.request_stop(
+                success=True, reason=JobExitReason.SUCCEEDED
+            )
+
+    def on_node_failed(self, node: Node, cluster_context):
+        self._remove_from_rdzv(node)
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_running_worker(node.rank_index)
+        if node.is_unrecoverable_failure():
+            reason = (
+                JobExitReason.NODE_OOM_ERROR
+                if node.exit_reason == NodeExitReason.OOM
+                else JobExitReason.NODE_ERROR
+            )
+            if node.critical:
+                self._master.request_stop(success=False, reason=reason)
+
+    def on_node_deleted(self, node: Node, cluster_context):
+        self._remove_from_rdzv(node)
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_running_worker(node.rank_index)
+
+    def _remove_from_rdzv(self, node: Node):
+        if node.type == NodeType.WORKER:
+            for manager in self._master.rdzv_managers.values():
+                manager.remove_alive_node(node.rank_index)

@@ -1,0 +1,124 @@
+"""Parameter-server node manager with consistent cluster versioning.
+
+Role parity: ``dlrover/python/master/node/ps.py``
+(``ParameterServerManager``) — PS jobs need a *consistent* PS address list
+across scale/migration: workers keep training against the current PS
+cluster until every new PS is running, then the master announces the next
+cluster (``get_next_training_ps_cluster``) and drops the old PSs only after
+all workers have switched (``delete_running_ps`` after sync).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.node.training_node import TrainingNodeManager
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+
+logger = get_logger("node.ps")
+
+
+class ParameterServerManager(TrainingNodeManager):
+    def __init__(self, nodes: Dict[int, Node], new_node_name_fn=None):
+        super().__init__(nodes, new_node_name_fn)
+        self._training_ps_cluster: List[Node] = []
+        self._next_training_ps_cluster: Optional[List[Node]] = None
+        self._migrated_ps_nodes: Dict[int, Node] = {}
+        self._init_training_ps_cluster()
+
+    def _init_training_ps_cluster(self):
+        self._training_ps_cluster = [
+            n for n in self.cur_nodes if not n.is_released
+        ]
+
+    # -- scale ---------------------------------------------------------------
+
+    def adjust_ps(self, group: NodeGroupResource) -> ScalePlan:
+        plan = self.adjust_node(group, NodeType.PS)
+        if not plan.empty():
+            self._next_training_ps_cluster = None  # recompute on next query
+        return plan
+
+    def scale_down_ps(self, down_num: int) -> ScalePlan:
+        """Mark the highest-rank PSs for removal *after* workers migrate."""
+        plan = ScalePlan()
+        alive = [n for n in self.cur_nodes if not n.is_released and not n.exited()]
+        for node in sorted(alive, key=lambda n: -n.rank_index)[:down_num]:
+            node.relaunchable = False
+            # NOT released yet: stays in the current training cluster until
+            # workers pick up the next cluster version.
+            node.migrated = True
+        self._next_training_ps_cluster = None
+        return plan
+
+    def migrate_parameter_servers(
+        self, ps_resources: Dict[str, NodeResource]
+    ) -> ScalePlan:
+        """Launch replacement PSs with new resources; old ones stay serving."""
+        plan = ScalePlan()
+        name_to_node = {n.name: n for n in self.cur_nodes}
+        for name, resource in ps_resources.items():
+            old = name_to_node.get(name)
+            if old is None or old.id in self._migrated_ps_nodes:
+                continue
+            sub_plan = self.migrate_node(old.id, resource)
+            # Keep the old PS serving until the new one is RUNNING.
+            old.is_released = False
+            plan.launch_nodes.extend(sub_plan.launch_nodes)
+            self._migrated_ps_nodes[old.id] = sub_plan.launch_nodes[0]
+        self._next_training_ps_cluster = None
+        return plan
+
+    # -- cluster versioning --------------------------------------------------
+
+    def get_training_ps_cluster(self) -> List[Node]:
+        """The PS set workers should currently be connected to."""
+        if not self._training_ps_cluster:
+            self._init_training_ps_cluster()
+        return [
+            n for n in self._training_ps_cluster
+            if not n.is_released and n.status != NodeStatus.FAILED
+        ]
+
+    def get_next_training_ps_cluster(self) -> List[Node]:
+        """The next consistent PS set; only advances when every incoming PS
+        is RUNNING (reference: ps.py:198)."""
+        if self._next_training_ps_cluster is not None:
+            return self._next_training_ps_cluster
+        candidates = [
+            n for n in self.cur_nodes
+            if not n.migrated and not n.is_released and not n.exited()
+        ]
+        # Migration replacements join once running.
+        for old_id, new_node in list(self._migrated_ps_nodes.items()):
+            if new_node.status == NodeStatus.RUNNING:
+                old = self.get_node(old_id)
+                if old is not None:
+                    old.is_released = True
+                del self._migrated_ps_nodes[old_id]
+        if all(n.status == NodeStatus.RUNNING for n in candidates) and candidates:
+            self._next_training_ps_cluster = sorted(
+                candidates, key=lambda n: n.rank_index
+            )
+            self._training_ps_cluster = self._next_training_ps_cluster
+            return self._next_training_ps_cluster
+        return self.get_training_ps_cluster()
+
+    def delete_running_ps(self) -> ScalePlan:
+        """Release PSs that scale-down marked, after workers switched."""
+        plan = ScalePlan()
+        for node in self.cur_nodes:
+            if node.migrated and not node.is_released and not node.relaunchable:
+                node.is_released = True
+                plan.remove_nodes.append(node)
+        return plan
+
+    def get_ps_addrs(self) -> List[str]:
+        return [
+            n.service_addr or n.name
+            for n in sorted(self.get_training_ps_cluster(),
+                            key=lambda n: n.rank_index)
+        ]

@@ -1,0 +1,166 @@
+"""Base manager for a homogeneous group of training nodes.
+
+Role parity: ``dlrover/python/master/node/training_node.py``
+(``TrainingNodeManager``) — shared relaunch/scale-up/scale-down mechanics
+per node type; subclasses add worker/PS-specific policy.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node, NodeGroupResource
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+
+logger = get_logger("node.manager")
+
+
+class TrainingNodeManager:
+    def __init__(
+        self,
+        nodes: Dict[int, Node],
+        new_node_name_fn: Optional[Callable[[str, int], str]] = None,
+    ):
+        self._nodes = nodes
+        self._lock = threading.Lock()
+        self._new_node_name_fn = new_node_name_fn or (
+            lambda node_type, node_id: f"{node_type}-{node_id}"
+        )
+        self._node_id_iter = itertools.count(
+            max(nodes.keys(), default=-1) + 1
+        )
+
+    @property
+    def cur_nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def get_node(self, node_id: int) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def update_node(self, node: Node):
+        with self._lock:
+            self._nodes[node.id] = node
+
+    def next_node_id(self) -> int:
+        return next(self._node_id_iter)
+
+    # -- relaunch ------------------------------------------------------------
+
+    def relaunch_node(self, node: Node) -> ScalePlan:
+        """Build the plan replacing a dead node (rank preserved)."""
+        plan = ScalePlan()
+        with self._lock:
+            node.relaunchable = False
+            node.is_released = True
+            new_id = self.next_node_id()
+            new_node = node.get_relaunch_node(new_id)
+            new_node.name = self._new_node_name_fn(node.type, new_id)
+            self._nodes[new_id] = new_node
+        logger.info("relaunching %s as %s (attempt %d)",
+                    node.name, new_node.name, new_node.relaunch_count)
+        plan.launch_nodes.append(new_node)
+        plan.remove_nodes.append(node)
+        return plan
+
+    # -- scale ---------------------------------------------------------------
+
+    def adjust_node(self, group: NodeGroupResource, node_type: str) -> ScalePlan:
+        """Scale this group up or down to ``group.count`` alive nodes."""
+        plan = ScalePlan()
+        plan.node_group_resources[node_type] = group
+        alive = [n for n in self.cur_nodes
+                 if not n.is_released and not n.exited()]
+        delta = group.count - len(alive)
+        if delta > 0:
+            used_ranks = {n.rank_index for n in alive}
+            next_rank = 0
+            with self._lock:
+                for _ in range(delta):
+                    while next_rank in used_ranks:
+                        next_rank += 1
+                    used_ranks.add(next_rank)
+                    new_id = self.next_node_id()
+                    node = Node(
+                        node_type=node_type,
+                        node_id=new_id,
+                        rank_index=next_rank,
+                        name=self._new_node_name_fn(node_type, new_id),
+                        config_resource=group.node_resource,
+                    )
+                    self._nodes[new_id] = node
+                    plan.launch_nodes.append(node)
+        elif delta < 0:
+            # Remove highest ranks first so the surviving world is contiguous.
+            for node in sorted(alive, key=lambda n: -n.rank_index)[: -delta]:
+                node.relaunchable = False
+                node.is_released = True
+                plan.remove_nodes.append(node)
+        return plan
+
+    def remove_node(self, node_id: int) -> ScalePlan:
+        plan = ScalePlan()
+        node = self.get_node(node_id)
+        if node is not None and not node.is_released:
+            node.relaunchable = False
+            node.is_released = True
+            plan.remove_nodes.append(node)
+        return plan
+
+    def migrate_node(self, node_id: int, resource) -> ScalePlan:
+        """Replace one node with a differently-sized one, same rank."""
+        plan = ScalePlan()
+        old = self.get_node(node_id)
+        if old is None:
+            return plan
+        with self._lock:
+            new_id = self.next_node_id()
+            new_node = Node(
+                node_type=old.type,
+                node_id=new_id,
+                rank_index=old.rank_index,
+                name=self._new_node_name_fn(old.type, new_id),
+                config_resource=resource,
+            )
+            self._nodes[new_id] = new_node
+        old.migrated = True
+        old.relaunchable = False
+        plan.launch_nodes.append(new_node)
+        plan.remove_nodes.append(old)
+        return plan
+
+    # -- queries -------------------------------------------------------------
+
+    def all_nodes_exited(self) -> bool:
+        alive = [n for n in self.cur_nodes if not n.is_released]
+        return all(n.exited() for n in alive) if alive else True
+
+    def all_nodes_succeeded(self) -> bool:
+        alive = [n for n in self.cur_nodes if not n.is_released]
+        return bool(alive) and all(
+            n.status == NodeStatus.SUCCEEDED for n in alive
+        )
+
+    def has_failed_node(self) -> bool:
+        return any(
+            n.status == NodeStatus.FAILED and not n.is_released
+            for n in self.cur_nodes
+        )
+
+    def running_nodes(self) -> List[Node]:
+        return [
+            n for n in self.cur_nodes
+            if n.status == NodeStatus.RUNNING and not n.is_released
+        ]
+
+    def pending_nodes(self) -> List[Node]:
+        return [
+            n for n in self.cur_nodes
+            if n.status in (NodeStatus.INITIAL, NodeStatus.PENDING)
+            and not n.is_released
+        ]

@@ -1,0 +1,88 @@
+"""Worker / chief / evaluator node managers.
+
+Role parity: ``dlrover/python/master/node/worker.py`` (``WorkerManager``,
+``ChiefManager``, ``EvaluatorManager``) — worker-specific policy on top of
+``TrainingNodeManager``: elastic scale up/down, dropping workers that never
+joined rendezvous, slice-aware removal.
+
+TPU-first: scale deltas are rounded to whole slices (``node_unit`` hosts)
+so the surviving world always maps onto complete TPU slices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node, NodeGroupResource
+from dlrover_tpu.master.node.training_node import TrainingNodeManager
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+
+logger = get_logger("node.worker")
+
+
+class WorkerManager(TrainingNodeManager):
+    def __init__(
+        self,
+        nodes: Dict[int, Node],
+        job_resource: Optional[NodeGroupResource] = None,
+        new_node_name_fn=None,
+        node_unit: int = 1,
+    ):
+        super().__init__(nodes, new_node_name_fn)
+        self._job_resource = job_resource or NodeGroupResource()
+        self._node_unit = max(node_unit, 1)
+
+    def adjust_worker(self, group: NodeGroupResource) -> ScalePlan:
+        """Scale workers, keeping the count a multiple of the slice size."""
+        count = max(
+            (group.count // self._node_unit) * self._node_unit,
+            self._node_unit,
+        )
+        rounded = NodeGroupResource(
+            count=count, node_resource=group.node_resource
+        )
+        logger.info("adjust workers -> %d (node_unit=%d)", count, self._node_unit)
+        return self.adjust_node(rounded, NodeType.WORKER)
+
+    def remove_not_joined_rdzv_workers(self, worker_ranks: List[int]) -> ScalePlan:
+        """Remove running workers that never made it into rendezvous."""
+        plan = ScalePlan()
+        for node in self.cur_nodes:
+            if node.rank_index in worker_ranks and not node.is_released:
+                node.relaunchable = False
+                node.is_released = True
+                plan.remove_nodes.append(node)
+        return plan
+
+    def has_exited_worker(self) -> bool:
+        return any(
+            n.exited() and not n.is_released for n in self.cur_nodes
+        )
+
+    def wait_worker_restart(self, max_restart_count: int = 3) -> bool:
+        """True if some failed worker still has relaunch budget."""
+        return any(
+            n.status == NodeStatus.FAILED
+            and n.relaunch_count < max_restart_count
+            for n in self.cur_nodes
+        )
+
+
+class ChiefManager(TrainingNodeManager):
+    """Rank-0 ('chief') nodes of a PS job."""
+
+    def is_chief_running(self) -> bool:
+        return any(
+            n.status == NodeStatus.RUNNING and not n.is_released
+            for n in self.cur_nodes
+        )
+
+
+class EvaluatorManager(TrainingNodeManager):
+    def is_evaluator_running(self) -> bool:
+        return any(
+            n.status == NodeStatus.RUNNING and not n.is_released
+            for n in self.cur_nodes
+        )

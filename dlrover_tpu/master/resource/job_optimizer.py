@@ -1,0 +1,101 @@
+"""Stage machine driving resource optimization across the job lifecycle.
+
+Role parity: ``dlrover/python/master/resource/job.py``
+(``JobResourceOptimizer`` with CREATE → WORKER_INITIAL → RUNNING stages;
+``PSJobResourceOptimizer`` / ``AllreduceJobResourceOptimizer``) — decides
+*when* to consult the optimizer backend and merges its plan into the job's
+group resources.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dlrover_tpu.common.constants import DistributionStrategy, JobStage, NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.resource.local_optimizer import (
+    PSLocalOptimizer,
+    ResourceOptimizer,
+    SpmdLocalOptimizer,
+)
+from dlrover_tpu.master.resource.plan import ResourcePlan
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+from dlrover_tpu.scheduler.job import JobArgs
+
+logger = get_logger("resource.job_optimizer")
+
+
+def new_resource_optimizer(
+    optimize_mode: str, job_args: JobArgs
+) -> ResourceOptimizer:
+    if optimize_mode == "cluster":
+        # Cluster mode delegates to the brain service when configured;
+        # constructed lazily so the master runs without it.
+        try:
+            from dlrover_tpu.brain.client import BrainResourceOptimizer
+
+            return BrainResourceOptimizer(job_args.job_name)
+        except Exception:  # noqa: BLE001
+            logger.warning("brain unavailable; falling back to local optimizer")
+    if job_args.distribution_strategy == DistributionStrategy.PS:
+        return PSLocalOptimizer(job_args.job_name, job_args.resource_limits)
+    worker_args = job_args.worker_args()
+    max_workers = 0
+    if job_args.resource_limits.chips and worker_args is not None:
+        per_host = worker_args.group_resource.node_resource.accelerator.chips
+        if per_host > 0:
+            max_workers = job_args.resource_limits.chips // per_host
+    return SpmdLocalOptimizer(
+        job_args.job_name, node_unit=job_args.node_unit, max_workers=max_workers
+    )
+
+
+class JobResourceOptimizer:
+    def __init__(self, job_args: JobArgs, optimizer: Optional[ResourceOptimizer] = None):
+        self._job_args = job_args
+        self._optimizer = optimizer or new_resource_optimizer(
+            job_args.optimize_mode, job_args
+        )
+        self._stage = JobStage.CREATE
+        self._job_uuid = ""
+
+    @property
+    def stage(self) -> str:
+        return self._stage
+
+    def update_job_uuid(self, job_uuid: str):
+        self._job_uuid = job_uuid
+        self._optimizer.update_job_uuid(job_uuid)
+
+    def init_job_resource(self, plan: ScalePlan):
+        """CREATE stage: fill in group resources the user left at zero."""
+        if self._job_args.optimize_mode == "manual":
+            self._stage = JobStage.RUNNING
+            return
+        opt = self._optimizer.generate_opt_plan(JobStage.CREATE)
+        if opt is not None:
+            for node_type, group in opt.node_group_resources.items():
+                cur = plan.node_group_resources.get(node_type)
+                if cur is None:
+                    continue
+                if cur.count == 0:
+                    cur.count = group.count
+                if cur.node_resource.cpu == 0:
+                    cur.node_resource.cpu = group.node_resource.cpu
+                if cur.node_resource.memory == 0:
+                    cur.node_resource.memory = group.node_resource.memory
+        self._stage = JobStage.WORKER_INITIAL
+
+    def get_job_resource_plan(self) -> Optional[ScalePlan]:
+        """RUNNING-stage plan for the auto-scaler."""
+        if self._job_args.optimize_mode == "manual":
+            return None
+        if self._stage == JobStage.WORKER_INITIAL:
+            self._stage = JobStage.RUNNING
+        opt = self._optimizer.generate_opt_plan(self._stage)
+        if opt is None or opt.empty():
+            return None
+        plan = opt.to_scale_plan()
+        # In-place migrations ride along as resource updates; the job
+        # manager's migrate path handles names.
+        return plan

@@ -1,0 +1,213 @@
+"""Single-job resource optimizer from locally-collected stats.
+
+Role parity: ``dlrover/python/master/resource/local_optimizer.py``
+(``PSLocalOptimizer``) — heuristics over the LocalStatsReporter's runtime
+samples: initial plans, worker count from PS-CPU headroom, hot-PS
+migration, OOM memory growth.
+
+TPU-first addition: an SPMD optimizer whose unit of scaling is a whole
+slice and whose signal is step-speed trend rather than PS utilization.
+"""
+
+from __future__ import annotations
+
+import statistics
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.resource.plan import ResourcePlan
+from dlrover_tpu.master.stats.reporter import LocalStatsReporter, StatsReporter
+from dlrover_tpu.master.stats.training_metrics import RuntimeMetric
+
+logger = get_logger("resource.local_optimizer")
+
+_WORKER_DEFAULT = NodeResource(cpu=4, memory=8192)
+_PS_DEFAULT = NodeResource(cpu=8, memory=16384)
+
+
+class ResourceOptimizer(ABC):
+    """Backend interface (local heuristics here; brain RPC in brain/)."""
+
+    @abstractmethod
+    def generate_opt_plan(self, stage: str = "") -> Optional[ResourcePlan]:
+        ...
+
+    def update_job_uuid(self, job_uuid: str):
+        ...
+
+
+class PSLocalOptimizer(ResourceOptimizer):
+    """PS-strategy heuristics (reference: PSLocalOptimizer)."""
+
+    def __init__(self, job_name: str, resource_limits=None):
+        self._stats: LocalStatsReporter = StatsReporter.new_stats_reporter(job_name)
+        self._limits = resource_limits
+        self._ctx = get_context()
+
+    # -- plans ---------------------------------------------------------------
+
+    def generate_job_create_resource(self) -> ResourcePlan:
+        plan = ResourcePlan()
+        plan.node_group_resources[NodeType.PS] = NodeGroupResource(
+            count=1, node_resource=_PS_DEFAULT
+        )
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=1, node_resource=_WORKER_DEFAULT
+        )
+        return plan
+
+    def generate_ps_initial_resource(self) -> ResourcePlan:
+        """Size the PS group from dataset/model stats once they exist."""
+        plan = ResourcePlan()
+        model = self._stats.model_metric
+        ps_count = 1
+        memory = _PS_DEFAULT.memory
+        if model is not None and model.param_count > 0:
+            # 4 bytes/param + optimizer slots ≈ 16 bytes/param, split over PSs.
+            total_mb = int(model.param_count * 16 / (1024 * 1024)) + 2048
+            ps_count = max(1, min(8, total_mb // _PS_DEFAULT.memory + 1))
+            memory = max(_PS_DEFAULT.memory, total_mb // ps_count)
+        plan.node_group_resources[NodeType.PS] = NodeGroupResource(
+            count=ps_count,
+            node_resource=NodeResource(cpu=_PS_DEFAULT.cpu, memory=memory),
+        )
+        return plan
+
+    def generate_worker_resource(self) -> ResourcePlan:
+        """Grow workers while PS CPU has headroom (reference :187-229)."""
+        plan = ResourcePlan()
+        samples = self._recent_samples(8)
+        if len(samples) < 2:
+            return plan
+        ps_util = self._max_ps_cpu_util(samples)
+        cur_workers = self._running_count(samples[-1], NodeType.WORKER)
+        if cur_workers == 0 or ps_util <= 0:
+            return plan
+        threshold = self._ctx.optimize_worker_cpu_threshold
+        if ps_util >= threshold:
+            # PS saturated: adding workers only adds contention.
+            return plan
+        # Linear model: PS load scales with worker count. Grow to the
+        # worker count that would bring the hottest PS to the threshold.
+        target = int(cur_workers * threshold / max(ps_util, 1e-6))
+        target = max(cur_workers + 1, min(target, cur_workers * 2))
+        if self._limits is not None and self._limits.cpu:
+            sample = samples[-1]
+            per_worker_cpu = self._group_cpu(sample, NodeType.WORKER) / cur_workers
+            max_workers = int(self._limits.cpu // max(per_worker_cpu, 0.1))
+            target = min(target, max_workers)
+        if target > cur_workers:
+            plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+                count=target, node_resource=NodeResource()
+            )
+        return plan
+
+    def generate_hot_ps_migration(self) -> ResourcePlan:
+        """Migrate PSs whose CPU runs at >90% of request to 2× CPU."""
+        plan = ResourcePlan()
+        samples = self._recent_samples(4)
+        if not samples:
+            return plan
+        latest = samples[-1]
+        for entry in latest.running_nodes.get(NodeType.PS, []):
+            req = max(entry.get("cpu", 0), 0.1)
+            used = entry.get("used_cpu", 0)
+            if used / req > 0.9:
+                name = f"ps-{entry['id']}"
+                plan.node_resources[name] = NodeResource(
+                    cpu=req * 2, memory=entry.get("memory", _PS_DEFAULT.memory)
+                )
+        return plan
+
+    def generate_oom_recovery_plan(
+        self, node_name: str, current: NodeResource
+    ) -> NodeResource:
+        factor = self._ctx.oom_memory_factor
+        return NodeResource(cpu=current.cpu, memory=int(current.memory * factor))
+
+    def generate_opt_plan(self, stage: str = "") -> Optional[ResourcePlan]:
+        from dlrover_tpu.common.constants import JobStage
+
+        if stage == JobStage.CREATE:
+            return self.generate_job_create_resource()
+        if stage == JobStage.WORKER_INITIAL:
+            return self.generate_ps_initial_resource()
+        plan = self.generate_worker_resource()
+        hot = self.generate_hot_ps_migration()
+        plan.node_resources.update(hot.node_resources)
+        return plan
+
+    # -- helpers -------------------------------------------------------------
+
+    def _recent_samples(self, n: int) -> List[RuntimeMetric]:
+        return self._stats.runtime_stats[-n:]
+
+    @staticmethod
+    def _running_count(sample: RuntimeMetric, node_type: str) -> int:
+        return len(sample.running_nodes.get(node_type, []))
+
+    @staticmethod
+    def _group_cpu(sample: RuntimeMetric, node_type: str) -> float:
+        return sum(
+            e.get("cpu", 0) for e in sample.running_nodes.get(node_type, [])
+        )
+
+    @staticmethod
+    def _max_ps_cpu_util(samples: List[RuntimeMetric]) -> float:
+        utils = []
+        for s in samples:
+            for e in s.running_nodes.get(NodeType.PS, []):
+                req = max(e.get("cpu", 0), 0.1)
+                utils.append(e.get("used_cpu", 0) / req)
+        return max(utils) if utils else 0.0
+
+
+class SpmdLocalOptimizer(ResourceOptimizer):
+    """Allreduce/SPMD-strategy optimizer (reference:
+    AllreduceJobResourceOptimizer, re-thought for TPU slices).
+
+    The only lever is the number of worker hosts (in whole slices); the
+    signal is whether per-step speed still improves when workers are added,
+    read from the runtime-sample history.
+    """
+
+    def __init__(self, job_name: str, node_unit: int = 1, max_workers: int = 0):
+        self._stats: LocalStatsReporter = StatsReporter.new_stats_reporter(job_name)
+        self._node_unit = max(node_unit, 1)
+        self._max_workers = max_workers
+
+    def generate_opt_plan(self, stage: str = "") -> Optional[ResourcePlan]:
+        plan = ResourcePlan()
+        samples = self._stats.runtime_stats[-12:]
+        if len(samples) < 4:
+            return plan
+        cur_workers = len(samples[-1].running_nodes.get(NodeType.WORKER, []))
+        if cur_workers == 0:
+            return plan
+        # Per-worker efficiency trend: speed / workers over the window.
+        half = len(samples) // 2
+        older = [s for s in samples[:half] if s.speed > 0]
+        newer = [s for s in samples[half:] if s.speed > 0]
+        if not older or not newer:
+            return plan
+        eff_old = statistics.mean(
+            s.speed / max(len(s.running_nodes.get(NodeType.WORKER, [])), 1)
+            for s in older
+        )
+        eff_new = statistics.mean(
+            s.speed / max(len(s.running_nodes.get(NodeType.WORKER, [])), 1)
+            for s in newer
+        )
+        # Scaling still pays off if per-worker efficiency held up (>90%).
+        if eff_new >= 0.9 * eff_old:
+            target = cur_workers + self._node_unit
+            if self._max_workers and target > self._max_workers:
+                return plan
+            plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+                count=target, node_resource=NodeResource()
+            )
+        return plan

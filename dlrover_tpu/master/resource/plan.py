@@ -1,0 +1,30 @@
+"""Resource plans produced by optimizers.
+
+Role parity: ``dlrover/python/common/resource``-style plan objects the
+reference passes between optimizer and job manager (``ResourcePlan`` with
+per-type ``NodeGroupResource`` plus per-node migrations).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+
+
+@dataclass
+class ResourcePlan:
+    # Target group sizes per node type.
+    node_group_resources: Dict[str, NodeGroupResource] = field(default_factory=dict)
+    # name -> new resource, for in-place migrations (hot PS).
+    node_resources: Dict[str, NodeResource] = field(default_factory=dict)
+
+    def empty(self) -> bool:
+        return not self.node_group_resources and not self.node_resources
+
+    def to_scale_plan(self) -> ScalePlan:
+        plan = ScalePlan()
+        plan.node_group_resources.update(self.node_group_resources)
+        return plan

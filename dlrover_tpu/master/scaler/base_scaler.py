@@ -1,0 +1,71 @@
+"""ScalePlan and the Scaler interface.
+
+Role parity: ``dlrover/python/master/scaler/base_scaler.py`` — a ScalePlan
+is the single currency between the resource optimizer / job manager (who
+decide) and the platform backend (who acts): group resource targets, plus
+concrete nodes to launch/remove/migrate.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from dlrover_tpu.common.node import Node, NodeGroupResource
+
+
+@dataclass
+class ScalePlan:
+    # Target (count, per-node resource) per node type.
+    node_group_resources: Dict[str, NodeGroupResource] = field(default_factory=dict)
+    # Concrete nodes to create (relaunches carry their rank_index forward).
+    launch_nodes: List[Node] = field(default_factory=list)
+    # Concrete nodes to delete.
+    remove_nodes: List[Node] = field(default_factory=list)
+    # PS addresses for the next PS cluster version (PS jobs only).
+    ps_addrs: List[str] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (
+            self.node_group_resources
+            or self.launch_nodes
+            or self.remove_nodes
+            or self.ps_addrs
+        )
+
+    def merge(self, other: "ScalePlan"):
+        self.node_group_resources.update(other.node_group_resources)
+        self.launch_nodes.extend(other.launch_nodes)
+        self.remove_nodes.extend(other.remove_nodes)
+        if other.ps_addrs:
+            self.ps_addrs = other.ps_addrs
+
+    def to_dict(self) -> Dict:
+        return {
+            "groups": {
+                t: {"count": g.count, "cpu": g.node_resource.cpu,
+                    "memory": g.node_resource.memory}
+                for t, g in self.node_group_resources.items()
+            },
+            "launch": [n.name for n in self.launch_nodes],
+            "remove": [n.name for n in self.remove_nodes],
+            "ps_addrs": list(self.ps_addrs),
+        }
+
+
+class Scaler(ABC):
+    """Executes ScalePlans against a platform (reference: Scaler)."""
+
+    def __init__(self, job_name: str):
+        self.job_name = job_name
+
+    @abstractmethod
+    def scale(self, plan: ScalePlan) -> None:
+        ...
+
+    def start(self):
+        """Hook for backends that run worker threads."""
+
+    def stop(self):
+        ...

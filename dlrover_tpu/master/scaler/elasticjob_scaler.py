@@ -1,0 +1,46 @@
+"""Scaler that emits ScalePlan custom resources for an external operator.
+
+Role parity: ``dlrover/python/master/scaler/elasticjob_scaler.py`` — instead
+of touching pods itself, the master records its decision as a ScalePlan CR
+and lets the cluster operator reconcile it. This is the mode where pod
+lifecycle belongs to the operator (GKE JobSet / ElasticJob controller).
+"""
+
+from __future__ import annotations
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.scheduler.kubernetes import SCALEPLAN_PLURAL, build_scale_plan_cr
+
+logger = get_logger("scaler.elasticjob")
+
+
+class ElasticJobScaler(Scaler):
+    def __init__(self, job_name: str, client):
+        super().__init__(job_name)
+        self._client = client
+
+    def scale(self, plan: ScalePlan) -> None:
+        if plan.empty():
+            return
+        groups = {
+            t: {
+                "replicas": g.count,
+                "resource": {
+                    "cpu": str(g.node_resource.cpu),
+                    "memory": f"{g.node_resource.memory}Mi",
+                    "chips": g.node_resource.accelerator.chips,
+                },
+            }
+            for t, g in plan.node_group_resources.items()
+        }
+        creates = [
+            {"name": n.name, "type": n.type, "id": n.id, "rankIndex": n.rank_index}
+            for n in plan.launch_nodes
+        ]
+        removes = [n.name for n in plan.remove_nodes]
+        cr = build_scale_plan_cr(
+            self.job_name, groups, creates, removes, plan.ps_addrs
+        )
+        self._client.create_custom_resource(SCALEPLAN_PLURAL, cr)
+        logger.info("submitted ScalePlan CR: %s", cr["metadata"]["name"])

@@ -1,0 +1,129 @@
+"""Scaler that creates/deletes pods directly through the k8s API.
+
+Role parity: ``dlrover/python/master/scaler/pod_scaler.py`` — a creation
+queue drained by a worker thread (pod creation is slow and can fail
+transiently; the control loop must never block on it), env injection for
+the master address + rank contract, and replica bookkeeping.
+
+TPU-first: each worker pod requests a whole TPU host's chips and pins to
+the slice topology via GKE node selectors (``scheduler/kubernetes.py``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.scheduler.kubernetes import build_pod_spec
+
+logger = get_logger("scaler.pod")
+
+
+class PodScaler(Scaler):
+    def __init__(
+        self,
+        job_name: str,
+        client,  # K8sClient-compatible (create_pod/delete_pod/list_pods)
+        master_addr: str,
+        image: str = "dlrover-tpu:latest",
+        command: Optional[List[str]] = None,
+        tpu_topology: str = "",
+        tpu_accelerator: str = "",
+    ):
+        super().__init__(job_name)
+        self._client = client
+        self._master_addr = master_addr
+        self._image = image
+        self._command = command or ["python", "-m", "dlrover_tpu.agent.training_agent"]
+        self._tpu_topology = tpu_topology
+        self._tpu_accelerator = tpu_accelerator
+        self._create_queue: "queue.Queue[Node]" = queue.Queue()
+        self._create_attempts: Dict[int, int] = {}
+        self._node_num = 0
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._drain_create_queue, name="pod-creator", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def pod_name(self, node: Node) -> str:
+        return f"{self.job_name}-{node.type}-{node.id}"
+
+    def scale(self, plan: ScalePlan) -> None:
+        for t, group in plan.node_group_resources.items():
+            if t and group.count > self._node_num:
+                self._node_num = group.count
+        for node in plan.remove_nodes:
+            self._client.delete_pod(self.pod_name(node))
+        for node in plan.launch_nodes:
+            self._create_queue.put(node)
+
+    def _drain_create_queue(self):
+        while not self._stopped.is_set():
+            try:
+                node = self._create_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self._create_pod(node)
+
+    def _node_env(self, node: Node) -> Dict[str, str]:
+        return {
+            NodeEnv.MASTER_ADDR: self._master_addr,
+            NodeEnv.JOB_NAME: self.job_name,
+            NodeEnv.NODE_ID: str(node.id),
+            NodeEnv.NODE_RANK: str(node.rank_index),
+            NodeEnv.NODE_NUM: str(max(self._node_num, 1)),
+            NodeEnv.NODE_TYPE: node.type,
+        }
+
+    def _create_pod(self, node: Node):
+        res = node.config_resource
+        pod = build_pod_spec(
+            job_name=self.job_name,
+            pod_name=self.pod_name(node),
+            node_type=node.type,
+            node_id=node.id,
+            rank_index=node.rank_index,
+            image=self._image,
+            command=self._command,
+            cpu=res.cpu,
+            memory_mb=res.memory,
+            tpu_chips=res.accelerator.chips,
+            tpu_topology=self._tpu_topology or res.accelerator.topology,
+            tpu_accelerator=self._tpu_accelerator,
+            env=self._node_env(node),
+        )
+        if self._client.create_pod(pod) is None:
+            attempts = self._create_attempts.get(node.id, 0) + 1
+            self._create_attempts[node.id] = attempts
+            if attempts >= 3:
+                # Spec is likely invalid (bad topology selector, quota):
+                # retrying forever only hammers the API. Surface as FAILED
+                # through the node object; the watcher never will.
+                logger.error(
+                    "pod creation for %s failed %d times; giving up",
+                    node.name, attempts,
+                )
+                from dlrover_tpu.common.constants import (
+                    NodeExitReason,
+                    NodeStatus,
+                )
+
+                node.exit_reason = NodeExitReason.FATAL_ERROR
+                node.update_status(NodeStatus.FAILED)
+                return
+            logger.error("pod creation failed for %s; requeueing", node.name)
+            time.sleep(min(2 ** attempts, 30))
+            self._create_queue.put(node)

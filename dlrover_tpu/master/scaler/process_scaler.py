@@ -1,0 +1,78 @@
+"""Scaler that launches nodes as local subprocesses.
+
+Role parity: the role ``PodScaler`` plays for k8s, realized on the local
+platform: every launched ``Node`` becomes an agent subprocess wired to the
+master address via the ``NodeEnv`` env contract. Used by ``--standalone``
+mode and by integration tests (N simulated hosts on one machine).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.scheduler.local import LocalProcessBackend
+
+logger = get_logger("scaler.process")
+
+
+def default_command_factory(node: Node) -> List[str]:
+    import sys
+
+    return [sys.executable, "-m", "dlrover_tpu.agent.training_agent"]
+
+
+class LocalProcessScaler(Scaler):
+    def __init__(
+        self,
+        job_name: str,
+        backend: LocalProcessBackend,
+        master_addr: str,
+        command_factory: Optional[Callable[[Node], List[str]]] = None,
+        extra_env: Optional[Dict[str, str]] = None,
+    ):
+        super().__init__(job_name)
+        self._backend = backend
+        self._master_addr = master_addr
+        self._command_factory = command_factory or default_command_factory
+        self._extra_env = extra_env or {}
+        # Sticky world size: relaunch plans carry no group resources, and a
+        # relaunched agent must still see the full job's NODE_NUM.
+        self._node_num = 0
+
+    def _node_env(self, node: Node, node_num: int) -> Dict[str, str]:
+        env = {
+            NodeEnv.MASTER_ADDR: self._master_addr,
+            NodeEnv.JOB_NAME: self.job_name,
+            NodeEnv.NODE_ID: str(node.id),
+            NodeEnv.NODE_RANK: str(node.rank_index),
+            NodeEnv.NODE_NUM: str(node_num),
+            NodeEnv.NODE_TYPE: node.type,
+        }
+        env.update(self._extra_env)
+        return env
+
+    def scale(self, plan: ScalePlan) -> None:
+        for node in plan.remove_nodes:
+            if self._backend.kill_process(node.name):
+                logger.info("removed node %s", node.name)
+        group_max = max(
+            (g.count for g in plan.node_group_resources.values()), default=0
+        )
+        self._node_num = max(self._node_num, group_max, len(plan.launch_nodes))
+        node_num = self._node_num
+        for node in plan.launch_nodes:
+            self._backend.start_process(
+                name=node.name,
+                node_type=node.type,
+                node_id=node.id,
+                rank_index=node.rank_index,
+                command=self._command_factory(node),
+                env=self._node_env(node, node_num),
+            )
+
+    def stop(self):
+        self._backend.stop_all()

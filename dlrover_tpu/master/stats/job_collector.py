@@ -1,0 +1,73 @@
+"""Aggregates job metrics into the stats backend.
+
+Role parity: ``dlrover/python/master/stats/job_collector.py``
+(``JobMetricCollector``) — the one place that assembles RuntimeMetric
+samples (speed + per-node usage) and forwards dataset/model facts reported
+by agents.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.master.stats.reporter import StatsReporter
+from dlrover_tpu.master.stats.training_metrics import (
+    DatasetMetric,
+    ModelMetric,
+    RuntimeMetric,
+)
+
+
+class JobMetricCollector:
+    def __init__(self, job_name: str, backend: str = "local"):
+        self._reporter = StatsReporter.new_stats_reporter(job_name, backend)
+
+    @property
+    def reporter(self):
+        return self._reporter
+
+    def collect_dataset_metric(self, name: str, size: int, storage_size: int = 0):
+        self._reporter.report_dataset_metric(
+            DatasetMetric(name=name, size=size, storage_size=storage_size)
+        )
+
+    def collect_model_metric(
+        self, param_count: int, flops_per_step: float,
+        activation_bytes: int = 0, extra: Dict[str, float] = None,
+    ):
+        self._reporter.report_model_metric(
+            ModelMetric(
+                param_count=param_count,
+                flops_per_step=flops_per_step,
+                activation_bytes=activation_bytes,
+                extra=extra or {},
+            )
+        )
+
+    def collect_runtime_stats(self, speed_monitor, job_nodes: Dict):
+        """Snapshot speed + per-node usage (called from the master loop)."""
+        metric = RuntimeMetric(
+            timestamp=time.time(),
+            global_step=speed_monitor.completed_global_step,
+            speed=speed_monitor.running_speed(),
+        )
+        for node_type, nodes in job_nodes.items():
+            entries = []
+            for node in nodes.values():
+                if node.status != NodeStatus.RUNNING or node.is_released:
+                    continue
+                entries.append(
+                    {
+                        "id": node.id,
+                        "cpu": node.config_resource.cpu,
+                        "memory": node.config_resource.memory,
+                        "used_cpu": node.used_resource.cpu,
+                        "used_memory": node.used_resource.memory,
+                    }
+                )
+            if entries:
+                metric.running_nodes[node_type] = entries
+        self._reporter.report_runtime_stats(metric)
+        return metric

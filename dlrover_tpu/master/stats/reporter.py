@@ -1,0 +1,67 @@
+"""Stats storage backends.
+
+Role parity: ``dlrover/python/master/stats/reporter.py``
+(``LocalStatsReporter`` and the Brain-backed reporter) — where the metric
+collector writes and the local optimizer reads. The local backend is
+in-memory per job; the brain backend forwards to a cluster-level service
+over RPC (``dlrover_tpu/brain``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.master.stats.training_metrics import (
+    DatasetMetric,
+    ModelMetric,
+    RuntimeMetric,
+)
+
+
+class StatsReporter:
+    """Interface; also the registry keyed by job name."""
+
+    _instances: Dict[str, "StatsReporter"] = {}
+    _lock = threading.Lock()
+
+    def report_dataset_metric(self, metric: DatasetMetric):
+        ...
+
+    def report_model_metric(self, metric: ModelMetric):
+        ...
+
+    def report_runtime_stats(self, metric: RuntimeMetric):
+        ...
+
+    @classmethod
+    def new_stats_reporter(cls, job_name: str, backend: str = "local"):
+        with cls._lock:
+            if job_name not in cls._instances:
+                cls._instances[job_name] = LocalStatsReporter()
+            return cls._instances[job_name]
+
+
+class LocalStatsReporter(StatsReporter):
+    """In-memory store the PSLocalOptimizer reads (reference :100)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dataset_metric: Optional[DatasetMetric] = None
+        self.model_metric: Optional[ModelMetric] = None
+        self.runtime_stats: List[RuntimeMetric] = []
+
+    def report_dataset_metric(self, metric: DatasetMetric):
+        with self._lock:
+            self.dataset_metric = metric
+
+    def report_model_metric(self, metric: ModelMetric):
+        with self._lock:
+            self.model_metric = metric
+
+    def report_runtime_stats(self, metric: RuntimeMetric):
+        with self._lock:
+            self.runtime_stats.append(metric)
+            # Bound memory: optimizers only look at recent windows.
+            if len(self.runtime_stats) > 500:
+                del self.runtime_stats[:-500]

@@ -1,0 +1,38 @@
+"""Typed runtime/dataset/model metric records.
+
+Role parity: ``dlrover/python/master/stats/training_metrics.py`` — the
+records the stats reporter stores and optimizers consume.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class DatasetMetric:
+    name: str = ""
+    size: int = 0  # total records
+    storage_size: int = 0  # bytes
+
+
+@dataclass
+class ModelMetric:
+    """Static model facts (reference: ModelInfo/TensorStats/OpStats)."""
+
+    param_count: int = 0
+    flops_per_step: float = 0.0
+    activation_bytes: int = 0
+    extra: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class RuntimeMetric:
+    """One sample of the job's runtime state: speed + per-node usage."""
+
+    timestamp: float = 0.0
+    global_step: int = 0
+    speed: float = 0.0  # steps/s
+    running_nodes: Dict[str, List[Dict]] = field(default_factory=dict)
+    # node dicts: {"id", "cpu", "memory", "cpu_percent"}

@@ -1,0 +1,33 @@
+"""NodeEvent and the NodeWatcher interface.
+
+Role parity: ``dlrover/python/master/watcher/base_watcher.py`` — watchers
+turn platform state changes (pod phases, subprocess exits) into a stream of
+``NodeEvent``s the job manager's monitor thread consumes.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Iterator, List
+
+from dlrover_tpu.common.node import Node
+
+
+@dataclass
+class NodeEvent:
+    event_type: str  # NodeEventType.{ADDED,MODIFIED,DELETED}
+    node: Node
+
+
+class NodeWatcher(ABC):
+    @abstractmethod
+    def watch(self) -> Iterator[NodeEvent]:
+        """Yield events until the watcher is stopped."""
+
+    @abstractmethod
+    def list(self) -> List[Node]:
+        """Snapshot of all currently-known nodes."""
+
+    def stop(self):
+        ...

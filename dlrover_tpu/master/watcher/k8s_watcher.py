@@ -1,0 +1,194 @@
+"""Watcher over k8s pods and ScalePlan CRs.
+
+Role parity: ``dlrover/python/master/watcher/k8s_watcher.py``
+(``PodWatcher`` — list/watch pods → NodeEvents, with exit-reason parsing:
+OOMKilled / Killed / fatal exit codes; ``K8sScalePlanWatcher`` — pick up
+user-submitted ScalePlan CRs for manual scaling).
+
+The watcher consumes plain pod dicts so tests feed canned API objects
+through a fake client, as the reference's tests do.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+from dlrover_tpu.master.watcher.base_watcher import NodeEvent, NodeWatcher
+
+logger = get_logger("watcher.k8s")
+
+_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.UNKNOWN,
+}
+
+# Exit codes the reference treats as unrecoverable user-code errors
+# (k8s_watcher.py:49 _get_pod_exit_reason).
+_FATAL_EXIT_CODES = {1, 2, 126, 127, 128}
+
+
+def parse_memory_mb(quantity) -> int:
+    """Parse a k8s memory quantity ('8192Mi', '2Gi', '512M', 1024) to MiB."""
+    if isinstance(quantity, (int, float)):
+        return int(quantity)
+    s = str(quantity).strip()
+    units = {"Ki": 1 / 1024, "Mi": 1, "Gi": 1024, "Ti": 1024 * 1024,
+             "K": 1 / 1024, "M": 1, "G": 1024, "T": 1024 * 1024}
+    for suffix, factor in units.items():
+        if s.endswith(suffix):
+            try:
+                return int(float(s[: -len(suffix)]) * factor)
+            except ValueError:
+                return 0
+    try:
+        return int(float(s))
+    except ValueError:
+        return 0
+
+
+def _dig(d: Dict, *keys, default=None):
+    cur: Any = d
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default
+        cur = cur[k]
+    return cur
+
+
+def get_pod_exit_reason(pod: Dict[str, Any]) -> str:
+    """Classify why a pod's main container died."""
+    statuses = _dig(pod, "status", "containerStatuses", default=[]) or []
+    for cs in statuses:
+        term = _dig(cs, "state", "terminated") or _dig(cs, "lastState", "terminated")
+        if not term:
+            continue
+        reason = term.get("reason", "")
+        code = term.get("exitCode", 0)
+        if reason == "OOMKilled":
+            return NodeExitReason.OOM
+        if reason == "Killed" or code in (-9, 137):
+            return NodeExitReason.KILLED
+        if code in _FATAL_EXIT_CODES:
+            return NodeExitReason.FATAL_ERROR
+        if code != 0:
+            return NodeExitReason.UNKNOWN_ERROR
+    return ""
+
+
+def pod_to_node(pod: Dict[str, Any]) -> Optional[Node]:
+    labels = _dig(pod, "metadata", "labels", default={}) or {}
+    node_type = labels.get("replica-type")
+    if node_type is None:
+        return None
+    rank = int(labels.get("rank-index", 0))
+    node_id = int(_dig(pod, "metadata", "annotations", "node-id", default=rank))
+    phase = _dig(pod, "status", "phase", default="Unknown")
+    node = Node(
+        node_type=node_type,
+        node_id=node_id,
+        rank_index=rank,
+        name=_dig(pod, "metadata", "name", default=f"{node_type}-{node_id}"),
+        status=_PHASE_TO_STATUS.get(phase, NodeStatus.UNKNOWN),
+    )
+    node.exit_reason = get_pod_exit_reason(pod)
+    return node
+
+
+class PodWatcher(NodeWatcher):
+    """List/watch pods of one job via a (real or fake) k8s client."""
+
+    def __init__(self, job_name: str, client, poll_secs: float = 1.0):
+        self._job_name = job_name
+        self._client = client
+        self._poll_secs = poll_secs
+        self._stopped = threading.Event()
+        self._selector = f"elasticjob-name={job_name}"
+
+    def list(self) -> List[Node]:
+        pods = self._client.list_pods(label_selector=self._selector) or []
+        nodes = [pod_to_node(p) for p in pods]
+        return [n for n in nodes if n is not None]
+
+    def watch(self) -> Iterator[NodeEvent]:
+        # Poll-based list+diff: equivalent behavior to the reference's
+        # list+watch without holding a server-side watch connection.
+        last: Dict[str, Node] = {}
+        while not self._stopped.is_set():
+            seen = set()
+            for node in self.list():
+                seen.add(node.name)
+                prev = last.get(node.name)
+                if prev is None:
+                    last[node.name] = node
+                    yield NodeEvent(NodeEventType.ADDED, node)
+                elif prev.status != node.status:
+                    last[node.name] = node
+                    yield NodeEvent(NodeEventType.MODIFIED, node)
+            for name in list(last):
+                if name not in seen:
+                    gone = last.pop(name)
+                    gone.status = NodeStatus.DELETED
+                    yield NodeEvent(NodeEventType.DELETED, gone)
+            time.sleep(self._poll_secs)
+
+    def stop(self):
+        self._stopped.set()
+
+
+class ScalePlanWatcher:
+    """Watch user-submitted ScalePlan CRs → manual ScalePlans.
+
+    Role parity: ``K8sScalePlanWatcher`` — a human (or external controller)
+    writes a ScalePlan CR; the master applies it like any optimizer plan.
+    """
+
+    def __init__(self, job_name: str, client, poll_secs: float = 2.0):
+        self._job_name = job_name
+        self._client = client
+        self._poll_secs = poll_secs
+        self._stopped = threading.Event()
+        self._seen: set = set()
+
+    def watch(self) -> Iterator[ScalePlan]:
+        while not self._stopped.is_set():
+            crs = self._client.list_scale_plans(self._job_name) or []
+            for cr in crs:
+                name = _dig(cr, "metadata", "name", default="")
+                if not name or name in self._seen:
+                    continue
+                self._seen.add(name)
+                yield self.to_scale_plan(cr)
+            time.sleep(self._poll_secs)
+
+    @staticmethod
+    def to_scale_plan(cr: Dict[str, Any]) -> ScalePlan:
+        plan = ScalePlan()
+        specs = _dig(cr, "spec", "replicaResourceSpecs", default={}) or {}
+        for node_type, spec in specs.items():
+            res = spec.get("resource", {})
+            plan.node_group_resources[node_type] = NodeGroupResource(
+                count=int(spec.get("replicas", 0)),
+                node_resource=NodeResource(
+                    cpu=float(res.get("cpu", 0) or 0),
+                    memory=parse_memory_mb(res.get("memory", 0)),
+                ),
+            )
+        plan.ps_addrs = _dig(cr, "spec", "psHosts", default=[]) or []
+        return plan
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,67 @@
+"""Watcher over the local subprocess backend.
+
+Role parity: the ``PodWatcher`` role on the local platform — polls the
+``LocalProcessBackend`` process table and emits ADDED/MODIFIED/DELETED
+``NodeEvent``s on state changes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, List
+
+from dlrover_tpu.common.constants import NodeEventType, NodeExitReason, NodeStatus
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.watcher.base_watcher import NodeEvent, NodeWatcher
+from dlrover_tpu.scheduler.local import LocalProcessBackend
+
+
+class LocalProcessWatcher(NodeWatcher):
+    def __init__(self, backend: LocalProcessBackend, poll_secs: float = 0.2):
+        self._backend = backend
+        self._poll_secs = poll_secs
+        self._stopped = threading.Event()
+
+    def _to_node(self, proc) -> Node:
+        node = Node(
+            node_type=proc.node_type,
+            node_id=proc.node_id,
+            rank_index=proc.rank_index,
+            name=proc.name,
+            status=proc.status(),
+        )
+        rc = proc.exit_code()
+        if proc.exit_reason:
+            node.exit_reason = proc.exit_reason
+        elif rc is not None and rc != 0:
+            # SIGKILL from the OS OOM-killer surfaces as -9.
+            node.exit_reason = (
+                NodeExitReason.OOM if rc == -9 else NodeExitReason.UNKNOWN_ERROR
+            )
+        return node
+
+    def list(self) -> List[Node]:
+        return [self._to_node(p) for p in self._backend.list_processes()]
+
+    def watch(self) -> Iterator[NodeEvent]:
+        last_status: Dict[str, str] = {}
+        while not self._stopped.is_set():
+            seen = set()
+            for proc in self._backend.list_processes():
+                node = self._to_node(proc)
+                seen.add(node.name)
+                prev = last_status.get(node.name)
+                if prev is None:
+                    last_status[node.name] = node.status
+                    yield NodeEvent(NodeEventType.ADDED, node)
+                elif prev != node.status:
+                    last_status[node.name] = node.status
+                    yield NodeEvent(NodeEventType.MODIFIED, node)
+            for name in list(last_status):
+                if name not in seen:
+                    del last_status[name]
+            time.sleep(self._poll_secs)
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,7 @@
+"""Platform abstraction: job description + node scheduling backends.
+
+Role parity: ``dlrover/python/scheduler/`` in the reference — a
+platform-independent ``JobArgs`` description plus per-platform clients
+(local subprocesses for development/tests, Kubernetes for production TPU
+node pools).
+"""

@@ -1,0 +1,91 @@
+"""Platform-independent job description.
+
+Role parity: ``dlrover/python/scheduler/job.py`` (``JobArgs``, ``NodeArgs``,
+``ResourceLimits``) — the master's view of what the user asked for, filled in
+from CLI args (local platform) or an ElasticJob custom resource (k8s).
+
+TPU-first: a node group carries slice topology (``node_unit`` = hosts per
+slice) so rendezvous and scaling keep worlds whole-slice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    DistributionStrategy,
+    NodeType,
+    PlatformType,
+)
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+
+
+@dataclass
+class ResourceLimits:
+    """Upper bounds the auto-scaler must respect (reference: ResourceLimits)."""
+
+    cpu: float = 0.0
+    memory: int = 0
+    chips: int = 0
+
+
+@dataclass
+class NodeArgs:
+    """Per-node-type request (reference: NodeArgs)."""
+
+    group_resource: NodeGroupResource = field(default_factory=NodeGroupResource)
+    auto_scale: bool = True
+    restart_count: int = 3
+    critical_nodes: str = ""
+
+
+@dataclass
+class JobArgs:
+    """Everything the master needs to know about one job.
+
+    ``initialize()`` on subclasses fills this from the platform source of
+    truth (CLI flags / ElasticJob CR).
+    """
+
+    platform: str = PlatformType.LOCAL
+    namespace: str = "default"
+    job_name: str = "job"
+    job_uuid: str = ""
+    user: str = ""
+    distribution_strategy: str = DistributionStrategy.SPMD
+    optimize_mode: str = "single-job"
+    node_args: Dict[str, NodeArgs] = field(default_factory=dict)
+    resource_limits: ResourceLimits = field(default_factory=ResourceLimits)
+    enable_dynamic_sharding: bool = True
+    enable_elastic_scheduling: bool = True
+    relaunch_always: bool = False
+    remove_exited_node: bool = True
+    cordon_fault_node: bool = True
+    # TPU: how many hosts form one slice — worlds must be multiples of this.
+    node_unit: int = 1
+
+    def worker_args(self) -> Optional[NodeArgs]:
+        return self.node_args.get(NodeType.WORKER)
+
+
+def local_job_args(
+    job_name: str = "local",
+    node_num: int = 1,
+    node_unit: int = 1,
+    distribution_strategy: str = DistributionStrategy.SPMD,
+) -> JobArgs:
+    """JobArgs for the local/standalone platform (reference: LocalJobArgs)."""
+    args = JobArgs(
+        platform=PlatformType.LOCAL,
+        job_name=job_name,
+        distribution_strategy=distribution_strategy,
+        node_unit=node_unit,
+    )
+    args.node_args[NodeType.WORKER] = NodeArgs(
+        group_resource=NodeGroupResource(
+            count=node_num, node_resource=NodeResource(cpu=1, memory=1024)
+        ),
+        restart_count=3,
+    )
+    return args

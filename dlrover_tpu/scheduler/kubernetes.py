@@ -1,0 +1,192 @@
+"""Kubernetes platform client and spec builders.
+
+Role parity: ``dlrover/python/scheduler/kubernetes.py`` (``k8sClient``
+singleton with retries + pod/service/CR CRUD). The real ``kubernetes``
+package is optional: the client is a thin injectable seam, and tests drive
+the scaler/watcher logic against a ``FakeK8sClient`` exactly like the
+reference monkey-patches its ``k8sClient`` (reference ``tests/test_utils.py``).
+
+TPU-first: pod specs request ``google.com/tpu`` chips and carry the
+topology selector a GKE TPU node pool expects.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("scheduler.k8s")
+
+ELASTICJOB_GROUP = "elastic.dlrover-tpu.org"
+ELASTICJOB_VERSION = "v1alpha1"
+SCALEPLAN_PLURAL = "scaleplans"
+ELASTICJOB_PLURAL = "elasticjobs"
+TPU_RESOURCE_KEY = "google.com/tpu"
+TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
+TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+
+
+def retry_k8s_request(func: Callable) -> Callable:
+    """Retry transient API failures (reference: k8sClient retry wrappers)."""
+
+    def wrapped(*args, **kwargs):
+        for attempt in range(3):
+            try:
+                return func(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001 - API errors are opaque
+                if attempt == 2:
+                    logger.error("%s failed: %s", func.__name__, exc)
+                    return None
+                time.sleep(0.5 * (attempt + 1))
+
+    return wrapped
+
+
+class K8sClient:
+    """Thin wrapper over the kubernetes python client.
+
+    Only constructed when the ``kubernetes`` package is importable; all
+    control-plane logic depends on this interface, not the package, so the
+    whole master runs (and is tested) without a cluster.
+    """
+
+    _instance: Optional["K8sClient"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, namespace: str = "default"):
+        import kubernetes  # deferred: optional dependency
+
+        kubernetes.config.load_incluster_config()
+        self._core = kubernetes.client.CoreV1Api()
+        self._custom = kubernetes.client.CustomObjectsApi()
+        self._watch = kubernetes.watch
+        self.namespace = namespace
+
+    @classmethod
+    def singleton_instance(cls, namespace: str = "default") -> "K8sClient":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(namespace)
+            return cls._instance
+
+    @retry_k8s_request
+    def create_pod(self, pod: Dict[str, Any]):
+        return self._core.create_namespaced_pod(self.namespace, pod)
+
+    @retry_k8s_request
+    def delete_pod(self, name: str):
+        return self._core.delete_namespaced_pod(name, self.namespace)
+
+    @retry_k8s_request
+    def list_pods(self, label_selector: str = "") -> List[Dict[str, Any]]:
+        pods = self._core.list_namespaced_pod(
+            self.namespace, label_selector=label_selector
+        )
+        return [p.to_dict() for p in pods.items]
+
+    @retry_k8s_request
+    def create_custom_resource(self, plural: str, body: Dict[str, Any]):
+        return self._custom.create_namespaced_custom_object(
+            ELASTICJOB_GROUP, ELASTICJOB_VERSION, self.namespace, plural, body
+        )
+
+    @retry_k8s_request
+    def get_custom_resource(self, plural: str, name: str):
+        return self._custom.get_namespaced_custom_object(
+            ELASTICJOB_GROUP, ELASTICJOB_VERSION, self.namespace, plural, name
+        )
+
+
+def build_pod_labels(job_name: str, node_type: str, rank_index: int) -> Dict[str, str]:
+    return {
+        "app": "dlrover-tpu",
+        "elasticjob-name": job_name,
+        "replica-type": node_type,
+        "rank-index": str(rank_index),
+    }
+
+
+def build_pod_spec(
+    job_name: str,
+    pod_name: str,
+    node_type: str,
+    node_id: int,
+    rank_index: int,
+    image: str,
+    command: List[str],
+    cpu: float,
+    memory_mb: int,
+    tpu_chips: int = 0,
+    tpu_topology: str = "",
+    tpu_accelerator: str = "",
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Build the pod dict the scaler submits (reference: PodScaler._create_pod_obj).
+
+    TPU pods pin to a GKE TPU node pool via topology/accelerator selectors
+    and request whole hosts' worth of chips — fractional TPU requests are
+    not a thing.
+    """
+    resources: Dict[str, Any] = {
+        "requests": {"cpu": str(cpu), "memory": f"{memory_mb}Mi"},
+        "limits": {"memory": f"{memory_mb}Mi"},
+    }
+    node_selector: Dict[str, str] = {}
+    if tpu_chips > 0:
+        resources["requests"][TPU_RESOURCE_KEY] = str(tpu_chips)
+        resources["limits"][TPU_RESOURCE_KEY] = str(tpu_chips)
+        if tpu_topology:
+            node_selector[TPU_TOPOLOGY_SELECTOR] = tpu_topology
+        if tpu_accelerator:
+            node_selector[TPU_ACCELERATOR_SELECTOR] = tpu_accelerator
+    env_list = [{"name": k, "value": v} for k, v in (env or {}).items()]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod_name,
+            "labels": build_pod_labels(job_name, node_type, rank_index),
+            "annotations": {"node-id": str(node_id)},
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeSelector": node_selector,
+            "containers": [
+                {
+                    "name": "main",
+                    "image": image,
+                    "command": command,
+                    "resources": resources,
+                    "env": env_list,
+                }
+            ],
+        },
+    }
+
+
+def build_scale_plan_cr(
+    job_name: str,
+    node_group_resources: Dict[str, Dict[str, Any]],
+    create_pods: Optional[List[Dict[str, Any]]] = None,
+    remove_pods: Optional[List[str]] = None,
+    ps_hosts: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """ScalePlan CR body (reference: ElasticJobScaler + scaleplan_types.go)."""
+    return {
+        "apiVersion": f"{ELASTICJOB_GROUP}/{ELASTICJOB_VERSION}",
+        "kind": "ScalePlan",
+        "metadata": {
+            "name": f"{job_name}-scaleplan-{int(time.time())}",
+            "labels": {"elasticjob-name": job_name},
+        },
+        "spec": {
+            "ownerJob": job_name,
+            "replicaResourceSpecs": node_group_resources,
+            "createPods": create_pods or [],
+            "removePods": remove_pods or [],
+            "psHosts": ps_hosts or [],
+        },
+    }

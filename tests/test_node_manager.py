@@ -1,0 +1,469 @@
+"""Node lifecycle, scalers, watchers, and resource optimization.
+
+Mirrors the reference's test strategy (SURVEY §4): pure-logic managers
+driven in-memory, platform clients faked, and one end-to-end run of the
+distributed master over real local subprocesses.
+"""
+
+import queue
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.node.job_manager import DistributedJobManager
+from dlrover_tpu.master.node.ps import ParameterServerManager
+from dlrover_tpu.master.node.training_node import TrainingNodeManager
+from dlrover_tpu.master.node.worker import WorkerManager
+from dlrover_tpu.master.resource.local_optimizer import (
+    PSLocalOptimizer,
+    SpmdLocalOptimizer,
+)
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.master.scaler.pod_scaler import PodScaler
+from dlrover_tpu.master.stats.reporter import StatsReporter
+from dlrover_tpu.master.stats.training_metrics import RuntimeMetric
+from dlrover_tpu.master.watcher.base_watcher import NodeEvent, NodeWatcher
+from dlrover_tpu.master.watcher.k8s_watcher import (
+    ScalePlanWatcher,
+    get_pod_exit_reason,
+    pod_to_node,
+)
+from dlrover_tpu.scheduler.job import local_job_args
+
+
+def make_nodes(n, node_type=NodeType.WORKER):
+    return {
+        i: Node(node_type=node_type, node_id=i, rank_index=i,
+                status=NodeStatus.RUNNING)
+        for i in range(n)
+    }
+
+
+class TestTrainingNodeManager:
+    def test_scale_up_assigns_fresh_ranks(self):
+        mgr = TrainingNodeManager(make_nodes(2))
+        plan = mgr.adjust_node(
+            NodeGroupResource(4, NodeResource(cpu=1)), NodeType.WORKER
+        )
+        assert len(plan.launch_nodes) == 2
+        assert sorted(n.rank_index for n in plan.launch_nodes) == [2, 3]
+
+    def test_scale_down_removes_highest_ranks(self):
+        mgr = TrainingNodeManager(make_nodes(4))
+        plan = mgr.adjust_node(
+            NodeGroupResource(2, NodeResource()), NodeType.WORKER
+        )
+        assert sorted(n.rank_index for n in plan.remove_nodes) == [2, 3]
+
+    def test_relaunch_preserves_rank(self):
+        nodes = make_nodes(2)
+        mgr = TrainingNodeManager(nodes)
+        dead = nodes[1]
+        plan = mgr.relaunch_node(dead)
+        assert plan.launch_nodes[0].rank_index == 1
+        assert plan.launch_nodes[0].id == 2
+        assert plan.remove_nodes == [dead]
+
+
+class TestWorkerManager:
+    def test_node_unit_rounding(self):
+        mgr = WorkerManager(make_nodes(4), node_unit=4)
+        plan = mgr.adjust_worker(NodeGroupResource(6, NodeResource()))
+        # 6 rounds down to 4: no new nodes.
+        assert plan.node_group_resources[NodeType.WORKER].count == 4
+        assert not plan.launch_nodes
+
+        plan = mgr.adjust_worker(NodeGroupResource(9, NodeResource()))
+        assert plan.node_group_resources[NodeType.WORKER].count == 8
+        assert len(plan.launch_nodes) == 4
+
+    def test_remove_not_joined(self):
+        mgr = WorkerManager(make_nodes(3))
+        plan = mgr.remove_not_joined_rdzv_workers([2])
+        assert [n.rank_index for n in plan.remove_nodes] == [2]
+
+
+class TestPSManager:
+    def test_next_cluster_waits_for_running(self):
+        nodes = make_nodes(2, NodeType.PS)
+        mgr = ParameterServerManager(nodes)
+        plan = mgr.adjust_ps(NodeGroupResource(3, NodeResource(cpu=2)))
+        assert len(plan.launch_nodes) == 1
+        new_ps = plan.launch_nodes[0]
+        # New PS still INITIAL: next cluster == current cluster (2 PSs).
+        assert len(mgr.get_next_training_ps_cluster()) == 2
+        new_ps.update_status(NodeStatus.PENDING)
+        new_ps.update_status(NodeStatus.RUNNING)
+        assert len(mgr.get_next_training_ps_cluster()) == 3
+
+    def test_migration_releases_old_after_new_runs(self):
+        nodes = make_nodes(2, NodeType.PS)
+        for n in nodes.values():
+            n.name = f"ps-{n.id}"
+        mgr = ParameterServerManager(nodes)
+        plan = mgr.migrate_parameter_servers(
+            {"ps-0": NodeResource(cpu=16, memory=32768)}
+        )
+        assert len(plan.launch_nodes) == 1
+        replacement = plan.launch_nodes[0]
+        assert not nodes[0].is_released
+        replacement.update_status(NodeStatus.RUNNING)
+        cluster = mgr.get_next_training_ps_cluster()
+        assert nodes[0].is_released
+        assert replacement in cluster
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        super().__init__("test")
+        self.plans = []
+
+    def scale(self, plan: ScalePlan):
+        self.plans.append(plan)
+
+
+class QueueWatcher(NodeWatcher):
+    """Feeds canned NodeEvents to the job manager's monitor thread."""
+
+    def __init__(self):
+        self.events = queue.Queue()
+        self._stopped = False
+
+    def watch(self):
+        while not self._stopped:
+            try:
+                yield self.events.get(timeout=0.1)
+            except queue.Empty:
+                continue
+
+    def list(self):
+        return []
+
+    def stop(self):
+        self._stopped = True
+
+
+def make_job_manager(node_num=2, node_unit=1):
+    args = local_job_args("jmtest", node_num=node_num, node_unit=node_unit)
+    scaler = RecordingScaler()
+    watcher = QueueWatcher()
+    mgr = DistributedJobManager(args, scaler, watcher)
+    mgr._init_nodes()
+    mgr._init_managers()
+    return mgr, scaler, watcher
+
+
+class TestDistributedJobManager:
+    def test_failure_triggers_relaunch(self):
+        mgr, scaler, _ = make_job_manager()
+        node = mgr.get_job_nodes(NodeType.WORKER)[0]
+        evt_node = Node(NodeType.WORKER, 0, status=NodeStatus.RUNNING)
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt_node))
+        assert node.status == NodeStatus.RUNNING
+        evt_node = Node(NodeType.WORKER, 0, status=NodeStatus.FAILED)
+        evt_node.exit_reason = NodeExitReason.KILLED
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt_node))
+        assert len(scaler.plans) == 1
+        launched = scaler.plans[0].launch_nodes[0]
+        assert launched.rank_index == 0
+        assert launched.relaunch_count == 1
+
+    def test_fatal_error_not_relaunched(self):
+        mgr, scaler, _ = make_job_manager()
+        evt_node = Node(NodeType.WORKER, 0, status=NodeStatus.FAILED)
+        evt_node.exit_reason = NodeExitReason.FATAL_ERROR
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt_node))
+        assert not scaler.plans
+
+    def test_oom_doubles_memory(self):
+        mgr, scaler, _ = make_job_manager()
+        node = mgr.get_job_nodes(NodeType.WORKER)[0]
+        node.config_resource.memory = 1024
+        evt_node = Node(NodeType.WORKER, 0, status=NodeStatus.FAILED)
+        evt_node.exit_reason = NodeExitReason.OOM
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt_node))
+        assert node.config_resource.memory == 2048
+        assert scaler.plans[0].launch_nodes[0].config_resource.memory == 2048
+
+    def test_relaunch_budget_exhausted(self):
+        mgr, scaler, _ = make_job_manager()
+        node = mgr.get_job_nodes(NodeType.WORKER)[0]
+        node.relaunch_count = node.max_relaunch_count
+        evt_node = Node(NodeType.WORKER, 0, status=NodeStatus.FAILED)
+        evt_node.exit_reason = NodeExitReason.KILLED
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt_node))
+        assert not scaler.plans
+
+    def test_breakdown_report_relaunches_node(self):
+        # An ICI network-check failure arrives as an agent report, not a
+        # watcher event: the process is alive but the chip/link is bad.
+        mgr, scaler, _ = make_job_manager()
+        node = mgr.get_job_nodes(NodeType.WORKER)[0]
+        evt = Node(NodeType.WORKER, 0, status=NodeStatus.RUNNING)
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt))
+        mgr.update_node_reported_status(
+            NodeType.WORKER, 0, NodeStatus.BREAKDOWN
+        )
+        assert node.exit_reason == NodeExitReason.HARDWARE_ERROR
+        assert len(scaler.plans) == 1
+        assert scaler.plans[0].launch_nodes[0].rank_index == 0
+
+    def test_slice_cordon_stops_relaunch(self):
+        mgr, scaler, _ = make_job_manager()
+        mgr._slice_relaunches[0] = mgr.max_relaunch_count
+        evt = Node(NodeType.WORKER, 0, status=NodeStatus.FAILED)
+        evt.exit_reason = NodeExitReason.KILLED
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt))
+        assert not scaler.plans
+
+    def test_pending_early_stop(self):
+        mgr, _, _ = make_job_manager()
+        ctx = mgr._ctx
+        old_timeout = ctx.seconds_to_wait_pending_pod
+        ctx.seconds_to_wait_pending_pod = 0.01
+        try:
+            for node in mgr.get_job_nodes(NodeType.WORKER).values():
+                node.update_status(NodeStatus.PENDING)
+                node.create_time = time.time() - 1
+            assert mgr.should_early_stop()
+            # One running node suppresses early stop.
+            mgr.get_job_nodes(NodeType.WORKER)[0].update_status(
+                NodeStatus.RUNNING
+            )
+            assert not mgr.should_early_stop()
+        finally:
+            ctx.seconds_to_wait_pending_pod = old_timeout
+
+    def test_all_workers_exited(self):
+        mgr, _, _ = make_job_manager(node_num=2)
+        nodes = mgr.get_job_nodes(NodeType.WORKER)
+        assert not mgr.all_workers_exited()
+        for nid in nodes:
+            evt = Node(NodeType.WORKER, nid, status=NodeStatus.SUCCEEDED)
+            mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt))
+        assert mgr.all_workers_exited()
+        assert mgr.all_workers_succeeded()
+
+
+class TestK8sWatcherParsing:
+    def make_pod(self, phase="Running", reason="", exit_code=0):
+        pod = {
+            "metadata": {
+                "name": "job-worker-0",
+                "labels": {"replica-type": "worker", "rank-index": "0"},
+                "annotations": {"node-id": "0"},
+            },
+            "status": {"phase": phase, "containerStatuses": []},
+        }
+        if reason or exit_code:
+            pod["status"]["containerStatuses"] = [
+                {"state": {"terminated": {"reason": reason,
+                                          "exitCode": exit_code}}}
+            ]
+        return pod
+
+    def test_pod_to_node(self):
+        node = pod_to_node(self.make_pod())
+        assert node.type == NodeType.WORKER
+        assert node.status == NodeStatus.RUNNING
+
+    def test_oom_reason(self):
+        pod = self.make_pod("Failed", reason="OOMKilled", exit_code=137)
+        assert get_pod_exit_reason(pod) == NodeExitReason.OOM
+
+    def test_fatal_exit_code(self):
+        pod = self.make_pod("Failed", exit_code=1)
+        assert get_pod_exit_reason(pod) == NodeExitReason.FATAL_ERROR
+
+    def test_scale_plan_cr_parsing(self):
+        cr = {
+            "metadata": {"name": "sp-1"},
+            "spec": {
+                "replicaResourceSpecs": {
+                    "worker": {"replicas": 8,
+                               "resource": {"cpu": "4", "memory": "8192Mi"}},
+                    "ps": {"replicas": 2,
+                           "resource": {"cpu": "8", "memory": "2Gi"}},
+                },
+                "psHosts": ["ps-0:2222"],
+            },
+        }
+        plan = ScalePlanWatcher.to_scale_plan(cr)
+        group = plan.node_group_resources["worker"]
+        assert group.count == 8
+        assert group.node_resource.memory == 8192
+        assert plan.node_group_resources["ps"].node_resource.memory == 2048
+        assert plan.ps_addrs == ["ps-0:2222"]
+
+
+class FakeK8sClient:
+    def __init__(self):
+        self.pods = []
+        self.deleted = []
+
+    def create_pod(self, pod):
+        self.pods.append(pod)
+        return pod
+
+    def delete_pod(self, name):
+        self.deleted.append(name)
+        return True
+
+    def list_pods(self, label_selector=""):
+        return list(self.pods)
+
+
+class TestPodScaler:
+    def test_launch_builds_tpu_pod(self):
+        client = FakeK8sClient()
+        scaler = PodScaler(
+            "job", client, "10.0.0.1:50051", tpu_topology="2x2x4",
+            tpu_accelerator="tpu-v5p-slice",
+        )
+        node = Node(NodeType.WORKER, 0, config_resource=NodeResource(
+            cpu=4, memory=8192))
+        node.config_resource.accelerator.chips = 4
+        plan = ScalePlan(launch_nodes=[node])
+        scaler.scale(plan)
+        scaler._create_pod(scaler._create_queue.get())
+        pod = client.pods[0]
+        spec = pod["spec"]["containers"][0]
+        assert spec["resources"]["requests"]["google.com/tpu"] == "4"
+        assert pod["spec"]["nodeSelector"][
+            "cloud.google.com/gke-tpu-topology"] == "2x2x4"
+        envs = {e["name"]: e["value"] for e in spec["env"]}
+        assert envs["DLROVER_TPU_MASTER_ADDR"] == "10.0.0.1:50051"
+
+
+def push_runtime_samples(job_name, specs):
+    """specs: list of dicts with speed, workers, ps (list of (cpu, used))."""
+    reporter = StatsReporter.new_stats_reporter(job_name)
+    reporter.runtime_stats.clear()
+    for i, s in enumerate(specs):
+        metric = RuntimeMetric(timestamp=float(i), speed=s.get("speed", 1.0))
+        metric.running_nodes[NodeType.WORKER] = [
+            {"id": w, "cpu": 4, "used_cpu": 2, "memory": 8192}
+            for w in range(s.get("workers", 1))
+        ]
+        if "ps" in s:
+            metric.running_nodes[NodeType.PS] = [
+                {"id": j, "cpu": cpu, "used_cpu": used, "memory": 16384}
+                for j, (cpu, used) in enumerate(s["ps"])
+            ]
+        reporter.runtime_stats.append(metric)
+    return reporter
+
+
+class TestLocalOptimizers:
+    def test_ps_headroom_grows_workers(self):
+        push_runtime_samples(
+            "opt1", [{"workers": 2, "ps": [(8, 3.2)]}] * 4
+        )
+        opt = PSLocalOptimizer("opt1")
+        plan = opt.generate_worker_resource()
+        group = plan.node_group_resources[NodeType.WORKER]
+        # util 0.4, threshold 0.8 → target capped at 2× current.
+        assert group.count == 4
+
+    def test_saturated_ps_blocks_growth(self):
+        push_runtime_samples(
+            "opt2", [{"workers": 2, "ps": [(8, 7.5)]}] * 4
+        )
+        opt = PSLocalOptimizer("opt2")
+        assert not opt.generate_worker_resource().node_group_resources
+
+    def test_hot_ps_migration(self):
+        push_runtime_samples("opt3", [{"workers": 2, "ps": [(8, 7.8)]}] * 4)
+        opt = PSLocalOptimizer("opt3")
+        plan = opt.generate_hot_ps_migration()
+        assert plan.node_resources["ps-0"].cpu == 16
+
+    def test_spmd_grows_while_efficient(self):
+        # Speed scales with workers: efficiency flat → keep growing.
+        specs = [{"workers": 4, "speed": 4.0}] * 6 + [
+            {"workers": 4, "speed": 4.0}] * 6
+        push_runtime_samples("opt4", specs)
+        opt = SpmdLocalOptimizer("opt4", node_unit=4)
+        plan = opt.generate_opt_plan()
+        assert plan.node_group_resources[NodeType.WORKER].count == 8
+
+    def test_spmd_stops_on_efficiency_drop(self):
+        specs = [{"workers": 4, "speed": 4.0}] * 6 + [
+            {"workers": 8, "speed": 4.4}] * 6
+        push_runtime_samples("opt5", specs)
+        opt = SpmdLocalOptimizer("opt5", node_unit=4)
+        plan = opt.generate_opt_plan()
+        assert not plan.node_group_resources
+
+
+class TestDistMasterEndToEnd:
+    def test_workers_run_to_completion(self):
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.master.scaler.process_scaler import LocalProcessScaler
+        from dlrover_tpu.master.watcher.process_watcher import LocalProcessWatcher
+        from dlrover_tpu.scheduler.local import LocalProcessBackend
+
+        backend = LocalProcessBackend()
+        args = local_job_args("e2e-nodes", node_num=2)
+        scaler = LocalProcessScaler(
+            "e2e-nodes", backend, "",
+            command_factory=lambda node: [
+                sys.executable, "-c", "import time; time.sleep(0.3)",
+            ],
+        )
+        master = DistributedJobMaster(
+            job_args=args,
+            scaler=scaler,
+            watcher=LocalProcessWatcher(backend, poll_secs=0.1),
+        )
+        master._ctx.seconds_interval_to_report = 0.2
+        master.prepare()
+        try:
+            rc = master.run()
+            assert rc == 0
+        finally:
+            master.stop()
+
+    def test_failing_worker_relaunched_then_succeeds(self, tmp_path):
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.master.scaler.process_scaler import LocalProcessScaler
+        from dlrover_tpu.master.watcher.process_watcher import LocalProcessWatcher
+        from dlrover_tpu.scheduler.local import LocalProcessBackend
+
+        marker = tmp_path / "failed_once"
+        script = (
+            "import os, sys, time\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close()\n"
+            "    sys.exit(1)\n"
+            "time.sleep(0.2)\n"
+        )
+        backend = LocalProcessBackend()
+        args = local_job_args("e2e-relaunch", node_num=1)
+        scaler = LocalProcessScaler(
+            "e2e-relaunch", backend, "",
+            command_factory=lambda node: [sys.executable, "-c", script],
+        )
+        master = DistributedJobMaster(
+            job_args=args,
+            scaler=scaler,
+            watcher=LocalProcessWatcher(backend, poll_secs=0.1),
+        )
+        master._ctx.seconds_interval_to_report = 0.2
+        master.prepare()
+        try:
+            rc = master.run()
+            assert rc == 0
+            workers = master.job_manager.get_job_nodes(NodeType.WORKER)
+            assert len(workers) == 2  # original + relaunch
+        finally:
+            master.stop()
