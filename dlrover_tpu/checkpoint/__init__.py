@@ -1,0 +1,18 @@
+"""Elastic checkpoint/resume: async Orbax saves with GSPMD resharding.
+
+Role parity: the reference's elastic FSDP checkpoint
+(``atorch/atorch/utils/fsdp_save_util.py``) + data-shard checkpoints
+(``batch_dataset_manager.py:157-203``).
+"""
+
+from dlrover_tpu.checkpoint.manager import (
+    CheckpointInterval,
+    ElasticCheckpointManager,
+    abstract_like,
+)
+
+__all__ = [
+    "CheckpointInterval",
+    "ElasticCheckpointManager",
+    "abstract_like",
+]

@@ -1,0 +1,101 @@
+"""Failure classification on the master.
+
+Role parity: ``dlrover/python/master/monitor/error_monitor.py``
+(``ErrorLogMonitor``) — turns raw failure reports from agents into a
+classified, deduplicated record the job manager and operators act on.
+
+TPU-first classification: XLA/TPU-specific signatures (device halt, ICI
+link error, HBM OOM) are recognized alongside generic Python tracebacks,
+because they imply different actions (hardware cordon vs relaunch vs
+memory bump).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("diagnosis.errors")
+
+# Signature -> (classified exit reason). Order matters: first match wins.
+_ERROR_SIGNATURES = [
+    (re.compile(r"RESOURCE_EXHAUSTED|out of memory|HBM OOM", re.I),
+     NodeExitReason.OOM),
+    (re.compile(r"ICI|interconnect|link.*(down|error)|DEADLINE_EXCEEDED.*"
+                r"collective", re.I),
+     NodeExitReason.HARDWARE_ERROR),
+    (re.compile(r"halted|device.*(unavailable|failure)|INTERNAL.*TPU", re.I),
+     NodeExitReason.HARDWARE_ERROR),
+    (re.compile(r"preempt", re.I), NodeExitReason.PREEMPTED),
+    (re.compile(r"SyntaxError|ImportError|ModuleNotFoundError|NameError"),
+     NodeExitReason.FATAL_ERROR),
+]
+
+
+@dataclass
+class ErrorRecord:
+    timestamp: float
+    node_id: int
+    level: str
+    reason: str
+    message: str
+
+
+@dataclass
+class ErrorLogMonitor:
+    max_records: int = 200
+    records: List[ErrorRecord] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+
+    def process_error(
+        self, node_id: int, restart_count: int, error_data: str, level: str
+    ) -> str:
+        """Classify and record; returns the inferred NodeExitReason."""
+        reason = classify_error(error_data)
+        record = ErrorRecord(
+            timestamp=time.time(),
+            node_id=node_id,
+            level=level,
+            reason=reason,
+            message=error_data[:2048],
+        )
+        with self._lock:
+            self.records.append(record)
+            if len(self.records) > self.max_records:
+                del self.records[: -self.max_records]
+        log = (
+            logger.error
+            if level in (TrainingExceptionLevel.NODE_ERROR,
+                         TrainingExceptionLevel.PROCESS_ERROR)
+            else logger.warning
+        )
+        log(
+            "node %d failure (level=%s restarts=%d reason=%s): %s",
+            node_id, level, restart_count, reason, error_data[:512],
+        )
+        return reason
+
+    def node_error_counts(self) -> Dict[int, int]:
+        with self._lock:
+            counts: Dict[int, int] = {}
+            for r in self.records:
+                counts[r.node_id] = counts.get(r.node_id, 0) + 1
+            return counts
+
+
+def classify_error(error_data: str) -> str:
+    for pattern, reason in _ERROR_SIGNATURES:
+        if pattern.search(error_data or ""):
+            return reason
+    return NodeExitReason.UNKNOWN_ERROR

@@ -1,0 +1,76 @@
+"""In-job hang detection, independent of the master.
+
+Role parity: ``atorch/atorch/fault_tolerance/hanging_detector.py:10-145``
+(``HangingDetector`` — per-worker heartbeat thread; missing heartbeats ⇒
+request relaunch) and ``custom_agent.py:19`` (``LocalDetectHangingAgent``).
+
+TPU-first: the thing that hangs on TPU is a collective waiting on a dead
+peer inside one XLA program — the Python thread stays alive while the
+device blocks. So the heartbeat is driven from the *host* side of the step
+loop (``report_normal()`` after each device-synced step), and the monitor
+escalates through a callback (agent restart / master report) when the gap
+exceeds the timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("diagnosis.hang")
+
+
+class HangingDetector:
+    def __init__(
+        self,
+        timeout_secs: float = 1800.0,
+        check_interval_secs: float = 30.0,
+        on_hang: Optional[Callable[[float], None]] = None,
+        monitor: bool = True,
+    ):
+        self._timeout = timeout_secs
+        self._interval = check_interval_secs
+        self._on_hang = on_hang
+        self._monitor_enabled = monitor
+        self._last_normal = time.time()
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.hang_detected = False
+
+    def start(self):
+        if not self._monitor_enabled or self._thread is not None:
+            return
+        self._last_normal = time.time()
+        self._thread = threading.Thread(
+            target=self._watch, name="hang-detector", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def report_normal(self):
+        """Call after each completed (host-synced) training step."""
+        with self._lock:
+            self._last_normal = time.time()
+            self.hang_detected = False
+
+    def seconds_since_progress(self) -> float:
+        with self._lock:
+            return time.time() - self._last_normal
+
+    def _watch(self):
+        while not self._stopped.wait(self._interval):
+            gap = self.seconds_since_progress()
+            if gap > self._timeout and not self.hang_detected:
+                self.hang_detected = True
+                logger.warning("no training progress for %.0fs", gap)
+                if self._on_hang is not None:
+                    try:
+                        self._on_hang(gap)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("on_hang callback failed")

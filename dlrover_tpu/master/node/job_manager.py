@@ -29,6 +29,7 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.node import Node, NodeGroupResource
 from dlrover_tpu.common.status_flow import get_node_state_flow
+from dlrover_tpu.diagnosis.error_monitor import ErrorLogMonitor
 from dlrover_tpu.master.node.event_callback import ClusterContext, NodeEventCallback
 from dlrover_tpu.master.node.ps import ParameterServerManager
 from dlrover_tpu.master.node.worker import (
@@ -89,6 +90,7 @@ class DistributedJobManager(JobManager):
         # A slice that burns through the job-level budget is cordoned.
         self._slice_relaunches: Dict[int, int] = {}
         self.max_relaunch_count = self._ctx.max_relaunch_count
+        self.error_monitor = ErrorLogMonitor()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -119,6 +121,8 @@ class DistributedJobManager(JobManager):
         self._scaler.stop()
 
     def _init_nodes(self):
+        import copy
+
         for node_type, args in self._job_args.node_args.items():
             group = args.group_resource
             self._job_nodes[node_type] = {
@@ -127,7 +131,9 @@ class DistributedJobManager(JobManager):
                     node_id=i,
                     rank_index=i,
                     name=f"{self._job_args.job_name}-{node_type}-{i}",
-                    config_resource=group.node_resource,
+                    # Each node owns its resource: the OOM relaunch path
+                    # mutates it, and that must not alias the group spec.
+                    config_resource=copy.deepcopy(group.node_resource),
                     max_relaunch_count=args.restart_count,
                     critical=(node_type in (NodeType.PS, NodeType.CHIEF)),
                     slice_index=i // max(self._job_args.node_unit, 1),
@@ -215,7 +221,12 @@ class DistributedJobManager(JobManager):
             if event.event_type == NodeEventType.DELETED
             else evt_node.status
         )
-        if evt_node.exit_reason:
+        # The agent's traceback-based classification (OOM/hardware/fatal)
+        # is more specific than the watcher's exit-code guess; only let the
+        # watcher overwrite generic or empty reasons.
+        if evt_node.exit_reason and node.exit_reason in (
+            "", NodeExitReason.UNKNOWN_ERROR, NodeExitReason.KILLED
+        ):
             node.exit_reason = evt_node.exit_reason
         flow = get_node_state_flow(node.status, new_status)
         if flow is None:
@@ -309,16 +320,20 @@ class DistributedJobManager(JobManager):
     def handle_training_failure(
         self, node_id: int, restart_count: int, error_data: str, level: str
     ):
+        reason = self.error_monitor.process_error(
+            node_id, restart_count, error_data, level
+        )
         node = self._get_node(NodeType.WORKER, node_id) or self._find_node_by_rank(
             NodeType.WORKER, node_id
         )
         if node is None:
             return
         node.update_reported_status(NodeStatus.FAILED)
-        logger.warning(
-            "training failure on %s (restarts=%d level=%s)",
-            node.name, restart_count, level,
-        )
+        # Remember the classified reason so the relaunch decision (made
+        # when the watcher sees the pod die) applies the right policy
+        # (OOM memory bump, fatal no-relaunch, hardware cordon).
+        if not node.exit_reason:
+            node.exit_reason = reason
 
     def update_node_resource_usage(
         self, node_type: str, node_id: int, cpu: float, memory: int
@@ -467,10 +482,29 @@ class DistributedJobManager(JobManager):
 
     # -- scaling entry points (used by the auto-scaler) ----------------------
 
+    def _fill_group_resource(self, node_type: str, group: NodeGroupResource):
+        """Optimizer plans often carry only a count; inherit the per-node
+        resource (cpu/memory/chips/topology) from the job spec so scale-up
+        pods still request TPU chips."""
+        import copy
+
+        args = self._job_args.node_args.get(node_type)
+        if args is None:
+            return group
+        base = args.group_resource.node_resource
+        res = group.node_resource
+        if res.cpu == 0 and res.memory == 0 and res.accelerator.chips == 0:
+            return NodeGroupResource(
+                count=group.count, node_resource=copy.deepcopy(base)
+            )
+        return group
+
     def execute_scale_plan(self, plan: ScalePlan):
         if plan.empty():
             return
-        for node_type, group in plan.node_group_resources.items():
+        for node_type, group in list(plan.node_group_resources.items()):
+            group = self._fill_group_resource(node_type, group)
+            plan.node_group_resources[node_type] = group
             if node_type == NodeType.WORKER and group.count > 0:
                 sub = self._worker_manager.adjust_worker(group)
                 plan.launch_nodes.extend(sub.launch_nodes)
@@ -479,5 +513,8 @@ class DistributedJobManager(JobManager):
                 sub = self._ps_manager.adjust_ps(group)
                 plan.launch_nodes.extend(sub.launch_nodes)
                 plan.remove_nodes.extend(sub.remove_nodes)
+        if plan.migrate_nodes:
+            sub = self._ps_manager.migrate_parameter_servers(plan.migrate_nodes)
+            plan.launch_nodes.extend(sub.launch_nodes)
         plan.ps_addrs = self._ps_manager.get_ps_addrs()
         self._scaler.scale(plan)

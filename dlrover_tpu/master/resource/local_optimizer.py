@@ -117,7 +117,7 @@ class PSLocalOptimizer(ResourceOptimizer):
             req = max(entry.get("cpu", 0), 0.1)
             used = entry.get("used_cpu", 0)
             if used / req > 0.9:
-                name = f"ps-{entry['id']}"
+                name = entry.get("name", f"ps-{entry['id']}")
                 plan.node_resources[name] = NodeResource(
                     cpu=req * 2, memory=entry.get("memory", _PS_DEFAULT.memory)
                 )

@@ -27,4 +27,5 @@ class ResourcePlan:
     def to_scale_plan(self) -> ScalePlan:
         plan = ScalePlan()
         plan.node_group_resources.update(self.node_group_resources)
+        plan.migrate_nodes.update(self.node_resources)
         return plan

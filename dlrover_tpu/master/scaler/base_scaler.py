@@ -12,7 +12,7 @@ from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 from typing import Dict, List
 
-from dlrover_tpu.common.node import Node, NodeGroupResource
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
 
 
 @dataclass
@@ -23,6 +23,8 @@ class ScalePlan:
     launch_nodes: List[Node] = field(default_factory=list)
     # Concrete nodes to delete.
     remove_nodes: List[Node] = field(default_factory=list)
+    # node name -> new resource: replace in place (hot-PS migration).
+    migrate_nodes: Dict[str, "NodeResource"] = field(default_factory=dict)
     # PS addresses for the next PS cluster version (PS jobs only).
     ps_addrs: List[str] = field(default_factory=list)
 
@@ -31,6 +33,7 @@ class ScalePlan:
             self.node_group_resources
             or self.launch_nodes
             or self.remove_nodes
+            or self.migrate_nodes
             or self.ps_addrs
         )
 
@@ -38,6 +41,7 @@ class ScalePlan:
         self.node_group_resources.update(other.node_group_resources)
         self.launch_nodes.extend(other.launch_nodes)
         self.remove_nodes.extend(other.remove_nodes)
+        self.migrate_nodes.update(other.migrate_nodes)
         if other.ps_addrs:
             self.ps_addrs = other.ps_addrs
 

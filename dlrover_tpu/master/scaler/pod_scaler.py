@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
@@ -125,5 +124,10 @@ class PodScaler(Scaler):
                 node.update_status(NodeStatus.FAILED)
                 return
             logger.error("pod creation failed for %s; requeueing", node.name)
-            time.sleep(min(2 ** attempts, 30))
-            self._create_queue.put(node)
+            # Back off without blocking the drain thread's other work: the
+            # requeue itself is immediate, the retry is delayed by a timer
+            # so stop() stays responsive and other pods keep creating.
+            delay = min(2 ** attempts, 30)
+            timer = threading.Timer(delay, self._create_queue.put, args=(node,))
+            timer.daemon = True
+            timer.start()

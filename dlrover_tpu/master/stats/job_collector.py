@@ -61,6 +61,7 @@ class JobMetricCollector:
                 entries.append(
                     {
                         "id": node.id,
+                        "name": node.name,
                         "cpu": node.config_resource.cpu,
                         "memory": node.config_resource.memory,
                         "used_cpu": node.used_resource.cpu,

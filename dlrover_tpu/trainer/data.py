@@ -1,0 +1,181 @@
+"""Elastic data input: resumable sampler + runtime-adjustable loader.
+
+Role parity: ``dlrover/trainer/torch/elastic_sampler.py:25``
+(``ElasticDistributedSampler`` — resumable, world-size-change-aware) and
+``elastic_dataloader.py:19`` (``ElasticDataLoader`` — batch size changed
+at runtime from a config push).
+
+TPU-first: each *host* feeds its local slice of the global batch; the
+sampler partitions the index space by (num_shards, shard_rank) just like
+per-host ``tf.data`` sharding, and resuming after a world change re-
+partitions the *remaining* indices over the new world. When a master is
+present, the dynamic sharding client (``IndexShardingClient``) replaces
+static partitioning entirely — faster hosts pull more shards.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("trainer.data")
+
+
+class ElasticDistributedSampler:
+    """Deterministic, resumable index sampler over ``dataset_size``.
+
+    ``state_dict``/``load_state_dict`` carry ``completed_num`` so a restore
+    (possibly at a different world size) skips consumed samples — the
+    reference's semantics, minus torch.
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        num_shards: int = 1,
+        shard_rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if num_shards < 1 or not 0 <= shard_rank < num_shards:
+            raise ValueError(
+                f"bad shard spec rank={shard_rank} of {num_shards}"
+            )
+        self.dataset_size = dataset_size
+        self.num_shards = num_shards
+        self.shard_rank = shard_rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.completed_num = 0  # global count of consumed samples
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.completed_num = 0
+
+    def _global_indices(self) -> np.ndarray:
+        idx = np.arange(self.dataset_size)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        return idx
+
+    def __iter__(self) -> Iterator[int]:
+        indices = self._global_indices()[self.completed_num:]
+        if self.drop_last:
+            usable = (len(indices) // self.num_shards) * self.num_shards
+            indices = indices[:usable]
+        else:
+            pad = (-len(indices)) % self.num_shards
+            if pad and len(indices) > 0:
+                # Tile until the pad is covered: near an epoch boundary the
+                # remainder can be smaller than the pad, and every shard
+                # must yield the same count or SPMD hosts desync.
+                reps = -(-pad // len(indices))
+                filler = np.tile(indices, reps)[:pad]
+                indices = np.concatenate([indices, filler])
+        for i in indices[self.shard_rank:: self.num_shards]:
+            yield int(i)
+
+    def __len__(self) -> int:
+        remaining = self.dataset_size - self.completed_num
+        if self.drop_last:
+            return remaining // self.num_shards
+        return math.ceil(remaining / self.num_shards)
+
+    # -- elasticity ----------------------------------------------------------
+
+    def record_batch(self, global_batch_size: int):
+        """Advance the resume cursor by one global batch."""
+        self.completed_num += global_batch_size
+
+    def reshard(self, num_shards: int, shard_rank: int):
+        """Adopt a new world; remaining indices re-partition cleanly."""
+        logger.info(
+            "sampler reshard: %d/%d -> %d/%d (completed=%d)",
+            self.shard_rank, self.num_shards, shard_rank, num_shards,
+            self.completed_num,
+        )
+        self.num_shards = num_shards
+        self.shard_rank = shard_rank
+
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "completed_num": self.completed_num,
+            "seed": self.seed,
+        }
+
+    def load_state_dict(self, state: dict):
+        self.epoch = state.get("epoch", 0)
+        self.completed_num = state.get("completed_num", 0)
+        self.seed = state.get("seed", self.seed)
+
+
+class ElasticDataLoader:
+    """Batched host-side loader with a runtime-adjustable batch size.
+
+    ``dataset`` is anything indexable; ``collate_fn`` stacks samples
+    (default: numpy stack over tree leaves). ``set_batch_size`` takes
+    effect at the next batch boundary — the reference reads a config file
+    pushed by the master; here the agent calls it directly from the
+    paral-config RPC.
+    """
+
+    def __init__(
+        self,
+        dataset: Sequence,
+        batch_size: int,
+        sampler: Optional[ElasticDistributedSampler] = None,
+        collate_fn: Optional[Callable[[List[Any]], Any]] = None,
+        sharding_client=None,
+    ):
+        self.dataset = dataset
+        self._batch_size = batch_size
+        self.sampler = sampler or ElasticDistributedSampler(
+            len(dataset), shuffle=False
+        )
+        self._collate = collate_fn or _default_collate
+        # When set, indices come from the master's dynamic sharding
+        # service instead of the static sampler.
+        self._sharding_client = sharding_client
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    def set_batch_size(self, batch_size: int):
+        if batch_size > 0 and batch_size != self._batch_size:
+            logger.info("batch size %d -> %d", self._batch_size, batch_size)
+            self._batch_size = batch_size
+
+    def _index_stream(self) -> Iterator[int]:
+        if self._sharding_client is not None:
+            yield from self._sharding_client.record_indices()
+        else:
+            yield from self.sampler
+
+    def __iter__(self) -> Iterator[Any]:
+        buf: List[Any] = []
+        for idx in self._index_stream():
+            buf.append(self.dataset[idx])
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf.clear()
+        if buf:
+            yield self._collate(buf)
+
+    def __len__(self) -> int:
+        return math.ceil(len(self.sampler) / max(self._batch_size, 1))
+
+
+def _default_collate(samples: List[Any]):
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *samples)

@@ -1,0 +1,212 @@
+"""ElasticTrainer: fixed global batch under a changing world.
+
+Role parity: ``dlrover/trainer/torch/elastic.py:214-407``
+(``ElasticTrainer``) — the reference keeps the *global* batch size fixed
+under elasticity by setting ``gradient_accumulation_steps =
+max_workers / cur_world`` and skipping gradient sync on accumulation
+steps.
+
+TPU-first: there is no per-step sync to skip — the train step is one
+compiled SPMD program. Elasticity instead means: when the world changes,
+re-derive the strategy for the new device count (same global batch, the
+``data`` axis shrinks, ``grad_accum_steps`` grows to compensate) and
+re-``accelerate``. Checkpoint/restore across the transition is GSPMD-
+native (``dlrover_tpu.checkpoint``). The per-step hot loop stays pure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from dlrover_tpu.checkpoint import (
+    CheckpointInterval,
+    ElasticCheckpointManager,
+    abstract_like,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.accelerate import AccelerateResult, accelerate
+from dlrover_tpu.parallel.strategy import Strategy
+
+logger = get_logger("trainer.elastic")
+
+
+class ElasticTrainer:
+    """Owns the (strategy, compiled step, state) triple across world changes.
+
+    Usage::
+
+        trainer = ElasticTrainer(init_fn, loss_fn, optimizer, example_batch,
+                                 strategy, ckpt_dir="/ckpt")
+        state = trainer.prepare()          # restores if a checkpoint exists
+        for batch in loader:
+            state, metrics = trainer.step(state, batch)
+        # agent signals a membership change:
+        state = trainer.on_world_change(state)   # recompile + reshard
+    """
+
+    def __init__(
+        self,
+        init_fn: Callable,
+        loss_fn: Callable,
+        optimizer,
+        example_batch: Any,
+        strategy: Optional[Strategy] = None,
+        ckpt_dir: str = "",
+        ckpt_interval: Optional[CheckpointInterval] = None,
+        master_client=None,
+        report_every_steps: int = 10,
+    ):
+        self._init_fn = init_fn
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._example_batch = example_batch
+        self._base_strategy = strategy or Strategy()
+        self._master_client = master_client
+        self._report_every = max(report_every_steps, 1)
+
+        self._result: Optional[AccelerateResult] = None
+        # Device count the base strategy was written for; grad-accum scales
+        # relative to this (the reference's max_workers anchor).
+        self._initial_devices: Optional[int] = None
+        # Host-side mirror of state.step: reading the device scalar every
+        # step would force a host-device sync in the hot loop.
+        self._host_step = 0
+        self._rng = jax.random.PRNGKey(0)
+        self._ckpt: Optional[ElasticCheckpointManager] = None
+        if ckpt_dir:
+            self._ckpt = ElasticCheckpointManager(
+                ckpt_dir, save_interval=ckpt_interval or CheckpointInterval()
+            )
+
+    # -- build / rebuild -----------------------------------------------------
+
+    @property
+    def accelerated(self) -> AccelerateResult:
+        if self._result is None:
+            raise RuntimeError("call prepare() first")
+        return self._result
+
+    def _build(self, num_devices: int) -> AccelerateResult:
+        if self._initial_devices is None:
+            self._initial_devices = num_devices
+        strategy = self._base_strategy.adjust_to_world(
+            num_devices, prev_num_devices=self._initial_devices
+        )
+        return accelerate(
+            self._init_fn,
+            self._loss_fn,
+            self._optimizer,
+            self._example_batch,
+            strategy=strategy,
+            rng=self._rng,
+        )
+
+    def prepare(self, state: Any = None) -> Any:
+        """Compile for the current world; restore or init state."""
+        self._result = self._build(len(jax.devices()))
+        if state is not None:
+            self._host_step = int(state.step)
+            return state
+        if self._ckpt is not None:
+            restored = self._try_restore()
+            if restored is not None:
+                return restored
+        self._host_step = 0
+        return self._result.init_fn(self._rng)
+
+    def _try_restore(self) -> Optional[Any]:
+        abstract = jax.eval_shape(
+            lambda r: self._result.init_fn(r), self._rng
+        )
+        target = abstract_like(abstract, self._result.state_sharding)
+        out = self._ckpt.restore(target)
+        if out is None:
+            return None
+        if out["shard_checkpoint"] and self._master_client is not None:
+            # Hand the data-shard state back to the master so the epoch
+            # resumes where it left off.
+            try:
+                from dlrover_tpu.common import comm
+
+                self._master_client.report(
+                    comm.ShardCheckpoint(content=out["shard_checkpoint"])
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("restoring shard checkpoint failed")
+        logger.info("resumed from step %d", out["step"])
+        self._host_step = int(out["state"].step)
+        return out["state"]
+
+    def on_world_change(self, state: Any) -> Any:
+        """Re-accelerate for the new device count and reshard the state.
+
+        Called by the agent/bootstrap after ``jax.distributed`` re-init.
+        The global batch stays fixed: ``Strategy.adjust_to_world`` shrinks
+        the data axis and grows grad accumulation to compensate — the
+        reference's ``_set_gradient_accumulation_steps`` semantics.
+        """
+        n = len(jax.devices())
+        old_accum = self._result.strategy.grad_accum_steps if self._result else 1
+        self._result = self._build(n)
+        logger.info(
+            "world changed -> %d devices; grad_accum %d -> %d",
+            n, old_accum, self._result.strategy.grad_accum_steps,
+        )
+        # Reshard the live state onto the new mesh. device_put with the new
+        # NamedShardings is an all-gather/reshard XLA program, not a host
+        # round-trip.
+        return jax.device_put(state, self._result.state_sharding)
+
+    # -- hot loop ------------------------------------------------------------
+
+    def step(self, state: Any, batch: Any) -> Tuple[Any, Dict]:
+        self._rng, step_rng = jax.random.split(self._rng)
+        sharded = self._result.shard_batch(batch)
+        state, metrics = self._result.train_step(state, sharded, step_rng)
+        self._host_step += 1
+        step = self._host_step
+        if self._master_client is not None and step % self._report_every == 0:
+            try:
+                from dlrover_tpu.common import comm
+
+                self._master_client.report(
+                    comm.GlobalStep(step=step, timestamp=time.time())
+                )
+            except Exception:  # noqa: BLE001 - reporting must never kill training
+                pass
+        if self._ckpt is not None and self._ckpt.interval.should_save(step):
+            self.save(state)
+        return state, metrics
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def save(self, state: Any, force: bool = True):
+        if self._ckpt is None:
+            return
+        shard_ckpt = ""
+        if self._master_client is not None:
+            try:
+                from dlrover_tpu.common import comm
+
+                resp = self._master_client.get(
+                    comm.ShardCheckpointRequest(dataset_name="")
+                )
+                shard_ckpt = getattr(resp, "content", "") or ""
+            except Exception:  # noqa: BLE001
+                pass
+        self._ckpt.save(
+            int(state.step),
+            state,
+            metadata={"strategy": self._result.strategy.to_json()},
+            shard_checkpoint=shard_ckpt,
+            force=force,
+        )
+
+    def finalize(self):
+        if self._ckpt is not None:
+            self._ckpt.wait()
+            self._ckpt.close()

@@ -1,0 +1,121 @@
+"""Hang detection, error classification, and the XLA-cost profiler."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.common.constants import NodeExitReason
+from dlrover_tpu.diagnosis.error_monitor import ErrorLogMonitor, classify_error
+from dlrover_tpu.diagnosis.hang_detector import HangingDetector
+from dlrover_tpu.parallel.accelerate import accelerate
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.utils.prof import AProfiler, DryRunner, analyze_cost, count_params
+
+
+class TestHangDetector:
+    def test_detects_and_recovers(self):
+        hangs = []
+        det = HangingDetector(
+            timeout_secs=0.2, check_interval_secs=0.05,
+            on_hang=lambda gap: hangs.append(gap),
+        )
+        det.start()
+        try:
+            time.sleep(0.4)
+            assert det.hang_detected
+            assert hangs
+            det.report_normal()
+            assert not det.hang_detected
+        finally:
+            det.stop()
+
+    def test_no_false_positive_with_heartbeats(self):
+        det = HangingDetector(timeout_secs=0.3, check_interval_secs=0.05)
+        det.start()
+        try:
+            for _ in range(6):
+                det.report_normal()
+                time.sleep(0.05)
+            assert not det.hang_detected
+        finally:
+            det.stop()
+
+
+class TestErrorClassification:
+    def test_signatures(self):
+        assert classify_error("RESOURCE_EXHAUSTED: HBM OOM on chip 3") == \
+            NodeExitReason.OOM
+        assert classify_error("ICI link down on host 2") == \
+            NodeExitReason.HARDWARE_ERROR
+        assert classify_error("worker preempted by scheduler") == \
+            NodeExitReason.PREEMPTED
+        assert classify_error("ModuleNotFoundError: no module foo") == \
+            NodeExitReason.FATAL_ERROR
+        assert classify_error("something else entirely") == \
+            NodeExitReason.UNKNOWN_ERROR
+
+    def test_monitor_records_and_counts(self):
+        mon = ErrorLogMonitor(max_records=3)
+        for i in range(5):
+            mon.process_error(i % 2, 0, f"err {i}", "process")
+        assert len(mon.records) == 3
+        counts = mon.node_error_counts()
+        assert sum(counts.values()) == 3
+
+
+def _mlp_init(rng):
+    k1, k2 = jax.random.split(rng)
+    return {"w1": jax.random.normal(k1, (16, 32)) * 0.1,
+            "w2": jax.random.normal(k2, (32, 8)) * 0.1}
+
+
+def _mlp_loss(params, batch, rng):
+    h = jnp.tanh(batch["x"] @ params["w1"])
+    return jnp.mean((h @ params["w2"] - batch["y"]) ** 2), {}
+
+
+def _batch(n=32):
+    rng = np.random.default_rng(0)
+    return {"x": rng.normal(size=(n, 16)).astype(np.float32),
+            "y": rng.normal(size=(n, 8)).astype(np.float32)}
+
+
+class TestProfiler:
+    def test_cost_analysis_flops(self):
+        def matmul(a, b):
+            return a @ b
+
+        a = jnp.ones((128, 256))
+        b = jnp.ones((256, 64))
+        report = analyze_cost(matmul, a, b)
+        # 2*M*N*K FLOPs for the matmul; XLA may add small epsilon ops.
+        assert report.flops >= 2 * 128 * 256 * 64
+
+    def test_dryrun_profiles_train_step(self):
+        res = accelerate(
+            _mlp_init, _mlp_loss, optax.adam(1e-2), _batch(),
+            strategy=Strategy(mesh=MeshPlan(data=-1)),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        runner = DryRunner(warmup=1, steps=3)
+        prof = runner.profile(
+            res.train_step, state, res.shard_batch(_batch()),
+            jax.random.PRNGKey(1),
+        )
+        assert prof.steps_per_sec > 0
+        assert prof.param_count == count_params(state.params)
+        assert prof.flops_per_step > 0
+        assert 0 <= prof.mfu(1e15) < 1
+
+    def test_aprofiler_summary(self):
+        params = _mlp_init(jax.random.PRNGKey(0))
+        prof = AProfiler(params)
+        info = prof.summary(_mlp_loss, _batch(), jax.random.PRNGKey(0))
+        assert info["param_count"] == 16 * 32 + 32 * 8
+        assert info["forward_flops"] > 0
+        assert set(info["subtrees"]) == {"w1", "w2"}

@@ -202,6 +202,63 @@ class TestDistributedJobManager:
         mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt_node))
         assert not scaler.plans
 
+    def test_oom_bump_does_not_alias_group_resource(self):
+        mgr, _, _ = make_job_manager(node_num=2)
+        nodes = mgr.get_job_nodes(NodeType.WORKER)
+        base_mem = nodes[1].config_resource.memory
+        evt = Node(NodeType.WORKER, 0, status=NodeStatus.FAILED)
+        evt.exit_reason = NodeExitReason.OOM
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt))
+        # Only the OOMed node's resource doubled.
+        assert nodes[0].config_resource.memory == base_mem * 2
+        assert nodes[1].config_resource.memory == base_mem
+
+    def test_agent_classification_survives_watcher_exit_code(self):
+        # Agent reports an OOM traceback; process then exits 1 and the
+        # watcher would classify FATAL. The specific reason must win.
+        mgr, scaler, _ = make_job_manager()
+        node = mgr.get_job_nodes(NodeType.WORKER)[0]
+        base_mem = node.config_resource.memory
+        mgr.handle_training_failure(
+            0, 0, "RESOURCE_EXHAUSTED: HBM OOM while allocating", "process"
+        )
+        assert node.exit_reason == NodeExitReason.OOM
+        evt = Node(NodeType.WORKER, 0, status=NodeStatus.FAILED)
+        evt.exit_reason = NodeExitReason.FATAL_ERROR
+        mgr._process_event(NodeEvent(NodeEventType.MODIFIED, evt))
+        assert node.exit_reason == NodeExitReason.OOM
+        assert scaler.plans  # relaunched with the memory bump
+        assert node.config_resource.memory == base_mem * 2
+
+    def test_scale_plan_inherits_node_resource(self):
+        # Optimizer plans carry only a count; launched nodes must still
+        # request the job's per-node resource (chips/cpu/memory).
+        mgr, scaler, _ = make_job_manager(node_num=2)
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=4, node_resource=NodeResource()
+        )
+        mgr.execute_scale_plan(plan)
+        launched = scaler.plans[-1].launch_nodes
+        assert len(launched) == 2
+        assert all(n.config_resource.cpu > 0 for n in launched)
+
+    def test_hot_ps_migration_reaches_scaler(self):
+        mgr, scaler, _ = make_job_manager()
+        ps_nodes = {
+            0: Node(NodeType.PS, 0, name="jmtest-ps-0",
+                    status=NodeStatus.RUNNING)
+        }
+        mgr._job_nodes[NodeType.PS] = ps_nodes
+        from dlrover_tpu.master.node.ps import ParameterServerManager
+        mgr._ps_manager = ParameterServerManager(ps_nodes)
+        plan = ScalePlan()
+        plan.migrate_nodes["jmtest-ps-0"] = NodeResource(cpu=16, memory=32768)
+        mgr.execute_scale_plan(plan)
+        launched = scaler.plans[-1].launch_nodes
+        assert len(launched) == 1
+        assert launched[0].config_resource.cpu == 16
+
     def test_breakdown_report_relaunches_node(self):
         # An ICI network-check failure arrives as an agent report, not a
         # watcher event: the process is alive but the chip/link is bad.
