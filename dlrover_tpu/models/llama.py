@@ -228,17 +228,13 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
     )
 
 
-def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
-          rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
-    """Returns (logits [B, S, V] in f32, moe_aux_loss scalar)."""
-    c = config
-    b, s = input_ids.shape
-    x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
-    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+def _decoder_block(c: LlamaConfig):
+    """Scan body over stacked layer params; shared by the plain and the
+    pipelined forward so the two cannot drift."""
 
     def block(carry, layer_params):
         x, block_rng = carry
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
         block_rng, ffn_rng = jax.random.split(block_rng)
         attn_in = _rms_norm(x, layer_params["input_norm"]["scale"], c.rms_eps)
         x = x + _attention_block(attn_in, layer_params, c, positions)
@@ -246,11 +242,71 @@ def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
         ffn_out, aux = _ffn_block(ffn_in, layer_params, c, ffn_rng)
         return (x + ffn_out, block_rng), aux
 
-    block = apply_remat(block, c.remat_policy)
+    return block
+
+
+def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
+          rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Returns (logits [B, S, V] in f32, moe_aux_loss scalar)."""
+    c = config
+    x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    block = apply_remat(_decoder_block(c), c.remat_policy)
     (x, _), aux_losses = lax.scan(block, (x, rng), params["layers"])
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
     logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
     return logits.astype(jnp.float32), jnp.sum(aux_losses)
+
+
+def apply_pipelined(
+    params: Dict,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    num_stages: int,
+    num_microbatches: int,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass with the decoder blocks run as a GPipe pipeline over
+    the "pipe" mesh axis (``parallel.pipeline``); embed/final-norm/head
+    stay outside the pipeline in the surrounding GSPMD program.
+
+    Equivalent to ``apply`` up to bf16 rounding for dense configs. For
+    MoE configs the math intentionally differs: expert capacity is
+    computed per *microbatch* (B/M tokens) rather than per batch, and
+    each stage restarts the rng chain, so routing overflow/jitter
+    decisions are not bit-identical to ``apply``. Use with the
+    "llama_pp" rule set so the stacked layer dim lands on "pipe".
+    """
+    from dlrover_tpu.parallel.pipeline import (
+        merge_microbatches,
+        pipeline_apply,
+        split_microbatches,
+        stack_stages,
+    )
+
+    c = config
+    x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def stage_fn(layers_chunk, state):
+        x, aux = state
+        block = apply_remat(_decoder_block(c), c.remat_policy)
+        (x, _), auxs = lax.scan(block, (x, rng), layers_chunk)
+        return (x, aux + jnp.sum(auxs))
+
+    stage_params = stack_stages(params["layers"], num_stages)
+    x_mb = split_microbatches(x, num_microbatches)
+    aux_mb = jnp.zeros((num_microbatches,), jnp.float32)
+    out_mb, aux_out = pipeline_apply(
+        stage_fn, stage_params, (x_mb, aux_mb)
+    )
+    x = merge_microbatches(out_mb)
+    aux = jnp.sum(aux_out)
+
+    x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
+    logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
+    return logits.astype(jnp.float32), aux
 
 
 # -- training glue ----------------------------------------------------------

@@ -127,7 +127,10 @@ def ring_attention(
     Composes with the surrounding GSPMD program: batch stays sharded on the
     data axes, heads on the tensor axis, sequence on the ring axis.
     """
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
 
     spec = P(batch_axes, head_axis, axis_name, None)
     fn = shard_map(

@@ -181,17 +181,39 @@ def accelerate(
         loss, aux = loss_fn(state.params, batch, jax.random.PRNGKey(0))
         return {"loss": loss, **aux}
 
-    jit_train_step = jax.jit(
+    def _under_mesh(fn):
+        """Trace under a mesh context so in-model sharding constraints
+        (pipeline stages, manual annotations) resolve against our mesh."""
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            try:
+                ctx = jax.sharding.set_mesh(mesh)
+            except ValueError:
+                # already inside a trace (e.g. eval_shape over init_fn):
+                # the caller's mesh context governs
+                return fn(*args, **kwargs)
+            with ctx:
+                return fn(*args, **kwargs)
+
+        if hasattr(fn, "lower"):
+            def lower(*args, **kwargs):
+                with jax.sharding.set_mesh(mesh):
+                    return fn.lower(*args, **kwargs)
+
+            wrapped.lower = lower
+        return wrapped
+
+    jit_train_step = _under_mesh(jax.jit(
         train_step,
         in_shardings=(state_sharding, batch_spec, replicated),
         out_shardings=(state_sharding, replicated),
         donate_argnums=(0,),
-    )
-    jit_eval_step = jax.jit(
+    ))
+    jit_eval_step = _under_mesh(jax.jit(
         eval_step,
         in_shardings=(state_sharding, batch_spec),
         out_shardings=replicated,
-    )
+    ))
 
     logger.info(
         "accelerate: mesh=%s accum=%d rules=%s remat=%s",
@@ -201,7 +223,7 @@ def accelerate(
     return AccelerateResult(
         train_step=jit_train_step,
         eval_step=jit_eval_step,
-        init_fn=sharded_init,
+        init_fn=_under_mesh(sharded_init),
         mesh=mesh,
         state_sharding=state_sharding,
         batch_spec=batch_spec,

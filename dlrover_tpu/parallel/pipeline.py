@@ -1,0 +1,183 @@
+"""GPipe-style pipeline parallelism over the "pipe" mesh axis.
+
+Role parity: atorch's PiPPy compiler stack (``atorch/atorch/modules/
+distributed_modules/compilers/pipe_compiler/distributed_pippy_compiler.py:90-378``
+— FX graph split into stages, torch RPC drivers, interleaver). The TPU
+formulation needs none of that machinery: stages are a *leading array
+dimension* sharded on the "pipe" mesh axis, the whole schedule is a
+``lax.scan`` over pipeline ticks, and the per-tick shift of activations to
+the next stage (``jnp.roll`` over the stage dim) lowers to an XLA
+collective-permute over ICI/DCN. Because this is plain GSPMD (no manual
+``shard_map``), it composes freely with the data/fsdp/seq/tensor axes —
+tensor-parallel matmuls inside a stage still get their collectives from
+the partitioner.
+
+Schedule: GPipe. With M microbatches and P stages the bubble fraction is
+(P-1)/(M+P-1); backward runs the reverse schedule automatically because
+``jax.grad`` transposes the scan and the collective-permute.
+
+Contract: ``stage_fn(stage_params, state) -> state`` must be
+shape/dtype-preserving on ``state`` (homogeneous stages — the transformer
+block case); heterogeneous embed/head layers stay *outside* the pipeline
+in the surrounding GSPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def _context_has_axis(axis_name: str) -> bool:
+    """Sharding constraints only resolve under a mesh context
+    (``jax.sharding.set_mesh``); skip them when running unsharded."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return False
+    return axis_name in getattr(mesh, "axis_names", ())
+
+
+def split_microbatches(tree: PyTree, num_microbatches: int) -> PyTree:
+    """[B, ...] leaves -> [M, B/M, ...] microbatch-stacked leaves."""
+
+    def split(x):
+        b = x.shape[0]
+        if b % num_microbatches:
+            raise ValueError(
+                f"batch of {b} rows not divisible into "
+                f"{num_microbatches} microbatches"
+            )
+        return x.reshape((num_microbatches, b // num_microbatches)
+                         + x.shape[1:])
+
+    return jax.tree.map(split, tree)
+
+
+def merge_microbatches(tree: PyTree) -> PyTree:
+    """[M, mb, ...] -> [M*mb, ...] (inverse of split_microbatches)."""
+    return jax.tree.map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]), tree
+    )
+
+
+def _stage_constraint(tree: PyTree, axis_name: str,
+                      batch_axes: Optional[Tuple]) -> PyTree:
+    """Pin the leading (stage) dim of every leaf on the pipe axis and the
+    microbatch dim on the data axes, leaving trailing dims to XLA."""
+    from jax.sharding import PartitionSpec as P
+
+    unconstrained = P.UNCONSTRAINED
+
+    def constrain(x):
+        spec = [axis_name]
+        if x.ndim > 1:
+            spec.append(batch_axes)
+        spec.extend(unconstrained for _ in range(x.ndim - len(spec)))
+        return lax.with_sharding_constraint(x, P(*spec))
+
+    return jax.tree.map(constrain, tree)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[PyTree, PyTree], PyTree],
+    stage_params: PyTree,  # leaves [num_stages, ...], pipe-sharded on dim 0
+    x_mb: PyTree,  # microbatch-stacked inputs, leaves [M, ...]
+    axis_name: str = "pipe",
+    batch_axes: Optional[Tuple] = ("data", "fsdp"),
+    constrain: bool = True,
+) -> PyTree:
+    """Run M microbatches through P homogeneous stages; returns outputs
+    with the same [M, ...] layout as ``x_mb``.
+
+    ``stage_fn`` sees one stage's params (dim 0 of ``stage_params``
+    stripped by vmap) and one microbatch-shaped ``state``.
+    """
+    stage_leaves = jax.tree.leaves(stage_params)
+    if not stage_leaves:
+        raise ValueError("stage_params is empty")
+    num_stages = stage_leaves[0].shape[0]
+    constrain = constrain and _context_has_axis(axis_name)
+    if constrain:
+        from jax.sharding import PartitionSpec as P
+
+        stage_params = jax.tree.map(
+            lambda w: lax.with_sharding_constraint(
+                w,
+                P(axis_name, *(P.UNCONSTRAINED for _ in range(w.ndim - 1))),
+            ),
+            stage_params,
+        )
+    x_leaves = jax.tree.leaves(x_mb)
+    num_mb = x_leaves[0].shape[0]
+    num_ticks = num_mb + num_stages - 1
+
+    vstage = jax.vmap(stage_fn)
+
+    def maybe_constrain(tree):
+        if not constrain:
+            return tree
+        return _stage_constraint(tree, axis_name, batch_axes)
+
+    # state: one in-flight microbatch per stage, [P, mb, ...]
+    state0 = jax.tree.map(
+        lambda x: jnp.zeros((num_stages,) + x.shape[1:], x.dtype), x_mb
+    )
+    outs0 = jax.tree.map(jnp.zeros_like, x_mb)
+
+    def tick(carry, t):
+        state, outs = carry
+        # feed the next microbatch into stage 0 (garbage during drain)
+        inp = jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, num_mb - 1), 0, keepdims=False
+            ),
+            x_mb,
+        )
+        state = jax.tree.map(
+            lambda s, i: lax.dynamic_update_index_in_dim(s, i, 0, 0),
+            state, inp,
+        )
+        state = maybe_constrain(state)
+        y = vstage(stage_params, state)
+        y = maybe_constrain(y)
+        # stage P-1 finished microbatch t-(P-1): collect it
+        out_idx = t - (num_stages - 1)
+        valid = jnp.logical_and(out_idx >= 0, out_idx < num_mb)
+        idx = jnp.clip(out_idx, 0, num_mb - 1)
+        outs = jax.tree.map(
+            lambda o, yy: jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(o, yy[-1], idx, 0),
+                o,
+            ),
+            outs, y,
+        )
+        # shift every stage's output to its successor: one collective
+        # permute around the pipe ring (slot 0 is overwritten next tick)
+        state = jax.tree.map(lambda yy: jnp.roll(yy, 1, axis=0), y)
+        return (state, outs), None
+
+    (_, outs), _ = lax.scan(
+        tick, (state0, outs0), jnp.arange(num_ticks)
+    )
+    return outs
+
+
+def stack_stages(layer_params: PyTree, num_stages: int) -> PyTree:
+    """[L, ...] scan-stacked layer params -> [P, L/P, ...] stage chunks."""
+
+    def restack(x):
+        layers = x.shape[0]
+        if layers % num_stages:
+            raise ValueError(
+                f"{layers} layers not divisible into {num_stages} stages"
+            )
+        return x.reshape((num_stages, layers // num_stages) + x.shape[1:])
+
+    return jax.tree.map(restack, layer_params)

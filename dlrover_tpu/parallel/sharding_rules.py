@@ -182,6 +182,30 @@ def llama_rules() -> ShardingRules:
     ])
 
 
+def llama_pp_rules() -> ShardingRules:
+    """Pipeline-parallel llama: the stacked layer dim lands on "pipe" so
+    each pipeline stage holds its contiguous chunk of layers
+    (``parallel.pipeline`` reshapes [L, ...] -> [P, L/P, ...] in-program,
+    a local reshape since L is pipe-sharded). TP stays on "tensor"."""
+    return ShardingRules(rules=[
+        (r"layers/.*(q_proj|k_proj|v_proj)/kernel$",
+         ("pipe", None, "tensor")),
+        (r"layers/.*o_proj/kernel$", ("pipe", "tensor", None)),
+        (r"layers/.*(gate_proj|up_proj)/kernel$", ("pipe", None, "tensor")),
+        (r"layers/.*down_proj/kernel$", ("pipe", "tensor", None)),
+        (r"layers/.*experts/up/kernel$",
+         ("pipe", ("data", "fsdp"), None, "tensor")),
+        (r"layers/.*experts/down/kernel$",
+         ("pipe", ("data", "fsdp"), "tensor", None)),
+        (r"layers/.*router/kernel$", ("pipe", None, None)),
+        (r"layers/.*(input_norm|post_norm)/scale$", ("pipe", None)),
+        (r"embed_tokens/embedding$", ("tensor", "fsdp")),
+        (r"lm_head/kernel$", ("fsdp", "tensor")),
+        (r"(norm|ln)[^/]*/(scale|bias)$", REPLICATED),
+        (r".*", FSDP_AUTO),
+    ])
+
+
 def moe_rules() -> ShardingRules:
     """Expert-parallel MoE: expert weight blocks sharded on the expert
     (data x fsdp) submesh; router replicated."""

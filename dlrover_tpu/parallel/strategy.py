@@ -24,6 +24,7 @@ from typing import Optional
 from dlrover_tpu.parallel.mesh import MeshPlan
 from dlrover_tpu.parallel.sharding_rules import (
     ShardingRules,
+    llama_pp_rules,
     llama_rules,
     moe_rules,
 )
@@ -31,6 +32,7 @@ from dlrover_tpu.parallel.sharding_rules import (
 RULE_SETS = {
     "fsdp": lambda: ShardingRules(),
     "llama": llama_rules,
+    "llama_pp": llama_pp_rules,
     "moe": moe_rules,
 }
 
