@@ -1,0 +1,155 @@
+"""Python face of the C++ shared-memory batch ring.
+
+Role parity: ``atorch/atorch/data/shm_context.py`` (shared-memory batch
+transport between coworker preprocessing processes and trainers). Batches
+are pytrees of numpy arrays; serialization is a tiny self-describing
+header + raw array bytes (no pickle on the hot path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno
+import json
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.native import load_library
+
+_HEADER_FMT = "<Q"  # meta-json byte length
+
+
+class RingClosed(Exception):
+    """Producer closed the stream and every slot has been drained."""
+
+
+class RingTimeout(Exception):
+    pass
+
+
+def _pack_batch(batch: Dict[str, np.ndarray]) -> bytes:
+    """header(json meta) + concatenated C-contiguous array payloads."""
+    meta: List[Dict[str, Any]] = []
+    payloads: List[bytes] = []
+    for key in sorted(batch):
+        arr = np.ascontiguousarray(batch[key])
+        meta.append(
+            {"key": key, "dtype": arr.dtype.str, "shape": list(arr.shape)}
+        )
+        payloads.append(arr.tobytes())
+    meta_bytes = json.dumps(meta).encode()
+    return b"".join(
+        [struct.pack(_HEADER_FMT, len(meta_bytes)), meta_bytes, *payloads]
+    )
+
+
+def _unpack_batch(buf: memoryview) -> Dict[str, np.ndarray]:
+    (meta_len,) = struct.unpack_from(_HEADER_FMT, buf, 0)
+    offset = struct.calcsize(_HEADER_FMT)
+    meta = json.loads(bytes(buf[offset:offset + meta_len]))
+    offset += meta_len
+    out = {}
+    for entry in meta:
+        dtype = np.dtype(entry["dtype"])
+        shape = tuple(entry["shape"])
+        nbytes = dtype.itemsize * int(np.prod(shape)) if shape else (
+            dtype.itemsize
+        )
+        arr = np.frombuffer(
+            buf[offset:offset + nbytes], dtype=dtype
+        ).reshape(shape)
+        out[entry["key"]] = arr.copy()  # own the memory; slot gets reused
+        offset += nbytes
+    return out
+
+
+class ShmBatchRing:
+    """Create with ``owner=True`` in one process, ``attach`` elsewhere."""
+
+    def __init__(self, name: str, slot_bytes: int = 1 << 22,
+                 n_slots: int = 8, owner: bool = True):
+        self._lib = load_library()
+        self.name = name
+        self.owner = owner
+        if owner:
+            handle = self._lib.shm_ring_create(
+                name.encode(), slot_bytes, n_slots
+            )
+        else:
+            handle = self._lib.shm_ring_attach(name.encode())
+        if not handle:
+            raise OSError(f"shm ring {name!r} unavailable "
+                          f"(owner={owner})")
+        self._handle = ctypes.c_void_p(handle)
+        # the control block is authoritative (an attacher's guess at the
+        # creator's slot size would livelock pop on a bigger payload)
+        self._slot_bytes = int(self._lib.shm_ring_slot_size(self._handle))
+        self._scratch = (ctypes.c_uint8 * self._slot_bytes)()
+        self._pop_lock = threading.Lock()  # _scratch is shared per handle
+
+    @classmethod
+    def attach(cls, name: str, slot_bytes: int = 0) -> "ShmBatchRing":
+        """slot size is read from the segment; the arg is ignored and kept
+        for signature compatibility."""
+        del slot_bytes
+        return cls(name, owner=False)
+
+    def put(self, batch: Dict[str, np.ndarray],
+            timeout: float = 60.0) -> None:
+        blob = _pack_batch(batch)
+        if len(blob) > self._slot_bytes:
+            raise ValueError(
+                f"batch of {len(blob)} bytes exceeds slot size "
+                f"{self._slot_bytes}"
+            )
+        # borrow the bytes object directly (the C side memcpys, never
+        # mutates) — avoids a second full copy of the payload
+        buf = ctypes.cast(ctypes.c_char_p(blob),
+                          ctypes.POINTER(ctypes.c_uint8))
+        rc = self._lib.shm_ring_push(
+            self._handle, buf, len(blob), int(timeout * 1000)
+        )
+        if rc == errno.ETIMEDOUT:
+            raise RingTimeout(f"put timed out after {timeout}s")
+        if rc == errno.EPIPE:
+            raise RingClosed("ring closed")
+        if rc:
+            raise OSError(f"shm_ring_push failed: errno {rc}")
+
+    def get(self, timeout: float = 60.0) -> Dict[str, np.ndarray]:
+        with self._pop_lock:
+            n = self._lib.shm_ring_pop(
+                self._handle, self._scratch, self._slot_bytes,
+                int(timeout * 1000),
+            )
+            if n == -errno.ETIMEDOUT:
+                raise RingTimeout(f"get timed out after {timeout}s")
+            if n == -errno.EPIPE:
+                raise RingClosed("ring closed and drained")
+            if n < 0:
+                raise OSError(f"shm_ring_pop failed: errno {-n}")
+            return _unpack_batch(memoryview(self._scratch)[:n])
+
+    def qsize(self) -> int:
+        return max(0, self._lib.shm_ring_size(self._handle))
+
+    def close(self) -> None:
+        """Signal end-of-stream (consumers drain, then see RingClosed)."""
+        if self._handle:
+            self._lib.shm_ring_close(self._handle)
+
+    def free(self) -> None:
+        """Unmap (and unlink, if owner) the segment."""
+        if self._handle:
+            self._lib.shm_ring_free(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        self.free()

@@ -1,0 +1,159 @@
+"""Coworker data pipeline: CPU preprocessing processes feeding trainers
+through the native shared-memory ring.
+
+Role parity: ``atorch/atorch/data/shm_dataloader.py:38-220``
+(``ShmDataloader``) + the coworker machinery in
+``atorch/atorch/distributed/distributed.py:41-205``: dedicated CPU
+processes run the user's preprocessing and publish ready batches into
+shared memory; the trainer process never spends Python time building
+batches. Transport is ``native/src/shm_ring.cc`` (C++, process-shared
+mutex ring), so the per-batch cost in the trainer is one memcpy.
+
+Also plays the ``GpuPreLoader`` role (``data/preloader.py:8``): on TPU
+the host->device overlap comes from ``jax.device_put`` on the *next*
+batch while the current step runs (device_put is async under jit).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.native.shm_ring import (
+    RingClosed,
+    RingTimeout,
+    ShmBatchRing,
+)
+
+logger = get_logger("trainer.shm")
+
+
+_DONE_KEY = "__shm_producer_done__"
+
+
+def _producer_main(ring_name: str, slot_bytes: int, produce_fn,
+                   worker_rank: int, num_workers: int):
+    """Runs in a coworker process: produce_fn yields numpy-dict batches."""
+    ring = ShmBatchRing.attach(ring_name, slot_bytes=slot_bytes)
+    try:
+        for batch in produce_fn(worker_rank, num_workers):
+            ring.put(batch)
+        # end-of-stream sentinel: the consumer closes the ring once every
+        # producer has reported done (closing here would cut off slower
+        # sibling producers)
+        ring.put({_DONE_KEY: np.array([worker_rank], np.int32)})
+    except (RingClosed, RingTimeout):
+        pass  # consumer went away; exit quietly
+
+
+class ShmDataLoader:
+    """Iterator over batches produced by ``num_workers`` coworker
+    processes.
+
+    ``produce_fn(worker_rank, num_workers)`` must be a picklable callable
+    yielding dict-of-numpy batches; workers partition the work by rank
+    (same contract as torch DataLoader worker sharding).
+    """
+
+    def __init__(
+        self,
+        produce_fn: Callable[[int, int], Iterator[Dict[str, np.ndarray]]],
+        num_workers: int = 1,
+        slot_bytes: int = 1 << 22,
+        n_slots: int = 8,
+        name: Optional[str] = None,
+        timeout: float = 120.0,
+    ):
+        self._timeout = timeout
+        self.name = name or f"/dlrover_shm_{os.getpid()}_{id(self) & 0xffff}"
+        self._ring = ShmBatchRing(
+            self.name, slot_bytes=slot_bytes, n_slots=n_slots, owner=True
+        )
+        ctx = mp.get_context("spawn")
+        self._workers = [
+            ctx.Process(
+                target=_producer_main,
+                args=(self.name, slot_bytes, produce_fn, rank, num_workers),
+                daemon=True,
+            )
+            for rank in range(num_workers)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        done = 0
+        while True:
+            try:
+                batch = self._ring.get(timeout=self._timeout)
+            except RingClosed:
+                return
+            except RingTimeout:
+                if not any(w.is_alive() for w in self._workers):
+                    logger.warning(
+                        "all shm producers died; ending stream"
+                    )
+                    return
+                raise
+            if _DONE_KEY in batch:
+                done += 1
+                if done == len(self._workers):
+                    self._ring.close()
+                    return
+                continue
+            yield batch
+
+    def qsize(self) -> int:
+        return self._ring.qsize()
+
+    def shutdown(self):
+        self._ring.close()
+        for w in self._workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+        self._ring.free()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+class DevicePrefetcher:
+    """Overlap host->device transfer with compute: keeps ``depth`` batches
+    in flight via ``jax.device_put`` (async) on a background thread."""
+
+    def __init__(self, batches: Iterator[Any], put_fn: Callable[[Any], Any],
+                 depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._done = object()
+        self._error: Optional[BaseException] = None
+
+        def pump():
+            try:
+                for b in batches:
+                    self._q.put(put_fn(b))
+            except BaseException as e:  # surface in the consumer, not lost
+                self._error = e
+            finally:
+                self._q.put(self._done)
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._done:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item

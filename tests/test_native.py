@@ -1,0 +1,154 @@
+"""Native C++ runtime: build, shm ring transport, host ops.
+
+Parity targets: tfplus scaffold (`tfplus/tfplus/cc/demo.cc` loaded via
+`python/demo.py`), atorch shm transport (`atorch/atorch/data/
+shm_context.py`, `shm_dataloader.py`).
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+class TestBuild:
+    def test_library_builds_and_loads(self):
+        from dlrover_tpu.native import load_library
+
+        lib = load_library()
+        assert lib.shm_ring_create is not None
+
+
+class TestHostOps:
+    def test_pack_sequences_matches_fallback(self):
+        from dlrover_tpu.native.host_ops import pack_sequences
+
+        tokens = np.arange(20, dtype=np.int32)
+        offsets = np.array([0, 3, 10, 20], dtype=np.int64)
+        ids_n, mask_n = pack_sequences(tokens, offsets, 8, pad_id=-1)
+        ids_p, mask_p = pack_sequences(
+            tokens, offsets, 8, pad_id=-1, use_native=False
+        )
+        np.testing.assert_array_equal(ids_n, ids_p)
+        np.testing.assert_array_equal(mask_n, mask_p)
+        # seq 2 has 10 tokens -> truncated to 8
+        np.testing.assert_array_equal(ids_n[2], np.arange(10, 18))
+
+    def test_shuffle_native_matches_fallback_and_is_permutation(self):
+        from dlrover_tpu.native.host_ops import shuffle_indices
+
+        got = shuffle_indices(100, seed=42)
+        ref = shuffle_indices(100, seed=42, use_native=False)
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(np.sort(got), np.arange(100))
+        assert not np.array_equal(got, np.arange(100))
+
+    def test_shift_labels(self):
+        from dlrover_tpu.native.host_ops import shift_labels
+
+        ids = np.array([[1, 2, 3, 0]], np.int32)
+        mask = np.array([[1, 1, 1, 0]], np.int32)
+        labels = shift_labels(ids, mask)
+        np.testing.assert_array_equal(labels, [[2, 3, -100, -100]])
+        ref = shift_labels(ids, mask, use_native=False)
+        np.testing.assert_array_equal(labels, ref)
+
+
+class TestShmRing:
+    def test_roundtrip_same_process(self):
+        from dlrover_tpu.native.shm_ring import ShmBatchRing
+
+        name = f"/dlrover_test_{os.getpid()}_rt"
+        with ShmBatchRing(name, slot_bytes=1 << 16, n_slots=4) as ring:
+            batch = {
+                "x": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "y": np.array([1, 2, 3], np.int64),
+            }
+            ring.put(batch)
+            assert ring.qsize() == 1
+            got = ring.get()
+            np.testing.assert_array_equal(got["x"], batch["x"])
+            np.testing.assert_array_equal(got["y"], batch["y"])
+
+    def test_oversized_batch_rejected(self):
+        from dlrover_tpu.native.shm_ring import ShmBatchRing
+
+        name = f"/dlrover_test_{os.getpid()}_big"
+        with ShmBatchRing(name, slot_bytes=256, n_slots=2) as ring:
+            with pytest.raises(ValueError):
+                ring.put({"x": np.zeros(1000, np.float32)})
+
+    def test_close_unblocks_consumer(self):
+        from dlrover_tpu.native.shm_ring import RingClosed, ShmBatchRing
+
+        name = f"/dlrover_test_{os.getpid()}_close"
+        with ShmBatchRing(name, slot_bytes=1 << 12, n_slots=2) as ring:
+            ring.put({"x": np.ones(2, np.float32)})
+            ring.close()
+            ring.get()  # drains the queued batch
+            with pytest.raises(RingClosed):
+                ring.get(timeout=5)
+
+    def test_cross_process_transport(self):
+        from dlrover_tpu.native.shm_ring import RingClosed, ShmBatchRing
+
+        name = f"/dlrover_test_{os.getpid()}_xp"
+        ring = ShmBatchRing(name, slot_bytes=1 << 16, n_slots=4)
+        try:
+            ctx = mp.get_context("spawn")
+            proc = ctx.Process(
+                target=_producer_entry, args=(name, 5), daemon=True
+            )
+            proc.start()
+            got = []
+            while True:
+                try:
+                    got.append(ring.get(timeout=30))
+                except RingClosed:
+                    break
+            proc.join(timeout=10)
+            assert len(got) == 5
+            for i, b in enumerate(got):
+                np.testing.assert_array_equal(
+                    b["step"], np.full((4,), i, np.int32)
+                )
+        finally:
+            ring.free()
+
+
+def _producer_entry(name: str, n: int):
+    from dlrover_tpu.native.shm_ring import ShmBatchRing
+
+    ring = ShmBatchRing.attach(name, slot_bytes=1 << 16)
+    for i in range(n):
+        ring.put({"step": np.full((4,), i, np.int32)})
+    ring.close()
+
+
+class TestShmDataLoader:
+    def test_end_to_end_with_coworkers(self):
+        from dlrover_tpu.trainer.shm_dataloader import ShmDataLoader
+
+        with ShmDataLoader(_range_producer, num_workers=2,
+                           slot_bytes=1 << 16, n_slots=4) as loader:
+            batches = list(loader)
+        seen = sorted(int(b["value"][0]) for b in batches)
+        assert seen == list(range(8))
+
+    def test_prefetcher_preserves_order(self):
+        from dlrover_tpu.trainer.shm_dataloader import DevicePrefetcher
+
+        out = list(DevicePrefetcher(iter(range(10)), lambda x: x * 2))
+        assert out == [x * 2 for x in range(10)]
+
+
+def _range_producer(worker_rank: int, num_workers: int):
+    for i in range(worker_rank, 8, num_workers):
+        yield {"value": np.full((2,), i, np.int32)}
