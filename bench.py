@@ -10,6 +10,9 @@ Env knobs:
   BENCH_PLATFORM=cpu     run the benchmark logic on CPU (smoke test)
   BENCH_STEPS=N          timed steps (default 10)
   BENCH_PRESET=tiny|1b   model size (default: fit to the chip)
+  BENCH_BATCH=N          batch rows for the TPU preset (default 4)
+  BENCH_REMAT=policy     per-layer remat policy (default dots_saveable)
+  BENCH_FLASH=0|1        Pallas flash kernel on/off (default 1)
 """
 
 from __future__ import annotations
@@ -62,10 +65,10 @@ def _pick_config(platform: str, preset: str):
         max_seq_len=2048,
         param_dtype=jnp.bfloat16,
         compute_dtype=jnp.bfloat16,
-        remat_policy="dots_saveable",
-        use_flash=True,
+        remat_policy=os.environ.get("BENCH_REMAT", "dots_saveable"),
+        use_flash=os.environ.get("BENCH_FLASH", "1") == "1",
     )
-    return cfg, 4, 2048
+    return cfg, int(os.environ.get("BENCH_BATCH", "4")), 2048
 
 
 def main() -> int:
@@ -117,7 +120,9 @@ def main() -> int:
         strategy=Strategy(
             mesh=MeshPlan(data=1, fsdp=n_dev),
             rule_set="llama",
-            remat_policy=config.remat_policy,
+            # the model already applies per-layer remat (config.remat_policy
+            # inside the scan); wrapping the loss again would double-remat
+            remat_policy="",
         ),
         devices=devices,
     )
@@ -126,6 +131,10 @@ def main() -> int:
 
     t0 = time.time()
     state, metrics = result.train_step(state, sharded, jax.random.PRNGKey(0))
+    # device_get of a value that depends on the whole step is the only
+    # reliable sync point: on tunneled platforms block_until_ready can
+    # return before the remote executable has finished
+    jax.device_get(metrics["loss"])
     jax.block_until_ready(state)
     compile_and_first_step = time.time() - t0
 
@@ -134,6 +143,9 @@ def main() -> int:
         state, metrics = result.train_step(
             state, sharded, jax.random.PRNGKey(i + 1)
         )
+    # the state dependency chain makes the last step's loss transitively
+    # depend on every timed step
+    jax.device_get(metrics["loss"])
     jax.block_until_ready(state)
     step_time = (time.time() - t0) / steps
 

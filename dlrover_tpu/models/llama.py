@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from dlrover_tpu.models.losses import masked_lm_loss
 from dlrover_tpu.ops import moe as moe_ops
@@ -199,6 +200,7 @@ def _attention_block(x, layer, config: LlamaConfig, positions):
         out = flash_attention(q, k, v, True)
     else:
         out = mha_reference(q, k, v, causal=True)
+    out = checkpoint_name(out, "attn_out")
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
     return out @ layer["o_proj"]["kernel"]
 

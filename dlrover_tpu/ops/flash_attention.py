@@ -9,9 +9,11 @@ only in registers/VMEM, and softmax is computed online (running max +
 normalizer in VMEM scratch carried across the K grid dimension), so HBM
 traffic is O(S*D) instead of O(S^2).
 
-Forward is a Pallas kernel; backward recomputes attention blockwise via the
-same online-softmax scheme expressed in XLA ops (no O(S^2) residuals are
-saved — ``jax.checkpoint``-friendly). Long-context scaling across chips is
+Forward and backward are Pallas kernels (FlashAttention-2 style: a dKV
+pass with k blocks outer / q blocks inner, and a dQ pass with q outer / k
+inner), recomputing probability tiles from the saved logsumexp — no
+O(S^2) residuals are ever materialized. All MXU dots run on the storage
+dtype (bf16) with f32 accumulation. Long-context scaling across chips is
 handled one level up by ``ops.ring_attention``.
 """
 
@@ -30,9 +32,19 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 LANES = 128
 
 
+def _fit_block(requested: int, dim: int) -> int:
+    """Largest divisor of ``dim`` that is <= ``requested`` — block sizes
+    must tile the sequence exactly, but callers shouldn't have to match
+    the defaults to their sequence length."""
+    b = min(requested, dim)
+    while dim % b:
+        b -= 1
+    return b
+
+
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref,  # [1, 1, Bq|Bk, D] VMEM blocks
-    o_ref, lse_ref,  # [1, 1, Bq, D], [1, 1, Bq]
+    o_ref, lse_ref,  # [1, 1, Bq, D], [1, 1, 1, Bq]
     m_scratch, l_scratch, acc_scratch,  # VMEM carries across the k grid dim
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
@@ -53,13 +65,15 @@ def _flash_fwd_kernel(
 
     @pl.when(block_needed)
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        k = k_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        # inputs stay in their storage dtype (bf16) so the MXU runs at
+        # full rate; only the accumulators are f32
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [Bq, Bk]
+        ) * scale  # [Bq, Bk] f32
 
         if causal:
             rows = jax.lax.broadcasted_iota(
@@ -79,7 +93,7 @@ def _flash_fwd_kernel(
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
 
         acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
@@ -93,7 +107,7 @@ def _flash_fwd_kernel(
         o_ref[0, 0, :, :] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
         # logsumexp residual for the blockwise backward pass
         lse = m + jnp.log(l_safe)
-        lse_ref[0, 0, :] = jnp.broadcast_to(lse[:, 0], lse_ref.shape[2:])
+        lse_ref[0, 0, 0, :] = lse[:, 0]
 
 
 def _flash_forward(
@@ -107,13 +121,8 @@ def _flash_forward(
             f"causal flash attention requires s_q == s_k (got {s_q} vs "
             f"{s_k}); use causal=False for cross attention"
         )
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
-    if s_q % block_q or s_k % block_k:
-        raise ValueError(
-            f"sequence lengths ({s_q}, {s_k}) must be divisible by blocks "
-            f"({block_q}, {block_k})"
-        )
+    block_q = _fit_block(block_q, s_q)
+    block_k = _fit_block(block_k, s_k)
     grid = (batch, heads, s_q // block_q, s_k // block_k)
 
     kernel = functools.partial(
@@ -134,12 +143,14 @@ def _flash_forward(
         out_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim),
                          lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, h, i, j: (b, h, i)),
+            # [B, H, 1, Sq] so the last-two block dims (1, block_q) satisfy
+            # the TPU (8, 128) tiling rule; squeezed after the call
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, heads, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, 1, s_q), jnp.float32),
         ],
         scratch_shapes=[
             _vmem((block_q, LANES)),  # running max m
@@ -165,8 +176,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Memory-efficient attention; differentiable (blockwise recompute
@@ -191,62 +202,202 @@ def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k,
         q, k, v, scale=scale_v, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interp,
     )
+    lse = lse.reshape(q.shape[0], q.shape[1], q.shape[2])
     return out, (q, k, v, out, lse)
+
+
+def _recompute_p(q, k, lse, *, scale, causal, i, j, block_q, block_k):
+    """Recompute the [Bq, Bk] probability tile from (q, k, lse): exact
+    probs p = exp(q k^T * scale - lse) with causal masking re-applied."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [Bq, Bk] f32
+    if causal:
+        rows = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        ) + i * block_q
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        ) + j * block_k
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return jnp.exp(s - lse[:, None])
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # VMEM blocks
+    dk_ref, dv_ref,
+    dk_scratch, dv_scratch,  # f32 carries across the q grid dim
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    j = pl.program_id(2)  # k block index
+    i = pl.program_id(3)  # q block index (innermost, sequential)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    # with causal masking, q blocks strictly above the k block's diagonal
+    # see none of these keys
+    block_needed = jnp.logical_or(
+        jnp.logical_not(causal), i * block_q + block_q - 1 >= j * block_k
+    )
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, 0, :]  # [Bq]
+        delta = delta_ref[0, 0, 0, :]  # [Bq]
+        p = _recompute_p(q, k, lse, scale=scale, causal=causal,
+                            i=i, j=j, block_q=block_q, block_k=block_k)
+        p_lo = p.astype(do.dtype)
+        # dv += p^T do  : contract over the q rows
+        dv_scratch[:] = dv_scratch[:] + jax.lax.dot_general(
+            p_lo, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dp = do v^T  : [Bq, Bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        # dk += ds^T q
+        dk_scratch[:] = dk_scratch[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    dq_scratch,  # f32 carry across the k grid dim
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    i = pl.program_id(2)  # q block index
+    j = pl.program_id(3)  # k block index (innermost, sequential)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    block_needed = jnp.logical_or(
+        jnp.logical_not(causal), j * block_k <= i * block_q + block_q - 1
+    )
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, 0, :]
+        delta = delta_ref[0, 0, 0, :]
+        p = _recompute_p(q, k, lse, scale=scale, causal=causal,
+                            i=i, j=j, block_q=block_q, block_k=block_k)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        # dq += ds k
+        dq_scratch[:] = dq_scratch[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scratch[:].astype(dq_ref.dtype)
 
 
 def _flash_attention_bwd(causal, scale, block_q, block_k, interpret,
                          residuals, g):
-    """Blockwise backward from the saved logsumexp.
-
-    A scan over K blocks recomputes each [S, Bk] probability tile from
-    (q, k_block, lse) — peak extra memory is O(S * Bk), never O(S^2):
-
-      p    = exp(q k_b^T * scale - lse)
-      dv_b = p^T g
-      ds   = p * (g v_b^T - delta) * scale,  delta = rowsum(g * o)
-      dq  += ds k_b ;  dk_b = ds^T q
-    """
+    """Pallas backward: a dKV kernel (k blocks outer, q inner) and a dQ
+    kernel (q outer, k inner), both recomputing probability tiles from the
+    saved logsumexp — peak extra memory is O(Bq * Bk), never O(S^2)."""
     q, k, v, out, lse = residuals
-    scale_v, _ = _resolve(scale, q.shape[-1], interpret)
+    scale_v, interp = _resolve(scale, q.shape[-1], interpret)
+
+    batch, heads, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq = _fit_block(block_q, s_q)
+    bk = _fit_block(block_k, s_k)
 
     f32 = jnp.float32
-    qf, kf, vf, gf, of = (x.astype(f32) for x in (q, k, v, g, out))
-    b, h, s_q, d = q.shape
-    s_k = k.shape[2]
-    bk = min(block_k, s_k)
-    nk = s_k // bk
+    delta = jnp.sum(
+        g.astype(f32) * out.astype(f32), axis=-1
+    )  # [B,H,Sq]
+    # [B, H, 1, S] layout so the last-two block dims obey TPU tiling
+    lse4 = lse.reshape(batch, heads, 1, s_q)
+    delta4 = delta.reshape(batch, heads, 1, s_q)
 
-    delta = jnp.sum(gf * of, axis=-1, keepdims=True)  # [B,H,Sq,1]
-    lse_e = lse[..., None]  # [B,H,Sq,1]
-    rows = jax.lax.broadcasted_iota(jnp.int32, (s_q, bk), 0)
+    def io_specs(outer_is_k):
+        """Block specs for (q, k, v, do, lse, delta) given grid layout."""
+        if outer_is_k:  # grid (b, h, j, i): i = q block, j = k block
+            q_idx = lambda b, h, j, i: (b, h, i, 0)  # noqa: E731
+            k_idx = lambda b, h, j, i: (b, h, j, 0)  # noqa: E731
+        else:  # grid (b, h, i, j)
+            q_idx = lambda b, h, i, j: (b, h, i, 0)  # noqa: E731
+            k_idx = lambda b, h, i, j: (b, h, j, 0)  # noqa: E731
+        lse_idx = (lambda b, h, j, i: (b, h, 0, i)) if outer_is_k else (
+            lambda b, h, i, j: (b, h, 0, i))
+        return [
+            pl.BlockSpec((1, 1, bq, d), q_idx),
+            pl.BlockSpec((1, 1, bk, d), k_idx),
+            pl.BlockSpec((1, 1, bk, d), k_idx),
+            pl.BlockSpec((1, 1, bq, d), q_idx),
+            pl.BlockSpec((1, 1, 1, bq), lse_idx),
+            pl.BlockSpec((1, 1, 1, bq), lse_idx),
+        ]
 
-    k_blocks = kf.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
-    v_blocks = vf.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale_v, causal=causal,
+            block_q=bq, block_k=bk,
+        ),
+        grid=(batch, heads, s_k // bk, s_q // bq),
+        in_specs=io_specs(outer_is_k=True),
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, d)), _vmem((bk, d))],
+        interpret=interp,
+    )(q, k, v, g, lse4, delta4)
 
-    def kblock_step(dq_acc, inputs):
-        j, k_b, v_b = inputs  # [B,H,Bk,D]
-        s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, k_b, preferred_element_type=f32
-        ) * scale_v  # [B,H,Sq,Bk]
-        if causal:
-            cols = jax.lax.broadcasted_iota(jnp.int32, (s_q, bk), 1) + j * bk
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse_e)  # [B,H,Sq,Bk]; exact probs via saved lse
-        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_b)
-        ds = p * (dp - delta) * scale_v
-        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_b)
-        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-        return dq_acc, (dk_b, dv_b)
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale_v, causal=causal,
+            block_q=bq, block_k=bk,
+        ),
+        grid=(batch, heads, s_q // bq, s_k // bk),
+        in_specs=io_specs(outer_is_k=False),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        scratch_shapes=[_vmem((bq, d))],
+        interpret=interp,
+    )(q, k, v, g, lse4, delta4)[0]
 
-    dq0 = jnp.zeros_like(qf)
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        kblock_step, dq0,
-        (jnp.arange(nk), k_blocks, v_blocks),
-    )
-    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, s_k, d)
-    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, s_k, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

@@ -16,7 +16,8 @@ def apply_remat(fn: Callable, policy: str = "dots_saveable",
                 prevent_cse: bool = True) -> Callable:
     """Wrap a block function with a remat policy.
 
-    ``policy`` is "none" (no remat), "full" (save nothing), or any
+    ``policy`` is "none" (no remat), "full" (save nothing),
+    "dots_and_attn_saveable" (dots + named Pallas attention outputs), or any
     ``jax.checkpoint_policies`` attribute name — "dots_saveable" (keep MXU
     outputs, recompute elementwise — the usual TPU sweet spot),
     "nothing_saveable", "dots_with_no_batch_dims_saveable", ...
@@ -25,6 +26,15 @@ def apply_remat(fn: Callable, policy: str = "dots_saveable",
         return fn
     if policy == "full":
         return jax.checkpoint(fn, prevent_cse=prevent_cse)
+    if policy == "dots_and_attn_saveable":
+        # dots_saveable only recognises dot_general outputs, so a Pallas
+        # attention kernel would be re-run in the backward pass; saving
+        # the named attention output avoids that recompute
+        policy_fn = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+        return jax.checkpoint(fn, policy=policy_fn, prevent_cse=prevent_cse)
     policy_fn = getattr(jax.checkpoint_policies, policy, None)
     if not callable(policy_fn):
         available = sorted(

@@ -56,14 +56,9 @@ class AccelerateResult:
 
 
 def _remat_wrap(loss_fn: LossFn, policy_name: str) -> LossFn:
-    if not policy_name:
-        return loss_fn
-    if policy_name == "full":
-        return jax.checkpoint(loss_fn)
-    policy = getattr(jax.checkpoint_policies, policy_name, None)
-    if policy is None:
-        raise ValueError(f"unknown remat policy {policy_name!r}")
-    return jax.checkpoint(loss_fn, policy=policy)
+    from dlrover_tpu.ops.remat import apply_remat
+
+    return apply_remat(loss_fn, policy_name or "none")
 
 
 def accelerate(

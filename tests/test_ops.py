@@ -50,10 +50,32 @@ class TestFlashAttention:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
 
-    def test_rejects_indivisible_seq(self):
-        q, k, v = _qkv(s=192)  # 192 % 128 != 0
-        with pytest.raises(ValueError):
-            flash_attention(q, k, v, True, None, 128, 128)
+    def test_indivisible_seq_falls_back_to_fitting_blocks(self):
+        q, k, v = _qkv(s=192)  # 192 % 128 != 0: blocks auto-shrink to 96
+        out = flash_attention(q, k, v, True, None, 128, 128)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_multi_block_grid_forward_and_grad(self):
+        # explicit small blocks force a 4x4 grid so the scratch-carry
+        # accumulation, re-init boundaries, and causal block-skip paths
+        # in both backward kernels are exercised
+        q, k, v = _qkv(b=1, h=2, s=256, d=64)
+
+        def f(*a):
+            return flash_attention(*a, True, None, 64, 64).sum()
+
+        def r(*a):
+            return mha_reference(*a, causal=True).sum()
+
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, True, None, 64, 64),
+            mha_reference(q, k, v, causal=True), atol=2e-5, rtol=2e-5,
+        )
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
 
     def test_bf16_inputs(self):
         q, k, v = _qkv(s=128, dtype=jnp.bfloat16)
@@ -156,7 +178,8 @@ class TestRemat:
         def f(x):
             return jnp.sin(x @ x).sum()
 
-        for policy in ["full", "dots_saveable", "nothing_saveable", "none"]:
+        for policy in ["full", "dots_saveable", "nothing_saveable", "none",
+                       "dots_and_attn_saveable"]:
             g = jax.grad(apply_remat(f, policy))(jnp.eye(8))
             assert g.shape == (8, 8)
 
