@@ -1,0 +1,264 @@
+"""Brain optimization algorithms.
+
+Role parity: ``dlrover/go/brain/pkg/optimizer/implementation/
+optalgorithm/*.go`` — eight algorithms keyed by name, selected through
+the brain config. Each consumes the datastore's metric history (which —
+unlike the per-job local optimizer — spans *all* jobs on the cluster,
+enabling cold-start plans learned from similar completed jobs).
+
+Payload conventions (``BrainJobMetrics.payload``):
+  RUNTIME_INFO: {"speed": steps/s, "workers": n,
+                 "nodes": {type: [{"name","cpu","used_cpu","memory",
+                                   "used_memory"}]}}
+  MODEL_FEATURE: {"param_count": n, "flops_per_step": f}
+  JOB_META: {"name", "user", "strategy", "node_unit"}
+  JOB_EXIT_REASON: {"reason", "node_type", "node_name"}
+"""
+
+from __future__ import annotations
+
+import re
+import statistics
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.brain.datastore import BaseDatastore
+from dlrover_tpu.brain.messages import (
+    GroupResourceMsg,
+    MetricType,
+    OptimizePlanMsg,
+    OptimizeRequest,
+)
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("brain.algorithms")
+
+_REGISTRY: Dict[str, Callable] = {}
+
+_PS_COLD = GroupResourceMsg(count=1, cpu=8, memory=16384)
+_WORKER_COLD = GroupResourceMsg(count=1, cpu=4, memory=8192)
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_algorithm(name: str) -> Optional[Callable]:
+    return _REGISTRY.get(name)
+
+
+def algorithm_names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def _base_name(job_name: str) -> str:
+    """Recurring jobs differ only by a numeric/date suffix."""
+    return re.sub(r"[-_]\d+$", "", job_name)
+
+
+def _similar_finished_jobs(
+    store: BaseDatastore, job_name: str, limit: int = 5
+) -> List[str]:
+    base = _base_name(job_name)
+    hits = []
+    for uuid in store.list_job_uuids():
+        meta = store.latest(uuid, MetricType.JOB_META)
+        if meta is None:
+            continue
+        if _base_name(meta.payload.get("name", "")) != base:
+            continue
+        if store.latest(uuid, MetricType.JOB_EXIT_REASON) is None:
+            continue  # still running
+        hits.append(uuid)
+    return hits[-limit:]
+
+
+def _runtime_series(store: BaseDatastore, job_uuid: str) -> List[Dict]:
+    return [
+        m.payload
+        for m in store.get_job_metrics(job_uuid, MetricType.RUNTIME_INFO)
+    ]
+
+
+def _plan(**groups) -> OptimizePlanMsg:
+    return OptimizePlanMsg(group_resources=dict(groups))
+
+
+# -- create-time (cold or history-informed) ---------------------------------
+
+
+@register("optimize_job_ps_cold_create_resource")
+def ps_cold_create(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    return _plan(**{NodeType.PS: _PS_COLD, NodeType.WORKER: _WORKER_COLD})
+
+
+@register("optimize_job_ps_create_resource")
+def ps_create(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    """Initial PS plan from the *peak observed* usage of similar jobs
+    (``optimize_job_ps_create_resource.go``)."""
+    similar = _similar_finished_jobs(store, req.job_name)
+    if not similar:
+        return ps_cold_create(store, req)
+    counts, cpus, mems = [], [], []
+    for uuid in similar:
+        for sample in _runtime_series(store, uuid):
+            ps_nodes = sample.get("nodes", {}).get(NodeType.PS, [])
+            if not ps_nodes:
+                continue
+            counts.append(len(ps_nodes))
+            cpus.append(max(n.get("used_cpu", 0) for n in ps_nodes))
+            mems.append(max(n.get("used_memory", 0) for n in ps_nodes))
+    if not counts:
+        return ps_cold_create(store, req)
+    plan = _plan(**{
+        NodeType.PS: GroupResourceMsg(
+            count=int(statistics.median(counts)),
+            # headroom over the hottest observed PS
+            cpu=max(1.0, 1.25 * max(cpus)),
+            memory=max(1024, int(1.25 * max(mems))),
+        ),
+    })
+    return plan
+
+
+@register("optimize_job_worker_create_resource")
+def worker_create(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    """Initial worker plan: the worker count similar jobs converged to."""
+    similar = _similar_finished_jobs(store, req.job_name)
+    finals = []
+    for uuid in similar:
+        series = _runtime_series(store, uuid)
+        if series:
+            finals.append(series[-1].get("workers", 0))
+    finals = [f for f in finals if f > 0]
+    if not finals:
+        return _plan(**{NodeType.WORKER: _WORKER_COLD})
+    return _plan(**{
+        NodeType.WORKER: GroupResourceMsg(
+            count=int(statistics.median(finals)),
+            cpu=_WORKER_COLD.cpu, memory=_WORKER_COLD.memory,
+        ),
+    })
+
+
+# -- runtime adjustment ------------------------------------------------------
+
+
+@register("optimize_job_ps_init_adjust_resource")
+def ps_init_adjust(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    """Re-size the PS group once model stats exist
+    (``optimize_job_ps_init_adjust_resource.go``): 16 bytes/param across
+    the group, bounded PS count."""
+    model = store.latest(req.job_uuid, MetricType.MODEL_FEATURE)
+    if model is None or model.payload.get("param_count", 0) <= 0:
+        return OptimizePlanMsg(success=False, reason="no model feature yet")
+    params = model.payload["param_count"]
+    total_mb = int(params * 16 / (1024 * 1024)) + 2048
+    count = max(1, min(8, total_mb // _PS_COLD.memory + 1))
+    return _plan(**{
+        NodeType.PS: GroupResourceMsg(
+            count=count, cpu=_PS_COLD.cpu,
+            memory=max(_PS_COLD.memory, total_mb // count),
+        ),
+    })
+
+
+@register("optimize_job_worker_resource")
+def worker_resource(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    """Runtime worker count from the speed trend and PS CPU headroom
+    (``optimize_job_worker_resource.go:30-120``): keep adding workers
+    while per-worker speed holds and the hottest PS stays under the
+    utilization threshold."""
+    series = _runtime_series(store, req.job_uuid)
+    if len(series) < 4:
+        return OptimizePlanMsg(success=False, reason="not enough samples")
+    threshold = float(req.config.get("ps_cpu_threshold", 0.8))
+    cur_workers = series[-1].get("workers", 0)
+    if cur_workers <= 0:
+        return OptimizePlanMsg(success=False, reason="no running workers")
+
+    # hottest PS utilization over the recent window
+    utils = []
+    for sample in series[-8:]:
+        for node in sample.get("nodes", {}).get(NodeType.PS, []):
+            req_cpu = max(node.get("cpu", 0), 0.1)
+            utils.append(node.get("used_cpu", 0) / req_cpu)
+    ps_util = max(utils) if utils else 0.0
+    if ps_util >= threshold:
+        return OptimizePlanMsg(success=False, reason="ps saturated")
+
+    # per-worker speed trend: only grow while efficiency holds
+    half = len(series) // 2
+    eff = lambda ss: statistics.mean(  # noqa: E731
+        s["speed"] / max(s.get("workers", 1), 1)
+        for s in ss if s.get("speed", 0) > 0
+    )
+    try:
+        eff_old, eff_new = eff(series[:half]), eff(series[half:])
+    except statistics.StatisticsError:
+        return OptimizePlanMsg(success=False, reason="no speed samples")
+    if eff_new < 0.9 * eff_old:
+        return OptimizePlanMsg(success=False, reason="scaling stopped paying")
+
+    if ps_util > 0:
+        target = int(cur_workers * threshold / max(ps_util, 1e-6))
+        target = max(cur_workers + 1, min(target, cur_workers * 2))
+    else:
+        target = cur_workers + int(req.config.get("node_unit", 1))
+    max_workers = int(req.config.get("max_workers", 0))
+    if max_workers and target > max_workers:
+        target = max_workers
+    if target <= cur_workers:
+        return OptimizePlanMsg(success=False, reason="at target already")
+    return _plan(**{NodeType.WORKER: GroupResourceMsg(count=target)})
+
+
+@register("optimize_job_hot_ps_resource")
+def hot_ps(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    """Double the CPU of PSs running >90% of request
+    (``optimize_job_hot_ps_resource.go``)."""
+    series = _runtime_series(store, req.job_uuid)
+    if not series:
+        return OptimizePlanMsg(success=False, reason="no samples")
+    plan = OptimizePlanMsg()
+    for node in series[-1].get("nodes", {}).get(NodeType.PS, []):
+        req_cpu = max(node.get("cpu", 0), 0.1)
+        if node.get("used_cpu", 0) / req_cpu > 0.9:
+            plan.node_resources[node.get("name", "")] = {
+                "cpu": req_cpu * 2,
+                "memory": node.get("memory", _PS_COLD.memory),
+            }
+    if not plan.node_resources:
+        return OptimizePlanMsg(success=False, reason="no hot ps")
+    return plan
+
+
+def _oom_adjust(store, req: OptimizeRequest, node_type: str) -> OptimizePlanMsg:
+    factor = float(req.config.get("oom_factor", 2.0))
+    current = float(req.config.get("current_memory", 0))
+    if current <= 0:
+        # fall back on the peak observed usage of that node type
+        series = _runtime_series(store, req.job_uuid)
+        peaks = [
+            n.get("used_memory", 0)
+            for s in series
+            for n in s.get("nodes", {}).get(node_type, [])
+        ]
+        current = max(peaks) if peaks else _WORKER_COLD.memory
+    return _plan(**{
+        node_type: GroupResourceMsg(memory=int(current * factor)),
+    })
+
+
+@register("optimize_job_ps_oom_resource")
+def ps_oom(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    return _oom_adjust(store, req, NodeType.PS)
+
+
+@register("optimize_job_worker_create_oom_resource")
+def worker_oom(store, req: OptimizeRequest) -> OptimizePlanMsg:
+    return _oom_adjust(store, req, NodeType.WORKER)

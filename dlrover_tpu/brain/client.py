@@ -1,0 +1,181 @@
+"""Brain client + the master-side optimizer/reporter built on it.
+
+Role parity: ``dlrover/python/brain/client.py:63`` (``BrainClient``,
+``GlobalBrainClient:280``), ``dlrover/python/master/resource/
+brain_optimizer.py`` (``BrainResoureOptimizer``) and the Brain stats
+reporter (``dlrover/python/master/stats/reporter.py:55-235``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from dlrover_tpu.brain.messages import (
+    BrainJobMetrics,
+    GroupResourceMsg,
+    JobMetricsDump,
+    JobMetricsQuery,
+    MetricType,
+    OptimizePlanMsg,
+    OptimizeRequest,
+)
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.resource.local_optimizer import ResourceOptimizer
+from dlrover_tpu.master.resource.plan import ResourcePlan
+from dlrover_tpu.master.stats.reporter import StatsReporter
+from dlrover_tpu.master.stats.training_metrics import (
+    DatasetMetric,
+    ModelMetric,
+    RuntimeMetric,
+)
+from dlrover_tpu.rpc.client import RpcChannel
+
+logger = get_logger("brain.client")
+
+BRAIN_ADDR_ENV = "DLROVER_BRAIN_ADDR"
+
+
+class BrainClient:
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self._channel = RpcChannel(addr, timeout=timeout)
+
+    def persist_metrics(self, metrics: BrainJobMetrics) -> bool:
+        return self._channel.report(metrics).success
+
+    def optimize(self, request: OptimizeRequest) -> OptimizePlanMsg:
+        return self._channel.get(request)
+
+    def get_job_metrics(
+        self, job_uuid: str, metric_type: str = ""
+    ) -> List[BrainJobMetrics]:
+        dump: JobMetricsDump = self._channel.get(
+            JobMetricsQuery(job_uuid=job_uuid, metric_type=metric_type)
+        )
+        return dump.metrics
+
+    def close(self):
+        self._channel.close()
+
+
+_GLOBAL_CLIENT: Optional[BrainClient] = None
+
+
+def global_brain_client() -> BrainClient:
+    """Singleton from ``DLROVER_BRAIN_ADDR`` (reference
+    ``GlobalBrainClient``)."""
+    global _GLOBAL_CLIENT
+    if _GLOBAL_CLIENT is None:
+        addr = os.environ.get(BRAIN_ADDR_ENV, "")
+        if not addr:
+            raise RuntimeError(f"{BRAIN_ADDR_ENV} is not set")
+        _GLOBAL_CLIENT = BrainClient(addr)
+    return _GLOBAL_CLIENT
+
+
+def _plan_from_msg(msg: OptimizePlanMsg) -> Optional[ResourcePlan]:
+    if not msg.success:
+        return None
+    plan = ResourcePlan()
+    for node_type, group in msg.group_resources.items():
+        g: GroupResourceMsg = group
+        plan.node_group_resources[node_type] = NodeGroupResource(
+            count=g.count,
+            node_resource=NodeResource(cpu=g.cpu, memory=g.memory),
+        )
+    for name, res in msg.node_resources.items():
+        plan.node_resources[name] = NodeResource(
+            cpu=res.get("cpu", 0), memory=int(res.get("memory", 0))
+        )
+    return plan
+
+
+class BrainResourceOptimizer(ResourceOptimizer):
+    """optimize_mode="cluster": plans come from the brain service."""
+
+    def __init__(self, job_name: str, client: Optional[BrainClient] = None):
+        self._job_name = job_name
+        self._job_uuid = ""
+        self._client = client or global_brain_client()
+
+    def update_job_uuid(self, job_uuid: str):
+        self._job_uuid = job_uuid
+
+    def generate_opt_plan(self, stage: str = "") -> Optional[ResourcePlan]:
+        try:
+            msg = self._client.optimize(OptimizeRequest(
+                job_uuid=self._job_uuid, job_name=self._job_name,
+                stage=stage,
+            ))
+        except Exception as e:  # noqa: BLE001 — brain outage ≠ job failure
+            logger.warning("brain optimize failed: %s", e)
+            return None
+        return _plan_from_msg(msg)
+
+    def generate_oom_recovery_plan(
+        self, node_name: str, current: NodeResource,
+        node_type: str = NodeType.WORKER,
+    ) -> NodeResource:
+        stage = "ps_oom" if node_type == NodeType.PS else "worker_oom"
+        try:
+            msg = self._client.optimize(OptimizeRequest(
+                job_uuid=self._job_uuid, job_name=self._job_name,
+                stage=stage,
+                config={"current_memory": current.memory},
+            ))
+        except Exception:  # noqa: BLE001
+            msg = OptimizePlanMsg(success=False)
+        if msg.success and node_type in msg.group_resources:
+            memory = msg.group_resources[node_type].memory
+            return NodeResource(cpu=current.cpu, memory=memory)
+        return NodeResource(cpu=current.cpu, memory=current.memory * 2)
+
+
+class BrainStatsReporter(StatsReporter):
+    """Forwards the master's metric stream to the brain datastore, giving
+    future jobs a history to learn initial plans from."""
+
+    def __init__(self, job_uuid: str, job_name: str,
+                 client: Optional[BrainClient] = None):
+        self._job_uuid = job_uuid
+        self._job_name = job_name
+        self._client = client or global_brain_client()
+
+    def _send(self, metric_type: str, payload: dict):
+        try:
+            self._client.persist_metrics(BrainJobMetrics(
+                job_uuid=self._job_uuid, job_name=self._job_name,
+                metric_type=metric_type, payload=payload,
+                timestamp=time.time(),
+            ))
+        except Exception as e:  # noqa: BLE001
+            logger.warning("brain metric report failed: %s", e)
+
+    def report_dataset_metric(self, metric: DatasetMetric):
+        self._send(MetricType.TRAINING_HYPER_PARAMS, {
+            "dataset": metric.name, "size": metric.size,
+        })
+
+    def report_model_metric(self, metric: ModelMetric):
+        self._send(MetricType.MODEL_FEATURE, {
+            "param_count": metric.param_count,
+            "flops_per_step": metric.flops_per_step,
+        })
+
+    def report_runtime_stats(self, metric: RuntimeMetric):
+        workers = len(metric.running_nodes.get(NodeType.WORKER, []))
+        self._send(MetricType.RUNTIME_INFO, {
+            "speed": metric.speed,
+            "workers": workers,
+            "nodes": metric.running_nodes,
+        })
+
+    def report_job_meta(self, **payload):
+        self._send(MetricType.JOB_META, payload)
+
+    def report_job_exit(self, reason: str, **payload):
+        self._send(MetricType.JOB_EXIT_REASON,
+                   {"reason": reason, **payload})

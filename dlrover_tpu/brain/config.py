@@ -1,0 +1,73 @@
+"""Brain configuration with hot reload.
+
+Role parity: ``dlrover/go/brain/pkg/config/manager.go:180`` — the Go
+brain watches a k8s ConfigMap and re-reads algorithm selection at
+runtime. Here the source is a JSON file re-checked by mtime on every
+read, which a ConfigMap volume mount provides for free on k8s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import JobStage
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("brain.config")
+
+DEFAULT_STAGE_ALGORITHMS = {
+    JobStage.CREATE: "optimize_job_ps_create_resource",
+    JobStage.WORKER_INITIAL: "optimize_job_ps_init_adjust_resource",
+    JobStage.RUNNING: "optimize_job_worker_resource",
+    "hot_ps": "optimize_job_hot_ps_resource",
+    "ps_oom": "optimize_job_ps_oom_resource",
+    "worker_oom": "optimize_job_worker_create_oom_resource",
+}
+
+
+class BrainConfig:
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._mtime = 0.0
+        self._lock = threading.Lock()
+        self._data: Dict = {}
+        self._reload_if_changed(force=True)
+
+    def _reload_if_changed(self, force: bool = False):
+        if not self._path:
+            return
+        try:
+            mtime = os.path.getmtime(self._path)
+        except OSError:
+            return
+        if not force and mtime == self._mtime:
+            return
+        try:
+            with open(self._path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("brain config reload failed: %s", e)
+            return
+        with self._lock:
+            self._data = data
+            self._mtime = mtime
+        logger.info("brain config (re)loaded from %s", self._path)
+
+    def algorithm_for(self, stage: str) -> str:
+        self._reload_if_changed()
+        with self._lock:
+            table = {
+                **DEFAULT_STAGE_ALGORITHMS,
+                **self._data.get("stage_algorithms", {}),
+            }
+        return table.get(stage, "")
+
+    def algorithm_config(self, algorithm: str) -> Dict:
+        self._reload_if_changed()
+        with self._lock:
+            return dict(self._data.get("algorithm_configs", {}).get(
+                algorithm, {}
+            ))

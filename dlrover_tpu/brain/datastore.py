@@ -1,0 +1,130 @@
+"""Brain datastores.
+
+Role parity: ``dlrover/go/brain/pkg/datastore`` (MySQL-backed
+``JobMetrics``/``JobNode`` tables, ``datastore/implementation/utils/
+mysql.go``). The cluster store here is sqlite (stdlib, durable, zero
+deps) behind the same interface as the in-memory store used in tests.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+from dlrover_tpu.brain.messages import BrainJobMetrics
+
+
+class BaseDatastore(ABC):
+    @abstractmethod
+    def persist_metrics(self, m: BrainJobMetrics) -> None:
+        ...
+
+    @abstractmethod
+    def get_job_metrics(
+        self, job_uuid: str, metric_type: str = ""
+    ) -> List[BrainJobMetrics]:
+        ...
+
+    @abstractmethod
+    def list_job_uuids(self) -> List[str]:
+        ...
+
+    def latest(
+        self, job_uuid: str, metric_type: str
+    ) -> Optional[BrainJobMetrics]:
+        rows = self.get_job_metrics(job_uuid, metric_type)
+        return rows[-1] if rows else None
+
+
+class MemoryDatastore(BaseDatastore):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: Dict[str, List[BrainJobMetrics]] = {}
+
+    def persist_metrics(self, m: BrainJobMetrics) -> None:
+        if not m.timestamp:
+            m.timestamp = time.time()
+        with self._lock:
+            self._rows.setdefault(m.job_uuid, []).append(m)
+
+    def get_job_metrics(self, job_uuid, metric_type=""):
+        with self._lock:
+            rows = list(self._rows.get(job_uuid, []))
+        if metric_type:
+            rows = [r for r in rows if r.metric_type == metric_type]
+        return rows
+
+    def list_job_uuids(self):
+        with self._lock:
+            return list(self._rows)
+
+
+class SqliteDatastore(BaseDatastore):
+    """Durable cluster store (the MySQL role). One connection per call —
+    sqlite handles locking; throughput needs are control-plane scale."""
+
+    def __init__(self, path: str):
+        self._path = path
+        with self._conn() as conn:
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS job_metrics ("
+                "  job_uuid TEXT, job_name TEXT, metric_type TEXT,"
+                "  payload TEXT, timestamp REAL)"
+            )
+            conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_job_metrics "
+                "ON job_metrics (job_uuid, metric_type)"
+            )
+
+    def _conn(self):
+        return sqlite3.connect(self._path, timeout=10.0)
+
+    def persist_metrics(self, m: BrainJobMetrics) -> None:
+        with self._conn() as conn:
+            conn.execute(
+                "INSERT INTO job_metrics VALUES (?, ?, ?, ?, ?)",
+                (
+                    m.job_uuid, m.job_name, m.metric_type,
+                    json.dumps(m.payload), m.timestamp or time.time(),
+                ),
+            )
+
+    def get_job_metrics(self, job_uuid, metric_type=""):
+        sql = (
+            "SELECT job_uuid, job_name, metric_type, payload, timestamp "
+            "FROM job_metrics WHERE job_uuid = ?"
+        )
+        args: List = [job_uuid]
+        if metric_type:
+            sql += " AND metric_type = ?"
+            args.append(metric_type)
+        sql += " ORDER BY timestamp"
+        with self._conn() as conn:
+            rows = conn.execute(sql, args).fetchall()
+        return [
+            BrainJobMetrics(
+                job_uuid=r[0], job_name=r[1], metric_type=r[2],
+                payload=json.loads(r[3]), timestamp=r[4],
+            )
+            for r in rows
+        ]
+
+    def list_job_uuids(self):
+        with self._conn() as conn:
+            rows = conn.execute(
+                "SELECT DISTINCT job_uuid FROM job_metrics"
+            ).fetchall()
+        return [r[0] for r in rows]
+
+
+def new_datastore(spec: str) -> BaseDatastore:
+    """"memory" or "sqlite:///path/to.db"."""
+    if spec == "memory" or not spec:
+        return MemoryDatastore()
+    if spec.startswith("sqlite://"):
+        return SqliteDatastore(spec[len("sqlite://"):])
+    raise ValueError(f"unknown datastore spec {spec!r}")

@@ -1,0 +1,44 @@
+"""``python -m dlrover_tpu.brain.main`` — run the brain service.
+
+Role parity: the Go brain's server binary
+(``dlrover/go/brain/cmd/brain/main.go``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from dlrover_tpu.brain.service import BrainService
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("dlrover-tpu brain")
+    parser.add_argument("--port", type=int, default=50051)
+    parser.add_argument(
+        "--datastore", default="memory",
+        help='"memory" or "sqlite:///path/to.db"',
+    )
+    parser.add_argument(
+        "--config", default="",
+        help="JSON config file (hot-reloaded; ConfigMap-mountable)",
+    )
+    args = parser.parse_args(argv)
+
+    service = BrainService(
+        port=args.port,
+        datastore_spec=args.datastore,
+        config_path=args.config or None,
+    )
+    service.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

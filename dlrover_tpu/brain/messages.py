@@ -1,0 +1,79 @@
+"""Brain wire messages.
+
+Role parity: ``dlrover/proto/brain.proto`` (``JobMetrics``,
+``OptimizeRequest``/``OptimizeResponse``, ``JobMetricsRequest`` — service
+rpcs ``persist_metrics`` / ``optimize`` / ``get_job_metrics``,
+``brain.proto:196-199``). JSON-framed dataclasses like the rest of the
+control plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import field
+from typing import Dict, List
+
+from dlrover_tpu.common import serialize
+
+
+class MetricType:
+    JOB_META = "job_meta"
+    MODEL_FEATURE = "model_feature"
+    RUNTIME_INFO = "runtime_info"
+    TRAINING_HYPER_PARAMS = "training_hyper_params"
+    JOB_EXIT_REASON = "job_exit_reason"
+    RESOURCE_USAGE = "resource_usage"
+
+
+@serialize.message
+class BrainJobMetrics:
+    """persist_metrics payload (reference ``JobMetrics``)."""
+
+    job_uuid: str = ""
+    job_name: str = ""
+    metric_type: str = ""  # MetricType
+    payload: Dict = field(default_factory=dict)
+    timestamp: float = 0.0
+
+
+@serialize.message
+class OptimizeRequest:
+    """optimize rpc (reference ``OptimizeRequest``: type + config +
+    jobs). ``stage`` selects the algorithm via the brain config."""
+
+    job_uuid: str = ""
+    job_name: str = ""
+    stage: str = ""  # JobStage
+    algorithm: str = ""  # explicit override; else config decides by stage
+    config: Dict = field(default_factory=dict)
+
+
+@serialize.message
+class GroupResourceMsg:
+    count: int = 0
+    cpu: float = 0.0
+    memory: int = 0  # MiB
+    chips: int = 0
+
+
+@serialize.message
+class OptimizePlanMsg:
+    """optimize response (reference ``JobOptimizePlan``/``JobResource``)."""
+
+    success: bool = True
+    reason: str = ""
+    # node_type -> group resource
+    group_resources: Dict[str, GroupResourceMsg] = field(default_factory=dict)
+    # node_name -> {"cpu", "memory"} for in-place migration
+    node_resources: Dict[str, Dict] = field(default_factory=dict)
+
+
+@serialize.message
+class JobMetricsQuery:
+    job_uuid: str = ""
+    metric_type: str = ""  # optional filter
+
+
+@serialize.message
+class JobMetricsDump:
+    job_uuid: str = ""
+    metrics: List[BrainJobMetrics] = field(default_factory=list)

@@ -1,0 +1,110 @@
+"""Brain service: cluster-level resource optimizer.
+
+Role parity: ``dlrover/go/brain/pkg/server/server.go:39-176``
+(``BrainServer`` gRPC: persist_metrics / optimize / get_job_metrics).
+Runs over the same codegen-free two-method transport as the master
+(``rpc.server``): metric reports arrive via ``report``, optimize and
+query via ``get``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dlrover_tpu.brain.algorithms import get_algorithm
+from dlrover_tpu.brain.config import BrainConfig
+from dlrover_tpu.brain.datastore import BaseDatastore, new_datastore
+from dlrover_tpu.brain.messages import (
+    BrainJobMetrics,
+    JobMetricsDump,
+    JobMetricsQuery,
+    OptimizePlanMsg,
+    OptimizeRequest,
+)
+from dlrover_tpu.common.comm import Response
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.rpc.server import build_server
+
+logger = get_logger("brain.service")
+
+
+class BrainServicer:
+    def __init__(
+        self,
+        datastore: Optional[BaseDatastore] = None,
+        config: Optional[BrainConfig] = None,
+    ):
+        self._store = datastore or new_datastore("memory")
+        self._config = config or BrainConfig()
+
+    @property
+    def datastore(self) -> BaseDatastore:
+        return self._store
+
+    # -- transport entry points (rpc.server contract) -----------------------
+
+    def report(self, request, context=None) -> Response:
+        if isinstance(request, BrainJobMetrics):
+            self._store.persist_metrics(request)
+            return Response(success=True)
+        return Response(success=False, reason=f"unknown {type(request).__name__}")
+
+    def get(self, request, context=None):
+        if isinstance(request, OptimizeRequest):
+            return self.optimize(request)
+        if isinstance(request, JobMetricsQuery):
+            return JobMetricsDump(
+                job_uuid=request.job_uuid,
+                metrics=self._store.get_job_metrics(
+                    request.job_uuid, request.metric_type
+                ),
+            )
+        return Response(success=False, reason=f"unknown {type(request).__name__}")
+
+    # -- logic --------------------------------------------------------------
+
+    def optimize(self, req: OptimizeRequest) -> OptimizePlanMsg:
+        name = req.algorithm or self._config.algorithm_for(req.stage)
+        algo = get_algorithm(name)
+        if algo is None:
+            return OptimizePlanMsg(
+                success=False, reason=f"no algorithm for stage {req.stage!r}"
+            )
+        config = {**self._config.algorithm_config(name), **req.config}
+        merged = OptimizeRequest(
+            job_uuid=req.job_uuid, job_name=req.job_name,
+            stage=req.stage, algorithm=name, config=config,
+        )
+        try:
+            plan = algo(self._store, merged)
+        except Exception as e:  # noqa: BLE001 — servable errors, not crashes
+            logger.exception("algorithm %s failed", name)
+            return OptimizePlanMsg(success=False, reason=str(e)[:200])
+        logger.info(
+            "optimize job=%s stage=%s algo=%s -> success=%s",
+            req.job_name, req.stage, name, plan.success,
+        )
+        return plan
+
+
+class BrainService:
+    """gRPC-served brain (`python -m dlrover_tpu.brain.main`)."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        datastore_spec: str = "memory",
+        config_path: Optional[str] = None,
+    ):
+        self.servicer = BrainServicer(
+            datastore=new_datastore(datastore_spec),
+            config=BrainConfig(config_path),
+        )
+        self._server, self.port = build_server(self.servicer, port=port)
+
+    def start(self):
+        self._server.start()
+        logger.info("brain service listening on :%d", self.port)
+
+    def stop(self, grace: float = 1.0):
+        self._server.stop(grace)

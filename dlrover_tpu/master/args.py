@@ -19,6 +19,11 @@ def build_master_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--node_num", type=int, default=1)
     parser.add_argument(
+        "--ray_conf", default="",
+        help="JSON job conf for --platform ray (see scheduler.ray."
+             "ray_job_args)",
+    )
+    parser.add_argument(
         "--timeout", type=float, default=0.0,
         help="exit with failure if the job outlives this many seconds (0=off)",
     )

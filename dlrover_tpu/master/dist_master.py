@@ -141,6 +141,18 @@ class DistributedJobMaster:
                 scaler or PodScaler(self.job_name, client, ""),
                 watcher or PodWatcher(self.job_name, client),
             )
+        if platform == PlatformType.RAY:
+            from dlrover_tpu.master.scaler.actor_scaler import ActorScaler
+            from dlrover_tpu.master.watcher.ray_watcher import ActorWatcher
+            from dlrover_tpu.scheduler.ray import RayClient
+
+            client = RayClient.singleton_instance(
+                self.job_args.namespace, self.job_name
+            )
+            return (
+                scaler or ActorScaler(self.job_name, client, master_addr=""),
+                watcher or ActorWatcher(self.job_name, client),
+            )
         raise ValueError(f"unsupported platform: {platform}")
 
     # -- lifecycle -----------------------------------------------------------

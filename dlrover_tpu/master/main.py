@@ -25,9 +25,21 @@ def run(args) -> int:
         # top of the local master's services; built in dist_master.py.
         from dlrover_tpu.master.dist_master import DistributedJobMaster
 
+        job_args = None
+        if args.platform == "ray":
+            import json
+
+            from dlrover_tpu.scheduler.ray import ray_job_args
+
+            conf = json.loads(args.ray_conf) if args.ray_conf else {
+                "worker": {"count": args.node_num},
+            }
+            job_args = ray_job_args(
+                conf, job_name=args.job_name, namespace=args.namespace,
+            )
         master = DistributedJobMaster(
             port=args.port, job_name=args.job_name, platform=args.platform,
-            node_num=args.node_num,
+            node_num=args.node_num, job_args=job_args,
         )
     master.prepare()
     print(f"DLROVER_TPU_MASTER_ADDR={master.addr}", flush=True)

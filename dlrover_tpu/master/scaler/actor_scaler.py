@@ -1,0 +1,109 @@
+"""Ray actor scaler.
+
+Role parity: ``dlrover/python/master/scaler/ray_scaler.py:39``
+(``ActorScaler`` — diffs the ScalePlan's group targets against the alive
+actors and creates/kills the difference).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.scheduler.ray import (
+    ActorArgs,
+    parse_type_id_from_actor_name,
+)
+
+logger = get_logger("scaler.actor")
+
+DEFAULT_EXECUTOR = "dlrover_tpu.trainer.bootstrap:worker_main"
+
+
+class ActorScaler(Scaler):
+    def __init__(
+        self,
+        job_name: str,
+        ray_client,  # scheduler.ray.RayClient or a fake
+        executor: str = DEFAULT_EXECUTOR,
+        master_addr: str = "",
+        env_factory: Optional[Callable[[Node], Dict[str, str]]] = None,
+    ):
+        super().__init__(job_name)
+        self._client = ray_client
+        self._executor = executor
+        self._master_addr = master_addr
+        self._env_factory = env_factory
+
+    def _alive_by_type(self) -> Dict[str, List[str]]:
+        alive: Dict[str, List[str]] = {}
+        for name, state in self._client.list_actors().items():
+            if state in ("DEAD",):
+                continue
+            node_type, _ = parse_type_id_from_actor_name(name)
+            alive.setdefault(node_type, []).append(name)
+        return alive
+
+    def _actor_args(self, node: Node) -> ActorArgs:
+        env = {
+            "DLROVER_MASTER_ADDR": self._master_addr,
+            "NODE_TYPE": node.type,
+            "NODE_ID": str(node.id),
+            "NODE_RANK": str(node.rank_index),
+        }
+        if self._env_factory is not None:
+            env.update(self._env_factory(node))
+        return ActorArgs(
+            actor_name=node.name,
+            executor=self._executor,
+            num_cpus=node.config_resource.cpu or 1.0,
+            memory_mb=node.config_resource.memory or 1024,
+            resources=(
+                {"TPU": float(node.config_resource.accelerator.chips)}
+                if node.config_resource.accelerator.chips else {}
+            ),
+            env=env,
+        )
+
+    def scale(self, plan: ScalePlan) -> None:
+        alive = self._alive_by_type()
+        # concrete launches/removals first (relaunch path); the alive map
+        # tracks them so the group loop below doesn't double-create the
+        # same names (the initial plan carries both fields)
+        for node in plan.launch_nodes:
+            logger.info("create actor %s", node.name)
+            self._client.create_actor(self._actor_args(node))
+            alive.setdefault(node.type, []).append(node.name)
+        for node in plan.remove_nodes:
+            logger.info("kill actor %s", node.name)
+            self._client.delete_actor(node.name)
+            names = alive.get(node.type, [])
+            if node.name in names:
+                names.remove(node.name)
+
+        # then group targets: grow with fresh ids, shrink from the top
+        for node_type, group in plan.node_group_resources.items():
+            if group.count <= 0:
+                continue
+            names = sorted(
+                alive.get(node_type, []),
+                key=lambda n: parse_type_id_from_actor_name(n)[1],
+            )
+            cur = len(names)
+            used_ids = {
+                parse_type_id_from_actor_name(n)[1] for n in names
+            }
+            next_id = max(used_ids) + 1 if used_ids else 0
+            for _ in range(cur, group.count):
+                node = Node(
+                    node_type=node_type, node_id=next_id,
+                    config_resource=group.node_resource,
+                )
+                logger.info("scale-up actor %s", node.name)
+                self._client.create_actor(self._actor_args(node))
+                next_id += 1
+            for name in names[group.count:]:
+                logger.info("scale-down actor %s", name)
+                self._client.delete_actor(name)

@@ -1,0 +1,161 @@
+"""ElasticJob / ScalePlan custom-resource types.
+
+Role parity: ``dlrover/go/operator/api/v1alpha1/elasticjob_types.go:29-100``
+and ``scaleplan_types.go:29-80``. CRs are plain dicts on the wire (what
+the k8s API returns); these helpers give them a typed veneer the
+reconcilers use, plus the phase constants of the Go ``commonv1`` package.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.scheduler.kubernetes import (
+    ELASTICJOB_GROUP,
+    ELASTICJOB_VERSION,
+)
+
+API_VERSION = f"{ELASTICJOB_GROUP}/{ELASTICJOB_VERSION}"
+
+
+class JobPhase:
+    CREATED = "Created"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SCALING = "Scaling"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class ReplicaSpec:
+    """Per-replica-type spec (reference: ReplicaSpec with RestartCount/
+    AutoScale/Priority)."""
+
+    replicas: int = 0
+    cpu: float = 1.0
+    memory_mb: int = 1024
+    tpu_chips: int = 0
+    tpu_topology: str = ""
+    tpu_accelerator: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    restart_count: int = 3
+    auto_scale: bool = True
+    priority: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaSpec":
+        res = d.get("resources", {})
+        return cls(
+            replicas=int(d.get("replicas", 0)),
+            cpu=float(res.get("cpu", 1)),
+            memory_mb=int(res.get("memory", 1024)),
+            tpu_chips=int(res.get("tpu", 0)),
+            tpu_topology=d.get("tpuTopology", ""),
+            tpu_accelerator=d.get("tpuAccelerator", ""),
+            image=d.get("image", ""),
+            command=list(d.get("command", [])),
+            restart_count=int(d.get("restartCount", 3)),
+            auto_scale=bool(d.get("autoScale", True)),
+            priority=d.get("priority", ""),
+        )
+
+
+@dataclass
+class ElasticJob:
+    name: str
+    namespace: str = "default"
+    distribution_strategy: str = "spmd"
+    optimize_mode: str = "single-job"
+    enable_dynamic_sharding: bool = True
+    enable_elastic_scheduling: bool = True
+    node_unit: int = 1
+    envs: Dict[str, str] = field(default_factory=dict)
+    replica_specs: Dict[str, ReplicaSpec] = field(default_factory=dict)
+    resource_limits: Dict[str, float] = field(default_factory=dict)
+    phase: str = JobPhase.CREATED
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, cr: Dict[str, Any]) -> "ElasticJob":
+        meta = cr.get("metadata", {})
+        spec = cr.get("spec", {})
+        status = cr.get("status", {})
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            distribution_strategy=spec.get("distributionStrategy", "spmd"),
+            optimize_mode=spec.get("optimizeMode", "single-job"),
+            enable_dynamic_sharding=spec.get("enableDynamicSharding", True),
+            enable_elastic_scheduling=spec.get(
+                "enableElasticScheduling", True
+            ),
+            node_unit=int(spec.get("nodeUnit", 1)),
+            envs=dict(spec.get("envs", {})),
+            replica_specs={
+                t: ReplicaSpec.from_dict(s)
+                for t, s in spec.get("replicaSpecs", {}).items()
+            },
+            resource_limits=dict(spec.get("resourceLimits", {})),
+            phase=status.get("phase", JobPhase.CREATED) or JobPhase.CREATED,
+            raw=cr,
+        )
+
+
+@dataclass
+class ScalePlan:
+    name: str
+    owner_job: str = ""
+    replica_resource_specs: Dict[str, Dict[str, Any]] = field(
+        default_factory=dict
+    )
+    create_pods: List[Dict[str, Any]] = field(default_factory=list)
+    remove_pods: List[str] = field(default_factory=list)
+    migrate_pods: List[Dict[str, Any]] = field(default_factory=list)
+    ps_hosts: List[str] = field(default_factory=list)
+    phase: str = JobPhase.PENDING
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, cr: Dict[str, Any]) -> "ScalePlan":
+        meta = cr.get("metadata", {})
+        spec = cr.get("spec", {})
+        status = cr.get("status", {})
+        return cls(
+            name=meta.get("name", ""),
+            owner_job=spec.get("ownerJob", ""),
+            replica_resource_specs=dict(spec.get("replicaResourceSpecs", {})),
+            create_pods=list(spec.get("createPods", [])),
+            remove_pods=list(spec.get("removePods", [])),
+            migrate_pods=list(spec.get("migratePods", [])),
+            ps_hosts=list(spec.get("psHosts", [])),
+            phase=status.get("phase", JobPhase.PENDING) or JobPhase.PENDING,
+            raw=cr,
+        )
+
+
+def elastic_job_cr(
+    name: str,
+    replica_specs: Dict[str, Dict[str, Any]],
+    namespace: str = "default",
+    distribution_strategy: str = "spmd",
+    optimize_mode: str = "single-job",
+    node_unit: int = 1,
+    envs: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Author an ElasticJob CR body (what a user would kubectl-apply)."""
+    return {
+        "apiVersion": API_VERSION,
+        "kind": "ElasticJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "distributionStrategy": distribution_strategy,
+            "optimizeMode": optimize_mode,
+            "nodeUnit": node_unit,
+            "envs": envs or {},
+            "replicaSpecs": replica_specs,
+        },
+        "status": {"phase": JobPhase.CREATED},
+    }

@@ -35,10 +35,19 @@ LANES = 128
 def _fit_block(requested: int, dim: int) -> int:
     """Largest divisor of ``dim`` that is <= ``requested`` — block sizes
     must tile the sequence exactly, but callers shouldn't have to match
-    the defaults to their sequence length."""
+    the defaults to their sequence length. Sequences whose only fitting
+    blocks would break the TPU sublane rule (multiple of 8, unless the
+    block covers the whole dim) are rejected with a clear error rather
+    than silently degrading to tiny blocks."""
     b = min(requested, dim)
     while dim % b:
         b -= 1
+    if b != dim and b % 8:
+        raise ValueError(
+            f"no legal block tiling for sequence length {dim} under block "
+            f"size {requested}: best divisor {b} is not a multiple of 8; "
+            "pad the sequence to a multiple of 8"
+        )
     return b
 
 

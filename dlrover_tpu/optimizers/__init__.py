@@ -1,0 +1,19 @@
+from dlrover_tpu.optimizers.bf16 import bf16_master_weights
+from dlrover_tpu.optimizers.clip import clip_by_global_norm, global_norm
+from dlrover_tpu.optimizers.grad_scaler import (
+    DynamicGradScaler,
+    GradScalerState,
+    all_finite,
+)
+from dlrover_tpu.optimizers.wsam import WsamOptimizer, wsam
+
+__all__ = [
+    "bf16_master_weights",
+    "clip_by_global_norm",
+    "global_norm",
+    "DynamicGradScaler",
+    "GradScalerState",
+    "all_finite",
+    "WsamOptimizer",
+    "wsam",
+]

@@ -1,0 +1,61 @@
+"""BF16 training with fp32 master weights.
+
+Role parity: ``atorch/atorch/optimizers/bf16_optimizer.py:46``
+(``BF16Optimizer`` — wraps a torch optimizer, keeps fp32 master copies of
+every half-precision parameter, steps the masters, copies back). The TPU
+version is an optax wrapper: the optimizer state holds the fp32 masters,
+the update returned to ``optax.apply_updates`` is the bf16 delta that
+moves the stored params onto the freshly-stepped masters — so tiny
+updates accumulate in fp32 even when each one underflows bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_HALF_DTYPES = (jnp.bfloat16, jnp.float16)
+
+
+class MasterWeightsState(NamedTuple):
+    master: Any  # fp32 copies of half-precision params (others aliased)
+    base_state: Any
+
+
+def bf16_master_weights(
+    base_optimizer: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Wrap ``base_optimizer`` so half-precision params are stepped
+    through fp32 masters. Full-precision params pass through unchanged."""
+
+    def _to_master(p):
+        return p.astype(jnp.float32) if p.dtype in _HALF_DTYPES else p
+
+    def init(params):
+        master = jax.tree.map(_to_master, params)
+        return MasterWeightsState(
+            master=master, base_state=base_optimizer.init(master)
+        )
+
+    def update(grads, state: MasterWeightsState, params=None):
+        if params is None:
+            raise ValueError("bf16_master_weights requires params")
+        grads32 = jax.tree.map(
+            lambda g: g.astype(jnp.float32)
+            if g.dtype in _HALF_DTYPES else g,
+            grads,
+        )
+        master_updates, base_state = base_optimizer.update(
+            grads32, state.base_state, state.master
+        )
+        new_master = optax.apply_updates(state.master, master_updates)
+        # the emitted update lands params exactly on cast(new_master)
+        updates = jax.tree.map(
+            lambda m, p: m.astype(p.dtype) - p, new_master, params
+        )
+        return updates, MasterWeightsState(new_master, base_state)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,53 @@
+"""Parallelism-aware gradient clipping.
+
+Role parity: ``atorch/atorch/auto/clip_grad_norm.py`` — the reference
+must sum squared norms across tensor-parallel process groups by hand.
+Under GSPMD the gradient pytree is logically global, so the plain global
+norm is already parallelism-correct; the ``axis_names`` path covers
+``shard_map`` contexts where collectives are manual.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def global_norm(
+    tree: Any, axis_names: Optional[Sequence[str]] = None
+) -> jnp.ndarray:
+    """L2 norm over every leaf; with ``axis_names``, the squared sum is
+    ``lax.psum``-ed over those mesh axes first (for use inside
+    ``shard_map`` where each shard only sees its local slice)."""
+    sq = sum(
+        jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        for leaf in jax.tree.leaves(tree)
+    )
+    if axis_names:
+        sq = jax.lax.psum(sq, tuple(axis_names))
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(
+    max_norm: float, axis_names: Optional[Sequence[str]] = None
+) -> optax.GradientTransformation:
+    """optax transformation clipping to ``max_norm``; shard_map-safe when
+    ``axis_names`` is given."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        norm = global_norm(updates, axis_names)
+        factor = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+        return jax.tree.map(
+            lambda u: (u.astype(jnp.float32) * factor).astype(u.dtype),
+            updates,
+        ), state
+
+    return optax.GradientTransformation(init, update)

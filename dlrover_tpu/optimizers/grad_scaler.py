@@ -1,0 +1,102 @@
+"""Dynamic loss scaling.
+
+Role parity: ``atorch/atorch/utils/grad_scaler.py`` /
+``amp/pipe_amp.py:51`` (``PipeGradScaler``) — torch ``GradScaler``
+variants. On TPU the default dtype is bf16 (no scaling needed), but the
+fp16 path and the reference's AMP surface need the same contract:
+scale the loss up, check grads for inf/nan, skip the step and back off
+on overflow, grow after a stable streak. Implemented as pure functions
+over an explicit state so the whole thing lives inside ``jit``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GradScalerState(NamedTuple):
+    scale: jnp.ndarray  # f32 scalar
+    growth_tracker: jnp.ndarray  # consecutive finite steps, int32
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """Scalar bool: every leaf of the pytree is finite."""
+    leaves = [
+        jnp.all(jnp.isfinite(leaf)) for leaf in jax.tree.leaves(tree)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+class DynamicGradScaler:
+    """torch.cuda.amp.GradScaler semantics, functionally.
+
+    Usage inside a train step::
+
+        state = scaler.init()
+        loss = scaler.scale(loss, state)          # before grad
+        grads = ...                                # grads of scaled loss
+        grads, finite = scaler.unscale(grads, state)
+        state = scaler.update(state, finite)
+        # apply the optimizer step only where `finite` (lax.cond / where)
+    """
+
+    def __init__(
+        self,
+        init_scale: float = 2.0 ** 16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+        max_scale: float = 2.0 ** 24,
+    ):
+        self.init_scale = init_scale
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.max_scale = max_scale
+
+    def init(self) -> GradScalerState:
+        return GradScalerState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            growth_tracker=jnp.zeros((), jnp.int32),
+        )
+
+    def scale(self, loss: jnp.ndarray, state: GradScalerState):
+        return loss * state.scale.astype(loss.dtype)
+
+    def unscale(
+        self, grads: Any, state: GradScalerState
+    ) -> Tuple[Any, jnp.ndarray]:
+        inv = (1.0 / state.scale).astype(jnp.float32)
+        unscaled = jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads
+        )
+        return unscaled, all_finite(unscaled)
+
+    def update(
+        self, state: GradScalerState, grads_finite: jnp.ndarray
+    ) -> GradScalerState:
+        grew = state.growth_tracker + 1 >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(
+                grew,
+                jnp.minimum(
+                    state.scale * self.growth_factor, self.max_scale
+                ),
+                state.scale,
+            ),
+            state.scale * self.backoff_factor,
+        )
+        new_tracker = jnp.where(
+            grads_finite,
+            jnp.where(grew, 0, state.growth_tracker + 1),
+            0,
+        )
+        return GradScalerState(
+            scale=new_scale, growth_tracker=new_tracker.astype(jnp.int32)
+        )

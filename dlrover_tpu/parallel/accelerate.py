@@ -154,9 +154,21 @@ def accelerate(
             (loss, _aux), grads = grad_fn(state.params, batch, step_rng)
         else:
             grads, loss = _accumulate_grads(state.params, batch, step_rng)
-        updates, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
+        if hasattr(optimizer, "update_with_grad_fn"):
+            # two-gradient optimizers (WSAM/SAM family): hand them a full
+            # forward/backward at arbitrary params on this same batch
+            def full_grad_fn(p):
+                if accum == 1:
+                    return grad_fn(p, batch, step_rng)[1]
+                return _accumulate_grads(p, batch, step_rng)[0]
+
+            updates, new_opt_state = optimizer.update_with_grad_fn(
+                grads, state.opt_state, state.params, full_grad_fn
+            )
+        else:
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
         import optax
 
         new_params = optax.apply_updates(state.params, updates)

@@ -88,6 +88,10 @@ class K8sClient:
         return [p.to_dict() for p in pods.items]
 
     @retry_k8s_request
+    def create_service(self, service: Dict[str, Any]):
+        return self._core.create_namespaced_service(self.namespace, service)
+
+    @retry_k8s_request
     def create_custom_resource(self, plural: str, body: Dict[str, Any]):
         return self._custom.create_namespaced_custom_object(
             ELASTICJOB_GROUP, ELASTICJOB_VERSION, self.namespace, plural, body
@@ -97,6 +101,22 @@ class K8sClient:
     def get_custom_resource(self, plural: str, name: str):
         return self._custom.get_namespaced_custom_object(
             ELASTICJOB_GROUP, ELASTICJOB_VERSION, self.namespace, plural, name
+        )
+
+    @retry_k8s_request
+    def list_custom_resources(self, plural: str) -> List[Dict[str, Any]]:
+        out = self._custom.list_namespaced_custom_object(
+            ELASTICJOB_GROUP, ELASTICJOB_VERSION, self.namespace, plural
+        )
+        return list(out.get("items", []))
+
+    @retry_k8s_request
+    def update_custom_resource_status(
+        self, plural: str, name: str, body: Dict[str, Any]
+    ):
+        return self._custom.patch_namespaced_custom_object_status(
+            ELASTICJOB_GROUP, ELASTICJOB_VERSION, self.namespace, plural,
+            name, body,
         )
 
 

@@ -1,0 +1,178 @@
+"""Ray platform client and job description.
+
+Role parity: ``dlrover/python/scheduler/ray.py:51-209`` (``RayClient``
+singleton, ``RayElasticJob``, ``RayJobArgs``). Like the k8s client, the
+``ray`` package is an optional deferred import behind a thin injectable
+seam — the scaler/watcher logic is tested against a fake, and the master
+runs without a Ray cluster present.
+
+Actor naming convention (shared with the watcher): ``{type}-{id}``, the
+same scheme ``common.node.Node`` uses, so names round-trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    DistributionStrategy,
+    NodeType,
+    PlatformType,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.scheduler.job import JobArgs, NodeArgs
+
+logger = get_logger("scheduler.ray")
+
+
+@dataclass
+class ActorArgs:
+    """What it takes to start one worker actor (reference: ActorArgs)."""
+
+    actor_name: str
+    executor: str = ""  # module:callable the actor runs
+    num_cpus: float = 1.0
+    memory_mb: int = 1024
+    resources: Dict[str, float] = field(default_factory=dict)  # e.g. {"TPU": 4}
+    env: Dict[str, str] = field(default_factory=dict)
+    args: List[Any] = field(default_factory=list)
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+def parse_type_id_from_actor_name(name: str):
+    """"worker-3" -> ("worker", 3) (reference ray_watcher.py:63)."""
+    node_type, _, node_id = name.rpartition("-")
+    try:
+        return node_type, int(node_id)
+    except ValueError:
+        return name, 0
+
+
+class RayClient:
+    """Deferred-import wrapper over the ray actor API (reference
+    ``RayClient.singleton_instance``).
+
+    Actors of one job are scoped by name prefix (``{job}__``): the state
+    API lists actors cluster-wide, so scaler/watcher logic would otherwise
+    see other jobs' actors. Node names stay prefix-free — the prefix is
+    added on create and stripped on list.
+    """
+
+    _instance: Optional["RayClient"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, namespace: str = "dlrover-tpu", job_name: str = ""):
+        import ray  # deferred: optional dependency
+
+        self._ray = ray
+        self.namespace = namespace
+        self._prefix = f"{job_name}__" if job_name else ""
+        if not ray.is_initialized():
+            ray.init(namespace=namespace, ignore_reinit_error=True)
+
+    @classmethod
+    def singleton_instance(
+        cls, namespace: str = "dlrover-tpu", job_name: str = ""
+    ) -> "RayClient":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(namespace, job_name)
+            return cls._instance
+
+    def create_actor(self, actor_args: ActorArgs):
+        import importlib
+
+        module_name, _, attr = actor_args.executor.partition(":")
+        executor = getattr(importlib.import_module(module_name), attr)
+        actor_cls = self._ray.remote(
+            num_cpus=actor_args.num_cpus,
+            memory=actor_args.memory_mb * 1024 * 1024,
+            resources=actor_args.resources or None,
+            name=self._prefix + actor_args.actor_name,
+            lifetime="detached",
+        )(executor)
+        return actor_cls.remote(*actor_args.args, **actor_args.kwargs)
+
+    def delete_actor(self, actor_name: str) -> bool:
+        try:
+            handle = self._ray.get_actor(
+                self._prefix + actor_name, namespace=self.namespace
+            )
+        except ValueError:
+            return False
+        self._ray.kill(handle)
+        return True
+
+    def list_actors(self) -> Dict[str, str]:
+        """{actor_name: state} for this job (prefix-filtered; the state
+        API itself is cluster-wide)."""
+        from ray.util.state import list_actors
+
+        out = {}
+        for actor in list_actors():
+            name = getattr(actor, "name", "") or actor.get("name", "")
+            state = getattr(actor, "state", "") or actor.get("state", "")
+            if not name or not name.startswith(self._prefix):
+                continue
+            out[name[len(self._prefix):]] = state
+        return out
+
+    def get_actor_status(self, actor_name: str) -> str:
+        return self.list_actors().get(actor_name, "DEAD")
+
+    def remote_call_actor(self, actor_name: str, func: str,
+                          args=(), kwargs=None, timeout: float = 30.0):
+        handle = self._ray.get_actor(
+            self._prefix + actor_name, namespace=self.namespace
+        )
+        ref = getattr(handle, func).remote(*args, **(kwargs or {}))
+        return self._ray.get(ref, timeout=timeout)
+
+    def check_health(self, actor_name: str) -> bool:
+        try:
+            return self.remote_call_actor(actor_name, "ping", timeout=5.0) is not None
+        except Exception:  # noqa: BLE001
+            return False
+
+
+def ray_job_args(
+    conf: Dict[str, Any],
+    job_name: str = "ray-job",
+    namespace: str = "dlrover-tpu",
+) -> JobArgs:
+    """Build JobArgs from a Ray job conf dict (reference: ``RayJobArgs.
+    initilize`` reading the python conf module). Expected shape::
+
+        {"worker": {"count": 4, "cpu": 8, "memory": 16384, "chips": 4},
+         "ps": {...},  # optional
+         "distribution_strategy": "spmd" | "ps", "node_unit": 1}
+    """
+    args = JobArgs(
+        platform=PlatformType.RAY,
+        namespace=namespace,
+        job_name=job_name,
+        distribution_strategy=conf.get(
+            "distribution_strategy", DistributionStrategy.SPMD
+        ),
+        node_unit=int(conf.get("node_unit", 1)),
+    )
+    for node_type in (NodeType.WORKER, NodeType.PS, NodeType.CHIEF,
+                      NodeType.EVALUATOR):
+        spec = conf.get(node_type)
+        if not spec:
+            continue
+        resource = NodeResource(
+            cpu=float(spec.get("cpu", 1)),
+            memory=int(spec.get("memory", 1024)),
+        )
+        resource.accelerator.chips = int(spec.get("chips", 0))
+        args.node_args[node_type] = NodeArgs(
+            group_resource=NodeGroupResource(
+                count=int(spec.get("count", 0)), node_resource=resource
+            ),
+            restart_count=int(spec.get("restart_count", 3)),
+        )
+    return args

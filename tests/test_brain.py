@@ -1,0 +1,282 @@
+"""Brain service: datastores, optimization algorithms, RPC service,
+config hot-reload, master-side optimizer integration."""
+
+import json
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.brain.algorithms import algorithm_names, get_algorithm
+from dlrover_tpu.brain.client import (
+    BrainClient,
+    BrainResourceOptimizer,
+    BrainStatsReporter,
+)
+from dlrover_tpu.brain.config import BrainConfig
+from dlrover_tpu.brain.datastore import (
+    MemoryDatastore,
+    SqliteDatastore,
+    new_datastore,
+)
+from dlrover_tpu.brain.messages import (
+    BrainJobMetrics,
+    MetricType,
+    OptimizeRequest,
+)
+from dlrover_tpu.brain.service import BrainService, BrainServicer
+from dlrover_tpu.common.constants import JobStage, NodeType
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.master.stats.training_metrics import RuntimeMetric
+
+
+def _runtime(store, uuid, speed, workers, ps_used_cpu=2.0, ps_cpu=8.0,
+             name="job-a"):
+    store.persist_metrics(BrainJobMetrics(
+        job_uuid=uuid, job_name=name, metric_type=MetricType.RUNTIME_INFO,
+        payload={
+            "speed": speed, "workers": workers,
+            "nodes": {
+                NodeType.PS: [{"name": "ps-0", "cpu": ps_cpu,
+                               "used_cpu": ps_used_cpu,
+                               "memory": 16384, "used_memory": 9000}],
+                NodeType.WORKER: [{} for _ in range(workers)],
+            },
+        },
+    ))
+
+
+class TestDatastore:
+    def test_memory_roundtrip(self):
+        store = MemoryDatastore()
+        _runtime(store, "u1", 10, 2)
+        rows = store.get_job_metrics("u1", MetricType.RUNTIME_INFO)
+        assert len(rows) == 1 and rows[0].payload["speed"] == 10
+
+    def test_sqlite_roundtrip(self, tmp_path):
+        store = SqliteDatastore(str(tmp_path / "brain.db"))
+        _runtime(store, "u1", 10, 2)
+        _runtime(store, "u2", 5, 1)
+        assert sorted(store.list_job_uuids()) == ["u1", "u2"]
+        rows = store.get_job_metrics("u1")
+        assert rows[0].payload["workers"] == 2
+        # durable across connections
+        store2 = SqliteDatastore(str(tmp_path / "brain.db"))
+        assert sorted(store2.list_job_uuids()) == ["u1", "u2"]
+
+    def test_spec_factory(self, tmp_path):
+        assert isinstance(new_datastore("memory"), MemoryDatastore)
+        assert isinstance(
+            new_datastore(f"sqlite://{tmp_path}/x.db"), SqliteDatastore
+        )
+        with pytest.raises(ValueError):
+            new_datastore("mysql://nope")
+
+
+class TestAlgorithms:
+    def test_registry_covers_reference_algorithms(self):
+        names = algorithm_names()
+        for expected in [
+            "optimize_job_ps_cold_create_resource",
+            "optimize_job_ps_create_resource",
+            "optimize_job_ps_init_adjust_resource",
+            "optimize_job_ps_oom_resource",
+            "optimize_job_hot_ps_resource",
+            "optimize_job_worker_create_resource",
+            "optimize_job_worker_create_oom_resource",
+            "optimize_job_worker_resource",
+        ]:
+            assert expected in names
+
+    def test_worker_resource_grows_with_headroom(self):
+        store = MemoryDatastore()
+        for i in range(8):
+            _runtime(store, "u1", speed=4.0 * 2, workers=2,
+                     ps_used_cpu=2.0)
+        plan = get_algorithm("optimize_job_worker_resource")(
+            store, OptimizeRequest(job_uuid="u1", config={})
+        )
+        assert plan.success
+        # util 0.25, threshold 0.8 -> capped at 2x current
+        assert plan.group_resources[NodeType.WORKER].count == 4
+
+    def test_worker_resource_stops_when_ps_saturated(self):
+        store = MemoryDatastore()
+        for _ in range(8):
+            _runtime(store, "u1", speed=8, workers=2, ps_used_cpu=7.5)
+        plan = get_algorithm("optimize_job_worker_resource")(
+            store, OptimizeRequest(job_uuid="u1")
+        )
+        assert not plan.success and "saturated" in plan.reason
+
+    def test_worker_resource_stops_on_efficiency_drop(self):
+        store = MemoryDatastore()
+        for _ in range(4):
+            _runtime(store, "u1", speed=20, workers=2)
+        for _ in range(4):
+            _runtime(store, "u1", speed=20, workers=4)  # no speedup
+        plan = get_algorithm("optimize_job_worker_resource")(
+            store, OptimizeRequest(job_uuid="u1")
+        )
+        assert not plan.success
+
+    def test_hot_ps_migration(self):
+        store = MemoryDatastore()
+        _runtime(store, "u1", speed=5, workers=2, ps_used_cpu=7.6)
+        plan = get_algorithm("optimize_job_hot_ps_resource")(
+            store, OptimizeRequest(job_uuid="u1")
+        )
+        assert plan.success and plan.node_resources["ps-0"]["cpu"] == 16.0
+
+    def test_ps_init_adjust_from_model(self):
+        store = MemoryDatastore()
+        store.persist_metrics(BrainJobMetrics(
+            job_uuid="u1", metric_type=MetricType.MODEL_FEATURE,
+            payload={"param_count": 8_000_000_000},
+        ))
+        plan = get_algorithm("optimize_job_ps_init_adjust_resource")(
+            store, OptimizeRequest(job_uuid="u1")
+        )
+        assert plan.success
+        group = plan.group_resources[NodeType.PS]
+        assert group.count == 8  # 8B params * 16B -> capped at 8 PSs
+        assert group.memory >= 16384
+
+    def test_oom_doubles_memory(self):
+        plan = get_algorithm("optimize_job_worker_create_oom_resource")(
+            MemoryDatastore(),
+            OptimizeRequest(job_uuid="u1",
+                            config={"current_memory": 4096}),
+        )
+        assert plan.group_resources[NodeType.WORKER].memory == 8192
+
+    def test_create_learns_from_similar_finished_jobs(self):
+        store = MemoryDatastore()
+        # a finished run of the same recurring job
+        store.persist_metrics(BrainJobMetrics(
+            job_uuid="old", job_name="nightly-20260701",
+            metric_type=MetricType.JOB_META,
+            payload={"name": "nightly-20260701"},
+        ))
+        for _ in range(3):
+            _runtime(store, "old", speed=10, workers=6,
+                     name="nightly-20260701")
+        store.persist_metrics(BrainJobMetrics(
+            job_uuid="old", job_name="nightly-20260701",
+            metric_type=MetricType.JOB_EXIT_REASON,
+            payload={"reason": "succeeded"},
+        ))
+        plan = get_algorithm("optimize_job_worker_create_resource")(
+            store, OptimizeRequest(job_uuid="new",
+                                   job_name="nightly-20260728"),
+        )
+        assert plan.group_resources[NodeType.WORKER].count == 6
+        ps_plan = get_algorithm("optimize_job_ps_create_resource")(
+            store, OptimizeRequest(job_uuid="new",
+                                   job_name="nightly-20260728"),
+        )
+        # 1.25x headroom over the hottest observed PS
+        assert ps_plan.group_resources[NodeType.PS].cpu == pytest.approx(2.5)
+
+    def test_cold_create_without_history(self):
+        plan = get_algorithm("optimize_job_ps_create_resource")(
+            MemoryDatastore(), OptimizeRequest(job_name="never-seen")
+        )
+        assert plan.group_resources[NodeType.PS].count == 1
+
+
+class TestConfig:
+    def test_defaults_and_hot_reload(self, tmp_path):
+        path = tmp_path / "brain.json"
+        path.write_text(json.dumps({
+            "stage_algorithms": {JobStage.RUNNING: "optimize_job_hot_ps_resource"},
+            "algorithm_configs": {
+                "optimize_job_worker_resource": {"max_workers": 16},
+            },
+        }))
+        cfg = BrainConfig(str(path))
+        assert cfg.algorithm_for(JobStage.RUNNING) == (
+            "optimize_job_hot_ps_resource"
+        )
+        assert cfg.algorithm_for(JobStage.CREATE) == (
+            "optimize_job_ps_create_resource"
+        )
+        assert cfg.algorithm_config(
+            "optimize_job_worker_resource"
+        )["max_workers"] == 16
+        # rewrite -> picked up on next read (mtime-based)
+        time.sleep(0.01)
+        path.write_text(json.dumps({
+            "stage_algorithms": {JobStage.RUNNING: "optimize_job_worker_resource"},
+        }))
+        os.utime(path)
+        assert cfg.algorithm_for(JobStage.RUNNING) == (
+            "optimize_job_worker_resource"
+        )
+
+
+class TestServiceOverRpc:
+    @pytest.fixture()
+    def service(self):
+        svc = BrainService(port=0)
+        svc.start()
+        yield svc
+        svc.stop()
+
+    def test_persist_optimize_query_roundtrip(self, service):
+        client = BrainClient(f"127.0.0.1:{service.port}")
+        reporter = BrainStatsReporter("u1", "job-a", client=client)
+        for _ in range(8):
+            reporter.report_runtime_stats(RuntimeMetric(
+                speed=8.0,
+                running_nodes={
+                    NodeType.WORKER: [{}, {}],
+                    NodeType.PS: [{"name": "ps-0", "cpu": 8,
+                                   "used_cpu": 2.0, "memory": 16384}],
+                },
+            ))
+        plan = client.optimize(OptimizeRequest(
+            job_uuid="u1", job_name="job-a", stage=JobStage.RUNNING,
+        ))
+        assert plan.success
+        assert plan.group_resources[NodeType.WORKER].count == 4
+        rows = client.get_job_metrics("u1", MetricType.RUNTIME_INFO)
+        assert len(rows) == 8
+        client.close()
+
+    def test_master_side_optimizer(self, service):
+        client = BrainClient(f"127.0.0.1:{service.port}")
+        opt = BrainResourceOptimizer("job-a", client=client)
+        opt.update_job_uuid("u2")
+        # no data yet: RUNNING stage declines, returns None
+        assert opt.generate_opt_plan(JobStage.RUNNING) is None
+        # OOM recovery always produces a grown plan
+        res = opt.generate_oom_recovery_plan(
+            "worker-1", NodeResource(cpu=4, memory=4096)
+        )
+        assert res.memory == 8192
+        client.close()
+
+    def test_unknown_message_rejected(self, service):
+        servicer = service.servicer
+        from dlrover_tpu.common.comm import Response
+
+        out = servicer.report(Response())
+        assert not out.success
+
+
+class TestServicerAlgorithms:
+    def test_explicit_algorithm_override(self):
+        servicer = BrainServicer()
+        plan = servicer.optimize(OptimizeRequest(
+            job_uuid="u", job_name="j",
+            algorithm="optimize_job_ps_cold_create_resource",
+        ))
+        assert plan.success
+        assert plan.group_resources[NodeType.PS].count == 1
+
+    def test_unknown_stage_fails_cleanly(self):
+        plan = BrainServicer().optimize(
+            OptimizeRequest(stage="not-a-stage")
+        )
+        assert not plan.success

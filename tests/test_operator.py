@@ -1,0 +1,216 @@
+"""Operator reconcilers against a fake API server (the reference tests
+drive its Go reconcilers against canned objects the same way)."""
+
+from dlrover_tpu.operator.controller import (
+    ElasticJobReconciler,
+    ScalePlanReconciler,
+    build_master_pod,
+    build_master_service,
+    master_addr,
+    master_pod_name,
+    run_operator,
+)
+from dlrover_tpu.operator.types import (
+    ElasticJob,
+    JobPhase,
+    ScalePlan,
+    elastic_job_cr,
+)
+from dlrover_tpu.scheduler.kubernetes import (
+    ELASTICJOB_PLURAL,
+    SCALEPLAN_PLURAL,
+    build_scale_plan_cr,
+)
+
+
+class FakeK8sClient:
+    """Tiny in-memory API server: pods, services, custom resources."""
+
+    def __init__(self):
+        self.pods = {}
+        self.services = {}
+        self.crs = {ELASTICJOB_PLURAL: {}, SCALEPLAN_PLURAL: {}}
+
+    # pod API
+    def create_pod(self, pod):
+        pod.setdefault("status", {"phase": "Pending"})
+        self.pods[pod["metadata"]["name"]] = pod
+
+    def delete_pod(self, name):
+        self.pods.pop(name, None)
+
+    def list_pods(self, label_selector=""):
+        wants = dict(
+            kv.split("=") for kv in label_selector.split(",") if "=" in kv
+        )
+        out = []
+        for pod in self.pods.values():
+            labels = pod["metadata"].get("labels", {})
+            if all(labels.get(k) == v for k, v in wants.items()):
+                out.append(pod)
+        return out
+
+    def create_service(self, service):
+        self.services[service["metadata"]["name"]] = service
+
+    # CR API
+    def create_custom_resource(self, plural, body):
+        self.crs[plural][body["metadata"]["name"]] = body
+
+    def get_custom_resource(self, plural, name):
+        return self.crs[plural].get(name)
+
+    def list_custom_resources(self, plural):
+        return list(self.crs[plural].values())
+
+    def update_custom_resource_status(self, plural, name, body):
+        self.crs[plural][name] = body
+
+    # test helpers
+    def set_pod_phase(self, name, phase):
+        self.pods[name]["status"]["phase"] = phase
+
+
+def _job_cr(name="job1"):
+    return elastic_job_cr(
+        name,
+        replica_specs={
+            "worker": {"replicas": 2, "resources": {"cpu": 4, "memory": 8192,
+                                                    "tpu": 4}},
+        },
+    )
+
+
+class TestTypes:
+    def test_elastic_job_parses_spec(self):
+        job = ElasticJob.from_dict(_job_cr())
+        assert job.name == "job1"
+        assert job.replica_specs["worker"].replicas == 2
+        assert job.replica_specs["worker"].tpu_chips == 4
+        assert job.phase == JobPhase.CREATED
+
+    def test_scale_plan_parses(self):
+        cr = build_scale_plan_cr(
+            "job1", {"worker": {"replicas": 4}}, remove_pods=["worker-9"]
+        )
+        plan = ScalePlan.from_dict(cr)
+        assert plan.owner_job == "job1"
+        assert plan.replica_resource_specs["worker"]["replicas"] == 4
+        assert plan.remove_pods == ["worker-9"]
+        assert plan.phase == JobPhase.PENDING
+
+
+class TestMasterBootstrap:
+    def test_master_pod_and_service(self):
+        job = ElasticJob.from_dict(_job_cr())
+        pod = build_master_pod(job, "img:1")
+        assert pod["metadata"]["name"] == master_pod_name("job1")
+        cmd = pod["spec"]["containers"][0]["command"]
+        assert "--platform" in cmd and "k8s" in cmd
+        assert "--node_num" in cmd and "2" in cmd
+        svc = build_master_service(job)
+        assert svc["spec"]["selector"]["elasticjob-name"] == "job1"
+        assert master_addr("job1", "default").endswith(":50001")
+
+
+class TestElasticJobReconciler:
+    def test_created_bootstraps_master_then_pending(self):
+        client = FakeK8sClient()
+        client.create_custom_resource(ELASTICJOB_PLURAL, _job_cr())
+        rec = ElasticJobReconciler(client, "img:1")
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        assert master_pod_name("job1") in client.pods
+        assert len(client.services) == 1
+        cr = client.get_custom_resource(ELASTICJOB_PLURAL, "job1")
+        assert cr["status"]["phase"] == JobPhase.PENDING
+
+    def test_phase_follows_master_pod(self):
+        client = FakeK8sClient()
+        client.create_custom_resource(ELASTICJOB_PLURAL, _job_cr())
+        rec = ElasticJobReconciler(client, "img:1")
+        cr = client.get_custom_resource(ELASTICJOB_PLURAL, "job1")
+        rec.reconcile(cr)  # Created -> Pending, master created
+        client.set_pod_phase(master_pod_name("job1"), "Running")
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        assert client.get_custom_resource(ELASTICJOB_PLURAL, "job1")[
+            "status"]["phase"] == JobPhase.RUNNING
+        client.set_pod_phase(master_pod_name("job1"), "Succeeded")
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        assert client.get_custom_resource(ELASTICJOB_PLURAL, "job1")[
+            "status"]["phase"] == JobPhase.SUCCEEDED
+
+    def test_failed_master_is_relaunched(self):
+        client = FakeK8sClient()
+        client.create_custom_resource(ELASTICJOB_PLURAL, _job_cr())
+        rec = ElasticJobReconciler(client, "img:1")
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        client.set_pod_phase(master_pod_name("job1"), "Failed")
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        # relaunched: pod exists again and is Pending
+        assert client.pods[master_pod_name("job1")]["status"][
+            "phase"] == "Pending"
+
+    def test_terminal_job_stops_pods(self):
+        client = FakeK8sClient()
+        cr = _job_cr()
+        cr["status"]["phase"] = JobPhase.FAILED
+        client.create_custom_resource(ELASTICJOB_PLURAL, cr)
+        # a leftover running worker pod
+        client.create_pod({
+            "metadata": {"name": "job1-worker-0",
+                         "labels": {"elasticjob-name": "job1",
+                                    "replica-type": "worker"}},
+            "status": {"phase": "Running"},
+        })
+        ElasticJobReconciler(client).reconcile(cr)
+        assert "job1-worker-0" not in client.pods
+
+    def test_pending_scaleplan_relayed_when_scaling(self):
+        client = FakeK8sClient()
+        cr = _job_cr()
+        cr["status"]["phase"] = JobPhase.SCALING
+        client.create_custom_resource(ELASTICJOB_PLURAL, cr)
+        plan_cr = build_scale_plan_cr("job1", {"worker": {"replicas": 4}})
+        plan_cr["status"] = {"phase": JobPhase.PENDING}
+        client.create_custom_resource(SCALEPLAN_PLURAL, plan_cr)
+        ElasticJobReconciler(client).reconcile(cr)
+        name = plan_cr["metadata"]["name"]
+        assert client.get_custom_resource(SCALEPLAN_PLURAL, name)[
+            "status"]["phase"] == JobPhase.SCALING
+
+
+class TestScalePlanReconciler:
+    def test_succeeds_when_replicas_match(self):
+        client = FakeK8sClient()
+        plan_cr = build_scale_plan_cr("job1", {"worker": {"replicas": 2}})
+        plan_cr["status"] = {"phase": JobPhase.SCALING}
+        client.create_custom_resource(SCALEPLAN_PLURAL, plan_cr)
+        for i in range(2):
+            client.create_pod({
+                "metadata": {"name": f"job1-worker-{i}",
+                             "labels": {"elasticjob-name": "job1",
+                                        "replica-type": "worker"}},
+                "status": {"phase": "Running"},
+            })
+        ScalePlanReconciler(client).reconcile(plan_cr)
+        assert plan_cr["status"]["phase"] == JobPhase.SUCCEEDED
+
+    def test_stays_scaling_until_pods_arrive(self):
+        client = FakeK8sClient()
+        plan_cr = build_scale_plan_cr("job1", {"worker": {"replicas": 2}})
+        plan_cr["status"] = {"phase": JobPhase.SCALING}
+        client.create_custom_resource(SCALEPLAN_PLURAL, plan_cr)
+        ScalePlanReconciler(client).reconcile(plan_cr)
+        assert plan_cr["status"]["phase"] == JobPhase.SCALING
+
+
+class TestOperatorLoop:
+    def test_end_to_end_rounds(self):
+        client = FakeK8sClient()
+        client.create_custom_resource(ELASTICJOB_PLURAL, _job_cr())
+        run_operator(client, poll_interval=0, max_rounds=1)
+        assert master_pod_name("job1") in client.pods
+        client.set_pod_phase(master_pod_name("job1"), "Running")
+        run_operator(client, poll_interval=0, max_rounds=1)
+        assert client.get_custom_resource(ELASTICJOB_PLURAL, "job1")[
+            "status"]["phase"] == JobPhase.RUNNING

@@ -56,6 +56,13 @@ class TestFlashAttention:
         ref = mha_reference(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_rejects_tpu_illegal_tiling(self):
+        # 1000's best divisor under 512 is 500 (not a multiple of 8):
+        # explicit error instead of a Mosaic lowering failure later
+        q, k, v = _qkv(b=1, h=1, s=1000, d=64)
+        with pytest.raises(ValueError, match="multiple of 8"):
+            flash_attention(q, k, v, True, None, 512, 512)
+
     def test_multi_block_grid_forward_and_grad(self):
         # explicit small blocks force a 4x4 grid so the scratch-carry
         # accumulation, re-init boundaries, and causal block-skip paths

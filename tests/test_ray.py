@@ -1,0 +1,202 @@
+"""Ray platform: job args, actor scaler, actor watcher, job submitter —
+all against fakes (the reference tests monkey-patch RayClient the same
+way; no Ray cluster required)."""
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.client.ray_job_submitter import RayJobSubmitter
+from dlrover_tpu.master.scaler.actor_scaler import ActorScaler
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+from dlrover_tpu.master.watcher.ray_watcher import (
+    ActorWatcher,
+    actor_state_to_status,
+)
+from dlrover_tpu.scheduler.ray import (
+    ActorArgs,
+    parse_type_id_from_actor_name,
+    ray_job_args,
+)
+
+
+class FakeRayClient:
+    """In-memory actor registry standing in for scheduler.ray.RayClient."""
+
+    def __init__(self):
+        self.actors = {}  # name -> state
+        self.created = []
+        self.deleted = []
+
+    def create_actor(self, actor_args: ActorArgs):
+        self.actors[actor_args.actor_name] = "ALIVE"
+        self.created.append(actor_args)
+
+    def delete_actor(self, name):
+        self.deleted.append(name)
+        return self.actors.pop(name, None) is not None
+
+    def list_actors(self):
+        return dict(self.actors)
+
+
+class TestRayJobArgs:
+    def test_conf_to_job_args(self):
+        args = ray_job_args({
+            "worker": {"count": 4, "cpu": 8, "memory": 16384, "chips": 4},
+            "ps": {"count": 2, "cpu": 16, "memory": 32768},
+            "distribution_strategy": "ps",
+            "node_unit": 2,
+        }, job_name="rj")
+        assert args.platform == "ray"
+        assert args.node_unit == 2
+        worker = args.node_args[NodeType.WORKER].group_resource
+        assert worker.count == 4
+        assert worker.node_resource.accelerator.chips == 4
+        assert args.node_args[NodeType.PS].group_resource.count == 2
+
+    def test_actor_name_roundtrip(self):
+        assert parse_type_id_from_actor_name("worker-3") == ("worker", 3)
+        assert parse_type_id_from_actor_name("ps-10") == ("ps", 10)
+        node = Node(node_type="worker", node_id=3)
+        assert parse_type_id_from_actor_name(node.name) == ("worker", 3)
+
+
+class TestActorScaler:
+    def _scaler(self, client):
+        return ActorScaler("rj", client, master_addr="127.0.0.1:1234")
+
+    def test_scale_up_from_group_target(self):
+        client = FakeRayClient()
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=3, node_resource=NodeResource(cpu=2, memory=2048)
+        )
+        self._scaler(client).scale(plan)
+        assert sorted(client.actors) == ["worker-0", "worker-1", "worker-2"]
+        env = client.created[0].env
+        assert env["DLROVER_MASTER_ADDR"] == "127.0.0.1:1234"
+        assert env["NODE_TYPE"] == NodeType.WORKER
+
+    def test_scale_down_removes_highest_ids(self):
+        client = FakeRayClient()
+        for i in range(4):
+            client.actors[f"worker-{i}"] = "ALIVE"
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=2, node_resource=NodeResource()
+        )
+        self._scaler(client).scale(plan)
+        assert sorted(client.actors) == ["worker-0", "worker-1"]
+        assert sorted(client.deleted) == ["worker-2", "worker-3"]
+
+    def test_relaunch_concrete_node(self):
+        client = FakeRayClient()
+        plan = ScalePlan()
+        plan.launch_nodes.append(Node(node_type="worker", node_id=7))
+        plan.remove_nodes.append(Node(node_type="worker", node_id=2))
+        client.actors["worker-2"] = "ALIVE"
+        self._scaler(client).scale(plan)
+        assert "worker-7" in client.actors
+        assert "worker-2" not in client.actors
+
+    def test_initial_plan_does_not_double_create(self):
+        # the initial plan carries the same workers in launch_nodes AND
+        # node_group_resources; only one actor per name must exist
+        client = FakeRayClient()
+        plan = ScalePlan()
+        plan.launch_nodes = [Node(node_type="worker", node_id=i)
+                             for i in range(2)]
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=2, node_resource=NodeResource()
+        )
+        self._scaler(client).scale(plan)
+        assert sorted(client.actors) == ["worker-0", "worker-1"]
+        assert len(client.created) == 2
+
+    def test_scale_up_skips_used_ids(self):
+        client = FakeRayClient()
+        client.actors["worker-0"] = "ALIVE"
+        client.actors["worker-2"] = "ALIVE"
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=3, node_resource=NodeResource()
+        )
+        self._scaler(client).scale(plan)
+        # new actor takes a fresh id above the max, not the hole
+        assert "worker-3" in client.actors
+
+
+class TestActorWatcher:
+    def test_list_maps_states(self):
+        client = FakeRayClient()
+        client.actors = {"worker-0": "ALIVE", "worker-1": "PENDING_CREATION"}
+        watcher = ActorWatcher("rj", client)
+        nodes = {n.name: n for n in watcher.list()}
+        assert nodes["worker-0"].status == NodeStatus.RUNNING
+        assert nodes["worker-1"].status == NodeStatus.PENDING
+
+    def test_watch_emits_transitions(self):
+        client = FakeRayClient()
+        client.actors = {"worker-0": "PENDING_CREATION"}
+        watcher = ActorWatcher("rj", client, poll_interval=0.01)
+        stream = watcher.watch()
+        ev = next(stream)
+        assert (ev.event_type, ev.node.name) == (NodeEventType.ADDED,
+                                                "worker-0")
+        client.actors["worker-0"] = "ALIVE"
+        ev = next(stream)
+        assert ev.event_type == NodeEventType.MODIFIED
+        assert ev.node.status == NodeStatus.RUNNING
+        del client.actors["worker-0"]
+        ev = next(stream)
+        assert ev.event_type == NodeEventType.DELETED
+        watcher.stop()
+
+    def test_state_mapping_unknown(self):
+        assert actor_state_to_status("WEIRD") == NodeStatus.UNKNOWN
+
+
+class FakeSubmissionClient:
+    def __init__(self):
+        self.jobs = {}
+
+    def submit_job(self, entrypoint, runtime_env=None):
+        job_id = f"raysubmit_{len(self.jobs)}"
+        self.jobs[job_id] = {"entrypoint": entrypoint, "status": "RUNNING"}
+        return job_id
+
+    def get_job_status(self, job_id):
+        return self.jobs[job_id]["status"]
+
+    def stop_job(self, job_id):
+        self.jobs[job_id]["status"] = "STOPPED"
+        return True
+
+    def get_job_info(self, job_id):
+        return self.jobs[job_id]
+
+    def get_job_logs(self, job_id):
+        return ""
+
+
+class TestRayJobSubmitter:
+    def test_submit_and_wait(self):
+        fake = FakeSubmissionClient()
+        submitter = RayJobSubmitter(
+            conf={"job_name": "rj", "worker": {"count": 2}}, client=fake
+        )
+        job_id = submitter.submit()
+        entry = fake.jobs[job_id]["entrypoint"]
+        assert "--platform ray" in entry and "rj" in entry
+        fake.jobs[job_id]["status"] = "SUCCEEDED"
+        assert submitter.wait_until_finish(job_id, timeout=1) == "SUCCEEDED"
+
+    def test_stop(self):
+        fake = FakeSubmissionClient()
+        submitter = RayJobSubmitter(conf={"job_name": "rj"}, client=fake)
+        job_id = submitter.submit()
+        assert submitter.stop_job(job_id)
+        assert submitter.get_status(job_id) == "STOPPED"
