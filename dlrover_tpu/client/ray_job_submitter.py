@@ -41,11 +41,14 @@ class RayJobSubmitter:
         self._client = client
 
     def _entrypoint(self) -> str:
+        import shlex
+
         job_name = self._conf.get("job_name", "ray-job")
         conf_json = json.dumps(self._conf)
         return (
             "python -m dlrover_tpu.master.main --platform ray "
-            f"--job_name {job_name} --ray_conf '{conf_json}'"
+            f"--job_name {shlex.quote(job_name)} "
+            f"--ray_conf {shlex.quote(conf_json)}"
         )
 
     def submit(self) -> str:

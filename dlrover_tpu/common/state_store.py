@@ -1,0 +1,159 @@
+"""Pluggable master-state backends.
+
+Role parity: ``dlrover/python/util/state/`` (``memory_store.py``,
+``stats_backend.py``, ``store_mananger.py``) — an interface for durable
+master state (shard checkpoints, rendezvous rounds, job metadata) with
+an in-memory default. The durable backend here is a JSON-file store
+(checkpointable to a PVC/GCS-fuse mount); the interface is the seam for
+anything stronger.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional
+
+
+class StateBackend(ABC):
+    @abstractmethod
+    def set(self, key: str, value: Any) -> None:
+        ...
+
+    @abstractmethod
+    def get(self, key: str, default: Any = None) -> Any:
+        ...
+
+    @abstractmethod
+    def delete(self, key: str) -> bool:
+        ...
+
+    @abstractmethod
+    def keys(self, prefix: str = "") -> List[str]:
+        ...
+
+    def update(self, values: Dict[str, Any]) -> None:
+        for k, v in values.items():
+            self.set(k, v)
+
+
+class MemoryStateBackend(StateBackend):
+    """Default: master state lives and dies with the process
+    (reference memory_store.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Any] = {}
+
+    def set(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def get(self, key, default=None):
+        with self._lock:
+            return self._data.get(key, default)
+
+    def delete(self, key):
+        with self._lock:
+            return self._data.pop(key, _MISSING) is not _MISSING
+
+    def keys(self, prefix=""):
+        with self._lock:
+            return [k for k in self._data if k.startswith(prefix)]
+
+
+_MISSING = object()
+
+
+class FileStateBackend(StateBackend):
+    """JSON-file-backed state: every mutation rewrites the file
+    atomically (tmp + rename), so a relaunched master resumes from the
+    last consistent snapshot. Values must be JSON-serializable."""
+
+    def __init__(self, path: str, flush_every: float = 0.0):
+        self._path = path
+        self._lock = threading.Lock()
+        self._flush_every = flush_every
+        self._last_flush = 0.0
+        self._data: Dict[str, Any] = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._data = json.load(f)
+            except (OSError, ValueError):
+                self._data = {}
+
+    def _flush_locked(self, force: bool = False):
+        now = time.time()
+        if not force and self._flush_every and (
+            now - self._last_flush < self._flush_every
+        ):
+            return
+        tmp = f"{self._path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._data, f)
+        os.replace(tmp, self._path)
+        self._last_flush = now
+
+    def set(self, key, value):
+        json.dumps(value)  # fail fast on non-serializable values
+        with self._lock:
+            self._data[key] = value
+            self._flush_locked()
+
+    def get(self, key, default=None):
+        with self._lock:
+            return self._data.get(key, default)
+
+    def delete(self, key):
+        with self._lock:
+            existed = self._data.pop(key, _MISSING) is not _MISSING
+            if existed:
+                self._flush_locked()
+            return existed
+
+    def keys(self, prefix=""):
+        with self._lock:
+            return [k for k in self._data if k.startswith(prefix)]
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked(force=True)
+
+
+class StoreManager:
+    """Backend registry/factory (reference store_mananger.py): named
+    stores, each independently backed."""
+
+    _lock = threading.Lock()
+    _stores: Dict[str, StateBackend] = {}
+
+    @classmethod
+    def build_store(cls, name: str, backend: str = "memory",
+                    path: str = "") -> StateBackend:
+        with cls._lock:
+            if name in cls._stores:
+                return cls._stores[name]
+            if backend == "memory":
+                store: StateBackend = MemoryStateBackend()
+            elif backend == "file":
+                if not path:
+                    raise ValueError("file backend requires path")
+                store = FileStateBackend(path)
+            else:
+                raise ValueError(f"unknown state backend {backend!r}")
+            cls._stores[name] = store
+            return store
+
+    @classmethod
+    def get_store(cls, name: str) -> Optional[StateBackend]:
+        with cls._lock:
+            return cls._stores.get(name)
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._stores.clear()

@@ -19,7 +19,7 @@ from dlrover_tpu.scheduler.ray import (
 
 logger = get_logger("scaler.actor")
 
-DEFAULT_EXECUTOR = "dlrover_tpu.trainer.bootstrap:worker_main"
+DEFAULT_EXECUTOR = "dlrover_tpu.scheduler.ray:RayWorker"
 
 
 class ActorScaler(Scaler):

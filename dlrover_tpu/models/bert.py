@@ -1,0 +1,219 @@
+"""BERT-family bidirectional encoder.
+
+Role parity: the reference accelerates HF Bert via module surgery
+(``atorch/modules/distributed_modules/transformer.py:39`` sharded Bert
+attention/MLP, ``modules/transformer/layers.py:729`` BertAttentionFA).
+TPU-first like ``models.llama``: functional init/apply, scan over
+stacked layers, Pallas flash attention (non-causal) or the XLA
+reference, post-LN residuals per the original architecture.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.models.losses import masked_lm_loss
+from dlrover_tpu.models.common import (
+    dense_init as _dense,
+    layer_norm as _layer_norm,
+)
+from dlrover_tpu.ops.attention_ref import mha_reference
+from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.remat import apply_remat
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat_policy: str = "dots_saveable"
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def bert_base(**overrides) -> BertConfig:
+    return replace(BertConfig(), **overrides)
+
+
+def bert_large(**overrides) -> BertConfig:
+    return replace(
+        BertConfig(hidden_size=1024, intermediate_size=4096,
+                   num_layers=24, num_heads=16),
+        **overrides,
+    )
+
+
+def bert_tiny(**overrides) -> BertConfig:
+    """Test-scale config (CPU mesh friendly)."""
+    return replace(
+        BertConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                   num_layers=2, num_heads=4, max_position=64,
+                   compute_dtype=jnp.float32, use_flash=False),
+        **overrides,
+    )
+
+
+def init(rng: jax.Array, config: BertConfig) -> Dict:
+    c = config
+    dt = c.param_dtype
+    keys = iter(jax.random.split(rng, 16))
+    l, d, f, h = c.num_layers, c.hidden_size, c.intermediate_size, c.num_heads
+    hd = c.head_dim
+
+    return {
+        "embeddings": {
+            "word": {"embedding": jax.random.normal(
+                next(keys), (c.vocab_size, d), dt) * 0.02},
+            "position": {"embedding": jax.random.normal(
+                next(keys), (c.max_position, d), dt) * 0.02},
+            "token_type": {"embedding": jax.random.normal(
+                next(keys), (c.type_vocab_size, d), dt) * 0.02},
+            "norm": {"scale": jnp.ones((d,), dt),
+                     "bias": jnp.zeros((d,), dt)},
+        },
+        "layers": {
+            "q_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dt),
+                       "bias": jnp.zeros((l, h * hd), dt)},
+            "k_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dt),
+                       "bias": jnp.zeros((l, h * hd), dt)},
+            "v_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dt),
+                       "bias": jnp.zeros((l, h * hd), dt)},
+            "o_proj": {"kernel": _dense(next(keys), (l, h * hd, d), dt),
+                       "bias": jnp.zeros((l, d), dt)},
+            "attn_norm": {"scale": jnp.ones((l, d), dt),
+                          "bias": jnp.zeros((l, d), dt)},
+            "up_proj": {"kernel": _dense(next(keys), (l, d, f), dt),
+                        "bias": jnp.zeros((l, f), dt)},
+            "down_proj": {"kernel": _dense(next(keys), (l, f, d), dt,
+                                           scale=1.0 / math.sqrt(f)),
+                          "bias": jnp.zeros((l, d), dt)},
+            "ffn_norm": {"scale": jnp.ones((l, d), dt),
+                         "bias": jnp.zeros((l, d), dt)},
+        },
+        "pooler": {"kernel": _dense(next(keys), (d, d), dt),
+                   "bias": jnp.zeros((d,), dt)},
+        "mlm_head": {"kernel": _dense(next(keys), (d, c.vocab_size), dt),
+                     "bias": jnp.zeros((c.vocab_size,), dt)},
+    }
+
+
+def _attention(x, layer, config: BertConfig, mask):
+    c = config
+    b, s, d = x.shape
+    h, hd = c.num_heads, c.head_dim
+    q = (x @ layer["q_proj"]["kernel"] + layer["q_proj"]["bias"])
+    k = (x @ layer["k_proj"]["kernel"] + layer["k_proj"]["bias"])
+    v = (x @ layer["v_proj"]["kernel"] + layer["v_proj"]["bias"])
+    q, k, v = (
+        t.reshape(b, s, h, hd).transpose(0, 2, 1, 3) for t in (q, k, v)
+    )
+    if mask is None and c.use_flash:
+        out = flash_attention(q, k, v, False)
+    else:
+        bias = None
+        if mask is not None:
+            # [B, S] 1/0 attention mask -> additive bias on keys
+            bias = jnp.where(
+                mask[:, None, None, :] > 0, 0.0,
+                jnp.finfo(jnp.float32).min,
+            )
+        out = mha_reference(q, k, v, causal=False, bias=bias)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
+
+
+def _encoder_block(c: BertConfig, mask):
+    def block(x, layer):
+        attn = _attention(x, layer, c, mask)
+        x = _layer_norm(x + attn, layer["attn_norm"]["scale"],
+                        layer["attn_norm"]["bias"], c.layer_norm_eps)
+        ffn = jax.nn.gelu(
+            x @ layer["up_proj"]["kernel"] + layer["up_proj"]["bias"]
+        )
+        ffn = ffn @ layer["down_proj"]["kernel"] + layer["down_proj"]["bias"]
+        x = _layer_norm(x + ffn, layer["ffn_norm"]["scale"],
+                        layer["ffn_norm"]["bias"], c.layer_norm_eps)
+        return x, None
+
+    return block
+
+
+def apply(
+    params: Dict,
+    input_ids: jax.Array,  # [B, S]
+    config: BertConfig,
+    token_type_ids: Optional[jax.Array] = None,
+    attention_mask: Optional[jax.Array] = None,  # [B, S] 1=attend
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (sequence_output [B, S, D], pooled [B, D])."""
+    c = config
+    b, s = input_ids.shape
+    emb = params["embeddings"]
+    x = emb["word"]["embedding"][input_ids]
+    x = x + emb["position"]["embedding"][None, :s, :]
+    types = token_type_ids if token_type_ids is not None else (
+        jnp.zeros_like(input_ids)
+    )
+    x = x + emb["token_type"]["embedding"][types]
+    x = _layer_norm(x, emb["norm"]["scale"], emb["norm"]["bias"],
+                    c.layer_norm_eps).astype(c.compute_dtype)
+
+    block = apply_remat(_encoder_block(c, attention_mask), c.remat_policy)
+    x, _ = lax.scan(block, x, params["layers"])
+
+    pooled = jnp.tanh(
+        x[:, 0, :] @ params["pooler"]["kernel"] + params["pooler"]["bias"]
+    )
+    return x, pooled
+
+
+def apply_mlm(params, input_ids, config, **kwargs) -> jax.Array:
+    """Masked-LM logits [B, S, V] in f32."""
+    x, _ = apply(params, input_ids, config, **kwargs)
+    logits = x @ params["mlm_head"]["kernel"].astype(x.dtype) + (
+        params["mlm_head"]["bias"].astype(x.dtype)
+    )
+    return logits.astype(jnp.float32)
+
+
+def make_init_fn(config: BertConfig):
+    return partial(init, config=config)
+
+
+def make_mlm_loss_fn(config: BertConfig):
+    """MLM loss over {"input_ids", "labels"} (-100 = unmasked, HF
+    convention)."""
+
+    def loss_fn(params, batch, rng):
+        del rng
+        logits = apply_mlm(params, batch["input_ids"], config)
+        return masked_lm_loss(logits, batch["labels"]), {}
+
+    return loss_fn
+
+
+def param_count(config: BertConfig) -> int:
+    abstract = jax.eval_shape(partial(init, config=config),
+                              jax.random.PRNGKey(0))
+    return sum(
+        math.prod(int(s) for s in leaf.shape)
+        for leaf in jax.tree.leaves(abstract)
+    )

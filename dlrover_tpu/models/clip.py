@@ -1,0 +1,267 @@
+"""CLIP-style dual encoder (text tower + ViT vision tower).
+
+Role parity: the reference ships sharded CLIP attention/MLP modules
+(``atorch/modules/distributed_modules/transformer.py`` CLIP blocks,
+``modules/transformer/layers.py`` CLIP FlashAttention adapters). Written
+TPU-first: both towers are scan-over-layers pre-LN transformers sharing
+one block implementation; the contrastive loss is computed in-batch (for
+multi-host training wrap it with an all-gather over the data axis).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.models.common import (
+    dense_init as _dense,
+    layer_norm as _layer_norm,
+)
+from dlrover_tpu.ops.attention_ref import mha_reference
+from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.remat import apply_remat
+
+
+@dataclass(frozen=True)
+class TowerConfig:
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_layers: int = 12
+    num_heads: int = 8
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+@dataclass(frozen=True)
+class CLIPConfig:
+    vocab_size: int = 49408
+    max_text_len: int = 77
+    image_size: int = 224
+    patch_size: int = 32
+    projection_dim: int = 512
+    text: TowerConfig = TowerConfig()
+    vision: TowerConfig = TowerConfig(hidden_size=768,
+                                      intermediate_size=3072,
+                                      num_heads=12)
+    logit_scale_init: float = 2.6592  # ln(1/0.07), the CLIP paper value
+    layer_norm_eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat_policy: str = "dots_saveable"
+    use_flash: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def clip_base(**overrides) -> CLIPConfig:
+    return replace(CLIPConfig(), **overrides)
+
+
+def clip_tiny(**overrides) -> CLIPConfig:
+    """Test-scale config."""
+    return replace(
+        CLIPConfig(
+            vocab_size=128, max_text_len=16, image_size=32, patch_size=8,
+            projection_dim=32,
+            text=TowerConfig(hidden_size=32, intermediate_size=64,
+                             num_layers=2, num_heads=4),
+            vision=TowerConfig(hidden_size=48, intermediate_size=96,
+                               num_layers=2, num_heads=4),
+            compute_dtype=jnp.float32, use_flash=False,
+        ),
+        **overrides,
+    )
+
+
+def _tower_init(rng, t: TowerConfig, dtype) -> Dict:
+    keys = iter(jax.random.split(rng, 8))
+    l, d, f, h = t.num_layers, t.hidden_size, t.intermediate_size, t.num_heads
+    hd = t.head_dim
+    return {
+        "q_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dtype)},
+        "k_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dtype)},
+        "v_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dtype)},
+        "o_proj": {"kernel": _dense(next(keys), (l, h * hd, d), dtype)},
+        "attn_norm": {"scale": jnp.ones((l, d), dtype),
+                      "bias": jnp.zeros((l, d), dtype)},
+        "up_proj": {"kernel": _dense(next(keys), (l, d, f), dtype)},
+        "down_proj": {"kernel": _dense(next(keys), (l, f, d), dtype,
+                                       scale=1.0 / math.sqrt(f))},
+        "ffn_norm": {"scale": jnp.ones((l, d), dtype),
+                     "bias": jnp.zeros((l, d), dtype)},
+    }
+
+
+def init(rng: jax.Array, config: CLIPConfig) -> Dict:
+    c = config
+    dt = c.param_dtype
+    keys = iter(jax.random.split(rng, 12))
+    td, vd, p = c.text.hidden_size, c.vision.hidden_size, c.projection_dim
+    patch_dim = 3 * c.patch_size * c.patch_size
+
+    return {
+        "text": {
+            "embed_tokens": {"embedding": jax.random.normal(
+                next(keys), (c.vocab_size, td), dt) * 0.02},
+            "pos_embed": jax.random.normal(
+                next(keys), (c.max_text_len, td), dt) * 0.01,
+            "layers": _tower_init(next(keys), c.text, dt),
+            "final_norm": {"scale": jnp.ones((td,), dt),
+                           "bias": jnp.zeros((td,), dt)},
+            "projection": {"kernel": _dense(next(keys), (td, p), dt)},
+        },
+        "vision": {
+            "patch_embed": {"kernel": _dense(
+                next(keys), (patch_dim, vd), dt)},
+            "cls_token": jax.random.normal(next(keys), (vd,), dt) * 0.02,
+            "pos_embed": jax.random.normal(
+                next(keys), (c.num_patches + 1, vd), dt) * 0.01,
+            "layers": _tower_init(next(keys), c.vision, dt),
+            "final_norm": {"scale": jnp.ones((vd,), dt),
+                           "bias": jnp.zeros((vd,), dt)},
+            "projection": {"kernel": _dense(next(keys), (vd, p), dt)},
+        },
+        "logit_scale": jnp.asarray(c.logit_scale_init, jnp.float32),
+    }
+
+
+def _attention(x, layer, t: TowerConfig, causal: bool, use_flash: bool):
+    b, s, d = x.shape
+    h, hd = t.num_heads, t.head_dim
+    q = (x @ layer["q_proj"]["kernel"]).reshape(b, s, h, hd)
+    k = (x @ layer["k_proj"]["kernel"]).reshape(b, s, h, hd)
+    v = (x @ layer["v_proj"]["kernel"]).reshape(b, s, h, hd)
+    q, k, v = (z.transpose(0, 2, 1, 3) for z in (q, k, v))
+    if use_flash:
+        out = flash_attention(q, k, v, causal)
+    else:
+        out = mha_reference(q, k, v, causal=causal)
+    return out.transpose(0, 2, 1, 3).reshape(b, s, h * hd) @ (
+        layer["o_proj"]["kernel"]
+    )
+
+
+def _tower_block(t: TowerConfig, eps, causal, use_flash):
+    """Pre-LN transformer block shared by both towers."""
+
+    def block(x, layer):
+        h = _layer_norm(x, layer["attn_norm"]["scale"],
+                        layer["attn_norm"]["bias"], eps)
+        x = x + _attention(h, layer, t, causal, use_flash)
+        h = _layer_norm(x, layer["ffn_norm"]["scale"],
+                        layer["ffn_norm"]["bias"], eps)
+        h = jax.nn.gelu(h @ layer["up_proj"]["kernel"])
+        x = x + h @ layer["down_proj"]["kernel"]
+        return x, None
+
+    return block
+
+
+def encode_text(params: Dict, input_ids: jax.Array,
+                config: CLIPConfig) -> jax.Array:
+    """[B, S] token ids -> [B, proj] L2-normalized embeddings. Pooling:
+    the last token position (CLIP uses argmax over EOT; with
+    right-padded sequences the max id position — here simply the final
+    position, callers pad with EOT)."""
+    c = config
+    tp = params["text"]
+    s = input_ids.shape[1]
+    x = tp["embed_tokens"]["embedding"][input_ids] + tp["pos_embed"][None, :s]
+    x = x.astype(c.compute_dtype)
+    block = apply_remat(
+        _tower_block(c.text, c.layer_norm_eps, causal=True,
+                     use_flash=c.use_flash),
+        c.remat_policy,
+    )
+    x, _ = lax.scan(block, x, tp["layers"])
+    x = _layer_norm(x, tp["final_norm"]["scale"], tp["final_norm"]["bias"],
+                    c.layer_norm_eps)
+    pooled = x[:, -1, :] @ tp["projection"]["kernel"].astype(x.dtype)
+    pooled = pooled.astype(jnp.float32)
+    return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+
+
+def _patchify(pixels: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, 3] -> [B, n_patches, patch*patch*3]."""
+    b, hh, ww, ch = pixels.shape
+    gh, gw = hh // patch, ww // patch
+    x = pixels.reshape(b, gh, patch, gw, patch, ch)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * ch)
+
+
+def encode_image(params: Dict, pixels: jax.Array,
+                 config: CLIPConfig) -> jax.Array:
+    """[B, H, W, 3] -> [B, proj] L2-normalized embeddings."""
+    c = config
+    vp = params["vision"]
+    x = _patchify(pixels, c.patch_size) @ vp["patch_embed"]["kernel"]
+    cls = jnp.broadcast_to(
+        vp["cls_token"][None, None, :], (x.shape[0], 1, x.shape[-1])
+    )
+    x = jnp.concatenate([cls, x], axis=1) + vp["pos_embed"][None]
+    x = x.astype(c.compute_dtype)
+    block = apply_remat(
+        _tower_block(c.vision, c.layer_norm_eps, causal=False,
+                     use_flash=c.use_flash),
+        c.remat_policy,
+    )
+    x, _ = lax.scan(block, x, vp["layers"])
+    x = _layer_norm(x, vp["final_norm"]["scale"], vp["final_norm"]["bias"],
+                    c.layer_norm_eps)
+    pooled = x[:, 0, :] @ vp["projection"]["kernel"].astype(x.dtype)
+    pooled = pooled.astype(jnp.float32)
+    return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+
+
+def contrastive_loss(
+    params: Dict, text_emb: jax.Array, image_emb: jax.Array
+) -> Tuple[jax.Array, Dict]:
+    """Symmetric InfoNCE over the (global) batch."""
+    scale = jnp.exp(params["logit_scale"])
+    logits = scale * text_emb @ image_emb.T  # [B, B]
+    labels = jnp.arange(logits.shape[0])
+    t2i = -jnp.mean(
+        jax.nn.log_softmax(logits, axis=-1)[labels, labels]
+    )
+    i2t = -jnp.mean(
+        jax.nn.log_softmax(logits.T, axis=-1)[labels, labels]
+    )
+    loss = (t2i + i2t) / 2
+    acc = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+    return loss, {"t2i_loss": t2i, "i2t_loss": i2t, "accuracy": acc}
+
+
+def make_init_fn(config: CLIPConfig):
+    return partial(init, config=config)
+
+
+def make_loss_fn(config: CLIPConfig):
+    """Contrastive loss over {"input_ids", "pixel_values"}."""
+
+    def loss_fn(params, batch, rng):
+        del rng
+        text = encode_text(params, batch["input_ids"], config)
+        image = encode_image(params, batch["pixel_values"], config)
+        return contrastive_loss(params, text, image)
+
+    return loss_fn
+
+
+def param_count(config: CLIPConfig) -> int:
+    abstract = jax.eval_shape(partial(init, config=config),
+                              jax.random.PRNGKey(0))
+    return sum(
+        math.prod(int(s) for s in leaf.shape)
+        for leaf in jax.tree.leaves(abstract)
+    )

@@ -1,0 +1,32 @@
+"""Shared building blocks for the model families."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dense_init(rng, shape, dtype, scale=None):
+    """Fan-in-scaled normal initializer (scale defaults to
+    1/sqrt(fan_in), fan_in = second-to-last dim)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(shape[-2])
+    return jax.random.normal(rng, shape, dtype) * scale
+
+
+def layer_norm(x, scale, bias, eps):
+    """LayerNorm with f32 statistics regardless of compute dtype."""
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    normed = (xf - mean) * lax.rsqrt(var + eps)
+    return normed.astype(x.dtype) * scale + bias
+
+
+def rms_norm(x, scale, eps):
+    """RMSNorm with f32 statistics (llama-family)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * scale

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
+from dlrover_tpu.models.common import dense_init as _dense, rms_norm as _rms_norm
 from dlrover_tpu.models.losses import masked_lm_loss
 from dlrover_tpu.ops import moe as moe_ops
 from dlrover_tpu.ops.attention_ref import mha_reference
@@ -99,11 +100,6 @@ def llama_tiny(**overrides) -> LlamaConfig:
 # -- init -------------------------------------------------------------------
 
 
-def _dense(rng, shape, dtype, scale=None):
-    scale = scale if scale is not None else 1.0 / math.sqrt(shape[-2])
-    return jax.random.normal(rng, shape, dtype) * scale
-
-
 def init(rng: jax.Array, config: LlamaConfig) -> Dict:
     c = config
     dt = c.param_dtype
@@ -149,12 +145,6 @@ def init(rng: jax.Array, config: LlamaConfig) -> Dict:
 
 
 # -- forward ----------------------------------------------------------------
-
-
-def _rms_norm(x, scale, eps):
-    xf = x.astype(jnp.float32)
-    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * scale
 
 
 def _rope(x, positions, theta):
@@ -268,6 +258,7 @@ def apply_pipelined(
     num_stages: int,
     num_microbatches: int,
     rng: Optional[jax.Array] = None,
+    num_virtual: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Forward pass with the decoder blocks run as a GPipe pipeline over
     the "pipe" mesh axis (``parallel.pipeline``); embed/final-norm/head
@@ -283,8 +274,10 @@ def apply_pipelined(
     from dlrover_tpu.parallel.pipeline import (
         merge_microbatches,
         pipeline_apply,
+        pipeline_apply_interleaved,
         split_microbatches,
         stack_stages,
+        stack_stages_interleaved,
     )
 
     c = config
@@ -297,12 +290,20 @@ def apply_pipelined(
         (x, _), auxs = lax.scan(block, (x, rng), layers_chunk)
         return (x, aux + jnp.sum(auxs))
 
-    stage_params = stack_stages(params["layers"], num_stages)
     x_mb = split_microbatches(x, num_microbatches)
     aux_mb = jnp.zeros((num_microbatches,), jnp.float32)
-    out_mb, aux_out = pipeline_apply(
-        stage_fn, stage_params, (x_mb, aux_mb)
-    )
+    if num_virtual > 1:
+        stage_params = stack_stages_interleaved(
+            params["layers"], num_stages, num_virtual
+        )
+        out_mb, aux_out = pipeline_apply_interleaved(
+            stage_fn, stage_params, (x_mb, aux_mb)
+        )
+    else:
+        stage_params = stack_stages(params["layers"], num_stages)
+        out_mb, aux_out = pipeline_apply(
+            stage_fn, stage_params, (x_mb, aux_mb)
+        )
     x = merge_microbatches(out_mb)
     aux = jnp.sum(aux_out)
 

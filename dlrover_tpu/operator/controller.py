@@ -104,9 +104,16 @@ class ElasticJobReconciler:
             self._initialize_job(job)
         elif job.phase in (JobPhase.PENDING, JobPhase.RUNNING):
             self._handle_fault_master(job)
-            self._sync_job_state(job)
+            # a user-authored Pending ScalePlan moves the job to Scaling
+            if self._execute_pending_scaleplans(job):
+                self._set_job_phase(job, JobPhase.SCALING)
+            else:
+                self._sync_job_state(job)
         elif job.phase == JobPhase.SCALING:
             self._execute_pending_scaleplans(job)
+            if not self._has_active_scaleplans(job):
+                # all plans terminal: fall back to tracking the master pod
+                self._set_job_phase(job, JobPhase.RUNNING)
             self._sync_job_state(job)
         elif job.phase in (JobPhase.SUCCEEDED, JobPhase.FAILED):
             self._stop_running_pods(job)
@@ -148,7 +155,9 @@ class ElasticJobReconciler:
             self._client.create_pod(build_master_pod(job, self._master_image))
             logger.info("job %s: relaunched master pod", job.name)
 
-    def _execute_pending_scaleplans(self, job: ElasticJob) -> None:
+    def _execute_pending_scaleplans(self, job: ElasticJob) -> int:
+        """Relay Pending plans; returns how many were moved to Scaling."""
+        relayed = 0
         for cr in self._client.list_custom_resources(SCALEPLAN_PLURAL):
             plan = ScalePlan.from_dict(cr)
             if plan.owner_job != job.name or plan.phase != JobPhase.PENDING:
@@ -158,6 +167,17 @@ class ElasticJobReconciler:
             self._set_scaleplan_phase(plan, JobPhase.SCALING)
             logger.info("job %s: scaleplan %s -> Scaling", job.name,
                         plan.name)
+            relayed += 1
+        return relayed
+
+    def _has_active_scaleplans(self, job: ElasticJob) -> bool:
+        for cr in self._client.list_custom_resources(SCALEPLAN_PLURAL):
+            plan = ScalePlan.from_dict(cr)
+            if plan.owner_job == job.name and plan.phase in (
+                JobPhase.PENDING, JobPhase.SCALING
+            ):
+                return True
+        return False
 
     def _stop_running_pods(self, job: ElasticJob) -> None:
         for pod in self._job_pods(job.name):

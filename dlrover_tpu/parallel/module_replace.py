@@ -1,0 +1,75 @@
+"""Module replacement optimization.
+
+Role parity: ``atorch/atorch/auto/opt_lib/module_replace_optimization.py:134``
+— the reference swaps HF attention modules for FlashAttention versions by
+class surgery. Functional JAX models have no module tree; a "module" is a
+config-selected implementation, so replacement is a registered config
+transform (e.g. flip the attention impl to the Pallas flash kernel, or a
+dense FFN to MoE).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("parallel.module_replace")
+
+# replacement name -> (model family -> config transform)
+_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_replacement(name: str, model_family: str):
+    def deco(fn):
+        _REGISTRY.setdefault(name, {})[model_family] = fn
+        return fn
+
+    return deco
+
+
+def available_replacements(model_family: str = "") -> List[str]:
+    if not model_family:
+        return sorted(_REGISTRY)
+    return sorted(
+        name for name, fams in _REGISTRY.items() if model_family in fams
+    )
+
+
+def apply_replacements(config, model_family: str,
+                       replacements: List[str]):
+    """Fold the named replacements over a model config."""
+    for name in replacements:
+        fams = _REGISTRY.get(name)
+        if fams is None or model_family not in fams:
+            raise ValueError(
+                f"no replacement {name!r} for model family "
+                f"{model_family!r}; have {available_replacements(model_family)}"
+            )
+        config = fams[model_family](config)
+        logger.info("applied %s to %s config", name, model_family)
+    return config
+
+
+# -- built-ins (the reference ships FA swaps for its HF families) -----------
+
+
+@register_replacement("flash_attention", "llama")
+@register_replacement("flash_attention", "gpt2")
+@register_replacement("flash_attention", "bert")
+def _use_flash(config):
+    return dataclasses.replace(config, use_flash=True)
+
+
+@register_replacement("reference_attention", "llama")
+@register_replacement("reference_attention", "gpt2")
+@register_replacement("reference_attention", "bert")
+def _use_reference(config):
+    return dataclasses.replace(config, use_flash=False)
+
+
+@register_replacement("ring_attention", "llama")
+def _use_ring(config):
+    # requires a mesh with a "seq" axis at accelerate() time
+    return dataclasses.replace(config, seq_axis="seq")

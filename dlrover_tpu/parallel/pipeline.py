@@ -181,3 +181,161 @@ def stack_stages(layer_params: PyTree, num_stages: int) -> PyTree:
         return x.reshape((num_stages, layers // num_stages) + x.shape[1:])
 
     return jax.tree.map(restack, layer_params)
+
+
+def stack_stages_interleaved(
+    layer_params: PyTree, num_stages: int, num_virtual: int
+) -> PyTree:
+    """[L, ...] -> [V, P, L/(V*P), ...] chunks for the circular schedule.
+
+    Logical layer order: a microbatch visits device 0..P-1 with round-0
+    chunks, wraps, visits 0..P-1 with round-1 chunks, ... — so layer
+    ``l`` lands in chunk (round r = l // (P*per), device p = (l // per)
+    % P).
+    """
+
+    def restack(x):
+        layers = x.shape[0]
+        total = num_stages * num_virtual
+        if layers % total:
+            raise ValueError(
+                f"{layers} layers not divisible into {num_virtual}x"
+                f"{num_stages} virtual stages"
+            )
+        per = layers // total
+        return x.reshape(
+            (num_virtual, num_stages, per) + x.shape[1:]
+        )
+
+    return jax.tree.map(restack, layer_params)
+
+
+def pipeline_apply_interleaved(
+    stage_fn: Callable[[PyTree, PyTree], PyTree],
+    stage_params: PyTree,  # leaves [V, P, ...]; dim 1 pipe-sharded
+    x_mb: PyTree,  # microbatch-stacked inputs, leaves [M, ...]
+    axis_name: str = "pipe",
+    batch_axes: Optional[Tuple] = ("data", "fsdp"),
+    constrain: bool = True,
+) -> PyTree:
+    """Circular (interleaved virtual stage) schedule.
+
+    Role parity: PiPPy's ``StageInterleaver`` / Megatron interleaved
+    virtual stages. Each physical stage holds V parameter chunks; a
+    microbatch circles the pipe ring V times, taking chunk r on round r.
+    With M microbatches the bubble shrinks from (P-1)/(M+P-1) to
+    (P-1)/(V*M+P-1) — the V-fold reduction interleaving buys — at the
+    cost of V-1 extra ring wraps of activation traffic.
+
+    Scheduling invariant (device 0 is busy with wrapped microbatches as
+    soon as round 1 begins): requires M >= P.
+    """
+    stage_leaves = jax.tree.leaves(stage_params)
+    if not stage_leaves:
+        raise ValueError("stage_params is empty")
+    num_virtual, num_stages = stage_leaves[0].shape[:2]
+    x_leaves = jax.tree.leaves(x_mb)
+    num_mb = x_leaves[0].shape[0]
+    if num_mb < num_stages:
+        raise ValueError(
+            f"circular schedule needs microbatches >= stages "
+            f"(got M={num_mb} < P={num_stages})"
+        )
+    constrain = constrain and _context_has_axis(axis_name)
+
+    if constrain:
+        from jax.sharding import PartitionSpec as P
+
+        stage_params = jax.tree.map(
+            lambda w: lax.with_sharding_constraint(
+                w,
+                P(None, axis_name,
+                  *(P.UNCONSTRAINED for _ in range(w.ndim - 2))),
+            ),
+            stage_params,
+        )
+
+    def maybe_constrain(tree):
+        if not constrain:
+            return tree
+        return _stage_constraint(tree, axis_name, batch_axes)
+
+    # stage p at tick t works on (round (t-p)//M, microbatch (t-p)%M)
+    def chunk_select(params_v, round_idx, state):
+        chunk = jax.tree.map(
+            lambda w: lax.dynamic_index_in_dim(
+                w, round_idx, 0, keepdims=False
+            ),
+            params_v,
+        )
+        return stage_fn(chunk, state)
+
+    # vmap over stages: params [V, P, ...] -> per-stage [V, ...]
+    vstage = jax.vmap(chunk_select, in_axes=(1, 0, 0))
+
+    stage_ids = jnp.arange(num_stages)
+    num_ticks = num_virtual * num_mb + num_stages - 1
+    # a wrap activation leaves stage P-1 at tick m+P-1 but stage 0 only
+    # consumes it at tick M+m (it processes all round-r jobs before any
+    # round-r+1 job): a FIFO of M-P+1 slots provides exactly that delay
+    fifo_len = num_mb - num_stages + 1
+
+    state0 = jax.tree.map(
+        lambda x: jnp.zeros((num_stages,) + x.shape[1:], x.dtype), x_mb
+    )
+    fifo0 = jax.tree.map(
+        lambda x: jnp.zeros((fifo_len,) + x.shape[1:], x.dtype), x_mb
+    )
+    outs0 = jax.tree.map(jnp.zeros_like, x_mb)
+
+    def tick(carry, t):
+        state, fifo, outs = carry
+        # stage 0 input: fresh microbatch during round 0, else the FIFO
+        # head (the wrap that left stage P-1 exactly M-P+1 ticks ago)
+        feed_fresh = t < num_mb
+        fresh = jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, num_mb - 1), 0, keepdims=False
+            ),
+            x_mb,
+        )
+        inp = jax.tree.map(
+            lambda f, q: jnp.where(feed_fresh, f, q[0]), fresh, fifo
+        )
+        state = jax.tree.map(
+            lambda s, i: lax.dynamic_update_index_in_dim(s, i, 0, 0),
+            state, inp,
+        )
+        state = maybe_constrain(state)
+        rounds = jnp.clip((t - stage_ids) // num_mb, 0, num_virtual - 1)
+        y = vstage(stage_params, rounds, state)
+        y = maybe_constrain(y)
+
+        # last stage finishes microbatch m of the FINAL round at tick
+        # (V-1)*M + m + (P-1)
+        fin = t - (num_stages - 1) - (num_virtual - 1) * num_mb
+        valid = jnp.logical_and(fin >= 0, fin < num_mb)
+        idx = jnp.clip(fin, 0, num_mb - 1)
+        outs = jax.tree.map(
+            lambda o, yy: jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(o, yy[-1], idx, 0),
+                o,
+            ),
+            outs, y,
+        )
+        # push this tick's wrap (stage P-1 output) onto the FIFO tail;
+        # slot 0 of the ring shift is overwritten next tick anyway
+        fifo = jax.tree.map(
+            lambda q, yy: lax.dynamic_update_index_in_dim(
+                jnp.roll(q, -1, axis=0), yy[-1], fifo_len - 1, 0
+            ),
+            fifo, y,
+        )
+        state = jax.tree.map(lambda yy: jnp.roll(yy, 1, axis=0), y)
+        return (state, fifo, outs), None
+
+    (_, _, outs), _ = lax.scan(
+        tick, (state0, fifo0, outs0), jnp.arange(num_ticks)
+    )
+    return outs

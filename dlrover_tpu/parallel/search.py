@@ -1,0 +1,277 @@
+"""Strategy search: combination generation + Bayesian optimization.
+
+Role parity: atorch's acceleration engine —
+``atorch/atorch/auto/engine/strategy.py:49`` (``StrategyInfoCollection``
+of dryrun-scored candidates), ``sg_algo/combination_sg.py:16``
+(cartesian candidate generation) and ``sg_algo/bo_sg.py:41`` (Bayesian
+optimization via the bundled HEBO). The TPU search space is the
+declarative Strategy: mesh factorization x remat policy x grad-accum.
+The BO here is a small numpy Gaussian process with expected-improvement
+acquisition — no external dependency, same role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.mesh import MeshPlan, candidate_plans
+from dlrover_tpu.parallel.strategy import Strategy
+
+logger = get_logger("parallel.search")
+
+REMAT_POLICIES = ["none", "dots_saveable", "dots_and_attn_saveable", "full"]
+
+
+@dataclass
+class StrategyInfo:
+    """One scored candidate (reference: StrategyInfoCollection entries)."""
+
+    strategy: Strategy
+    step_time_s: float = 0.0
+    peak_memory_bytes: int = 0
+    compile_time_s: float = 0.0
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return not self.error and self.step_time_s > 0
+
+
+class StrategyInfoCollection:
+    """History of evaluated strategies, JSON-persistable so later jobs
+    warm-start (the reference pickles its strategies)."""
+
+    def __init__(self):
+        self._infos: List[StrategyInfo] = []
+
+    def add(self, info: StrategyInfo):
+        self._infos.append(info)
+
+    def __len__(self):
+        return len(self._infos)
+
+    def __iter__(self):
+        return iter(self._infos)
+
+    @property
+    def best(self) -> Optional[StrategyInfo]:
+        ok = [i for i in self._infos if i.ok]
+        return min(ok, key=lambda i: i.step_time_s) if ok else None
+
+    def to_json(self) -> str:
+        return json.dumps([
+            {
+                "strategy": json.loads(i.strategy.to_json()),
+                "step_time_s": i.step_time_s,
+                "peak_memory_bytes": i.peak_memory_bytes,
+                "compile_time_s": i.compile_time_s,
+                "error": i.error,
+            }
+            for i in self._infos
+        ])
+
+    @classmethod
+    def from_json(cls, text: str) -> "StrategyInfoCollection":
+        out = cls()
+        for row in json.loads(text):
+            out.add(StrategyInfo(
+                strategy=Strategy.from_json(json.dumps(row["strategy"])),
+                step_time_s=row["step_time_s"],
+                peak_memory_bytes=row["peak_memory_bytes"],
+                compile_time_s=row["compile_time_s"],
+                error=row["error"],
+            ))
+        return out
+
+
+def combination_candidates(
+    n_devices: int,
+    base: Optional[Strategy] = None,
+    remat_policies: Optional[Sequence[str]] = None,
+    accum_options: Sequence[int] = (1, 2, 4),
+    max_candidates: int = 64,
+) -> List[Strategy]:
+    """Cartesian product over (mesh plan, remat policy, grad accum)
+    (reference combination_sg)."""
+    base = base or Strategy()
+    remats = list(remat_policies) if remat_policies is not None else (
+        REMAT_POLICIES
+    )
+    out = []
+    for plan, remat, accum in itertools.product(
+        candidate_plans(n_devices), remats, accum_options
+    ):
+        if base.global_batch_size and base.global_batch_size % accum:
+            continue
+        out.append(dataclasses.replace(
+            base, mesh=plan, remat_policy="" if remat == "none" else remat,
+            grad_accum_steps=accum,
+        ))
+        if len(out) >= max_candidates:
+            break
+    return out
+
+
+# -- encoding ----------------------------------------------------------------
+
+
+def encode_strategy(s: Strategy) -> np.ndarray:
+    """Knob vector for the GP: log2 mesh axis sizes + remat index +
+    log2 accum."""
+    mesh = s.mesh
+    axes = [mesh.pipe, mesh.data, mesh.fsdp, mesh.seq, mesh.tensor]
+    remat = s.remat_policy or "none"
+    remat_idx = REMAT_POLICIES.index(remat) if remat in REMAT_POLICIES else 0
+    return np.array(
+        [math.log2(max(a, 1)) for a in axes]
+        + [float(remat_idx), math.log2(max(s.grad_accum_steps, 1))],
+        dtype=np.float64,
+    )
+
+
+# -- gaussian process --------------------------------------------------------
+
+
+class _GP:
+    """Tiny RBF-kernel GP regression (zero mean, observation noise)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-4):
+        self._ls = length_scale
+        self._noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._l_chol: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self._ls ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        self._x = x
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        k = self._kernel(x, x) + self._noise * np.eye(len(x))
+        self._l_chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._l_chol.T, np.linalg.solve(self._l_chol, yn)
+        )
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        ks = self._kernel(x, self._x)  # [n, m]
+        mean = ks @ self._alpha
+        v = np.linalg.solve(self._l_chol, ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return (
+            mean * self._y_std + self._y_mean,
+            np.sqrt(var) * self._y_std,
+        )
+
+
+def _expected_improvement(
+    mean: np.ndarray, std: np.ndarray, best: float
+) -> np.ndarray:
+    """EI for minimization."""
+    z = (best - mean) / std
+    # standard normal pdf/cdf without scipy
+    pdf = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+    cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+    return (best - mean) * cdf + std * pdf
+
+
+class BayesianSearch:
+    """Sequential candidate proposal (reference bo_sg/HEBO role):
+    ``propose`` returns the unevaluated candidate with the highest
+    expected improvement under a GP fit to the observations so far."""
+
+    def __init__(self, candidates: Sequence[Strategy],
+                 init_random: int = 3, seed: int = 0):
+        self._pool: List[Strategy] = list(candidates)
+        self._encoded = [encode_strategy(s) for s in self._pool]
+        self._observed: List[Tuple[int, float]] = []  # (pool idx, y)
+        self._failed: set = set()
+        self._init_random = init_random
+        self._rng = np.random.RandomState(seed)
+
+    def _remaining(self) -> List[int]:
+        done = {i for i, _ in self._observed} | self._failed
+        return [i for i in range(len(self._pool)) if i not in done]
+
+    def propose(self) -> Optional[Tuple[int, Strategy]]:
+        remaining = self._remaining()
+        if not remaining:
+            return None
+        if len(self._observed) < self._init_random:
+            idx = int(self._rng.choice(remaining))
+            return idx, self._pool[idx]
+        x = np.stack([self._encoded[i] for i, _ in self._observed])
+        y = np.array([v for _, v in self._observed])
+        gp = _GP(length_scale=1.5)
+        gp.fit(x, y)
+        cand = np.stack([self._encoded[i] for i in remaining])
+        mean, std = gp.predict(cand)
+        ei = _expected_improvement(mean, std, float(y.min()))
+        idx = remaining[int(np.argmax(ei))]
+        return idx, self._pool[idx]
+
+    def observe(self, idx: int, step_time_s: float, failed: bool = False):
+        if failed:
+            self._failed.add(idx)
+        else:
+            self._observed.append((idx, step_time_s))
+
+    @property
+    def best(self) -> Optional[Tuple[Strategy, float]]:
+        if not self._observed:
+            return None
+        idx, y = min(self._observed, key=lambda t: t[1])
+        return self._pool[idx], y
+
+
+def bayesian_search_strategy(
+    evaluate: Callable[[Strategy], StrategyInfo],
+    n_devices: int,
+    base: Optional[Strategy] = None,
+    budget: int = 12,
+    candidates: Optional[Sequence[Strategy]] = None,
+    collection: Optional[StrategyInfoCollection] = None,
+) -> Tuple[Strategy, StrategyInfoCollection]:
+    """BO loop: generate combinations, evaluate ``budget`` of them guided
+    by EI, return (best strategy, full history).
+
+    ``evaluate`` is typically ``lambda s: dryrun-of(accelerate(..., s))``
+    (see ``parallel.auto_tune``); it must return a StrategyInfo.
+    """
+    pool = list(candidates) if candidates is not None else (
+        combination_candidates(n_devices, base)
+    )
+    collection = collection or StrategyInfoCollection()
+    search = BayesianSearch(pool)
+    for _ in range(min(budget, len(pool))):
+        proposal = search.propose()
+        if proposal is None:
+            break
+        idx, strategy = proposal
+        info = evaluate(strategy)
+        collection.add(info)
+        search.observe(idx, info.step_time_s, failed=not info.ok)
+        logger.info(
+            "search: %s remat=%s accum=%d -> %s",
+            strategy.mesh, strategy.remat_policy or "none",
+            strategy.grad_accum_steps,
+            f"{info.step_time_s:.4f}s" if info.ok else f"FAIL {info.error[:60]}",
+        )
+    best = collection.best
+    if best is None:
+        raise RuntimeError("no viable strategy found in search budget")
+    return best.strategy, collection

@@ -206,6 +206,32 @@ def llama_pp_rules() -> ShardingRules:
     ])
 
 
+def bert_rules() -> ShardingRules:
+    """BERT-family encoders: same Megatron TP layout as llama plus the
+    three-table embedding block and the MLM head."""
+    return ShardingRules(rules=[
+        (r"layers/.*(q_proj|k_proj|v_proj)/kernel$",
+         ("fsdp", None, "tensor")),
+        (r"layers/.*(q_proj|k_proj|v_proj)/bias$", ("fsdp", "tensor")),
+        (r"layers/.*o_proj/kernel$", ("fsdp", "tensor", None)),
+        (r"layers/.*up_proj/kernel$", ("fsdp", None, "tensor")),
+        (r"layers/.*up_proj/bias$", ("fsdp", "tensor")),
+        (r"layers/.*down_proj/kernel$", ("fsdp", "tensor", None)),
+        (r"embeddings/word/embedding$", ("tensor", "fsdp")),
+        (r"embeddings/(position|token_type)/embedding$", (None, "fsdp")),
+        (r"mlm_head/kernel$", ("fsdp", "tensor")),
+        (r"mlm_head/bias$", ("tensor",)),
+        (r"(norm|ln)[^/]*/(scale|bias)$", REPLICATED),
+        (r".*", FSDP_AUTO),
+    ])
+
+
+def clip_rules() -> ShardingRules:
+    """CLIP dual encoder: both towers' stacked blocks reuse the llama
+    TP/FSDP layout (paths are nested under text/ and vision/)."""
+    return llama_rules()
+
+
 def moe_rules() -> ShardingRules:
     """Expert-parallel MoE: expert weight blocks sharded on the expert
     (data x fsdp) submesh; router replicated."""

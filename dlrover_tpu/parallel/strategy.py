@@ -24,6 +24,8 @@ from typing import Optional
 from dlrover_tpu.parallel.mesh import MeshPlan
 from dlrover_tpu.parallel.sharding_rules import (
     ShardingRules,
+    bert_rules,
+    clip_rules,
     llama_pp_rules,
     llama_rules,
     moe_rules,
@@ -34,6 +36,8 @@ RULE_SETS = {
     "llama": llama_rules,
     "llama_pp": llama_pp_rules,
     "moe": moe_rules,
+    "bert": bert_rules,
+    "clip": clip_rules,
 }
 
 

@@ -42,6 +42,47 @@ class ActorArgs:
     kwargs: Dict[str, Any] = field(default_factory=dict)
 
 
+class RayWorker:
+    """The actor class every training node runs as (reference
+    ``scheduler/ray.py:40`` ``RayWorker`` — exec_module + health probe).
+    Instantiated remotely by ``RayClient.create_actor``; the env dict
+    carries the master address / rank contract (``NodeEnv``)."""
+
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        import os
+
+        for key, value in (env or {}).items():
+            os.environ[key] = str(value)
+
+    def ping(self) -> str:
+        return "pong"
+
+    def run_module(self, module: str, args: Optional[List[str]] = None) -> int:
+        """Run ``python -m module args...`` in-process (the agent
+        entrypoint)."""
+        import runpy
+        import sys
+
+        argv = [module] + list(args or [])
+        old = sys.argv
+        sys.argv = argv
+        try:
+            runpy.run_module(module, run_name="__main__")
+            return 0
+        except SystemExit as e:
+            return int(e.code or 0)
+        finally:
+            sys.argv = old
+
+    def exec_func(self, target: str, *args, **kwargs):
+        """Run ``module:callable`` and return its result."""
+        import importlib
+
+        module_name, _, attr = target.partition(":")
+        fn = getattr(importlib.import_module(module_name), attr)
+        return fn(*args, **kwargs)
+
+
 def parse_type_id_from_actor_name(name: str):
     """"worker-3" -> ("worker", 3) (reference ray_watcher.py:63)."""
     node_type, _, node_id = name.rpartition("-")
@@ -87,14 +128,21 @@ class RayClient:
 
         module_name, _, attr = actor_args.executor.partition(":")
         executor = getattr(importlib.import_module(module_name), attr)
-        actor_cls = self._ray.remote(
+        if not isinstance(executor, type):
+            raise TypeError(
+                f"executor {actor_args.executor!r} must resolve to a class "
+                "(ray actors are classes; see scheduler.ray.RayWorker)"
+            )
+        remote_cls = self._ray.remote(executor)
+        return remote_cls.options(
             num_cpus=actor_args.num_cpus,
             memory=actor_args.memory_mb * 1024 * 1024,
             resources=actor_args.resources or None,
             name=self._prefix + actor_args.actor_name,
             lifetime="detached",
-        )(executor)
-        return actor_cls.remote(*actor_args.args, **actor_args.kwargs)
+        ).remote(
+            *actor_args.args, env=actor_args.env, **actor_args.kwargs
+        )
 
     def delete_actor(self, actor_name: str) -> bool:
         try:

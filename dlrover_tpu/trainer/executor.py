@@ -1,0 +1,214 @@
+"""High-level train_and_evaluate executor with hooks.
+
+Role parity: ``dlrover/trainer/tensorflow/executor/
+estimator_executor.py:52-287`` (estimator ``train_and_evaluate`` wrapper
+with SessionRunHooks, checkpoint cadence, failover-driven session
+restart) and the reporting hooks of ``dlrover/python/elastic_agent/
+tensorflow/hooks.py:59-113``.
+
+The TPU shape: the "session" is the compiled SPMD program owned by
+``ElasticTrainer``; a restart is recompile+reshard, not process death.
+Hooks observe the loop at the same points the TF SessionRunHooks did.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.trainer.conf import Configuration
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.failover import FailoverClient, TrainingFailover
+
+logger = get_logger("trainer.executor")
+
+
+class TrainHook:
+    """SessionRunHook parity: override any subset."""
+
+    def begin(self, executor: "TrainExecutor"):
+        ...
+
+    def before_step(self, step: int):
+        ...
+
+    def after_step(self, step: int, metrics: Dict[str, Any]):
+        ...
+
+    def after_evaluate(self, step: int, metrics: Dict[str, Any]):
+        ...
+
+    def end(self, executor: "TrainExecutor"):
+        ...
+
+
+class ElasticDataShardReportHook(TrainHook):
+    """Report consumed batches so the master completes shards
+    (reference hooks.py:97 ``ElasticDataShardReportHook``)."""
+
+    def __init__(self, sharding_client, batch_size: int):
+        self._client = sharding_client
+        self._batch_size = batch_size
+
+    def after_step(self, step: int, metrics: Dict[str, Any]):
+        try:
+            self._client.report_batch_done(self._batch_size)
+        except Exception:  # noqa: BLE001 — reporting must not kill training
+            logger.exception("shard report failed")
+
+
+class ReportModelInfoHook(TrainHook):
+    """Report model facts + step speed to the master (reference
+    hooks.py:59 ``ReportModelMetricHook``)."""
+
+    def __init__(self, master_client, param_count: int = 0,
+                 flops_per_step: float = 0.0, every_steps: int = 20):
+        self._client = master_client
+        self._param_count = param_count
+        self._flops = flops_per_step
+        self._every = max(every_steps, 1)
+
+    def begin(self, executor: "TrainExecutor"):
+        if self._param_count <= 0:
+            return
+        try:
+            from dlrover_tpu.common import comm
+
+            self._client.report_model_info(comm.ModelInfo(
+                num_params=self._param_count,
+                flops_per_step=self._flops,
+            ))
+        except Exception:  # noqa: BLE001
+            logger.exception("model info report failed")
+
+    def after_step(self, step: int, metrics: Dict[str, Any]):
+        if step % self._every:
+            return
+        try:
+            self._client.report_global_step(step)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class TrainExecutor:
+    """train_and_evaluate over an ElasticTrainer.
+
+    Args:
+      trainer: a prepared-or-not ElasticTrainer.
+      train_iter_fn: () -> iterable of batches (re-invoked after restart,
+        so elastic data sources re-attach at the current shard).
+      eval_fn: optional (state) -> metrics dict.
+      conf: Configuration with (all optional) ``train_steps``,
+        ``eval_every_steps``, ``log_every_steps``.
+    """
+
+    def __init__(
+        self,
+        trainer: ElasticTrainer,
+        train_iter_fn: Callable[[], Iterable],
+        eval_fn: Optional[Callable[[Any], Dict]] = None,
+        hooks: Optional[List[TrainHook]] = None,
+        conf: Optional[Configuration] = None,
+        master_client=None,
+        failover_client: Optional[FailoverClient] = None,
+    ):
+        self._trainer = trainer
+        self._train_iter_fn = train_iter_fn
+        self._eval_fn = eval_fn
+        self._hooks = list(hooks or [])
+        conf = conf or Configuration()
+        self._train_steps = int(conf.get("train_steps", 0))
+        self._eval_every = int(conf.get("eval_every_steps", 0))
+        self._log_every = int(conf.get("log_every_steps", 50))
+        self._restart_requested = False
+        self._failover: Optional[TrainingFailover] = None
+        if master_client is not None:
+            if failover_client is not None:
+                failover_client.init_version()
+            self._failover = TrainingFailover(
+                master_client, self.request_restart,
+                failover_client=failover_client,
+            )
+        self.state: Any = None
+        self.eval_metrics: Dict[str, Any] = {}
+
+    # -- failover ------------------------------------------------------------
+
+    def request_restart(self):
+        """Membership changed: finish the current step, then rebuild."""
+        self._restart_requested = True
+
+    def _maybe_restart(self):
+        if not self._restart_requested:
+            return
+        self._restart_requested = False
+        logger.info("rebuilding training session (membership change)")
+        self.state = self._trainer.on_world_change(self.state)
+
+    # -- loop ----------------------------------------------------------------
+
+    def train_and_evaluate(self) -> Dict[str, Any]:
+        self.state = self._trainer.prepare(self.state)
+        for hook in self._hooks:
+            hook.begin(self)
+        if self._failover is not None:
+            self._failover.start()
+
+        step = int(self.state.step)
+        last_log = time.time()
+        try:
+            while True:
+                data_iter = iter(self._train_iter_fn())
+                restarted = False
+                for batch in data_iter:
+                    for hook in self._hooks:
+                        hook.before_step(step + 1)
+                    self.state, metrics = self._trainer.step(
+                        self.state, batch
+                    )
+                    step += 1
+                    for hook in self._hooks:
+                        hook.after_step(step, metrics)
+
+                    if self._log_every and step % self._log_every == 0:
+                        dt = time.time() - last_log
+                        last_log = time.time()
+                        logger.info(
+                            "step %d loss=%.4f (%.2f steps/s)", step,
+                            float(metrics.get("loss", float("nan"))),
+                            self._log_every / max(dt, 1e-9),
+                        )
+                    if self._eval_every and step % self._eval_every == 0:
+                        self._evaluate(step)
+                    if self._train_steps and step >= self._train_steps:
+                        return self._finish(step)
+                    if self._restart_requested:
+                        self._maybe_restart()
+                        restarted = True
+                        break  # re-enter with a fresh data iterator
+                if not restarted:
+                    # data source exhausted
+                    return self._finish(step)
+        finally:
+            if self._failover is not None:
+                self._failover.stop()
+
+    def _evaluate(self, step: int):
+        if self._eval_fn is None:
+            return
+        self.eval_metrics = self._eval_fn(self.state)
+        logger.info("eval @%d: %s", step, {
+            k: float(v) for k, v in self.eval_metrics.items()
+        })
+        for hook in self._hooks:
+            hook.after_evaluate(step, self.eval_metrics)
+
+    def _finish(self, step: int) -> Dict[str, Any]:
+        if self._eval_fn is not None:
+            self._evaluate(step)
+        self._trainer.save(self.state, force=True)
+        self._trainer.finalize()
+        for hook in self._hooks:
+            hook.end(self)
+        return {"step": step, **self.eval_metrics}

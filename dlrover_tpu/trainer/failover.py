@@ -1,0 +1,135 @@
+"""Failover client: cluster-version handshake + change watcher.
+
+Role parity: ``dlrover/trainer/tensorflow/failover/failover_client.py:21``
+(local/global/restored cluster versions negotiated through the master's
+ElasticPsService) and ``tensorflow_failover.py:33-144``
+(``TensorflowFailover`` — a watcher thread that detects PS-cluster /
+world changes and triggers a training-session restart).
+
+On TPU the "session restart" is ``ElasticTrainer.on_world_change`` —
+recompile for the new mesh and reshard state — so the watcher's job is
+only detection + callback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("trainer.failover")
+
+
+class VersionType:
+    LOCAL = "local"
+    GLOBAL = "global"
+    RESTORED = "restored"
+
+
+class FailoverClient:
+    """Version handshake (reference failover_client.py): each worker
+    keeps a LOCAL version; the master keeps GLOBAL (current cluster) and
+    RESTORED (checkpoint the cluster came back from) versions. A worker
+    whose LOCAL version trails GLOBAL must rebuild its session."""
+
+    def __init__(self, master_client, task_type: str = "worker",
+                 task_id: int = 0):
+        self._client = master_client
+        self._task_type = task_type
+        self._task_id = task_id
+
+    def init_version(self):
+        """On startup: local <- global (first worker bumps global to 1)."""
+        global_version = self.get_version(VersionType.GLOBAL)
+        if global_version == 0:
+            self.set_version(VersionType.GLOBAL, 1)
+            global_version = 1
+        self.set_version(VersionType.LOCAL, global_version)
+
+    def get_version(self, version_type: str) -> int:
+        return self._client.get_cluster_version(
+            version_type, self._task_type, self._task_id
+        )
+
+    def set_version(self, version_type: str, version: int):
+        self._client.update_cluster_version(
+            version_type, version, self._task_type, self._task_id
+        )
+
+    def ps_cluster_changed(self) -> bool:
+        local = self.get_version(VersionType.LOCAL)
+        global_v = self.get_version(VersionType.GLOBAL)
+        return local < global_v
+
+    def sync_to_global(self):
+        self.set_version(
+            VersionType.LOCAL, self.get_version(VersionType.GLOBAL)
+        )
+
+
+class TrainingFailover:
+    """Watches for membership / PS-cluster changes and fires a restart
+    callback (reference TensorflowFailover.start_failover_monitor)."""
+
+    def __init__(
+        self,
+        master_client,
+        on_change: Callable[[], None],
+        failover_client: Optional[FailoverClient] = None,
+        poll_interval: float = 5.0,
+    ):
+        self._client = master_client
+        self._on_change = on_change
+        self._failover = failover_client
+        self._interval = poll_interval
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._last_ps_addrs: Optional[List[str]] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="failover-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def _changed(self) -> bool:
+        # PS strategy: version handshake
+        if self._failover is not None and self._failover.ps_cluster_changed():
+            return True
+        # PS address list drift (reference: address_changed via TF_CONFIG)
+        try:
+            ps_nodes = self._client.query_ps_nodes()
+            addrs = sorted(
+                getattr(node, "service_addr", "") for node in ps_nodes.nodes
+            )
+            if self._last_ps_addrs is not None and addrs != self._last_ps_addrs:
+                self._last_ps_addrs = addrs
+                return True
+            self._last_ps_addrs = addrs
+        except Exception:  # noqa: BLE001 — master briefly unreachable
+            pass
+        # SPMD strategy: nodes waiting at the rendezvous
+        try:
+            if self._client.num_nodes_waiting() > 0:
+                return True
+        except Exception:  # noqa: BLE001
+            pass
+        return False
+
+    def _run(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                if self._changed():
+                    logger.info("membership change detected; firing restart")
+                    if self._failover is not None:
+                        self._failover.sync_to_global()
+                    self._on_change()
+            except Exception:  # noqa: BLE001
+                logger.exception("failover monitor iteration failed")
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 1)

@@ -1,0 +1,249 @@
+"""Trainer executor: conf system, hooks, train_and_evaluate loop,
+failover version handshake + restart path."""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.trainer.conf import (
+    Configuration,
+    ConfigurationManager,
+    ConfigurationManagerMeta,
+    build_configuration,
+)
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.executor import (
+    ElasticDataShardReportHook,
+    ReportModelInfoHook,
+    TrainExecutor,
+    TrainHook,
+)
+from dlrover_tpu.trainer.failover import (
+    FailoverClient,
+    TrainingFailover,
+    VersionType,
+)
+
+
+class TestConfiguration:
+    def test_class_merge_subclass_wins(self):
+        class Base:
+            lr = 0.1
+            batch_size = 32
+            data = {"path": "/a", "format": "tfrecord"}
+
+        class Override(Base):
+            lr = 0.01
+            data = {"path": "/b"}
+
+        conf = Configuration.from_class(Override)
+        assert conf.lr == 0.01
+        assert conf.batch_size == 32
+        # note: class-attr merge replaces dicts (python semantics); deep
+        # merge applies across build_configuration sources
+        assert conf.data.path == "/b"
+
+    def test_build_configuration_deep_merge(self):
+        conf = build_configuration(
+            {"train": {"steps": 100, "lr": 0.1}},
+            {"train": {"lr": 0.01}},
+            overrides={"eval_every_steps": 10},
+        )
+        assert conf.train.steps == 100
+        assert conf.train.lr == 0.01
+        assert conf.eval_every_steps == 10
+
+    def test_manager_registry(self):
+        ConfigurationManagerMeta.clear()
+
+        class DataConf(ConfigurationManager):
+            dataset = "mnist"
+
+        class TrainConf(ConfigurationManager):
+            lr = 0.05
+
+        merged = ConfigurationManager.merged_configuration()
+        assert merged.dataset == "mnist"
+        assert merged.lr == 0.05
+        ConfigurationManagerMeta.clear()
+
+
+class StubMasterClient:
+    """Minimal master for failover tests."""
+
+    def __init__(self):
+        self.versions = {}
+        self.waiting = 0
+        self.global_steps = []
+        self.model_infos = []
+
+    def get_cluster_version(self, version_type, task_type, task_id):
+        return self.versions.get(version_type, 0)
+
+    def update_cluster_version(self, version_type, version, task_type,
+                               task_id):
+        self.versions[version_type] = version
+
+    def query_ps_nodes(self):
+        class _PsNodes:
+            nodes = []
+
+        return _PsNodes()
+
+    def num_nodes_waiting(self):
+        return self.waiting
+
+    def report_global_step(self, step, **kw):
+        self.global_steps.append(step)
+
+    def report_model_info(self, info):
+        self.model_infos.append(info)
+
+
+class TestFailoverClient:
+    def test_version_handshake(self):
+        client = FailoverClient(StubMasterClient())
+        client.init_version()
+        assert client.get_version(VersionType.GLOBAL) == 1
+        assert client.get_version(VersionType.LOCAL) == 1
+        assert not client.ps_cluster_changed()
+        client.set_version(VersionType.GLOBAL, 2)
+        assert client.ps_cluster_changed()
+        client.sync_to_global()
+        assert not client.ps_cluster_changed()
+
+    def test_monitor_fires_on_waiting_nodes(self):
+        master = StubMasterClient()
+        fired = []
+        monitor = TrainingFailover(
+            master, lambda: fired.append(1), poll_interval=0.02
+        )
+        monitor.start()
+        import time
+
+        master.waiting = 2
+        time.sleep(0.2)
+        monitor.stop()
+        assert fired
+
+
+def _make_trainer(**kwargs):
+    def init_fn(rng):
+        return {"w": jax.random.normal(rng, (4, 2)), "b": jnp.zeros((2,))}
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    rngs = jax.random.split(jax.random.PRNGKey(0), 2)
+    x = jax.random.normal(rngs[0], (16, 4))
+    batch = {"x": x, "y": x @ jax.random.normal(rngs[1], (4, 2))}
+    trainer = ElasticTrainer(
+        init_fn, loss_fn, optax.sgd(0.1), batch,
+        strategy=Strategy(mesh=MeshPlan(data=-1)), **kwargs,
+    )
+    return trainer, batch
+
+
+class CountingHook(TrainHook):
+    def __init__(self):
+        self.begins = self.steps = self.evals = self.ends = 0
+
+    def begin(self, executor):
+        self.begins += 1
+
+    def after_step(self, step, metrics):
+        self.steps += 1
+
+    def after_evaluate(self, step, metrics):
+        self.evals += 1
+
+    def end(self, executor):
+        self.ends += 1
+
+
+class TestTrainExecutor:
+    def test_train_and_evaluate_runs_hooks_and_eval(self):
+        trainer, batch = _make_trainer()
+        hook = CountingHook()
+
+        def eval_fn(state):
+            return {"eval_loss": jnp.asarray(0.5)}
+
+        executor = TrainExecutor(
+            trainer,
+            train_iter_fn=lambda: [batch] * 100,
+            eval_fn=eval_fn,
+            hooks=[hook],
+            conf=Configuration({"train_steps": 7, "eval_every_steps": 3,
+                                "log_every_steps": 2}),
+        )
+        out = executor.train_and_evaluate()
+        assert out["step"] == 7
+        assert hook.begins == 1 and hook.ends == 1
+        assert hook.steps == 7
+        # evals at steps 3, 6 + final
+        assert hook.evals == 3
+        assert float(out["eval_loss"]) == 0.5
+
+    def test_restart_rebuilds_and_continues(self):
+        trainer, batch = _make_trainer()
+
+        class RestartOnce(TrainHook):
+            def __init__(self, executor_box):
+                self.box = executor_box
+                self.done = False
+
+            def after_step(self, step, metrics):
+                if step == 3 and not self.done:
+                    self.done = True
+                    self.box[0].request_restart()
+
+        box = []
+        hook = RestartOnce(box)
+        executor = TrainExecutor(
+            trainer, train_iter_fn=lambda: [batch] * 100,
+            hooks=[hook],
+            conf=Configuration({"train_steps": 6, "log_every_steps": 0}),
+        )
+        box.append(executor)
+        out = executor.train_and_evaluate()
+        assert out["step"] == 6
+        assert hook.done
+
+    def test_data_exhaustion_finishes(self):
+        trainer, batch = _make_trainer()
+        executor = TrainExecutor(
+            trainer, train_iter_fn=lambda: [batch] * 4,
+            conf=Configuration({"log_every_steps": 0}),
+        )
+        out = executor.train_and_evaluate()
+        assert out["step"] == 4
+
+    def test_report_hooks(self):
+        master = StubMasterClient()
+        trainer, batch = _make_trainer()
+
+        class FakeShardingClient:
+            def __init__(self):
+                self.batches = 0
+
+            def report_batch_done(self, n):
+                self.batches += n
+
+        shard_client = FakeShardingClient()
+        executor = TrainExecutor(
+            trainer, train_iter_fn=lambda: [batch] * 10,
+            hooks=[
+                ElasticDataShardReportHook(shard_client, batch_size=16),
+                ReportModelInfoHook(master, param_count=10,
+                                    every_steps=2),
+            ],
+            conf=Configuration({"train_steps": 4, "log_every_steps": 0}),
+        )
+        executor.train_and_evaluate()
+        assert shard_client.batches == 4 * 16
+        assert master.global_steps == [2, 4]
+        assert len(master.model_infos) == 1

@@ -165,6 +165,33 @@ class TestElasticJobReconciler:
         ElasticJobReconciler(client).reconcile(cr)
         assert "job1-worker-0" not in client.pods
 
+    def test_running_job_picks_up_user_scaleplan(self):
+        # the natural flow: user applies a ScalePlan against a Running
+        # job; the reconciler relays it and moves the job to Scaling,
+        # then back to Running once the plan is terminal
+        client = FakeK8sClient()
+        client.create_custom_resource(ELASTICJOB_PLURAL, _job_cr())
+        rec = ElasticJobReconciler(client, "img:1")
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        client.set_pod_phase(master_pod_name("job1"), "Running")
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+
+        plan_cr = build_scale_plan_cr("job1", {"worker": {"replicas": 1}})
+        plan_cr["status"] = {"phase": JobPhase.PENDING}
+        client.create_custom_resource(SCALEPLAN_PLURAL, plan_cr)
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        name = plan_cr["metadata"]["name"]
+        assert client.get_custom_resource(SCALEPLAN_PLURAL, name)[
+            "status"]["phase"] == JobPhase.SCALING
+        assert client.get_custom_resource(ELASTICJOB_PLURAL, "job1")[
+            "status"]["phase"] == JobPhase.SCALING
+        # plan succeeds -> job returns to Running
+        client.get_custom_resource(SCALEPLAN_PLURAL, name)["status"][
+            "phase"] = JobPhase.SUCCEEDED
+        rec.reconcile(client.get_custom_resource(ELASTICJOB_PLURAL, "job1"))
+        assert client.get_custom_resource(ELASTICJOB_PLURAL, "job1")[
+            "status"]["phase"] == JobPhase.RUNNING
+
     def test_pending_scaleplan_relayed_when_scaling(self):
         client = FakeK8sClient()
         cr = _job_cr()

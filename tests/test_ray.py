@@ -129,6 +129,28 @@ class TestActorScaler:
         assert "worker-3" in client.actors
 
 
+class TestRayWorker:
+    def test_default_executor_resolves_to_actor_class(self):
+        import importlib
+
+        from dlrover_tpu.master.scaler.actor_scaler import DEFAULT_EXECUTOR
+
+        module_name, _, attr = DEFAULT_EXECUTOR.partition(":")
+        cls = getattr(importlib.import_module(module_name), attr)
+        assert isinstance(cls, type)
+
+    def test_worker_applies_env_and_runs(self, monkeypatch):
+        import os
+
+        from dlrover_tpu.scheduler.ray import RayWorker
+
+        monkeypatch.delenv("RAY_TEST_KEY", raising=False)
+        worker = RayWorker(env={"RAY_TEST_KEY": "42"})
+        assert os.environ["RAY_TEST_KEY"] == "42"
+        assert worker.ping() == "pong"
+        assert worker.exec_func("math:sqrt", 9.0) == 3.0
+
+
 class TestActorWatcher:
     def test_list_maps_states(self):
         client = FakeRayClient()

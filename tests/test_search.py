@@ -1,0 +1,135 @@
+"""Strategy search: combination generation, GP/EI Bayesian loop,
+strategy-info persistence, module replacement."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.module_replace import (
+    apply_replacements,
+    available_replacements,
+)
+from dlrover_tpu.parallel.search import (
+    BayesianSearch,
+    StrategyInfo,
+    StrategyInfoCollection,
+    bayesian_search_strategy,
+    combination_candidates,
+    encode_strategy,
+)
+from dlrover_tpu.parallel.strategy import Strategy
+
+
+class TestCombinations:
+    def test_covers_mesh_and_remat_space(self):
+        cands = combination_candidates(8, max_candidates=1000)
+        meshes = {tuple(dataclasses.astuple(c.mesh)) for c in cands}
+        remats = {c.remat_policy for c in cands}
+        assert len(meshes) > 1
+        assert "" in remats and "dots_saveable" in remats
+
+    def test_respects_global_batch_divisibility(self):
+        base = Strategy(global_batch_size=4)
+        cands = combination_candidates(8, base=base,
+                                       accum_options=(1, 2, 3, 4))
+        assert all(c.grad_accum_steps in (1, 2, 4) for c in cands)
+
+
+class TestBayesianSearch:
+    def _pool(self):
+        return combination_candidates(
+            8, remat_policies=["none", "dots_saveable"],
+            accum_options=(1, 2), max_candidates=24,
+        )
+
+    def test_finds_synthetic_optimum(self):
+        pool = self._pool()
+        # synthetic objective: fastest when tensor axis is big and accum=1
+        def cost(s):
+            return (
+                1.0 / max(s.mesh.tensor, 1)
+                + 0.2 * s.grad_accum_steps
+                + (0.1 if s.remat_policy else 0.0)
+            )
+
+        truth_best = min(pool, key=cost)
+        search = BayesianSearch(pool, init_random=3)
+        for _ in range(14):
+            proposal = search.propose()
+            if proposal is None:
+                break
+            idx, s = proposal
+            search.observe(idx, cost(s))
+        best, y = search.best
+        assert y <= cost(truth_best) * 1.3
+
+    def test_failed_candidates_excluded(self):
+        pool = self._pool()[:4]
+        search = BayesianSearch(pool, init_random=1)
+        seen = set()
+        for _ in range(10):
+            p = search.propose()
+            if p is None:
+                break
+            idx, _ = p
+            assert idx not in seen
+            seen.add(idx)
+            search.observe(idx, 0.0, failed=True)
+        assert search.propose() is None
+        assert search.best is None
+
+    def test_encode_distinguishes_strategies(self):
+        a = encode_strategy(Strategy(mesh=MeshPlan(data=8)))
+        b = encode_strategy(Strategy(mesh=MeshPlan(tensor=8)))
+        assert not np.allclose(a, b)
+
+
+class TestSearchLoop:
+    def test_end_to_end_with_synthetic_evaluator(self):
+        def evaluate(s):
+            if s.mesh.pipe > 1:  # pretend pipe candidates OOM
+                return StrategyInfo(strategy=s, error="OOM")
+            t = 1.0 / max(s.mesh.data, 1) + 0.05 * s.grad_accum_steps
+            return StrategyInfo(strategy=s, step_time_s=t)
+
+        best, infos = bayesian_search_strategy(
+            evaluate, n_devices=8, budget=10,
+        )
+        assert best.mesh.pipe == 1
+        assert len(infos) == 10
+        # persistence round-trip
+        restored = StrategyInfoCollection.from_json(infos.to_json())
+        assert restored.best.step_time_s == infos.best.step_time_s
+
+    def test_raises_when_all_fail(self):
+        with pytest.raises(RuntimeError):
+            bayesian_search_strategy(
+                lambda s: StrategyInfo(strategy=s, error="nope"),
+                n_devices=8, budget=3,
+            )
+
+
+class TestModuleReplace:
+    def test_flash_swap(self):
+        cfg = llama.llama_tiny()
+        assert not cfg.use_flash
+        out = apply_replacements(cfg, "llama", ["flash_attention"])
+        assert out.use_flash
+        back = apply_replacements(out, "llama", ["reference_attention"])
+        assert not back.use_flash
+
+    def test_ring_attention_sets_seq_axis(self):
+        cfg = llama.llama_tiny()
+        out = apply_replacements(cfg, "llama", ["ring_attention"])
+        assert out.seq_axis == "seq"
+
+    def test_unknown_replacement_raises(self):
+        with pytest.raises(ValueError):
+            apply_replacements(llama.llama_tiny(), "llama", ["nope"])
+
+    def test_catalog(self):
+        assert "flash_attention" in available_replacements("llama")
+        assert "ring_attention" not in available_replacements("gpt2")

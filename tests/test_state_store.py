@@ -1,0 +1,69 @@
+"""Pluggable state backends: memory, atomic file persistence, registry."""
+
+import pytest
+
+from dlrover_tpu.common.state_store import (
+    FileStateBackend,
+    MemoryStateBackend,
+    StoreManager,
+)
+
+
+class TestMemoryBackend:
+    def test_crud(self):
+        store = MemoryStateBackend()
+        store.set("a/1", {"x": 1})
+        store.set("a/2", 2)
+        store.set("b/1", 3)
+        assert store.get("a/1") == {"x": 1}
+        assert store.get("missing", 42) == 42
+        assert sorted(store.keys("a/")) == ["a/1", "a/2"]
+        assert store.delete("a/1")
+        assert not store.delete("a/1")
+
+
+class TestFileBackend:
+    def test_persists_across_instances(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        store = FileStateBackend(path)
+        store.set("rdzv/round", 3)
+        store.set("shards", {"todo": [1, 2], "doing": []})
+        # a relaunched master re-reads the snapshot
+        store2 = FileStateBackend(path)
+        assert store2.get("rdzv/round") == 3
+        assert store2.get("shards")["todo"] == [1, 2]
+
+    def test_delete_persists(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        store = FileStateBackend(path)
+        store.set("k", 1)
+        store.delete("k")
+        assert FileStateBackend(path).get("k") is None
+
+    def test_rejects_non_serializable(self, tmp_path):
+        store = FileStateBackend(str(tmp_path / "s.json"))
+        with pytest.raises(TypeError):
+            store.set("bad", object())
+
+    def test_corrupt_file_starts_empty(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text("{not json")
+        store = FileStateBackend(str(path))
+        assert store.keys() == []
+
+
+class TestStoreManager:
+    def test_named_stores_and_reuse(self, tmp_path):
+        StoreManager.reset()
+        a = StoreManager.build_store("job-a")
+        assert StoreManager.build_store("job-a") is a
+        f = StoreManager.build_store(
+            "job-b", backend="file", path=str(tmp_path / "b.json")
+        )
+        f.set("k", 1)
+        assert StoreManager.get_store("job-b").get("k") == 1
+        with pytest.raises(ValueError):
+            StoreManager.build_store("job-c", backend="redis")
+        with pytest.raises(ValueError):
+            StoreManager.build_store("job-d", backend="file")
+        StoreManager.reset()
