@@ -112,9 +112,10 @@ def main() -> int:
     }
 
     n_dev = len(devices)
+    head_chunk = int(os.environ.get("BENCH_HEAD_CHUNK", "0"))
     result = accelerate(
         llama.make_init_fn(config),
-        llama.make_loss_fn(config),
+        llama.make_loss_fn(config, head_chunk=head_chunk),
         optax.adafactor(1e-3),
         batch,
         strategy=Strategy(

@@ -136,7 +136,17 @@ class StoreManager:
                     path: str = "") -> StateBackend:
         with cls._lock:
             if name in cls._stores:
-                return cls._stores[name]
+                existing = cls._stores[name]
+                wanted = (
+                    FileStateBackend if backend == "file"
+                    else MemoryStateBackend
+                )
+                if not isinstance(existing, wanted):
+                    raise ValueError(
+                        f"store {name!r} already exists with backend "
+                        f"{type(existing).__name__}, requested {backend!r}"
+                    )
+                return existing
             if backend == "memory":
                 store: StateBackend = MemoryStateBackend()
             elif backend == "file":

@@ -21,8 +21,10 @@ from jax import lax
 
 from dlrover_tpu.models.losses import masked_lm_loss
 from dlrover_tpu.models.common import (
+    cast_floats,
     dense_init as _dense,
     layer_norm as _layer_norm,
+    param_count as common_param_count,
 )
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention
@@ -142,6 +144,7 @@ def _attention(x, layer, config: BertConfig, mask):
 
 def _encoder_block(c: BertConfig, mask):
     def block(x, layer):
+        layer = cast_floats(layer, c.compute_dtype)
         attn = _attention(x, layer, c, mask)
         x = _layer_norm(x + attn, layer["attn_norm"]["scale"],
                         layer["attn_norm"]["bias"], c.layer_norm_eps)
@@ -211,9 +214,4 @@ def make_mlm_loss_fn(config: BertConfig):
 
 
 def param_count(config: BertConfig) -> int:
-    abstract = jax.eval_shape(partial(init, config=config),
-                              jax.random.PRNGKey(0))
-    return sum(
-        math.prod(int(s) for s in leaf.shape)
-        for leaf in jax.tree.leaves(abstract)
-    )
+    return common_param_count(partial(init, config=config))

@@ -20,8 +20,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from dlrover_tpu.models.common import (
+    cast_floats,
     dense_init as _dense,
     layer_norm as _layer_norm,
+    param_count as common_param_count,
 )
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention
@@ -155,6 +157,7 @@ def _tower_block(t: TowerConfig, eps, causal, use_flash):
     """Pre-LN transformer block shared by both towers."""
 
     def block(x, layer):
+        layer = cast_floats(layer, x.dtype)
         h = _layer_norm(x, layer["attn_norm"]["scale"],
                         layer["attn_norm"]["bias"], eps)
         x = x + _attention(h, layer, t, causal, use_flash)
@@ -259,9 +262,4 @@ def make_loss_fn(config: CLIPConfig):
 
 
 def param_count(config: CLIPConfig) -> int:
-    abstract = jax.eval_shape(partial(init, config=config),
-                              jax.random.PRNGKey(0))
-    return sum(
-        math.prod(int(s) for s in leaf.shape)
-        for leaf in jax.tree.leaves(abstract)
-    )
+    return common_param_count(partial(init, config=config))

@@ -17,16 +17,44 @@ def dense_init(rng, shape, dtype, scale=None):
 
 
 def layer_norm(x, scale, bias, eps):
-    """LayerNorm with f32 statistics regardless of compute dtype."""
+    """LayerNorm with f32 statistics regardless of compute dtype. The
+    scale/bias params are cast to x's dtype so the output dtype is
+    stable under scan even when params are f32 and compute is bf16."""
     xf = x.astype(jnp.float32)
     mean = xf.mean(axis=-1, keepdims=True)
     var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
     normed = (xf - mean) * lax.rsqrt(var + eps)
-    return normed.astype(x.dtype) * scale + bias
+    return normed.astype(x.dtype) * scale.astype(x.dtype) + bias.astype(
+        x.dtype
+    )
 
 
 def rms_norm(x, scale, eps):
-    """RMSNorm with f32 statistics (llama-family)."""
+    """RMSNorm with f32 statistics (llama-family); output keeps x's
+    dtype (see layer_norm)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * scale
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(
+        x.dtype
+    )
+
+
+def cast_floats(tree, dtype):
+    """Cast floating leaves to ``dtype`` (params stored f32, computed
+    bf16 — the mixed-precision pattern); non-float leaves pass through."""
+    return jax.tree.map(
+        lambda w: w.astype(dtype)
+        if jnp.issubdtype(w.dtype, jnp.floating) else w,
+        tree,
+    )
+
+
+def param_count(init_fn) -> int:
+    """Total parameter count of ``init_fn(rng)`` via abstract eval."""
+    import math
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    return sum(
+        math.prod(int(s) for s in leaf.shape)
+        for leaf in jax.tree.leaves(abstract)
+    )

@@ -30,8 +30,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
-from dlrover_tpu.models.common import dense_init as _dense, rms_norm as _rms_norm
-from dlrover_tpu.models.losses import masked_lm_loss
+from dlrover_tpu.models.common import (
+    cast_floats,
+    dense_init as _dense,
+    param_count as common_param_count,
+    rms_norm as _rms_norm,
+)
+from dlrover_tpu.models.losses import chunked_lm_head_loss, masked_lm_loss
 from dlrover_tpu.ops import moe as moe_ops
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention
@@ -226,6 +231,8 @@ def _decoder_block(c: LlamaConfig):
 
     def block(carry, layer_params):
         x, block_rng = carry
+        # params may be stored f32; compute in the configured dtype
+        layer_params = cast_floats(layer_params, c.compute_dtype)
         positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
         block_rng, ffn_rng = jax.random.split(block_rng)
         attn_in = _rms_norm(x, layer_params["input_norm"]["scale"], c.rms_eps)
@@ -237,9 +244,12 @@ def _decoder_block(c: LlamaConfig):
     return block
 
 
-def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
-          rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
-    """Returns (logits [B, S, V] in f32, moe_aux_loss scalar)."""
+def apply_hidden(
+    params: Dict, input_ids: jax.Array, config: LlamaConfig,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (final hidden states [B, S, D] in compute dtype,
+    moe_aux_loss scalar) — everything except the lm head."""
     c = config
     x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -247,8 +257,16 @@ def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
     block = apply_remat(_decoder_block(c), c.remat_policy)
     (x, _), aux_losses = lax.scan(block, (x, rng), params["layers"])
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
+    return x, jnp.sum(aux_losses)
+
+
+def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
+          rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Returns (logits [B, S, V] in f32, moe_aux_loss scalar)."""
+    c = config
+    x, aux = apply_hidden(params, input_ids, config, rng)
     logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
-    return logits.astype(jnp.float32), jnp.sum(aux_losses)
+    return logits.astype(jnp.float32), aux
 
 
 def apply_pipelined(
@@ -319,13 +337,29 @@ def make_init_fn(config: LlamaConfig):
     return partial(init, config=config)
 
 
-def make_loss_fn(config: LlamaConfig, z_loss_weight: float = 0.0):
+def make_loss_fn(config: LlamaConfig, z_loss_weight: float = 0.0,
+                 head_chunk: int = 0):
     """Causal-LM loss over batches {"input_ids", "labels"} (labels==-100
-    are masked, HF convention)."""
+    are masked, HF convention).
+
+    ``head_chunk`` > 0 fuses the lm head with the cross entropy over
+    sequence chunks (``losses.chunked_lm_head_loss``) so the [B, S, V]
+    f32 logits never materialize — the memory lever for long sequences
+    and large vocabularies.
+    """
 
     def loss_fn(params, batch, rng):
-        logits, moe_aux = apply(params, batch["input_ids"], config, rng)
-        loss = masked_lm_loss(logits, batch["labels"], z_loss_weight)
+        if head_chunk > 0:
+            hidden, moe_aux = apply_hidden(
+                params, batch["input_ids"], config, rng
+            )
+            loss = chunked_lm_head_loss(
+                hidden, params["lm_head"]["kernel"], batch["labels"],
+                chunk_size=head_chunk, z_loss_weight=z_loss_weight,
+            )
+        else:
+            logits, moe_aux = apply(params, batch["input_ids"], config, rng)
+            loss = masked_lm_loss(logits, batch["labels"], z_loss_weight)
         if config.num_experts > 0:
             loss = loss + config.moe_aux_weight * moe_aux / max(
                 1, config.num_layers
@@ -336,12 +370,7 @@ def make_loss_fn(config: LlamaConfig, z_loss_weight: float = 0.0):
 
 
 def param_count(config: LlamaConfig) -> int:
-    abstract = jax.eval_shape(partial(init, config=config),
-                              jax.random.PRNGKey(0))
-    return sum(
-        math.prod(int(s) for s in l.shape)
-        for l in jax.tree.leaves(abstract)
-    )
+    return common_param_count(partial(init, config=config))
 
 
 def flops_per_token(config: LlamaConfig) -> float:

@@ -70,7 +70,10 @@ class RayWorker:
             runpy.run_module(module, run_name="__main__")
             return 0
         except SystemExit as e:
-            return int(e.code or 0)
+            if e.code is None:
+                return 0
+            # sys.exit("message") means failure with the message printed
+            return e.code if isinstance(e.code, int) else 1
         finally:
             sys.argv = old
 
@@ -134,15 +137,16 @@ class RayClient:
                 "(ray actors are classes; see scheduler.ray.RayWorker)"
             )
         remote_cls = self._ray.remote(executor)
+        kwargs = dict(actor_args.kwargs)
+        if actor_args.env and "env" not in kwargs:
+            kwargs["env"] = actor_args.env
         return remote_cls.options(
             num_cpus=actor_args.num_cpus,
             memory=actor_args.memory_mb * 1024 * 1024,
             resources=actor_args.resources or None,
             name=self._prefix + actor_args.actor_name,
             lifetime="detached",
-        ).remote(
-            *actor_args.args, env=actor_args.env, **actor_args.kwargs
-        )
+        ).remote(*actor_args.args, **kwargs)
 
     def delete_actor(self, actor_name: str) -> bool:
         try:

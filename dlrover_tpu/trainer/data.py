@@ -175,6 +175,50 @@ class ElasticDataLoader:
         return math.ceil(len(self.sampler) / max(self._batch_size, 1))
 
 
+class DevicePreloader:
+    """Overlap host→device transfer with compute.
+
+    Role parity: ``atorch/atorch/data/preloader.py:8`` (``GpuPreLoader``
+    — a CUDA-stream H2D prefetcher). On TPU, ``jax.device_put`` is
+    asynchronous: issuing the transfer for batch N+1 while batch N
+    computes hides the PCIe/host time. ``sharding`` may be a
+    NamedSharding (the accelerate batch spec) so the prefetch lands
+    pre-sharded on the mesh.
+    """
+
+    def __init__(self, iterable, sharding=None, prefetch: int = 2):
+        if prefetch < 1:
+            raise ValueError("prefetch must be >= 1")
+        self._iterable = iterable
+        self._sharding = sharding
+        self._prefetch = prefetch
+
+    def _put(self, batch):
+        import jax
+
+        if self._sharding is not None:
+            return jax.device_put(batch, self._sharding)
+        return jax.device_put(batch)
+
+    def __iter__(self):
+        import collections
+
+        queue = collections.deque()
+        it = iter(self._iterable)
+        try:
+            for _ in range(self._prefetch):
+                queue.append(self._put(next(it)))
+        except StopIteration:
+            pass
+        while queue:
+            out = queue.popleft()
+            try:
+                queue.append(self._put(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
+
 def _default_collate(samples: List[Any]):
     import jax
 

@@ -132,6 +132,7 @@ class TrainExecutor:
             )
         self.state: Any = None
         self.eval_metrics: Dict[str, Any] = {}
+        self._last_eval_step = -1
 
     # -- failover ------------------------------------------------------------
 
@@ -157,6 +158,7 @@ class TrainExecutor:
 
         step = int(self.state.step)
         last_log = time.time()
+        self._last_eval_step = -1
         try:
             while True:
                 data_iter = iter(self._train_iter_fn())
@@ -195,8 +197,9 @@ class TrainExecutor:
                 self._failover.stop()
 
     def _evaluate(self, step: int):
-        if self._eval_fn is None:
+        if self._eval_fn is None or step == self._last_eval_step:
             return
+        self._last_eval_step = step
         self.eval_metrics = self._eval_fn(self.state)
         logger.info("eval @%d: %s", step, {
             k: float(v) for k, v in self.eval_metrics.items()

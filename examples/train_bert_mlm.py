@@ -1,0 +1,70 @@
+"""Masked-LM pretraining for the BERT family (synthetic data).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/train_bert_mlm.py --steps 20
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.models import bert
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.trainer.conf import build_configuration
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.executor import TrainExecutor
+
+
+def mlm_batches(vocab_size, batch, seq, mask_prob=0.15, seed=0):
+    rng = np.random.RandomState(seed)
+
+    def gen():
+        while True:
+            ids = rng.randint(4, vocab_size, size=(batch, seq))
+            mask = rng.rand(batch, seq) < mask_prob
+            labels = np.where(mask, ids, -100)
+            inputs = np.where(mask, 3, ids)  # 3 = [MASK]
+            yield {
+                "input_ids": jnp.asarray(inputs),
+                "labels": jnp.asarray(labels),
+            }
+
+    return gen
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "base", "large"])
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=64)
+    args = p.parse_args()
+
+    config = {
+        "tiny": bert.bert_tiny, "base": bert.bert_base,
+        "large": bert.bert_large,
+    }[args.preset]()
+    batches = mlm_batches(config.vocab_size, args.batch, args.seq)
+    trainer = ElasticTrainer(
+        bert.make_init_fn(config),
+        bert.make_mlm_loss_fn(config),
+        optax.adamw(1e-4),
+        next(batches()),
+        strategy=Strategy(mesh=MeshPlan(data=-1), rule_set="bert",
+                          remat_policy=""),
+    )
+    executor = TrainExecutor(
+        trainer, train_iter_fn=batches,
+        conf=build_configuration({"train_steps": args.steps,
+                                  "log_every_steps": 10}),
+    )
+    out = executor.train_and_evaluate()
+    print(f"finished at step {out['step']}")
+
+
+if __name__ == "__main__":
+    main()

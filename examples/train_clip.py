@@ -1,0 +1,66 @@
+"""Contrastive image-text pretraining (CLIP) on synthetic pairs.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/train_clip.py --steps 20
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.models import clip
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.trainer.conf import build_configuration
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.executor import TrainExecutor
+
+
+def pair_batches(config, batch, seed=0):
+    rng = np.random.RandomState(seed)
+    size = config.image_size
+
+    def gen():
+        while True:
+            yield {
+                "input_ids": jnp.asarray(rng.randint(
+                    0, config.vocab_size, (batch, config.max_text_len)
+                )),
+                "pixel_values": jnp.asarray(
+                    rng.rand(batch, size, size, 3), jnp.float32
+                ),
+            }
+
+    return gen
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="tiny", choices=["tiny", "base"])
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=8)
+    args = p.parse_args()
+
+    config = (clip.clip_tiny if args.preset == "tiny" else clip.clip_base)()
+    batches = pair_batches(config, args.batch)
+    trainer = ElasticTrainer(
+        clip.make_init_fn(config),
+        clip.make_loss_fn(config),
+        optax.adamw(1e-4),
+        next(batches()),
+        strategy=Strategy(mesh=MeshPlan(data=-1), rule_set="clip",
+                          remat_policy=""),
+    )
+    executor = TrainExecutor(
+        trainer, train_iter_fn=batches,
+        conf=build_configuration({"train_steps": args.steps,
+                                  "log_every_steps": 10}),
+    )
+    out = executor.train_and_evaluate()
+    print(f"finished at step {out['step']}")
+
+
+if __name__ == "__main__":
+    main()

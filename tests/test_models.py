@@ -90,6 +90,33 @@ class TestLlama:
         state, m = result.train_step(state, batch, jax.random.PRNGKey(0))
         assert np.isfinite(float(m["loss"]))
 
+    def test_chunked_head_loss_matches_full(self):
+        cfg = llama.llama_tiny()
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 64)))
+        labels = jnp.where(jnp.asarray(rng.rand(2, 64)) < 0.9, ids, -100)
+        batch = {"input_ids": ids, "labels": labels}
+        key = jax.random.PRNGKey(1)
+        full, _ = llama.make_loss_fn(cfg)(params, batch, key)
+        chunked, _ = llama.make_loss_fn(cfg, head_chunk=16)(
+            params, batch, key
+        )
+        np.testing.assert_allclose(float(full), float(chunked), rtol=1e-5)
+        # gradients agree too (the checkpointed scan recomputes logits)
+        gf = jax.grad(lambda p: llama.make_loss_fn(cfg)(p, batch, key)[0])(
+            params
+        )
+        gc = jax.grad(
+            lambda p: llama.make_loss_fn(cfg, head_chunk=16)(
+                p, batch, key
+            )[0]
+        )(params)
+        np.testing.assert_allclose(
+            np.asarray(gf["lm_head"]["kernel"]),
+            np.asarray(gc["lm_head"]["kernel"]), atol=1e-5, rtol=1e-4,
+        )
+
     def test_param_count_7b_in_range(self):
         n = llama.param_count(llama.llama2_7b())
         assert 6.5e9 < n < 7.5e9

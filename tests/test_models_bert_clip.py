@@ -59,6 +59,22 @@ class TestBert:
     def test_param_count(self):
         assert bert.param_count(bert.bert_tiny()) > 0
 
+    def test_bf16_compute_with_f32_params(self):
+        # the production default: f32 params, bf16 compute — the scan
+        # carry dtype must stay stable through the norms
+        cfg = bert.bert_tiny(compute_dtype=jnp.bfloat16)
+        params = bert.init(jax.random.PRNGKey(0), cfg)
+        seq, _ = bert.apply(params, jnp.zeros((2, 8), jnp.int32), cfg)
+        assert seq.dtype == jnp.bfloat16
+
+    def test_clip_bf16_compute_with_f32_params(self):
+        cfg = clip.clip_tiny(compute_dtype=jnp.bfloat16)
+        params = clip.init(jax.random.PRNGKey(0), cfg)
+        out = clip.encode_text(
+            params, jnp.zeros((2, 8), jnp.int32), cfg
+        )
+        assert out.shape == (2, cfg.projection_dim)
+
 
 class TestClip:
     def test_encoders_normalized(self):
