@@ -9,10 +9,13 @@ dryrun_multichip).
 Env knobs:
   BENCH_PLATFORM=cpu     run the benchmark logic on CPU (smoke test)
   BENCH_STEPS=N          timed steps (default 10)
-  BENCH_PRESET=tiny|1b   model size (default: fit to the chip)
+  BENCH_PRESET=tiny|1b|long  model size; "long" = 16k-token context on
+                         one chip (full remat + chunked lm head)
+  BENCH_SEQ=N            sequence length override
   BENCH_BATCH=N          batch rows for the TPU preset (default 4)
   BENCH_REMAT=policy     per-layer remat policy (default dots_saveable)
   BENCH_FLASH=0|1        Pallas flash kernel on/off (default 1)
+  BENCH_HEAD_CHUNK=N     fused chunked lm-head loss chunk size (0=off)
 """
 
 from __future__ import annotations
@@ -39,9 +42,14 @@ PEAK_FLOPS = {
 
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "cpu")
-    for name, flops in PEAK_FLOPS.items():
-        if kind.lower().startswith(name.lower()):
-            return flops
+    # longest prefix wins: "TPU v5 lite" must match its own entry, not
+    # the "TPU v5" (v5p) one
+    best = ""
+    for name in PEAK_FLOPS:
+        if kind.lower().startswith(name.lower()) and len(name) > len(best):
+            best = name
+    if best:
+        return PEAK_FLOPS[best]
     return PEAK_FLOPS.get("cpu", 5e11)
 
 
@@ -56,19 +64,31 @@ def _pick_config(platform: str, preset: str):
         )
         return cfg, 4, 128
     # ~1.3B-param llama sized for a single 16GB chip with bf16 params
+    seq = int(os.environ.get("BENCH_SEQ", "0"))
+    if preset == "long":
+        # long-context single-chip: flash attention + full remat +
+        # chunked lm head keep memory linear in sequence length
+        seq = seq or 16384
+        batch = int(os.environ.get("BENCH_BATCH", "1"))
+        remat = os.environ.get("BENCH_REMAT", "full")
+        os.environ.setdefault("BENCH_HEAD_CHUNK", "1024")
+    else:
+        seq = seq or 2048
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        remat = os.environ.get("BENCH_REMAT", "dots_saveable")
     cfg = llama.llama2_7b(
         hidden_size=2048,
         intermediate_size=5504,
         num_layers=16,
         num_heads=16,
         num_kv_heads=16,
-        max_seq_len=2048,
+        max_seq_len=seq,
         param_dtype=jnp.bfloat16,
         compute_dtype=jnp.bfloat16,
-        remat_policy=os.environ.get("BENCH_REMAT", "dots_saveable"),
+        remat_policy=remat,
         use_flash=os.environ.get("BENCH_FLASH", "1") == "1",
     )
-    return cfg, int(os.environ.get("BENCH_BATCH", "4")), 2048
+    return cfg, batch, seq
 
 
 def main() -> int:

@@ -1,0 +1,211 @@
+"""Distributed acceleration engine: rank0 searches, all ranks execute.
+
+Role parity: ``atorch/atorch/auto/engine/executor.py:36`` +
+``auto/accelerate.py:563-614`` — rank0 hosts an AccelerationEngine;
+every rank runs an EngineClient loop pulling tasks (ANALYSE / DRYRUN /
+SETUP_PARALLEL_GROUP / FINISH) over RPC and reporting results. Here the
+engine serves Strategy candidates (from ``parallel.search``), collects
+dryrun timings into a ``StrategyInfoCollection``, and finishes every
+client with the winning strategy — which each rank applies via
+``accelerate`` (the SETUP_PARALLEL_GROUP equivalent: on TPU the mesh is
+built per-process from the same Strategy, no NCCL group plumbing).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from dlrover_tpu.common import serialize
+from dlrover_tpu.common.comm import Response
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.search import StrategyInfo, StrategyInfoCollection
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.rpc.client import RpcChannel
+from dlrover_tpu.rpc.server import build_server
+
+logger = get_logger("parallel.engine")
+
+
+class TaskType:
+    ANALYSE = "analyse"
+    DRYRUN = "dryrun"
+    WAIT = "wait"
+    FINISH = "finish"
+    FAIL = "fail"
+
+
+@serialize.message
+class EngineTaskRequest:
+    node_rank: int = 0
+
+
+@serialize.message
+class EngineTask:
+    task_id: int = -1
+    task_type: str = TaskType.WAIT
+    strategy_json: str = ""
+    payload: Dict = field(default_factory=dict)
+
+
+@serialize.message
+class EngineTaskResult:
+    task_id: int = -1
+    node_rank: int = 0
+    ok: bool = True
+    step_time_s: float = 0.0
+    peak_memory_bytes: int = 0
+    error: str = ""
+    payload: Dict = field(default_factory=dict)
+
+
+class AccelerationEngineServicer:
+    """Serves candidates round-robin to whichever rank asks next;
+    finishes everyone once all candidates are scored (or the budget is
+    spent)."""
+
+    def __init__(self, candidates: Sequence[Strategy],
+                 analyse_first: bool = True):
+        self._lock = threading.Lock()
+        self._candidates = list(candidates)
+        if not self._candidates:
+            raise ValueError("engine needs at least one candidate strategy")
+        self._next = 0
+        self._outstanding: Dict[int, Strategy] = {}
+        self._analyse_done = not analyse_first
+        self.collection = StrategyInfoCollection()
+        self.analysis: Dict = {}
+
+    # -- transport entry points ---------------------------------------------
+
+    def get(self, request, context=None) -> EngineTask:
+        if not isinstance(request, EngineTaskRequest):
+            return EngineTask(task_type=TaskType.FAIL)
+        with self._lock:
+            if not self._analyse_done:
+                self._analyse_done = True
+                return EngineTask(task_id=-2, task_type=TaskType.ANALYSE)
+            if self._next < len(self._candidates):
+                task_id = self._next
+                strategy = self._candidates[task_id]
+                self._next += 1
+                self._outstanding[task_id] = strategy
+                return EngineTask(
+                    task_id=task_id, task_type=TaskType.DRYRUN,
+                    strategy_json=strategy.to_json(),
+                )
+            if self._outstanding:
+                return EngineTask(task_type=TaskType.WAIT)
+            best = self.collection.best
+            if best is None:
+                return EngineTask(task_type=TaskType.FAIL)
+            return EngineTask(
+                task_type=TaskType.FINISH,
+                strategy_json=best.strategy.to_json(),
+            )
+
+    def report(self, request, context=None) -> Response:
+        if not isinstance(request, EngineTaskResult):
+            return Response(success=False, reason="unknown message")
+        with self._lock:
+            if request.task_id == -2:  # analysis result
+                self.analysis.update(request.payload)
+                return Response(success=True)
+            strategy = self._outstanding.pop(request.task_id, None)
+            if strategy is None:
+                return Response(success=False, reason="unknown task")
+            self.collection.add(StrategyInfo(
+                strategy=strategy,
+                step_time_s=request.step_time_s,
+                peak_memory_bytes=request.peak_memory_bytes,
+                error="" if request.ok else (request.error or "failed"),
+            ))
+        return Response(success=True)
+
+
+class AccelerationEngine:
+    """rank0-hosted engine service (``AccelerationEngine.start_service``
+    parity)."""
+
+    def __init__(self, candidates: Sequence[Strategy], port: int = 0):
+        self.servicer = AccelerationEngineServicer(candidates)
+        self._server, self.port = build_server(self.servicer, port=port)
+        self.addr = f"127.0.0.1:{self.port}"
+
+    def start(self):
+        self._server.start()
+        logger.info("acceleration engine at :%d", self.port)
+
+    def stop(self, grace: float = 1.0):
+        self._server.stop(grace)
+
+    @property
+    def best_strategy(self) -> Optional[Strategy]:
+        best = self.servicer.collection.best
+        return best.strategy if best else None
+
+
+class EngineClient:
+    """Per-rank task loop (``EngineClient`` / ``run_task`` parity).
+
+    ``dryrun_fn(strategy) -> StrategyInfo`` measures one candidate;
+    ``analyse_fn() -> dict`` reports device/model facts (rank0 only
+    receives the ANALYSE task once).
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        node_rank: int,
+        dryrun_fn: Callable[[Strategy], StrategyInfo],
+        analyse_fn: Optional[Callable[[], Dict]] = None,
+        poll_interval: float = 0.2,
+    ):
+        self._channel = RpcChannel(addr)
+        self._rank = node_rank
+        self._dryrun = dryrun_fn
+        self._analyse = analyse_fn
+        self._interval = poll_interval
+
+    def run(self, max_tasks: int = 1000) -> Strategy:
+        """Execute tasks until FINISH; returns the winning strategy."""
+        import time
+
+        for _ in range(max_tasks):
+            task: EngineTask = self._channel.get(
+                EngineTaskRequest(node_rank=self._rank)
+            )
+            if task.task_type == TaskType.FINISH:
+                return Strategy.from_json(task.strategy_json)
+            if task.task_type == TaskType.FAIL:
+                raise RuntimeError("engine search failed: no viable strategy")
+            if task.task_type == TaskType.WAIT:
+                time.sleep(self._interval)
+                continue
+            if task.task_type == TaskType.ANALYSE:
+                payload = self._analyse() if self._analyse else {}
+                self._channel.report(EngineTaskResult(
+                    task_id=task.task_id, node_rank=self._rank,
+                    payload=payload,
+                ))
+                continue
+            # DRYRUN
+            strategy = Strategy.from_json(task.strategy_json)
+            try:
+                info = self._dryrun(strategy)
+                self._channel.report(EngineTaskResult(
+                    task_id=task.task_id, node_rank=self._rank,
+                    ok=info.ok, step_time_s=info.step_time_s,
+                    peak_memory_bytes=info.peak_memory_bytes,
+                    error=info.error,
+                ))
+            except Exception as e:  # noqa: BLE001 — report, keep looping
+                self._channel.report(EngineTaskResult(
+                    task_id=task.task_id, node_rank=self._rank,
+                    ok=False, error=f"{type(e).__name__}: {e}"[:200],
+                ))
+        raise RuntimeError("engine task budget exhausted without FINISH")
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,111 @@
+"""Acceleration engine: task protocol over real RPC, multi-client
+search, failure handling."""
+
+import threading
+
+import pytest
+
+from dlrover_tpu.parallel.engine import (
+    AccelerationEngine,
+    EngineClient,
+    EngineTask,
+    EngineTaskRequest,
+    TaskType,
+)
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.search import StrategyInfo
+from dlrover_tpu.parallel.strategy import Strategy
+
+
+def _candidates():
+    return [
+        Strategy(mesh=MeshPlan(data=8)),
+        Strategy(mesh=MeshPlan(data=4, tensor=2)),
+        Strategy(mesh=MeshPlan(data=2, fsdp=2, tensor=2)),
+    ]
+
+
+def _dryrun_fn(strategy: Strategy) -> StrategyInfo:
+    # synthetic: tensor parallelism wins
+    t = 1.0 / max(strategy.mesh.tensor, 1) + 0.1 * strategy.mesh.data
+    return StrategyInfo(strategy=strategy, step_time_s=t)
+
+
+class TestEngine:
+    def test_single_client_search(self):
+        engine = AccelerationEngine(_candidates())
+        engine.start()
+        try:
+            client = EngineClient(
+                engine.addr, 0, _dryrun_fn, analyse_fn=lambda: {"chips": 8}
+            )
+            best = client.run()
+            assert best.mesh.tensor == 2 and best.mesh.fsdp == 2
+            assert engine.servicer.analysis == {"chips": 8}
+            assert len(engine.servicer.collection) == 3
+            client.close()
+        finally:
+            engine.stop()
+
+    def test_multi_client_convergence(self):
+        engine = AccelerationEngine(_candidates())
+        engine.start()
+        results = {}
+
+        def worker(rank):
+            client = EngineClient(engine.addr, rank, _dryrun_fn,
+                                  poll_interval=0.01)
+            results[rank] = client.run()
+            client.close()
+
+        try:
+            threads = [threading.Thread(target=worker, args=(r,))
+                       for r in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            # every rank converges on the same winning strategy
+            assert len(results) == 3
+            meshes = {r.to_json() for r in results.values()}
+            assert len(meshes) == 1
+        finally:
+            engine.stop()
+
+    def test_failed_candidates_excluded(self):
+        def flaky_dryrun(strategy):
+            if strategy.mesh.data == 8:
+                raise MemoryError("oom")
+            return _dryrun_fn(strategy)
+
+        engine = AccelerationEngine(_candidates())
+        engine.start()
+        try:
+            best = EngineClient(engine.addr, 0, flaky_dryrun).run()
+            assert best.mesh.data != 8
+        finally:
+            engine.stop()
+
+    def test_all_failing_raises(self):
+        def bad(strategy):
+            raise RuntimeError("nope")
+
+        engine = AccelerationEngine(_candidates())
+        engine.start()
+        try:
+            with pytest.raises(RuntimeError, match="no viable"):
+                EngineClient(engine.addr, 0, bad).run()
+        finally:
+            engine.stop()
+
+    def test_servicer_rejects_unknown_messages(self):
+        engine = AccelerationEngine(_candidates())
+        out = engine.servicer.get(EngineTaskRequest(node_rank=0))
+        # first task is ANALYSE
+        assert out.task_type == TaskType.ANALYSE
+        bad = engine.servicer.get(EngineTask())
+        assert bad.task_type == TaskType.FAIL
+
+    def test_empty_candidates_rejected(self):
+        with pytest.raises(ValueError):
+            AccelerationEngine([])
