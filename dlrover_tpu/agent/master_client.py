@@ -204,10 +204,12 @@ class MasterClient:
         return resp.version
 
     def update_cluster_version(self, version_type: str, version: int,
-                               task_type: str, task_id: int):
+                               task_type: str, task_id: int,
+                               expected: int = -1):
         return self._channel.report(comm.ClusterVersionUpdate(
             task_type=task_type, task_id=task_id,
             version_type=version_type, version=version,
+            expected=expected,
         ))
 
     def query_ps_nodes(self) -> comm.PsNodes:

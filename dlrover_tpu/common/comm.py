@@ -334,6 +334,10 @@ class ClusterVersionUpdate:
     task_id: int = 0
     version_type: str = "global"
     version: int = 0
+    # Compare-and-set guard: apply only while the current value equals
+    # `expected` (-1 = unconditional). Makes concurrent global-version
+    # bumps race-free server-side.
+    expected: int = -1
 
 
 @message

@@ -38,11 +38,22 @@ class ElasticPsService:
             )
 
     def update_cluster_version(self, version_type: str, version: int,
-                               task_type: str, task_id: int):
+                               task_type: str, task_id: int,
+                               expected: int = -1) -> bool:
+        """Set a version; with ``expected >= 0`` this is an atomic
+        compare-and-set (applied only while the current value equals
+        ``expected``), so concurrent workers bumping GLOBAL cannot
+        clobber each other's read-modify-write."""
         with self._lock:
             if version_type == self.GLOBAL:
+                if expected >= 0 and self._global_version != expected:
+                    return False
                 self._global_version = version
-                return
-            self._node_versions.setdefault(task_type, {}).setdefault(
+                return True
+            node = self._node_versions.setdefault(task_type, {}).setdefault(
                 task_id, {}
-            )[version_type] = version
+            )
+            if expected >= 0 and node.get(version_type, 0) != expected:
+                return False
+            node[version_type] = version
+            return True

@@ -336,11 +336,13 @@ class MasterServicer:
         return comm.ClusterVersion(version=version)
 
     def _update_cluster_version(self, req: comm.ClusterVersionUpdate):
+        applied = True
         if self._elastic_ps_service is not None:
-            self._elastic_ps_service.update_cluster_version(
-                req.version_type, req.version, req.task_type, req.task_id
+            applied = self._elastic_ps_service.update_cluster_version(
+                req.version_type, req.version, req.task_type, req.task_id,
+                expected=req.expected,
             )
-        return comm.Response(success=True)
+        return comm.Response(success=applied)
 
     def _query_ps_nodes(self, req: comm.QueryPsNodesRequest):
         if self._job_manager is None or not hasattr(

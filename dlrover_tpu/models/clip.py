@@ -231,7 +231,9 @@ def contrastive_loss(
     params: Dict, text_emb: jax.Array, image_emb: jax.Array
 ) -> Tuple[jax.Array, Dict]:
     """Symmetric InfoNCE over the (global) batch."""
-    scale = jnp.exp(params["logit_scale"])
+    # CLIP recipe: clamp the learnable temperature so exp(logit_scale)
+    # never exceeds 100, preventing runaway contrastive logits.
+    scale = jnp.exp(jnp.minimum(params["logit_scale"], math.log(100.0)))
     logits = scale * text_emb @ image_emb.T  # [B, B]
     labels = jnp.arange(logits.shape[0])
     t2i = -jnp.mean(

@@ -178,10 +178,10 @@ def _attention_block(x, layer, config: LlamaConfig, positions):
     v = (x @ layer["v_proj"]["kernel"]).reshape(b, s, kv, hd)
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
-    if kv != h:  # GQA: broadcast kv heads across query groups
-        rep = h // kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA kv heads are NOT repeated: the flash/ring kernels index the
+    # shared KV head per query group, so HBM holds (and the ring
+    # rotates) only the kv heads — h/kv less traffic than the repeat
+    # the reference pays before its CUDA kernel (layers.py:1268).
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
     if c.seq_axis and c.mesh is not None:
         out = ring_attention(

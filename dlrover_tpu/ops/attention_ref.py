@@ -11,14 +11,18 @@ import jax.numpy as jnp
 
 def mha_reference(
     q: jax.Array,  # [B, H, S, D]
-    k: jax.Array,  # [B, H, S, D]
-    v: jax.Array,  # [B, H, S, D]
+    k: jax.Array,  # [B, H_kv, S, D] (H_kv divides H; GQA broadcast here)
+    v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
     bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     head_dim = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (head_dim ** 0.5)
+    if k.shape[1] != q.shape[1]:  # GQA: the reference may materialize
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     logits = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale

@@ -15,6 +15,16 @@ inner), recomputing probability tiles from the saved logsumexp — no
 O(S^2) residuals are ever materialized. All MXU dots run on the storage
 dtype (bf16) with f32 accumulation. Long-context scaling across chips is
 handled one level up by ``ops.ring_attention``.
+
+GQA is native: K/V may carry fewer heads than Q (``num_kv_heads``
+divides ``num_heads``); the kernels index the shared KV block per query
+group (``h // group`` in the BlockSpec index maps) instead of
+materializing repeated heads, so HBM traffic for K/V is ``kv/h`` of the
+MHA equivalent (the reference pays the full repeat before its CUDA
+kernel, ``modules/transformer/layers.py:1268``). ``flash_attention_lse``
+additionally returns the per-row logsumexp and is differentiable in it,
+which is what lets ``ring_attention`` rescale and merge per-ring-step
+outputs without ever forming an [S, S] tile.
 """
 
 from __future__ import annotations
@@ -119,12 +129,23 @@ def _flash_fwd_kernel(
         lse_ref[0, 0, 0, :] = lse[:, 0]
 
 
+def _group_size(q, k) -> int:
+    """Query heads per KV head (1 = MHA). Static, from the shapes."""
+    heads, kv_heads = q.shape[1], k.shape[1]
+    if heads % kv_heads:
+        raise ValueError(
+            f"num_heads {heads} not divisible by num_kv_heads {kv_heads}"
+        )
+    return heads // kv_heads
+
+
 def _flash_forward(
     q, k, v, *, scale: float, causal: bool,
     block_q: int, block_k: int, interpret: bool,
 ):
     batch, heads, s_q, head_dim = q.shape
     s_k = k.shape[2]
+    group = _group_size(q, k)
     if causal and s_q != s_k:
         raise ValueError(
             f"causal flash attention requires s_q == s_k (got {s_q} vs "
@@ -144,10 +165,11 @@ def _flash_forward(
         in_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim),
                          lambda b, h, i, j: (b, h, i, 0)),
+            # GQA: query head h reads KV head h // group
             pl.BlockSpec((1, 1, block_k, head_dim),
-                         lambda b, h, i, j: (b, h, j, 0)),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, block_k, head_dim),
-                         lambda b, h, i, j: (b, h, j, 0)),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim),
@@ -179,6 +201,27 @@ def _vmem(shape):
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
+def flash_attention_lse(
+    q: jax.Array,  # [B, H, S, D]
+    k: jax.Array,  # [B, H_kv, S, D] (H_kv divides H)
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """Attention returning ``(out, lse)`` where ``lse[b,h,s]`` is the
+    row logsumexp of the (scaled, masked) scores. Differentiable in both
+    outputs — the lse cotangent folds into the backward's delta term
+    (``ds = p * (dp - (delta - dlse))``), which is what makes the
+    ring-attention merge exact under autodiff."""
+    (out, lse), _ = _flash_attention_lse_fwd(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return out, lse
+
+
 def flash_attention(
     q: jax.Array,  # [B, H, S, D]
     k: jax.Array,
@@ -191,10 +234,9 @@ def flash_attention(
 ) -> jax.Array:
     """Memory-efficient attention; differentiable (blockwise recompute
     backward from the saved logsumexp, no quadratic residuals)."""
-    out, _ = _flash_attention_fwd(
+    return flash_attention_lse(
         q, k, v, causal, scale, block_q, block_k, interpret
-    )
-    return out
+    )[0]
 
 
 def _resolve(scale, head_dim, interpret):
@@ -204,15 +246,15 @@ def _resolve(scale, head_dim, interpret):
     return scale, interpret
 
 
-def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k,
-                         interpret):
+def _flash_attention_lse_fwd(q, k, v, causal, scale, block_q, block_k,
+                             interpret):
     scale_v, interp = _resolve(scale, q.shape[-1], interpret)
     out, lse = _flash_forward(
         q, k, v, scale=scale_v, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interp,
     )
     lse = lse.reshape(q.shape[0], q.shape[1], q.shape[2])
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
 def _recompute_p(q, k, lse, *, scale, causal, i, j, block_q, block_k):
@@ -236,14 +278,19 @@ def _recompute_p(q, k, lse, *, scale, causal, i, j, block_q, block_k):
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # VMEM blocks
     dk_ref, dv_ref,
-    dk_scratch, dv_scratch,  # f32 carries across the q grid dim
+    dk_scratch, dv_scratch,  # f32 carries across the (g, q) grid dims
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
+    # grid (batch, kv_head, j, g, i): the two innermost (sequential)
+    # dims sweep the query heads of this KV head's group and the q
+    # blocks, so dk/dv accumulate over both without write conflicts.
     j = pl.program_id(2)  # k block index
-    i = pl.program_id(3)  # q block index (innermost, sequential)
-    nq = pl.num_programs(3)
+    g = pl.program_id(3)  # query-head index within the KV group
+    i = pl.program_id(4)  # q block index (innermost, sequential)
+    ng = pl.num_programs(3)
+    nq = pl.num_programs(4)
 
-    @pl.when(i == 0)
+    @pl.when(jnp.logical_and(g == 0, i == 0))
     def _init():
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
@@ -282,7 +329,7 @@ def _flash_bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(i == nq - 1)
+    @pl.when(jnp.logical_and(g == ng - 1, i == nq - 1))
     def _finalize():
         dk_ref[0, 0, :, :] = dk_scratch[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_scratch[:].astype(dv_ref.dtype)
@@ -332,56 +379,56 @@ def _flash_bwd_dq_kernel(
         dq_ref[0, 0, :, :] = dq_scratch[:].astype(dq_ref.dtype)
 
 
-def _flash_attention_bwd(causal, scale, block_q, block_k, interpret,
-                         residuals, g):
+def _flash_attention_lse_bwd(causal, scale, block_q, block_k, interpret,
+                             residuals, cotangents):
     """Pallas backward: a dKV kernel (k blocks outer, q inner) and a dQ
     kernel (q outer, k inner), both recomputing probability tiles from the
-    saved logsumexp — peak extra memory is O(Bq * Bk), never O(S^2)."""
+    saved logsumexp — peak extra memory is O(Bq * Bk), never O(S^2).
+
+    The lse cotangent is exact and free: d(lse)/d(scores) is the prob
+    tile itself, so it enters as ``ds = p * (dp - (delta - dlse))`` —
+    the existing delta term with ``dlse`` subtracted."""
     q, k, v, out, lse = residuals
+    do, dlse = cotangents
     scale_v, interp = _resolve(scale, q.shape[-1], interpret)
 
     batch, heads, s_q, d = q.shape
     s_k = k.shape[2]
+    group = _group_size(q, k)
     bq = _fit_block(block_q, s_q)
     bk = _fit_block(block_k, s_k)
 
     f32 = jnp.float32
     delta = jnp.sum(
-        g.astype(f32) * out.astype(f32), axis=-1
-    )  # [B,H,Sq]
+        do.astype(f32) * out.astype(f32), axis=-1
+    ) - dlse.astype(f32)  # [B,H,Sq]
     # [B, H, 1, S] layout so the last-two block dims obey TPU tiling
     lse4 = lse.reshape(batch, heads, 1, s_q)
     delta4 = delta.reshape(batch, heads, 1, s_q)
 
-    def io_specs(outer_is_k):
-        """Block specs for (q, k, v, do, lse, delta) given grid layout."""
-        if outer_is_k:  # grid (b, h, j, i): i = q block, j = k block
-            q_idx = lambda b, h, j, i: (b, h, i, 0)  # noqa: E731
-            k_idx = lambda b, h, j, i: (b, h, j, 0)  # noqa: E731
-        else:  # grid (b, h, i, j)
-            q_idx = lambda b, h, i, j: (b, h, i, 0)  # noqa: E731
-            k_idx = lambda b, h, i, j: (b, h, j, 0)  # noqa: E731
-        lse_idx = (lambda b, h, j, i: (b, h, 0, i)) if outer_is_k else (
-            lambda b, h, i, j: (b, h, 0, i))
-        return [
-            pl.BlockSpec((1, 1, bq, d), q_idx),
-            pl.BlockSpec((1, 1, bk, d), k_idx),
-            pl.BlockSpec((1, 1, bk, d), k_idx),
-            pl.BlockSpec((1, 1, bq, d), q_idx),
-            pl.BlockSpec((1, 1, 1, bq), lse_idx),
-            pl.BlockSpec((1, 1, 1, bq), lse_idx),
-        ]
-
+    # dKV grid (b, kv_head, j, g, i): g sweeps the query heads sharing
+    # this KV head, i sweeps q blocks; both are sequential on TPU so the
+    # f32 scratch accumulates across the whole group (the GQA head-sum).
+    qh = lambda b, hk, j, g, i: (b, hk * group + g, i, 0)  # noqa: E731
+    kvh = lambda b, hk, j, g, i: (b, hk, j, 0)  # noqa: E731
+    row = lambda b, hk, j, g, i: (b, hk * group + g, 0, i)  # noqa: E731
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale_v, causal=causal,
             block_q=bq, block_k=bk,
         ),
-        grid=(batch, heads, s_k // bk, s_q // bq),
-        in_specs=io_specs(outer_is_k=True),
+        grid=(batch, k.shape[1], s_k // bk, group, s_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), qh),
+            pl.BlockSpec((1, 1, bk, d), kvh),
+            pl.BlockSpec((1, 1, bk, d), kvh),
+            pl.BlockSpec((1, 1, bq, d), qh),
+            pl.BlockSpec((1, 1, 1, bq), row),
+            pl.BlockSpec((1, 1, 1, bq), row),
+        ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), kvh),
+            pl.BlockSpec((1, 1, bk, d), kvh),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -389,27 +436,40 @@ def _flash_attention_bwd(causal, scale, block_q, block_k, interpret,
         ],
         scratch_shapes=[_vmem((bk, d)), _vmem((bk, d))],
         interpret=interp,
-    )(q, k, v, g, lse4, delta4)
+    )(q, k, v, do, lse4, delta4)
 
+    # dQ grid (b, h, i, j): per-q-head, reads the group's shared KV head
+    qi = lambda b, h, i, j: (b, h, i, 0)  # noqa: E731
+    kj = lambda b, h, i, j: (b, h // group, j, 0)  # noqa: E731
+    ri = lambda b, h, i, j: (b, h, 0, i)  # noqa: E731
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale_v, causal=causal,
             block_q=bq, block_k=bk,
         ),
         grid=(batch, heads, s_q // bq, s_k // bk),
-        in_specs=io_specs(outer_is_k=False),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), qi),
+            pl.BlockSpec((1, 1, bk, d), kj),
+            pl.BlockSpec((1, 1, bk, d), kj),
+            pl.BlockSpec((1, 1, bq, d), qi),
+            pl.BlockSpec((1, 1, 1, bq), ri),
+            pl.BlockSpec((1, 1, 1, bq), ri),
+        ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), qi),
         ],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         scratch_shapes=[_vmem((bq, d))],
         interpret=interp,
-    )(q, k, v, g, lse4, delta4)[0]
+    )(q, k, v, do, lse4, delta4)[0]
 
     return dq, dk, dv
 
 
-flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+flash_attention_lse.defvjp(
+    _flash_attention_lse_fwd, _flash_attention_lse_bwd
+)
 
 
 def attention(q, k, v, causal=True, scale=None, use_flash=True, **kwargs):

@@ -1,17 +1,32 @@
-"""Ring attention: sequence-parallel attention over a mesh axis.
+"""Ring attention: sequence-parallel flash attention over a mesh axis.
 
 Role parity: ``atorch/atorch/modules/distributed_transformer/
 distributed_attention.py:21-130`` (DistributedSoftmax + micro-chunk
 allgather with compute/comm overlap on two CUDA streams). The TPU-native
 formulation inverts the data movement: K/V shards rotate around the "seq"
 mesh axis with ``lax.ppermute`` (one ICI hop per step — the natural TPU
-torus pattern) while Q stays resident, and softmax is combined *online*
-(running max/normalizer per query) so no [S, S] tile and no second pass
-over the sequence ever exist. XLA overlaps the ppermute with the block
+torus pattern) while Q stays resident, and per-step outputs are merged
+*online* via their logsumexp, so no [S, S] tile and no second pass over
+the sequence ever exist. XLA overlaps the ppermute with the block
 attention compute, which is the dual-stream overlap of the reference.
 
-Memory per chip: O(S_local * D). Sequence length scales linearly with the
-"seq" axis size.
+Each ring step runs the in-tree Pallas flash kernel
+(``ops.flash_attention.flash_attention_lse``) on the visiting K/V shard:
+the [Bq, Bk] logits tile exists only in VMEM inside the kernel, and the
+kernel returns ``(out, lse)`` which the ring merges exactly:
+
+  lse' = logaddexp(lse, lse_i)
+  o'   = o * exp(lse - lse') + o_i * exp(lse_i - lse')
+
+Causality is resolved at *block* granularity, for free: the local shard
+attends with the standard causal kernel; a visiting shard is either
+entirely in the past (attend with no mask) or entirely in the future
+(skip — ``lax.cond`` keeps the carry). GQA rotates only the KV heads
+(``[B, H_kv, S_local, D]``), so ring ICI bytes are ``kv/h`` of the MHA
+equivalent and the kernel indexes the shared KV head per query group.
+
+Memory per chip: O(S_local * D). Sequence length scales linearly with
+the "seq" axis size.
 """
 
 from __future__ import annotations
@@ -24,41 +39,103 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from dlrover_tpu.ops.flash_attention import flash_attention_lse
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _block_attend(q, k, v, row_offset, col_offset, scale, causal):
-    """One (local-q x visiting-kv) block with global-position masking.
+def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
+                    block_k: int = 512):
+    """Blockwise-XLA attention returning ``(out_f32, lse_f32)``.
 
-    Returns (unnormalized acc, row max m, row normalizer l).
+    The non-TPU counterpart of the Pallas kernel: a ``lax.scan`` over
+    K/V chunks carrying (acc, m, l), so peak memory is O(S_q * block_k)
+    per head — linear in the sequence, like the kernel, which keeps the
+    CPU-mesh long-context tests honest. GQA-aware (k/v may carry fewer
+    heads).
     """
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        rows = lax.broadcasted_iota(jnp.int32, s.shape, 2) + row_offset
-        cols = lax.broadcasted_iota(jnp.int32, s.shape, 3) + col_offset
-        s = jnp.where(rows >= cols, s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Sq,1]
-    # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1; clamp m first
-    m_safe = jnp.maximum(m, NEG_INF / 2)
-    p = jnp.exp(s - m_safe)
-    p = jnp.where(m <= NEG_INF / 2, 0.0, p)  # kill fully-masked rows
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
+    b, h, s_q, d = q.shape
+    hkv, s_k = k.shape[1], k.shape[2]
+    g = h // hkv
+    bk = min(block_k, s_k)
+    pad = (-s_k) % bk
+    if pad:  # pad K/V with masked keys instead of shrinking the block
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    nk = (s_k + pad) // bk
+
+    qf = q.reshape(b, hkv, g, s_q, d).astype(jnp.float32)
+    kb = jnp.moveaxis(k.reshape(b, hkv, nk, bk, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, hkv, nk, bk, d), 2, 0)
+
+    def step(carry, inp):
+        acc, m, l = carry
+        kj, vj, j = inp
+        s = jnp.einsum(
+            "bkgqd,bkcd->bkgqc", qf, kj.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        cols = lax.broadcasted_iota(jnp.int32, s.shape, 4) + j * bk
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if pad:
+            s = jnp.where(cols < s_k, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        m_safe = jnp.maximum(m_new, NEG_INF / 2)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where((s <= NEG_INF / 2)[..., :], 0.0, p)
+        alpha = jnp.where(
+            m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe)
+        )
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bkgqc,bkcd->bkgqd", p, vj.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return (acc_new, m_new, l_new), None
+
+    # derive init from qf so the carry varies over any shard_map manual
+    # axes exactly like the step outputs do
+    init = (
+        qf * 0.0,
+        qf[..., 0] * 0.0 + NEG_INF,
+        qf[..., 0] * 0.0,
     )
-    return acc, jnp.where(m <= NEG_INF / 2, NEG_INF, m), l
+    (acc, m, l), _ = lax.scan(
+        step, init, (kb, vb, jnp.arange(nk, dtype=jnp.int32))
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe[..., None]).reshape(b, h, s_q, d)
+    lse = jnp.where(
+        l == 0.0, NEG_INF, jnp.maximum(m, NEG_INF / 2) + jnp.log(l_safe)
+    ).reshape(b, h, s_q)
+    return out, lse
+
+
+def _attend_lse(q, k, v, *, causal, scale, impl, block_q, block_k):
+    """One (local-q x visiting-kv) shard attention -> (out f32, lse f32)."""
+    if impl == "xla":
+        return _xla_attend_lse(q, k, v, causal=causal, scale=scale,
+                               block_k=block_k)
+    out, lse = flash_attention_lse(
+        q, k, v, causal, scale, block_q, block_k,
+        interpret=(impl == "pallas_interpret") or None,
+    )
+    return out.astype(jnp.float32), lse
 
 
 def ring_attention_local(
     q: jax.Array,  # local shard [B, H, S_local, D]
-    k: jax.Array,
+    k: jax.Array,  # [B, H_kv, S_local, D]
     v: jax.Array,
     axis_name: str = "seq",
     causal: bool = True,
     scale: Optional[float] = None,
+    impl: Optional[str] = None,  # pallas | pallas_interpret | xla
+    block_q: int = 512,
+    block_k: int = 1024,
 ) -> jax.Array:
     """The per-device body; call inside shard_map over ``axis_name``.
 
@@ -67,53 +144,64 @@ def ring_attention_local(
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
-    s_local = q.shape[2]
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-
-    qf = q.astype(jnp.float32)
-    row_offset = my * s_local
-
-    def combine(acc, m, l, a_new, m_new, l_new):
-        m_comb = jnp.maximum(m, m_new)
-        alpha = jnp.exp(m - m_comb)
-        beta = jnp.exp(m_new - m_comb)
-        return (
-            acc * alpha + a_new * beta,
-            m_comb,
-            l * alpha + l_new * beta,
-        )
-
-    # step 0: the local block (no rotation needed)
-    acc, m, l = _block_attend(
-        qf, k, v, row_offset, my * s_local, scale, causal
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    attend = functools.partial(
+        _attend_lse, scale=scale, impl=impl,
+        block_q=block_q, block_k=block_k,
     )
+
+    # step 0: the local block — the only one needing an intra-block
+    # causal mask, which the flash kernel applies at tile granularity
+    o, lse = attend(q, k, v, causal=causal)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def merge(o, lse, o_i, lse_i):
+        lse_new = jnp.logaddexp(lse, lse_i)
+        o_new = (
+            o * jnp.exp(lse - lse_new)[..., None]
+            + o_i * jnp.exp(lse_i - lse_new)[..., None]
+        )
+        return o_new, lse_new
+
+    def attend_merge(o, lse, ck, cv):
+        o_i, lse_i = attend(q, ck, cv, causal=False)
+        return merge(o, lse, o_i, lse_i)
+
     def step(carry, _):
-        acc, m, l, cur_k, cur_v, owner = carry
+        o, lse, cur_k, cur_v, owner = carry
         # rotate kv to the next neighbor (single ICI hop), then attend;
         # n-1 rotations total — the last visiting shard is not re-sent.
+        # Only the H_kv heads travel: GQA pays kv/h of the MHA bytes.
         cur_k = lax.ppermute(cur_k, axis_name, perm)
         cur_v = lax.ppermute(cur_v, axis_name, perm)
         owner = jnp.asarray((owner - 1) % n, jnp.int32)
-        a_new, m_new, l_new = _block_attend(
-            qf, cur_k, cur_v, row_offset, owner * s_local, scale, causal
-        )
-        acc, m, l = combine(acc, m, l, a_new, m_new, l_new)
-        return (acc, m, l, cur_k, cur_v, owner), None
+        if causal:
+            # visiting shard is wholly past (attend, unmasked) or wholly
+            # future (skip — keep the carry); never straddles the
+            # diagonal because the layout is contiguous
+            o, lse = lax.cond(
+                owner < my,
+                attend_merge,
+                lambda o, lse, ck, cv: (o, lse),
+                o, lse, cur_k, cur_v,
+            )
+        else:
+            o, lse = attend_merge(o, lse, cur_k, cur_v)
+        return (o, lse, cur_k, cur_v, owner), None
 
-    (acc, m, l, _, _, _), _ = lax.scan(
-        step, (acc, m, l, k, v, jnp.asarray(my, jnp.int32)), None,
+    (o, lse, _, _, _), _ = lax.scan(
+        step, (o, lse, k, v, jnp.asarray(my, jnp.int32)), None,
         length=n - 1,
     )
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    return (acc / l_safe).astype(q.dtype)
+    return o.astype(q.dtype)
 
 
 def ring_attention(
     q: jax.Array,  # global [B, H, S, D], S sharded on `axis_name`
-    k: jax.Array,
+    k: jax.Array,  # global [B, H_kv, S, D]
     v: jax.Array,
     mesh,
     axis_name: str = "seq",
@@ -121,6 +209,9 @@ def ring_attention(
     scale: Optional[float] = None,
     batch_axes=("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
+    impl: Optional[str] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
 ) -> jax.Array:
     """shard_map wrapper: global arrays in, global arrays out.
 
@@ -132,14 +223,48 @@ def ring_attention(
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    if head_axis is not None:
+        # GQA kv heads must still divide the head mesh axis; when they
+        # don't (e.g. 8 kv heads over tensor=16), repeat minimally so
+        # the spec is legal — still cheaper than the full h/kv repeat.
+        tensor_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+            head_axis, 1
+        )
+        kv_heads, heads = k.shape[1], q.shape[1]
+        if kv_heads % tensor_size:
+            rep = next(
+                (r for r in range(1, heads // kv_heads + 1)
+                 if (kv_heads * r) % tensor_size == 0
+                 and heads % (kv_heads * r) == 0),
+                None,
+            )
+            if rep is None:
+                raise ValueError(
+                    f"cannot shard {kv_heads} kv heads (of {heads} query "
+                    f"heads) over {head_axis}={tensor_size}"
+                )
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
     spec = P(batch_axes, head_axis, axis_name, None)
+    # pallas_call out_shapes carry no varying-mesh-axes metadata, so
+    # vma/replication checking cannot see through the kernel; the knob
+    # is check_vma on current jax, check_rep on older shard_map
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False} if "check_vma" in params
+        else {"check_rep": False} if "check_rep" in params
+        else {}
+    )
     fn = shard_map(
         functools.partial(
             ring_attention_local, axis_name=axis_name, causal=causal,
-            scale=scale,
+            scale=scale, impl=impl, block_q=block_q, block_k=block_k,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **check_kw,
     )
     return fn(q, k, v)

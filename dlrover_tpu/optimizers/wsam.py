@@ -83,7 +83,17 @@ def wsam(
         return jax.tree.map(lambda g: g * factor, grads)
 
     def update_with_grad_fn(grads, state: WsamState, params, grad_fn):
-        scale = rho / (global_norm(grads) + sam_eps)
+        # ASAM semantics: when adaptive, the perturbation radius is
+        # measured in the weight-adaptive metric, so the norm is taken
+        # over |p|*g (matching the reference's _grad_norm) while the
+        # numerator carries |p|^2*g.
+        if adaptive:
+            norm = global_norm(
+                jax.tree.map(lambda p, g: jnp.abs(p) * g, params, grads)
+            )
+        else:
+            norm = global_norm(grads)
+        scale = rho / (norm + sam_eps)
         e_w = jax.tree.map(
             lambda p, g: (jnp.square(p) if adaptive else 1.0) * g * (
                 scale.astype(g.dtype)

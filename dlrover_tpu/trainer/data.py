@@ -135,6 +135,7 @@ class ElasticDataLoader:
         sampler: Optional[ElasticDistributedSampler] = None,
         collate_fn: Optional[Callable[[List[Any]], Any]] = None,
         sharding_client=None,
+        drop_last: bool = True,
     ):
         self.dataset = dataset
         self._batch_size = batch_size
@@ -145,6 +146,12 @@ class ElasticDataLoader:
         # When set, indices come from the master's dynamic sharding
         # service instead of the static sampler.
         self._sharding_client = sharding_client
+        # Every emitted batch must have a fixed leading dim: a trailing
+        # partial batch recompiles the jitted SPMD step, and with the
+        # dynamic sharding client different hosts can see different
+        # partial sizes and desync. drop_last=False pads the final batch
+        # (wrapping samples) instead of dropping it.
+        self._drop_last = drop_last
 
     @property
     def batch_size(self) -> int:
@@ -168,11 +175,14 @@ class ElasticDataLoader:
             if len(buf) == self._batch_size:
                 yield self._collate(buf)
                 buf.clear()
-        if buf:
-            yield self._collate(buf)
+        if buf and not self._drop_last:
+            while len(buf) < self._batch_size:  # pad to the fixed shape
+                buf.extend(buf[: self._batch_size - len(buf)])
+            yield self._collate(buf[: self._batch_size])
 
     def __len__(self) -> int:
-        return math.ceil(len(self.sampler) / max(self._batch_size, 1))
+        n, bs = len(self.sampler), max(self._batch_size, 1)
+        return n // bs if self._drop_last else -(-n // bs)
 
 
 class DevicePreloader:

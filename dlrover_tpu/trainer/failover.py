@@ -41,11 +41,16 @@ class FailoverClient:
         self._task_id = task_id
 
     def init_version(self):
-        """On startup: local <- global (first worker bumps global to 1)."""
+        """On startup: local <- global (first worker bumps global 0->1
+        via a master-side compare-and-set, so two workers starting at
+        once cannot both apply their own read-modify-write)."""
         global_version = self.get_version(VersionType.GLOBAL)
         if global_version == 0:
-            self.set_version(VersionType.GLOBAL, 1)
-            global_version = 1
+            self._client.update_cluster_version(
+                VersionType.GLOBAL, 1, self._task_type, self._task_id,
+                expected=0,
+            )
+            global_version = self.get_version(VersionType.GLOBAL)
         self.set_version(VersionType.LOCAL, global_version)
 
     def get_version(self, version_type: str) -> int:

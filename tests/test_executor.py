@@ -83,7 +83,9 @@ class StubMasterClient:
         return self.versions.get(version_type, 0)
 
     def update_cluster_version(self, version_type, version, task_type,
-                               task_id):
+                               task_id, expected=-1):
+        if expected >= 0 and self.versions.get(version_type, 0) != expected:
+            return
         self.versions[version_type] = version
 
     def query_ps_nodes(self):

@@ -89,6 +89,22 @@ def test_cluster_version_over_rpc(master, client):
     assert client.get_cluster_version("local", "worker", 1) == 2
 
 
+def test_cluster_version_cas_over_rpc(master, client):
+    # compare-and-set: only applies while current == expected, so two
+    # workers racing the 0->1 startup bump cannot clobber each other
+    cur = client.get_cluster_version("global", "worker", 0)
+    stale = client.update_cluster_version(
+        "global", 99, "worker", 0, expected=cur + 7
+    )
+    assert not stale.success
+    assert client.get_cluster_version("global", "worker", 0) == cur
+    ok = client.update_cluster_version(
+        "global", cur + 1, "worker", 0, expected=cur
+    )
+    assert ok.success
+    assert client.get_cluster_version("global", "worker", 0) == cur + 1
+
+
 def test_job_exit_over_rpc(master, client):
     assert not master.servicer.job_exit_requested
     client.report_job_exit(success=True, reason="all done")
