@@ -82,6 +82,12 @@ def accelerate(
       example_batch: host-local example with GLOBAL batch dimension.
       strategy: mesh/rules/remat/dtype/accum decisions (default: all-fsdp).
     """
+    from dlrover_tpu.utils.compile_cache import enable_compile_cache
+
+    # make every train-step compile land in the persistent cache so a
+    # restarted (preempted/rescaled) job warm-starts its compiles
+    enable_compile_cache()
+
     strategy = strategy or Strategy()
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 

@@ -49,6 +49,12 @@ def init_worker(platform: Optional[str] = None,
     """
     import jax
 
+    from dlrover_tpu.utils.compile_cache import enable_compile_cache
+
+    # persistent XLA cache: a restarted worker recompiling the same
+    # program hits disk instead of the compiler (<90 s restore budget)
+    enable_compile_cache()
+
     if platform:
         jax.config.update("jax_platforms", platform)
         if platform == "cpu" and cpu_collectives:
