@@ -72,7 +72,6 @@ def _pick_config(platform: str, preset: str):
             use_flash=False,
         )
         return cfg, 4, 128
-    # ~1.3B-param llama sized for a single 16GB chip with bf16 params
     seq = int(os.environ.get("BENCH_SEQ", "0"))
     if preset == "long":
         # long-context single-chip: flash attention + full remat +
@@ -81,21 +80,34 @@ def _pick_config(platform: str, preset: str):
         batch = int(os.environ.get("BENCH_BATCH", "1"))
         remat = os.environ.get("BENCH_REMAT", "full")
         os.environ.setdefault("BENCH_HEAD_CHUNK", "1024")
-    else:
+    elif preset == "1b":
+        # ~940M-param proxy (round-1 headline model)
         seq = seq or 2048
         batch = int(os.environ.get("BENCH_BATCH", "4"))
         remat = os.environ.get("BENCH_REMAT", "dots_saveable")
+    else:
+        # default: ~2.7B — the largest llama that fits one 16 GB v5e
+        # with bf16 params + adafactor; needs full remat + chunked
+        # lm-head at this size (dots_saveable overflows the compiler)
+        seq = seq or 2048
+        batch = int(os.environ.get("BENCH_BATCH", "2"))
+        remat = os.environ.get("BENCH_REMAT", "full")
+        os.environ.setdefault("BENCH_HEAD_CHUNK", "1024")
+    if preset in ("1b", "long"):
+        # the 16k-token long-context preset keeps the ~940M shape: at
+        # seq 16384 the activations, not the params, bound the chip
+        shape = dict(hidden_size=2048, intermediate_size=5504,
+                     num_layers=16, num_heads=16, num_kv_heads=16)
+    else:
+        shape = dict(hidden_size=2560, intermediate_size=6912,
+                     num_layers=32, num_heads=20, num_kv_heads=20)
     cfg = llama.llama2_7b(
-        hidden_size=2048,
-        intermediate_size=5504,
-        num_layers=16,
-        num_heads=16,
-        num_kv_heads=16,
         max_seq_len=seq,
         param_dtype=jnp.bfloat16,
         compute_dtype=jnp.bfloat16,
         remat_policy=remat,
         use_flash=os.environ.get("BENCH_FLASH", "1") == "1",
+        **shape,
     )
     return cfg, batch, seq
 

@@ -63,19 +63,73 @@ class EngineTaskResult:
 class AccelerationEngineServicer:
     """Serves candidates round-robin to whichever rank asks next;
     finishes everyone once all candidates are scored (or the budget is
-    spent)."""
+    spent).
+
+    Fault tolerance (reference ``executor.py:36`` task lifecycle): an
+    outstanding DRYRUN whose rank goes silent past ``task_timeout_s`` is
+    reassigned to the next asking rank; after ``max_attempts`` the
+    candidate is recorded as failed instead of wedging every other rank
+    in WAIT forever — in an elastic job the search itself must survive a
+    worker loss."""
 
     def __init__(self, candidates: Sequence[Strategy],
-                 analyse_first: bool = True):
+                 analyse_first: bool = True,
+                 task_timeout_s: float = 600.0,
+                 max_attempts: int = 2):
         self._lock = threading.Lock()
         self._candidates = list(candidates)
         if not self._candidates:
             raise ValueError("engine needs at least one candidate strategy")
         self._next = 0
-        self._outstanding: Dict[int, Strategy] = {}
+        # task_id -> (strategy, rank, deadline)
+        self._outstanding: Dict[int, tuple] = {}
+        self._retry: List[int] = []
+        self._attempts: Dict[int, int] = {}
+        self._timeout = task_timeout_s
+        self._max_attempts = max_attempts
         self._analyse_done = not analyse_first
         self.collection = StrategyInfoCollection()
         self.analysis: Dict = {}
+
+    def _reap_expired(self):
+        """Under the lock: move timed-out tasks to retry or fail them."""
+        import time
+
+        now = time.monotonic()
+        for task_id in [
+            t for t, (_, _, deadline) in self._outstanding.items()
+            if now > deadline
+        ]:
+            strategy, rank, _ = self._outstanding.pop(task_id)
+            if self._attempts[task_id] < self._max_attempts:
+                logger.warning(
+                    "dryrun task %d timed out on rank %d; reassigning",
+                    task_id, rank,
+                )
+                self._retry.append(task_id)
+            else:
+                logger.warning(
+                    "dryrun task %d timed out %d times; marking failed",
+                    task_id, self._attempts[task_id],
+                )
+                self.collection.add(StrategyInfo(
+                    strategy=strategy,
+                    error=f"dryrun timeout after {self._attempts[task_id]} "
+                          "attempts",
+                ))
+
+    def _assign(self, task_id: int, rank: int) -> EngineTask:
+        import time
+
+        strategy = self._candidates[task_id]
+        self._attempts[task_id] = self._attempts.get(task_id, 0) + 1
+        self._outstanding[task_id] = (
+            strategy, rank, time.monotonic() + self._timeout
+        )
+        return EngineTask(
+            task_id=task_id, task_type=TaskType.DRYRUN,
+            strategy_json=strategy.to_json(),
+        )
 
     # -- transport entry points ---------------------------------------------
 
@@ -86,15 +140,13 @@ class AccelerationEngineServicer:
             if not self._analyse_done:
                 self._analyse_done = True
                 return EngineTask(task_id=-2, task_type=TaskType.ANALYSE)
+            self._reap_expired()
+            if self._retry:
+                return self._assign(self._retry.pop(0), request.node_rank)
             if self._next < len(self._candidates):
                 task_id = self._next
-                strategy = self._candidates[task_id]
                 self._next += 1
-                self._outstanding[task_id] = strategy
-                return EngineTask(
-                    task_id=task_id, task_type=TaskType.DRYRUN,
-                    strategy_json=strategy.to_json(),
-                )
+                return self._assign(task_id, request.node_rank)
             if self._outstanding:
                 return EngineTask(task_type=TaskType.WAIT)
             best = self.collection.best
@@ -112,9 +164,16 @@ class AccelerationEngineServicer:
             if request.task_id == -2:  # analysis result
                 self.analysis.update(request.payload)
                 return Response(success=True)
-            strategy = self._outstanding.pop(request.task_id, None)
-            if strategy is None:
+            entry = self._outstanding.get(request.task_id)
+            if entry is None:
+                # late report for a task already completed or failed
                 return Response(success=False, reason="unknown task")
+            if entry[1] != request.node_rank:
+                # late report from a rank whose task was reassigned to
+                # another rank — only the current assignee's counts
+                return Response(success=False, reason="task reassigned")
+            del self._outstanding[request.task_id]
+            strategy = entry[0]
             self.collection.add(StrategyInfo(
                 strategy=strategy,
                 step_time_s=request.step_time_s,
@@ -128,8 +187,12 @@ class AccelerationEngine:
     """rank0-hosted engine service (``AccelerationEngine.start_service``
     parity)."""
 
-    def __init__(self, candidates: Sequence[Strategy], port: int = 0):
-        self.servicer = AccelerationEngineServicer(candidates)
+    def __init__(self, candidates: Sequence[Strategy], port: int = 0,
+                 task_timeout_s: float = 600.0, max_attempts: int = 2):
+        self.servicer = AccelerationEngineServicer(
+            candidates, task_timeout_s=task_timeout_s,
+            max_attempts=max_attempts,
+        )
         self._server, self.port = build_server(self.servicer, port=port)
         self.addr = f"127.0.0.1:{self.port}"
 

@@ -61,6 +61,7 @@ class ModelSpec:
     param_bytes: int = 2  # bf16 storage
     optim_bytes_per_param: int = 8  # adam moments in f32... adafactor ~1
     dtype_bytes: int = 2
+    ffn_mult: float = 2.7  # intermediate/hidden ratio (llama ~2.69)
 
 
 @dataclass
@@ -153,15 +154,31 @@ def estimate(
     comm_s = tp_comm_s + fsdp_comm_s + dp_comm_s + seq_comm_s
     step_s = max(compute_s, comm_s) + 0.25 * min(compute_s, comm_s)
 
-    # ---- memory
+    # ---- memory (modeled on the production path: flash attention, so
+    # no S^2 tile; dots_saveable-style per-layer saves)
     param_shard = model.param_count * (
         model.param_bytes + model.optim_bytes_per_param
     ) / (fsdp * tensor * pipe)
+    # gradient + optimizer-update temporaries materialize in f32 during
+    # the step (donation reuses the state buffers, not these)
+    grad_temp = model.param_count * 4 / (fsdp * tensor * pipe)
+    # activations: the remat floor persists ~2 residual-stream saves per
+    # layer; recomputation additionally holds ONE layer's full working
+    # set (attention projections + MLP gate/up, tensor-sharded) at a
+    # time during the backward sweep
+    # residual stream (unsharded) + attention projections and MLP
+    # gate/up, both tensor-sharded
+    layer_working = act_elems * model.dtype_bytes * (
+        1.0 + (2.0 + 2.0 * model.ffn_mult) / tensor
+    )
     act_bytes = (
         model.num_layers / pipe
-    ) * act_elems * model.dtype_bytes * 2  # remat floor: 2 saves/layer
-    logits_bytes = rows * (model.seq_len / seq) * model.vocab_size * 4
-    memory = param_shard + act_bytes + logits_bytes
+    ) * act_elems * model.dtype_bytes * 2 + layer_working
+    # vocab logits in f32, forward value + backward cotangent
+    logits_bytes = (
+        rows * (model.seq_len / seq) * model.vocab_size / tensor * 4 * 2
+    )
+    memory = param_shard + grad_temp + act_bytes + logits_bytes
     fits = memory < device.hbm_bytes * 0.92
 
     return PlanScore(
@@ -176,6 +193,7 @@ def estimate(
             "dp_comm_s": dp_comm_s,
             "seq_comm_s": seq_comm_s,
             "param_shard_bytes": param_shard,
+            "grad_temp_bytes": grad_temp,
             "act_bytes": act_bytes,
         },
     )
@@ -259,4 +277,5 @@ def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
         global_batch=global_batch,
         vocab_size=config.vocab_size,
         param_bytes=np.dtype(config.param_dtype).itemsize,
+        ffn_mult=config.intermediate_size / config.hidden_size,
     )

@@ -1,0 +1,64 @@
+"""Deviceless AOT compile-and-fit proofs on virtual TPU topologies.
+
+The BASELINE "Llama-2-7B on v5p-32" viability proof runs with no TPU at
+all: XLA's TPU compiler is hermetic, so the full jitted train step is
+compiled against a ``TopologyDescription`` and memory/cost analysis read
+back (``parallel/aot.py``). Committed artifact: ``AOT_7B.json``.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.aot import (
+    KNOWN_TOPOLOGIES,
+    aot_compile_train_step,
+)
+from dlrover_tpu.parallel.mesh import MeshPlan
+
+
+def test_tiny_llama_compiles_on_virtual_v5p_slice():
+    config = llama.llama_tiny(use_flash=False)
+    report = aot_compile_train_step(
+        config, topology="v5p-16", tpu_gen="v5p", global_batch=16,
+        model_name="llama_tiny",
+    )
+    assert report.n_devices == 8  # v5p-16 = 16 cores = 8 chips
+    assert report.fits
+    assert report.hbm_per_device_bytes < 1e9
+    assert report.flops_per_step > 0
+    assert report.params == llama.param_count(config)
+
+
+def test_known_topology_aliases_cover_v5p_sizes():
+    assert KNOWN_TOPOLOGIES["v5p-32"] == "v5:2x2x4"
+
+
+@pytest.mark.slow
+def test_llama2_7b_fits_v5p_32():
+    """The BASELINE row: real 7B config, 16-chip v5p-32, explicit
+    data=2 x fsdp=4 x tensor=2 mesh, full remat. Asserts HBM fit via
+    compiled memory_analysis — no hardware involved."""
+    config = llama.llama2_7b(
+        max_seq_len=4096,
+        param_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16,
+        remat_policy="full",
+        use_flash=False,
+    )
+    report = aot_compile_train_step(
+        config, topology="v5p-32", tpu_gen="v5p", global_batch=16,
+        mesh_plan=MeshPlan(data=2, fsdp=4, seq=1, tensor=2),
+        model_name="llama2_7b",
+    )
+    assert report.n_devices == 16
+    assert report.params > 6.7e9
+    assert report.fits, (
+        f"7B must fit v5p-32: {report.hbm_per_device_bytes / 1e9:.1f} GB "
+        f"of {report.hbm_capacity_bytes / 1e9:.0f} GB"
+    )
+    # at least ~75% headroom consumed by state+activations is expected
+    # to stay under capacity with margin
+    assert report.hbm_per_device_bytes < 0.5 * report.hbm_capacity_bytes
+    assert report.predicted_mfu >= 0.45

@@ -10,6 +10,7 @@ from dlrover_tpu.parallel.engine import (
     EngineClient,
     EngineTask,
     EngineTaskRequest,
+    EngineTaskResult,
     TaskType,
 )
 from dlrover_tpu.parallel.mesh import MeshPlan
@@ -97,6 +98,63 @@ class TestEngine:
                 EngineClient(engine.addr, 0, bad).run()
         finally:
             engine.stop()
+
+    def test_dead_rank_task_reassigned(self):
+        """A rank that takes a DRYRUN and dies must not wedge the
+        search: its task times out and is reassigned (engine survives a
+        worker loss, reference executor.py:36 task lifecycle)."""
+        engine = AccelerationEngine(
+            _candidates(), task_timeout_s=0.5, max_attempts=2
+        )
+        engine.start()
+        try:
+            # "dead" rank: pulls one dryrun over real RPC, never reports
+            dead = EngineClient(engine.addr, 0, _dryrun_fn)
+            task = dead._channel.get(EngineTaskRequest(node_rank=0))
+            assert task.task_type == TaskType.ANALYSE
+            dead._channel.report(EngineTaskResult(task_id=-2, node_rank=0))
+            task = dead._channel.get(EngineTaskRequest(node_rank=0))
+            assert task.task_type == TaskType.DRYRUN
+            dead.close()  # dies mid-dryrun
+
+            # surviving rank completes the search, including the
+            # abandoned task after its timeout expires
+            survivor = EngineClient(engine.addr, 1, _dryrun_fn,
+                                    poll_interval=0.05)
+            best = survivor.run()
+            assert best.mesh.tensor == 2 and best.mesh.fsdp == 2
+            assert len(engine.servicer.collection) == 3
+            survivor.close()
+        finally:
+            engine.stop()
+
+    def test_repeatedly_timing_out_task_marked_failed(self):
+        """A candidate that never completes within max_attempts is
+        excluded instead of blocking FINISH."""
+        from dlrover_tpu.parallel.engine import AccelerationEngineServicer
+
+        servicer = AccelerationEngineServicer(
+            _candidates(), analyse_first=False,
+            task_timeout_s=0.01, max_attempts=2,
+        )
+        import time
+
+        seen = []
+        # drain: every poll abandons the handed-out task; timeouts
+        # expire between polls until all candidates exhaust attempts
+        for _ in range(20):
+            task = servicer.get(EngineTaskRequest(node_rank=0))
+            if task.task_type == TaskType.DRYRUN:
+                seen.append(task.task_id)
+                time.sleep(0.02)  # let it expire
+            elif task.task_type in (TaskType.FINISH, TaskType.FAIL):
+                break
+        # every candidate got exactly max_attempts tries then failed
+        assert all(seen.count(t) == 2 for t in set(seen))
+        assert task.task_type == TaskType.FAIL  # nothing ever succeeded
+        # and the failures are recorded, not lost
+        assert len(servicer.collection) == 3
+        assert all("timeout" in i.error for i in servicer.collection)
 
     def test_servicer_rejects_unknown_messages(self):
         engine = AccelerationEngine(_candidates())
