@@ -49,6 +49,16 @@ class Context:
         # checkpoint
         self.ckpt_async = True
         self.ckpt_host_staging = True
+        # numerics debugging: opt-in jax_debug_nans (traps the first NaN
+        # inside jit with a traceback; expensive — debug runs only)
+        self.jax_debug_nans = False
+        # guardrail: steps between non-finite loss/grad checks (0 = off);
+        # each check reads one device scalar, so keep it off the per-step
+        # hot path
+        self.check_finite_every_steps = 10
+        # what to do on a non-finite step after reporting the failure:
+        # "halt" | "rollback" (restore last checkpoint) | "ignore"
+        self.on_nonfinite = "halt"
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -82,11 +82,17 @@ def accelerate(
       example_batch: host-local example with GLOBAL batch dimension.
       strategy: mesh/rules/remat/dtype/accum decisions (default: all-fsdp).
     """
+    from dlrover_tpu.common.config import get_context
     from dlrover_tpu.utils.compile_cache import enable_compile_cache
 
     # make every train-step compile land in the persistent cache so a
     # restarted (preempted/rescaled) job warm-starts its compiles
     enable_compile_cache()
+    if get_context().jax_debug_nans:
+        # opt-in NaN trap (DLROVER_TPU_JAX_DEBUG_NANS=1): jit re-runs the
+        # offending op un-jitted and raises at the first NaN — the
+        # debug-flag counterpart of the reference's error monitor
+        jax.config.update("jax_debug_nans", True)
 
     strategy = strategy or Strategy()
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -178,9 +184,15 @@ def accelerate(
         import optax
 
         new_params = optax.apply_updates(state.params, updates)
+        grad_norm = optax.global_norm(grads)
         metrics = {
             "loss": loss,
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
+            # NaN/overflow guardrail (reference: the error monitor's
+            # silent-NaN failure class): any non-finite grad propagates
+            # into the global norm, so this is a free full-tree check
+            # the executor routes to report_failure
+            "finite": jnp.isfinite(loss) & jnp.isfinite(grad_norm),
             "step": state.step + 1,
         }
         if extra_metrics_fn is not None:

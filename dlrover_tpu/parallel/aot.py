@@ -104,11 +104,19 @@ def aot_compile_train_step(
     device_spec = planner.TPU_SPECS[tpu_gen]
 
     model = planner.model_spec_from_llama(config, global_batch)
+    effective_remat = remat_policy or getattr(config, "remat_policy", "")
+    fallback_plans: list = []
     if mesh_plan is None:
-        scores = planner.plan_mesh(model, n, device_spec)
+        scores = planner.plan_mesh(model, n, device_spec,
+                                   remat_policy=effective_remat, top_k=3)
         if not scores:
             raise ValueError(f"no mesh plan for {n} devices")
         mesh_plan = scores[0].plan
+        # planner proposes, XLA disposes: if the compiled memory analysis
+        # contradicts the analytic fit, fall back to the next-ranked plan
+        # (the dryrun-loop shape of the reference's search, executed
+        # against the hermetic compiler instead of live chips)
+        fallback_plans = [s.plan for s in scores[1:]]
         logger.info(
             "planner chose %s (predicted %.3fs/step)",
             mesh_plan, scores[0].step_time_s,
@@ -123,66 +131,89 @@ def aot_compile_train_step(
         "input_ids": jnp.asarray(ids[:, :-1]),
         "labels": jnp.asarray(ids[:, 1:]),
     }
-    result = accelerate(
-        llama.make_init_fn(config),
-        llama.make_loss_fn(config),
-        optax.adafactor(1e-3),
-        batch,
-        strategy=Strategy(
-            mesh=mesh_plan, rule_set=rule_set, remat_policy=remat_policy
-        ),
-        devices=devices,
-    )
+    def compile_plan(plan):
+        result = accelerate(
+            llama.make_init_fn(config),
+            llama.make_loss_fn(config),
+            optax.adafactor(1e-3),
+            batch,
+            strategy=Strategy(
+                mesh=plan, rule_set=rule_set, remat_policy=remat_policy
+            ),
+            devices=devices,
+        )
+        key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        abstract_state = jax.eval_shape(
+            result.init_fn, jax.random.PRNGKey(0)
+        )
+        abstract_batch = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch
+        )
+        t0 = time.time()
+        lowered = result.train_step.lower(
+            abstract_state, abstract_batch, key
+        )
+        compiled = lowered.compile()
+        return compiled, time.time() - t0
 
-    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
-    abstract_state = jax.eval_shape(
-        result.init_fn, jax.random.PRNGKey(0)
-    )
-    abstract_batch = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch
-    )
-
-    t0 = time.time()
-    lowered = result.train_step.lower(abstract_state, abstract_batch, key)
-    compiled = lowered.compile()
-    compile_time = time.time() - t0
-
-    mem = compiled.memory_analysis()
-    # per-device residency: arguments (the sharded state + batch) plus
-    # transient temps; donated bytes (alias) are not double-counted
-    per_device = (
-        mem.argument_size_in_bytes
-        + mem.temp_size_in_bytes
-        + mem.output_size_in_bytes
-        - mem.alias_size_in_bytes
-    )
+    best = None  # (per_device, compiled, compile_time, plan) — min memory
+    last_exc: Optional[Exception] = None
+    for plan in [mesh_plan] + fallback_plans:
+        try:
+            compiled_i, compile_time_i = compile_plan(plan)
+        except Exception as e:  # noqa: BLE001 — plan infeasible for XLA
+            last_exc = e
+            logger.warning(
+                "plan %s failed to compile (%s); trying next-ranked",
+                plan, f"{type(e).__name__}: {e}"[:160],
+            )
+            continue
+        mem = compiled_i.memory_analysis()
+        # per-device residency: arguments (the sharded state + batch)
+        # plus transient temps; donated (alias) bytes not double-counted
+        per_device_i = (
+            mem.argument_size_in_bytes
+            + mem.temp_size_in_bytes
+            + mem.output_size_in_bytes
+            - mem.alias_size_in_bytes
+        )
+        if best is None or per_device_i < best[0]:
+            best = (per_device_i, compiled_i, compile_time_i, plan)
+        if per_device_i <= device_spec.hbm_bytes:
+            break
+        logger.warning(
+            "plan %s compiled but needs %.1f GB > %.0f GB HBM; trying "
+            "next-ranked", plan, per_device_i / 1e9,
+            device_spec.hbm_bytes / 1e9,
+        )
+    if best is None:
+        # nothing compiled at all — surface the last compiler error
+        raise last_exc if last_exc is not None else RuntimeError(
+            "no plan compiled"
+        )
+    per_device, compiled, compile_time, mesh_plan = best
     fits = per_device <= device_spec.hbm_bytes
 
     # XLA cost_analysis does not multiply FLOPs by loop trip counts, so
-    # a scan-over-layers model reads ~1/num_layers of the truth; take
-    # the max of compiled and analytic counts, and charge remat
-    # recompute explicitly (full remat re-runs the forward: 8N vs 6N)
+    # a scan-over-layers model reads ~1/num_layers of the truth; report
+    # the max of compiled and analytic executed counts. The *prediction*
+    # comes from the calibrated planner roofline (anchored to measured
+    # BENCH points, efficiency clamped < 1, so predicted_mfu is always
+    # physical — the round-2 artifact claimed 1.31 from an uncalibrated
+    # compute term).
     costs = compiled.cost_analysis() or {}
-    analytic = planner._flops_per_step(model)
-    remat_factor = {"full": 8.0 / 6.0, "dots_saveable": 7.0 / 6.0}.get(
-        remat_policy or getattr(config, "remat_policy", ""), 1.0
-    )
+    score = planner.estimate(mesh_plan, model, device_spec,
+                             remat_policy=effective_remat)
     flops = max(float(costs.get("flops", 0.0)) * n,
-                analytic * remat_factor)
-    # predicted step time: executed FLOPs at the planner's compute
-    # ceiling, overlapped with the planner's analytic comm terms for
-    # this mesh — a comm-bound or recompute-heavy plan scores worse
-    score = planner.estimate(mesh_plan, model, device_spec)
-    compute_s = flops / (device_spec.flops_per_s * n * 0.55)
-    comm_s = sum(
-        v for k, v in score.breakdown.items() if k.endswith("_comm_s")
-    )
-    step_time = max(compute_s, comm_s) + 0.25 * min(compute_s, comm_s)
+                score.breakdown["exec_flops"])
+    step_time = score.step_time_s
     # MFU convention: MODEL flops (6N+attn), not recompute flops
-    predicted_mfu = (
-        planner._flops_per_step(model)
-        / (device_spec.flops_per_s * n * step_time)
-    )
+    predicted_mfu = score.predicted_mfu
+    if not 0.0 < predicted_mfu < 1.0:
+        raise AssertionError(
+            f"cost model produced unphysical MFU {predicted_mfu:.3f} "
+            f"(step {step_time:.4f}s, mesh {mesh_plan})"
+        )
 
     report = AotReport(
         model=model_name,

@@ -179,7 +179,15 @@ class ElasticTrainer:
             except Exception:  # noqa: BLE001 - reporting must never kill training
                 pass
         if self._ckpt is not None and self._ckpt.interval.should_save(step):
-            self.save(state)
+            # never checkpoint a NaN-poisoned state: it would corrupt the
+            # rollback/restore target (the one device sync this costs
+            # happens only on save steps)
+            if "finite" not in metrics or bool(metrics["finite"]):
+                self.save(state)
+            else:
+                logger.warning(
+                    "skipping checkpoint at step %d: non-finite state", step
+                )
         return state, metrics
 
     # -- checkpoint ----------------------------------------------------------

@@ -16,12 +16,19 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import TrainingExceptionLevel
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.trainer.conf import Configuration
 from dlrover_tpu.trainer.elastic import ElasticTrainer
 from dlrover_tpu.trainer.failover import FailoverClient, TrainingFailover
 
 logger = get_logger("trainer.executor")
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised when the guardrail sees a NaN/Inf loss or gradient and the
+    configured policy is \"halt\"."""
 
 
 class TrainHook:
@@ -118,9 +125,20 @@ class TrainExecutor:
         self._eval_fn = eval_fn
         self._hooks = list(hooks or [])
         conf = conf or Configuration()
+        ctx = get_context()
         self._train_steps = int(conf.get("train_steps", 0))
         self._eval_every = int(conf.get("eval_every_steps", 0))
         self._log_every = int(conf.get("log_every_steps", 50))
+        # NaN/overflow guardrail cadence + policy (reference: the error
+        # monitor / report_failure path the torch agent takes on a
+        # process error, training.py:426)
+        self._check_finite_every = int(conf.get(
+            "check_finite_every_steps", ctx.check_finite_every_steps
+        ))
+        self._on_nonfinite = str(conf.get("on_nonfinite", ctx.on_nonfinite))
+        self._max_rollbacks = int(conf.get("max_nonfinite_rollbacks", 3))
+        self._rollbacks = 0
+        self._master_client = master_client
         self._restart_requested = False
         self._failover: Optional[TrainingFailover] = None
         if master_client is not None:
@@ -146,6 +164,73 @@ class TrainExecutor:
         self._restart_requested = False
         logger.info("rebuilding training session (membership change)")
         self.state = self._trainer.on_world_change(self.state)
+
+    # -- NaN/overflow guardrail ----------------------------------------------
+
+    @staticmethod
+    def _step_is_finite(metrics: Dict[str, Any]) -> bool:
+        import math
+
+        if "finite" in metrics:
+            return bool(metrics["finite"])
+        try:
+            return math.isfinite(float(metrics.get("loss", 0.0)))
+        except (TypeError, ValueError):
+            return True
+
+    def _handle_nonfinite(self, step: int, metrics: Dict[str, Any]) -> bool:
+        """Report the failure and apply the policy. Returns True when the
+        loop must re-enter (rollback restored an older state)."""
+        import json as _json
+
+        detail = _json.dumps({
+            "step": step,
+            "loss": repr(metrics.get("loss")),
+            "grad_norm": repr(metrics.get("grad_norm")),
+            "reason": "non-finite loss/gradients",
+        })
+        logger.error("non-finite training step: %s", detail)
+        if self._master_client is not None:
+            try:
+                self._master_client.report_failure(
+                    node_rank=getattr(self._master_client, "node_rank", 0),
+                    restart_count=0,
+                    error_data=detail,
+                    level=TrainingExceptionLevel.PROCESS_ERROR,
+                )
+            except Exception:  # noqa: BLE001 — never mask the real error
+                logger.exception("failed to report non-finite step")
+        if self._on_nonfinite == "rollback":
+            ckpt = getattr(self._trainer, "_ckpt", None)
+            if ckpt is not None:
+                # commit any in-flight async save before restoring
+                try:
+                    ckpt.wait()
+                except Exception:  # noqa: BLE001
+                    logger.exception("flushing async checkpoint failed")
+            if ckpt is None or ckpt.latest_step() is None:
+                # no checkpoint manager OR nothing saved yet: "rollback"
+                # would silently restart from a fresh random init —
+                # escalate instead of losing all progress
+                raise NonFiniteLossError(
+                    "on_nonfinite=rollback but no checkpoint exists to "
+                    f"restore; halting. {detail}"
+                )
+            self._rollbacks += 1
+            if self._rollbacks > self._max_rollbacks:
+                raise NonFiniteLossError(
+                    f"non-finite step persisted through {self._max_rollbacks}"
+                    f" rollbacks; halting. {detail}"
+                )
+            logger.warning(
+                "rolling back to the last checkpoint after non-finite step "
+                "(%d/%d)", self._rollbacks, self._max_rollbacks,
+            )
+            self.state = self._trainer.prepare(None)
+            return True
+        if self._on_nonfinite == "ignore":
+            return False
+        raise NonFiniteLossError(detail)
 
     # -- loop ----------------------------------------------------------------
 
@@ -173,6 +258,15 @@ class TrainExecutor:
                     for hook in self._hooks:
                         hook.after_step(step, metrics)
 
+                    if (
+                        self._check_finite_every
+                        and step % self._check_finite_every == 0
+                        and not self._step_is_finite(metrics)
+                    ):
+                        if self._handle_nonfinite(step, metrics):
+                            step = int(self.state.step)
+                            restarted = True
+                            break  # rollback: fresh iterator + old state
                     if self._log_every and step % self._log_every == 0:
                         dt = time.time() - last_log
                         last_log = time.time()

@@ -61,4 +61,21 @@ def test_llama2_7b_fits_v5p_32():
     # at least ~75% headroom consumed by state+activations is expected
     # to stay under capacity with margin
     assert report.hbm_per_device_bytes < 0.5 * report.hbm_capacity_bytes
-    assert report.predicted_mfu >= 0.45
+    # both bounds: the target AND physical sanity (round-2 artifact
+    # claimed 1.31 — an uncalibrated cost model must never pass again)
+    assert 0.45 <= report.predicted_mfu < 1.0
+    # cross-check the hand-rolled XLA memory sum against the planner's
+    # analytic model: a double-counted donation or dropped term in either
+    # shows up as a gross disagreement
+    from dlrover_tpu.parallel import planner
+
+    spec = planner.model_spec_from_llama(config, 16)
+    score = planner.estimate(
+        MeshPlan(data=2, fsdp=4, seq=1, tensor=2), spec,
+        planner.TPU_SPECS["v5p"], remat_policy="full",
+    )
+    ratio = report.hbm_per_device_bytes / score.memory_bytes
+    assert 0.3 < ratio < 3.0, (
+        f"XLA-measured {report.hbm_per_device_bytes/1e9:.1f} GB vs "
+        f"planner-modeled {score.memory_bytes/1e9:.1f} GB"
+    )
