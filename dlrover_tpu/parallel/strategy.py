@@ -55,6 +55,11 @@ class Strategy:
     remat_policy: str = ""  # "", "full", "dots_saveable", "nothing_saveable"
     dtypes: DtypePolicy = field(default_factory=DtypePolicy)
     grad_accum_steps: int = 1
+    # pipeline schedule: virtual stages per physical stage (V>1 = the
+    # circular/interleaved schedule, PiPPy StageInterleaver parity —
+    # bubble shrinks (P-1)/(M+P-1) -> (P-1)/(V*M+P-1)). Consumed by
+    # model forwards via ``apply_pipelined(..., num_virtual=...)``.
+    num_virtual: int = 1
     # global batch row count; accelerate() validates the example batch
     # against it and adjust_to_world keeps accum a divisor of it.
     # 0 = derived from the example batch at accelerate() time.

@@ -138,6 +138,7 @@ class TrainExecutor:
         self._on_nonfinite = str(conf.get("on_nonfinite", ctx.on_nonfinite))
         self._max_rollbacks = int(conf.get("max_nonfinite_rollbacks", 3))
         self._rollbacks = 0
+        self._last_metrics: Optional[Dict[str, Any]] = None
         self._master_client = master_client
         self._restart_requested = False
         self._failover: Optional[TrainingFailover] = None
@@ -193,7 +194,7 @@ class TrainExecutor:
         if self._master_client is not None:
             try:
                 self._master_client.report_failure(
-                    node_rank=getattr(self._master_client, "node_rank", 0),
+                    node_rank=getattr(self._master_client, "node_id", 0),
                     restart_count=0,
                     error_data=detail,
                     level=TrainingExceptionLevel.PROCESS_ERROR,
@@ -254,6 +255,7 @@ class TrainExecutor:
                     self.state, metrics = self._trainer.step(
                         self.state, batch
                     )
+                    self._last_metrics = metrics
                     step += 1
                     for hook in self._hooks:
                         hook.after_step(step, metrics)
@@ -304,7 +306,17 @@ class TrainExecutor:
     def _finish(self, step: int) -> Dict[str, Any]:
         if self._eval_fn is not None:
             self._evaluate(step)
-        self._trainer.save(self.state, force=True)
+        if self._last_metrics is None or self._step_is_finite(
+            self._last_metrics
+        ):
+            self._trainer.save(self.state, force=True)
+        else:
+            # the final state is NaN-poisoned (e.g. on_nonfinite=ignore,
+            # or the NaN landed between check cadences): a force-save
+            # here would make it the newest restore target
+            logger.warning(
+                "skipping final checkpoint: last step was non-finite"
+            )
         self._trainer.finalize()
         for hook in self._hooks:
             hook.end(self)

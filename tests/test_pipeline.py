@@ -232,3 +232,52 @@ class TestLlamaPipelined:
             )
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0]
+
+    def test_strategy_drives_interleaved_schedule(self):
+        """Round-2 verdict #3: num_virtual is a Strategy field, survives
+        JSON round-trip, and drives the circular schedule end-to-end on
+        the sharded mesh."""
+        from dlrover_tpu.parallel.accelerate import accelerate
+        from dlrover_tpu.parallel.strategy import Strategy
+
+        strategy = Strategy(
+            mesh=MeshPlan(pipe=2, data=2, tensor=2),
+            rule_set="llama_pp",
+            num_virtual=2,
+        )
+        # persistence: the knob must survive save/load like the rest
+        assert Strategy.from_json(strategy.to_json()).num_virtual == 2
+
+        config = llama.llama_tiny(num_layers=4)
+
+        def loss_fn(params, batch, rng):
+            from dlrover_tpu.models.losses import masked_lm_loss
+
+            logits, _ = llama.apply_pipelined(
+                params, batch["input_ids"], config,
+                num_stages=2, num_microbatches=2, rng=rng,
+                num_virtual=strategy.num_virtual,
+            )
+            return masked_lm_loss(logits, batch["labels"]), {}
+
+        batch = {
+            "input_ids": jax.random.randint(
+                jax.random.PRNGKey(0), (8, 16), 0, config.vocab_size
+            ),
+            "labels": jax.random.randint(
+                jax.random.PRNGKey(1), (8, 16), 0, config.vocab_size
+            ),
+        }
+        result = accelerate(
+            llama.make_init_fn(config), loss_fn,
+            optax.adam(1e-2), batch, strategy=strategy,
+        )
+        state = result.init_fn(jax.random.PRNGKey(0))
+        sharded = result.shard_batch(batch)
+        losses = []
+        for i in range(3):
+            state, metrics = result.train_step(
+                state, sharded, jax.random.PRNGKey(i)
+            )
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
