@@ -43,6 +43,10 @@ class Context:
         # elasticity
         self.auto_scale_enabled = True
         self.dynamic_sharding_enabled = True
+        # cooldown between executed scale plans: a scale-up implies a new
+        # rendezvous + recompile, and the stats window needs to refill
+        # with post-scale samples before the optimizer can judge again
+        self.seconds_between_scale_plans = 60
         # optimizer
         self.oom_memory_factor = 2.0
         self.optimize_worker_cpu_threshold = 0.8

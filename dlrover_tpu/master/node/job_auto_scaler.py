@@ -39,6 +39,7 @@ class JobAutoScaler:
         self._interval = interval_secs or ctx.seconds_interval_to_optimize
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_plan_time = 0.0
         self.started = False
 
     def start_auto_scaling(self):
@@ -65,8 +66,17 @@ class JobAutoScaler:
 
     def optimize_once(self):
         """One optimize-and-execute step (also the unit-test entry)."""
-        if not get_context().auto_scale_enabled:
+        import time
+
+        ctx = get_context()
+        if not ctx.auto_scale_enabled:
             return
+        if (
+            self._last_plan_time
+            and time.monotonic() - self._last_plan_time
+            < ctx.seconds_between_scale_plans
+        ):
+            return  # cooling down after the previous scale event
         if not self._speed_monitor.worker_adjustment_finished():
             logger.info("waiting for worker count to stabilize")
             return
@@ -76,6 +86,9 @@ class JobAutoScaler:
         self.execute_job_optimization_plan(plan)
 
     def execute_job_optimization_plan(self, plan: ScalePlan):
+        import time
+
         logger.info("executing optimization plan: %s", plan.to_dict())
         self._speed_monitor.reset_running_speed_monitor()
+        self._last_plan_time = time.monotonic()
         self._job_manager.execute_scale_plan(plan)

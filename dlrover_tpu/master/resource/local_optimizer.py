@@ -190,6 +190,16 @@ class SpmdLocalOptimizer(ResourceOptimizer):
             return plan
         # Per-worker efficiency trend: speed / workers over the window.
         half = len(samples) // 2
+        # The judged tail must be entirely at the CURRENT membership —
+        # judging stale pre-scale samples right after a scale event would
+        # propose another scale-up before the new world produced a single
+        # post-scale sample (observed in the e2e loop: 1 -> 2 -> 3).
+        tail_counts = {
+            len(s.running_nodes.get(NodeType.WORKER, []))
+            for s in samples[half:]
+        }
+        if tail_counts != {cur_workers}:
+            return plan
         older = [s for s in samples[:half] if s.speed > 0]
         newer = [s for s in samples[half:] if s.speed > 0]
         if not older or not newer:

@@ -192,6 +192,18 @@ class ElasticTrainer:
 
     # -- checkpoint ----------------------------------------------------------
 
+    def latest_checkpoint_step(self) -> Optional[int]:
+        """Newest restorable step, flushing any in-flight async save
+        first; None when no checkpointing is configured or nothing has
+        been committed yet (the executor's rollback precondition)."""
+        if self._ckpt is None:
+            return None
+        try:
+            self._ckpt.wait()
+        except Exception:  # noqa: BLE001
+            logger.exception("flushing async checkpoint failed")
+        return self._ckpt.latest_step()
+
     def save(self, state: Any, force: bool = True):
         if self._ckpt is None:
             return

@@ -179,9 +179,9 @@ class TrainExecutor:
         except (TypeError, ValueError):
             return True
 
-    def _handle_nonfinite(self, step: int, metrics: Dict[str, Any]) -> bool:
-        """Report the failure and apply the policy. Returns True when the
-        loop must re-enter (rollback restored an older state)."""
+    def _report_nonfinite(self, step: int, metrics: Dict[str, Any]) -> str:
+        """Log + report the non-finite step to the master; returns the
+        serialized detail for the exception message."""
         import json as _json
 
         detail = _json.dumps({
@@ -201,15 +201,17 @@ class TrainExecutor:
                 )
             except Exception:  # noqa: BLE001 — never mask the real error
                 logger.exception("failed to report non-finite step")
+        return detail
+
+    def _handle_nonfinite(self, step: int, metrics: Dict[str, Any]) -> bool:
+        """Report the failure and apply the policy. Returns True when the
+        loop must re-enter (rollback restored an older state)."""
+        detail = self._report_nonfinite(step, metrics)
         if self._on_nonfinite == "rollback":
-            ckpt = getattr(self._trainer, "_ckpt", None)
-            if ckpt is not None:
-                # commit any in-flight async save before restoring
-                try:
-                    ckpt.wait()
-                except Exception:  # noqa: BLE001
-                    logger.exception("flushing async checkpoint failed")
-            if ckpt is None or ckpt.latest_step() is None:
+            latest = getattr(
+                self._trainer, "latest_checkpoint_step", lambda: None
+            )()
+            if latest is None:
                 # no checkpoint manager OR nothing saved yet: "rollback"
                 # would silently restart from a fresh random init —
                 # escalate instead of losing all progress
@@ -311,12 +313,17 @@ class TrainExecutor:
         ):
             self._trainer.save(self.state, force=True)
         else:
-            # the final state is NaN-poisoned (e.g. on_nonfinite=ignore,
-            # or the NaN landed between check cadences): a force-save
-            # here would make it the newest restore target
+            # the final state is NaN-poisoned (the NaN landed between
+            # check cadences, or the policy is "ignore"/"rollback"): a
+            # force-save here would make it the newest restore target.
+            # Report it, and under "halt" fail the run — a NaN final step
+            # must not exit 0 as a success.
+            detail = self._report_nonfinite(step, self._last_metrics)
             logger.warning(
                 "skipping final checkpoint: last step was non-finite"
             )
+            if self._on_nonfinite == "halt":
+                raise NonFiniteLossError(f"final step non-finite: {detail}")
         self._trainer.finalize()
         for hook in self._hooks:
             hook.end(self)

@@ -1,0 +1,125 @@
+"""The closed auto-scaling loop, end to end (round-2 verdict #5).
+
+Reference path (SURVEY §3.4, ``dlrover/python/master/node/
+job_auto_scaler.py:154``): worker global-step reports -> SpeedMonitor ->
+runtime stats -> resource optimizer plan -> ScalePlan -> scaler launches
+a node -> the new agent joins the rendezvous -> the existing agent
+restarts its workers into the bigger world.
+
+Everything here is real: a live DistributedJobMaster with its gRPC
+servicer, a LocalProcessScaler spawning REAL tpurun agent subprocesses,
+real worker subprocesses reporting steps over the wire, and a real
+second rendezvous at world size 2.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import NodeType
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def fast_ctx():
+    """Shrink the control-loop cadences; restore after the test."""
+    ctx = get_context()
+    saved = {
+        k: getattr(ctx, k)
+        for k in (
+            "seconds_interval_to_report",
+            "seconds_for_stable_worker_count",
+            "seconds_interval_to_optimize",
+            "seconds_between_scale_plans",
+            "auto_scale_enabled",
+        )
+    }
+    ctx.seconds_interval_to_report = 0.3
+    ctx.seconds_for_stable_worker_count = 1.0
+    ctx.seconds_interval_to_optimize = 0.5
+    ctx.seconds_between_scale_plans = 30
+    ctx.auto_scale_enabled = True
+    yield ctx
+    for k, v in saved.items():
+        setattr(ctx, k, v)
+
+
+@pytest.mark.slow
+def test_speed_to_plan_to_scaler_to_new_rendezvous(fast_ctx, tmp_path):
+    from dlrover_tpu.master.dist_master import DistributedJobMaster
+    from dlrover_tpu.master.scaler.process_scaler import LocalProcessScaler
+    from dlrover_tpu.master.watcher.process_watcher import (
+        LocalProcessWatcher,
+    )
+    from dlrover_tpu.scheduler.job import local_job_args
+    from dlrover_tpu.scheduler.local import LocalProcessBackend
+
+    marker = tmp_path / "scaled_world"
+    worker_script = os.path.join(TESTDATA, "autoscale_worker.py")
+
+    def agent_command(node):
+        # a REAL tpurun agent per node: master addr + node rank arrive
+        # via the scaler's NodeEnv contract
+        return [
+            sys.executable, "-m", "dlrover_tpu.trainer.run",
+            "--nnodes", "1:4",
+            "--rdzv_waiting_timeout", "2.0",
+            "--monitor_interval", "0.3",
+            "--max_restarts", "3",
+            worker_script,
+        ]
+
+    backend = LocalProcessBackend()
+    args = local_job_args("autoscale-e2e", node_num=1)
+    scaler = LocalProcessScaler(
+        "autoscale-e2e", backend, "",
+        command_factory=agent_command,
+        extra_env={
+            "PYTHONPATH": REPO_ROOT + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+            "AUTOSCALE_MARKER": str(marker),
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    master = DistributedJobMaster(
+        job_args=args,
+        scaler=scaler,
+        watcher=LocalProcessWatcher(backend, poll_secs=0.1),
+    )
+    master.prepare()
+    rc_box = {}
+
+    def run_master():
+        rc_box["rc"] = master.run()
+
+    thread = threading.Thread(target=run_master, daemon=True)
+    thread.start()
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and not marker.exists():
+            time.sleep(0.5)
+        assert marker.exists(), (
+            "auto-scaling loop never produced a 2-node world "
+            f"(auto_scaler started={master.job_auto_scaler.started}, "
+            f"samples={master.speed_monitor.sample_count})"
+        )
+        assert marker.read_text().strip() == "2"
+        # the loop actually flowed through the scaler: two worker nodes
+        # exist in the job manager (original + scale-up)
+        workers = master.job_manager.get_job_nodes(NodeType.WORKER)
+        assert len(workers) >= 2
+        # and the rendezvous re-formed at world size 2
+        rdzv = master.rdzv_managers["elastic-training"]
+        assert len(rdzv.world_dict()) == 2
+        # job runs to completion after the scaled workers exit 0
+        thread.join(timeout=60)
+        assert rc_box.get("rc") == 0
+    finally:
+        master.stop()
+        backend.stop_all()

@@ -303,6 +303,30 @@ class TestTrainExecutor:
         )["loss"])
         assert final_loss == final_loss  # not NaN
 
+    def test_nonfinite_final_step_off_cadence_still_fails(self):
+        """A NaN landing between check cadences on the LAST step must not
+        exit 0 as a success (review finding: _finish swallowed it)."""
+        import pytest
+
+        from dlrover_tpu.trainer.executor import NonFiniteLossError
+
+        master = StubMasterClient()
+        trainer, batch = _make_trainer()
+        nan_batch = {"x": batch["x"] * jnp.nan, "y": batch["y"]}
+        executor = TrainExecutor(
+            trainer,
+            train_iter_fn=lambda: [batch, batch, batch, nan_batch],
+            conf=Configuration({
+                "train_steps": 4, "log_every_steps": 0,
+                "check_finite_every_steps": 10,  # never fires mid-loop
+                "on_nonfinite": "halt",
+            }),
+            master_client=master,
+        )
+        with pytest.raises(NonFiniteLossError, match="final step"):
+            executor.train_and_evaluate()
+        assert master.failures
+
     def test_nonfinite_rollback_without_ckpt_escalates_to_halt(self):
         import pytest
 

@@ -1,0 +1,45 @@
+"""Auto-scale e2e worker.
+
+World of 1: reports a steadily advancing global step so the master's
+SpeedMonitor sees healthy speed (the auto-scaler's input signal).
+After the scale-up the agent restarts it into a >= 2-process world; it
+then writes the marker file and exits 0, letting the whole job finish.
+"""
+
+import os
+import sys
+import time
+
+from dlrover_tpu.trainer.bootstrap import init_worker
+
+
+def main() -> int:
+    ctx = init_worker(platform="cpu")
+    marker = os.environ.get("AUTOSCALE_MARKER", "")
+
+    if ctx.num_processes >= 2:
+        if ctx.is_chief and marker:
+            with open(marker, "w") as f:
+                f.write(str(ctx.num_processes))
+        print(
+            f"worker {ctx.process_id}: scaled world of "
+            f"{ctx.num_processes} reached", flush=True,
+        )
+        return 0
+
+    client = ctx.master_client
+    step = 0
+    deadline = time.time() + float(
+        os.environ.get("AUTOSCALE_WORKER_TIMEOUT", "120")
+    )
+    while time.time() < deadline:
+        step += 1
+        if client is not None and ctx.is_chief:
+            client.report_global_step(step)
+        time.sleep(0.1)
+    print("worker: never restarted into a bigger world", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
