@@ -23,6 +23,9 @@ Env knobs:
   BENCH_FLASH=0|1        Pallas flash kernel on/off (default 1)
   BENCH_HEAD_CHUNK=N     fused chunked lm-head loss chunk size (0=off)
   BENCH_RECOVERY_DIR=D   scratch dir for --mode recovery artifacts
+  BENCH_RECOVERY_PRESET  model preset for the MTTR bench (default
+                         "recovery" = GPT-2-124M-scale)
+  BENCH_SKIP_RECOVERY=1  default mode: skip the MTTR phase/MTTR.json
 """
 
 from __future__ import annotations
@@ -73,6 +76,26 @@ def _pick_config(platform: str, preset: str):
         )
         return cfg, 4, 128
     seq = int(os.environ.get("BENCH_SEQ", "0"))
+    if preset == "recovery":
+        # GPT-2-124M-scale llama (a BASELINE.json listed config): the
+        # MTTR bench measures the recovery MACHINERY (boot, cached
+        # compile, staged restore), so the state must be small enough
+        # that host<->device transfer isn't the metric — this harness's
+        # tunneled chip moves ~25-45 MB/s, an environment artifact a
+        # real v5p host (~10 GB/s PCIe/DMA) doesn't have.
+        seq = seq or 1024
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        remat = os.environ.get("BENCH_REMAT", "dots_saveable")
+        cfg = llama.llama2_7b(
+            max_seq_len=seq,
+            param_dtype=jnp.bfloat16,
+            compute_dtype=jnp.bfloat16,
+            remat_policy=remat,
+            use_flash=os.environ.get("BENCH_FLASH", "1") == "1",
+            hidden_size=768, intermediate_size=2048, num_layers=12,
+            num_heads=12, num_kv_heads=12,
+        )
+        return cfg, batch, seq
     if preset == "long":
         # long-context single-chip: flash attention + full remat +
         # chunked lm head keep memory linear in sequence length
@@ -174,9 +197,35 @@ def _build_train(devices, preset: str):
     return result, batch, config, batch_size, seq_len
 
 
+def _maybe_emit_mttr():
+    """Default driver invocation: also measure MTTR and write MTTR.json
+    (the machine-verifiable recovery artifact). Runs BEFORE this process
+    touches the accelerator — the recovery worker subprocesses need the
+    chip to themselves. Opt out with BENCH_SKIP_RECOVERY=1."""
+    if os.environ.get("BENCH_SKIP_RECOVERY", "") == "1":
+        return
+    if os.environ.get("BENCH_PLATFORM", "") == "cpu":
+        return  # smoke runs: the MTTR claim is a TPU number
+    try:
+        result = recovery_result()
+    except Exception as e:  # noqa: BLE001 — MTTR must not sink the MFU run
+        result = {
+            "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:200],
+        }
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "MTTR.json"
+    )
+    with open(path, "w") as f:
+        f.write(json.dumps(result) + "\n")
+
+
 def main() -> int:
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     preset = os.environ.get("BENCH_PRESET", "")
+
+    _maybe_emit_mttr()
 
     devices, err = _get_devices("llama_pretrain_mfu")
     if devices is None:
@@ -254,9 +303,31 @@ def _recovery_worker(ckpt_dir: str, status_file: str, total_steps: int,
     appends one JSON status line per completed step. Restarting it
     resumes from the latest committed checkpoint (the elastic restore
     path: Orbax reshard-on-load + persistent XLA compile cache)."""
+    import threading
+
     from dlrover_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()  # honors DLROVER_COMPILE_CACHE_DIR
+
+    # Overlap the (slow, possibly tunneled) backend init with pulling the
+    # latest checkpoint into the page cache, so the restore that follows
+    # build is a DRAM read (SURVEY §7: the <90 s budget forces overlapping
+    # device init with restore staging).
+    stop_prefetch = threading.Event()
+
+    def _prefetch_checkpoint():
+        for root, _dirs, files in os.walk(ckpt_dir):
+            for name in files:
+                try:
+                    with open(os.path.join(root, name), "rb") as fh:
+                        while fh.read(1 << 22):
+                            if stop_prefetch.is_set():
+                                return
+                except OSError:
+                    pass
+
+    prefetch = threading.Thread(target=_prefetch_checkpoint, daemon=True)
+    prefetch.start()
 
     preset = os.environ.get("BENCH_PRESET", "")
     devices, err = _get_devices("recovery_mttr_s")
@@ -290,6 +361,7 @@ def _recovery_worker(ckpt_dir: str, status_file: str, total_steps: int,
         state = result.init_fn(jax.random.PRNGKey(0))
         start = 0
     jax.block_until_ready(state)
+    stop_prefetch.set()
     phases["t_restore_s"] = round(
         time.time() - t_boot - phases["t_build_s"], 2
     )
@@ -352,14 +424,15 @@ def _wait_status(status_file: str, pred, timeout: float, proc=None):
     return None
 
 
-def recovery_main() -> int:
+def recovery_result() -> dict:
     """Kill-and-restore MTTR benchmark (BASELINE: <90 s restore).
 
-    Phase 1 trains + checkpoints (cold compile, cache fills). The
-    SIGKILL is the injected host preemption. Phase 2's wall time from
-    kill to the first *completed* post-restore step is the MTTR — it
-    includes process boot, JAX init, cached compile, Orbax restore, and
-    one full training step.
+    Phase 1 trains + checkpoints (cold compile, cache fills, host-DRAM
+    staging mirrors the latest step). The SIGKILL is the injected host
+    preemption. Phase 2's wall time from kill to the first *completed*
+    post-restore step is the MTTR — it includes process boot, JAX init,
+    cached compile, staged Orbax restore, and one full training step.
+    Returns the result-line dict (with an "error" key on failure).
     """
     import shutil
     import subprocess
@@ -382,6 +455,15 @@ def recovery_main() -> int:
 
     env = dict(os.environ)
     env["DLROVER_COMPILE_CACHE_DIR"] = cache_dir
+    # recovery workers use the recovery-sized model unless overridden;
+    # drop the caller's MFU shape knobs so e.g. BENCH_SEQ=16384 from a
+    # long-context MFU run can't reshape the recovery model
+    env["BENCH_PRESET"] = os.environ.get("BENCH_RECOVERY_PRESET",
+                                         "recovery")
+    if "BENCH_RECOVERY_PRESET" not in os.environ:
+        for knob in ("BENCH_SEQ", "BENCH_BATCH", "BENCH_REMAT",
+                     "BENCH_FLASH", "BENCH_HEAD_CHUNK"):
+            env.pop(knob, None)
     cmd = [
         sys.executable, os.path.abspath(__file__), "--recovery-worker",
         "--ckpt-dir", ckpt_dir, "--status-file", status_file,
@@ -410,12 +492,11 @@ def recovery_main() -> int:
                        proc=p1)
     if rec is None:
         p1.kill()
-        print(json.dumps({
+        return {
             "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
             "vs_baseline": 0.0,
             "error": "phase-1 worker never reached a committed checkpoint",
-        }))
-        return 1
+        }
     cold_boot_s = first_line.get("boot_to_step_s", rec["boot_to_step_s"])
 
     p1.kill()  # SIGKILL: the injected preemption
@@ -436,11 +517,10 @@ def recovery_main() -> int:
         shutil.rmtree(scratch, ignore_errors=True)
 
     if rec2 is None:
-        print(json.dumps({
+        return {
             "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
             "vs_baseline": 0.0, "error": "restarted worker never stepped",
-        }))
-        return 1
+        }
 
     result_line = {
         "metric": "recovery_mttr_s",
@@ -458,11 +538,16 @@ def recovery_main() -> int:
                 ("t_devices_s", "t_build_s", "t_restore_s") if k in rec2
             },
             "loss_after_restore": rec2["loss"],
-            "preset": os.environ.get("BENCH_PRESET", "") or "default",
+            "preset": os.environ.get("BENCH_RECOVERY_PRESET", "recovery"),
         },
     }
+    return result_line
+
+
+def recovery_main() -> int:
+    result_line = recovery_result()
     print(json.dumps(result_line))
-    return 0
+    return 1 if result_line.get("error") else 0
 
 
 def _parse_args(argv):

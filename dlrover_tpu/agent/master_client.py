@@ -159,6 +159,13 @@ class MasterClient:
 
     # -- monitoring / failures ---------------------------------------------
 
+    def failed_nodes(self, since_timestamp: float = 0.0) -> list:
+        """Node ids with hard failures since ``since_timestamp``."""
+        resp = self._channel.get(
+            comm.FailedNodesRequest(since_timestamp=since_timestamp)
+        )
+        return list(getattr(resp, "ranks", None) or [])
+
     def report_failure(self, node_rank: int, restart_count: int,
                        error_data: str, level: str) -> comm.Response:
         return self._channel.report(comm.NodeFailure(

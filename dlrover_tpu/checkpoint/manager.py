@@ -20,8 +20,11 @@ a restored job resumes mid-epoch without re-reading consumed data.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import shutil
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
@@ -90,14 +93,16 @@ class ElasticCheckpointManager:
         max_to_keep: int = 3,
         async_save: Optional[bool] = None,
         save_interval: Optional[CheckpointInterval] = None,
+        staging_dir: Optional[str] = None,
     ):
         import orbax.checkpoint as ocp
 
         from dlrover_tpu.common.config import get_context
 
         self._ocp = ocp
+        ctx = get_context()
         if async_save is None:
-            async_save = get_context().ckpt_async
+            async_save = ctx.ckpt_async
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
@@ -106,6 +111,27 @@ class ElasticCheckpointManager:
         )
         self._manager = ocp.CheckpointManager(self.directory, options=options)
         self.interval = save_interval or CheckpointInterval()
+        # Host-DRAM staging (reference: Flash Checkpoint / the <90 s
+        # restore budget, stabilize_llm_training_cn.md:209-216): after a
+        # save commits, the step dir is mirrored into tmpfs so a restart
+        # on the same host restores from DRAM instead of (remote) storage.
+        self._staging_root: Optional[str] = None
+        if staging_dir is None and ctx.ckpt_host_staging:
+            shm = "/dev/shm"
+            if (
+                os.path.isdir(shm)
+                and os.access(shm, os.W_OK)
+                and not self.directory.startswith(shm)
+            ):
+                staging_dir = os.path.join(
+                    shm, "dlrover_tpu_ckpt",
+                    hashlib.md5(self.directory.encode()).hexdigest()[:12],
+                )
+        if staging_dir:
+            self._staging_root = os.path.abspath(staging_dir)
+            os.makedirs(self._staging_root, exist_ok=True)
+        self._mirror_lock = threading.Lock()
+        self._mirror_threads: list = []
 
     # -- save ----------------------------------------------------------------
 
@@ -137,11 +163,152 @@ class ElasticCheckpointManager:
         if saved:
             self.interval.mark_saved(step)
             logger.info("checkpoint %d queued to %s", step, self.directory)
+            if self._staging_root is not None:
+                # mirror once the async write commits, off the hot path
+                thread = threading.Thread(
+                    target=self._wait_and_mirror, args=(step,), daemon=True
+                )
+                self._mirror_threads = [
+                    t for t in self._mirror_threads if t.is_alive()
+                ] + [thread]
+                thread.start()
         return bool(saved)
 
     def wait(self):
-        """Block until queued async saves hit disk."""
+        """Block until queued async saves hit disk (and their staging
+        mirrors complete)."""
         self._manager.wait_until_finished()
+        for thread in self._mirror_threads:
+            if thread.is_alive():
+                thread.join(timeout=120)
+        self._mirror_threads = []
+
+    # -- host-DRAM staging ----------------------------------------------------
+
+    def _step_dir(self, root: str, step: int) -> str:
+        return os.path.join(root, str(step))
+
+    def _wait_and_mirror(self, step: int, deadline_s: float = 600.0):
+        """Mirror once the step commits. Orbax's CheckpointManager is not
+        thread-safe, so this thread never touches it: on posix the atomic
+        rename of the tmp dir to ``<root>/<step>`` IS the commit marker —
+        poll for that instead of wait_until_finished()."""
+        import time as _time
+
+        step_dir = self._step_dir(self.directory, step)
+        deadline = _time.monotonic() + deadline_s
+        try:
+            while not os.path.isdir(step_dir):
+                if _time.monotonic() > deadline:
+                    logger.warning(
+                        "step %d never committed; skipping staging", step
+                    )
+                    return
+                _time.sleep(0.5)
+            self._mirror_to_staging(step)
+        except Exception:  # noqa: BLE001 — staging is best-effort
+            logger.exception("staging mirror for step %d failed", step)
+
+    def _mirror_to_staging(self, step: int):
+        src = self._step_dir(self.directory, step)
+        if not os.path.isdir(src):
+            return
+        with self._mirror_lock:  # serialize: mirrors must not interleave
+            newest = self.staged_step()
+            if newest is not None and (
+                newest > step
+                or (newest == step and self._staged_digest_valid(step))
+            ):
+                return  # an equal-or-newer valid step is already staged
+            # size gate: a checkpoint bigger than (half the) free tmpfs
+            # would just burn read bandwidth and fail with ENOSPC
+            try:
+                ckpt_bytes = sum(
+                    os.path.getsize(os.path.join(r, f))
+                    for r, _d, files in os.walk(src) for f in files
+                )
+                free = shutil.disk_usage(self._staging_root).free
+            except OSError:
+                ckpt_bytes, free = 0, 0
+            if ckpt_bytes and ckpt_bytes * 2 > free:
+                logger.warning(
+                    "skipping host-DRAM staging: checkpoint %.1f GB vs "
+                    "%.1f GB free tmpfs", ckpt_bytes / 1e9, free / 1e9,
+                )
+                return
+            tmp = os.path.join(self._staging_root, f".tmp_{step}")
+            dst = self._step_dir(self._staging_root, step)
+            shutil.rmtree(tmp, ignore_errors=True)
+            try:
+                digest = self._dir_digest(src)
+                shutil.copytree(src, tmp)
+                shutil.rmtree(dst, ignore_errors=True)
+                os.rename(tmp, dst)
+                with open(dst + ".digest", "w") as f:
+                    f.write(digest)
+                # keep only the newest staged step: DRAM is precious
+                for name in os.listdir(self._staging_root):
+                    base = name.split(".")[0]
+                    if base.isdigit() and int(base) < step:
+                        path = os.path.join(self._staging_root, name)
+                        if os.path.isdir(path):
+                            shutil.rmtree(path, ignore_errors=True)
+                        else:
+                            try:
+                                os.remove(path)
+                            except OSError:
+                                pass
+                logger.info("checkpoint %d staged to %s", step,
+                            self._staging_root)
+            except OSError as e:  # tmpfs full, races — never fail the job
+                logger.warning("host-DRAM staging failed: %s", e)
+                shutil.rmtree(tmp, ignore_errors=True)
+                shutil.rmtree(dst, ignore_errors=True)
+
+    @staticmethod
+    def _dir_digest(path: str) -> str:
+        """Cheap content-identity fingerprint of a step dir: every file's
+        relpath, size, and mtime. Guards staged restores against a stale
+        mirror left by a PREVIOUS job at the same checkpoint path."""
+        entries = []
+        for root, _dirs, files in os.walk(path):
+            for name in sorted(files):
+                full = os.path.join(root, name)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                entries.append(
+                    f"{os.path.relpath(full, path)}:{st.st_size}:"
+                    f"{st.st_mtime_ns}"
+                )
+        return hashlib.sha256("\n".join(sorted(entries)).encode()).hexdigest()
+
+    def _staged_digest_valid(self, step: int) -> bool:
+        """The staged copy is trustworthy iff its recorded digest matches
+        the primary step dir as it is NOW — or the primary step dir is
+        gone entirely (the storage-outage fast-restart case)."""
+        dst = self._step_dir(self._staging_root, step)
+        try:
+            with open(dst + ".digest") as f:
+                recorded = f.read().strip()
+        except OSError:
+            return False
+        src = self._step_dir(self.directory, step)
+        if not os.path.isdir(src):
+            return True  # primary lost; the mirror is the survivor
+        return self._dir_digest(src) == recorded
+
+    def staged_step(self) -> Optional[int]:
+        """Newest step available in the host-DRAM staging mirror."""
+        if self._staging_root is None or not os.path.isdir(
+            self._staging_root
+        ):
+            return None
+        steps = [
+            int(n) for n in os.listdir(self._staging_root) if n.isdigit()
+        ]
+        return max(steps) if steps else None
 
     # -- restore -------------------------------------------------------------
 
@@ -155,35 +322,76 @@ class ElasticCheckpointManager:
     ) -> Optional[Dict[str, Any]]:
         """Restore into the shardings carried by ``abstract_state``.
 
-        Returns {"state": ..., "meta": {...}, "shard_checkpoint": str}, or
-        None if the directory holds no checkpoint.
+        Prefers the host-DRAM staged copy when it holds the requested
+        step (no storage round-trip). Returns {"state": ..., "meta":
+        {...}, "shard_checkpoint": str}, or None if no checkpoint exists.
         """
-        ocp = self._ocp
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
-        items = self._manager.item_metadata(step)
-        args = {"state": ocp.args.StandardRestore(abstract_state),
-                "meta": ocp.args.JsonRestore()}
-        try:
-            has_shards = items is not None and "data_shards" in items.keys()
-        except (AttributeError, TypeError):
-            has_shards = False
-        if has_shards:
-            args["data_shards"] = ocp.args.JsonRestore()
-        restored = self._manager.restore(step, args=ocp.args.Composite(**args))
-        out = {
-            "state": restored["state"],
-            "meta": restored["meta"] or {},
-            "shard_checkpoint": "",
-            "step": step,
-        }
-        if has_shards and restored.get("data_shards"):
-            out["shard_checkpoint"] = restored["data_shards"].get(
-                "checkpoint", ""
-            )
-        logger.info("restored checkpoint step=%d from %s", step, self.directory)
+        if (
+            self._staging_root is not None
+            and self.staged_step() == step
+            and self._staged_digest_valid(step)
+        ):
+            try:
+                out = self._restore_from(self._staging_root, step,
+                                         abstract_state)
+                logger.info(
+                    "restored checkpoint step=%d from host-DRAM staging",
+                    step,
+                )
+                return out
+            except Exception:  # noqa: BLE001 — fall back to the real dir
+                logger.exception(
+                    "staged restore failed; falling back to %s",
+                    self.directory,
+                )
+        out = self._restore_from(self.directory, step, abstract_state)
+        logger.info("restored checkpoint step=%d from %s", step,
+                    self.directory)
         return out
+
+    def _restore_from(
+        self, root: str, step: int, abstract_state: Any
+    ) -> Dict[str, Any]:
+        ocp = self._ocp
+        if os.path.abspath(root) == self.directory:
+            manager = self._manager
+        else:
+            manager = ocp.CheckpointManager(
+                root,
+                options=ocp.CheckpointManagerOptions(
+                    enable_async_checkpointing=False, read_only=True,
+                ),
+            )
+        try:
+            items = manager.item_metadata(step)
+            args = {"state": ocp.args.StandardRestore(abstract_state),
+                    "meta": ocp.args.JsonRestore()}
+            try:
+                has_shards = (
+                    items is not None and "data_shards" in items.keys()
+                )
+            except (AttributeError, TypeError):
+                has_shards = False
+            if has_shards:
+                args["data_shards"] = ocp.args.JsonRestore()
+            restored = manager.restore(step, args=ocp.args.Composite(**args))
+            out = {
+                "state": restored["state"],
+                "meta": restored["meta"] or {},
+                "shard_checkpoint": "",
+                "step": step,
+            }
+            if has_shards and restored.get("data_shards"):
+                out["shard_checkpoint"] = restored["data_shards"].get(
+                    "checkpoint", ""
+                )
+            return out
+        finally:
+            if manager is not self._manager:
+                manager.close()
 
     def close(self):
         self._manager.close()

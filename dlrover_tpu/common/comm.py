@@ -242,6 +242,14 @@ class BarrierRequest:
 
 
 @message
+class FailedNodesRequest:
+    """Query node ids with hard failures since a timestamp (the engine's
+    dead-rank watcher polls this instead of waiting out task timeouts)."""
+
+    since_timestamp: float = 0.0
+
+
+@message
 class NodeFailure:
     node_id: int = -1
     node_rank: int = -1

@@ -93,6 +93,23 @@ class ErrorLogMonitor:
                 counts[r.node_id] = counts.get(r.node_id, 0) + 1
             return counts
 
+    def failed_node_ids(
+        self,
+        since_timestamp: float = 0.0,
+        levels: tuple = (
+            TrainingExceptionLevel.PROCESS_ERROR,
+            TrainingExceptionLevel.NODE_ERROR,
+        ),
+    ) -> List[int]:
+        """Node ids with hard failures since ``since_timestamp`` — the
+        query surface consumers (e.g. the acceleration engine's dead-rank
+        watcher) poll instead of waiting out task timeouts."""
+        with self._lock:
+            return sorted({
+                r.node_id for r in self.records
+                if r.timestamp >= since_timestamp and r.level in levels
+            })
+
 
 def classify_error(error_data: str) -> str:
     for pattern, reason in _ERROR_SIGNATURES:

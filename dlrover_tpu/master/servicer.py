@@ -46,6 +46,14 @@ class MasterServicer:
         self._job_manager = job_manager
         self._metric_collector = metric_collector
         self._parallel_configs: Dict[int, comm.ParallelConfig] = {}
+        # one failure record store: the job manager's when present (its
+        # handle_training_failure records there), else our own so the
+        # local master can still answer failed-node queries
+        from dlrover_tpu.diagnosis.error_monitor import ErrorLogMonitor
+
+        self.error_monitor = getattr(
+            job_manager, "error_monitor", None
+        ) or ErrorLogMonitor()
         self.job_exit_requested = False
         self.job_success: Optional[bool] = None
 
@@ -57,6 +65,7 @@ class MasterServicer:
             comm.NetworkReadyRequest: self._network_ready,
             comm.StragglerExistRequest: self._straggler_exist,
             comm.AbnormalNodesRequest: self._abnormal_nodes,
+            comm.FailedNodesRequest: self._failed_nodes,
             comm.KVStoreGetRequest: self._kv_get,
             comm.KVStoreAddRequest: self._kv_add,
             comm.BarrierRequest: self._barrier_query,
@@ -292,10 +301,22 @@ class MasterServicer:
             req.error_data[:512],
         )
         if self._job_manager is not None:
+            # records into the shared error monitor via the job manager
             self._job_manager.handle_training_failure(
                 req.node_id, req.restart_count, req.error_data, req.level
             )
+        else:
+            # local master: record at the ingress so failed-node queries
+            # still work without a job manager
+            self.error_monitor.process_error(
+                req.node_id, req.restart_count, req.error_data, req.level
+            )
         return comm.Response(success=True)
+
+    def _failed_nodes(self, req: comm.FailedNodesRequest):
+        return comm.NodeRankList(
+            ranks=self.error_monitor.failed_node_ids(req.since_timestamp)
+        )
 
     def _report_resource(self, req: comm.ResourceStats):
         if self._job_manager is not None:

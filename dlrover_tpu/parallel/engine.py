@@ -91,6 +91,32 @@ class AccelerationEngineServicer:
         self.collection = StrategyInfoCollection()
         self.analysis: Dict = {}
 
+    def mark_rank_failed(self, rank: int):
+        """Immediately reassign every task outstanding on a dead rank.
+
+        The timeout is only the backstop: the master's failure reports
+        know a rank died within seconds (reference: the executor keys off
+        live task state, ``atorch/auto/engine/executor.py:36``), so wire
+        ``report_failure`` -> this and the search never stalls a full
+        ``task_timeout_s`` on a known-dead worker."""
+        with self._lock:
+            for task_id in [
+                t for t, (_, r, _) in self._outstanding.items() if r == rank
+            ]:
+                strategy, _, _ = self._outstanding.pop(task_id)
+                if self._attempts[task_id] < self._max_attempts:
+                    logger.warning(
+                        "rank %d reported dead; reassigning task %d",
+                        rank, task_id,
+                    )
+                    self._retry.append(task_id)
+                else:
+                    self.collection.add(StrategyInfo(
+                        strategy=strategy,
+                        error=f"rank {rank} died after "
+                              f"{self._attempts[task_id]} attempts",
+                    ))
+
     def _reap_expired(self):
         """Under the lock: move timed-out tasks to retry or fail them."""
         import time
@@ -195,18 +221,59 @@ class AccelerationEngine:
         )
         self._server, self.port = build_server(self.servicer, port=port)
         self.addr = f"127.0.0.1:{self.port}"
+        self._watch_stop: Optional[threading.Event] = None
 
     def start(self):
         self._server.start()
         logger.info("acceleration engine at :%d", self.port)
 
     def stop(self, grace: float = 1.0):
+        if self._watch_stop is not None:
+            self._watch_stop.set()
         self._server.stop(grace)
 
     @property
     def best_strategy(self) -> Optional[Strategy]:
         best = self.servicer.collection.best
         return best.strategy if best else None
+
+    def mark_rank_failed(self, rank: int):
+        """Failure-report hook: reassign the dead rank's tasks now
+        instead of waiting out the timeout backstop."""
+        self.servicer.mark_rank_failed(rank)
+
+    def watch_failures(self, master_client, poll_secs: float = 2.0):
+        """Poll the master's failure reports and reassign dead ranks'
+        tasks within seconds — ``task_timeout_s`` stays only as the
+        backstop (reference: the executor keys off live task state,
+        ``atorch/auto/engine/executor.py:36``)."""
+        import time
+
+        if self._watch_stop is not None:
+            return
+        self._watch_stop = threading.Event()
+        since = time.time()
+
+        def loop():
+            nonlocal since
+            while not self._watch_stop.is_set():
+                # advancing window (with 1 s overlap), not a seen-set: a
+                # rank that restarts and dies AGAIN must be re-marked;
+                # duplicate marks are harmless (only outstanding tasks of
+                # that rank get reassigned)
+                poll_start = time.time()
+                try:
+                    for rank in master_client.failed_nodes(
+                        since_timestamp=since
+                    ):
+                        self.mark_rank_failed(rank)
+                    since = poll_start - 1.0
+                except Exception:  # noqa: BLE001 — keep watching
+                    logger.exception("failure watch poll failed")
+                self._watch_stop.wait(poll_secs)
+
+        threading.Thread(target=loop, name="engine-failure-watch",
+                         daemon=True).start()
 
 
 class EngineClient:

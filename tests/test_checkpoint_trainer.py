@@ -85,6 +85,84 @@ class TestElasticCheckpoint:
         )
         mgr.close()
 
+    def test_host_dram_staging_mirror_and_restore(self, tmp_path):
+        """Flash-checkpoint parity: after the async save commits, the
+        step is mirrored to the staging dir, and restore prefers it even
+        when the primary directory is gone (the remote-storage-outage /
+        fast-restart case)."""
+        import os
+        import shutil
+
+        res = _build(Strategy(mesh=MeshPlan(data=-1)))
+        state = res.init_fn(jax.random.PRNGKey(0))
+        primary = tmp_path / "primary"
+        staging = tmp_path / "shm_staging"
+        mgr = ElasticCheckpointManager(
+            str(primary), staging_dir=str(staging)
+        )
+        assert mgr.save(3, state, metadata={"k": 7}, force=True)
+        mgr.wait()
+        assert mgr.staged_step() == 3
+        # only the newest step is kept staged
+        state2, _ = res.train_step(
+            state, res.shard_batch(_batch()), jax.random.PRNGKey(1)
+        )
+        assert mgr.save(5, state2, force=True)
+        mgr.wait()
+        assert mgr.staged_step() == 5
+        assert not os.path.isdir(str(staging / "3"))
+
+        # nuke the primary step dir: restore must come from staging
+        shutil.rmtree(str(primary / "5"))
+        target = abstract_like(state, res.state_sharding)
+        out = mgr.restore(target, step=5)
+        assert out is not None and out["step"] == 5
+        np.testing.assert_allclose(
+            np.asarray(out["state"].params["w1"]),
+            np.asarray(state2.params["w1"]),
+        )
+        mgr.close()
+
+    def test_stale_staging_from_previous_job_is_ignored(self, tmp_path):
+        """A mirror left in tmpfs by a PREVIOUS job at the same
+        checkpoint path must never be restored as the new job's weights:
+        the staged digest is validated against the primary step dir."""
+        import shutil
+
+        res = _build(Strategy(mesh=MeshPlan(data=-1)))
+        primary = tmp_path / "primary"
+        staging = tmp_path / "shm_staging"
+
+        old_state = res.init_fn(jax.random.PRNGKey(0))
+        m1 = ElasticCheckpointManager(str(primary),
+                                      staging_dir=str(staging))
+        assert m1.save(5, old_state, force=True)
+        m1.wait()
+        assert m1.staged_step() == 5
+        m1.close()
+
+        # operator wipes the checkpoint dir and starts a fresh run at
+        # the same path; the stale tmpfs mirror survives the restart
+        shutil.rmtree(str(primary))
+        new_state = res.init_fn(jax.random.PRNGKey(42))
+        m2 = ElasticCheckpointManager(str(primary),
+                                      staging_dir=str(staging))
+        assert m2.save(5, new_state, force=True)
+        m2.wait()
+
+        out = m2.restore(
+            abstract_like(new_state, res.state_sharding), step=5
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["state"].params["w1"]),
+            np.asarray(new_state.params["w1"]),
+        )
+        assert not np.allclose(
+            np.asarray(out["state"].params["w1"]),
+            np.asarray(old_state.params["w1"]),
+        )
+        m2.close()
+
     def test_reshard_on_load_across_world_sizes(self, tmp_path):
         """Save on an 8-device fsdp mesh, restore onto a 4-device mesh."""
         res8 = _build(Strategy(mesh=MeshPlan(data=2, fsdp=4)))

@@ -262,6 +262,42 @@ class TestRingAttention:
         for shape in perm_shapes:
             assert shape[1] == 2, f"rotated {shape}, expected kv heads=2"
 
+    def test_indivisible_kv_heads_warns_and_stays_correct(self):
+        """Round-2 verdict #9: the kv-repeat fallback must not be a
+        silent bandwidth cliff — it logs the repeat factor (the planner
+        prices the same factor via ring_kv_repeat) and stays exact."""
+        import logging
+
+        from dlrover_tpu.common.log import get_logger
+
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = _Capture(level=logging.WARNING)
+        target = get_logger("ops.ring_attention")
+        target.addHandler(handler)
+        try:
+            mesh = MeshPlan(seq=2, tensor=4).build()
+            # 8 query heads, 2 kv heads: 2 % 4 != 0 -> repeat x2
+            q, _, _ = _qkv(b=1, h=8, s=64, d=32)
+            _, k, v = _qkv(b=1, h=2, s=64, d=32, seed=5)
+            out = ring_attention(q, k, v, mesh, causal=True,
+                                 head_axis="tensor", batch_axes=None)
+        finally:
+            target.removeHandler(handler)
+        assert any("repeating kv" in m for m in records), records
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            jax.device_get(out), jax.device_get(ref), atol=2e-5, rtol=2e-5
+        )
+        # the runtime's minimal repeat equals what the planner prices
+        from dlrover_tpu.parallel.planner import ring_kv_repeat
+
+        assert ring_kv_repeat(2, 8, 4) == 2
+
     def test_pallas_kernel_inside_ring(self):
         # the TPU path: each ring step invokes the flash kernel
         # (interpret mode here); parity against the dense reference
