@@ -14,13 +14,15 @@ from kill to the first post-restore completed step.
 
 Env knobs:
   BENCH_PLATFORM=cpu     run the benchmark logic on CPU (smoke test)
-  BENCH_STEPS=N          timed steps (default 10)
+  BENCH_STEPS=N          timed steps (default 20)
+  BENCH_RECOVERY_STEPS=N recovery-worker training steps (default 60)
   BENCH_PRESET=tiny|1b|long  model size; "long" = 16k-token context on
                          one chip (full remat + chunked lm head)
   BENCH_SEQ=N            sequence length override
   BENCH_BATCH=N          batch rows for the TPU preset (default 4)
   BENCH_REMAT=policy     per-layer remat policy (default dots_saveable)
   BENCH_FLASH=0|1        Pallas flash kernel on/off (default 1)
+  BENCH_BLOCK_Q/K=N      flash kernel tile sizes (default 512/1024)
   BENCH_HEAD_CHUNK=N     fused chunked lm-head loss chunk size (0=off)
   BENCH_RECOVERY_DIR=D   scratch dir for --mode recovery artifacts
   BENCH_RECOVERY_PRESET  model preset for the MTTR bench (default
@@ -111,11 +113,16 @@ def _pick_config(platform: str, preset: str):
     else:
         # default: ~2.7B — the largest llama that fits one 16 GB v5e
         # with bf16 params + adafactor; needs full remat + chunked
-        # lm-head at this size (dots_saveable overflows the compiler)
-        seq = seq or 2048
-        batch = int(os.environ.get("BENCH_BATCH", "2"))
+        # lm-head at this size (dots_saveable overflows the compiler,
+        # remat=none needs 42 GB). Shape knobs are the round-3 sweep
+        # winner (docs/bench_tuning.md): batch 16 x seq 1024, head
+        # chunk 1024, flash block_q 1024 -> 0.563 MFU (b8 x s2048 with
+        # the same tiling measures 0.548).
+        seq = seq or 1024
+        batch = int(os.environ.get("BENCH_BATCH", "16"))
         remat = os.environ.get("BENCH_REMAT", "full")
         os.environ.setdefault("BENCH_HEAD_CHUNK", "1024")
+        os.environ.setdefault("BENCH_BLOCK_Q", "1024")
     if preset in ("1b", "long"):
         # the 16k-token long-context preset keeps the ~940M shape: at
         # seq 16384 the activations, not the params, bound the chip
@@ -130,6 +137,8 @@ def _pick_config(platform: str, preset: str):
         compute_dtype=jnp.bfloat16,
         remat_policy=remat,
         use_flash=os.environ.get("BENCH_FLASH", "1") == "1",
+        flash_block_q=int(os.environ.get("BENCH_BLOCK_Q", "512")),
+        flash_block_k=int(os.environ.get("BENCH_BLOCK_K", "1024")),
         **shape,
     )
     return cfg, batch, seq
@@ -204,8 +213,46 @@ def _maybe_emit_mttr():
     chip to themselves. Opt out with BENCH_SKIP_RECOVERY=1."""
     if os.environ.get("BENCH_SKIP_RECOVERY", "") == "1":
         return
+    # detect the backend in a subprocess (this process must stay off the
+    # accelerator so the recovery workers can own it): a CPU-only host
+    # must not write a CPU-measured number against the TPU target
+    import subprocess
+
     if os.environ.get("BENCH_PLATFORM", "") == "cpu":
         return  # smoke runs: the MTTR claim is a TPU number
+    platform = ""
+    probe_err = ""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "BENCH_PLATFORM": ""},
+        )
+        if probe.returncode == 0:
+            platform = (probe.stdout.strip().splitlines() or [""])[-1]
+        else:
+            probe_err = (probe.stderr or "")[-200:]
+    except Exception as e:  # noqa: BLE001
+        probe_err = f"{type(e).__name__}: {e}"[:200]
+    if platform == "cpu":
+        return  # CPU-only host: never write a CPU number vs the TPU target
+    if not platform:
+        # a real-TPU host where the probe failed must not silently keep
+        # a stale artifact: say so, loudly and in the artifact
+        print(f"MTTR skipped: backend probe failed ({probe_err})",
+              file=sys.stderr)
+        result = {
+            "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
+            "vs_baseline": 0.0,
+            "error": f"backend probe failed: {probe_err}",
+        }
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "MTTR.json"
+        )
+        with open(path, "w") as f:
+            f.write(json.dumps(result) + "\n")
+        return
     try:
         result = recovery_result()
     except Exception as e:  # noqa: BLE001 — MTTR must not sink the MFU run
@@ -222,7 +269,7 @@ def _maybe_emit_mttr():
 
 
 def main() -> int:
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
     preset = os.environ.get("BENCH_PRESET", "")
 
     _maybe_emit_mttr()
@@ -438,7 +485,9 @@ def recovery_result() -> dict:
     import subprocess
     import tempfile
 
-    total_steps = int(os.environ.get("BENCH_STEPS", "60"))
+    # deliberately NOT BENCH_STEPS: the MFU step count must not reshape
+    # the recovery phase (phase 1 needs >= save_every + 3 steps to commit)
+    total_steps = int(os.environ.get("BENCH_RECOVERY_STEPS", "60"))
     save_every = int(os.environ.get("BENCH_SAVE_EVERY", "5"))
     base = os.environ.get("BENCH_RECOVERY_DIR", "")
     scratch = base or tempfile.mkdtemp(prefix="dlrover_mttr_")
@@ -462,7 +511,8 @@ def recovery_result() -> dict:
                                          "recovery")
     if "BENCH_RECOVERY_PRESET" not in os.environ:
         for knob in ("BENCH_SEQ", "BENCH_BATCH", "BENCH_REMAT",
-                     "BENCH_FLASH", "BENCH_HEAD_CHUNK"):
+                     "BENCH_FLASH", "BENCH_HEAD_CHUNK", "BENCH_BLOCK_Q",
+                     "BENCH_BLOCK_K", "BENCH_STEPS"):
             env.pop(knob, None)
     cmd = [
         sys.executable, os.path.abspath(__file__), "--recovery-worker",

@@ -161,10 +161,19 @@ class MasterClient:
 
     def failed_nodes(self, since_timestamp: float = 0.0) -> list:
         """Node ids with hard failures since ``since_timestamp``."""
+        return self.failed_nodes_since(since_timestamp)[0]
+
+    def failed_nodes_since(self, since_timestamp: float = 0.0) -> tuple:
+        """(failed node ids, master-clock response time). Pollers pass
+        the returned server time back as the next window start — both
+        ends of the comparison stay on the master's clock."""
         resp = self._channel.get(
             comm.FailedNodesRequest(since_timestamp=since_timestamp)
         )
-        return list(getattr(resp, "ranks", None) or [])
+        return (
+            list(getattr(resp, "ranks", None) or []),
+            float(getattr(resp, "server_time", 0.0)),
+        )
 
     def report_failure(self, node_rank: int, restart_count: int,
                        error_data: str, level: str) -> comm.Response:

@@ -215,6 +215,13 @@ class ElasticCheckpointManager:
             return
         with self._mirror_lock:  # serialize: mirrors must not interleave
             newest = self.staged_step()
+            if newest is not None and not self._staging_provenance_valid():
+                # leftovers from a previous job at this checkpoint path:
+                # clear them so staging works from this job's first save
+                logger.info("clearing stale staging mirror (provenance "
+                            "mismatch)")
+                self._clear_staging()
+                newest = None
             if newest is not None and (
                 newest > step
                 or (newest == step and self._staged_digest_valid(step))
@@ -246,6 +253,7 @@ class ElasticCheckpointManager:
                 os.rename(tmp, dst)
                 with open(dst + ".digest", "w") as f:
                     f.write(digest)
+                self._write_provenance()
                 # keep only the newest staged step: DRAM is precious
                 for name in os.listdir(self._staging_root):
                     base = name.split(".")[0]
@@ -264,6 +272,64 @@ class ElasticCheckpointManager:
                 logger.warning("host-DRAM staging failed: %s", e)
                 shutil.rmtree(tmp, ignore_errors=True)
                 shutil.rmtree(dst, ignore_errors=True)
+
+    def _primary_identity(self) -> str:
+        """Identity token of the primary checkpoint root: a uuid file
+        created once per root. Survives a same-host restart (the outage
+        case); a fresh job that wiped and recreated the root gets a new
+        uuid, so its staging can never inherit the old job's weights."""
+        marker = os.path.join(self.directory, ".dlrover_ckpt_id")
+        try:
+            with open(marker) as f:
+                return f.read().strip()
+        except OSError:
+            pass
+        import uuid
+
+        ident = uuid.uuid4().hex
+        try:
+            tmp = f"{marker}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(ident)
+            os.rename(tmp, marker)
+            with open(marker) as f:  # racing writers: reread the winner
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def _write_provenance(self):
+        ident = self._primary_identity()
+        if not ident:
+            return
+        try:
+            with open(os.path.join(self._staging_root, "PROVENANCE"),
+                      "w") as f:
+                f.write(ident)
+        except OSError:
+            pass
+
+    def _staging_provenance_valid(self) -> bool:
+        try:
+            with open(os.path.join(self._staging_root, "PROVENANCE")) as f:
+                recorded = f.read().strip()
+        except OSError:
+            return False
+        ident = self._primary_identity()
+        return bool(ident) and ident == recorded
+
+    def _clear_staging(self):
+        try:
+            for name in os.listdir(self._staging_root):
+                path = os.path.join(self._staging_root, name)
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        except OSError:
+            pass
 
     @staticmethod
     def _dir_digest(path: str) -> str:
@@ -296,7 +362,15 @@ class ElasticCheckpointManager:
             return False
         src = self._step_dir(self.directory, step)
         if not os.path.isdir(src):
-            return True  # primary lost; the mirror is the survivor
+            if not os.path.isdir(self.directory):
+                # the primary ROOT vanished after construction (the
+                # constructor makedirs it, so a fresh job always has
+                # one): storage outage — the mirror is the survivor
+                return True
+            # root present but step missing: trust the mirror only for
+            # the SAME primary root (a fresh job recreating the path
+            # must not inherit the previous job's weights)
+            return self._staging_provenance_valid()
         return self._dir_digest(src) == recorded
 
     def staged_step(self) -> Optional[int]:
@@ -326,7 +400,16 @@ class ElasticCheckpointManager:
         step (no storage round-trip). Returns {"state": ..., "meta":
         {...}, "shard_checkpoint": str}, or None if no checkpoint exists.
         """
-        step = step if step is not None else self.latest_step()
+        if step is None:
+            try:
+                step = self.latest_step()
+            except Exception:  # noqa: BLE001 — primary storage gone
+                step = None
+        if step is None and self._staging_root is not None:
+            # primary storage lost entirely: the host-DRAM mirror is the
+            # restore source of last resort (digest/provenance checked
+            # below like any other staged restore)
+            step = self.staged_step()
         if step is None:
             return None
         if (

@@ -182,6 +182,9 @@ class AbnormalNodesRequest:
 @message
 class NodeRankList:
     ranks: Optional[List[int]] = None
+    # master-clock timestamp of the response: pollers reuse it as the
+    # next window start so cross-host clock skew can't drop records
+    server_time: float = 0.0
 
 
 @message

@@ -314,8 +314,14 @@ class MasterServicer:
         return comm.Response(success=True)
 
     def _failed_nodes(self, req: comm.FailedNodesRequest):
+        import time as _time
+
+        if req.since_timestamp < 0:
+            # baseline probe: hand out the master clock only, no history
+            return comm.NodeRankList(ranks=[], server_time=_time.time())
         return comm.NodeRankList(
-            ranks=self.error_monitor.failed_node_ids(req.since_timestamp)
+            ranks=self.error_monitor.failed_node_ids(req.since_timestamp),
+            server_time=_time.time(),
         )
 
     def _report_resource(self, req: comm.ResourceStats):

@@ -62,6 +62,10 @@ class LlamaConfig:
     compute_dtype: Any = jnp.bfloat16
     remat_policy: str = "dots_saveable"
     use_flash: bool = True  # pallas kernel on TPU; reference otherwise
+    # pallas flash kernel tiling (VMEM working-set vs grid overhead
+    # trade; sweepable via bench BENCH_BLOCK_Q/BENCH_BLOCK_K)
+    flash_block_q: int = 512
+    flash_block_k: int = 1024
     # sequence parallelism: set seq_axis="seq" and pass the Mesh to run
     # ring attention (shard_map) inside the jitted GSPMD program; with
     # mesh=None the model must itself be running under shard_map.
@@ -187,12 +191,17 @@ def _attention_block(x, layer, config: LlamaConfig, positions):
         out = ring_attention(
             q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
             batch_axes=("data", "fsdp"), head_axis="tensor",
+            block_q=c.flash_block_q, block_k=c.flash_block_k,
         )
     elif c.seq_axis:
         out = ring_attention_local(q, k, v, axis_name=c.seq_axis,
-                                   causal=True)
+                                   causal=True,
+                                   block_q=c.flash_block_q,
+                                   block_k=c.flash_block_k)
     elif c.use_flash:
-        out = flash_attention(q, k, v, True)
+        out = flash_attention(q, k, v, True,
+                              block_q=c.flash_block_q,
+                              block_k=c.flash_block_k)
     else:
         out = mha_reference(q, k, v, causal=True)
     out = checkpoint_name(out, "attn_out")

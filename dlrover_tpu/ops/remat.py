@@ -26,6 +26,19 @@ def apply_remat(fn: Callable, policy: str = "dots_saveable",
         return fn
     if policy == "full":
         return jax.checkpoint(fn, prevent_cse=prevent_cse)
+    if policy == "attn_saveable":
+        # save ONLY the named attention outputs: tiny residency
+        # (B*S*D/layer) but the backward skips re-running the flash
+        # kernel's forward — the selective middle ground between "full"
+        # (8/6 recompute) and "dots_saveable" (which at multi-B scale
+        # can overflow the compiler's memory budget)
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out"
+            ),
+            prevent_cse=prevent_cse,
+        )
     if policy == "dots_and_attn_saveable":
         # dots_saveable only recognises dot_general outputs, so a Pallas
         # attention kernel would be re-run in the backward pass; saving

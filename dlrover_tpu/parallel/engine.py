@@ -103,19 +103,28 @@ class AccelerationEngineServicer:
             for task_id in [
                 t for t, (_, r, _) in self._outstanding.items() if r == rank
             ]:
-                strategy, _, _ = self._outstanding.pop(task_id)
-                if self._attempts[task_id] < self._max_attempts:
-                    logger.warning(
-                        "rank %d reported dead; reassigning task %d",
-                        rank, task_id,
-                    )
-                    self._retry.append(task_id)
-                else:
-                    self.collection.add(StrategyInfo(
-                        strategy=strategy,
-                        error=f"rank {rank} died after "
-                              f"{self._attempts[task_id]} attempts",
-                    ))
+                self._release_task(task_id, f"rank {rank} died")
+
+    def _release_task(self, task_id: int, reason: str):
+        """Under the lock: pop an outstanding task and either queue it
+        for reassignment or record the candidate as failed (shared by
+        the timeout backstop and the dead-rank fast path)."""
+        strategy, rank, _ = self._outstanding.pop(task_id)
+        if self._attempts[task_id] < self._max_attempts:
+            logger.warning(
+                "task %d on rank %d released (%s); reassigning",
+                task_id, rank, reason,
+            )
+            self._retry.append(task_id)
+        else:
+            logger.warning(
+                "task %d failed after %d attempts (%s)",
+                task_id, self._attempts[task_id], reason,
+            )
+            self.collection.add(StrategyInfo(
+                strategy=strategy,
+                error=f"{reason} after {self._attempts[task_id]} attempts",
+            ))
 
     def _reap_expired(self):
         """Under the lock: move timed-out tasks to retry or fail them."""
@@ -126,23 +135,7 @@ class AccelerationEngineServicer:
             t for t, (_, _, deadline) in self._outstanding.items()
             if now > deadline
         ]:
-            strategy, rank, _ = self._outstanding.pop(task_id)
-            if self._attempts[task_id] < self._max_attempts:
-                logger.warning(
-                    "dryrun task %d timed out on rank %d; reassigning",
-                    task_id, rank,
-                )
-                self._retry.append(task_id)
-            else:
-                logger.warning(
-                    "dryrun task %d timed out %d times; marking failed",
-                    task_id, self._attempts[task_id],
-                )
-                self.collection.add(StrategyInfo(
-                    strategy=strategy,
-                    error=f"dryrun timeout after {self._attempts[task_id]} "
-                          "attempts",
-                ))
+            self._release_task(task_id, "dryrun timeout")
 
     def _assign(self, task_id: int, rank: int) -> EngineTask:
         import time
@@ -247,27 +240,37 @@ class AccelerationEngine:
         tasks within seconds — ``task_timeout_s`` stays only as the
         backstop (reference: the executor keys off live task state,
         ``atorch/auto/engine/executor.py:36``)."""
-        import time
-
         if self._watch_stop is not None:
             return
         self._watch_stop = threading.Event()
-        since = time.time()
+        since = -1.0  # < 0 = baseline probe: master clock, no history
+        primed = False
 
         def loop():
-            nonlocal since
+            nonlocal since, primed
             while not self._watch_stop.is_set():
                 # advancing window (with 1 s overlap), not a seen-set: a
                 # rank that restarts and dies AGAIN must be re-marked;
                 # duplicate marks are harmless (only outstanding tasks of
-                # that rank get reassigned)
-                poll_start = time.time()
+                # that rank get reassigned). The window start is the
+                # MASTER's response clock, so cross-host skew can't drop
+                # records; the baseline probe (since<0) returns no ranks,
+                # so pre-engine failure history is never acted on and
+                # nothing real is ever discarded.
+                import time as _time
+
+                local_now = _time.time()
                 try:
-                    for rank in master_client.failed_nodes(
+                    ranks, server_time = master_client.failed_nodes_since(
                         since_timestamp=since
-                    ):
-                        self.mark_rank_failed(rank)
-                    since = poll_start - 1.0
+                    )
+                    if primed:
+                        for rank in ranks:
+                            self.mark_rank_failed(rank)
+                    # older masters omit server_time: degrade to the
+                    # local clock rather than going inert
+                    since = (server_time or local_now) - 1.0
+                    primed = True
                 except Exception:  # noqa: BLE001 — keep watching
                     logger.exception("failure watch poll failed")
                 self._watch_stop.wait(poll_secs)

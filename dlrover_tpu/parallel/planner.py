@@ -76,6 +76,7 @@ REMAT_RECOMPUTE = {
     "none": 1.0,
     "dots_saveable": 7.0 / 6.0,
     "dots_and_attn_saveable": 7.0 / 6.0,
+    "attn_saveable": 7.5 / 6.0,  # full minus the attention-fwd re-run
     "full": 8.0 / 6.0,
     "nothing_saveable": 8.0 / 6.0,  # jax alias for save-nothing
 }

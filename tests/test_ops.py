@@ -404,7 +404,7 @@ class TestRemat:
             return jnp.sin(x @ x).sum()
 
         for policy in ["full", "dots_saveable", "nothing_saveable", "none",
-                       "dots_and_attn_saveable"]:
+                       "dots_and_attn_saveable", "attn_saveable"]:
             g = jax.grad(apply_remat(f, policy))(jnp.eye(8))
             assert g.shape == (8, 8)
 
