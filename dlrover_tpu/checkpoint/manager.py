@@ -400,16 +400,20 @@ class ElasticCheckpointManager:
         step (no storage round-trip). Returns {"state": ..., "meta":
         {...}, "shard_checkpoint": str}, or None if no checkpoint exists.
         """
+        staging_only = False
         if step is None:
             try:
                 step = self.latest_step()
             except Exception:  # noqa: BLE001 — primary storage gone
                 step = None
-        if step is None and self._staging_root is not None:
-            # primary storage lost entirely: the host-DRAM mirror is the
-            # restore source of last resort (digest/provenance checked
-            # below like any other staged restore)
-            step = self.staged_step()
+            if step is None and self._staging_root is not None:
+                # primary storage lost entirely: the host-DRAM mirror is
+                # the restore source of last resort (digest/provenance
+                # checked below like any other staged restore). The
+                # primary has no such step, so there is no fallback:
+                # failed validation means "no checkpoint", not a crash.
+                step = self.staged_step()
+                staging_only = step is not None
         if step is None:
             return None
         if (
@@ -430,6 +434,16 @@ class ElasticCheckpointManager:
                     "staged restore failed; falling back to %s",
                     self.directory,
                 )
+        if staging_only:
+            # the step exists ONLY in staging and wasn't restorable
+            # (stale provenance or a failed read): a fresh job must
+            # start from scratch, not crash on a primary that never
+            # held this step
+            logger.warning(
+                "staged step %d not restorable and absent from the "
+                "primary; treating as no checkpoint", step,
+            )
+            return None
         out = self._restore_from(self.directory, step, abstract_state)
         logger.info("restored checkpoint step=%d from %s", step,
                     self.directory)

@@ -245,6 +245,9 @@ class AccelerationEngine:
         self._watch_stop = threading.Event()
         since = -1.0  # < 0 = baseline probe: master clock, no history
         primed = False
+        import time as _time
+
+        watch_start_mono = _time.monotonic()
 
         def loop():
             nonlocal since, primed
@@ -252,13 +255,13 @@ class AccelerationEngine:
                 # advancing window (with 1 s overlap), not a seen-set: a
                 # rank that restarts and dies AGAIN must be re-marked;
                 # duplicate marks are harmless (only outstanding tasks of
-                # that rank get reassigned). The window start is the
-                # MASTER's response clock, so cross-host skew can't drop
-                # records; the baseline probe (since<0) returns no ranks,
-                # so pre-engine failure history is never acted on and
-                # nothing real is ever discarded.
-                import time as _time
-
+                # that rank get reassigned). Window starts are MASTER
+                # clock, so cross-host skew can't drop records. The
+                # baseline probe (since<0) returns no ranks; the first
+                # window then reaches BACK by the (skew-free, monotonic)
+                # time elapsed since the watch started, so a failure
+                # landing before the first successful poll is still
+                # caught while pre-watch history is excluded.
                 local_now = _time.time()
                 try:
                     ranks, server_time = master_client.failed_nodes_since(
@@ -269,8 +272,13 @@ class AccelerationEngine:
                             self.mark_rank_failed(rank)
                     # older masters omit server_time: degrade to the
                     # local clock rather than going inert
-                    since = (server_time or local_now) - 1.0
-                    primed = True
+                    base = server_time or local_now
+                    if not primed:
+                        back = _time.monotonic() - watch_start_mono + 1.0
+                        since = base - back
+                        primed = True
+                    else:
+                        since = base - 1.0
                 except Exception:  # noqa: BLE001 — keep watching
                     logger.exception("failure watch poll failed")
                 self._watch_stop.wait(poll_secs)

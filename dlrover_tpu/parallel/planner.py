@@ -129,6 +129,19 @@ MEASURED_ANCHORS = (
         measured_step_s=0.701,
         measured_mfu=0.5106,
     ),
+    CalibrationAnchor(
+        name="bench_r03_2p7b_tuned",  # round-3 sweep winner (BENCH_r03)
+        model=ModelSpec(
+            param_count=2_701_560_320, num_layers=32, hidden_size=2560,
+            seq_len=1024, global_batch=16, vocab_size=32000,
+            optim_bytes_per_param=1, ffn_mult=6912 / 2560,
+            num_heads=20, kv_heads=20,
+        ),
+        device_gen="v5e",
+        remat_policy="full",
+        measured_step_s=2.4624,
+        measured_mfu=0.5645,
+    ),
 )
 
 
