@@ -163,6 +163,32 @@ class TestElasticCheckpoint:
         )
         m2.close()
 
+    def test_fresh_job_with_only_stale_staging_restores_nothing(
+        self, tmp_path
+    ):
+        """A fresh job whose empty primary coexists with a stale staging
+        mirror must get 'no checkpoint' (None), not a crash and not the
+        old job's weights."""
+        import shutil
+
+        res = _build(Strategy(mesh=MeshPlan(data=-1)))
+        primary = tmp_path / "primary"
+        staging = tmp_path / "shm_staging"
+        state = res.init_fn(jax.random.PRNGKey(0))
+        m1 = ElasticCheckpointManager(str(primary),
+                                      staging_dir=str(staging))
+        assert m1.save(7, state, force=True)
+        m1.wait()
+        m1.close()
+
+        # fresh job: wiped primary, stale mirror survives in tmpfs
+        shutil.rmtree(str(primary))
+        m2 = ElasticCheckpointManager(str(primary),
+                                      staging_dir=str(staging))
+        target = abstract_like(state, res.state_sharding)
+        assert m2.restore(target) is None  # from scratch, no crash
+        m2.close()
+
     def test_reshard_on_load_across_world_sizes(self, tmp_path):
         """Save on an 8-device fsdp mesh, restore onto a 4-device mesh."""
         res8 = _build(Strategy(mesh=MeshPlan(data=2, fsdp=4)))
