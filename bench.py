@@ -100,11 +100,14 @@ def _pick_config(platform: str, preset: str):
         return cfg, batch, seq
     if preset == "long":
         # long-context single-chip: flash attention + full remat +
-        # chunked lm head keep memory linear in sequence length
+        # chunked lm head keep memory linear in sequence length.
+        # Tiling from the round-3 sweep (docs/bench_tuning.md):
+        # block_q 1024 + head chunk 512 -> 0.469 MFU at 16k (was 0.413)
         seq = seq or 16384
         batch = int(os.environ.get("BENCH_BATCH", "1"))
         remat = os.environ.get("BENCH_REMAT", "full")
-        os.environ.setdefault("BENCH_HEAD_CHUNK", "1024")
+        os.environ.setdefault("BENCH_HEAD_CHUNK", "512")
+        os.environ.setdefault("BENCH_BLOCK_Q", "1024")
     elif preset == "1b":
         # ~940M-param proxy (round-1 headline model)
         seq = seq or 2048
