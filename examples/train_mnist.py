@@ -1,0 +1,73 @@
+"""MNIST CNN smoke training (BASELINE config #1): the classic
+``dlrover-run`` elastic-agent hello-world, on the JAX stack.
+
+    # plain single process
+    JAX_PLATFORMS=cpu python examples/train_mnist.py --steps 30
+
+    # the full elastic stack: local master subprocess, agent,
+    # rendezvous, worker spawn, monitoring
+    python -m dlrover_tpu.trainer.run --standalone --nnodes 1 \\
+        examples/train_mnist.py --steps 30
+
+Role parity: ``dlrover/examples/pytorch/mnist`` +
+``dlrover-run --standalone``.
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.models import mnist_cnn
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.trainer.conf import build_configuration
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.executor import TrainExecutor
+
+
+def synthetic_mnist(batch, seed=0):
+    rng = np.random.RandomState(seed)
+
+    def gen():
+        while True:
+            images = rng.rand(batch, 28, 28, 1).astype(np.float32)
+            labels = rng.randint(0, 10, size=(batch,))
+            yield {
+                "image": jnp.asarray(images),
+                "label": jnp.asarray(labels),
+            }
+
+    return gen
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch", type=int, default=64)
+    args = p.parse_args()
+
+    batches = synthetic_mnist(args.batch)
+    trainer = ElasticTrainer(
+        mnist_cnn.make_init_fn(),
+        mnist_cnn.make_loss_fn(),
+        optax.sgd(0.1, momentum=0.9),
+        next(batches()),
+        strategy=Strategy(mesh=MeshPlan(data=-1)),
+    )
+    executor = TrainExecutor(
+        trainer,
+        train_iter_fn=batches,
+        conf=build_configuration({
+            "train_steps": args.steps, "log_every_steps": 10,
+        }),
+    )
+    out = executor.train_and_evaluate()
+    print(f"finished at step {out['step']} on "
+          f"{jax.device_count()} devices")
+
+
+if __name__ == "__main__":
+    main()
