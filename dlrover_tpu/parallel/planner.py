@@ -274,6 +274,17 @@ def estimate(
         )
         dp_comm_s = 2 * grad_bytes * (data - 1) / data / device.ici_bw
 
+    # ---- pipeline activation handoff: the full per-device batch of
+    # activations crosses a stage boundary once fwd + once bwd; distinct
+    # boundaries transfer concurrently on distinct host pairs, so (like
+    # every other term) this is PER-LINK time. Pipe is the outermost
+    # axis: on multi-slice topologies this rides DCN, not ICI.
+    pipe_comm_s = 0.0
+    if pipe > 1:
+        pipe_comm_s = (
+            2 * act_elems * model.dtype_bytes / device.dcn_bw
+        )
+
     # ---- ring attention (seq axis): K/V circulate once per layer; GQA
     # rotates only kv_heads/num_heads of the activation bytes, times the
     # head-divisibility repeat factor when kv_heads % tensor != 0
@@ -288,7 +299,7 @@ def estimate(
 
     # comm overlaps with compute imperfectly; charge the max of compute
     # and total comm plus a fraction of the smaller (conservative)
-    comm_s = tp_comm_s + fsdp_comm_s + dp_comm_s + seq_comm_s
+    comm_s = tp_comm_s + fsdp_comm_s + dp_comm_s + seq_comm_s + pipe_comm_s
     step_s = max(compute_s, comm_s) + 0.25 * min(compute_s, comm_s)
 
     # ---- memory (modeled on the production path: flash attention, so
@@ -353,6 +364,7 @@ def estimate(
             "fsdp_comm_s": fsdp_comm_s,
             "dp_comm_s": dp_comm_s,
             "seq_comm_s": seq_comm_s,
+            "pipe_comm_s": pipe_comm_s,
             "param_shard_bytes": param_shard,
             "grad_temp_bytes": grad_temp,
             "gather_buf_bytes": gather_buf,

@@ -94,6 +94,13 @@ class TestCalibration:
                              remat_policy=remat)
                 assert 0.0 < s.predicted_mfu < 1.0
 
+    def test_pipe_activation_handoff_priced_on_dcn(self):
+        spec = _llama7b_spec()
+        piped = estimate(MeshPlan(pipe=4, fsdp=8), spec)
+        flat = estimate(MeshPlan(fsdp=32), spec)
+        assert piped.breakdown["pipe_comm_s"] > 0
+        assert flat.breakdown["pipe_comm_s"] == 0
+
     def test_remat_recompute_slows_prediction(self):
         spec = _llama7b_spec()
         none = estimate(MeshPlan(fsdp=16), spec)
