@@ -239,6 +239,77 @@ def flash_attention(
     )[0]
 
 
+def minimal_kv_repeat(kv_heads: int, num_heads: int, ways: int) -> int:
+    """Smallest repeat making ``kv_heads * rep`` divisible by ``ways``
+    while still dividing ``num_heads`` (the GQA head-shard legalizer
+    shared by the sharded flash wrapper and ring attention; the planner
+    prices the same factor, ``planner.ring_kv_repeat``)."""
+    if kv_heads <= 0 or ways <= 1 or kv_heads % ways == 0:
+        return 1
+    for rep in range(1, num_heads // kv_heads + 1):
+        if (kv_heads * rep) % ways == 0 and num_heads % (
+            kv_heads * rep
+        ) == 0:
+            return rep
+    raise ValueError(
+        f"cannot shard {kv_heads} kv heads (of {num_heads} query heads) "
+        f"over {ways} ways"
+    )
+
+
+def flash_attention_sharded(
+    q: jax.Array,  # global [B, H, S, D]
+    k: jax.Array,  # global [B, H_kv, S, D]
+    v: jax.Array,
+    mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    batch_axes=("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """The multi-chip flash path: GSPMD cannot auto-partition a Mosaic
+    custom call, so the kernel runs under ``shard_map`` with batch on
+    the data axes and heads on the tensor axis — attention with an
+    unsharded sequence is embarrassingly parallel over (batch, head)
+    shards, so the body needs zero collectives. The (seq-sharded)
+    counterpart is ``ops.ring_attention``."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    if head_axis is not None:
+        sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))
+        ways = sizes.get(head_axis, 1)
+        rep = minimal_kv_repeat(k.shape[1], q.shape[1], ways)
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+    spec = P(batch_axes, head_axis, None, None)
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False} if "check_vma" in params
+        else {"check_rep": False} if "check_rep" in params
+        else {}
+    )
+
+    def body(ql, kl, vl):
+        return flash_attention(ql, kl, vl, causal, scale,
+                               block_q, block_k, interpret)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        **check_kw,
+    )(q, k, v)
+
+
 def _resolve(scale, head_dim, interpret):
     scale = scale if scale is not None else 1.0 / (head_dim ** 0.5)
     if interpret is None:

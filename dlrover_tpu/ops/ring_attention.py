@@ -232,17 +232,9 @@ def ring_attention(
         )
         kv_heads, heads = k.shape[1], q.shape[1]
         if kv_heads % tensor_size:
-            rep = next(
-                (r for r in range(1, heads // kv_heads + 1)
-                 if (kv_heads * r) % tensor_size == 0
-                 and heads % (kv_heads * r) == 0),
-                None,
-            )
-            if rep is None:
-                raise ValueError(
-                    f"cannot shard {kv_heads} kv heads (of {heads} query "
-                    f"heads) over {head_axis}={tensor_size}"
-                )
+            from dlrover_tpu.ops.flash_attention import minimal_kv_repeat
+
+            rep = minimal_kv_repeat(kv_heads, heads, tensor_size)
             # No hidden bandwidth cliff (round-2 verdict #9): this costs
             # rep x the ring's ICI bytes, and the planner's seq-comm term
             # prices exactly this factor (planner.ring_kv_repeat).

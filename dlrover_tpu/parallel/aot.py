@@ -254,6 +254,12 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--seq", type=int, default=4096)
     p.add_argument("--remat", default="dots_saveable")
+    p.add_argument("--flash", dest="flash", action="store_true",
+                   default=None,
+                   help="force the Pallas kernel path")
+    p.add_argument("--no-flash", dest="flash", action="store_false",
+                   help="lower the XLA reference attention instead of "
+                        "the Pallas kernel")
     p.add_argument("--mesh", default="",
                    help="override the planner, e.g. data=2,fsdp=4,tensor=2")
     args = p.parse_args(argv)
@@ -263,13 +269,27 @@ def main(argv: Optional[list] = None) -> int:
     import jax.numpy as jnp
 
     factory = getattr(llama, args.model)
-    config = factory(
+    overrides = dict(
         max_seq_len=args.seq,
         param_dtype=jnp.bfloat16,
         compute_dtype=jnp.bfloat16,
         remat_policy=args.remat,
-        use_flash=False,  # deviceless lowering keeps the XLA path
+        # tracing happens on a CPU host but the compile targets the TPU
+        # topology: force the real Mosaic kernel, never the interpreter
+        # emulation the backend-sniffing default would pick
+        flash_interpret=False,
     )
+    if args.flash is not None:
+        # only override the factory's use_flash when the user asked
+        # (llama_tiny deliberately defaults to the XLA reference path)
+        overrides["use_flash"] = args.flash
+    elif args.model.startswith("llama2"):
+        # the production-scale models prove the production path: the
+        # hermetic TPU compiler lowers Pallas/Mosaic with no devices, so
+        # no S^2 tile exists and dots_saveable fits where the XLA
+        # reference path OOMs
+        overrides["use_flash"] = True
+    config = factory(**overrides)
     mesh_plan = None
     if args.mesh:
         from dlrover_tpu.parallel.mesh import MeshPlan
