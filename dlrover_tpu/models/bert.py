@@ -27,7 +27,7 @@ from dlrover_tpu.models.common import (
     param_count as common_param_count,
 )
 from dlrover_tpu.ops.attention_ref import mha_reference
-from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.flash_attention import flash_attention_auto
 from dlrover_tpu.ops.remat import apply_remat
 
 
@@ -128,7 +128,7 @@ def _attention(x, layer, config: BertConfig, mask):
         t.reshape(b, s, h, hd).transpose(0, 2, 1, 3) for t in (q, k, v)
     )
     if mask is None and c.use_flash:
-        out = flash_attention(q, k, v, False)
+        out = flash_attention_auto(q, k, v, False)
     else:
         bias = None
         if mask is not None:

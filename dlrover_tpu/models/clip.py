@@ -26,7 +26,7 @@ from dlrover_tpu.models.common import (
     param_count as common_param_count,
 )
 from dlrover_tpu.ops.attention_ref import mha_reference
-from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.flash_attention import flash_attention_auto
 from dlrover_tpu.ops.remat import apply_remat
 
 
@@ -145,7 +145,7 @@ def _attention(x, layer, t: TowerConfig, causal: bool, use_flash: bool):
     v = (x @ layer["v_proj"]["kernel"]).reshape(b, s, h, hd)
     q, k, v = (z.transpose(0, 2, 1, 3) for z in (q, k, v))
     if use_flash:
-        out = flash_attention(q, k, v, causal)
+        out = flash_attention_auto(q, k, v, causal)
     else:
         out = mha_reference(q, k, v, causal=causal)
     return out.transpose(0, 2, 1, 3).reshape(b, s, h * hd) @ (

@@ -18,7 +18,7 @@ from jax import lax
 
 from dlrover_tpu.models.losses import masked_lm_loss
 from dlrover_tpu.ops.attention_ref import mha_reference
-from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.flash_attention import flash_attention_auto
 from dlrover_tpu.ops.remat import apply_remat
 
 
@@ -114,7 +114,7 @@ def apply(params: Dict, input_ids: jax.Array, config: GPT2Config,
                                                     c.head_dim)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         if c.use_flash:
-            attn = flash_attention(q, k, v, True)
+            attn = flash_attention_auto(q, k, v, True)
         else:
             attn = mha_reference(q, k, v, causal=True)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)

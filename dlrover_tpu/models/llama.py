@@ -39,10 +39,7 @@ from dlrover_tpu.models.common import (
 from dlrover_tpu.models.losses import chunked_lm_head_loss, masked_lm_loss
 from dlrover_tpu.ops import moe as moe_ops
 from dlrover_tpu.ops.attention_ref import mha_reference
-from dlrover_tpu.ops.flash_attention import (
-    flash_attention,
-    flash_attention_sharded,
-)
+from dlrover_tpu.ops.flash_attention import flash_attention_auto
 from dlrover_tpu.ops.remat import apply_remat
 from dlrover_tpu.ops.ring_attention import (
     ring_attention,
@@ -164,27 +161,6 @@ def init(rng: jax.Array, config: LlamaConfig) -> Dict:
 # -- forward ----------------------------------------------------------------
 
 
-def _flash_shard_mesh():
-    """The ambient mesh when tracing under ``jax.sharding.set_mesh``
-    with >1 device on the flash-relevant axes; None = single-device or
-    unsharded tracing (plain pallas_call is fine there)."""
-    try:
-        mesh = jax.sharding.get_abstract_mesh()
-    except Exception:  # noqa: BLE001 — no mesh context
-        return None
-    names = tuple(getattr(mesh, "axis_names", ()) or ())
-    # the sharded wrapper's PartitionSpec names all three axes, so a
-    # partial mesh (user-built, not MeshPlan.build) must stay on the
-    # plain path rather than crash on an unbound axis inside shard_map
-    if not all(a in names for a in ("data", "fsdp", "tensor")):
-        return None
-    sizes = dict(zip(names, mesh.axis_sizes))
-    relevant = sum(sizes[a] for a in ("data", "fsdp", "tensor"))
-    if relevant <= 3:  # all three axes trivial (size 1 each)
-        return None
-    return mesh
-
-
 def _rope(x, positions, theta):
     """x: [B, S, H, Dh]; rotate pairs (even, odd halves)."""
     b, s, h, hd = x.shape
@@ -228,21 +204,12 @@ def _attention_block(x, layer, config: LlamaConfig, positions):
                                    block_q=c.flash_block_q,
                                    block_k=c.flash_block_k)
     elif c.use_flash:
-        mesh = _flash_shard_mesh()
-        if mesh is not None:
-            # GSPMD cannot auto-partition a Mosaic custom call: under a
-            # multi-device mesh the kernel must run inside shard_map
-            # (batch on data axes, heads on tensor — zero collectives)
-            out = flash_attention_sharded(
-                q, k, v, mesh, causal=True,
-                block_q=c.flash_block_q, block_k=c.flash_block_k,
-                interpret=c.flash_interpret,
-            )
-        else:
-            out = flash_attention(q, k, v, True,
-                                  block_q=c.flash_block_q,
-                                  block_k=c.flash_block_k,
-                                  interpret=c.flash_interpret)
+        # auto-routes through shard_map under a non-trivial mesh (GSPMD
+        # cannot partition the Mosaic call itself)
+        out = flash_attention_auto(q, k, v, True,
+                                   block_q=c.flash_block_q,
+                                   block_k=c.flash_block_k,
+                                   interpret=c.flash_interpret)
     else:
         out = mha_reference(q, k, v, causal=True)
     out = checkpoint_name(out, "attn_out")

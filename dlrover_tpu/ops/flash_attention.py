@@ -239,6 +239,50 @@ def flash_attention(
     )[0]
 
 
+def ambient_shard_mesh():
+    """The ambient mesh when tracing under ``jax.sharding.set_mesh``
+    with >1 device on the flash-relevant (data/fsdp/tensor) axes; None
+    when single-device, unsharded, or under a partial mesh missing one
+    of those axes (the sharded wrapper's PartitionSpec names all
+    three)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:  # noqa: BLE001 — no mesh context
+        return None
+    names = tuple(getattr(mesh, "axis_names", ()) or ())
+    if not all(a in names for a in ("data", "fsdp", "tensor")):
+        return None
+    sizes = dict(zip(names, mesh.axis_sizes))
+    if sum(sizes[a] for a in ("data", "fsdp", "tensor")) <= 3:
+        return None  # all three axes trivial (size 1 each)
+    return mesh
+
+
+def flash_attention_auto(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``flash_attention`` that routes itself through the ``shard_map``
+    wrapper whenever the ambient mesh is non-trivial — GSPMD cannot
+    auto-partition a Mosaic custom call, so every model's flash call
+    site must make this choice; centralizing it here keeps them all
+    multi-chip-safe."""
+    mesh = ambient_shard_mesh()
+    if mesh is not None:
+        return flash_attention_sharded(
+            q, k, v, mesh, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    return flash_attention(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+
+
 def minimal_kv_repeat(kv_heads: int, num_heads: int, ways: int) -> int:
     """Smallest repeat making ``kv_heads * rep`` divisible by ``ways``
     while still dividing ``num_heads`` (the GQA head-shard legalizer
