@@ -20,7 +20,11 @@ The committed numbers live in ``docs/data_pipeline.md``.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
