@@ -104,13 +104,16 @@ def main() -> int:
                 print(f"# stock {name} failed: {e}"[:160])
         stock_best = min(stock_times, key=stock_times.get)
         stock_t = stock_times[stock_best]
+        stock_ok = stock_t != float("inf")
         print(json.dumps({
             "metric": "flash_attention_vs_stock",
             "shape": f"b{b}h{h}s{s}d{d}",
             "ours_ms": round(ours_t * 1e3, 2),
-            "stock_ms": round(stock_t * 1e3, 2),
-            "stock_best_config": stock_best,
-            "speedup": round(stock_t / ours_t, 3),
+            # null, not Infinity: the line must stay valid JSON even
+            # when every stock config fails on this shape
+            "stock_ms": round(stock_t * 1e3, 2) if stock_ok else None,
+            "stock_best_config": stock_best if stock_ok else None,
+            "speedup": round(stock_t / ours_t, 3) if stock_ok else None,
         }))
     return 0
 

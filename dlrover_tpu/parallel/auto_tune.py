@@ -100,6 +100,7 @@ def search_strategy(
     devices: Optional[Sequence] = None,
     max_candidates: int = 8,
     profile_steps: int = 3,
+    model_spec=None,
 ) -> tuple:
     """Try candidate meshes; return (best_strategy, all_reports).
 
@@ -107,12 +108,32 @@ def search_strategy(
     ranks; here every candidate compiles against the same devices, so the
     loop is local and the winning strategy is broadcast via the master's
     ParallelConfig push instead.
+
+    ``model_spec`` (a ``planner.ModelSpec``): when given, the analytic
+    planner orders the candidates before the budget truncation, so the
+    measured search spends its compiles on the cost model's best guesses
+    instead of dropping candidates in enumeration order.
     """
     base = base_strategy or Strategy()
     n_devices = len(devices) if devices is not None else jax.device_count()
     plans = list(candidates) if candidates is not None else candidate_plans(
         n_devices
     )
+    if model_spec is not None and len(plans) > 1:
+        from dlrover_tpu.parallel import planner
+
+        scored = [
+            # resolve -1 (infer) axes first: estimate() would clamp
+            # them to 1 and misprice the plan
+            planner.estimate(p.resolve(n_devices), model_spec,
+                             remat_policy=base.remat_policy)
+            for p in plans
+        ]
+        # predicted-feasible first (fastest first), predicted-OOM last —
+        # kept in the pool so a wrong memory model only demotes, never
+        # eliminates
+        scored.sort(key=lambda s: (not s.fits, s.step_time_s))
+        plans = [s.plan for s in scored]
     if len(plans) > max_candidates:
         logger.info(
             "search: truncating %d candidates to %d (dropped: %s)",
