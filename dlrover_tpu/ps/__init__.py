@@ -1,0 +1,36 @@
+"""Asynchronous parameter-server execution path.
+
+Role parity: the reference's PS *distribution strategy* — scheduling and
+membership live in the master (``dlrover/python/master/node/ps.py``,
+``elastic_training/elastic_ps.py``), while the execution engine there is
+TensorFlow's parameter-server runtime driven through the estimator trainer
+(``dlrover/trainer/tensorflow/``, DeepRec CPU PS jobs in
+``docs/blogs/deeprec_autoscale_cn.md``). We do not wrap TF; this package is
+the TPU-framework-native execution engine for that strategy:
+
+- ``ps.server``  — a PS shard process: host-memory parameter store with
+  numpy-native optimizers applied on push (the PS owns optimizer state,
+  exactly like TF's PS applies updates server-side).
+- ``ps.client``  — worker-side cluster view: discovers PS shards through the
+  master, partitions parameters across shards (size-balanced), pulls and
+  pushes tensors over a binary gRPC framing.
+- ``ps.trainer`` — the async training loop: grads computed with jax (jit on
+  the accelerator), pushed asynchronously; elastic PS membership changes are
+  picked up through the master's cluster-version handshake.
+
+Sparse/CPU recommendation models (DeepFM et al.) are the intended workload,
+mirroring the reference's DeepRec positioning; dense LLM training on TPU
+uses the synchronous GSPMD path in ``dlrover_tpu.parallel`` instead.
+"""
+
+from dlrover_tpu.ps.client import PsClusterClient, partition_params
+from dlrover_tpu.ps.server import PsShardServer, start_ps_shard
+from dlrover_tpu.ps.trainer import AsyncPsTrainer
+
+__all__ = [
+    "PsClusterClient",
+    "partition_params",
+    "PsShardServer",
+    "start_ps_shard",
+    "AsyncPsTrainer",
+]

@@ -1,0 +1,228 @@
+"""Worker-side PS cluster view: discovery, partitioning, pull/push.
+
+Role parity: the worker half of the reference's PS strategy — TF workers
+resolve the PS cluster from TF_CONFIG kept fresh by the failover watcher
+(``dlrover/trainer/tensorflow/failover/tensorflow_failover.py:33-144``) and
+the variable placer spreads variables over PS tasks. Here:
+
+- discovery: ``query_ps_nodes`` rpc against the distributed master
+  (``servicer.py`` parity) with a KV-store fallback (``ps/addr/{i}`` keys)
+  that the local/standalone path uses;
+- placement: deterministic greedy size-balanced assignment of parameter
+  names to shards — every worker computes the same mapping from the same
+  specs, so there is no placement metadata service;
+- elasticity: the master's cluster-version handshake
+  (``elastic_ps.ElasticPsService``) signals membership changes; workers
+  re-resolve addresses and re-pull.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.ps import wire
+from dlrover_tpu.ps.server import PS_METHOD
+
+logger = get_logger("ps.client")
+
+
+def partition_params(specs: Dict[str, int], num_shards: int) -> Dict[str, int]:
+    """name -> shard id; greedy bin-pack by byte size, deterministic.
+
+    Sorting by (-size, name) then assigning each param to the least-loaded
+    shard gives every worker the identical mapping with balanced bytes.
+    """
+    if num_shards <= 0:
+        raise ValueError("num_shards must be positive")
+    heap: List[Tuple[int, int]] = [(0, i) for i in range(num_shards)]
+    heapq.heapify(heap)
+    assignment: Dict[str, int] = {}
+    for name in sorted(specs, key=lambda n: (-specs[n], n)):
+        load, shard = heapq.heappop(heap)
+        assignment[name] = shard
+        heapq.heappush(heap, (load + specs[name], shard))
+    return assignment
+
+
+class PsClusterClient:
+    """Talks to every PS shard; presents one logical parameter dict."""
+
+    def __init__(self, addrs: Sequence[str],
+                 master_client=None):
+        self._master = master_client
+        self._addrs: List[str] = list(addrs)
+        self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
+        self._channels: Dict[int, grpc.Channel] = {}
+        self._assignment: Dict[str, int] = {}
+        self._known_version = 0  # master global cluster version we built on
+
+    # -- discovery ---------------------------------------------------------
+
+    @classmethod
+    def discover(cls, master_client, num_shards: Optional[int] = None,
+                 timeout_s: float = 30.0) -> "PsClusterClient":
+        """Resolve shard addresses via the master. Prefers the job-manager
+        backed ``query_ps_nodes``; falls back to KV keys for local mode."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            ps = master_client.query_ps_nodes()
+            if ps.ready and ps.addrs:
+                return cls(ps.addrs, master_client)
+            addrs = cls._kv_addrs(master_client, num_shards)
+            if addrs is not None:
+                return cls(addrs, master_client)
+            if time.monotonic() > deadline:
+                raise TimeoutError("PS shards did not register in time")
+            time.sleep(0.2)
+
+    @staticmethod
+    def _kv_addrs(master_client,
+                  num_shards: Optional[int]) -> Optional[List[str]]:
+        if num_shards is None:
+            # the shard launcher announces the cluster size (ps/count) so a
+            # worker racing shard registration can't adopt a partial list —
+            # a partial view would compute a different placement than later
+            # workers and silently split parameters
+            count = master_client.kv_store_get("ps/count")
+            if count:
+                num_shards = int(count)
+        addrs: List[str] = []
+        i = 0
+        while True:
+            addr = master_client.kv_store_get(f"ps/addr/{i}")
+            if not addr:
+                break
+            addrs.append(addr)
+            i += 1
+        if not addrs:
+            return None
+        if num_shards is not None and len(addrs) < num_shards:
+            return None  # still registering
+        return addrs
+
+    # -- channels ----------------------------------------------------------
+
+    def _stub(self, shard: int) -> grpc.UnaryUnaryMultiCallable:
+        if shard not in self._stubs:
+            channel = grpc.insecure_channel(
+                self._addrs[shard],
+                options=[
+                    ("grpc.max_send_message_length", 1024 * 1024 * 1024),
+                    ("grpc.max_receive_message_length", 1024 * 1024 * 1024),
+                ],
+            )
+            self._channels[shard] = channel
+            self._stubs[shard] = channel.unary_unary(
+                PS_METHOD,
+                request_serializer=wire.identity,
+                response_deserializer=wire.identity,
+            )
+        return self._stubs[shard]
+
+    def close(self):
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+        self._stubs.clear()
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._addrs)
+
+    # -- logical parameter ops --------------------------------------------
+
+    def _fanout(self, frames: Dict[int, bytes], op: str) -> Dict[int, tuple]:
+        """Issue one call per shard concurrently (step latency = max shard
+        RTT, not the sum — the point of sharding the PS) and collect."""
+        futs = {shard: self._stub(shard).future(frame)
+                for shard, frame in frames.items()}
+        out = {}
+        for shard, fut in futs.items():
+            meta, tensors = wire.unpack_frame(fut.result())
+            if not meta.get("ok"):
+                raise RuntimeError(f"PS {op} failed on shard {shard}: {meta}")
+            out[shard] = (meta, tensors)
+        return out
+
+    def init(self, params: Dict[str, np.ndarray]) -> None:
+        specs = {n: int(a.nbytes) for n, a in params.items()}
+        self._assignment = partition_params(specs, self.num_shards)
+        frames = {}
+        for shard in range(self.num_shards):
+            group = {n: params[n] for n, s in self._assignment.items()
+                     if s == shard}
+            if group:
+                frames[shard] = wire.pack_frame({"op": "init"}, group)
+        self._fanout(frames, "init")
+
+    def pull(self) -> Tuple[Dict[str, np.ndarray], int]:
+        """Fetch all params; returns (params, max shard version)."""
+        frames = {}
+        for shard in range(self.num_shards):
+            names = [n for n, s in self._assignment.items() if s == shard]
+            if names:
+                frames[shard] = wire.pack_frame(
+                    {"op": "pull", "names": names})
+        out: Dict[str, np.ndarray] = {}
+        version = 0
+        for meta, tensors in self._fanout(frames, "pull").values():
+            out.update(tensors)
+            version = max(version, int(meta.get("version", 0)))
+        return out, version
+
+    def push(self, grads: Dict[str, np.ndarray]) -> int:
+        """Send grads to owning shards; PS applies updates server-side."""
+        frames = {}
+        for shard in range(self.num_shards):
+            group = {n: grads[n] for n, s in self._assignment.items()
+                     if s == shard and n in grads}
+            if group:
+                frames[shard] = wire.pack_frame({"op": "push"}, group)
+        version = 0
+        for meta, _ in self._fanout(frames, "push").values():
+            version = max(version, int(meta.get("version", 0)))
+        return version
+
+    def checkpoint(self, directory: Optional[str] = None) -> None:
+        frames = {shard: wire.pack_frame({"op": "checkpoint",
+                                          "dir": directory})
+                  for shard in range(self.num_shards)}
+        self._fanout(frames, "checkpoint")
+
+    # -- elasticity --------------------------------------------------------
+
+    def membership_changed(self) -> bool:
+        """Poll the master's global PS cluster version; on a bump, re-resolve
+        shard addresses (same handshake the TF failover watcher does on
+        TF_CONFIG change)."""
+        if self._master is None:
+            return False
+        version = self._master.get_cluster_version("global", "worker", 0)
+        if version == self._known_version:
+            return False
+        addrs = self._kv_addrs(self._master, None)
+        ps = self._master.query_ps_nodes()
+        if ps.ready and ps.addrs:
+            addrs = ps.addrs
+        if not addrs:
+            # resolution not ready yet — leave _known_version unconsumed so
+            # the next check retries instead of pinning dead addresses
+            return False
+        logger.info("PS cluster version %d -> %d: re-resolved %d shards",
+                    self._known_version, version, len(addrs))
+        self._known_version = version
+        self.close()
+        self._addrs = list(addrs)
+        # same shard count => placement unchanged; a resize would need a
+        # repartition + parameter move, which the migration driver does
+        # via checkpoint/restore before bumping the version.
+        if self._assignment and \
+                max(self._assignment.values()) >= len(self._addrs):
+            self._assignment = {}
+        return True

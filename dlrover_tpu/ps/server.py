@@ -1,0 +1,287 @@
+"""PS shard server: host-memory parameter store with server-side updates.
+
+Role parity: the parameter-server side of the reference's PS strategy. There
+the PS is a TensorFlow server applying optimizer updates in its own process
+(DeepRec CPU PS jobs, ``docs/blogs/deeprec_autoscale_cn.md``); the DLRover
+master schedules and migrates those processes
+(``dlrover/python/master/node/ps.py:198,315``). Here the PS shard is a small
+gRPC process holding a dict of numpy parameters and per-parameter optimizer
+slots, applying updates on ``push`` — server-side application is what makes
+the strategy *asynchronous*: workers never wait for each other, only for
+their own push/pull round-trips.
+
+Updates run in numpy (C-level, no GIL-bound Python loops over elements),
+which is the honest host-side analogue of TF's C++ apply-ops. Grad staleness
+is inherent to async PS and is surfaced via the version counter so trainers
+can bound it (``AsyncPsTrainer.max_staleness``).
+
+Checkpoint/restore is a single ``.npz`` per shard, so a migrated PS (master
+scale event) restores its slice and bumps the cluster version; workers
+re-resolve addresses and re-pull (``tensorflow_failover.py:33-144`` parity).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+from typing import Dict, Optional, Tuple
+
+import grpc
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.ps import wire
+
+logger = get_logger("ps.server")
+
+PS_SERVICE = "dlrover_tpu.PS"
+PS_METHOD = f"/{PS_SERVICE}/call"
+
+
+# ---------------------------------------------------------------------------
+# numpy optimizers (PS-side slots)
+# ---------------------------------------------------------------------------
+
+class _NpOptimizer:
+    """Server-side optimizer: one slot-dict per parameter."""
+
+    def __init__(self, spec: str):
+        # spec: "sgd:0.1" | "momentum:0.1:0.9" | "adagrad:0.05" | "adam:1e-3"
+        parts = spec.split(":")
+        self.kind = parts[0]
+        self.lr = float(parts[1]) if len(parts) > 1 else 0.01
+        self.extra = [float(p) for p in parts[2:]]
+        if self.kind not in ("sgd", "momentum", "adagrad", "adam"):
+            raise ValueError(f"unknown PS optimizer {self.kind!r}")
+
+    def init_slots(self, param: np.ndarray) -> Dict[str, np.ndarray]:
+        if self.kind == "sgd":
+            return {}
+        if self.kind == "momentum":
+            return {"m": np.zeros_like(param)}
+        if self.kind == "adagrad":
+            return {"acc": np.full_like(param, 0.1)}
+        return {"m": np.zeros_like(param), "v": np.zeros_like(param),
+                "t": np.zeros((), np.int64)}
+
+    def apply(self, param: np.ndarray, grad: np.ndarray,
+              slots: Dict[str, np.ndarray]) -> None:
+        grad = grad.astype(param.dtype, copy=False)
+        if self.kind == "sgd":
+            param -= self.lr * grad
+        elif self.kind == "momentum":
+            mu = self.extra[0] if self.extra else 0.9
+            slots["m"] *= mu
+            slots["m"] += grad
+            param -= self.lr * slots["m"]
+        elif self.kind == "adagrad":
+            slots["acc"] += grad * grad
+            param -= self.lr * grad / np.sqrt(slots["acc"])
+        else:  # adam
+            b1 = self.extra[0] if len(self.extra) > 0 else 0.9
+            b2 = self.extra[1] if len(self.extra) > 1 else 0.999
+            slots["t"] += 1
+            t = int(slots["t"])
+            slots["m"] *= b1
+            slots["m"] += (1 - b1) * grad
+            slots["v"] *= b2
+            slots["v"] += (1 - b2) * grad * grad
+            mhat = slots["m"] / (1 - b1 ** t)
+            vhat = slots["v"] / (1 - b2 ** t)
+            param -= self.lr * mhat / (np.sqrt(vhat) + 1e-8)
+
+
+# ---------------------------------------------------------------------------
+# shard server
+# ---------------------------------------------------------------------------
+
+class PsShardServer:
+    """One PS shard: params + optimizer slots + a raw-bytes gRPC service."""
+
+    def __init__(self, shard_id: int, optimizer: str = "adagrad:0.05",
+                 checkpoint_dir: Optional[str] = None):
+        self.shard_id = shard_id
+        self._opt = _NpOptimizer(optimizer)
+        self._ckpt_dir = checkpoint_dir
+        self._lock = threading.Lock()
+        self._params: Dict[str, np.ndarray] = {}
+        self._slots: Dict[str, Dict[str, np.ndarray]] = {}
+        self._version = 0  # total applied pushes (staleness reference)
+        self._server: Optional[grpc.Server] = None
+        self.addr: Optional[str] = None
+
+    # -- rpc entry ---------------------------------------------------------
+
+    def call(self, request: bytes, context=None) -> bytes:
+        meta, tensors = wire.unpack_frame(request)
+        op = meta.get("op")
+        if op == "init":
+            return self._do_init(meta, tensors)
+        if op == "pull":
+            return self._do_pull(meta)
+        if op == "push":
+            return self._do_push(meta, tensors)
+        if op == "checkpoint":
+            return self._do_checkpoint(meta)
+        if op == "restore":
+            return self._do_restore(meta)
+        if op == "stats":
+            with self._lock:
+                return wire.pack_frame({
+                    "ok": True, "version": self._version,
+                    "num_params": len(self._params),
+                    "bytes": int(sum(p.nbytes for p in self._params.values())),
+                })
+        return wire.pack_frame({"ok": False, "error": f"unknown op {op!r}"})
+
+    # -- ops ---------------------------------------------------------------
+
+    def _do_init(self, meta, tensors) -> bytes:
+        """Create parameters that don't exist yet (idempotent: a worker
+        racing another worker's init, or re-initing after PS restore, is a
+        no-op for existing keys)."""
+        created = []
+        with self._lock:
+            for name, arr in tensors.items():
+                if name not in self._params:
+                    self._params[name] = np.array(arr, copy=True)
+                    self._slots[name] = self._opt.init_slots(self._params[name])
+                    created.append(name)
+        return wire.pack_frame({"ok": True, "created": created,
+                                "version": self._version})
+
+    def _do_pull(self, meta) -> bytes:
+        names = meta.get("names")
+        with self._lock:
+            if names is None:
+                names = list(self._params)
+            missing = [n for n in names if n not in self._params]
+            if missing:
+                return wire.pack_frame(
+                    {"ok": False, "error": "missing", "missing": missing})
+            out = {n: self._params[n].copy() for n in names}
+            version = self._version
+        return wire.pack_frame({"ok": True, "version": version}, out)
+
+    def _do_push(self, meta, tensors) -> bytes:
+        with self._lock:
+            missing = [n for n in tensors if n not in self._params]
+            if missing:
+                return wire.pack_frame(
+                    {"ok": False, "error": "missing", "missing": missing})
+            for name, grad in tensors.items():
+                self._opt.apply(self._params[name], grad, self._slots[name])
+            self._version += 1
+            version = self._version
+        return wire.pack_frame({"ok": True, "version": version})
+
+    def _ckpt_path(self, directory: Optional[str]) -> str:
+        d = directory or self._ckpt_dir
+        if not d:
+            raise ValueError("no checkpoint dir configured")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"ps-shard-{self.shard_id}.npz")
+
+    def _do_checkpoint(self, meta) -> bytes:
+        path = self._ckpt_path(meta.get("dir"))
+        with self._lock:
+            payload = {f"p/{n}": a for n, a in self._params.items()}
+            for n, slots in self._slots.items():
+                for sname, sval in slots.items():
+                    payload[f"s/{n}/{sname}"] = sval
+            payload["__version__"] = np.asarray(self._version, np.int64)
+            tmp = path + ".tmp.npz"  # .npz suffix keeps savez from renaming
+            np.savez(tmp, **payload)
+            os.replace(tmp, path)
+        return wire.pack_frame({"ok": True, "path": path})
+
+    def _do_restore(self, meta) -> bytes:
+        path = self._ckpt_path(meta.get("dir"))
+        if not os.path.exists(path):
+            return wire.pack_frame({"ok": False, "error": "no checkpoint"})
+        with self._lock:
+            self._params.clear()
+            self._slots.clear()
+            with np.load(path) as data:
+                for key in data.files:
+                    if key == "__version__":
+                        self._version = int(data[key])
+                    elif key.startswith("p/"):
+                        self._params[key[2:]] = np.array(data[key])
+                for key in data.files:
+                    if key.startswith("s/"):
+                        # slot names ("m","v","t","acc") never contain "/",
+                        # so rsplit keeps param names with "/" intact
+                        name, sname = key[2:].rsplit("/", 1)
+                        self._slots.setdefault(name, {})[sname] = \
+                            np.array(data[key])
+            # params restored without slots (optimizer change): re-init
+            for name in self._params:
+                if name not in self._slots:
+                    self._slots[name] = self._opt.init_slots(self._params[name])
+        return wire.pack_frame({"ok": True, "version": self._version,
+                                "num_params": len(self._params)})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> str:
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=16),
+                             options=[
+            ("grpc.max_send_message_length", 1024 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 1024 * 1024 * 1024),
+        ])
+        shard = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                if details.method != PS_METHOD:
+                    return None
+                return grpc.unary_unary_rpc_method_handler(
+                    shard.call,
+                    request_deserializer=wire.identity,
+                    response_serializer=wire.identity,
+                )
+
+        server.add_generic_rpc_handlers((_Handler(),))
+        bound = server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise RuntimeError("cannot bind PS shard port")
+        server.start()
+        self._server = server
+        self.addr = f"{host}:{bound}"
+        logger.info("PS shard %d serving at %s", self.shard_id, self.addr)
+        return self.addr
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None
+
+
+def start_ps_shard(shard_id: int, master_client=None,
+                   optimizer: str = "adagrad:0.05",
+                   checkpoint_dir: Optional[str] = None,
+                   restore: bool = False,
+                   num_shards: Optional[int] = None,
+                   port: int = 0) -> PsShardServer:
+    """Start a shard and register its address with the master's KV store so
+    workers can discover it (``ps/addr/{shard_id}``). A replacement shard for
+    the same id (PS migration) overwrites the key; the migration driver then
+    bumps the global cluster version and workers re-resolve. With
+    ``restore=True`` the shard reloads its slice from ``checkpoint_dir``
+    before serving (the migration path)."""
+    shard = PsShardServer(shard_id, optimizer=optimizer,
+                          checkpoint_dir=checkpoint_dir)
+    if restore:
+        meta, _ = wire.unpack_frame(shard.call(wire.pack_frame(
+            {"op": "restore"})))
+        if not meta.get("ok"):
+            raise RuntimeError(f"PS shard {shard_id} restore failed: {meta}")
+    addr = shard.start(port=port)
+    if master_client is not None:
+        master_client.kv_store_set(f"ps/addr/{shard_id}", addr)
+        if num_shards is not None:
+            # announce cluster size so discovery never adopts a partial list
+            master_client.kv_store_set("ps/count", str(num_shards))
+    return shard

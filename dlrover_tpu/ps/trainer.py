@@ -1,0 +1,104 @@
+"""Asynchronous PS training loop.
+
+Role parity: the reference's PS-strategy trainer — TF estimator workers
+computing grads and letting the PS apply them asynchronously
+(``dlrover/trainer/tensorflow/executor/estimator_executor.py``), with
+elasticity handled by the cluster-version handshake
+(``failover/failover_client.py``). Here the worker computes grads with a
+jitted jax function (TPU or CPU — recommendation models are typically CPU
+workers, matching DeepRec) and push/pulls through ``PsClusterClient``.
+
+The loop is genuinely asynchronous: no barrier with other workers, global
+batch is emergent, staleness bounded only by the pull-compute-push cadence.
+This is intentionally the opposite discipline from ``dlrover_tpu.parallel``'s
+synchronous GSPMD path — it exists for the sparse/CPU workloads where the
+reference uses PS.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.ps.client import PsClusterClient
+
+logger = get_logger("ps.trainer")
+
+
+def _flatten_named(params) -> Tuple[Dict[str, np.ndarray], Any, list]:
+    """Pytree -> {path-name: array}; returns (dict, treedef, ordered names)."""
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+    names, flat = [], {}
+    for path, leaf in leaves_with_path:
+        name = jax.tree_util.keystr(path)
+        names.append(name)
+        flat[name] = np.asarray(leaf)
+    return flat, treedef, names
+
+
+class AsyncPsTrainer:
+    """Pull -> grad -> push loop against a PS cluster.
+
+    ``loss_fn(params, batch) -> scalar`` is differentiated and jitted once;
+    parameter structure is captured at ``init_params``.
+    """
+
+    def __init__(self, loss_fn: Callable, cluster: PsClusterClient,
+                 master_client=None, membership_check_every: int = 8,
+                 report_every: int = 16):
+        self._cluster = cluster
+        self._master = master_client
+        self._check_every = membership_check_every
+        self._report_every = report_every
+        self._grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+        self._treedef = None
+        self._names: list = []
+        self._step = 0
+        self._start_ts = time.time()
+
+    # -- setup -------------------------------------------------------------
+
+    def init_params(self, params) -> None:
+        flat, self._treedef, self._names = _flatten_named(params)
+        self._cluster.init(flat)
+
+    def _unflatten(self, flat: Dict[str, np.ndarray]):
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [flat[n] for n in self._names])
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self, batch) -> float:
+        """One async step: pull fresh params, compute grads, push."""
+        if self._step and self._check_every and \
+                self._step % self._check_every == 0:
+            self._cluster.membership_changed()
+        flat, _version = self._cluster.pull()
+        if not flat:
+            # a membership change that resized the cluster invalidates the
+            # placement; the migration driver must move params first
+            raise RuntimeError("PS pull returned no parameters; if the "
+                               "cluster was resized, restore from "
+                               "checkpoint before resuming workers")
+        params = self._unflatten(flat)
+        loss, grads = self._grad_fn(params, batch)
+        gflat, _, _ = _flatten_named(grads)
+        self._cluster.push(gflat)
+        self._step += 1
+        if self._master is not None and self._report_every and \
+                self._step % self._report_every == 0:
+            self._master.report_global_step(self._step)
+        return float(loss)
+
+    @property
+    def global_step(self) -> int:
+        return self._step
+
+    # -- checkpoint --------------------------------------------------------
+
+    def checkpoint(self, directory: Optional[str] = None) -> None:
+        self._cluster.checkpoint(directory)
