@@ -1,0 +1,255 @@
+"""GLM-family decoder (prefix-LM mask, 2D positional encoding).
+
+Role parity: the reference's GLM support — Megatron-sharded GLM blocks in
+the shardable-operator registry and the glm-mask FlashAttention adapter
+(``atorch/modules/transformer/layers.py:1191`` ``fa2_with_glm_mask``).
+GLM's two signatures, built TPU-first:
+
+  * **prefix-LM attention**: token ``i`` attends to ``j`` iff
+    ``j < prefix_len`` (bidirectional over the prompt) OR ``j <= i``
+    (causal over the generation). Per-example ``prefix_len`` arrives in
+    the batch; the mask is computed in-program from iota comparisons —
+    static shapes, no data-dependent control flow, so one compiled
+    program serves every prefix split.
+  * **2D positions** (autoregressive blank-infilling): position ids run
+    0..p-1 over the prefix then freeze at ``p``; block-position ids are 0
+    over the prefix and 1..n over the generation. Two learned tables are
+    summed into the token embedding, matching GLM's scheme.
+
+Pure-causal batches (prefix_len == 0) route through the Pallas flash
+kernel; prefix batches use the bias-capable XLA attention — the mask is a
+per-batch bias, which XLA fuses into the attention einsum.
+
+Sharding: ``parallel.sharding_rules.glm_rules``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.models.common import (
+    cast_floats,
+    dense_init as _dense,
+    layer_norm as _layer_norm,
+    param_count as common_param_count,
+)
+from dlrover_tpu.models.losses import masked_lm_loss
+from dlrover_tpu.ops.attention_ref import mha_reference
+from dlrover_tpu.ops.flash_attention import flash_attention_auto
+from dlrover_tpu.ops.remat import apply_remat
+
+
+@dataclass(frozen=True)
+class GLMConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_seq_len: int = 1024
+    ln_eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat_policy: str = "dots_saveable"
+    use_flash: bool = True  # used only for pure-causal batches
+    flash_block_q: int = 512
+    flash_block_k: int = 1024
+    flash_interpret: Any = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def glm_large(**overrides) -> GLMConfig:
+    return replace(GLMConfig(), **overrides)
+
+
+def glm_10b(**overrides) -> GLMConfig:
+    return replace(
+        GLMConfig(hidden_size=4096, num_layers=48, num_heads=64,
+                  intermediate_size=16384, max_seq_len=2048),
+        **overrides,
+    )
+
+
+def glm_tiny(**overrides) -> GLMConfig:
+    return replace(
+        GLMConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                  num_heads=4, intermediate_size=128, max_seq_len=128,
+                  compute_dtype=jnp.float32, use_flash=False),
+        **overrides,
+    )
+
+
+# -- init -------------------------------------------------------------------
+
+
+def init(rng: jax.Array, config: GLMConfig) -> Dict:
+    c = config
+    dt = c.param_dtype
+    keys = iter(jax.random.split(rng, 12))
+    l, d, f = c.num_layers, c.hidden_size, c.intermediate_size
+    h, hd = c.num_heads, c.head_dim
+
+    layers = {
+        "input_norm": {"scale": jnp.ones((l, d), dt),
+                       "bias": jnp.zeros((l, d), dt)},
+        "post_norm": {"scale": jnp.ones((l, d), dt),
+                      "bias": jnp.zeros((l, d), dt)},
+        "q_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dt),
+                   "bias": jnp.zeros((l, h * hd), dt)},
+        "k_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dt),
+                   "bias": jnp.zeros((l, h * hd), dt)},
+        "v_proj": {"kernel": _dense(next(keys), (l, d, h * hd), dt),
+                   "bias": jnp.zeros((l, h * hd), dt)},
+        "o_proj": {"kernel": _dense(next(keys), (l, h * hd, d), dt),
+                   "bias": jnp.zeros((l, d), dt)},
+        "up_proj": {"kernel": _dense(next(keys), (l, d, f), dt),
+                    "bias": jnp.zeros((l, f), dt)},
+        "down_proj": {"kernel": _dense(next(keys), (l, f, d), dt,
+                                       scale=1.0 / math.sqrt(f)),
+                      "bias": jnp.zeros((l, d), dt)},
+    }
+    return {
+        "embed_tokens": {"embedding": jax.random.normal(
+            next(keys), (c.vocab_size, d), dt) * 0.02},
+        # 2D positional encoding: absolute + block tables
+        "pos_embed": {"embedding": jax.random.normal(
+            next(keys), (c.max_seq_len + 1, d), dt) * 0.02},
+        "block_pos_embed": {"embedding": jax.random.normal(
+            next(keys), (c.max_seq_len + 1, d), dt) * 0.02},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((d,), dt),
+                       "bias": jnp.zeros((d,), dt)},
+        "lm_head": {"kernel": _dense(next(keys), (d, c.vocab_size), dt)},
+    }
+
+
+# -- masks / positions ------------------------------------------------------
+
+
+def glm_positions(seq_len: int, prefix_len: jax.Array):
+    """2D position ids from per-example prefix lengths [B].
+
+    Returns (position_ids, block_position_ids), each [B, S]:
+      prefix token i       -> (i, 0)
+      generation token g_j -> (prefix_len, j + 1)
+    """
+    idx = jnp.arange(seq_len)[None, :]  # [1, S]
+    p = prefix_len[:, None]  # [B, 1]
+    position_ids = jnp.where(idx < p, idx, p)
+    block_position_ids = jnp.where(idx < p, 0, idx - p + 1)
+    return position_ids, block_position_ids
+
+
+def prefix_lm_bias(seq_len: int, prefix_len: jax.Array,
+                   dtype=jnp.float32) -> jax.Array:
+    """Additive attention bias [B, 1, S, S]: 0 where attending is allowed
+    (j < prefix_len OR j <= i), large-negative otherwise."""
+    i = jnp.arange(seq_len)[:, None]  # queries
+    j = jnp.arange(seq_len)[None, :]  # keys
+    causal = j <= i  # [S, S]
+    in_prefix = j[None, :, :] < prefix_len[:, None, None]  # [B, S, S]
+    allowed = jnp.logical_or(causal[None], in_prefix)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, dtype)
+    return jnp.where(allowed, jnp.zeros((), dtype), neg)[:, None, :, :]
+
+
+# -- forward ----------------------------------------------------------------
+
+
+def _attention(x, layer, c: GLMConfig, bias):
+    b, s, d = x.shape
+    h, hd = c.num_heads, c.head_dim
+    q = (x @ layer["q_proj"]["kernel"] + layer["q_proj"]["bias"]
+         ).reshape(b, s, h, hd)
+    k = (x @ layer["k_proj"]["kernel"] + layer["k_proj"]["bias"]
+         ).reshape(b, s, h, hd)
+    v = (x @ layer["v_proj"]["kernel"] + layer["v_proj"]["bias"]
+         ).reshape(b, s, h, hd)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if bias is None and c.use_flash:
+        out = flash_attention_auto(q, k, v, True,
+                                   block_q=c.flash_block_q,
+                                   block_k=c.flash_block_k,
+                                   interpret=c.flash_interpret)
+    else:
+        # prefix-LM mask rides as an additive bias; causal=False because
+        # the bias already encodes the causal part
+        out = mha_reference(q, k, v, bias=bias, causal=bias is None)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
+
+
+def _block(c: GLMConfig, bias):
+    def block(x, layer):
+        layer = cast_floats(layer, c.compute_dtype)
+        attn_in = _layer_norm(x, layer["input_norm"]["scale"],
+                              layer["input_norm"]["bias"], c.ln_eps)
+        x = x + _attention(attn_in, layer, c, bias)
+        mlp_in = _layer_norm(x, layer["post_norm"]["scale"],
+                             layer["post_norm"]["bias"], c.ln_eps)
+        up = mlp_in @ layer["up_proj"]["kernel"] + layer["up_proj"]["bias"]
+        mlp_out = jax.nn.gelu(up) @ layer["down_proj"]["kernel"] \
+            + layer["down_proj"]["bias"]
+        return x + mlp_out, None
+
+    return block
+
+
+def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
+          rng: Optional[jax.Array] = None,
+          prefix_len: Optional[jax.Array] = None) -> jax.Array:
+    """prefix_len: [B] int array; None means pure causal LM (flash path)."""
+    c = config
+    b, s = input_ids.shape
+    x = params["embed_tokens"]["embedding"][input_ids]
+    if prefix_len is not None:
+        pos_ids, block_ids = glm_positions(s, prefix_len)
+        bias = prefix_lm_bias(s, prefix_len, c.compute_dtype)
+    else:
+        pos_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+        block_ids = jnp.zeros((b, s), jnp.int32)
+        bias = None
+    x = x + params["pos_embed"]["embedding"][pos_ids] \
+        + params["block_pos_embed"]["embedding"][block_ids]
+    x = x.astype(c.compute_dtype)
+
+    block = apply_remat(_block(c, bias), c.remat_policy)
+    x, _ = lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["final_norm"]["scale"],
+                    params["final_norm"]["bias"], c.ln_eps)
+    logits = x @ params["lm_head"]["kernel"].astype(c.compute_dtype)
+    return logits.astype(jnp.float32)
+
+
+# -- training glue ----------------------------------------------------------
+
+
+def make_init_fn(config: GLMConfig):
+    return partial(init, config=config)
+
+
+def make_loss_fn(config: GLMConfig, z_loss_weight: float = 0.0):
+    """Batches: {"input_ids", "labels"} (+ optional "prefix_len" [B]).
+    With prefix_len present, loss is typically masked to the generation
+    span via labels==-100 over the prefix (HF convention)."""
+
+    def loss_fn(params, batch, rng):
+        logits = apply(params, batch["input_ids"], config, rng,
+                       prefix_len=batch.get("prefix_len"))
+        return masked_lm_loss(logits, batch["labels"], z_loss_weight), {}
+
+    return loss_fn
+
+
+def param_count(config: GLMConfig) -> int:
+    return common_param_count(partial(init, config=config))

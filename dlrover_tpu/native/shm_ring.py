@@ -10,17 +10,13 @@ from __future__ import annotations
 
 import ctypes
 import errno
-import json
-import struct
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import tensor_codec
 from dlrover_tpu.native import load_library
-
-_HEADER_FMT = "<Q"  # meta-json byte length
-
 
 class RingClosed(Exception):
     """Producer closed the stream and every slot has been drained."""
@@ -31,38 +27,14 @@ class RingTimeout(Exception):
 
 
 def _pack_batch(batch: Dict[str, np.ndarray]) -> bytes:
-    """header(json meta) + concatenated C-contiguous array payloads."""
-    meta: List[Dict[str, Any]] = []
-    payloads: List[bytes] = []
-    for key in sorted(batch):
-        arr = np.ascontiguousarray(batch[key])
-        meta.append(
-            {"key": key, "dtype": arr.dtype.str, "shape": list(arr.shape)}
-        )
-        payloads.append(arr.tobytes())
-    meta_bytes = json.dumps(meta).encode()
-    return b"".join(
-        [struct.pack(_HEADER_FMT, len(meta_bytes)), meta_bytes, *payloads]
-    )
+    """Shared framework codec (``common.tensor_codec``): json manifest +
+    raw array bytes, no pickle on the hot path."""
+    return tensor_codec.pack_frame({}, batch)
 
 
 def _unpack_batch(buf: memoryview) -> Dict[str, np.ndarray]:
-    (meta_len,) = struct.unpack_from(_HEADER_FMT, buf, 0)
-    offset = struct.calcsize(_HEADER_FMT)
-    meta = json.loads(bytes(buf[offset:offset + meta_len]))
-    offset += meta_len
-    out = {}
-    for entry in meta:
-        dtype = np.dtype(entry["dtype"])
-        shape = tuple(entry["shape"])
-        nbytes = dtype.itemsize * int(np.prod(shape)) if shape else (
-            dtype.itemsize
-        )
-        arr = np.frombuffer(
-            buf[offset:offset + nbytes], dtype=dtype
-        ).reshape(shape)
-        out[entry["key"]] = arr.copy()  # own the memory; slot gets reused
-        offset += nbytes
+    # copy=True: the arrays must own their memory — the slot gets reused
+    _meta, out = tensor_codec.unpack_frame(buf, copy=True)
     return out
 
 

@@ -322,10 +322,7 @@ def flash_attention_sharded(
     counterpart is ``ops.ring_attention``."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     if head_axis is not None:
         sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))

@@ -113,7 +113,11 @@ def moe_ffn(
         config.router_jitter if train else 0.0,
     )
     # all-to-all #1: tokens -> expert queues (XLA inserts the collective
-    # when experts are mesh-sharded)
+    # when experts are mesh-sharded). The SPMD partitioner may log an
+    # "involuntary full rematerialization" for the [T,1,1] gate broadcast
+    # when dispatch/combine consumers want different T shardings — that
+    # tensor is tokens*4 bytes, so the replicate-and-repartition it falls
+    # back to is noise, not a bandwidth problem.
     expert_in = jnp.einsum(
         "tec,td->ecd", dispatch.astype(x.dtype), xt
     )  # [E, C, D]

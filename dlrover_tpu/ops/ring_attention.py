@@ -218,10 +218,7 @@ def ring_attention(
     Composes with the surrounding GSPMD program: batch stays sharded on the
     data axes, heads on the tensor axis, sequence on the ring axis.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     if head_axis is not None:
         # GQA kv heads must still divide the head mesh axis; when they

@@ -232,6 +232,33 @@ def clip_rules() -> ShardingRules:
     return llama_rules()
 
 
+def neox_rules() -> ShardingRules:
+    """GPT-NeoX / GLM family: llama's Megatron column/row layout plus the
+    bias vectors — a column-parallel projection's bias shards with its
+    output dim; a row-parallel projection's bias replicates (it adds after
+    the reduce)."""
+    return ShardingRules(rules=[
+        (r"layers/.*(q_proj|k_proj|v_proj|up_proj)/kernel$",
+         ("fsdp", None, "tensor")),
+        (r"layers/.*(q_proj|k_proj|v_proj|up_proj)/bias$",
+         ("fsdp", "tensor")),
+        (r"layers/.*(o_proj|down_proj)/kernel$", ("fsdp", "tensor", None)),
+        (r"layers/.*(o_proj|down_proj)/bias$", ("fsdp", None)),
+        (r"layers/.*(input_norm|post_norm)/(scale|bias)$", ("fsdp", None)),
+        (r"embed_tokens/embedding$", ("tensor", "fsdp")),
+        (r"(pos|block_pos)_embed/embedding$", (None, "fsdp")),
+        (r"lm_head/kernel$", ("fsdp", "tensor")),
+        (r"(norm|ln|final_norm)[^/]*/(scale|bias)$", REPLICATED),
+        (r".*", FSDP_AUTO),
+    ])
+
+
+def glm_rules() -> ShardingRules:
+    """GLM shares NeoX's biased-projection layout; the 2D position tables
+    get their own fsdp rule (in neox_rules already)."""
+    return neox_rules()
+
+
 def moe_rules() -> ShardingRules:
     """Expert-parallel MoE: expert weight blocks sharded on the expert
     (data x fsdp) submesh; router replicated."""

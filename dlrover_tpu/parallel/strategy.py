@@ -26,9 +26,11 @@ from dlrover_tpu.parallel.sharding_rules import (
     ShardingRules,
     bert_rules,
     clip_rules,
+    glm_rules,
     llama_pp_rules,
     llama_rules,
     moe_rules,
+    neox_rules,
 )
 
 RULE_SETS = {
@@ -38,6 +40,8 @@ RULE_SETS = {
     "moe": moe_rules,
     "bert": bert_rules,
     "clip": clip_rules,
+    "neox": neox_rules,
+    "glm": glm_rules,
 }
 
 

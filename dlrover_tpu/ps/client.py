@@ -60,7 +60,14 @@ class PsClusterClient:
         self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
         self._channels: Dict[int, grpc.Channel] = {}
         self._assignment: Dict[str, int] = {}
+        self._by_shard: Dict[int, List[str]] = {}  # shard -> ordered names
         self._known_version = 0  # master global cluster version we built on
+
+    def _set_assignment(self, assignment: Dict[str, int]) -> None:
+        self._assignment = assignment
+        self._by_shard = {}
+        for name in sorted(assignment):
+            self._by_shard.setdefault(assignment[name], []).append(name)
 
     # -- discovery ---------------------------------------------------------
 
@@ -152,23 +159,20 @@ class PsClusterClient:
 
     def init(self, params: Dict[str, np.ndarray]) -> None:
         specs = {n: int(a.nbytes) for n, a in params.items()}
-        self._assignment = partition_params(specs, self.num_shards)
-        frames = {}
-        for shard in range(self.num_shards):
-            group = {n: params[n] for n, s in self._assignment.items()
-                     if s == shard}
-            if group:
-                frames[shard] = wire.pack_frame({"op": "init"}, group)
+        self._set_assignment(partition_params(specs, self.num_shards))
+        frames = {
+            shard: wire.pack_frame(
+                {"op": "init"}, {n: params[n] for n in names})
+            for shard, names in self._by_shard.items()
+        }
         self._fanout(frames, "init")
 
     def pull(self) -> Tuple[Dict[str, np.ndarray], int]:
         """Fetch all params; returns (params, max shard version)."""
-        frames = {}
-        for shard in range(self.num_shards):
-            names = [n for n, s in self._assignment.items() if s == shard]
-            if names:
-                frames[shard] = wire.pack_frame(
-                    {"op": "pull", "names": names})
+        frames = {
+            shard: wire.pack_frame({"op": "pull", "names": names})
+            for shard, names in self._by_shard.items()
+        }
         out: Dict[str, np.ndarray] = {}
         version = 0
         for meta, tensors in self._fanout(frames, "pull").values():
@@ -179,9 +183,8 @@ class PsClusterClient:
     def push(self, grads: Dict[str, np.ndarray]) -> int:
         """Send grads to owning shards; PS applies updates server-side."""
         frames = {}
-        for shard in range(self.num_shards):
-            group = {n: grads[n] for n, s in self._assignment.items()
-                     if s == shard and n in grads}
+        for shard, names in self._by_shard.items():
+            group = {n: grads[n] for n in names if n in grads}
             if group:
                 frames[shard] = wire.pack_frame({"op": "push"}, group)
         version = 0
@@ -224,5 +227,5 @@ class PsClusterClient:
         # via checkpoint/restore before bumping the version.
         if self._assignment and \
                 max(self._assignment.values()) >= len(self._addrs):
-            self._assignment = {}
+            self._set_assignment({})
         return True

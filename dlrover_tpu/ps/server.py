@@ -12,8 +12,10 @@ their own push/pull round-trips.
 
 Updates run in numpy (C-level, no GIL-bound Python loops over elements),
 which is the honest host-side analogue of TF's C++ apply-ops. Grad staleness
-is inherent to async PS and is surfaced via the version counter so trainers
-can bound it (``AsyncPsTrainer.max_staleness``).
+is inherent to async PS: a worker's push lands on parameters other workers
+have advanced since its pull. The pull-compute-push cadence bounds it to one
+compute duration; the version counter in pull/push responses exposes it for
+monitoring.
 
 Checkpoint/restore is a single ``.npz`` per shard, so a migrated PS (master
 scale event) restores its slice and bumps the cluster version; workers
@@ -114,6 +116,13 @@ class PsShardServer:
     # -- rpc entry ---------------------------------------------------------
 
     def call(self, request: bytes, context=None) -> bytes:
+        try:
+            return self._dispatch(request)
+        except Exception as exc:  # keep the {'ok': False} error contract
+            logger.exception("PS shard %d op failed", self.shard_id)
+            return wire.pack_frame({"ok": False, "error": repr(exc)})
+
+    def _dispatch(self, request: bytes) -> bytes:
         meta, tensors = wire.unpack_frame(request)
         op = meta.get("op")
         if op == "init":
@@ -280,8 +289,11 @@ def start_ps_shard(shard_id: int, master_client=None,
             raise RuntimeError(f"PS shard {shard_id} restore failed: {meta}")
     addr = shard.start(port=port)
     if master_client is not None:
-        master_client.kv_store_set(f"ps/addr/{shard_id}", addr)
         if num_shards is not None:
-            # announce cluster size so discovery never adopts a partial list
+            # announce cluster size BEFORE the addr key: discovery keyed on
+            # ps/count must never observe addr keys without the count, or a
+            # worker racing registration adopts a partial list and computes
+            # a divergent placement
             master_client.kv_store_set("ps/count", str(num_shards))
+        master_client.kv_store_set(f"ps/addr/{shard_id}", addr)
     return shard

@@ -1,63 +1,16 @@
 """Binary tensor framing for the PS data plane.
 
 The control plane stays on the JSON dataclass codec (``common.serialize``);
-parameter pull/push moves megabytes of tensors per call, so it gets a raw
-binary frame instead: a JSON header (op, metadata, tensor manifest) followed
-by the concatenated array buffers. No base64, no copies beyond the single
-``b"".join``.
-
-Frame layout::
-
-    [4-byte big-endian header length][header JSON][buf0][buf1]...
-
-Header::
-
-    {"meta": {...}, "tensors": [{"name","dtype","shape","nbytes"}, ...]}
+parameter pull/push moves megabytes of tensors per call, so it uses the
+shared binary frame (``common.tensor_codec`` — same codec as the shm data
+ring, one implementation to keep bug-compatible).
 """
 
 from __future__ import annotations
 
-import json
-import struct
-from typing import Any, Dict, Tuple
+from dlrover_tpu.common.tensor_codec import pack_frame, unpack_frame
 
-import numpy as np
-
-_LEN = struct.Struct(">I")
-
-
-def pack_frame(meta: Dict[str, Any],
-               tensors: Dict[str, np.ndarray] | None = None) -> bytes:
-    tensors = tensors or {}
-    manifest = []
-    bufs = []
-    for name in sorted(tensors):
-        arr = np.ascontiguousarray(tensors[name])
-        manifest.append({
-            "name": name,
-            "dtype": arr.dtype.str,
-            "shape": list(arr.shape),
-            "nbytes": arr.nbytes,
-        })
-        bufs.append(arr.tobytes())
-    header = json.dumps({"meta": meta, "tensors": manifest}).encode()
-    return b"".join([_LEN.pack(len(header)), header] + bufs)
-
-
-def unpack_frame(frame: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
-    (hlen,) = _LEN.unpack_from(frame, 0)
-    header = json.loads(frame[4:4 + hlen].decode())
-    tensors: Dict[str, np.ndarray] = {}
-    offset = 4 + hlen
-    view = memoryview(frame)
-    for entry in header["tensors"]:
-        n = entry["nbytes"]
-        arr = np.frombuffer(
-            view[offset:offset + n], dtype=np.dtype(entry["dtype"])
-        ).reshape(entry["shape"])
-        tensors[entry["name"]] = arr
-        offset += n
-    return header["meta"], tensors
+__all__ = ["pack_frame", "unpack_frame", "identity"]
 
 
 def identity(b: bytes) -> bytes:

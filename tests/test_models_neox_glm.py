@@ -1,0 +1,164 @@
+"""GPT-NeoX and GLM families: architecture semantics, gradients, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.models import glm, gpt_neox
+from dlrover_tpu.parallel.accelerate import accelerate
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+
+
+class TestGPTNeoX:
+    def test_forward_shapes(self):
+        cfg = gpt_neox.neox_tiny()
+        params = gpt_neox.init(jax.random.PRNGKey(0), cfg)
+        logits = gpt_neox.apply(params, jnp.zeros((2, 16), jnp.int32), cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_partial_rotary_dims(self):
+        cfg = gpt_neox.neox_tiny()  # head_dim 16, pct 0.25
+        assert cfg.rotary_dims == 4
+        assert gpt_neox.neox_tiny(rotary_pct=1.0).rotary_dims == 16
+        assert gpt_neox.neox_tiny(rotary_pct=0.0).rotary_dims == 0
+
+    def test_rotary_gives_position_sensitivity(self):
+        # one attention layer is permutation-invariant over its (key,
+        # value) pairs, so WITHOUT any positional signal, permuting the
+        # context leaves the last position's logits unchanged; rotary must
+        # break that (multi-layer stacks lose the invariance through the
+        # causal mask on intermediate positions, hence num_layers=1)
+        ids = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        perm = jnp.asarray([[4, 2, 3, 1, 6, 5, 7, 8]], jnp.int32)
+
+        cfg_rot = gpt_neox.neox_tiny(num_layers=1)
+        params = gpt_neox.init(jax.random.PRNGKey(0), cfg_rot)
+        a = gpt_neox.apply(params, ids, cfg_rot)
+        b = gpt_neox.apply(params, perm, cfg_rot)
+        assert not np.allclose(np.asarray(a[0, -1]), np.asarray(b[0, -1]),
+                               atol=1e-6)
+
+        cfg_norot = gpt_neox.neox_tiny(num_layers=1, rotary_pct=0.0)
+        a = gpt_neox.apply(params, ids, cfg_norot)
+        b = gpt_neox.apply(params, perm, cfg_norot)
+        np.testing.assert_allclose(np.asarray(a[0, -1]),
+                                   np.asarray(b[0, -1]), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_parallel_vs_sequential_residual_differ(self):
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 8)))
+        p_cfg = gpt_neox.neox_tiny(use_parallel_residual=True)
+        s_cfg = gpt_neox.neox_tiny(use_parallel_residual=False)
+        params = gpt_neox.init(jax.random.PRNGKey(0), p_cfg)
+        out_p = gpt_neox.apply(params, ids, p_cfg)
+        out_s = gpt_neox.apply(params, ids, s_cfg)
+        assert not np.allclose(np.asarray(out_p), np.asarray(out_s))
+
+    def test_overfits_tiny_batch_sharded(self):
+        cfg = gpt_neox.neox_tiny()
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 16)))
+        batch = {"input_ids": ids, "labels": ids}
+        result = accelerate(
+            gpt_neox.make_init_fn(cfg), gpt_neox.make_loss_fn(cfg),
+            optax.adam(1e-3), batch,
+            strategy=Strategy(mesh=MeshPlan(data=2, fsdp=2, tensor=2),
+                              rule_set="neox"),
+        )
+        state = result.init_fn(jax.random.PRNGKey(0))
+        sb = result.shard_batch(batch)
+        losses = []
+        for i in range(15):
+            state, m = result.train_step(state, sb, jax.random.PRNGKey(i))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] * 0.7
+
+
+class TestGLM:
+    def test_forward_shapes_causal(self):
+        cfg = glm.glm_tiny()
+        params = glm.init(jax.random.PRNGKey(0), cfg)
+        logits = glm.apply(params, jnp.zeros((2, 16), jnp.int32), cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_glm_positions(self):
+        pos, block = glm.glm_positions(6, jnp.asarray([3, 0]))
+        np.testing.assert_array_equal(
+            np.asarray(pos), [[0, 1, 2, 3, 3, 3], [0, 0, 0, 0, 0, 0]])
+        np.testing.assert_array_equal(
+            np.asarray(block), [[0, 0, 0, 1, 2, 3], [1, 2, 3, 4, 5, 6]])
+
+    def test_prefix_lm_bias_matches_bruteforce(self):
+        s, p = 5, 3
+        bias = np.asarray(glm.prefix_lm_bias(s, jnp.asarray([p])))[0, 0]
+        for i in range(s):
+            for j in range(s):
+                allowed = (j < p) or (j <= i)
+                assert (bias[i, j] == 0.0) == allowed, (i, j)
+
+    def test_prefix_is_bidirectional_causal_tail_is_not(self):
+        cfg = glm.glm_tiny()
+        params = glm.init(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8)))
+        ids2 = ids.at[0, 2].set((ids[0, 2] + 1) % cfg.vocab_size)
+
+        # prefix_len=4: editing token 2 (inside the prefix) must change
+        # position 0's output — the prefix attends bidirectionally
+        p4 = jnp.asarray([4])
+        out_a = glm.apply(params, ids, cfg, prefix_len=p4)
+        out_b = glm.apply(params, ids2, cfg, prefix_len=p4)
+        assert not np.allclose(np.asarray(out_a[0, 0]),
+                               np.asarray(out_b[0, 0]), atol=1e-6)
+
+        # editing token 6 (in the causal tail) must NOT change position 0
+        ids3 = ids.at[0, 6].set((ids[0, 6] + 1) % cfg.vocab_size)
+        out_c = glm.apply(params, ids3, cfg, prefix_len=p4)
+        np.testing.assert_allclose(np.asarray(out_a[0, 0]),
+                                   np.asarray(out_c[0, 0]), rtol=1e-5)
+
+    def test_zero_prefix_is_strictly_causal(self):
+        # prefix_len=0 uses GLM's generation-span positions (pos frozen at
+        # 0, block positions 1..S — intentionally NOT the same encoding as
+        # prefix_len=None plain causal LM) but the mask must be strictly
+        # causal: editing a later token cannot change an earlier position
+        cfg = glm.glm_tiny()
+        params = glm.init(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (1, 8)))
+        ids2 = ids.at[0, 6].set((ids[0, 6] + 1) % cfg.vocab_size)
+        zero = jnp.zeros((1,), jnp.int32)
+        out_a = glm.apply(params, ids, cfg, prefix_len=zero)
+        out_b = glm.apply(params, ids2, cfg, prefix_len=zero)
+        np.testing.assert_allclose(np.asarray(out_a[0, :6]),
+                                   np.asarray(out_b[0, :6]), rtol=1e-5)
+
+    def test_overfits_prefix_batch_sharded(self):
+        cfg = glm.glm_tiny()
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 16)))
+        prefix = jnp.asarray([4, 4, 4, 4], jnp.int32)
+        # loss only over the generation span (HF -100 convention)
+        mask = jnp.arange(16)[None, :] >= prefix[:, None]
+        labels = jnp.where(mask, ids, -100)
+        batch = {"input_ids": ids, "labels": labels, "prefix_len": prefix}
+        result = accelerate(
+            glm.make_init_fn(cfg), glm.make_loss_fn(cfg),
+            optax.adam(1e-3), batch,
+            strategy=Strategy(mesh=MeshPlan(data=2, fsdp=2, tensor=2),
+                              rule_set="glm"),
+        )
+        state = result.init_fn(jax.random.PRNGKey(0))
+        sb = result.shard_batch(batch)
+        losses = []
+        for i in range(15):
+            state, m = result.train_step(state, sb, jax.random.PRNGKey(i))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_param_counts(self):
+        assert glm.param_count(glm.glm_tiny()) > 0
+        assert gpt_neox.param_count(gpt_neox.neox_tiny()) > 0
