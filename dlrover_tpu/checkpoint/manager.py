@@ -401,6 +401,7 @@ class ElasticCheckpointManager:
         {...}, "shard_checkpoint": str}, or None if no checkpoint exists.
         """
         staging_only = False
+        explicit_step = step is not None
         if step is None:
             try:
                 step = self.latest_step()
@@ -444,10 +445,60 @@ class ElasticCheckpointManager:
                 "primary; treating as no checkpoint", step,
             )
             return None
-        out = self._restore_from(self.directory, step, abstract_state)
+        try:
+            out = self._restore_from(self.directory, step, abstract_state)
+        except Exception:  # noqa: BLE001 — torn/corrupt latest step
+            if explicit_step:
+                raise
+            # auto-selected latest failed (partial write, bit corruption):
+            # a recovering job must come back from the newest GOOD step,
+            # not crash on the bad one
+            older = sorted(
+                (s for s in self._manager.all_steps() if s < step),
+                reverse=True,
+            )
+            logger.exception(
+                "restore of latest step %d failed; trying older steps %s",
+                step, older,
+            )
+            for s in older:
+                try:
+                    out = self._restore_from(self.directory, s,
+                                             abstract_state)
+                    logger.warning(
+                        "restored OLDER checkpoint step=%d (latest %d "
+                        "unreadable)", s, step,
+                    )
+                    self._quarantine_step(step)
+                    return out
+                except Exception:  # noqa: BLE001 — keep walking back
+                    logger.exception("restore of step %d also failed", s)
+            raise
         logger.info("restored checkpoint step=%d from %s", step,
                     self.directory)
         return out
+
+    def _quarantine_step(self, step: int) -> None:
+        """Move an unreadable step dir aside after a successful fallback.
+
+        Left in place, the corrupt dir keeps winning latest_step() (every
+        restart repeats the failed walk) and — worse — Orbax refuses to
+        save any step <= the existing latest, so the resumed job's re-save
+        at that step number would be silently dropped and progress past
+        the fallback step repeatedly lost."""
+        src = self._step_dir(self.directory, step)
+        dst = os.path.join(self.directory,
+                           f"corrupt-{step}-{int(time.time())}")
+        try:
+            os.replace(src, dst)
+            logger.warning("quarantined unreadable step %d -> %s", step, dst)
+        except OSError:
+            logger.exception("could not quarantine step %d", step)
+            return
+        try:
+            self._manager.reload()  # drop the cached step listing
+        except Exception:  # noqa: BLE001 — cache refresh is best-effort
+            logger.exception("orbax reload after quarantine failed")
 
     def _restore_from(
         self, root: str, step: int, abstract_state: Any

@@ -1,0 +1,166 @@
+"""Deterministic fault injection for elasticity/recovery testing.
+
+Role parity: the reference snapshot has no dedicated chaos framework —
+its tests simulate faults with mocks and canned events (SURVEY §4/§5);
+later DLRover versions grew one because mocked faults miss integration
+bugs (a SIGKILLed process and a raised exception exercise different
+recovery paths). This module injects *real* faults into *real* runs:
+
+- ``kill_workers``       — SIGKILL live worker subprocesses (not a polite
+  exception: the process dies mid-syscall, exactly like an OOM kill or a
+  preemption).
+- ``FlakyChannel``       — wraps an ``rpc.client.RpcChannel`` and fails a
+  seeded, deterministic fraction of calls with UNAVAILABLE, exercising
+  the retry decorators instead of bypassing them.
+- ``corrupt_checkpoint`` — truncates (torn-write) or bit-flips the array
+  payload of a checkpoint step, exercising restore fallback to the
+  newest good step + quarantine of the bad one.
+
+Everything is seeded/counted — a chaos test that cannot reproduce its
+failure is worse than no test.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+from typing import Iterable, List, Optional
+
+import grpc
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("diagnosis.chaos")
+
+
+# ---------------------------------------------------------------------------
+# process faults
+# ---------------------------------------------------------------------------
+
+def kill_workers(pids: Iterable[int], sig: int = signal.SIGKILL) -> List[int]:
+    """SIGKILL the given pids; returns those actually signalled."""
+    killed = []
+    for pid in pids:
+        try:
+            os.kill(pid, sig)
+            killed.append(pid)
+            logger.info("chaos: sent signal %d to pid %d", sig, pid)
+        except ProcessLookupError:
+            pass
+    return killed
+
+
+# ---------------------------------------------------------------------------
+# rpc faults
+# ---------------------------------------------------------------------------
+
+class _InjectedUnavailable(grpc.RpcError):
+    """Transient failure as the retry layer sees it."""
+
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self):
+        return "chaos: injected UNAVAILABLE"
+
+
+class _FlakyCallable:
+    """Decorates a raw grpc unary callable with seeded failures.
+
+    A failure raises *before* the real call for half the hits and *after*
+    it for the other half — the latter models "the master applied my
+    report but I never saw the ack", the case that catches non-idempotent
+    handlers.
+    """
+
+    def __init__(self, inner, rng: random.Random, drop_rate: float,
+                 stats: "FlakyStats"):
+        self._inner = inner
+        self._rng = rng
+        self._drop = drop_rate
+        self._stats = stats
+
+    def __call__(self, *args, **kwargs):
+        pre = self._rng.random() < self._drop
+        post = not pre and self._rng.random() < self._drop
+        if pre:
+            self._stats.injected += 1
+            raise _InjectedUnavailable()
+        out = self._inner(*args, **kwargs)
+        if post:
+            self._stats.injected += 1
+            raise _InjectedUnavailable()
+        return out
+
+
+class FlakyStats:
+    injected = 0
+
+
+def make_flaky(channel, drop_rate: float = 0.3, seed: int = 0) -> FlakyStats:
+    """Patch an ``RpcChannel`` in place so its raw grpc callables fail a
+    deterministic fraction of the time. Injects BELOW the ``retry_rpc``
+    decorator (which wraps ``channel.get/report``), so the production
+    retry path is what absorbs the faults. Returns the stats counter."""
+    stats = FlakyStats()
+    rng = random.Random(seed)
+    channel._get = _FlakyCallable(channel._get, rng, drop_rate, stats)
+    channel._report = _FlakyCallable(channel._report, rng, drop_rate, stats)
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# storage faults
+# ---------------------------------------------------------------------------
+
+def corrupt_checkpoint(step_dir: str, mode: str = "truncate",
+                       nbytes: int = 64, seed: int = 0) -> Optional[str]:
+    """Damage the largest data file under a checkpoint step directory.
+
+    ``mode="truncate"`` cuts the file to half (the torn-write model — a
+    killed writer leaves a short file, and reads fail loudly).
+    ``mode="flip"`` XORs ``nbytes`` random bytes (bitrot model; note
+    formats without payload checksums may read flipped bytes back
+    silently). Returns the corrupted path, or None if no file found.
+    Metadata files are skipped — the target is the array payload."""
+    candidates = []
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            # skip metadata: damaged metadata is merely logged by readers;
+            # the torn-write target is the array payload
+            if name.endswith((".json", ".txt")) or name.startswith((".", "_")):
+                continue
+            if "METADATA" in name.upper() or "manifest" in name.lower():
+                continue
+            path = os.path.join(root, name)
+            candidates.append((os.path.getsize(path), path))
+    if not candidates:
+        return None
+    if mode == "truncate":
+        # a writer killed mid-flush leaves MANY short files (ocdbt spreads
+        # one array over several data files) — truncate all of them
+        for size, path in candidates:
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+        logger.info("chaos: truncated %d files under %s", len(candidates),
+                    step_dir)
+        return max(candidates)[1]
+    _, path = max(candidates)
+    size = os.path.getsize(path)
+    rng = random.Random(seed)
+    with open(path, "r+b") as f:
+        for _ in range(min(nbytes, size)):
+            off = rng.randrange(size)
+            f.seek(off)
+            byte = f.read(1)
+            f.seek(off)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    logger.info("chaos: flipped %d bytes of %s", nbytes, path)
+    return path
+
+
+# NaN injection intentionally lives in the executor tests, not here: the
+# guardrail tests (tests/test_executor.py) poison a batch directly
+# (x * jnp.nan), which needs no side-channel contract with the jitted
+# step. A loss-wrapper injector was removed for that reason.

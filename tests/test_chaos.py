@@ -1,0 +1,170 @@
+"""Chaos tests: REAL faults (SIGKILL, flaky rpc, torn checkpoint) against
+real components — the integration layer mocked-fault unit tests miss.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training_agent import (
+    AgentConfig,
+    ElasticTrainingAgent,
+)
+from dlrover_tpu.agent.worker_group import WorkerSpec
+from dlrover_tpu.diagnosis.fault_injection import (
+    corrupt_checkpoint,
+    kill_workers,
+    make_flaky,
+)
+from dlrover_tpu.master.local_master import start_local_master
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER_ENV = {
+    "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+
+@pytest.fixture()
+def master():
+    m = start_local_master()
+    yield m
+    m.stop()
+
+
+def test_external_sigkill_triggers_restart(master):
+    """A worker killed from OUTSIDE (SIGKILL, like an OOM killer or
+    preemption — not a polite exception) must be detected by the monitor
+    loop and restarted within the budget."""
+    client = MasterClient(master.addr, node_id=0)
+    config = AgentConfig(
+        node_rank=0, node_id=0, nproc_per_node=1, min_nodes=1, max_nodes=1,
+        max_restarts=2, monitor_interval=0.2, rdzv_waiting_timeout=5.0,
+    )
+    spec = WorkerSpec(
+        entrypoint=os.path.join(TESTDATA, "chaos_worker.py"),
+        nproc_per_node=1, env=dict(WORKER_ENV),
+    )
+    agent = ElasticTrainingAgent(config, spec, client, host_ip="127.0.0.1")
+
+    result = {}
+    thread = threading.Thread(
+        target=lambda: result.update(rc=agent.run()), daemon=True
+    )
+    thread.start()
+
+    # wait for the round-0 worker process, then SIGKILL it
+    deadline = time.monotonic() + 30
+    pids = []
+    while time.monotonic() < deadline:
+        procs = getattr(agent._worker_group, "_procs", [])
+        pids = [p.pid for p in procs if p.poll() is None]
+        if pids:
+            break
+        time.sleep(0.1)
+    assert pids, "worker never spawned"
+    assert kill_workers(pids)
+
+    thread.join(timeout=60)
+    assert not thread.is_alive(), "agent did not finish after chaos kill"
+    assert result["rc"] == 0
+    assert agent._worker_group.restart_round >= 1
+
+
+def test_flaky_rpc_absorbed_by_retries(master):
+    """Inject UNAVAILABLE below the retry decorator on a deterministic
+    fraction of calls; the dynamic-sharding flow must still complete."""
+    client = MasterClient(master.addr, node_id=0)
+    stats = make_flaky(client._channel, drop_rate=0.25, seed=7)
+
+    client.report_dataset_shard_params(
+        dataset_name="chaos_ds", dataset_size=24, batch_size=3,
+        num_epochs=1, num_minibatches_per_shard=2,
+    )
+    # a post-call injected fault on get_task LOSES the response: the shard
+    # sits in "doing" until the timeout monitor requeues it. Drive that
+    # recovery deterministically (timeout=0 == one monitor tick) between
+    # drain rounds — completion must survive both fault modes.
+    done = 0
+    for _attempt in range(6):
+        while True:
+            task = client.get_task("chaos_ds")
+            if task is None or task.task_id < 0:
+                break
+            client.report_task_result("chaos_ds", task.task_id)
+            done += 1
+        if done >= 4:
+            break
+        dataset = master.task_manager.get_dataset("chaos_ds")
+        dataset.recover_timeout_tasks(0)
+    assert done == 4  # 24 records / (3*2) per shard, every shard completed
+    assert stats.injected > 0, "no faults were actually injected"
+    client.close()
+
+
+def test_corrupt_latest_checkpoint_falls_back(tmp_path):
+    """Torn-write the newest checkpoint; restore must come back from the
+    newest GOOD step instead of crashing."""
+    from dlrover_tpu.checkpoint.manager import (
+        ElasticCheckpointManager,
+        abstract_like,
+    )
+
+    mgr = ElasticCheckpointManager(
+        str(tmp_path / "ckpt"), async_save=False, staging_dir="",
+    )
+    state = {"w": jnp.full((64, 64), 1.0), "step": jnp.asarray(1)}
+    assert mgr.save(1, state, force=True)
+    state2 = {"w": jnp.full((64, 64), 2.0), "step": jnp.asarray(2)}
+    assert mgr.save(2, state2, force=True)
+    mgr.wait()
+
+    step2_dir = mgr._step_dir(mgr.directory, 2)
+    assert os.path.isdir(step2_dir)
+    assert corrupt_checkpoint(step2_dir, mode="truncate") is not None
+
+    out = mgr.restore(abstract_like(state))
+    assert out is not None
+    assert out["step"] == 1
+    np.testing.assert_allclose(np.asarray(out["state"]["w"]), 1.0)
+
+    # the corrupt step must be quarantined: otherwise it keeps winning
+    # latest_step() and blocks the resumed job's re-save at step 2
+    assert mgr.latest_step() == 1
+    assert not os.path.isdir(step2_dir)
+    assert mgr.save(2, state2, force=True), (
+        "re-save at the quarantined step number must be accepted"
+    )
+    mgr.wait()
+    assert mgr.latest_step() == 2
+    out2 = mgr.restore(abstract_like(state))
+    assert out2["step"] == 2
+    np.testing.assert_allclose(np.asarray(out2["state"]["w"]), 2.0)
+    mgr.close()
+
+
+def test_explicit_step_restore_still_raises_on_corruption(tmp_path):
+    """Fallback only applies to auto-selected steps: explicitly asking for
+    a specific (corrupt) step must fail loudly, not silently substitute."""
+    from dlrover_tpu.checkpoint.manager import (
+        ElasticCheckpointManager,
+        abstract_like,
+    )
+
+    mgr = ElasticCheckpointManager(
+        str(tmp_path / "ckpt"), async_save=False, staging_dir="",
+    )
+    state = {"w": jnp.full((64, 64), 1.0)}
+    assert mgr.save(1, state, force=True)
+    assert mgr.save(2, {"w": jnp.full((64, 64), 2.0)}, force=True)
+    mgr.wait()
+    corrupt_checkpoint(mgr._step_dir(mgr.directory, 2), mode="truncate")
+    with pytest.raises(Exception):
+        mgr.restore(abstract_like(state), step=2)
+    mgr.close()

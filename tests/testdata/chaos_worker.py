@@ -1,0 +1,17 @@
+"""E2E chaos worker: runs long enough to be SIGKILLed from outside on its
+first launch; finishes quickly after the agent restarts it."""
+
+import os
+import sys
+import time
+
+from dlrover_tpu.common.constants import NodeEnv
+
+restart_round = int(os.environ.get(NodeEnv.RESTART_ROUND, "0"))
+if restart_round == 0:
+    print("chaos worker: round 0, running slow (kill me)", flush=True)
+    for _ in range(100):  # ~20 s — the test kills us long before
+        time.sleep(0.2)
+    sys.exit(0)
+print(f"chaos worker: round {restart_round}, finishing", flush=True)
+sys.exit(0)
