@@ -450,6 +450,28 @@ class ElasticCheckpointManager:
         except Exception:  # noqa: BLE001 — torn/corrupt latest step
             if explicit_step:
                 raise
+            # before dropping to an older step: the host-DRAM mirror may
+            # hold a readable copy of EXACTLY this step (the digest gate
+            # above compares against the now-corrupt primary, so it
+            # rejected the mirror for the wrong reason). Provenance still
+            # must match — a stale mirror from another job must not win.
+            if (
+                self._staging_root is not None
+                and self.staged_step() == step
+                and self._staging_provenance_valid()
+            ):
+                try:
+                    out = self._restore_from(self._staging_root, step,
+                                             abstract_state)
+                    logger.warning(
+                        "primary step %d unreadable; restored the SAME "
+                        "step from host-DRAM staging", step,
+                    )
+                    self._quarantine_step(step)
+                    return out
+                except Exception:  # noqa: BLE001 — mirror also bad
+                    logger.exception(
+                        "staged copy of step %d also unreadable", step)
             # auto-selected latest failed (partial write, bit corruption):
             # a recovering job must come back from the newest GOOD step,
             # not crash on the bad one

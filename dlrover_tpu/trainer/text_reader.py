@@ -1,0 +1,152 @@
+"""Text-file readers fed by the dynamic shard service.
+
+Role parity: ``dlrover/trainer/tensorflow/reader/file_reader.py`` (an
+ElasticReader exposing ``count_data`` + ``read_data_by_index_range``, fed
+shard index ranges by the sharding client) — re-designed for the jax
+training loop: the reader maps *record indices* to fixed-shape token
+batches, so the master stays on the per-shard path and the device sees
+static shapes only.
+
+- ``LineIndexedFile``: one pass builds a byte-offset index; thereafter any
+  index range is a seek+read, so workers can consume shards in any order
+  (dynamic sharding's whole point: fast workers get more shards).
+- ``ByteTokenizer``: zero-dependency byte-level tokenizer (vocab 256 +
+  pad/bos), fixed ``seq_len`` per record — honest tokenization for tests
+  and examples without shipping a vocab file; swap in any callable with
+  the same signature for real vocabularies.
+- ``ShardedTextBatches``: glues a ShardingClient to the reader — fetch
+  shard, render [B, S] batches, report batch/task completion. Shard
+  checkpoint/restore comes for free from the master.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("trainer.text")
+
+
+class LineIndexedFile:
+    """Random access to a text file by line index."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # start offset of each line; Python's line iteration never yields
+        # a phantom record for a trailing newline
+        self._starts: List[int] = []
+        offset = 0
+        with open(path, "rb") as f:
+            for line in f:
+                self._starts.append(offset)
+                offset += len(line)
+        self._size = offset
+
+    def count(self) -> int:
+        """Number of records (reference: ``FileReader.count_data``)."""
+        return len(self._starts)
+
+    def read_range(self, start: int, end: int) -> List[bytes]:
+        """Records in [start, end) (reference:
+        ``read_data_by_index_range``)."""
+        end = min(end, self.count())
+        if start >= end:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self._starts[start])
+            out = []
+            for i in range(start, end):
+                upper = (self._starts[i + 1] if i + 1 < self.count()
+                         else self._size)
+                raw = f.read(upper - self._starts[i])
+                out.append(raw.rstrip(b"\r\n"))
+        return out
+
+    def read_indices(self, indices: List[int]) -> List[bytes]:
+        """Records at arbitrary indices, in the given order (shuffled
+        shards carry an explicit permutation). Contiguous runs are read
+        with one seek."""
+        out: List[bytes] = []
+        i = 0
+        while i < len(indices):
+            j = i
+            while j + 1 < len(indices) and \
+                    indices[j + 1] == indices[j] + 1:
+                j += 1
+            out.extend(self.read_range(indices[i], indices[j] + 1))
+            i = j + 1
+        return out
+
+
+class ByteTokenizer:
+    """Byte-level ids in [2, 257]; 0 = pad, 1 = bos. Fixed length."""
+
+    vocab_size = 258
+
+    def __init__(self, seq_len: int):
+        self.seq_len = seq_len
+
+    def __call__(self, record: bytes) -> np.ndarray:
+        ids = np.frombuffer(record[: self.seq_len - 1], np.uint8)
+        out = np.zeros((self.seq_len,), np.int32)
+        out[0] = 1  # bos
+        out[1:1 + len(ids)] = ids.astype(np.int32) + 2
+        return out
+
+
+class ShardedTextBatches:
+    """Dynamic-shard consumption loop over a line-indexed text file.
+
+    Yields ``{"input_ids": [B, S], "labels": [B, S]}`` numpy batches
+    (labels = inputs shifted left, pad masked to -100). The master hands
+    out index shards; batch rendering happens worker-side, so the master
+    is never on the per-batch path.
+    """
+
+    def __init__(
+        self,
+        sharding_client,
+        reader: LineIndexedFile,
+        batch_size: int,
+        tokenizer: Optional[Callable[[bytes], np.ndarray]] = None,
+        seq_len: int = 128,
+    ):
+        self._client = sharding_client
+        self._reader = reader
+        self._batch = batch_size
+        self._tok = tokenizer or ByteTokenizer(seq_len)
+
+    def _render(self, records: List[bytes]) -> dict:
+        ids = np.stack([self._tok(r) for r in records])
+        labels = np.full_like(ids, -100)
+        labels[:, :-1] = ids[:, 1:]
+        labels[labels == 0] = -100  # don't train on pad
+        return {"input_ids": ids, "labels": labels}
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            shard = self._client.fetch_shard()
+            if shard is None:
+                return
+            if shard.record_indices:
+                # shuffled datasets: the master's shard carries an
+                # explicit permutation — honor it, or "shuffle=True"
+                # would silently train on contiguous ranges
+                records = self._reader.read_indices(
+                    list(shard.record_indices))
+            else:
+                records = self._reader.read_range(shard.start, shard.end)
+            for lo in range(0, len(records), self._batch):
+                chunk = records[lo:lo + self._batch]
+                if len(chunk) < self._batch:
+                    # pad the tail batch to a static shape (XLA: one
+                    # compiled program) by repeating the last record
+                    chunk = chunk + [chunk[-1]] * (
+                        self._batch - len(chunk))
+                yield self._render(chunk)
+                self._client.report_batch_done()
+            self._client.report_task_done()

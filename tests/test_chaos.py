@@ -149,6 +149,73 @@ def test_corrupt_latest_checkpoint_falls_back(tmp_path):
     mgr.close()
 
 
+def test_corrupt_primary_recovers_same_step_from_staging(tmp_path):
+    """When the primary copy of the latest step is torn but the host-DRAM
+    mirror still holds that step (digest gate rejects it only because the
+    PRIMARY is now corrupt), the fallback must restore the SAME step from
+    staging — losing zero progress — and quarantine the bad primary."""
+    from dlrover_tpu.checkpoint.manager import (
+        ElasticCheckpointManager,
+        abstract_like,
+    )
+
+    mgr = ElasticCheckpointManager(
+        str(tmp_path / "ckpt"), async_save=False,
+        staging_dir=str(tmp_path / "shm"),
+    )
+    state1 = {"w": jnp.full((64, 64), 1.0), "step": jnp.asarray(1)}
+    state2 = {"w": jnp.full((64, 64), 2.0), "step": jnp.asarray(2)}
+    assert mgr.save(1, state1, force=True)
+    mgr.wait()
+    assert mgr.save(2, state2, force=True)
+    mgr.wait()
+    assert mgr.staged_step() == 2
+
+    corrupt_checkpoint(mgr._step_dir(mgr.directory, 2), mode="truncate")
+    out = mgr.restore(abstract_like(state1))
+    assert out is not None
+    assert out["step"] == 2, "staging held step 2 — no progress loss"
+    np.testing.assert_allclose(np.asarray(out["state"]["w"]), 2.0)
+    assert not os.path.isdir(mgr._step_dir(mgr.directory, 2))
+    mgr.close()
+
+
+def test_shuffled_text_shards_honor_permutation(tmp_path):
+    """A shuffled text dataset's shards carry record_indices; the batch
+    source must train on that permutation, not contiguous ranges."""
+    from dlrover_tpu.trainer.text_reader import (
+        LineIndexedFile,
+        ShardedTextBatches,
+    )
+    from dlrover_tpu.agent.sharding_client import ShardingClient
+
+    path = tmp_path / "c.txt"
+    path.write_text("".join(f"rec{i:03d}\n" for i in range(32)))
+    reader = LineIndexedFile(str(path))
+
+    m = start_local_master()
+    try:
+        client = MasterClient(m.addr, node_id=0)
+        sc = ShardingClient(
+            client, dataset_name="shuf", batch_size=4,
+            dataset_size=reader.count(), num_epochs=1,
+            num_minibatches_per_shard=1, shuffle=True,
+            storage_type="text",
+        )
+        source = ShardedTextBatches(sc, reader, batch_size=4, seq_len=16)
+        seen = []
+        for batch in source:
+            for row in batch["input_ids"]:
+                chars = bytes(int(t) - 2 for t in row[1:] if t >= 2)
+                seen.append(chars.decode())
+        # every record consumed exactly once, and NOT in file order
+        assert sorted(set(seen)) == [f"rec{i:03d}" for i in range(32)]
+        assert seen != sorted(seen), "shuffle produced file order?"
+        client.close()
+    finally:
+        m.stop()
+
+
 def test_explicit_step_restore_still_raises_on_corruption(tmp_path):
     """Fallback only applies to auto-selected steps: explicitly asking for
     a specific (corrupt) step must fail loudly, not silently substitute."""
