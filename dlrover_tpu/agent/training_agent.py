@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import subprocess
 import sys
+import tempfile
 import time
 from dataclasses import dataclass
 from typing import List, Optional
@@ -55,6 +56,16 @@ class AgentConfig:
     rdzv_waiting_timeout: float = 30.0
     network_check: bool = False
     probe_platform: str = ""  # '' = process default (tpu in prod, cpu tests)
+    # > 0 enables hang-relaunch (reference --relaunch_on_hanging): when no
+    # worker heartbeat lands for this many seconds while processes are
+    # still alive (a collective blocked on a dead peer), restart workers
+    hang_timeout: float = 0.0
+    # extra allowance before the FIRST beat of a round: the initial XLA
+    # compile (+ checkpoint restore) happens inside the first step, where
+    # the worker has no opportunity to beat — without this grace a slow
+    # compile looks like a hang and restarts burn the budget on a
+    # healthy job (each round recompiling into the same false flag)
+    hang_first_beat_grace: float = 600.0
 
 
 class ElasticTrainingAgent:
@@ -63,6 +74,8 @@ class ElasticTrainingAgent:
                  host_ip: Optional[str] = None):
         self._config = config
         self._client = master_client
+        if config.hang_timeout > 0 and not spec.heartbeat_dir:
+            spec.heartbeat_dir = tempfile.mkdtemp(prefix="dlrover_hb_")
         self._worker_group = WorkerGroup(spec)
         self._rdzv_handler = MasterRendezvousHandler(
             master_client,
@@ -130,10 +143,49 @@ class ElasticTrainingAgent:
                 logger.error("restart budget exhausted; giving up")
                 self._client.report_node_status(NodeStatus.FAILED)
                 return 1
+            # healthy processes can still be HUNG (the TPU failure mode: a
+            # collective waiting forever on a dead peer keeps every
+            # process alive while the step loop is frozen)
+            hang_gap = self._hang_gap()
+            if hang_gap is not None:
+                self._report_hang(hang_gap)
+                if self._remaining_restarts > 0:
+                    self._remaining_restarts -= 1
+                    self._restart_workers()
+                    continue
+                logger.error("hang detected and restart budget exhausted")
+                self._client.report_node_status(NodeStatus.FAILED)
+                return 1
             # healthy: check whether membership changed (new/rejoined nodes
             # waiting) and restart into a bigger/smaller world if so.
             if self._membership_changed():
                 self._restart_workers()
+
+    def _hang_gap(self) -> Optional[float]:
+        """Stale-heartbeat gap in seconds, or None if healthy/disabled.
+        Measured once so the report matches what triggered the restart."""
+        if self._config.hang_timeout <= 0:
+            return None
+        latest, beaten = self._worker_group.latest_heartbeat()
+        allowed = self._config.hang_timeout
+        if not beaten:
+            # first window of the round: compile/restore runs inside the
+            # first step, so the worker cannot beat yet
+            allowed += self._config.hang_first_beat_grace
+        gap = time.time() - latest
+        return gap if gap > allowed else None
+
+    def _report_hang(self, gap: float):
+        logger.error(
+            "no worker heartbeat for %.1f s (timeout %.1f s): treating "
+            "as hang", gap, self._config.hang_timeout,
+        )
+        self._client.report_failure(
+            node_rank=self._config.node_rank,
+            restart_count=self._worker_group.restart_round,
+            error_data=f"hang: no heartbeat for {gap:.1f}s",
+            level=TrainingExceptionLevel.NODE_ERROR,
+        )
 
     def _membership_changed(self) -> bool:
         try:

@@ -42,6 +42,7 @@ class WorkerSpec:
     nproc_per_node: int = 1
     env: Dict[str, str] = field(default_factory=dict)
     redirect_output: Optional[str] = None  # directory for per-rank logs
+    heartbeat_dir: str = ""  # exported for hang-relaunch (agent sets it)
 
 
 @dataclass
@@ -58,6 +59,28 @@ class WorkerGroup:
         self._log_files: List = []
         self.state = WorkerGroupState.INIT
         self.restart_round = 0
+        self.started_at = time.time()
+
+    def latest_heartbeat(self) -> "Tuple[float, bool]":
+        """(newest beat unix time, whether any beat landed this round).
+        The spawn time floors the value so a fresh round isn't judged by
+        the previous round's stale files; the flag lets the agent allow a
+        longer first window (XLA compile happens inside the first step,
+        with no Python-side opportunity to beat)."""
+        latest = self.started_at
+        beaten = False
+        d = self.spec.heartbeat_dir
+        if d and os.path.isdir(d):
+            for name in os.listdir(d):
+                if name.startswith("hb_"):
+                    try:
+                        mtime = os.path.getmtime(os.path.join(d, name))
+                    except OSError:
+                        continue
+                    if mtime > self.started_at:
+                        beaten = True
+                        latest = max(latest, mtime)
+        return latest, beaten
 
     def start(self, rdzv: RendezvousInfo, master_addr: str, node_id: int):
         """Spawn ``nproc_per_node`` processes with SPMD coordinates."""
@@ -68,9 +91,14 @@ class WorkerGroup:
         self.stop()
         self._procs = []
         self._log_files = []
+        self.started_at = time.time()
+        if self.spec.heartbeat_dir:
+            os.makedirs(self.spec.heartbeat_dir, exist_ok=True)
         for local_rank in range(self.spec.nproc_per_node):
             env = dict(os.environ)
             env.update(self.spec.env)
+            if self.spec.heartbeat_dir:
+                env[NodeEnv.HEARTBEAT_DIR] = self.spec.heartbeat_dir
             env.update({
                 NodeEnv.MASTER_ADDR: master_addr,
                 NodeEnv.NODE_ID: str(node_id),

@@ -417,6 +417,7 @@ class ElasticCheckpointManager:
                 staging_only = step is not None
         if step is None:
             return None
+        staged_already_failed = False
         if (
             self._staging_root is not None
             and self.staged_step() == step
@@ -431,6 +432,7 @@ class ElasticCheckpointManager:
                 )
                 return out
             except Exception:  # noqa: BLE001 — fall back to the real dir
+                staged_already_failed = True
                 logger.exception(
                     "staged restore failed; falling back to %s",
                     self.directory,
@@ -456,7 +458,8 @@ class ElasticCheckpointManager:
             # rejected the mirror for the wrong reason). Provenance still
             # must match — a stale mirror from another job must not win.
             if (
-                self._staging_root is not None
+                not staged_already_failed
+                and self._staging_root is not None
                 and self.staged_step() == step
                 and self._staging_provenance_valid()
             ):

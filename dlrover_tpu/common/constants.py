@@ -111,6 +111,9 @@ class NodeEnv:
     PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
     NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
     RESTART_ROUND = "DLROVER_TPU_RESTART_ROUND"
+    # set by the agent when hang-relaunch is on; workers touch
+    # "<dir>/hb_<LOCAL_RANK>" each step (diagnosis.hang_detector)
+    HEARTBEAT_DIR = "DLROVER_TPU_HEARTBEAT_DIR"
 
 
 class DefaultValues:

@@ -14,6 +14,7 @@ exceeds the timeout.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -21,6 +22,35 @@ from typing import Callable, Optional
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("diagnosis.hang")
+
+_heartbeat_path: Optional[str] = None
+_heartbeat_resolved = False
+
+
+def touch_heartbeat() -> None:
+    """Per-step liveness beacon for the agent's hang-relaunch mode
+    (reference ``LocalDetectHangingAgent`` / ``--relaunch_on_hanging``).
+
+    When the agent exports ``NodeEnv.HEARTBEAT_DIR``, each worker touches
+    ``hb_<LOCAL_RANK>`` after every host-synced step; the agent monitor
+    loop treats a stale newest-beat as a hang (a collective blocked on a
+    dead peer keeps the process alive but the step loop frozen) and
+    restarts the workers. No-op when the env var is absent."""
+    global _heartbeat_path, _heartbeat_resolved
+    if not _heartbeat_resolved:
+        _heartbeat_resolved = True
+        from dlrover_tpu.common.constants import NodeEnv
+
+        directory = os.environ.get(NodeEnv.HEARTBEAT_DIR, "")
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+            _heartbeat_path = os.path.join(
+                directory, f"hb_{os.environ.get('LOCAL_RANK', '0')}"
+            )
+    if _heartbeat_path is None:
+        return
+    with open(_heartbeat_path, "w") as f:
+        f.write(str(time.time()))
 
 
 class HangingDetector:

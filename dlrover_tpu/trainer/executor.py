@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import TrainingExceptionLevel
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.diagnosis.hang_detector import touch_heartbeat
 from dlrover_tpu.trainer.conf import Configuration
 from dlrover_tpu.trainer.elastic import ElasticTrainer
 from dlrover_tpu.trainer.failover import FailoverClient, TrainingFailover
@@ -239,6 +240,7 @@ class TrainExecutor:
 
     def train_and_evaluate(self) -> Dict[str, Any]:
         self.state = self._trainer.prepare(self.state)
+        touch_heartbeat()  # liveness covers the pre-step setup phase
         for hook in self._hooks:
             hook.begin(self)
         if self._failover is not None:
@@ -259,6 +261,7 @@ class TrainExecutor:
                     )
                     self._last_metrics = metrics
                     step += 1
+                    touch_heartbeat()  # hang-relaunch liveness beacon
                     for hook in self._hooks:
                         hook.after_step(step, metrics)
 
@@ -299,6 +302,7 @@ class TrainExecutor:
             return
         self._last_eval_step = step
         self.eval_metrics = self._eval_fn(self.state)
+        touch_heartbeat()  # a long eval must not read as a hang
         logger.info("eval @%d: %s", step, {
             k: float(v) for k, v in self.eval_metrics.items()
         })

@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax platform for the chip probe (tests: cpu)")
     p.add_argument("--rdzv_waiting_timeout", type=float, default=30.0)
     p.add_argument("--monitor_interval", type=float, default=2.0)
+    p.add_argument("--relaunch_on_hang", "--relaunch-on-hang",
+                   dest="relaunch_on_hang",
+                   type=float, default=0.0, metavar="SECONDS",
+                   help="restart workers when no heartbeat lands for this "
+                        "many seconds (0 = off); parity with the "
+                        "reference's --relaunch_on_hanging mode")
     p.add_argument("--log_dir", default="",
                    help="redirect per-worker stdout/err to this directory")
     p.add_argument("entrypoint", help="training script or executable")
@@ -151,6 +157,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             rdzv_waiting_timeout=args.rdzv_waiting_timeout,
             network_check=args.network_check,
             probe_platform=args.probe_platform,
+            hang_timeout=args.relaunch_on_hang,
         )
         spec = WorkerSpec(
             entrypoint=args.entrypoint,

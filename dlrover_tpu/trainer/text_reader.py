@@ -50,35 +50,44 @@ class LineIndexedFile:
         """Number of records (reference: ``FileReader.count_data``)."""
         return len(self._starts)
 
+    def _read_range_into(self, f, start: int, end: int,
+                         out: List[bytes]) -> None:
+        f.seek(self._starts[start])
+        for i in range(start, end):
+            upper = (self._starts[i + 1] if i + 1 < self.count()
+                     else self._size)
+            raw = f.read(upper - self._starts[i])
+            out.append(raw.rstrip(b"\r\n"))
+
     def read_range(self, start: int, end: int) -> List[bytes]:
         """Records in [start, end) (reference:
         ``read_data_by_index_range``)."""
         end = min(end, self.count())
         if start >= end:
             return []
+        out: List[bytes] = []
         with open(self.path, "rb") as f:
-            f.seek(self._starts[start])
-            out = []
-            for i in range(start, end):
-                upper = (self._starts[i + 1] if i + 1 < self.count()
-                         else self._size)
-                raw = f.read(upper - self._starts[i])
-                out.append(raw.rstrip(b"\r\n"))
+            self._read_range_into(f, start, end, out)
         return out
 
     def read_indices(self, indices: List[int]) -> List[bytes]:
         """Records at arbitrary indices, in the given order (shuffled
-        shards carry an explicit permutation). Contiguous runs are read
-        with one seek."""
+        shards carry an explicit permutation). One open for the whole
+        call; contiguous runs share one seek — a fully shuffled shard is
+        seeks, not open/close pairs (which dominate on network fs)."""
         out: List[bytes] = []
-        i = 0
-        while i < len(indices):
-            j = i
-            while j + 1 < len(indices) and \
-                    indices[j + 1] == indices[j] + 1:
-                j += 1
-            out.extend(self.read_range(indices[i], indices[j] + 1))
-            i = j + 1
+        with open(self.path, "rb") as f:
+            i = 0
+            while i < len(indices):
+                j = i
+                while j + 1 < len(indices) and \
+                        indices[j + 1] == indices[j] + 1:
+                    j += 1
+                if indices[i] < self.count():
+                    self._read_range_into(
+                        f, indices[i], min(indices[j] + 1, self.count()),
+                        out)
+                i = j + 1
         return out
 
 

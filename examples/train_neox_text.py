@@ -27,6 +27,7 @@ import optax
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.sharding_client import ShardingClient
 from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.diagnosis.hang_detector import touch_heartbeat
 from dlrover_tpu.models import gpt_neox
 from dlrover_tpu.parallel.accelerate import accelerate
 from dlrover_tpu.parallel.mesh import MeshPlan
@@ -98,6 +99,7 @@ def main():
         state, m = result.train_step(
             state, result.shard_batch(batch), jax.random.PRNGKey(step))
         losses.append(float(m["loss"]))
+        touch_heartbeat()  # keeps --relaunch-on-hang usable
         client.report_global_step(step + 1)
         batch = next(it, None)
         if batch is None:

@@ -77,6 +77,32 @@ def test_external_sigkill_triggers_restart(master):
     assert agent._worker_group.restart_round >= 1
 
 
+def test_hang_without_heartbeat_triggers_relaunch(master):
+    """A worker whose process stays alive but whose step loop freezes
+    (the TPU hang mode: a collective waiting on a dead peer) must be
+    detected via the heartbeat gap and relaunched — the reference's
+    --relaunch_on_hanging semantics."""
+    client = MasterClient(master.addr, node_id=0)
+    config = AgentConfig(
+        node_rank=0, node_id=0, nproc_per_node=1, min_nodes=1, max_nodes=1,
+        max_restarts=2, monitor_interval=0.2, rdzv_waiting_timeout=5.0,
+        # must exceed worker python startup on a loaded 1-core host, or
+        # the restarted round gets falsely flagged before its first beat
+        hang_timeout=8.0,
+    )
+    spec = WorkerSpec(
+        entrypoint=os.path.join(TESTDATA, "hang_worker.py"),
+        nproc_per_node=1, env=dict(WORKER_ENV),
+    )
+    agent = ElasticTrainingAgent(config, spec, client, host_ip="127.0.0.1")
+    rc = agent.run()
+    assert rc == 0
+    assert agent._worker_group.restart_round >= 1
+    # the hang was reported to the master's failure log as node 0
+    assert 0 in client.failed_nodes()
+    client.close()
+
+
 def test_flaky_rpc_absorbed_by_retries(master):
     """Inject UNAVAILABLE below the retry decorator on a deterministic
     fraction of calls; the dynamic-sharding flow must still complete."""

@@ -1,0 +1,22 @@
+"""E2E chaos worker: heartbeats a few steps, then freezes (simulating a
+collective blocked on a dead peer — process alive, step loop stuck). The
+restarted round finishes cleanly."""
+
+import os
+import sys
+import time
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.diagnosis.hang_detector import touch_heartbeat
+
+restart_round = int(os.environ.get(NodeEnv.RESTART_ROUND, "0"))
+if restart_round == 0:
+    for _ in range(3):
+        touch_heartbeat()
+        time.sleep(0.1)
+    print("hang worker: freezing now (no more heartbeats)", flush=True)
+    time.sleep(120)  # the agent must kill us long before this returns
+    sys.exit(0)
+touch_heartbeat()
+print(f"hang worker: round {restart_round} finishing", flush=True)
+sys.exit(0)
