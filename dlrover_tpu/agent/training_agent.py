@@ -14,10 +14,12 @@ number of slices.
 
 from __future__ import annotations
 
+import shutil
 import subprocess
 import sys
 import tempfile
 import time
+import dataclasses
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -74,8 +76,13 @@ class ElasticTrainingAgent:
                  host_ip: Optional[str] = None):
         self._config = config
         self._client = master_client
+        self._owned_hb_dir = ""
         if config.hang_timeout > 0 and not spec.heartbeat_dir:
-            spec.heartbeat_dir = tempfile.mkdtemp(prefix="dlrover_hb_")
+            # copy, don't mutate the caller's spec; the dir is ours to
+            # remove on exit
+            self._owned_hb_dir = tempfile.mkdtemp(prefix="dlrover_hb_")
+            spec = dataclasses.replace(
+                spec, heartbeat_dir=self._owned_hb_dir)
         self._worker_group = WorkerGroup(spec)
         self._rdzv_handler = MasterRendezvousHandler(
             master_client,
@@ -109,6 +116,8 @@ class ElasticTrainingAgent:
             return self._invoke_run()
         finally:
             self._worker_group.stop()
+            if self._owned_hb_dir:
+                shutil.rmtree(self._owned_hb_dir, ignore_errors=True)
 
     def _initialize_workers(self):
         rdzv = self._rdzv_handler.next_rendezvous()

@@ -63,6 +63,13 @@ class Context:
         # what to do on a non-finite step after reporting the failure:
         # "halt" | "rollback" (restore last checkpoint) | "ignore"
         self.on_nonfinite = "halt"
+        # xprof trace capture ("" = off): the executor records
+        # trace_num_steps steps starting at trace_start_step into
+        # trace_dir (open with tensorboard/xprof). Env:
+        # DLROVER_TPU_TRACE_DIR etc.
+        self.trace_dir = ""
+        self.trace_start_step = 5
+        self.trace_num_steps = 3
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -46,8 +46,13 @@ class DryrunReport:
 
 
 def dryrun(result: AccelerateResult, example_batch, rng=None,
-           warmup_steps: int = 1, profile_steps: int = 3) -> DryrunReport:
-    """Compile + a few timed steps (``ATORCH_DRYRUN_*`` parity)."""
+           warmup_steps: int = 1, profile_steps: int = 3,
+           trace_dir: str = "") -> DryrunReport:
+    """Compile + a few timed steps (``ATORCH_DRYRUN_*`` parity).
+
+    ``trace_dir``: capture the timed steps as an xprof trace (open with
+    tensorboard/xprof) — the per-op view when the aggregate numbers in
+    the report aren't enough."""
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     report = DryrunReport(strategy=result.strategy)
     try:
@@ -78,11 +83,17 @@ def dryrun(result: AccelerateResult, example_batch, rng=None,
         for _ in range(warmup_steps):
             state, _metrics = compiled(state, batch, rng)
         jax.block_until_ready(state)
-        t0 = time.time()
-        for _ in range(profile_steps):
-            state, _metrics = compiled(state, batch, rng)
-        jax.block_until_ready(state)
-        report.step_time_s = (time.time() - t0) / max(1, profile_steps)
+        if trace_dir:
+            jax.profiler.start_trace(trace_dir)
+        try:
+            t0 = time.time()
+            for _ in range(profile_steps):
+                state, _metrics = compiled(state, batch, rng)
+            jax.block_until_ready(state)
+            report.step_time_s = (time.time() - t0) / max(1, profile_steps)
+        finally:
+            if trace_dir:
+                jax.profiler.stop_trace()
     except Exception as e:  # candidate infeasible (OOM, bad factorization)
         report.error = f"{type(e).__name__}: {e}"
         logger.info("dryrun failed for %s: %s",

@@ -138,6 +138,14 @@ class TrainExecutor:
         ))
         self._on_nonfinite = str(conf.get("on_nonfinite", ctx.on_nonfinite))
         self._max_rollbacks = int(conf.get("max_nonfinite_rollbacks", 3))
+        # xprof trace capture (SURVEY §5 tracing): a bounded window of
+        # steps recorded to a directory tensorboard/xprof can open
+        self._trace_dir = str(conf.get("trace_dir", ctx.trace_dir))
+        self._trace_start = int(conf.get(
+            "trace_start_step", ctx.trace_start_step))
+        self._trace_steps = int(conf.get(
+            "trace_num_steps", ctx.trace_num_steps))
+        self._tracing = False
         self._rollbacks = 0
         self._last_metrics: Optional[Dict[str, Any]] = None
         self._master_client = master_client
@@ -239,8 +247,11 @@ class TrainExecutor:
     # -- loop ----------------------------------------------------------------
 
     def train_and_evaluate(self) -> Dict[str, Any]:
+        # NB: no heartbeat before the first step — the agent's
+        # hang_first_beat_grace covers setup + first-step compile, and an
+        # early beat would forfeit it (beaten=True drops the allowance to
+        # the bare timeout while the compile is still running)
         self.state = self._trainer.prepare(self.state)
-        touch_heartbeat()  # liveness covers the pre-step setup phase
         for hook in self._hooks:
             hook.begin(self)
         if self._failover is not None:
@@ -262,6 +273,7 @@ class TrainExecutor:
                     self._last_metrics = metrics
                     step += 1
                     touch_heartbeat()  # hang-relaunch liveness beacon
+                    self._update_trace(step)
                     for hook in self._hooks:
                         hook.after_step(step, metrics)
 
@@ -294,15 +306,51 @@ class TrainExecutor:
                     # data source exhausted
                     return self._finish(step)
         finally:
+            self._stop_trace_if_open(step)
             if self._failover is not None:
                 self._failover.stop()
+
+    def _update_trace(self, step: int):
+        """Start/stop the bounded xprof window around the step counter.
+        Capture begins after ``trace_start_step`` completed steps (past
+        compile + warmup) and spans ``trace_num_steps`` steps."""
+        if not self._trace_dir:
+            return
+        if not self._tracing and step >= self._trace_start:
+            # ">=", not "==": a checkpoint-resumed run enters with the
+            # restored global step already past trace_start_step, and
+            # profiling a restored production job is a primary use
+            import jax
+
+            jax.profiler.start_trace(self._trace_dir)
+            self._tracing = True
+            self._trace_stop_at = step + self._trace_steps
+            logger.info("xprof trace started at step %d -> %s", step,
+                        self._trace_dir)
+        elif self._tracing and step >= self._trace_stop_at:
+            self._stop_trace_if_open(step)
+
+    def _stop_trace_if_open(self, step: int):
+        """xprof only flushes on stop_trace — also called from the run's
+        finally so a window open at exit isn't lost."""
+        if not self._tracing:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._tracing = False
+        self._trace_dir = ""  # one window per run
+        logger.info("xprof trace stopped after step %d", step)
 
     def _evaluate(self, step: int):
         if self._eval_fn is None or step == self._last_eval_step:
             return
         self._last_eval_step = step
+        # reset the hang clock at eval ENTRY so the allowance covers the
+        # eval from its start (a beat after it would land too late)
+        touch_heartbeat()
         self.eval_metrics = self._eval_fn(self.state)
-        touch_heartbeat()  # a long eval must not read as a hang
+        touch_heartbeat()
         logger.info("eval @%d: %s", step, {
             k: float(v) for k, v in self.eval_metrics.items()
         })

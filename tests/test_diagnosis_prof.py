@@ -112,6 +112,26 @@ class TestProfiler:
         assert prof.flops_per_step > 0
         assert 0 <= prof.mfu(1e15) < 1
 
+    def test_dryrun_trace_capture(self, tmp_path):
+        """trace_dir writes an xprof trace directory the tooling can
+        open (SURVEY §5 tracing parity)."""
+        import os
+
+        from dlrover_tpu.parallel.auto_tune import dryrun
+
+        res = accelerate(
+            _mlp_init, _mlp_loss, optax.adam(1e-2), _batch(),
+            strategy=Strategy(mesh=MeshPlan(data=-1)),
+        )
+        trace_dir = str(tmp_path / "trace")
+        report = dryrun(res, _batch(), profile_steps=2,
+                        trace_dir=trace_dir)
+        assert report.ok, report.error
+        found = []
+        for root, _dirs, files in os.walk(trace_dir):
+            found.extend(files)
+        assert found, "no trace files written"
+
     def test_aprofiler_summary(self):
         params = _mlp_init(jax.random.PRNGKey(0))
         prof = AProfiler(params)
