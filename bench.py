@@ -238,6 +238,19 @@ def _maybe_emit_mttr():
             probe_err = (probe.stderr or "")[-200:]
     except Exception as e:  # noqa: BLE001
         probe_err = f"{type(e).__name__}: {e}"[:200]
+    def write_mttr(result):
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "MTTR.json"
+        )
+        with open(path, "w") as f:
+            f.write(json.dumps(result) + "\n")
+
+    def error_artifact(message):
+        return {
+            "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
+            "vs_baseline": 0.0, "error": message,
+        }
+
     if platform == "cpu":
         return  # CPU-only host: never write a CPU number vs the TPU target
     if not platform:
@@ -245,30 +258,13 @@ def _maybe_emit_mttr():
         # a stale artifact: say so, loudly and in the artifact
         print(f"MTTR skipped: backend probe failed ({probe_err})",
               file=sys.stderr)
-        result = {
-            "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
-            "vs_baseline": 0.0,
-            "error": f"backend probe failed: {probe_err}",
-        }
-        path = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "MTTR.json"
-        )
-        with open(path, "w") as f:
-            f.write(json.dumps(result) + "\n")
+        write_mttr(error_artifact(f"backend probe failed: {probe_err}"))
         return
     try:
         result = recovery_result()
     except Exception as e:  # noqa: BLE001 — MTTR must not sink the MFU run
-        result = {
-            "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:200],
-        }
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "MTTR.json"
-    )
-    with open(path, "w") as f:
-        f.write(json.dumps(result) + "\n")
+        result = error_artifact(f"{type(e).__name__}: {e}"[:200])
+    write_mttr(result)
 
 
 def main() -> int:

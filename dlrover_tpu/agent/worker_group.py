@@ -69,17 +69,33 @@ class WorkerGroup:
         with no Python-side opportunity to beat)."""
         latest = self.started_at
         beaten = False
+        now = time.time()
         d = self.spec.heartbeat_dir
         if d and os.path.isdir(d):
             for name in os.listdir(d):
+                path = os.path.join(d, name)
                 if name.startswith("hb_"):
                     try:
-                        mtime = os.path.getmtime(os.path.join(d, name))
+                        mtime = os.path.getmtime(path)
                     except OSError:
                         continue
                     if mtime > self.started_at:
                         beaten = True
                         latest = max(latest, mtime)
+                elif name.startswith("lease_"):
+                    # a declared bounded no-beat window (recompile,
+                    # restore): counts as liveness until its deadline.
+                    # Only leases WRITTEN this round count — a stale one
+                    # from before a restart must not extend the fresh
+                    # round's clock
+                    try:
+                        if os.path.getmtime(path) <= self.started_at:
+                            continue
+                        with open(path) as f:
+                            deadline = float(f.read().strip() or 0)
+                    except (OSError, ValueError):
+                        continue
+                    latest = max(latest, min(deadline, now))
         return latest, beaten
 
     def start(self, rdzv: RendezvousInfo, master_addr: str, node_id: int):

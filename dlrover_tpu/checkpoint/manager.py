@@ -94,10 +94,22 @@ class ElasticCheckpointManager:
         async_save: Optional[bool] = None,
         save_interval: Optional[CheckpointInterval] = None,
         staging_dir: Optional[str] = None,
+        run_identity: str = "",
     ):
         import orbax.checkpoint as ocp
 
         from dlrover_tpu.common.config import get_context
+        from dlrover_tpu.common.constants import NodeEnv
+
+        # staging provenance token. A path-local uuid file alone cannot
+        # survive the very outage staging exists for (primary root wiped
+        # => the uuid is gone => a fresh uuid rejects the good mirror and
+        # the job silently restarts from scratch). A caller-stable run
+        # identity (job name under the launcher env contract) survives
+        # primary loss while still fencing out a DIFFERENT job reusing
+        # the path.
+        self._run_identity = run_identity or os.environ.get(
+            NodeEnv.JOB_NAME, "")
 
         self._ocp = ocp
         ctx = get_context()
@@ -274,10 +286,15 @@ class ElasticCheckpointManager:
                 shutil.rmtree(dst, ignore_errors=True)
 
     def _primary_identity(self) -> str:
-        """Identity token of the primary checkpoint root: a uuid file
-        created once per root. Survives a same-host restart (the outage
-        case); a fresh job that wiped and recreated the root gets a new
-        uuid, so its staging can never inherit the old job's weights."""
+        """Identity token used for staging provenance. With a run
+        identity (job name), the token is stable across loss of the
+        primary root — the storage-outage case staging exists for.
+        Otherwise: a uuid file created once per root; it survives a
+        same-host restart, but a wiped-and-recreated root gets a new
+        uuid (so an anonymous fresh job can never inherit a previous
+        job's weights — at the cost of the outage fallback)."""
+        if self._run_identity:
+            return f"job:{self._run_identity}"
         marker = os.path.join(self.directory, ".dlrover_ckpt_id")
         try:
             with open(marker) as f:

@@ -51,6 +51,38 @@ def touch_heartbeat() -> None:
         return
     with open(_heartbeat_path, "w") as f:
         f.write(str(time.time()))
+    # a beat means any declared long phase is over: drop the lease so a
+    # REAL hang right after a fast restore/recompile is judged promptly
+    # instead of hiding behind the remainder of the lease window
+    try:
+        os.remove(_lease_path(_heartbeat_path))
+    except OSError:
+        pass
+
+
+def _lease_path(heartbeat_path: str) -> str:
+    # swap the basename prefix only — the heartbeat DIRECTORY itself
+    # contains "hb_" (tempfile prefix "dlrover_hb_"), so a whole-path
+    # replace would point into a nonexistent directory
+    d, name = os.path.split(heartbeat_path)
+    return os.path.join(d, name.replace("hb_", "lease_", 1))
+
+
+def announce_long_phase(seconds: float) -> None:
+    """Declare a bounded no-heartbeat window (world-change recompile,
+    rollback restore): writes a lease deadline next to the heartbeat
+    file. The agent treats an unexpired lease as liveness, so a known
+    minutes-long in-process phase isn't misread as a hang — while a
+    REAL hang during the phase still trips once the lease expires. The
+    next heartbeat (first step after the phase) clears the lease.
+    No-op when hang-relaunch is off."""
+    global _heartbeat_path
+    if _heartbeat_path is None:
+        touch_heartbeat()  # resolves the path on first use
+    if _heartbeat_path is None:
+        return
+    with open(_lease_path(_heartbeat_path), "w") as f:
+        f.write(str(time.time() + seconds))
 
 
 class HangingDetector:

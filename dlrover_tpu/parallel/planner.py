@@ -185,17 +185,23 @@ def _flops_per_step(m: ModelSpec) -> float:
     return (6.0 * m.param_count + attn) * tokens
 
 
-def ring_kv_repeat(kv_heads: int, num_heads: int, tensor: int) -> int:
+def ring_kv_repeat(kv_heads: int, num_heads: int,
+                   tensor: int) -> Optional[int]:
     """The minimal KV-head repeat ``ops.ring_attention`` applies when the
     kv heads don't divide the tensor axis — planner-visible so the seq
-    comm term prices the extra ICI bytes instead of hiding them."""
+    comm term prices the extra ICI bytes instead of hiding them.
+
+    Returns None when NO legal repeat exists — the same inputs make the
+    runtime legalizer (``ops.flash_attention.minimal_kv_repeat``) raise,
+    so the planner must demote the mesh as infeasible rather than price
+    a program that cannot be built."""
     if kv_heads <= 0 or tensor <= 1 or kv_heads % tensor == 0:
         return 1
     num_heads = max(num_heads, kv_heads)
     for rep in range(1, num_heads // kv_heads + 1):
         if (kv_heads * rep) % tensor == 0 and num_heads % (kv_heads * rep) == 0:
             return rep
-    return max(1, num_heads // kv_heads)
+    return None
 
 
 def estimate(
@@ -289,11 +295,20 @@ def estimate(
     # rotates only kv_heads/num_heads of the activation bytes, times the
     # head-divisibility repeat factor when kv_heads % tensor != 0
     seq_comm_s = 0.0
+    heads_shardable = True
+    kv_rep = 1
+    if model.kv_heads and model.num_heads:
+        rep = ring_kv_repeat(model.kv_heads, model.num_heads, tensor)
+        if rep is None:
+            # the runtime head-shard legalizer raises for these inputs;
+            # any mesh relying on them must never win the ranking
+            heads_shardable = False
+        else:
+            kv_rep = rep
     if seq > 1:
         kv_frac = 1.0
         if model.kv_heads and model.num_heads:
-            rep = ring_kv_repeat(model.kv_heads, model.num_heads, tensor)
-            kv_frac = model.kv_heads * rep / model.num_heads
+            kv_frac = model.kv_heads * kv_rep / model.num_heads
         kv_bytes = 2 * act_elems * model.dtype_bytes * kv_frac
         seq_comm_s = model.num_layers * (seq - 1) * kv_bytes / device.ici_bw
 
@@ -347,10 +362,18 @@ def estimate(
     # the hoisted-gather case the model undercounts (measured 28.87 vs
     # modeled ~22.7 GB on the 7B AOT point => ~1.3x, inside the margin)
     fits = memory < device.hbm_bytes * 0.8
+    if not heads_shardable:
+        # the attention program cannot be built for this head/tensor
+        # combination — never feasible, and never the least-bad fallback
+        fits = False
+        step_s = float("inf")
 
     # predicted MFU convention: MODEL flops (6N+attn), not recompute
     # flops; bounded < 1 by construction (step_s >= exec/(n*peak*0.9))
-    predicted_mfu = flops / (n_chips * device.flops_per_s * step_s)
+    predicted_mfu = (
+        flops / (n_chips * device.flops_per_s * step_s)
+        if step_s != float("inf") else 0.0
+    )
 
     return PlanScore(
         plan=plan,

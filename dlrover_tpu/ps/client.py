@@ -109,8 +109,12 @@ class PsClusterClient:
             i += 1
         if not addrs:
             return None
-        if num_shards is not None and len(addrs) < num_shards:
-            return None  # still registering
+        if num_shards is not None:
+            if len(addrs) < num_shards:
+                return None  # still registering
+            # a shrink leaves stale ps/addr/{i} keys beyond the announced
+            # count — they point at dead shards, never at live ones
+            addrs = addrs[:num_shards]
         return addrs
 
     # -- channels ----------------------------------------------------------
@@ -220,12 +224,21 @@ class PsClusterClient:
         logger.info("PS cluster version %d -> %d: re-resolved %d shards",
                     self._known_version, version, len(addrs))
         self._known_version = version
+        old_count = len(self._addrs)
         self.close()
         self._addrs = list(addrs)
-        # same shard count => placement unchanged; a resize would need a
-        # repartition + parameter move, which the migration driver does
-        # via checkpoint/restore before bumping the version.
-        if self._assignment and \
-                max(self._assignment.values()) >= len(self._addrs):
+        # same shard count => same-placement migration (addresses moved,
+        # mapping unchanged). ANY count change invalidates the placement
+        # — keeping it would push/pull against a different partition than
+        # other workers compute (silent parameter split on grow, dead
+        # endpoints on shrink). The migration driver must move params via
+        # checkpoint/restore before bumping the version; workers then
+        # fail fast on the empty placement instead of diverging.
+        if len(self._addrs) != old_count and self._assignment:
+            logger.warning(
+                "PS cluster resized %d -> %d shards: invalidating the "
+                "parameter placement; restore from checkpoint to resume",
+                old_count, len(self._addrs),
+            )
             self._set_assignment({})
         return True

@@ -141,6 +141,20 @@ class ElasticTrainer:
         self._host_step = int(out["state"].step)
         return out["state"]
 
+    def restore_state(self) -> Optional[Any]:
+        """Restore the latest checkpoint onto the EXISTING compiled
+        program — the rollback path. The world hasn't changed, so the
+        jitted step and shardings stay valid; rebuilding via
+        ``prepare(None)`` would pay a full re-accelerate + retrace for
+        nothing (minutes at scale, and a silent no-heartbeat window the
+        hang detector could misread)."""
+        if self._result is None or self._ckpt is None:
+            return None
+        from dlrover_tpu.diagnosis.hang_detector import announce_long_phase
+
+        announce_long_phase(600.0)  # restore window: not a hang
+        return self._try_restore()
+
     def on_world_change(self, state: Any) -> Any:
         """Re-accelerate for the new device count and reshard the state.
 
@@ -149,6 +163,9 @@ class ElasticTrainer:
         the data axis and grows grad accumulation to compensate — the
         reference's ``_set_gradient_accumulation_steps`` semantics.
         """
+        from dlrover_tpu.diagnosis.hang_detector import announce_long_phase
+
+        announce_long_phase(900.0)  # recompile window: not a hang
         n = len(jax.devices())
         old_accum = self._result.strategy.grad_accum_steps if self._result else 1
         self._result = self._build(n)

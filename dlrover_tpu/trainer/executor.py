@@ -238,7 +238,12 @@ class TrainExecutor:
                 "rolling back to the last checkpoint after non-finite step "
                 "(%d/%d)", self._rollbacks, self._max_rollbacks,
             )
-            self.state = self._trainer.prepare(None)
+            # same world: restore onto the existing compiled program;
+            # prepare(None) would recompile the whole step for nothing
+            restore = getattr(self._trainer, "restore_state", None)
+            restored = restore() if restore is not None else None
+            self.state = (restored if restored is not None
+                          else self._trainer.prepare(None))
             return True
         if self._on_nonfinite == "ignore":
             return False

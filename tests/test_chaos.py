@@ -103,6 +103,58 @@ def test_hang_without_heartbeat_triggers_relaunch(master):
     client.close()
 
 
+def test_long_phase_lease_defers_hang_judgment(tmp_path):
+    """A declared bounded no-beat window (recompile/restore lease) must
+    count as liveness until its deadline — and a stale lease from before
+    a restart must not extend the fresh round's clock."""
+    from dlrover_tpu.agent.worker_group import WorkerGroup, WorkerSpec
+
+    spec = WorkerSpec(entrypoint="x", heartbeat_dir=str(tmp_path))
+    group = WorkerGroup(spec)
+    group.started_at = time.time() - 100  # round began 100 s ago
+
+    # no beats, no lease: gap is the full 100 s
+    latest, beaten = group.latest_heartbeat()
+    assert not beaten and time.time() - latest > 90
+
+    # write the lease through the REAL producer (announce_long_phase) —
+    # the heartbeat dir itself contains "hb_" like the agent's tempdir,
+    # which a naive whole-path prefix swap would corrupt
+    import dlrover_tpu.diagnosis.hang_detector as hd
+    from dlrover_tpu.common.constants import NodeEnv
+
+    hb_dir = tmp_path / "dlrover_hb_test"
+    old_env = os.environ.get(NodeEnv.HEARTBEAT_DIR)
+    os.environ[NodeEnv.HEARTBEAT_DIR] = str(hb_dir)
+    hd._heartbeat_path = None
+    hd._heartbeat_resolved = False
+    try:
+        spec2 = WorkerSpec(entrypoint="x", heartbeat_dir=str(hb_dir))
+        group2 = WorkerGroup(spec2)
+        group2.started_at = time.time() - 100
+        hd.announce_long_phase(300)
+        assert (hb_dir / "lease_0").exists()
+        latest, _ = group2.latest_heartbeat()
+        assert time.time() - latest < 5
+
+        # the next heartbeat (phase over) clears the lease
+        hd.touch_heartbeat()
+        assert not (hb_dir / "lease_0").exists()
+
+        # a stale lease is ignored once a new round starts after it
+        hd.announce_long_phase(300)
+        group2.started_at = time.time() + 1
+        latest, _ = group2.latest_heartbeat()
+        assert latest == group2.started_at
+    finally:
+        hd._heartbeat_path = None
+        hd._heartbeat_resolved = False
+        if old_env is None:
+            os.environ.pop(NodeEnv.HEARTBEAT_DIR, None)
+        else:
+            os.environ[NodeEnv.HEARTBEAT_DIR] = old_env
+
+
 def test_flaky_rpc_absorbed_by_retries(master):
     """Inject UNAVAILABLE below the retry decorator on a deterministic
     fraction of calls; the dynamic-sharding flow must still complete."""
@@ -204,6 +256,52 @@ def test_corrupt_primary_recovers_same_step_from_staging(tmp_path):
     np.testing.assert_allclose(np.asarray(out["state"]["w"]), 2.0)
     assert not os.path.isdir(mgr._step_dir(mgr.directory, 2))
     mgr.close()
+
+
+def test_primary_loss_recovers_from_staging_across_restart(tmp_path):
+    """The storage-outage story end to end ACROSS a process restart: the
+    primary root is wiped, a new manager (same run identity) comes up,
+    and the host-DRAM mirror restores — a path-local uuid would have
+    been lost with the primary and wrongly rejected the mirror. A
+    DIFFERENT job identity must still refuse the mirror."""
+    import shutil
+
+    from dlrover_tpu.checkpoint.manager import (
+        ElasticCheckpointManager,
+        abstract_like,
+    )
+
+    primary = str(tmp_path / "ckpt")
+    staging = str(tmp_path / "shm")
+    state = {"w": jnp.full((32, 32), 5.0), "step": jnp.asarray(3)}
+
+    mgr1 = ElasticCheckpointManager(
+        primary, async_save=False, staging_dir=staging,
+        run_identity="jobA",
+    )
+    assert mgr1.save(3, state, force=True)
+    mgr1.wait()
+    assert mgr1.staged_step() == 3
+    mgr1.close()
+
+    shutil.rmtree(primary)  # the outage
+
+    mgr2 = ElasticCheckpointManager(
+        primary, async_save=False, staging_dir=staging,
+        run_identity="jobA",
+    )
+    out = mgr2.restore(abstract_like(state))
+    assert out is not None and out["step"] == 3
+    np.testing.assert_allclose(np.asarray(out["state"]["w"]), 5.0)
+    mgr2.close()
+
+    shutil.rmtree(primary)
+    mgr3 = ElasticCheckpointManager(
+        primary, async_save=False, staging_dir=staging,
+        run_identity="jobB",
+    )
+    assert mgr3.restore(abstract_like(state)) is None
+    mgr3.close()
 
 
 def test_shuffled_text_shards_honor_permutation(tmp_path):

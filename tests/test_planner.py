@@ -116,6 +116,31 @@ class TestRingKvRepeat:
         # 8 kv heads over tensor=16 -> repeat x2 (16 kv heads)
         assert ring_kv_repeat(8, 32, 16) == 2
 
+    def test_unshardable_heads_match_runtime_and_demote_plan(self):
+        """When no legal repeat exists the runtime legalizer raises; the
+        planner must agree (None) and mark any such mesh infeasible —
+        otherwise the search can select a program that cannot be
+        built."""
+        import pytest as _pytest
+
+        from dlrover_tpu.ops.flash_attention import minimal_kv_repeat
+
+        assert ring_kv_repeat(3, 6, 4) is None
+        with _pytest.raises(ValueError):
+            minimal_kv_repeat(3, 6, 4)
+
+        spec = ModelSpec(
+            param_count=int(1e8), num_layers=4, hidden_size=512,
+            seq_len=256, global_batch=8, vocab_size=1024,
+            num_heads=6, kv_heads=3,
+        )
+        score = estimate(MeshPlan(data=2, tensor=4), spec)
+        assert not score.fits
+        assert score.step_time_s == float("inf")
+        # a legal head split on the same model stays feasible-rankable
+        ok = estimate(MeshPlan(data=4, tensor=2), spec)
+        assert ok.step_time_s != float("inf")
+
     def test_seq_comm_prices_the_repeat(self):
         # divisibility is a property of (kv_heads, tensor): the same GQA
         # model pays 2x the ring bytes when tensor=16 forces kv repeat
