@@ -77,6 +77,22 @@ class ShardingClient:
             )
             self._current_task = None
 
+    @property
+    def current_task_id(self) -> Optional[int]:
+        return (self._current_task.task_id
+                if self._current_task is not None else None)
+
+    def report_task_done_by_id(self, task_id: int, err_message: str = ""):
+        """Complete a specific task — for consumers that buffer records
+        across fetches (packing) and must defer completion until the
+        buffered data has actually been emitted."""
+        self._client.report_task_result(
+            self.dataset_name, task_id, err_message
+        )
+        if self._current_task is not None and \
+                self._current_task.task_id == task_id:
+            self._current_task = None
+
     def get_shard_checkpoint(self) -> str:
         return self._client.get_shard_checkpoint(self.dataset_name)
 

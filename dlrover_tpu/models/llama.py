@@ -178,7 +178,8 @@ def _rope(x, positions, theta):
     return rotated.astype(x.dtype)
 
 
-def _attention_block(x, layer, config: LlamaConfig, positions):
+def _attention_block(x, layer, config: LlamaConfig, positions,
+                     segment_ids=None):
     c = config
     b, s, d = x.shape
     h, kv, hd = c.num_heads, c.num_kv_heads, c.head_dim
@@ -192,7 +193,31 @@ def _attention_block(x, layer, config: LlamaConfig, positions):
     # rotates) only the kv heads — h/kv less traffic than the repeat
     # the reference pays before its CUDA kernel (layers.py:1268).
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
-    if c.seq_axis and c.mesh is not None:
+    if segment_ids is not None:
+        # packed sequences: per-document masking fused into the kernel
+        if c.seq_axis:
+            raise NotImplementedError(
+                "packed sequences (segment_ids) are not supported "
+                "together with sequence parallelism (seq_axis); pack "
+                "fits the dense single-sequence path"
+            )
+        if c.use_flash:
+            from dlrover_tpu.ops.flash_attention import (
+                flash_attention_segmented_auto,
+            )
+
+            # auto-routes through shard_map under a non-trivial mesh
+            out = flash_attention_segmented_auto(
+                q, k, v, segment_ids, causal=True,
+                block_q=c.flash_block_q, block_k=c.flash_block_k,
+                interpret=c.flash_interpret,
+            )
+        else:
+            same = segment_ids[:, None, :, None] == \
+                segment_ids[:, None, None, :]
+            bias = jnp.where(same, 0.0, jnp.finfo(jnp.float32).min)
+            out = mha_reference(q, k, v, causal=True, bias=bias)
+    elif c.seq_axis and c.mesh is not None:
         out = ring_attention(
             q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
             batch_axes=("data", "fsdp"), head_axis="tensor",
@@ -242,7 +267,21 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
     )
 
 
-def _decoder_block(c: LlamaConfig):
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """[B, S] segment ids -> position WITHIN each segment (RoPE must
+    restart per packed document, or later documents see phantom long
+    distances)."""
+    b, s = segment_ids.shape
+    idx = jnp.arange(s)[None, :]
+    is_start = jnp.concatenate(
+        [jnp.ones((b, 1), bool),
+         segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1,
+    )
+    starts = jax.lax.cummax(jnp.where(is_start, idx, 0), axis=1)
+    return idx - starts
+
+
+def _decoder_block(c: LlamaConfig, segment_ids=None):
     """Scan body over stacked layer params; shared by the plain and the
     pipelined forward so the two cannot drift."""
 
@@ -250,10 +289,15 @@ def _decoder_block(c: LlamaConfig):
         x, block_rng = carry
         # params may be stored f32; compute in the configured dtype
         layer_params = cast_floats(layer_params, c.compute_dtype)
-        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        if segment_ids is not None:
+            positions = segment_positions(segment_ids)
+        else:
+            positions = jnp.broadcast_to(
+                jnp.arange(x.shape[1]), x.shape[:2])
         block_rng, ffn_rng = jax.random.split(block_rng)
         attn_in = _rms_norm(x, layer_params["input_norm"]["scale"], c.rms_eps)
-        x = x + _attention_block(attn_in, layer_params, c, positions)
+        x = x + _attention_block(attn_in, layer_params, c, positions,
+                                 segment_ids)
         ffn_in = _rms_norm(x, layer_params["post_norm"]["scale"], c.rms_eps)
         ffn_out, aux = _ffn_block(ffn_in, layer_params, c, ffn_rng)
         return (x + ffn_out, block_rng), aux
@@ -264,24 +308,30 @@ def _decoder_block(c: LlamaConfig):
 def apply_hidden(
     params: Dict, input_ids: jax.Array, config: LlamaConfig,
     rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (final hidden states [B, S, D] in compute dtype,
-    moe_aux_loss scalar) — everything except the lm head."""
+    moe_aux_loss scalar) — everything except the lm head.
+
+    ``segment_ids`` [B, S]: packed-sequence mode — per-document
+    attention masking and segment-relative RoPE positions."""
     c = config
     x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    block = apply_remat(_decoder_block(c), c.remat_policy)
+    block = apply_remat(_decoder_block(c, segment_ids), c.remat_policy)
     (x, _), aux_losses = lax.scan(block, (x, rng), params["layers"])
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
     return x, jnp.sum(aux_losses)
 
 
 def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
-          rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+          rng: Optional[jax.Array] = None,
+          segment_ids: Optional[jax.Array] = None,
+          ) -> Tuple[jax.Array, jax.Array]:
     """Returns (logits [B, S, V] in f32, moe_aux_loss scalar)."""
     c = config
-    x, aux = apply_hidden(params, input_ids, config, rng)
+    x, aux = apply_hidden(params, input_ids, config, rng, segment_ids)
     logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
     return logits.astype(jnp.float32), aux
 
@@ -366,16 +416,19 @@ def make_loss_fn(config: LlamaConfig, z_loss_weight: float = 0.0,
     """
 
     def loss_fn(params, batch, rng):
+        segment_ids = batch.get("segment_ids")
         if head_chunk > 0:
             hidden, moe_aux = apply_hidden(
-                params, batch["input_ids"], config, rng
+                params, batch["input_ids"], config, rng,
+                segment_ids=segment_ids,
             )
             loss = chunked_lm_head_loss(
                 hidden, params["lm_head"]["kernel"], batch["labels"],
                 chunk_size=head_chunk, z_loss_weight=z_loss_weight,
             )
         else:
-            logits, moe_aux = apply(params, batch["input_ids"], config, rng)
+            logits, moe_aux = apply(params, batch["input_ids"], config,
+                                    rng, segment_ids=segment_ids)
             loss = masked_lm_loss(logits, batch["labels"], z_loss_weight)
         if config.num_experts > 0:
             loss = loss + config.moe_aux_weight * moe_aux / max(

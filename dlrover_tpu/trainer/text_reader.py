@@ -106,6 +106,11 @@ class ByteTokenizer:
         out[1:1 + len(ids)] = ids.astype(np.int32) + 2
         return out
 
+    def encode(self, record: bytes) -> np.ndarray:
+        """Variable-length encoding (bos + bytes), for the packing path."""
+        ids = np.frombuffer(record, np.uint8).astype(np.int32) + 2
+        return np.concatenate([np.asarray([1], np.int32), ids])
+
 
 class ShardedTextBatches:
     """Dynamic-shard consumption loop over a line-indexed text file.
@@ -123,11 +128,32 @@ class ShardedTextBatches:
         batch_size: int,
         tokenizer: Optional[Callable[[bytes], np.ndarray]] = None,
         seq_len: int = 128,
+        pack: bool = False,
     ):
         self._client = sharding_client
         self._reader = reader
         self._batch = batch_size
+        self._seq_len = seq_len
         self._tok = tokenizer or ByteTokenizer(seq_len)
+        self._pack = pack
+        if pack and not hasattr(self._tok, "encode"):
+            raise ValueError(
+                "pack=True needs a tokenizer with an .encode(bytes) -> "
+                "variable-length id array method (fixed-length __call__ "
+                "tokenizers cannot pack); ByteTokenizer provides one"
+            )
+        # packing state: documents spill across shard fetches
+        self._pack_rows: List[dict] = []
+        self._cur_ids: List[int] = []
+        self._cur_segs: List[int] = []
+        self._next_seg = 0
+        self._rows_finished = 0  # rows ever completed by _finish_row
+        self._rows_consumed = 0  # rows ever emitted in yielded batches
+        # (task_id, row mark): the shard may be reported done only once
+        # every row holding its tokens has been YIELDED — reporting at
+        # pack time would let the master mark records consumed that a
+        # worker crash would lose from the in-memory buffer
+        self._pending_tasks: List[Tuple[int, int]] = []
 
     def _render(self, records: List[bytes]) -> dict:
         ids = np.stack([self._tok(r) for r in records])
@@ -136,10 +162,86 @@ class ShardedTextBatches:
         labels[labels == 0] = -100  # don't train on pad
         return {"input_ids": ids, "labels": labels}
 
+    # -- packed mode --------------------------------------------------------
+
+    def _finish_row(self):
+        s = self._seq_len
+        ids = np.zeros((s,), np.int32)
+        segs = np.full((s,), -1, np.int32)  # -1 = pad segment
+        n = len(self._cur_ids)
+        ids[:n] = self._cur_ids
+        segs[:n] = self._cur_segs
+        labels = np.full((s,), -100, np.int32)
+        # next-token WITHIN a segment only: no target across document
+        # boundaries or into pad
+        labels[:-1] = ids[1:]
+        boundary = segs[:-1] != segs[1:]
+        labels[:-1][boundary] = -100
+        labels[-1] = -100
+        labels[segs == -1] = -100
+        self._pack_rows.append(
+            {"input_ids": ids, "segment_ids": segs, "labels": labels})
+        self._cur_ids, self._cur_segs = [], []
+        self._rows_finished += 1
+
+    def _pack_records(self, records: List[bytes]):
+        """Greedy fill: a document that doesn't fit the remainder is
+        split; the continuation gets a fresh segment id (attention can't
+        span rows, so the split IS a truncation boundary)."""
+        s = self._seq_len
+        for rec in records:
+            encoded = self._tok.encode(rec)
+            offset = 0
+            while offset < len(encoded):
+                room = s - len(self._cur_ids)
+                if room == 0:
+                    self._finish_row()
+                    room = s
+                take = encoded[offset:offset + room]
+                seg = self._next_seg
+                self._next_seg += 1
+                self._cur_ids.extend(take.tolist())
+                self._cur_segs.extend([seg] * len(take))
+                offset += len(take)
+            if len(self._cur_ids) == s:
+                self._finish_row()
+
+    def _drain_packed_batches(self, flush: bool = False):
+        if flush and self._cur_ids:
+            self._finish_row()
+        while len(self._pack_rows) >= self._batch or (
+            flush and self._pack_rows
+        ):
+            rows = self._pack_rows[: self._batch]
+            del self._pack_rows[: len(rows)]
+            self._rows_consumed += len(rows)
+            while len(rows) < self._batch:  # flush tail: repeat last row
+                rows.append(rows[-1])
+            yield {
+                key: np.stack([r[key] for r in rows])
+                for key in ("input_ids", "segment_ids", "labels")
+            }
+            self._client.report_batch_done()
+            self._report_emitted_tasks()
+
+    def _report_emitted_tasks(self, flush: bool = False):
+        """Complete shards whose every row has been yielded (or all of
+        them at flush, when the buffers are empty by construction)."""
+        remaining = []
+        for task_id, mark in self._pending_tasks:
+            if flush or mark <= self._rows_consumed:
+                self._client.report_task_done_by_id(task_id)
+            else:
+                remaining.append((task_id, mark))
+        self._pending_tasks = remaining
+
     def __iter__(self) -> Iterator[dict]:
         while True:
             shard = self._client.fetch_shard()
             if shard is None:
+                if self._pack:
+                    yield from self._drain_packed_batches(flush=True)
+                    self._report_emitted_tasks(flush=True)
                 return
             if shard.record_indices:
                 # shuffled datasets: the master's shard carries an
@@ -149,6 +251,17 @@ class ShardedTextBatches:
                     list(shard.record_indices))
             else:
                 records = self._reader.read_range(shard.start, shard.end)
+            if self._pack:
+                self._pack_records(records)
+                task_id = self._client.current_task_id
+                if task_id is not None:
+                    # completion deferred until this shard's rows (incl.
+                    # the still-open partial row) have been YIELDED
+                    mark = self._rows_finished + (
+                        1 if self._cur_ids else 0)
+                    self._pending_tasks.append((task_id, mark))
+                yield from self._drain_packed_batches()
+                continue
             for lo in range(0, len(records), self._batch):
                 chunk = records[lo:lo + self._batch]
                 if len(chunk) < self._batch:

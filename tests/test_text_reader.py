@@ -60,6 +60,84 @@ class TestByteTokenizer:
         assert (out[1:] == np.frombuffer(b"abc", np.uint8) + 2).all()
 
 
+class TestPackedBatches:
+    def test_packing_consumes_all_tokens_with_segments(self, corpus):
+        path, lines = corpus
+        master = start_local_master()
+        try:
+            reader = LineIndexedFile(path)
+            client = MasterClient(master.addr, node_id=0)
+            shard_client = ShardingClient(
+                client, dataset_name="packed", batch_size=2,
+                dataset_size=reader.count(), num_epochs=1,
+                num_minibatches_per_shard=4,
+            )
+            tok = ByteTokenizer(48)
+            source = ShardedTextBatches(
+                shard_client, reader, batch_size=2, tokenizer=tok,
+                seq_len=48, pack=True,
+            )
+            total_tokens = sum(
+                len(tok.encode(line.encode())) for line in lines
+            )
+            seen_tokens = 0
+            for batch in source:
+                assert batch["input_ids"].shape == (2, 48)
+                assert batch["segment_ids"].shape == (2, 48)
+                seen_tokens += int((batch["segment_ids"] >= 0).sum())
+                # labels never cross a segment boundary or land on pad
+                segs, labels = batch["segment_ids"], batch["labels"]
+                trained = labels != -100
+                assert (segs[trained] >= 0).all()
+                same_next = segs[:, :-1] == segs[:, 1:]
+                assert (~trained[:, :-1] | same_next).all()
+                assert not trained[:, -1].any()
+            # every token packed exactly once, modulo the repeated last
+            # row of the flush batch (allow overshoot, forbid loss)
+            assert seen_tokens >= total_tokens
+            client.close()
+        finally:
+            master.stop()
+
+
+class TestPackedTaskAccounting:
+    def test_completion_deferred_until_rows_yielded(self, corpus):
+        """A shard whose tokens still sit in the packing buffer must stay
+        in the master's 'doing' state — reporting it done at pack time
+        would make a worker crash silently drop those records (the
+        dead-worker recovery only re-queues incomplete tasks)."""
+        path, _lines = corpus
+        master = start_local_master()
+        try:
+            reader = LineIndexedFile(path)
+            client = MasterClient(master.addr, node_id=0)
+            shard_client = ShardingClient(
+                client, dataset_name="defer", batch_size=4,
+                dataset_size=reader.count(), num_epochs=1,
+                num_minibatches_per_shard=1,
+            )
+            tok = ByteTokenizer(512)
+            source = ShardedTextBatches(
+                shard_client, reader, batch_size=4, tokenizer=tok,
+                seq_len=512, pack=True,
+            )
+            dataset = master.task_manager.get_dataset("defer")
+            it = iter(source)
+            next(it)  # one batch out; more shards were fetched than
+            # fully emitted (512-token rows swallow many 30-byte lines)
+            assert dataset.doing, (
+                "every fetched shard already reported done while its "
+                "tokens are still buffered"
+            )
+            # draining everything completes every task
+            for _ in it:
+                pass
+            assert not dataset.doing
+            client.close()
+        finally:
+            master.stop()
+
+
 class TestShardedTextBatches:
     def test_consumes_corpus_exactly_once(self, corpus):
         path, lines = corpus
