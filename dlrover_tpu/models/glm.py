@@ -166,7 +166,7 @@ def prefix_lm_bias(seq_len: int, prefix_len: jax.Array,
 # -- forward ----------------------------------------------------------------
 
 
-def _attention(x, layer, c: GLMConfig, bias):
+def _attention(x, layer, c: GLMConfig, bias, prefix_len=None):
     b, s, d = x.shape
     h, hd = c.num_heads, c.head_dim
     q = (x @ layer["q_proj"]["kernel"] + layer["q_proj"]["bias"]
@@ -176,7 +176,18 @@ def _attention(x, layer, c: GLMConfig, bias):
     v = (x @ layer["v_proj"]["kernel"] + layer["v_proj"]["bias"]
          ).reshape(b, s, h, hd)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    if bias is None and c.use_flash:
+    if prefix_len is not None and c.use_flash:
+        # the prefix-LM mask fused into the Pallas tiles — no S x S bias
+        from dlrover_tpu.ops.flash_attention import (
+            flash_attention_prefix_auto,
+        )
+
+        out = flash_attention_prefix_auto(
+            q, k, v, prefix_len,
+            block_q=c.flash_block_q, block_k=c.flash_block_k,
+            interpret=c.flash_interpret,
+        )
+    elif bias is None and c.use_flash:
         out = flash_attention_auto(q, k, v, True,
                                    block_q=c.flash_block_q,
                                    block_k=c.flash_block_k,
@@ -189,12 +200,12 @@ def _attention(x, layer, c: GLMConfig, bias):
     return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
 
 
-def _block(c: GLMConfig, bias):
+def _block(c: GLMConfig, bias, prefix_len=None):
     def block(x, layer):
         layer = cast_floats(layer, c.compute_dtype)
         attn_in = _layer_norm(x, layer["input_norm"]["scale"],
                               layer["input_norm"]["bias"], c.ln_eps)
-        x = x + _attention(attn_in, layer, c, bias)
+        x = x + _attention(attn_in, layer, c, bias, prefix_len)
         mlp_in = _layer_norm(x, layer["post_norm"]["scale"],
                              layer["post_norm"]["bias"], c.ln_eps)
         up = mlp_in @ layer["up_proj"]["kernel"] + layer["up_proj"]["bias"]
@@ -214,7 +225,10 @@ def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
     x = params["embed_tokens"]["embedding"][input_ids]
     if prefix_len is not None:
         pos_ids, block_ids = glm_positions(s, prefix_len)
-        bias = prefix_lm_bias(s, prefix_len, c.compute_dtype)
+        # the flash path fuses the prefix mask into the kernel tiles; the
+        # bias is only materialized for the reference (use_flash=False)
+        bias = (None if c.use_flash
+                else prefix_lm_bias(s, prefix_len, c.compute_dtype))
     else:
         pos_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
         block_ids = jnp.zeros((b, s), jnp.int32)
@@ -223,7 +237,7 @@ def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
         + params["block_pos_embed"]["embedding"][block_ids]
     x = x.astype(c.compute_dtype)
 
-    block = apply_remat(_block(c, bias), c.remat_policy)
+    block = apply_remat(_block(c, bias, prefix_len), c.remat_policy)
     x, _ = lax.scan(block, x, params["layers"])
     x = _layer_norm(x, params["final_norm"]["scale"],
                     params["final_norm"]["bias"], c.ln_eps)

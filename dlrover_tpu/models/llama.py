@@ -281,22 +281,22 @@ def segment_positions(segment_ids: jax.Array) -> jax.Array:
     return idx - starts
 
 
-def _decoder_block(c: LlamaConfig, segment_ids=None):
+def _decoder_block(c: LlamaConfig, segment_ids=None, positions=None):
     """Scan body over stacked layer params; shared by the plain and the
-    pipelined forward so the two cannot drift."""
+    pipelined forward so the two cannot drift. ``positions`` is computed
+    ONCE by the caller (it is layer-invariant; inside the scan body it
+    would run per layer, and again per layer under remat)."""
 
     def block(carry, layer_params):
         x, block_rng = carry
         # params may be stored f32; compute in the configured dtype
         layer_params = cast_floats(layer_params, c.compute_dtype)
-        if segment_ids is not None:
-            positions = segment_positions(segment_ids)
-        else:
-            positions = jnp.broadcast_to(
-                jnp.arange(x.shape[1]), x.shape[:2])
+        pos = positions
+        if pos is None:
+            pos = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
         block_rng, ffn_rng = jax.random.split(block_rng)
         attn_in = _rms_norm(x, layer_params["input_norm"]["scale"], c.rms_eps)
-        x = x + _attention_block(attn_in, layer_params, c, positions,
+        x = x + _attention_block(attn_in, layer_params, c, pos,
                                  segment_ids)
         ffn_in = _rms_norm(x, layer_params["post_norm"]["scale"], c.rms_eps)
         ffn_out, aux = _ffn_block(ffn_in, layer_params, c, ffn_rng)
@@ -319,7 +319,10 @@ def apply_hidden(
     x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    block = apply_remat(_decoder_block(c, segment_ids), c.remat_policy)
+    positions = (segment_positions(segment_ids)
+                 if segment_ids is not None else None)
+    block = apply_remat(_decoder_block(c, segment_ids, positions),
+                        c.remat_policy)
     (x, _), aux_losses = lax.scan(block, (x, rng), params["layers"])
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
     return x, jnp.sum(aux_losses)

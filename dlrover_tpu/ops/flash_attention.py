@@ -63,12 +63,17 @@ def _fit_block(requested: int, dim: int) -> int:
 
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref,  # [1, 1, Bq|Bk, D] VMEM blocks
-    *rest,  # (+seg_q_ref, seg_k_ref when segmented) o_ref, lse_ref, scratch
+    *rest,  # (+seg_q_ref, seg_k_ref when segmented; +prefix_ref when
+    # prefix) o_ref, lse_ref, scratch
     scale: float, causal: bool, block_q: int, block_k: int,
-    segmented: bool = False,
+    segmented: bool = False, prefix: bool = False,
 ):
     if segmented:
         (seg_q_ref, seg_k_ref, o_ref, lse_ref,
+         m_scratch, l_scratch, acc_scratch) = rest
+    elif prefix:
+        seg_q_ref = seg_k_ref = None
+        (prefix_ref, o_ref, lse_ref,
          m_scratch, l_scratch, acc_scratch) = rest
     else:
         seg_q_ref = seg_k_ref = None
@@ -83,10 +88,17 @@ def _flash_fwd_kernel(
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    # with causal masking, blocks fully above the diagonal contribute nothing
-    block_needed = jnp.logical_or(
+    # with causal masking, blocks fully above the diagonal contribute
+    # nothing; in prefix-LM mode a block is also needed when it holds
+    # prefix columns (bidirectionally visible)
+    causal_needed = jnp.logical_or(
         jnp.logical_not(causal), j * block_k <= i * block_q + block_q - 1
     )
+    if prefix:
+        p_len = prefix_ref[0, 0]
+        block_needed = jnp.logical_or(causal_needed, j * block_k < p_len)
+    else:
+        block_needed = causal_needed
 
     @pl.when(block_needed)
     def _compute():
@@ -100,14 +112,18 @@ def _flash_fwd_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # [Bq, Bk] f32
 
-        if causal:
+        if causal or prefix:
             rows = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             ) + i * block_q
             cols = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             ) + j * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            allowed = rows >= cols
+            if prefix:
+                # prefix-LM: the prompt is bidirectionally visible
+                allowed = jnp.logical_or(allowed, cols < p_len)
+            s = jnp.where(allowed, s, NEG_INF)
         if segmented:
             # packed sequences: tokens attend only within their segment
             sq = seg_q_ref[0, 0, 0, :]  # [Bq] int32
@@ -118,12 +134,14 @@ def _flash_fwd_kernel(
         l_prev = l_scratch[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        if segmented:
+        if segmented or prefix:
             # a visited block can be FULLY masked for some rows (their
-            # segment's keys live in other blocks): m_new stays NEG_INF
-            # there and exp(NEG_INF - NEG_INF) would poison the
-            # accumulator with NaN. Clamp the subtrahend — those rows
-            # have l_prev == 0, so any finite alpha is harmless.
+            # segment's keys live elsewhere; or a prefix-needed block
+            # past both the diagonal and the prefix for early rows):
+            # m_new stays NEG_INF there and exp(NEG_INF - NEG_INF)
+            # would poison the accumulator with NaN. Clamp the
+            # subtrahend — those rows have l_prev == 0, so any finite
+            # alpha is harmless.
             m_sub = jnp.where(m_new <= NEG_INF * 0.5, 0.0, m_new)
         else:
             m_sub = m_new
@@ -163,6 +181,7 @@ def _flash_forward(
     q, k, v, *, scale: float, causal: bool,
     block_q: int, block_k: int, interpret: bool,
     segment_ids=None,  # [B, S] int32 — packed-sequence masking
+    prefix_len=None,  # [B] int32 — prefix-LM (bidirectional prompt)
 ):
     batch, heads, s_q, head_dim = q.shape
     s_k = k.shape[2]
@@ -176,10 +195,15 @@ def _flash_forward(
     block_k = _fit_block(block_k, s_k)
     grid = (batch, heads, s_q // block_q, s_k // block_k)
     segmented = segment_ids is not None
+    prefixed = prefix_len is not None
+    if segmented and prefixed:
+        raise ValueError("segment_ids and prefix_len are mutually "
+                         "exclusive masking modes")
 
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, segmented=segmented,
+        prefix=prefixed,
     )
     in_specs = [
         pl.BlockSpec((1, 1, block_q, head_dim),
@@ -199,6 +223,14 @@ def _flash_forward(
         in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
                                      lambda b, h, i, j: (b, 0, 0, j)))
         operands += [seg4, seg4]
+    if prefixed:
+        # [B, LANES] broadcast so the block obeys TPU lane tiling; the
+        # kernel reads lane 0
+        p2 = jnp.broadcast_to(
+            prefix_len.astype(jnp.int32)[:, None], (batch, LANES))
+        in_specs.append(pl.BlockSpec((1, LANES),
+                                     lambda b, h, i, j: (b, 0)))
+        operands.append(p2)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -315,6 +347,44 @@ def flash_attention_auto(
                            interpret)
 
 
+def _shard_mapped_attention(mesh, body, q, k, v, extras=(),
+                            extra_ndims=(), batch_axes=("data", "fsdp"),
+                            head_axis: Optional[str] = "tensor"):
+    """Shared shard_map routing for every flash variant: GQA head-shard
+    legalization, (batch, head) partition specs, and the shard_map
+    keyword-compat shim live HERE once. ``extras`` are additional
+    operands sharded along batch only (segment ids, prefix lengths);
+    ``extra_ndims`` gives each one's rank so its spec pads with None."""
+    from jax.sharding import PartitionSpec as P
+
+    from jax import shard_map
+
+    if head_axis is not None:
+        sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))
+        ways = sizes.get(head_axis, 1)
+        rep = minimal_kv_repeat(k.shape[1], q.shape[1], ways)
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+    spec = P(batch_axes, head_axis, None, None)
+    extra_specs = tuple(
+        P(batch_axes, *([None] * (nd - 1))) for nd in extra_ndims
+    )
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False} if "check_vma" in params
+        else {"check_rep": False} if "check_rep" in params
+        else {}
+    )
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec) + extra_specs, out_specs=spec,
+        **check_kw,
+    )(q, k, v, *extras)
+
+
 def flash_attention_segmented_auto(
     q: jax.Array,
     k: jax.Array,
@@ -333,43 +403,22 @@ def flash_attention_segmented_auto(
     partition the Mosaic call, and segmented attention with an unsharded
     sequence is embarrassingly parallel over (batch, head) shards, with
     segment ids sharded along batch only."""
-    from jax.sharding import PartitionSpec as P
-
     mesh = ambient_shard_mesh()
     if mesh is None:
         return flash_attention_segmented(
             q, k, v, segment_ids, causal, scale, block_q, block_k,
             interpret,
         )
-    from jax import shard_map
-
-    if head_axis is not None:
-        sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))
-        ways = sizes.get(head_axis, 1)
-        rep = minimal_kv_repeat(k.shape[1], q.shape[1], ways)
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-    spec = P(batch_axes, head_axis, None, None)
-    seg_spec = P(batch_axes, None)
-    import inspect
-
-    params = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False} if "check_vma" in params
-        else {"check_rep": False} if "check_rep" in params
-        else {}
-    )
 
     def body(ql, kl, vl, segl):
         return flash_attention_segmented(
             ql, kl, vl, segl, causal, scale, block_q, block_k, interpret
         )
 
-    return shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
-        out_specs=spec, **check_kw,
-    )(q, k, v, segment_ids)
+    return _shard_mapped_attention(
+        mesh, body, q, k, v, extras=(segment_ids,), extra_ndims=(2,),
+        batch_axes=batch_axes, head_axis=head_axis,
+    )
 
 
 def minimal_kv_repeat(kv_heads: int, num_heads: int, ways: int) -> int:
@@ -409,35 +458,14 @@ def flash_attention_sharded(
     unsharded sequence is embarrassingly parallel over (batch, head)
     shards, so the body needs zero collectives. The (seq-sharded)
     counterpart is ``ops.ring_attention``."""
-    from jax.sharding import PartitionSpec as P
-
-    from jax import shard_map
-
-    if head_axis is not None:
-        sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))
-        ways = sizes.get(head_axis, 1)
-        rep = minimal_kv_repeat(k.shape[1], q.shape[1], ways)
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-    spec = P(batch_axes, head_axis, None, None)
-    import inspect
-
-    params = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False} if "check_vma" in params
-        else {"check_rep": False} if "check_rep" in params
-        else {}
-    )
 
     def body(ql, kl, vl):
         return flash_attention(ql, kl, vl, causal, scale,
                                block_q, block_k, interpret)
 
-    return shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        **check_kw,
-    )(q, k, v)
+    return _shard_mapped_attention(
+        mesh, body, q, k, v, batch_axes=batch_axes, head_axis=head_axis,
+    )
 
 
 def _resolve(scale, head_dim, interpret):
@@ -459,22 +487,25 @@ def _flash_attention_lse_fwd(q, k, v, causal, scale, block_q, block_k,
 
 
 def _recompute_p(q, k, lse, *, scale, causal, i, j, block_q, block_k,
-                 seg_q=None, seg_k=None):
+                 seg_q=None, seg_k=None, prefix_len=None):
     """Recompute the [Bq, Bk] probability tile from (q, k, lse): exact
-    probs p = exp(q k^T * scale - lse) with causal (and segment) masking
-    re-applied."""
+    probs p = exp(q k^T * scale - lse) with causal (segment / prefix)
+    masking re-applied."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale  # [Bq, Bk] f32
-    if causal:
+    if causal or prefix_len is not None:
         rows = jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         ) + i * block_q
         cols = jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         ) + j * block_k
-        s = jnp.where(rows >= cols, s, NEG_INF)
+        allowed = rows >= cols
+        if prefix_len is not None:
+            allowed = jnp.logical_or(allowed, cols < prefix_len)
+        s = jnp.where(allowed, s, NEG_INF)
     if seg_q is not None:
         s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         # rows whose segment has no keys in this block: s == NEG_INF and
@@ -486,15 +517,17 @@ def _recompute_p(q, k, lse, *, scale, causal, i, j, block_q, block_k,
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # VMEM blocks
-    *rest,  # (+seg_q_ref, seg_k_ref when segmented) dk_ref, dv_ref, scratch
+    *rest,  # (+seg refs / prefix_ref per mode) dk_ref, dv_ref, scratch
     scale: float, causal: bool, block_q: int, block_k: int,
-    segmented: bool = False,
+    segmented: bool = False, prefix: bool = False,
 ):
+    prefix_ref = seg_q_ref = seg_k_ref = None
     if segmented:
         (seg_q_ref, seg_k_ref, dk_ref, dv_ref,
          dk_scratch, dv_scratch) = rest
+    elif prefix:
+        prefix_ref, dk_ref, dv_ref, dk_scratch, dv_scratch = rest
     else:
-        seg_q_ref = seg_k_ref = None
         dk_ref, dv_ref, dk_scratch, dv_scratch = rest
     # grid (batch, kv_head, j, g, i): the two innermost (sequential)
     # dims sweep the query heads of this KV head's group and the q
@@ -511,10 +544,14 @@ def _flash_bwd_dkv_kernel(
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
     # with causal masking, q blocks strictly above the k block's diagonal
-    # see none of these keys
+    # see none of these keys; prefix columns are visible to every q block
     block_needed = jnp.logical_or(
         jnp.logical_not(causal), i * block_q + block_q - 1 >= j * block_k
     )
+    if prefix:
+        block_needed = jnp.logical_or(
+            block_needed, j * block_k < prefix_ref[0, 0]
+        )
 
     @pl.when(block_needed)
     def _compute():
@@ -529,6 +566,7 @@ def _flash_bwd_dkv_kernel(
             i=i, j=j, block_q=block_q, block_k=block_k,
             seg_q=seg_q_ref[0, 0, 0, :] if segmented else None,
             seg_k=seg_k_ref[0, 0, 0, :] if segmented else None,
+            prefix_len=prefix_ref[0, 0] if prefix else None,
         )
         p_lo = p.astype(do.dtype)
         # dv += p^T do  : contract over the q rows
@@ -556,14 +594,16 @@ def _flash_bwd_dkv_kernel(
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    *rest,  # (+seg_q_ref, seg_k_ref when segmented) dq_ref, dq_scratch
+    *rest,  # (+seg refs / prefix_ref per mode) dq_ref, dq_scratch
     scale: float, causal: bool, block_q: int, block_k: int,
-    segmented: bool = False,
+    segmented: bool = False, prefix: bool = False,
 ):
+    prefix_ref = seg_q_ref = seg_k_ref = None
     if segmented:
         seg_q_ref, seg_k_ref, dq_ref, dq_scratch = rest
+    elif prefix:
+        prefix_ref, dq_ref, dq_scratch = rest
     else:
-        seg_q_ref = seg_k_ref = None
         dq_ref, dq_scratch = rest
     i = pl.program_id(2)  # q block index
     j = pl.program_id(3)  # k block index (innermost, sequential)
@@ -576,6 +616,10 @@ def _flash_bwd_dq_kernel(
     block_needed = jnp.logical_or(
         jnp.logical_not(causal), j * block_k <= i * block_q + block_q - 1
     )
+    if prefix:
+        block_needed = jnp.logical_or(
+            block_needed, j * block_k < prefix_ref[0, 0]
+        )
 
     @pl.when(block_needed)
     def _compute():
@@ -590,6 +634,7 @@ def _flash_bwd_dq_kernel(
             i=i, j=j, block_q=block_q, block_k=block_k,
             seg_q=seg_q_ref[0, 0, 0, :] if segmented else None,
             seg_k=seg_k_ref[0, 0, 0, :] if segmented else None,
+            prefix_len=prefix_ref[0, 0] if prefix else None,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -608,7 +653,8 @@ def _flash_bwd_dq_kernel(
 
 
 def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
-                    block_q, block_k, interpret, segment_ids=None):
+                    block_q, block_k, interpret, segment_ids=None,
+                    prefix_len=None):
     """Pallas backward: a dKV kernel (k blocks outer, q inner) and a dQ
     kernel (q outer, k inner), both recomputing probability tiles from the
     saved logsumexp — peak extra memory is O(Bq * Bk), never O(S^2).
@@ -624,6 +670,7 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
     bq = _fit_block(block_q, s_q)
     bk = _fit_block(block_k, s_k)
     segmented = segment_ids is not None
+    prefixed = prefix_len is not None
 
     f32 = jnp.float32
     delta = jnp.sum(
@@ -634,6 +681,9 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
     delta4 = delta.reshape(batch, heads, 1, s_q)
     seg4 = (segment_ids.astype(jnp.int32).reshape(batch, 1, 1, s_q)
             if segmented else None)
+    p2 = (jnp.broadcast_to(prefix_len.astype(jnp.int32)[:, None],
+                           (batch, LANES))
+          if prefixed else None)
 
     # dKV grid (b, kv_head, j, g, i): g sweeps the query heads sharing
     # this KV head, i sweeps q blocks; both are sequential on TPU so the
@@ -656,10 +706,15 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
         dkv_specs.append(pl.BlockSpec(
             (1, 1, 1, bk), lambda b, hk, j, g, i: (b, 0, 0, j)))
         dkv_operands += [seg4, seg4]
+    if prefixed:
+        dkv_specs.append(pl.BlockSpec(
+            (1, LANES), lambda b, hk, j, g, i: (b, 0)))
+        dkv_operands.append(p2)
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale_v, causal=causal,
             block_q=bq, block_k=bk, segmented=segmented,
+            prefix=prefixed,
         ),
         grid=(batch, k.shape[1], s_k // bk, group, s_q // bq),
         in_specs=dkv_specs,
@@ -694,10 +749,15 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
         dq_specs.append(pl.BlockSpec(
             (1, 1, 1, bk), lambda b, h, i, j: (b, 0, 0, j)))
         dq_operands += [seg4, seg4]
+    if prefixed:
+        dq_specs.append(pl.BlockSpec(
+            (1, LANES), lambda b, h, i, j: (b, 0)))
+        dq_operands.append(p2)
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale_v, causal=causal,
             block_q=bq, block_k=bk, segmented=segmented,
+            prefix=prefixed,
         ),
         grid=(batch, heads, s_q // bq, s_k // bk),
         in_specs=dq_specs,
@@ -793,6 +853,97 @@ def _flash_seg_bwd(causal, scale, block_q, block_k, interpret,
 
 
 flash_attention_segmented.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
+# -- prefix-LM flash attention ----------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_prefix(
+    q: jax.Array,  # [B, H, S, D]
+    k: jax.Array,
+    v: jax.Array,
+    prefix_len: jax.Array,  # [B] int — bidirectional over [0, prefix)
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Prefix-LM flash attention (GLM's mask): token ``i`` attends key
+    ``j`` iff ``j <= i`` (causal) OR ``j < prefix_len`` (the prompt is
+    bidirectionally visible). Fused into the Pallas tiles — the GLM
+    family's alternative to materializing an S x S bias. Reference
+    counterpart: ``fa2_with_glm_mask``
+    (``atorch/modules/transformer/layers.py:1191``)."""
+    out, _lse = _flash_prefix_fwd_impl(
+        q, k, v, prefix_len, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_prefix_fwd_impl(q, k, v, prefix_len, scale, block_q, block_k,
+                           interpret):
+    scale_v, interp = _resolve(scale, q.shape[-1], interpret)
+    out, lse = _flash_forward(
+        q, k, v, scale=scale_v, causal=True,
+        block_q=block_q, block_k=block_k, interpret=interp,
+        prefix_len=prefix_len,
+    )
+    return out, lse.reshape(q.shape[0], q.shape[1], q.shape[2])
+
+
+def _flash_prefix_fwd(q, k, v, prefix_len, scale, block_q, block_k,
+                      interpret):
+    out, lse = _flash_prefix_fwd_impl(
+        q, k, v, prefix_len, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, prefix_len, out, lse)
+
+
+def _flash_prefix_bwd(scale, block_q, block_k, interpret, residuals, do):
+    import numpy as np
+
+    q, k, v, prefix_len, out, lse = residuals
+    dlse = jnp.zeros_like(lse)
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, do, dlse, causal=True, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        prefix_len=prefix_len,
+    )
+    dprefix = np.zeros(prefix_len.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dprefix
+
+
+flash_attention_prefix.defvjp(_flash_prefix_fwd, _flash_prefix_bwd)
+
+
+def flash_attention_prefix_auto(
+    q, k, v, prefix_len,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+    batch_axes=("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+) -> jax.Array:
+    """Multi-chip-safe ``flash_attention_prefix`` (same shard_map
+    discipline as the other auto wrappers; prefix lengths shard along
+    batch only)."""
+    mesh = ambient_shard_mesh()
+    if mesh is None:
+        return flash_attention_prefix(
+            q, k, v, prefix_len, scale, block_q, block_k, interpret
+        )
+
+    def body(ql, kl, vl, pl_):
+        return flash_attention_prefix(
+            ql, kl, vl, pl_, scale, block_q, block_k, interpret
+        )
+
+    return _shard_mapped_attention(
+        mesh, body, q, k, v, extras=(prefix_len,), extra_ndims=(1,),
+        batch_axes=batch_axes, head_axis=head_axis,
+    )
 
 
 def attention(q, k, v, causal=True, scale=None, use_flash=True, **kwargs):

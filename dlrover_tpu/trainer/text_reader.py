@@ -221,7 +221,12 @@ class ShardedTextBatches:
                 key: np.stack([r[key] for r in rows])
                 for key in ("input_ids", "segment_ids", "labels")
             }
-            self._client.report_batch_done()
+            # NB: no report_batch_done here. The master credits that rpc
+            # in SOURCE RECORDS and auto-completes a shard when credits
+            # reach its size (batch_dataset_manager.report_batch_done) —
+            # packed rows are not records, so crediting them would pop
+            # the task out of 'doing' while its tokens still sit in this
+            # buffer, silently bypassing the deferred completion below.
             self._report_emitted_tasks()
 
     def _report_emitted_tasks(self, flush: bool = False):

@@ -122,6 +122,15 @@ class TestPackedTaskAccounting:
                 seq_len=512, pack=True,
             )
             dataset = master.task_manager.get_dataset("defer")
+
+            # packed mode must NEVER credit record counts: the master
+            # auto-completes a shard once credits reach its size, which
+            # would pop it from 'doing' while tokens are still buffered
+            def _forbidden(*_a, **_k):
+                raise AssertionError(
+                    "report_batch_done called in packed mode")
+
+            shard_client.report_batch_done = _forbidden
             it = iter(source)
             next(it)  # one batch out; more shards were fetched than
             # fully emitted (512-token rows swallow many 30-byte lines)
