@@ -58,3 +58,20 @@ def param_count(init_fn) -> int:
         math.prod(int(s) for s in leaf.shape)
         for leaf in jax.tree.leaves(abstract)
     )
+
+
+def segment_positions(segment_ids):
+    """[B, S] segment ids -> position WITHIN each segment (positional
+    encodings must restart per packed document, or later documents see
+    phantom long distances). Shared by every packed-capable family."""
+    import jax
+    import jax.numpy as jnp
+
+    b, s = segment_ids.shape
+    idx = jnp.arange(s)[None, :]
+    is_start = jnp.concatenate(
+        [jnp.ones((b, 1), bool),
+         segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1,
+    )
+    starts = jax.lax.cummax(jnp.where(is_start, idx, 0), axis=1)
+    return idx - starts

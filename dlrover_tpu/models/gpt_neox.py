@@ -153,7 +153,7 @@ def _partial_rope(x, positions, theta, rot_dims):
     return jnp.concatenate([rotated, rest], axis=-1)
 
 
-def _attention(x, layer, c: GPTNeoXConfig, positions):
+def _attention(x, layer, c: GPTNeoXConfig, positions, segment_ids=None):
     b, s, d = x.shape
     h, hd = c.num_heads, c.head_dim
     q = (x @ layer["q_proj"]["kernel"] + layer["q_proj"]["bias"]
@@ -165,7 +165,15 @@ def _attention(x, layer, c: GPTNeoXConfig, positions):
     q = _partial_rope(q, positions, c.rope_theta, c.rotary_dims)
     k = _partial_rope(k, positions, c.rope_theta, c.rotary_dims)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    if c.use_flash:
+    if segment_ids is not None:
+        from dlrover_tpu.ops.flash_attention import segmented_attention
+
+        out = segmented_attention(
+            q, k, v, segment_ids, c.use_flash,
+            block_q=c.flash_block_q, block_k=c.flash_block_k,
+            interpret=c.flash_interpret,
+        )
+    elif c.use_flash:
         out = flash_attention_auto(q, k, v, True,
                                    block_q=c.flash_block_q,
                                    block_k=c.flash_block_k,
@@ -182,13 +190,15 @@ def _mlp(x, layer):
         + layer["down_proj"]["bias"]
 
 
-def _block(c: GPTNeoXConfig):
+def _block(c: GPTNeoXConfig, segment_ids=None, positions=None):
     def block(x, layer):
         layer = cast_floats(layer, c.compute_dtype)
-        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        pos = positions
+        if pos is None:
+            pos = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
         attn_in = _layer_norm(x, layer["input_norm"]["scale"],
                               layer["input_norm"]["bias"], c.ln_eps)
-        attn_out = _attention(attn_in, layer, c, positions)
+        attn_out = _attention(attn_in, layer, c, pos, segment_ids)
         if c.use_parallel_residual:
             # x + attn(ln1(x)) + mlp(ln2(x)): both branches read the SAME
             # residual stream — one add chain, no attn->mlp dependency
@@ -204,10 +214,18 @@ def _block(c: GPTNeoXConfig):
 
 
 def apply(params: Dict, input_ids: jax.Array, config: GPTNeoXConfig,
-          rng: Optional[jax.Array] = None) -> jax.Array:
+          rng: Optional[jax.Array] = None,
+          segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """``segment_ids`` [B, S]: packed-sequence mode — per-document
+    attention and segment-relative rotary positions."""
     c = config
     x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
-    block = apply_remat(_block(c), c.remat_policy)
+    positions = None
+    if segment_ids is not None:
+        from dlrover_tpu.models.common import segment_positions
+
+        positions = segment_positions(segment_ids)
+    block = apply_remat(_block(c, segment_ids, positions), c.remat_policy)
     x, _ = lax.scan(block, x, params["layers"])
     x = _layer_norm(x, params["final_norm"]["scale"],
                     params["final_norm"]["bias"], c.ln_eps)
@@ -224,7 +242,8 @@ def make_init_fn(config: GPTNeoXConfig):
 
 def make_loss_fn(config: GPTNeoXConfig, z_loss_weight: float = 0.0):
     def loss_fn(params, batch, rng):
-        logits = apply(params, batch["input_ids"], config, rng)
+        logits = apply(params, batch["input_ids"], config, rng,
+                       segment_ids=batch.get("segment_ids"))
         return masked_lm_loss(logits, batch["labels"], z_loss_weight), {}
 
     return loss_fn

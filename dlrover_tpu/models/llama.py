@@ -201,22 +201,13 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
                 "together with sequence parallelism (seq_axis); pack "
                 "fits the dense single-sequence path"
             )
-        if c.use_flash:
-            from dlrover_tpu.ops.flash_attention import (
-                flash_attention_segmented_auto,
-            )
+        from dlrover_tpu.ops.flash_attention import segmented_attention
 
-            # auto-routes through shard_map under a non-trivial mesh
-            out = flash_attention_segmented_auto(
-                q, k, v, segment_ids, causal=True,
-                block_q=c.flash_block_q, block_k=c.flash_block_k,
-                interpret=c.flash_interpret,
-            )
-        else:
-            same = segment_ids[:, None, :, None] == \
-                segment_ids[:, None, None, :]
-            bias = jnp.where(same, 0.0, jnp.finfo(jnp.float32).min)
-            out = mha_reference(q, k, v, causal=True, bias=bias)
+        out = segmented_attention(
+            q, k, v, segment_ids, c.use_flash,
+            block_q=c.flash_block_q, block_k=c.flash_block_k,
+            interpret=c.flash_interpret,
+        )
     elif c.seq_axis and c.mesh is not None:
         out = ring_attention(
             q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
@@ -267,18 +258,8 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
     )
 
 
-def segment_positions(segment_ids: jax.Array) -> jax.Array:
-    """[B, S] segment ids -> position WITHIN each segment (RoPE must
-    restart per packed document, or later documents see phantom long
-    distances)."""
-    b, s = segment_ids.shape
-    idx = jnp.arange(s)[None, :]
-    is_start = jnp.concatenate(
-        [jnp.ones((b, 1), bool),
-         segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1,
-    )
-    starts = jax.lax.cummax(jnp.where(is_start, idx, 0), axis=1)
-    return idx - starts
+# shared packed-sequence helper (re-exported for existing callers)
+from dlrover_tpu.models.common import segment_positions  # noqa: E402
 
 
 def _decoder_block(c: LlamaConfig, segment_ids=None, positions=None):

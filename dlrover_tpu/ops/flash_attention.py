@@ -917,6 +917,25 @@ def _flash_prefix_bwd(scale, block_q, block_k, interpret, residuals, do):
 flash_attention_prefix.defvjp(_flash_prefix_fwd, _flash_prefix_bwd)
 
 
+def segmented_attention(q, k, v, segment_ids, use_flash: bool,
+                        block_q: int = 512, block_k: int = 1024,
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """The one segmented-attention dispatch every model family shares:
+    fused Pallas kernel (shard_map-routed) when flash is on, additive
+    bias over the XLA reference otherwise. Centralized so the mask
+    semantics cannot drift between families."""
+    if use_flash:
+        return flash_attention_segmented_auto(
+            q, k, v, segment_ids, causal=True,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    from dlrover_tpu.ops.attention_ref import mha_reference
+
+    same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+    bias = jnp.where(same, 0.0, jnp.finfo(jnp.float32).min)
+    return mha_reference(q, k, v, causal=True, bias=bias)
+
+
 def flash_attention_prefix_auto(
     q, k, v, prefix_len,
     scale: Optional[float] = None,

@@ -58,6 +58,9 @@ def main():
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--pack", action="store_true",
+                   help="pack documents into dense rows (segment ids + "
+                        "segmented flash attention; zero pad FLOPs)")
     args = p.parse_args()
 
     reader = LineIndexedFile(args.text or default_corpus())
@@ -82,7 +85,8 @@ def main():
         num_minibatches_per_shard=4, storage_type="text",
     )
     source = ShardedTextBatches(sharding, reader, args.batch,
-                                tokenizer=tok, seq_len=args.seq)
+                                tokenizer=tok, seq_len=args.seq,
+                                pack=args.pack)
 
     it = iter(source)
     first = next(it)
