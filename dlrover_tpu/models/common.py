@@ -64,9 +64,6 @@ def segment_positions(segment_ids):
     """[B, S] segment ids -> position WITHIN each segment (positional
     encodings must restart per packed document, or later documents see
     phantom long distances). Shared by every packed-capable family."""
-    import jax
-    import jax.numpy as jnp
-
     b, s = segment_ids.shape
     idx = jnp.arange(s)[None, :]
     is_start = jnp.concatenate(

@@ -929,8 +929,6 @@ def segmented_attention(q, k, v, segment_ids, use_flash: bool,
             q, k, v, segment_ids, causal=True,
             block_q=block_q, block_k=block_k, interpret=interpret,
         )
-    from dlrover_tpu.ops.attention_ref import mha_reference
-
     same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
     bias = jnp.where(same, 0.0, jnp.finfo(jnp.float32).min)
     return mha_reference(q, k, v, causal=True, bias=bias)

@@ -202,6 +202,19 @@ class PsClusterClient:
                   for shard in range(self.num_shards)}
         self._fanout(frames, "checkpoint")
 
+    def total_params(self) -> int:
+        """Parameters held across every shard (0 = nothing restored)."""
+        frames = {shard: wire.pack_frame({"op": "stats"})
+                  for shard in range(self.num_shards)}
+        return sum(int(meta.get("num_params", 0))
+                   for meta, _ in self._fanout(frames, "stats").values())
+
+    def reassign(self, specs: Dict[str, int]) -> None:
+        """Recompute the placement locally from parameter byte sizes —
+        the post-resize path. Pure client-side: the resized cluster must
+        already HOLD the (repartitioned) parameters; nothing is sent."""
+        self._set_assignment(partition_params(specs, self.num_shards))
+
     # -- elasticity --------------------------------------------------------
 
     def membership_changed(self) -> bool:

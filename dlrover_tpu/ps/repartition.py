@@ -1,0 +1,84 @@
+"""Offline PS checkpoint repartitioning for cluster resizes.
+
+Role parity: the reference resizes PS clusters through checkpoint +
+restart (``dlrover/python/master/node/ps.py`` scale-up/down drives a new
+PS cluster version; TF restores variables onto the new partitioning).
+Here the migration driver runs this utility between stopping the old
+shards and starting the new ones:
+
+    repartition_checkpoint(ckpt_dir, old_n, new_n)
+
+It merges every shard's parameter slice + optimizer slots, recomputes
+the deterministic size-balanced placement for ``new_n`` shards (the same
+``partition_params`` every worker uses), and rewrites the per-shard
+``.npz`` files. New shards then ``restore=True`` their slice; workers
+detect the version bump, see the resized address list, drop their stale
+placement, and recompute it against the restored cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.ps.client import partition_params
+
+logger = get_logger("ps.repartition")
+
+
+def _shard_path(directory: str, shard_id: int) -> str:
+    return os.path.join(directory, f"ps-shard-{shard_id}.npz")
+
+
+def repartition_checkpoint(directory: str, old_num_shards: int,
+                           new_num_shards: int) -> Dict[str, int]:
+    """Rewrite per-shard checkpoint files for a new shard count.
+
+    Returns the new name -> shard assignment. Atomic per file (tmp +
+    rename); old files beyond the new count are removed last, so a crash
+    mid-way leaves a restorable superset."""
+    params: Dict[str, np.ndarray] = {}
+    slots: Dict[str, Dict[str, np.ndarray]] = {}
+    version = 0
+    for i in range(old_num_shards):
+        path = _shard_path(directory, i)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"missing PS shard checkpoint {path}")
+        with np.load(path) as data:
+            for key in data.files:
+                if key == "__version__":
+                    version = max(version, int(data[key]))
+                elif key.startswith("p/"):
+                    params[key[2:]] = np.array(data[key])
+                elif key.startswith("s/"):
+                    name, sname = key[2:].rsplit("/", 1)
+                    slots.setdefault(name, {})[sname] = np.array(data[key])
+
+    specs = {n: int(a.nbytes) for n, a in params.items()}
+    assignment = partition_params(specs, new_num_shards)
+
+    for shard in range(new_num_shards):
+        payload = {"__version__": np.asarray(version, np.int64)}
+        for name, target in assignment.items():
+            if target != shard:
+                continue
+            payload[f"p/{name}"] = params[name]
+            for sname, sval in slots.get(name, {}).items():
+                payload[f"s/{name}/{sname}"] = sval
+        path = _shard_path(directory, shard)
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, **payload)
+        os.replace(tmp, path)
+    for i in range(new_num_shards, old_num_shards):
+        try:
+            os.remove(_shard_path(directory, i))
+        except OSError:
+            pass
+    logger.info(
+        "repartitioned %d params across %d -> %d PS shards (version %d)",
+        len(params), old_num_shards, new_num_shards, version,
+    )
+    return assignment

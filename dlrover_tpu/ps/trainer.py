@@ -59,12 +59,17 @@ class AsyncPsTrainer:
         self._names: list = []
         self._step = 0
         self._start_ts = time.time()
+        # parameter byte sizes, captured at init: all that's needed to
+        # recompute the placement after a cluster resize (no full-model
+        # copy pinned on the worker)
+        self._specs: Optional[Dict[str, int]] = None
 
     # -- setup -------------------------------------------------------------
 
     def init_params(self, params) -> None:
         flat, self._treedef, self._names = _flatten_named(params)
         self._cluster.init(flat)
+        self._specs = {n: int(a.nbytes) for n, a in flat.items()}
 
     def _unflatten(self, flat: Dict[str, np.ndarray]):
         return jax.tree_util.tree_unflatten(
@@ -79,11 +84,29 @@ class AsyncPsTrainer:
             self._cluster.membership_changed()
         flat, _version = self._cluster.pull()
         if not flat:
-            # a membership change that resized the cluster invalidates the
-            # placement; the migration driver must move params first
-            raise RuntimeError("PS pull returned no parameters; if the "
-                               "cluster was resized, restore from "
-                               "checkpoint before resuming workers")
+            # a resize invalidated the placement. The worker knows every
+            # parameter's byte size, so it recomputes the placement
+            # locally — but ONLY against a cluster that demonstrably
+            # holds the repartitioned parameters. Re-seeding an empty
+            # cluster from a worker's stale snapshot would silently
+            # discard other workers' progress and reset optimizer state.
+            if self._specs is None:
+                raise RuntimeError(
+                    "PS pull returned no parameters and no parameter "
+                    "specs are known; initialize or restore first")
+            held = self._cluster.total_params()
+            if held != len(self._specs):
+                raise RuntimeError(
+                    f"PS cluster holds {held} of {len(self._specs)} "
+                    "parameters after the resize; repartition + restore "
+                    "the checkpoint before resuming workers")
+            logger.info("PS placement invalidated (resize): recomputed "
+                        "against %d shards", self._cluster.num_shards)
+            self._cluster.reassign(self._specs)
+            flat, _version = self._cluster.pull()
+            if not flat:
+                raise RuntimeError("PS pull still empty after placement "
+                                   "recompute; cluster is not restored")
         params = self._unflatten(flat)
         loss, grads = self._grad_fn(params, batch)
         gflat, _, _ = _flatten_named(grads)

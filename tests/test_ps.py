@@ -134,12 +134,108 @@ def test_async_ps_training_two_workers(master, tmp_path):
         owner.close()
 
 
+def test_ps_resize_via_checkpoint_repartition(master, tmp_path):
+    """Grow the PS cluster 2 -> 3 shards: checkpoint, offline
+    repartition, restart with restore, version bump — the worker drops
+    its stale placement, recomputes it against the resized cluster, and
+    training continues with optimizer state intact."""
+    from dlrover_tpu.ps.repartition import repartition_checkpoint
+
+    owner = MasterClient(master.addr, node_id=9)
+    ckpt = str(tmp_path / "resize_ckpt")
+    shards = [
+        start_ps_shard(i, master_client=owner, optimizer="adagrad:0.3",
+                       checkpoint_dir=ckpt, num_shards=2)
+        for i in range(2)
+    ]
+    new_shards = []
+    mc = MasterClient(master.addr, node_id=0)
+    try:
+        x, y = _make_problem(seed=2)
+        cluster = PsClusterClient.discover(mc, num_shards=2)
+        trainer = AsyncPsTrainer(_loss_fn, cluster, master_client=mc,
+                                 membership_check_every=1)
+        trainer.init_params({"w": np.zeros((8, 1), np.float32),
+                             "b": np.zeros((1,), np.float32)})
+        for _ in range(40):
+            loss_before = trainer.step((x[:128], y[:128]))
+        trainer.checkpoint()
+
+        # the migration driver's sequence
+        for s in shards:
+            s.stop()
+        assignment = repartition_checkpoint(ckpt, 2, 3)
+        assert set(assignment.values()) <= {0, 1, 2}
+        new_shards = [
+            start_ps_shard(i, master_client=owner, optimizer="adagrad:0.3",
+                           checkpoint_dir=ckpt, restore=True, num_shards=3)
+            for i in range(3)
+        ]
+        cur = owner.get_cluster_version("global", "worker", 0)
+        owner.update_cluster_version("global", cur + 1, "worker", 0,
+                                     expected=cur)
+
+        for _ in range(40):
+            loss_after = trainer.step((x[:128], y[:128]))
+        assert loss_after <= loss_before, (loss_before, loss_after)
+        assert cluster.num_shards == 3
+        # both parameters are placed against the resized cluster
+        assert len(cluster._assignment) == 2
+    finally:
+        for s in shards + new_shards:
+            s.stop()
+        owner.close()
+        mc.close()
+
+
+def test_ps_resize_without_restore_fails_fast(master, tmp_path):
+    """A resized cluster that was NOT restored must make workers fail
+    loudly — re-seeding empty shards from a worker's stale snapshot
+    would silently discard other workers' progress."""
+    owner = MasterClient(master.addr, node_id=9)
+    shards = [
+        start_ps_shard(i, master_client=owner, optimizer="adagrad:0.3",
+                       num_shards=2)
+        for i in range(2)
+    ]
+    new_shards = []
+    mc = MasterClient(master.addr, node_id=0)
+    try:
+        x, y = _make_problem(seed=3)
+        cluster = PsClusterClient.discover(mc, num_shards=2)
+        trainer = AsyncPsTrainer(_loss_fn, cluster, master_client=mc,
+                                 membership_check_every=1)
+        trainer.init_params({"w": np.zeros((8, 1), np.float32),
+                             "b": np.zeros((1,), np.float32)})
+        trainer.step((x[:64], y[:64]))
+
+        for s in shards:
+            s.stop()
+        # driver "forgets" repartition+restore: fresh EMPTY shards
+        new_shards = [
+            start_ps_shard(i, master_client=owner, optimizer="adagrad:0.3",
+                           num_shards=3)
+            for i in range(3)
+        ]
+        cur = owner.get_cluster_version("global", "worker", 0)
+        owner.update_cluster_version("global", cur + 1, "worker", 0,
+                                     expected=cur)
+        with pytest.raises(RuntimeError, match="repartition"):
+            for _ in range(4):
+                trainer.step((x[:64], y[:64]))
+    finally:
+        for s in shards + new_shards:
+            s.stop()
+        owner.close()
+        mc.close()
+
+
 def test_ps_migration_restore_and_version_bump(master, tmp_path):
     owner = MasterClient(master.addr, node_id=9)
     ckpt = str(tmp_path / "ps_ckpt")
     shards = [
         start_ps_shard(i, master_client=owner, optimizer="adagrad:0.3",
-                       checkpoint_dir=ckpt)
+                       checkpoint_dir=ckpt, num_shards=2)
         for i in range(2)
     ]
     replacement = None
