@@ -1,0 +1,98 @@
+"""GLM prefix-LM fine-tuning (instruction/response shape).
+
+Each record is a prompt + response; the prompt is bidirectionally
+visible (GLM's prefix mask, fused into the Pallas kernel on the flash
+path), the response is generated causally with 2D block positions, and
+the loss covers only response tokens (a fixed synthetic batch, overfit
+as a demo — see train_neox_text.py for the shard-service data path).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/train_glm_prefix.py --steps 25
+
+Role parity: the reference's GLM support (Megatron-sharded GLM blocks +
+``fa2_with_glm_mask``) exercised as a training recipe.
+"""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.models import glm
+from dlrover_tpu.parallel.accelerate import accelerate
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+
+
+def synth_instruction_batch(vocab, batch, seq, seed):
+    """Prompt of random length, response echoing a transformed prompt —
+    learnable structure so the loss visibly falls."""
+    rng = np.random.RandomState(seed)
+    ids = np.zeros((batch, seq), np.int64)
+    prefix = rng.randint(4, seq // 2, size=(batch,))
+    labels = np.full((batch, seq), -100, np.int64)
+    for b in range(batch):
+        p = prefix[b]
+        prompt = rng.randint(2, vocab, size=(p,))
+        ids[b, :p] = prompt
+        n = min(seq - p, p)
+        response = (prompt[:n] + 1) % vocab  # the learnable mapping
+        ids[b, p:p + n] = response
+        # loss on response tokens only (predict token t at t-1)
+        labels[b, p - 1:p + n - 1] = ids[b, p:p + n]
+    return {
+        "input_ids": jnp.asarray(ids),
+        "labels": jnp.asarray(labels),
+        "prefix_len": jnp.asarray(prefix, jnp.int32),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=25)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=64)
+    args = p.parse_args()
+    if args.seq < 10:
+        p.error("--seq must be >= 10 (prompts span 4..seq/2 tokens)")
+
+    # flash_interpret stays at the config default (None): it resolves
+    # to the Mosaic kernel on TPU and the interpreter elsewhere
+    cfg = glm.glm_tiny(max_seq_len=args.seq, use_flash=True)
+
+    batch = synth_instruction_batch(cfg.vocab_size, args.batch,
+                                    args.seq, seed=0)
+    result = accelerate(
+        glm.make_init_fn(cfg), glm.make_loss_fn(cfg),
+        optax.adam(2e-3), batch,
+        strategy=Strategy(mesh=MeshPlan(data=-1), rule_set="glm"),
+    )
+    state = result.init_fn(jax.random.PRNGKey(0))
+
+    client = None
+    addr = os.environ.get(NodeEnv.MASTER_ADDR, "")
+    if addr:
+        client = MasterClient(addr, node_id=int(
+            os.environ.get(NodeEnv.NODE_ID, "0")))
+
+    losses = []
+    sharded = result.shard_batch(batch)
+    for step in range(args.steps):
+        state, m = result.train_step(state, sharded,
+                                     jax.random.PRNGKey(step))
+        losses.append(float(m["loss"]))
+        if client is not None:
+            client.report_global_step(step + 1)
+    print(f"glm prefix-LM: loss {losses[0]:.4f} -> {losses[-1]:.4f} "
+          f"(response-only loss, fused prefix mask)")
+    if client is not None:
+        client.close()
+
+
+if __name__ == "__main__":
+    main()
