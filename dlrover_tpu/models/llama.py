@@ -99,6 +99,27 @@ def llama2_13b(**overrides) -> LlamaConfig:
     )
 
 
+def llama3_8b(**overrides) -> LlamaConfig:
+    """Llama-3-8B shape: GQA 32/8, 128k vocab, theta 5e5."""
+    return replace(
+        LlamaConfig(vocab_size=128256, hidden_size=4096,
+                    intermediate_size=14336, num_layers=32,
+                    num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                    rope_theta=500000.0),
+        **overrides,
+    )
+
+
+def llama3_70b(**overrides) -> LlamaConfig:
+    return replace(
+        LlamaConfig(vocab_size=128256, hidden_size=8192,
+                    intermediate_size=28672, num_layers=80,
+                    num_heads=64, num_kv_heads=8, max_seq_len=8192,
+                    rope_theta=500000.0),
+        **overrides,
+    )
+
+
 def llama_tiny(**overrides) -> LlamaConfig:
     """Test-scale config (runs on the 8-device CPU mesh)."""
     return replace(

@@ -246,7 +246,8 @@ def main(argv: Optional[list] = None) -> int:
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="llama2_7b",
-                   choices=["llama2_7b", "llama2_13b", "llama_tiny"])
+                   choices=["llama2_7b", "llama2_13b", "llama3_8b",
+                            "llama3_70b", "llama_tiny"])
     p.add_argument("--topology", default="v5p-32",
                    help="v5p-N alias or raw topology (v5:2x2x4)")
     p.add_argument("--gen", default="v5p", choices=["v4", "v5e", "v5p",
@@ -283,11 +284,12 @@ def main(argv: Optional[list] = None) -> int:
         # only override the factory's use_flash when the user asked
         # (llama_tiny deliberately defaults to the XLA reference path)
         overrides["use_flash"] = args.flash
-    elif args.model.startswith("llama2"):
-        # the production-scale models prove the production path: the
+    elif args.model != "llama_tiny":
+        # every production-scale model proves the production path: the
         # hermetic TPU compiler lowers Pallas/Mosaic with no devices, so
         # no S^2 tile exists and dots_saveable fits where the XLA
-        # reference path OOMs
+        # reference path OOMs (llama_tiny deliberately stays on the
+        # reference path)
         overrides["use_flash"] = True
     config = factory(**overrides)
     mesh_plan = None

@@ -182,6 +182,18 @@ class TestLlama:
         n = llama.param_count(llama.llama2_7b())
         assert 6.5e9 < n < 7.5e9
 
+    def test_param_count_llama3_8b_in_range(self):
+        n = llama.param_count(llama.llama3_8b())
+        assert 7.8e9 < n < 8.3e9
+        cfg = llama.llama3_8b()
+        assert cfg.num_heads // cfg.num_kv_heads == 4  # GQA group of 4
+
+    def test_param_count_llama3_70b_in_range(self):
+        n = llama.param_count(llama.llama3_70b())
+        assert 69e9 < n < 72e9
+        cfg = llama.llama3_70b()
+        assert cfg.num_heads // cfg.num_kv_heads == 8
+
 
 class TestGPT2:
     def test_forward_and_tied_head(self):
