@@ -199,6 +199,18 @@ def _rope(x, positions, theta):
     return rotated.astype(x.dtype)
 
 
+def _ring_impl(c: LlamaConfig):
+    """Map the config's flash knobs onto the ring attention impl
+    selector (mirrors what the dense branches honor): use_flash=False
+    -> blockwise XLA; flash_interpret=True -> interpreted Pallas;
+    otherwise auto (Mosaic on TPU, XLA elsewhere)."""
+    if not c.use_flash:
+        return "xla"
+    if c.flash_interpret:
+        return "pallas_interpret"
+    return None
+
+
 def _attention_block(x, layer, config: LlamaConfig, positions,
                      segment_ids=None):
     c = config
@@ -215,31 +227,45 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
     # the reference pays before its CUDA kernel (layers.py:1268).
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
     if segment_ids is not None:
-        # packed sequences: per-document masking fused into the kernel
-        if c.seq_axis:
-            raise NotImplementedError(
-                "packed sequences (segment_ids) are not supported "
-                "together with sequence parallelism (seq_axis); pack "
-                "fits the dense single-sequence path"
+        # packed sequences: per-document masking fused into the kernel;
+        # under sequence parallelism the segment ids ride the ring with
+        # the KV shards (documents may span ring shards)
+        if c.seq_axis and c.mesh is not None:
+            out = ring_attention(
+                q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
+                batch_axes=("data", "fsdp"), head_axis="tensor",
+                block_q=c.flash_block_q, block_k=c.flash_block_k,
+                segment_ids=segment_ids, impl=_ring_impl(c),
             )
-        from dlrover_tpu.ops.flash_attention import segmented_attention
+        elif c.seq_axis:
+            out = ring_attention_local(
+                q, k, v, axis_name=c.seq_axis, causal=True,
+                block_q=c.flash_block_q, block_k=c.flash_block_k,
+                segment_ids=segment_ids, impl=_ring_impl(c),
+            )
+        else:
+            from dlrover_tpu.ops.flash_attention import (
+                segmented_attention,
+            )
 
-        out = segmented_attention(
-            q, k, v, segment_ids, c.use_flash,
-            block_q=c.flash_block_q, block_k=c.flash_block_k,
-            interpret=c.flash_interpret,
-        )
+            out = segmented_attention(
+                q, k, v, segment_ids, c.use_flash,
+                block_q=c.flash_block_q, block_k=c.flash_block_k,
+                interpret=c.flash_interpret,
+            )
     elif c.seq_axis and c.mesh is not None:
         out = ring_attention(
             q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
             batch_axes=("data", "fsdp"), head_axis="tensor",
             block_q=c.flash_block_q, block_k=c.flash_block_k,
+            impl=_ring_impl(c),
         )
     elif c.seq_axis:
         out = ring_attention_local(q, k, v, axis_name=c.seq_axis,
                                    causal=True,
                                    block_q=c.flash_block_q,
-                                   block_k=c.flash_block_k)
+                                   block_k=c.flash_block_k,
+                                   impl=_ring_impl(c))
     elif c.use_flash:
         # auto-routes through shard_map under a non-trivial mesh (GSPMD
         # cannot partition the Mosaic call itself)

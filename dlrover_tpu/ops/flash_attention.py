@@ -180,7 +180,10 @@ def _group_size(q, k) -> int:
 def _flash_forward(
     q, k, v, *, scale: float, causal: bool,
     block_q: int, block_k: int, interpret: bool,
-    segment_ids=None,  # [B, S] int32 — packed-sequence masking
+    segment_ids=None,  # [B, S_q] int32 — packed-sequence masking
+    segment_ids_kv=None,  # [B, S_k] — kv-side ids when they differ
+    # (ring steps: local q vs a VISITING kv shard); defaults to the
+    # q-side array
     prefix_len=None,  # [B] int32 — prefix-LM (bidirectional prompt)
 ):
     batch, heads, s_q, head_dim = q.shape
@@ -216,13 +219,16 @@ def _flash_forward(
     ]
     operands = [q, k, v]
     if segmented:
-        seg4 = segment_ids.astype(jnp.int32).reshape(batch, 1, 1, s_q)
+        seg4q = segment_ids.astype(jnp.int32).reshape(batch, 1, 1, s_q)
+        seg_kv = (segment_ids_kv if segment_ids_kv is not None
+                  else segment_ids)
+        seg4k = seg_kv.astype(jnp.int32).reshape(batch, 1, 1, s_k)
         # broadcast over heads: index map pins the head/row dims to 0
         in_specs.append(pl.BlockSpec((1, 1, 1, block_q),
                                      lambda b, h, i, j: (b, 0, 0, i)))
         in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
                                      lambda b, h, i, j: (b, 0, 0, j)))
-        operands += [seg4, seg4]
+        operands += [seg4q, seg4k]
     if prefixed:
         # [B, LANES] broadcast so the block obeys TPU lane tiling; the
         # kernel reads lane 0
@@ -654,7 +660,7 @@ def _flash_bwd_dq_kernel(
 
 def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
                     block_q, block_k, interpret, segment_ids=None,
-                    prefix_len=None):
+                    segment_ids_kv=None, prefix_len=None):
     """Pallas backward: a dKV kernel (k blocks outer, q inner) and a dQ
     kernel (q outer, k inner), both recomputing probability tiles from the
     saved logsumexp — peak extra memory is O(Bq * Bk), never O(S^2).
@@ -679,8 +685,13 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
     # [B, H, 1, S] layout so the last-two block dims obey TPU tiling
     lse4 = lse.reshape(batch, heads, 1, s_q)
     delta4 = delta.reshape(batch, heads, 1, s_q)
-    seg4 = (segment_ids.astype(jnp.int32).reshape(batch, 1, 1, s_q)
-            if segmented else None)
+    seg4q = (segment_ids.astype(jnp.int32).reshape(batch, 1, 1, s_q)
+             if segmented else None)
+    seg4k = None
+    if segmented:
+        seg_kv = (segment_ids_kv if segment_ids_kv is not None
+                  else segment_ids)
+        seg4k = seg_kv.astype(jnp.int32).reshape(batch, 1, 1, s_k)
     p2 = (jnp.broadcast_to(prefix_len.astype(jnp.int32)[:, None],
                            (batch, LANES))
           if prefixed else None)
@@ -705,7 +716,7 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
             (1, 1, 1, bq), lambda b, hk, j, g, i: (b, 0, 0, i)))
         dkv_specs.append(pl.BlockSpec(
             (1, 1, 1, bk), lambda b, hk, j, g, i: (b, 0, 0, j)))
-        dkv_operands += [seg4, seg4]
+        dkv_operands += [seg4q, seg4k]
     if prefixed:
         dkv_specs.append(pl.BlockSpec(
             (1, LANES), lambda b, hk, j, g, i: (b, 0)))
@@ -748,7 +759,7 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
             (1, 1, 1, bq), lambda b, h, i, j: (b, 0, 0, i)))
         dq_specs.append(pl.BlockSpec(
             (1, 1, 1, bk), lambda b, h, i, j: (b, 0, 0, j)))
-        dq_operands += [seg4, seg4]
+        dq_operands += [seg4q, seg4k]
     if prefixed:
         dq_specs.append(pl.BlockSpec(
             (1, LANES), lambda b, h, i, j: (b, 0)))
@@ -853,6 +864,71 @@ def _flash_seg_bwd(causal, scale, block_q, block_k, interpret,
 
 
 flash_attention_segmented.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
+# NB: no single-array segmented-lse variant exists — ring attention's
+# pair variant below with seg_q == seg_k subsumes it, and keeping two
+# vjps in sync with _flash_backward bought nothing.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_segmented_pair_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    seg_q: jax.Array,  # [B, S_q]
+    seg_k: jax.Array,  # [B, S_k] — independent kv-side ids
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """Segmented flash where the q-side and kv-side segment ids are
+    INDEPENDENT arrays — the ring-attention step shape (local queries
+    against a visiting KV shard). Returns (out, lse)."""
+    return _flash_seg_pair_impl(
+        q, k, v, seg_q, seg_k, causal, scale, block_q, block_k, interpret
+    )
+
+
+def _flash_seg_pair_impl(q, k, v, seg_q, seg_k, causal, scale, block_q,
+                         block_k, interpret):
+    scale_v, interp = _resolve(scale, q.shape[-1], interpret)
+    out, lse = _flash_forward(
+        q, k, v, scale=scale_v, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interp,
+        segment_ids=seg_q, segment_ids_kv=seg_k,
+    )
+    return out, lse.reshape(q.shape[0], q.shape[1], q.shape[2])
+
+
+def _flash_seg_pair_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
+                        block_k, interpret):
+    out, lse = _flash_seg_pair_impl(
+        q, k, v, seg_q, seg_k, causal, scale, block_q, block_k, interpret
+    )
+    return (out, lse), (q, k, v, seg_q, seg_k, out, lse)
+
+
+def _flash_seg_pair_bwd(causal, scale, block_q, block_k, interpret,
+                        residuals, cotangents):
+    import numpy as np
+
+    q, k, v, seg_q, seg_k, out, lse = residuals
+    do, dlse = cotangents
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, do, dlse, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        segment_ids=seg_q, segment_ids_kv=seg_k,
+    )
+    f0 = jax.dtypes.float0
+    return (dq, dk, dv, np.zeros(seg_q.shape, f0),
+            np.zeros(seg_k.shape, f0))
+
+
+flash_attention_segmented_pair_lse.defvjp(_flash_seg_pair_fwd,
+                                          _flash_seg_pair_bwd)
 
 
 # -- prefix-LM flash attention ----------------------------------------------

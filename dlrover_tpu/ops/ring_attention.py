@@ -45,7 +45,7 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
 def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
-                    block_k: int = 512):
+                    block_k: int = 512, seg_q=None, seg_k=None):
     """Blockwise-XLA attention returning ``(out_f32, lse_f32)``.
 
     The non-TPU counterpart of the Pallas kernel: a ``lax.scan`` over
@@ -62,15 +62,21 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
     if pad:  # pad K/V with masked keys instead of shrinking the block
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        if seg_k is not None:
+            # sentinel no real query segment carries
+            seg_k = jnp.pad(seg_k, ((0, 0), (0, pad)),
+                            constant_values=-2)
     nk = (s_k + pad) // bk
 
     qf = q.reshape(b, hkv, g, s_q, d).astype(jnp.float32)
     kb = jnp.moveaxis(k.reshape(b, hkv, nk, bk, d), 2, 0)
     vb = jnp.moveaxis(v.reshape(b, hkv, nk, bk, d), 2, 0)
+    sb = (jnp.moveaxis(seg_k.reshape(b, nk, bk), 1, 0)
+          if seg_k is not None else jnp.zeros((nk, b, 1), jnp.int32))
 
     def step(carry, inp):
         acc, m, l = carry
-        kj, vj, j = inp
+        kj, vj, sj, j = inp
         s = jnp.einsum(
             "bkgqd,bkcd->bkgqc", qf, kj.astype(jnp.float32),
             preferred_element_type=jnp.float32,
@@ -81,6 +87,11 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
             s = jnp.where(rows >= cols, s, NEG_INF)
         if pad:
             s = jnp.where(cols < s_k, s, NEG_INF)
+        if seg_q is not None:
+            # packed documents: mask cross-segment pairs
+            same = (seg_q[:, None, None, :, None]
+                    == sj[:, None, None, None, :])
+            s = jnp.where(same, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_cur)
         m_safe = jnp.maximum(m_new, NEG_INF / 2)
@@ -104,7 +115,7 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
         qf[..., 0] * 0.0,
     )
     (acc, m, l), _ = lax.scan(
-        step, init, (kb, vb, jnp.arange(nk, dtype=jnp.int32))
+        step, init, (kb, vb, sb, jnp.arange(nk, dtype=jnp.int32))
     )
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l_safe[..., None]).reshape(b, h, s_q, d)
@@ -114,14 +125,28 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
     return out, lse
 
 
-def _attend_lse(q, k, v, *, causal, scale, impl, block_q, block_k):
+def _attend_lse(q, k, v, *, causal, scale, impl, block_q, block_k,
+                seg_q=None, seg_k=None):
     """One (local-q x visiting-kv) shard attention -> (out f32, lse f32)."""
     if impl == "xla":
         return _xla_attend_lse(q, k, v, causal=causal, scale=scale,
-                               block_k=block_k)
+                               block_k=block_k, seg_q=seg_q, seg_k=seg_k)
+    interp = (impl == "pallas_interpret") or None
+    if seg_q is not None:
+        # ring steps attend local q against a VISITING kv shard: the two
+        # sides carry independent segment arrays
+        from dlrover_tpu.ops.flash_attention import (
+            flash_attention_segmented_pair_lse,
+        )
+
+        out, lse = flash_attention_segmented_pair_lse(
+            q, k, v, seg_q, seg_k, causal, scale, block_q, block_k,
+            interp,
+        )
+        return out.astype(jnp.float32), lse
     out, lse = flash_attention_lse(
         q, k, v, causal, scale, block_q, block_k,
-        interpret=(impl == "pallas_interpret") or None,
+        interpret=interp,
     )
     return out.astype(jnp.float32), lse
 
@@ -136,11 +161,15 @@ def ring_attention_local(
     impl: Optional[str] = None,  # pallas | pallas_interpret | xla
     block_q: int = 512,
     block_k: int = 1024,
+    segment_ids: Optional[jax.Array] = None,  # local [B, S_local]
 ) -> jax.Array:
     """The per-device body; call inside shard_map over ``axis_name``.
 
     Sequence layout is contiguous: device i owns global positions
-    [i * S_local, (i+1) * S_local).
+    [i * S_local, (i+1) * S_local). With ``segment_ids``, packed
+    documents may SPAN ring shards: the id arrays rotate with the KV
+    shards (negligible ICI bytes next to KV) and every step masks
+    cross-segment pairs.
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -151,10 +180,11 @@ def ring_attention_local(
         _attend_lse, scale=scale, impl=impl,
         block_q=block_q, block_k=block_k,
     )
+    seg = segment_ids
 
     # step 0: the local block — the only one needing an intra-block
     # causal mask, which the flash kernel applies at tile granularity
-    o, lse = attend(q, k, v, causal=causal)
+    o, lse = attend(q, k, v, causal=causal, seg_q=seg, seg_k=seg)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -166,17 +196,22 @@ def ring_attention_local(
         )
         return o_new, lse_new
 
-    def attend_merge(o, lse, ck, cv):
-        o_i, lse_i = attend(q, ck, cv, causal=False)
+    def attend_merge(o, lse, ck, cv, cs):
+        o_i, lse_i = attend(
+            q, ck, cv, causal=False, seg_q=seg,
+            seg_k=cs if seg is not None else None,
+        )
         return merge(o, lse, o_i, lse_i)
 
     def step(carry, _):
-        o, lse, cur_k, cur_v, owner = carry
+        o, lse, cur_k, cur_v, cur_s, owner = carry
         # rotate kv to the next neighbor (single ICI hop), then attend;
         # n-1 rotations total — the last visiting shard is not re-sent.
         # Only the H_kv heads travel: GQA pays kv/h of the MHA bytes.
         cur_k = lax.ppermute(cur_k, axis_name, perm)
         cur_v = lax.ppermute(cur_v, axis_name, perm)
+        if seg is not None:
+            cur_s = lax.ppermute(cur_s, axis_name, perm)
         owner = jnp.asarray((owner - 1) % n, jnp.int32)
         if causal:
             # visiting shard is wholly past (attend, unmasked) or wholly
@@ -185,15 +220,17 @@ def ring_attention_local(
             o, lse = lax.cond(
                 owner < my,
                 attend_merge,
-                lambda o, lse, ck, cv: (o, lse),
-                o, lse, cur_k, cur_v,
+                lambda o, lse, ck, cv, cs: (o, lse),
+                o, lse, cur_k, cur_v, cur_s,
             )
         else:
-            o, lse = attend_merge(o, lse, cur_k, cur_v)
-        return (o, lse, cur_k, cur_v, owner), None
+            o, lse = attend_merge(o, lse, cur_k, cur_v, cur_s)
+        return (o, lse, cur_k, cur_v, cur_s, owner), None
 
-    (o, lse, _, _, _), _ = lax.scan(
-        step, (o, lse, k, v, jnp.asarray(my, jnp.int32)), None,
+    init_seg = seg if seg is not None else jnp.zeros(
+        (q.shape[0], 1), jnp.int32)
+    (o, lse, _, _, _, _), _ = lax.scan(
+        step, (o, lse, k, v, init_seg, jnp.asarray(my, jnp.int32)), None,
         length=n - 1,
     )
     return o.astype(q.dtype)
@@ -212,11 +249,14 @@ def ring_attention(
     impl: Optional[str] = None,
     block_q: int = 512,
     block_k: int = 1024,
+    segment_ids: Optional[jax.Array] = None,  # global [B, S]
 ) -> jax.Array:
     """shard_map wrapper: global arrays in, global arrays out.
 
     Composes with the surrounding GSPMD program: batch stays sharded on the
     data axes, heads on the tensor axis, sequence on the ring axis.
+    ``segment_ids`` (packed documents, which may span ring shards) shard
+    on (batch, seq) and rotate with the KV shards.
     """
     from jax import shard_map
 
@@ -257,12 +297,24 @@ def ring_attention(
         else {"check_rep": False} if "check_rep" in params
         else {}
     )
+    body = functools.partial(
+        ring_attention_local, axis_name=axis_name, causal=causal,
+        scale=scale, impl=impl, block_q=block_q, block_k=block_k,
+    )
+    if segment_ids is not None:
+        seg_spec = P(batch_axes, axis_name)
+
+        def seg_body(ql, kl, vl, sl):
+            return body(ql, kl, vl, segment_ids=sl)
+
+        fn = shard_map(
+            seg_body, mesh=mesh,
+            in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
+            **check_kw,
+        )
+        return fn(q, k, v, segment_ids.astype(jnp.int32))
     fn = shard_map(
-        functools.partial(
-            ring_attention_local, axis_name=axis_name, causal=causal,
-            scale=scale, impl=impl, block_q=block_q, block_k=block_k,
-        ),
-        mesh=mesh,
+        body, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         **check_kw,
