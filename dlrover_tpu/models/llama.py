@@ -35,6 +35,7 @@ from dlrover_tpu.models.common import (
     dense_init as _dense,
     param_count as common_param_count,
     rms_norm as _rms_norm,
+    segment_positions,
 )
 from dlrover_tpu.models.losses import chunked_lm_head_loss, masked_lm_loss
 from dlrover_tpu.ops import moe as moe_ops
@@ -305,8 +306,6 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
     )
 
 
-# shared packed-sequence helper (re-exported for existing callers)
-from dlrover_tpu.models.common import segment_positions  # noqa: E402
 
 
 def _decoder_block(c: LlamaConfig, segment_ids=None, positions=None):

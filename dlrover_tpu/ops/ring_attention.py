@@ -54,6 +54,11 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
     CPU-mesh long-context tests honest. GQA-aware (k/v may carry fewer
     heads).
     """
+    if seg_q is not None and seg_k is None:
+        # self-attention shape: one id array serves both sides — never
+        # fall through to the dummy carry, which would silently mask
+        # every nonzero-segment token against everything
+        seg_k = seg_q
     b, h, s_q, d = q.shape
     hkv, s_k = k.shape[1], k.shape[2]
     g = h // hkv

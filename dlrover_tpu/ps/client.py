@@ -102,8 +102,13 @@ class PsClusterClient:
         addrs: List[str] = []
         i = 0
         while True:
-            addr = master_client.kv_store_get(f"ps/addr/{i}")
-            if not addr:
+            value = master_client.kv_store_get(f"ps/addr/{i}")
+            if not value:
+                break
+            addr, _, gen = value.partition("|")
+            if gen and num_shards is not None and gen != str(num_shards):
+                # written by a different-sized cluster generation: a dead
+                # endpoint, never a live one
                 break
             addrs.append(addr)
             i += 1
@@ -112,8 +117,6 @@ class PsClusterClient:
         if num_shards is not None:
             if len(addrs) < num_shards:
                 return None  # still registering
-            # a shrink leaves stale ps/addr/{i} keys beyond the announced
-            # count — they point at dead shards, never at live ones
             addrs = addrs[:num_shards]
         return addrs
 

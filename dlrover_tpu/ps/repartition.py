@@ -60,6 +60,12 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
     specs = {n: int(a.nbytes) for n, a in params.items()}
     assignment = partition_params(specs, new_num_shards)
 
+    # two phases: write EVERY tmp file, then rename them all. Renaming as
+    # we go would destroy a parameter's only on-disk copy (old shard file
+    # overwritten) before its new home is written — a mid-run crash must
+    # leave either the complete old layout or the complete new one
+    # recoverable, never a file set missing parameters.
+    tmps = []
     for shard in range(new_num_shards):
         payload = {"__version__": np.asarray(version, np.int64)}
         for name, target in assignment.items():
@@ -71,6 +77,8 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
         path = _shard_path(directory, shard)
         tmp = path + ".tmp.npz"
         np.savez(tmp, **payload)
+        tmps.append((tmp, path))
+    for tmp, path in tmps:
         os.replace(tmp, path)
     for i in range(new_num_shards, old_num_shards):
         try:

@@ -295,12 +295,12 @@ def start_ps_shard(shard_id: int, master_client=None,
             # worker racing registration adopts a partial list and computes
             # a divergent placement
             master_client.kv_store_set("ps/count", str(num_shards))
-            # clear stale addr keys a LARGER previous cluster generation
-            # left behind — discovery scans until the first missing key,
-            # so a dead ps/addr/{num_shards} would be adopted as live
-            i = num_shards
-            while master_client.kv_store_get(f"ps/addr/{i}"):
-                master_client.kv_store_set(f"ps/addr/{i}", "")
-                i += 1
-        master_client.kv_store_set(f"ps/addr/{shard_id}", addr)
+            # the addr value carries its GENERATION (the announced count)
+            # so discovery can reject keys a different-sized cluster
+            # generation wrote — race-free, unlike best-effort clearing
+            # of stale keys
+            master_client.kv_store_set(f"ps/addr/{shard_id}",
+                                       f"{addr}|{num_shards}")
+        else:
+            master_client.kv_store_set(f"ps/addr/{shard_id}", addr)
     return shard

@@ -107,6 +107,15 @@ class AsyncPsTrainer:
             if not flat:
                 raise RuntimeError("PS pull still empty after placement "
                                    "recompute; cluster is not restored")
+            # validate by NAME, not just count: a same-size foreign
+            # checkpoint (or a double-held leftover from a crashed
+            # repartition) must not pass as restored state
+            if set(flat) != set(self._specs):
+                missing = sorted(set(self._specs) - set(flat))[:5]
+                raise RuntimeError(
+                    "PS cluster parameter names do not match this "
+                    f"worker's model after the resize (missing e.g. "
+                    f"{missing}); wrong or partial checkpoint restored")
         params = self._unflatten(flat)
         loss, grads = self._grad_fn(params, batch)
         gflat, _, _ = _flatten_named(grads)

@@ -215,8 +215,14 @@ class ShardedTextBatches:
             rows = self._pack_rows[: self._batch]
             del self._pack_rows[: len(rows)]
             self._rows_consumed += len(rows)
-            while len(rows) < self._batch:  # flush tail: repeat last row
-                rows.append(rows[-1])
+            while len(rows) < self._batch:
+                # flush tail: repeat the last row for a static shape,
+                # with labels masked — a packed row is a full dense
+                # seq_len of tokens, so an unmasked copy would weight
+                # its gradient batch-fill times
+                filler = dict(rows[-1])
+                filler["labels"] = np.full_like(filler["labels"], -100)
+                rows.append(filler)
             yield {
                 key: np.stack([r[key] for r in rows])
                 for key in ("input_ids", "segment_ids", "labels")
