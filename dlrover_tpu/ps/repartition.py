@@ -43,19 +43,36 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
     params: Dict[str, np.ndarray] = {}
     slots: Dict[str, Dict[str, np.ndarray]] = {}
     version = 0
-    for i in range(old_num_shards):
-        path = _shard_path(directory, i)
-        if not os.path.exists(path):
-            raise FileNotFoundError(f"missing PS shard checkpoint {path}")
+
+    def ingest(path):
+        nonlocal version
         with np.load(path) as data:
             for key in data.files:
                 if key == "__version__":
                     version = max(version, int(data[key]))
                 elif key.startswith("p/"):
-                    params[key[2:]] = np.array(data[key])
+                    params.setdefault(key[2:], np.array(data[key]))
                 elif key.startswith("s/"):
                     name, sname = key[2:].rsplit("/", 1)
-                    slots.setdefault(name, {})[sname] = np.array(data[key])
+                    slots.setdefault(name, {}).setdefault(
+                        sname, np.array(data[key]))
+
+    for i in range(old_num_shards):
+        path = _shard_path(directory, i)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"missing PS shard checkpoint {path}")
+        ingest(path)
+    # crash recovery: a previous repartition run killed between its
+    # batched renames can leave a parameter ONLY in a leftover tmp file
+    # (its old home already renamed away, its new home not yet) — ingest
+    # tmps so a rerun never silently drops it. Values are identical
+    # where duplicated (repartition only moves), so setdefault is safe.
+    # Tmps are NOT deleted here: until the new canonical files land they
+    # may hold a parameter's only copy; stale ones are removed after the
+    # rename phase below.
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("ps-shard-") and name.endswith(".tmp.npz"):
+            ingest(os.path.join(directory, name))
 
     specs = {n: int(a.nbytes) for n, a in params.items()}
     assignment = partition_params(specs, new_num_shards)
@@ -85,8 +102,52 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
             os.remove(_shard_path(directory, i))
         except OSError:
             pass
+    # every parameter is now in a canonical file: stale tmps from a
+    # previous crashed run (for shard ids this layout didn't rewrite)
+    # are safe to drop
+    written = {tmp for tmp, _ in tmps}
+    for name in os.listdir(directory):
+        full = os.path.join(directory, name)
+        if name.startswith("ps-shard-") and name.endswith(".tmp.npz") \
+                and full not in written:
+            try:
+                os.remove(full)
+            except OSError:
+                pass
     logger.info(
         "repartitioned %d params across %d -> %d PS shards (version %d)",
         len(params), old_num_shards, new_num_shards, version,
     )
     return assignment
+
+
+def main(argv=None) -> int:
+    """CLI for the migration driver:
+
+        python -m dlrover_tpu.ps.repartition CKPT_DIR OLD_N NEW_N
+
+    Run between stopping the old shards and starting the new ones
+    (``start_ps_shard(..., restore=True, num_shards=NEW_N)``), then bump
+    the global cluster version so workers re-resolve.
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Repartition PS shard checkpoints for a new shard "
+                    "count (offline, atomic).")
+    p.add_argument("directory")
+    p.add_argument("old_num_shards", type=int)
+    p.add_argument("new_num_shards", type=int)
+    args = p.parse_args(argv)
+    assignment = repartition_checkpoint(
+        args.directory, args.old_num_shards, args.new_num_shards)
+    per_shard = {}
+    for name, shard in assignment.items():
+        per_shard[shard] = per_shard.get(shard, 0) + 1
+    print(f"repartitioned {len(assignment)} params across "
+          f"{args.new_num_shards} shards: {dict(sorted(per_shard.items()))}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -295,10 +295,21 @@ def start_ps_shard(shard_id: int, master_client=None,
             # worker racing registration adopts a partial list and computes
             # a divergent placement
             master_client.kv_store_set("ps/count", str(num_shards))
-            # the addr value carries its GENERATION (the announced count)
-            # so discovery can reject keys a different-sized cluster
-            # generation wrote — race-free, unlike best-effort clearing
-            # of stale keys
+            # two complementary defenses against stale addr keys:
+            # (1) the value carries its generation (the announced count),
+            #     so discovery rejects keys a DIFFERENT-sized generation
+            #     wrote even if clearing races a straggler writer;
+            # (2) keys beyond the announced count are cleared, covering
+            #     the resize-back-to-a-previous-size case where the
+            #     count tag alone cannot distinguish generations.
+            # Residual: a still-running straggler shard of a SAME-sized
+            # previous generation re-registering late — the migration
+            # driver's contract is to stop old shards before starting
+            # new ones (the version bump is the sync point).
+            i = num_shards
+            while master_client.kv_store_get(f"ps/addr/{i}"):
+                master_client.kv_store_set(f"ps/addr/{i}", "")
+                i += 1
             master_client.kv_store_set(f"ps/addr/{shard_id}",
                                        f"{addr}|{num_shards}")
         else:

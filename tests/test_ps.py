@@ -7,6 +7,8 @@ migration/failover pass through the cluster-version handshake
 (``tensorflow_failover.py`` parity).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -186,6 +188,41 @@ def test_ps_resize_via_checkpoint_repartition(master, tmp_path):
             s.stop()
         owner.close()
         mc.close()
+
+
+def test_repartition_rerun_recovers_param_from_leftover_tmp(tmp_path):
+    """The crash window between batched renames: a parameter whose old
+    home was already rewritten but whose new home only exists as a tmp
+    file must survive a rerun (ingested from the tmp, not dropped)."""
+    import numpy as np
+
+    from dlrover_tpu.ps.repartition import repartition_checkpoint
+
+    d = str(tmp_path)
+    # post-crash state: 'w' moved old-shard-0 -> new-shard-1; shard 0
+    # already renamed (new payload, no w), shard 1 still old (no w),
+    # the only copy of w sits in shard 1's tmp file
+    np.savez(os.path.join(d, "ps-shard-0.npz"),
+             **{"p/b": np.zeros((4,)), "__version__": np.asarray(7)})
+    np.savez(os.path.join(d, "ps-shard-1.npz"),
+             **{"p/e": np.ones((2, 2)), "__version__": np.asarray(7)})
+    np.savez(os.path.join(d, "ps-shard-1.npz.tmp.npz"),
+             **{"p/w": np.full((8, 8), 3.0),
+                "s/w/acc": np.ones((8, 8)),
+                "__version__": np.asarray(7)})
+
+    assignment = repartition_checkpoint(d, 2, 2)
+    assert set(assignment) == {"w", "b", "e"}
+    # every param (and w's slots) is back in a canonical file; tmps gone
+    found = {}
+    for i in range(2):
+        with np.load(os.path.join(d, f"ps-shard-{i}.npz")) as z:
+            for key in z.files:
+                if key.startswith(("p/", "s/")):
+                    found[key] = np.array(z[key])
+    assert "p/w" in found and float(found["p/w"][0, 0]) == 3.0
+    assert "s/w/acc" in found
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp.npz")]
 
 
 def test_ps_resize_without_restore_fails_fast(master, tmp_path):
