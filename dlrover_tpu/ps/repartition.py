@@ -44,9 +44,19 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
     slots: Dict[str, Dict[str, np.ndarray]] = {}
     version = 0
 
-    def ingest(path):
+    def ingest(path, tolerate_torn=False):
         nonlocal version
-        with np.load(path) as data:
+        try:
+            data = np.load(path)
+        except Exception:  # noqa: BLE001 — torn write from a killed run
+            if tolerate_torn:
+                # safe to skip: tmp writes complete strictly BEFORE any
+                # rename in a run, so a torn tmp's source data is still
+                # in a canonical file or another (complete) tmp
+                logger.warning("skipping unreadable leftover %s", path)
+                return
+            raise
+        with data:
             for key in data.files:
                 if key == "__version__":
                     version = max(version, int(data[key]))
@@ -57,10 +67,18 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
                     slots.setdefault(name, {}).setdefault(
                         sname, np.array(data[key]))
 
+    found_any = False
     for i in range(old_num_shards):
         path = _shard_path(directory, i)
         if not os.path.exists(path):
-            raise FileNotFoundError(f"missing PS shard checkpoint {path}")
+            # a rerun after a crash mid-removal of a downsize: the file
+            # may be legitimately gone (its params already live in the
+            # new layout). Tolerate; the complete-set raise below and the
+            # workers' name validation catch genuine loss.
+            logger.warning("old shard checkpoint %s missing (crashed "
+                           "earlier run?); continuing", path)
+            continue
+        found_any = True
         ingest(path)
     # crash recovery: a previous repartition run killed between its
     # batched renames can leave a parameter ONLY in a leftover tmp file
@@ -71,8 +89,13 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
     # may hold a parameter's only copy; stale ones are removed after the
     # rename phase below.
     for name in sorted(os.listdir(directory)):
-        if name.startswith("ps-shard-") and name.endswith(".tmp.npz"):
-            ingest(os.path.join(directory, name))
+        if name.startswith("ps-shard-") and ".tmp" in name and \
+                name.endswith(".npz"):
+            found_any = True
+            ingest(os.path.join(directory, name), tolerate_torn=True)
+    if not found_any or not params:
+        raise FileNotFoundError(
+            f"no restorable PS shard checkpoints under {directory}")
 
     specs = {n: int(a.nbytes) for n, a in params.items()}
     assignment = partition_params(specs, new_num_shards)
@@ -81,7 +104,9 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
     # we go would destroy a parameter's only on-disk copy (old shard file
     # overwritten) before its new home is written — a mid-run crash must
     # leave either the complete old layout or the complete new one
-    # recoverable, never a file set missing parameters.
+    # recoverable, never a file set missing parameters. Tmp names carry
+    # this run's pid so a rerun never overwrites a PREVIOUS run's
+    # leftover tmp (which may hold a parameter's only surviving copy).
     tmps = []
     for shard in range(new_num_shards):
         payload = {"__version__": np.asarray(version, np.int64)}
@@ -92,7 +117,7 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
             for sname, sval in slots.get(name, {}).items():
                 payload[f"s/{name}/{sname}"] = sval
         path = _shard_path(directory, shard)
-        tmp = path + ".tmp.npz"
+        tmp = path + f".tmp{os.getpid()}.npz"
         np.savez(tmp, **payload)
         tmps.append((tmp, path))
     for tmp, path in tmps:
@@ -102,16 +127,14 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
             os.remove(_shard_path(directory, i))
         except OSError:
             pass
-    # every parameter is now in a canonical file: stale tmps from a
-    # previous crashed run (for shard ids this layout didn't rewrite)
-    # are safe to drop
-    written = {tmp for tmp, _ in tmps}
+    # every parameter is now in a canonical file: leftover tmps (this
+    # run's are renamed away already; earlier crashed runs') are safe to
+    # drop
     for name in os.listdir(directory):
-        full = os.path.join(directory, name)
-        if name.startswith("ps-shard-") and name.endswith(".tmp.npz") \
-                and full not in written:
+        if name.startswith("ps-shard-") and ".tmp" in name and \
+                name.endswith(".npz"):
             try:
-                os.remove(full)
+                os.remove(os.path.join(directory, name))
             except OSError:
                 pass
     logger.info(

@@ -290,6 +290,15 @@ def start_ps_shard(shard_id: int, master_client=None,
     addr = shard.start(port=port)
     if master_client is not None:
         if num_shards is not None:
+            # read the PREVIOUS generation's count before overwriting it:
+            # it bounds the stale-key sweep even when the old key range
+            # has gaps (a shard that never registered must not shield the
+            # stale keys behind it from clearing)
+            prev = master_client.kv_store_get("ps/count")
+            try:
+                prev_count = int(prev) if prev else 0
+            except ValueError:
+                prev_count = 0
             # announce cluster size BEFORE the addr key: discovery keyed on
             # ps/count must never observe addr keys without the count, or a
             # worker racing registration adopts a partial list and computes
@@ -299,17 +308,16 @@ def start_ps_shard(shard_id: int, master_client=None,
             # (1) the value carries its generation (the announced count),
             #     so discovery rejects keys a DIFFERENT-sized generation
             #     wrote even if clearing races a straggler writer;
-            # (2) keys beyond the announced count are cleared, covering
-            #     the resize-back-to-a-previous-size case where the
-            #     count tag alone cannot distinguish generations.
+            # (2) keys beyond the announced count — swept up to the
+            #     previous generation's count regardless of gaps — are
+            #     cleared, covering resize-back-to-a-previous-size where
+            #     the count tag alone cannot distinguish generations.
             # Residual: a still-running straggler shard of a SAME-sized
             # previous generation re-registering late — the migration
             # driver's contract is to stop old shards before starting
             # new ones (the version bump is the sync point).
-            i = num_shards
-            while master_client.kv_store_get(f"ps/addr/{i}"):
+            for i in range(num_shards, max(prev_count, num_shards)):
                 master_client.kv_store_set(f"ps/addr/{i}", "")
-                i += 1
             master_client.kv_store_set(f"ps/addr/{shard_id}",
                                        f"{addr}|{num_shards}")
         else:

@@ -186,6 +186,48 @@ def test_flaky_rpc_absorbed_by_retries(master):
     client.close()
 
 
+@pytest.mark.slow
+def test_kill_restart_soak(master):
+    """Repeated external SIGKILL cycles: every round must be detected,
+    reported, and restarted until the budget genuinely runs out —
+    recovery machinery that only survives ONE fault is not recovery."""
+    rounds = 3
+    client = MasterClient(master.addr, node_id=0)
+    config = AgentConfig(
+        node_rank=0, node_id=0, nproc_per_node=1, min_nodes=1, max_nodes=1,
+        max_restarts=rounds, monitor_interval=0.2,
+        rdzv_waiting_timeout=5.0,
+    )
+    spec = WorkerSpec(
+        entrypoint=os.path.join(TESTDATA, "soak_worker.py"),
+        nproc_per_node=1, env=dict(WORKER_ENV),
+    )
+    agent = ElasticTrainingAgent(config, spec, client, host_ip="127.0.0.1")
+    result = {}
+    thread = threading.Thread(
+        target=lambda: result.update(rc=agent.run()), daemon=True
+    )
+    thread.start()
+
+    killed = 0
+    deadline = time.monotonic() + 120
+    while killed < rounds and time.monotonic() < deadline:
+        procs = getattr(agent._worker_group, "_procs", [])
+        pids = [p.pid for p in procs if p.poll() is None]
+        round_now = agent._worker_group.restart_round
+        if pids and round_now == killed:
+            time.sleep(0.5)  # let the round take a breath, then kill it
+            if kill_workers(pids):
+                killed += 1
+        time.sleep(0.1)
+    assert killed == rounds, f"only injected {killed}/{rounds} kills"
+
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+    assert result["rc"] == 0  # final (uninjected) round completes
+    assert agent._worker_group.restart_round == rounds
+
+
 def test_corrupt_latest_checkpoint_falls_back(tmp_path):
     """Torn-write the newest checkpoint; restore must come back from the
     newest GOOD step instead of crashing."""

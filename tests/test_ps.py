@@ -194,8 +194,6 @@ def test_repartition_rerun_recovers_param_from_leftover_tmp(tmp_path):
     """The crash window between batched renames: a parameter whose old
     home was already rewritten but whose new home only exists as a tmp
     file must survive a rerun (ingested from the tmp, not dropped)."""
-    import numpy as np
-
     from dlrover_tpu.ps.repartition import repartition_checkpoint
 
     d = str(tmp_path)
@@ -210,6 +208,10 @@ def test_repartition_rerun_recovers_param_from_leftover_tmp(tmp_path):
              **{"p/w": np.full((8, 8), 3.0),
                 "s/w/acc": np.ones((8, 8)),
                 "__version__": np.asarray(7)})
+    # plus a TORN tmp from the same killed run: must be skipped with a
+    # warning, not abort every rerun
+    with open(os.path.join(d, "ps-shard-0.npz.tmp999.npz"), "wb") as f:
+        f.write(b"PK\x03\x04 torn zip garbage")
 
     assignment = repartition_checkpoint(d, 2, 2)
     assert set(assignment) == {"w", "b", "e"}

@@ -1,0 +1,10 @@
+"""Soak worker: every round runs long enough to be SIGKILLed from
+outside; a round that survives ~3 s undisturbed exits cleanly."""
+
+import sys
+import time
+
+for _ in range(15):
+    time.sleep(0.2)
+print("soak worker: survived undisturbed, exiting cleanly", flush=True)
+sys.exit(0)
