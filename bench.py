@@ -147,7 +147,56 @@ def _pick_config(platform: str, preset: str):
     return cfg, batch, seq
 
 
+_PROBE_CACHE = {}
+
+
+def _probe_backend(timeout_s: float = 300.0):
+    """Backend init in a SUBPROCESS with a timeout, BEFORE this process
+    commits to it. A wedged accelerator tunnel blocks ``jax.devices()``
+    indefinitely inside a C call no Python timeout can interrupt — the
+    driver must get a JSON error line, not a hung bench. Honors the
+    BENCH_PLATFORM override exactly as ``_get_devices`` will apply it.
+    Cached: the MTTR phase and the MFU phase share one probe. Returns
+    (platform_name, error) — platform "" on failure."""
+    if "result" in _PROBE_CACHE:
+        return _PROBE_CACHE["result"]
+    import subprocess
+
+    override = os.environ.get("BENCH_PLATFORM", "")
+    prog = (
+        "import jax\n"
+        + (f"jax.config.update('jax_platforms', {override!r})\n"
+           if override else "")
+        + "print(jax.devices()[0].platform)\n"
+    )
+    platform, err = "", ""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", prog],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if probe.returncode == 0:
+            platform = (probe.stdout.strip().splitlines() or [""])[-1]
+        else:
+            err = f"backend init failed: {(probe.stderr or '')[-160:]}"
+    except subprocess.TimeoutExpired:
+        err = (f"backend init exceeded {timeout_s:.0f}s "
+               "(accelerator tunnel wedged?)")
+    except Exception as e:  # noqa: BLE001
+        err = f"{type(e).__name__}: {e}"[:200]
+    _PROBE_CACHE["result"] = (platform, err)
+    return platform, err
+
+
 def _get_devices(metric: str):
+    _, err = _probe_backend()
+    if err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "",
+            "vs_baseline": 0.0, "error": err,
+        }))
+        return None, RuntimeError(err)
+
     import jax
 
     platform_override = os.environ.get("BENCH_PLATFORM", "")
@@ -223,21 +272,7 @@ def _maybe_emit_mttr():
 
     if os.environ.get("BENCH_PLATFORM", "") == "cpu":
         return  # smoke runs: the MTTR claim is a TPU number
-    platform = ""
-    probe_err = ""
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=300,
-            env={**os.environ, "BENCH_PLATFORM": ""},
-        )
-        if probe.returncode == 0:
-            platform = (probe.stdout.strip().splitlines() or [""])[-1]
-        else:
-            probe_err = (probe.stderr or "")[-200:]
-    except Exception as e:  # noqa: BLE001
-        probe_err = f"{type(e).__name__}: {e}"[:200]
+    platform, probe_err = _probe_backend()
     def write_mttr(result):
         path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "MTTR.json"

@@ -46,8 +46,13 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
 
     def ingest(path, tolerate_torn=False):
         nonlocal version
+        # the whole read sits in the try: a torn tmp can fail at open OR
+        # at member decode (zip directory persisted, data blocks not)
         try:
-            data = np.load(path)
+            with np.load(path) as data:
+                staged = []
+                for key in data.files:
+                    staged.append((key, np.array(data[key])))
         except Exception:  # noqa: BLE001 — torn write from a killed run
             if tolerate_torn:
                 # safe to skip: tmp writes complete strictly BEFORE any
@@ -56,28 +61,34 @@ def repartition_checkpoint(directory: str, old_num_shards: int,
                 logger.warning("skipping unreadable leftover %s", path)
                 return
             raise
-        with data:
-            for key in data.files:
-                if key == "__version__":
-                    version = max(version, int(data[key]))
-                elif key.startswith("p/"):
-                    params.setdefault(key[2:], np.array(data[key]))
-                elif key.startswith("s/"):
-                    name, sname = key[2:].rsplit("/", 1)
-                    slots.setdefault(name, {}).setdefault(
-                        sname, np.array(data[key]))
+        for key, arr in staged:
+            if key == "__version__":
+                version = max(version, int(arr))
+            elif key.startswith("p/"):
+                params.setdefault(key[2:], arr)
+            elif key.startswith("s/"):
+                name, sname = key[2:].rsplit("/", 1)
+                slots.setdefault(name, {}).setdefault(sname, arr)
 
     found_any = False
     for i in range(old_num_shards):
         path = _shard_path(directory, i)
         if not os.path.exists(path):
-            # a rerun after a crash mid-removal of a downsize: the file
-            # may be legitimately gone (its params already live in the
-            # new layout). Tolerate; the complete-set raise below and the
-            # workers' name validation catch genuine loss.
-            logger.warning("old shard checkpoint %s missing (crashed "
-                           "earlier run?); continuing", path)
-            continue
+            if i >= new_num_shards:
+                # a crashed downsize rerun only ever REMOVES ids in
+                # [new, old) — a missing file there is the benign
+                # mid-removal state (its params already live in the
+                # rewritten lower ids)
+                logger.warning("old shard checkpoint %s missing "
+                               "(crashed downsize rerun); continuing",
+                               path)
+                continue
+            # ids below the new count get REWRITTEN, never removed: a
+            # missing one means genuine loss — fail before overwriting
+            # anything
+            raise FileNotFoundError(
+                f"missing PS shard checkpoint {path} (not explicable "
+                "by a crashed rerun; refusing to rewrite a partial set)")
         found_any = True
         ingest(path)
     # crash recovery: a previous repartition run killed between its
