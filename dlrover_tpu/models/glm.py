@@ -166,7 +166,8 @@ def prefix_lm_bias(seq_len: int, prefix_len: jax.Array,
 # -- forward ----------------------------------------------------------------
 
 
-def _attention(x, layer, c: GLMConfig, bias, prefix_len=None):
+def _attention(x, layer, c: GLMConfig, bias, prefix_len=None,
+               segment_ids=None):
     b, s, d = x.shape
     h, hd = c.num_heads, c.head_dim
     q = (x @ layer["q_proj"]["kernel"] + layer["q_proj"]["bias"]
@@ -176,7 +177,19 @@ def _attention(x, layer, c: GLMConfig, bias, prefix_len=None):
     v = (x @ layer["v_proj"]["kernel"] + layer["v_proj"]["bias"]
          ).reshape(b, s, h, hd)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    if prefix_len is not None and c.use_flash:
+    # segment dispatch comes FIRST (the sibling families' discipline):
+    # the plain-flash branch below also matches when segment_ids is set
+    # (bias is None then), and taking it would silently drop the
+    # per-document mask
+    if segment_ids is not None:
+        from dlrover_tpu.ops.flash_attention import segmented_attention
+
+        out = segmented_attention(
+            q, k, v, segment_ids, c.use_flash,
+            block_q=c.flash_block_q, block_k=c.flash_block_k,
+            interpret=c.flash_interpret,
+        )
+    elif prefix_len is not None and c.use_flash:
         # the prefix-LM mask fused into the Pallas tiles — no S x S bias
         from dlrover_tpu.ops.flash_attention import (
             flash_attention_prefix_auto,
@@ -200,12 +213,13 @@ def _attention(x, layer, c: GLMConfig, bias, prefix_len=None):
     return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
 
 
-def _block(c: GLMConfig, bias, prefix_len=None):
+def _block(c: GLMConfig, bias, prefix_len=None, segment_ids=None):
     def block(x, layer):
         layer = cast_floats(layer, c.compute_dtype)
         attn_in = _layer_norm(x, layer["input_norm"]["scale"],
                               layer["input_norm"]["bias"], c.ln_eps)
-        x = x + _attention(attn_in, layer, c, bias, prefix_len)
+        x = x + _attention(attn_in, layer, c, bias, prefix_len,
+                           segment_ids)
         mlp_in = _layer_norm(x, layer["post_norm"]["scale"],
                              layer["post_norm"]["bias"], c.ln_eps)
         up = mlp_in @ layer["up_proj"]["kernel"] + layer["up_proj"]["bias"]
@@ -218,10 +232,17 @@ def _block(c: GLMConfig, bias, prefix_len=None):
 
 def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
           rng: Optional[jax.Array] = None,
-          prefix_len: Optional[jax.Array] = None) -> jax.Array:
-    """prefix_len: [B] int array; None means pure causal LM (flash path)."""
+          prefix_len: Optional[jax.Array] = None,
+          segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """prefix_len: [B] int array; None means pure causal LM (flash path).
+    segment_ids: [B, S] packed-document mode (causal per document,
+    positions restarting per segment) — mutually exclusive with
+    prefix_len."""
     c = config
     b, s = input_ids.shape
+    if prefix_len is not None and segment_ids is not None:
+        raise ValueError("prefix_len and segment_ids are mutually "
+                         "exclusive GLM modes")
     x = params["embed_tokens"]["embedding"][input_ids]
     if prefix_len is not None:
         pos_ids, block_ids = glm_positions(s, prefix_len)
@@ -229,6 +250,12 @@ def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
         # bias is only materialized for the reference (use_flash=False)
         bias = (None if c.use_flash
                 else prefix_lm_bias(s, prefix_len, c.compute_dtype))
+    elif segment_ids is not None:
+        from dlrover_tpu.models.common import segment_positions
+
+        pos_ids = segment_positions(segment_ids)
+        block_ids = jnp.zeros((b, s), jnp.int32)
+        bias = None
     else:
         pos_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
         block_ids = jnp.zeros((b, s), jnp.int32)
@@ -237,7 +264,8 @@ def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
         + params["block_pos_embed"]["embedding"][block_ids]
     x = x.astype(c.compute_dtype)
 
-    block = apply_remat(_block(c, bias, prefix_len), c.remat_policy)
+    block = apply_remat(_block(c, bias, prefix_len, segment_ids),
+                        c.remat_policy)
     x, _ = lax.scan(block, x, params["layers"])
     x = _layer_norm(x, params["final_norm"]["scale"],
                     params["final_norm"]["bias"], c.ln_eps)
@@ -253,13 +281,18 @@ def make_init_fn(config: GLMConfig):
 
 
 def make_loss_fn(config: GLMConfig, z_loss_weight: float = 0.0):
-    """Batches: {"input_ids", "labels"} (+ optional "prefix_len" [B]).
-    With prefix_len present, loss is typically masked to the generation
-    span via labels==-100 over the prefix (HF convention)."""
+    """Batches: {"input_ids", "labels"} (+ optional "prefix_len" [B] or
+    "segment_ids" [B, S] — mutually exclusive). With prefix_len, loss is
+    typically masked to the generation span via labels==-100 over the
+    prefix (HF convention). With segment_ids (packed documents), labels
+    at segment boundaries MUST be -100: the attention mask stops reads
+    across documents, but only label masking stops the last token of one
+    document being trained to predict the first of the next."""
 
     def loss_fn(params, batch, rng):
         logits = apply(params, batch["input_ids"], config, rng,
-                       prefix_len=batch.get("prefix_len"))
+                       prefix_len=batch.get("prefix_len"),
+                       segment_ids=batch.get("segment_ids"))
         return masked_lm_loss(logits, batch["labels"], z_loss_weight), {}
 
     return loss_fn

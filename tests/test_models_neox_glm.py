@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from dlrover_tpu.models import glm, gpt_neox
 from dlrover_tpu.parallel.accelerate import accelerate
@@ -174,6 +175,37 @@ class TestGLM:
             state, m = result.train_step(state, sb, jax.random.PRNGKey(i))
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0] * 0.7
+
+    def test_packed_segments_equal_separate_documents(self):
+        # BOTH dispatch paths: the bias reference (use_flash=False) and
+        # the fused kernel (use_flash=True, interpret) — the flash
+        # branch ordering silently dropping the mask is the regression
+        # this guards
+        for cfg in (glm.glm_tiny(),
+                    glm.glm_tiny(use_flash=True, flash_interpret=True)):
+            params = glm.init(jax.random.PRNGKey(0), cfg)
+            rng = np.random.RandomState(0)
+            doc_a = rng.randint(0, cfg.vocab_size, (1, 14))
+            doc_b = rng.randint(0, cfg.vocab_size, (1, 18))
+            packed_ids = jnp.asarray(
+                np.concatenate([doc_a, doc_b], axis=1))
+            seg = jnp.asarray([[0] * 14 + [1] * 18])
+            packed = glm.apply(params, packed_ids, cfg, segment_ids=seg)
+            alone_a = glm.apply(params, jnp.asarray(doc_a), cfg)
+            alone_b = glm.apply(params, jnp.asarray(doc_b), cfg)
+            np.testing.assert_allclose(packed[0, :14], alone_a[0],
+                                       atol=2e-5, rtol=2e-5)
+            np.testing.assert_allclose(packed[0, 14:], alone_b[0],
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_prefix_and_segments_mutually_exclusive(self):
+        cfg = glm.glm_tiny()
+        params = glm.init(jax.random.PRNGKey(0), cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            glm.apply(params, ids, cfg,
+                      prefix_len=jnp.asarray([2]),
+                      segment_ids=jnp.zeros((1, 8), jnp.int32))
 
     def test_param_counts(self):
         assert glm.param_count(glm.glm_tiny()) > 0
