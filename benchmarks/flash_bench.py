@@ -115,7 +115,70 @@ def main() -> int:
             "stock_best_config": stock_best if stock_ok else None,
             "speedup": round(stock_t / ours_t, 3) if stock_ok else None,
         }))
+
+    if os.environ.get("FLASH_BENCH_MASKS", "1") == "1":
+        _mask_variants()
     return 0
+
+
+def _mask_variants():
+    """Fused masking vs materialized bias: the segmented (packed) and
+    prefix-LM kernels against the XLA reference with an additive S x S
+    bias — the memory/time cost the fused masks exist to remove."""
+    from dlrover_tpu.ops.flash_attention import (
+        flash_attention_prefix,
+        flash_attention_segmented,
+        segmented_attention,
+    )
+
+    for b, h, hkv, s, d in SHAPES:
+        q, k, v = _inputs(b, h, hkv, s, d)
+        bq, bk = min(1024, s), min(1024, s)
+
+        # packed: 4 documents per row, uneven boundaries
+        seg_np = np.sort(
+            np.random.RandomState(1).randint(0, 4, (b, s)), axis=1
+        ).astype(np.int32)
+        seg = jnp.asarray(seg_np)
+        seg_t = _time_fwd_bwd(
+            lambda q, k, v: flash_attention_segmented(
+                q, k, v, seg, True, block_q=bq, block_k=bk),
+            q, k, v,
+        )
+        try:
+            # the PRODUCTION bias dispatch (use_flash=False), not a
+            # hand-rolled replica — this is exactly what the fused
+            # kernel replaces; everything (incl. the S x S bias its
+            # trace materializes) stays inside the try, since that
+            # allocation is the thing expected to blow up at long S
+            bias_t = _time_fwd_bwd(
+                lambda q, k, v: segmented_attention(
+                    q, k, v, seg, use_flash=False),
+                q, k, v,
+            )
+        except Exception as e:  # noqa: BLE001 — S x S bias can OOM
+            bias_t = None
+            print(f"# bias path failed (expected at long S): {e}"[:160])
+        print(json.dumps({
+            "metric": "segmented_fused_vs_bias",
+            "shape": f"b{b}h{h}s{s}d{d}",
+            "fused_ms": round(seg_t * 1e3, 2),
+            "bias_ms": round(bias_t * 1e3, 2) if bias_t else None,
+            "speedup": round(bias_t / seg_t, 3) if bias_t else None,
+        }))
+
+        # prefix-LM: prompt = S/4
+        prefix = jnp.full((b,), s // 4, jnp.int32)
+        pre_t = _time_fwd_bwd(
+            lambda q, k, v: flash_attention_prefix(
+                q, k, v, prefix, block_q=bq, block_k=bk),
+            q, k, v,
+        )
+        print(json.dumps({
+            "metric": "prefix_fused",
+            "shape": f"b{b}h{h}s{s}d{d}",
+            "fused_ms": round(pre_t * 1e3, 2),
+        }))
 
 
 if __name__ == "__main__":

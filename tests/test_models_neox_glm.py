@@ -59,20 +59,25 @@ class TestGPTNeoX:
         assert not np.allclose(np.asarray(out_p), np.asarray(out_s))
 
     def test_packed_segments_equal_separate_documents(self):
-        cfg = gpt_neox.neox_tiny()
-        params = gpt_neox.init(jax.random.PRNGKey(0), cfg)
-        rng = np.random.RandomState(0)
-        doc_a = rng.randint(0, cfg.vocab_size, (1, 12))
-        doc_b = rng.randint(0, cfg.vocab_size, (1, 20))
-        packed_ids = jnp.asarray(np.concatenate([doc_a, doc_b], axis=1))
-        seg = jnp.asarray([[0] * 12 + [1] * 20])
-        packed = gpt_neox.apply(params, packed_ids, cfg, segment_ids=seg)
-        alone_a = gpt_neox.apply(params, jnp.asarray(doc_a), cfg)
-        alone_b = gpt_neox.apply(params, jnp.asarray(doc_b), cfg)
-        np.testing.assert_allclose(packed[0, :12], alone_a[0],
-                                   atol=2e-5, rtol=2e-5)
-        np.testing.assert_allclose(packed[0, 12:], alone_b[0],
-                                   atol=2e-5, rtol=2e-5)
+        # both dispatch paths: bias reference and fused kernel
+        for cfg in (gpt_neox.neox_tiny(),
+                    gpt_neox.neox_tiny(use_flash=True,
+                                       flash_interpret=True)):
+            params = gpt_neox.init(jax.random.PRNGKey(0), cfg)
+            rng = np.random.RandomState(0)
+            doc_a = rng.randint(0, cfg.vocab_size, (1, 12))
+            doc_b = rng.randint(0, cfg.vocab_size, (1, 20))
+            packed_ids = jnp.asarray(
+                np.concatenate([doc_a, doc_b], axis=1))
+            seg = jnp.asarray([[0] * 12 + [1] * 20])
+            packed = gpt_neox.apply(params, packed_ids, cfg,
+                                    segment_ids=seg)
+            alone_a = gpt_neox.apply(params, jnp.asarray(doc_a), cfg)
+            alone_b = gpt_neox.apply(params, jnp.asarray(doc_b), cfg)
+            np.testing.assert_allclose(packed[0, :12], alone_a[0],
+                                       atol=2e-5, rtol=2e-5)
+            np.testing.assert_allclose(packed[0, 12:], alone_b[0],
+                                       atol=2e-5, rtol=2e-5)
 
     def test_overfits_tiny_batch_sharded(self):
         cfg = gpt_neox.neox_tiny()
