@@ -160,6 +160,11 @@ def _probe_backend(timeout_s: float = 300.0):
     (platform_name, error) — platform "" on failure."""
     if "result" in _PROBE_CACHE:
         return _PROBE_CACHE["result"]
+    if os.environ.get("BENCH_IN_RECOVERY_WORKER"):
+        # the kill-to-first-step window is the METRIC: the worker must
+        # not pay a throwaway full backend init for a guard the driver's
+        # _wait_status timeout already provides
+        return "", ""
     import subprocess
 
     override = os.environ.get("BENCH_PLATFORM", "")
@@ -538,6 +543,7 @@ def recovery_result() -> dict:
 
     env = dict(os.environ)
     env["DLROVER_COMPILE_CACHE_DIR"] = cache_dir
+    env["BENCH_IN_RECOVERY_WORKER"] = "1"  # skip the backend-init probe
     # recovery workers use the recovery-sized model unless overridden;
     # drop the caller's MFU shape knobs so e.g. BENCH_SEQ=16384 from a
     # long-context MFU run can't reshape the recovery model

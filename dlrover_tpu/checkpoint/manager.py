@@ -226,6 +226,20 @@ class ElasticCheckpointManager:
         if not os.path.isdir(src):
             return
         with self._mirror_lock:  # serialize: mirrors must not interleave
+            # reclaim tmp dirs orphaned by a crash mid-copy (the exact
+            # preemption staging exists for): the keep-newest cleanup
+            # below only understands numbered step dirs, so without this
+            # every crashed mirror permanently leaks tmpfs until the
+            # free-space gate silently disables staging altogether
+            try:
+                for name in os.listdir(self._staging_root):
+                    if name.startswith(".tmp_"):
+                        shutil.rmtree(
+                            os.path.join(self._staging_root, name),
+                            ignore_errors=True,
+                        )
+            except OSError:
+                pass
             newest = self.staged_step()
             if newest is not None and not self._staging_provenance_valid():
                 # leftovers from a previous job at this checkpoint path:

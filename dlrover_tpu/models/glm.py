@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from dlrover_tpu.models.common import (
     cast_floats,
@@ -209,6 +210,9 @@ def _attention(x, layer, c: GLMConfig, bias, prefix_len=None,
         # prefix-LM mask rides as an additive bias; causal=False because
         # the bias already encodes the causal part
         out = mha_reference(q, k, v, bias=bias, causal=bias is None)
+    # named so the "attn_saveable" remat policy keeps the attention
+    # outputs for this family too
+    out = checkpoint_name(out, "attn_out")
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
     return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
 

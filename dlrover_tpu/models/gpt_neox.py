@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from dlrover_tpu.models.common import (
     cast_floats,
@@ -180,6 +181,10 @@ def _attention(x, layer, c: GPTNeoXConfig, positions, segment_ids=None):
                                    interpret=c.flash_interpret)
     else:
         out = mha_reference(q, k, v, causal=True)
+    # named so the "attn_saveable" remat policy can keep exactly the
+    # attention outputs (without the tag the policy silently saves
+    # nothing for this family)
+    out = checkpoint_name(out, "attn_out")
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
     return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
 

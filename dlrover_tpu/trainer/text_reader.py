@@ -275,11 +275,16 @@ class ShardedTextBatches:
                 continue
             for lo in range(0, len(records), self._batch):
                 chunk = records[lo:lo + self._batch]
-                if len(chunk) < self._batch:
+                n_real = len(chunk)
+                if n_real < self._batch:
                     # pad the tail batch to a static shape (XLA: one
-                    # compiled program) by repeating the last record
-                    chunk = chunk + [chunk[-1]] * (
-                        self._batch - len(chunk))
-                yield self._render(chunk)
+                    # compiled program) by repeating the last record —
+                    # with the copies' labels masked, or the repeated
+                    # record would train at (batch - n_real + 1)x weight
+                    chunk = chunk + [chunk[-1]] * (self._batch - n_real)
+                batch = self._render(chunk)
+                if n_real < self._batch:
+                    batch["labels"][n_real:] = -100
+                yield batch
                 self._client.report_batch_done()
             self._client.report_task_done()
