@@ -26,6 +26,8 @@ from dlrover_tpu.models.common import (
     layer_norm as _layer_norm,
     param_count as common_param_count,
 )
+from jax.ad_checkpoint import checkpoint_name
+
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
 from dlrover_tpu.ops.remat import apply_remat
@@ -138,6 +140,8 @@ def _attention(x, layer, config: BertConfig, mask):
                 jnp.finfo(jnp.float32).min,
             )
         out = mha_reference(q, k, v, causal=False, bias=bias)
+    # named for the "attn_saveable" remat policy
+    out = checkpoint_name(out, "attn_out")
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
     return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
 

@@ -25,6 +25,8 @@ from dlrover_tpu.models.common import (
     layer_norm as _layer_norm,
     param_count as common_param_count,
 )
+from jax.ad_checkpoint import checkpoint_name
+
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
 from dlrover_tpu.ops.remat import apply_remat
@@ -148,6 +150,8 @@ def _attention(x, layer, t: TowerConfig, causal: bool, use_flash: bool):
         out = flash_attention_auto(q, k, v, causal)
     else:
         out = mha_reference(q, k, v, causal=causal)
+    # named for the "attn_saveable" remat policy
+    out = checkpoint_name(out, "attn_out")
     return out.transpose(0, 2, 1, 3).reshape(b, s, h * hd) @ (
         layer["o_proj"]["kernel"]
     )

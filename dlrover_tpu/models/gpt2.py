@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from dlrover_tpu.models.losses import masked_lm_loss
+from jax.ad_checkpoint import checkpoint_name
+
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
 from dlrover_tpu.ops.remat import apply_remat
@@ -117,6 +119,9 @@ def apply(params: Dict, input_ids: jax.Array, config: GPT2Config,
             attn = flash_attention_auto(q, k, v, True)
         else:
             attn = mha_reference(q, k, v, causal=True)
+        # named for the "attn_saveable" remat policy (which otherwise
+        # silently saves nothing for this family)
+        attn = checkpoint_name(attn, "attn_out")
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
         x = x + attn @ layer["o_proj"]["kernel"]
         h = _layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"],

@@ -112,6 +112,49 @@ class ByteTokenizer:
         return np.concatenate([np.asarray([1], np.int32), ids])
 
 
+class HFTokenizerAdapter:
+    """Plug a HuggingFace tokenizer (``transformers`` PreTrained* or a
+    raw ``tokenizers.Tokenizer``) into the shard-fed batch source: maps
+    its encode onto the fixed-shape ``__call__`` (padded mode) and the
+    variable-length ``encode`` (packed mode) this pipeline expects.
+    ``ByteTokenizer`` remains the zero-dependency default; this is the
+    production-vocabulary path."""
+
+    def __init__(self, tokenizer, seq_len: int,
+                 pad_id: int = 0, bos_id: Optional[int] = None):
+        self._tok = tokenizer
+        self.seq_len = seq_len
+        self.pad_id = pad_id
+        self.bos_id = bos_id
+        size = getattr(tokenizer, "vocab_size", None)
+        if size is None and hasattr(tokenizer, "get_vocab_size"):
+            size = tokenizer.get_vocab_size()
+        self.vocab_size = int(size)
+
+    def _ids(self, record: bytes) -> List[int]:
+        text = record.decode("utf-8", errors="replace")
+        try:
+            # transformers tokenizers inject their own specials by
+            # default (duplicated BOS, [CLS]/[SEP] in every record) —
+            # this pipeline owns special-token placement
+            encoded = self._tok.encode(text, add_special_tokens=False)
+        except TypeError:  # raw `tokenizers.Tokenizer`: no such kwarg
+            encoded = self._tok.encode(text)
+        ids = encoded if isinstance(encoded, list) else encoded.ids
+        if self.bos_id is not None:
+            ids = [self.bos_id] + list(ids)
+        return list(ids)
+
+    def encode(self, record: bytes) -> np.ndarray:
+        return np.asarray(self._ids(record), np.int32)
+
+    def __call__(self, record: bytes) -> np.ndarray:
+        ids = self._ids(record)[: self.seq_len]
+        out = np.full((self.seq_len,), self.pad_id, np.int32)
+        out[: len(ids)] = ids
+        return out
+
+
 class ShardedTextBatches:
     """Dynamic-shard consumption loop over a line-indexed text file.
 
@@ -159,7 +202,18 @@ class ShardedTextBatches:
         ids = np.stack([self._tok(r) for r in records])
         labels = np.full_like(ids, -100)
         labels[:, :-1] = ids[:, 1:]
-        labels[labels == 0] = -100  # don't train on pad
+        # mask pad by POSITION (the trailing pad run), not by token id —
+        # masking every occurrence of the id would silently untrain real
+        # tokens sharing it (the common pad == eos convention)
+        pad_id = getattr(self._tok, "pad_id", 0)
+        not_pad = ids != pad_id
+        has_any = not_pad.any(axis=1)
+        lengths = np.where(
+            has_any, ids.shape[1] - np.argmax(not_pad[:, ::-1], axis=1), 0
+        )
+        # labels[t] predicts ids[t+1]: valid only while t+1 < length
+        t = np.arange(ids.shape[1])[None, :]
+        labels[t >= lengths[:, None] - 1] = -100
         return {"input_ids": ids, "labels": labels}
 
     # -- packed mode --------------------------------------------------------

@@ -23,7 +23,8 @@ SURFACE = {
                                  "DevicePreloader"],
     "dlrover_tpu.trainer.text_reader": ["LineIndexedFile",
                                         "ByteTokenizer",
-                                        "ShardedTextBatches"],
+                                        "ShardedTextBatches",
+                                        "HFTokenizerAdapter"],
     "dlrover_tpu.checkpoint.manager": ["ElasticCheckpointManager",
                                        "abstract_like"],
     "dlrover_tpu.agent.master_client": ["MasterClient"],

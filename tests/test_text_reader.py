@@ -60,6 +60,58 @@ class TestByteTokenizer:
         assert (out[1:] == np.frombuffer(b"abc", np.uint8) + 2).all()
 
 
+class TestHFTokenizerAdapter:
+    def _tokenizer(self):
+        # a real `tokenizers` tokenizer built in memory (no network)
+        from tokenizers import Tokenizer, models
+        from tokenizers.pre_tokenizers import Whitespace
+
+        vocab = {"<pad>": 0, "<bos>": 1, "<unk>": 2}
+        for i, w in enumerate(["line", "number", "with", "some", "text"]
+                              + [str(n) for n in range(100)]):
+            vocab[w] = i + 3
+        t = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+        t.pre_tokenizer = Whitespace()
+        return t
+
+    def test_padded_and_packed_modes(self, corpus):
+        from dlrover_tpu.trainer.text_reader import HFTokenizerAdapter
+
+        path, lines = corpus
+        tok = HFTokenizerAdapter(self._tokenizer(), seq_len=16,
+                                 pad_id=0, bos_id=1)
+        assert tok.vocab_size == 108
+        fixed = tok(lines[7].encode())
+        assert fixed.shape == (16,) and fixed[0] == 1  # bos
+        var = tok.encode(lines[7].encode())
+        assert var.ndim == 1 and var[0] == 1 and len(var) <= 16
+
+        master = start_local_master()
+        try:
+            reader = LineIndexedFile(path)
+            client = MasterClient(master.addr, node_id=0)
+            for name, pack in (("hf_pad", False), ("hf_pack", True)):
+                sc = ShardingClient(
+                    client, dataset_name=name, batch_size=4,
+                    dataset_size=reader.count(), num_epochs=1,
+                    num_minibatches_per_shard=2,
+                )
+                source = ShardedTextBatches(
+                    sc, reader, batch_size=4, tokenizer=tok, seq_len=16,
+                    pack=pack,
+                )
+                batches = list(source)
+                assert batches, name
+                for b in batches:
+                    assert b["input_ids"].shape == (4, 16)
+                    # pad ids never trained on
+                    trained = b["labels"] != -100
+                    assert (b["labels"][trained] != 0).all()
+            client.close()
+        finally:
+            master.stop()
+
+
 class TestPackedBatches:
     def test_packing_consumes_all_tokens_with_segments(self, corpus):
         path, lines = corpus
