@@ -112,6 +112,50 @@ class TestHFTokenizerAdapter:
             master.stop()
 
 
+class TestPadLabelMasking:
+    def test_interior_pad_id_tokens_keep_labels(self, tmp_path):
+        """pad == eos convention: a REAL token sharing the pad id inside
+        the sequence must keep its label — only the trailing pad run is
+        masked (masking by id would silently untrain EOS everywhere)."""
+
+        class IdTok:
+            pad_id = 7
+            vocab_size = 16
+            seq_len = 8
+
+            def __call__(self, record):
+                # record "a b" -> [3, 7, 4] then padded with 7s: the
+                # interior 7 is a REAL token (eos-like), trailing 7s pad
+                ids = np.full((8,), 7, np.int32)
+                ids[:3] = [3, 7, 4]
+                return ids
+
+        path = tmp_path / "one.txt"
+        path.write_text("x\n" * 4)
+        master = start_local_master()
+        try:
+            reader = LineIndexedFile(str(path))
+            client = MasterClient(master.addr, node_id=0)
+            sc = ShardingClient(
+                client, dataset_name="padmask", batch_size=4,
+                dataset_size=reader.count(), num_epochs=1,
+                num_minibatches_per_shard=1,
+            )
+            source = ShardedTextBatches(sc, reader, batch_size=4,
+                                        tokenizer=IdTok(), seq_len=8)
+            batch = next(iter(source))
+            labels = batch["labels"]
+            # label[0] predicts ids[1] == 7 (the real interior token):
+            # must be TRAINED; label[1] predicts ids[2] == 4: trained;
+            # labels from position 2 on point into the trailing pad run
+            assert (labels[:, 0] == 7).all()
+            assert (labels[:, 1] == 4).all()
+            assert (labels[:, 2:] == -100).all()
+            client.close()
+        finally:
+            master.stop()
+
+
 class TestPackedBatches:
     def test_packing_consumes_all_tokens_with_segments(self, corpus):
         path, lines = corpus
