@@ -13,7 +13,13 @@ it (the injected preemption), restarts it, and reports the wall time
 from kill to the first post-restore completed step.
 
 Env knobs:
-  BENCH_PLATFORM=cpu     run the benchmark logic on CPU (smoke test)
+  BENCH_PLATFORM=cpu     run the benchmark logic on CPU (smoke test).
+                         Steers EVERY phase uniformly, including the
+                         backend probe the MTTR phase shares with the
+                         MFU phase: =cpu skips MTTR entirely (a CPU
+                         number must never stand against the TPU
+                         target); any other value makes the MTTR probe
+                         test that backend, not the default one.
   BENCH_STEPS=N          timed steps (default 20)
   BENCH_RECOVERY_STEPS=N recovery-worker training steps (default 60)
   BENCH_PRESET=tiny|1b|long  model size; "long" = 16k-token context on

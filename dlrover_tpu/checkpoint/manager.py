@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional
 
 import jax
 
+from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("checkpoint.manager")
@@ -99,17 +100,22 @@ class ElasticCheckpointManager:
         import orbax.checkpoint as ocp
 
         from dlrover_tpu.common.config import get_context
-        from dlrover_tpu.common.constants import NodeEnv
 
         # staging provenance token. A path-local uuid file alone cannot
         # survive the very outage staging exists for (primary root wiped
         # => the uuid is gone => a fresh uuid rejects the good mirror and
         # the job silently restarts from scratch). A caller-stable run
-        # identity (job name under the launcher env contract) survives
-        # primary loss while still fencing out a DIFFERENT job reusing
-        # the path.
-        self._run_identity = run_identity or os.environ.get(
-            NodeEnv.JOB_NAME, "")
+        # identity survives primary loss while still fencing out another
+        # run reusing the path. RUN_ID (job name + launch epoch, set by
+        # the scalers) is preferred over the bare JOB_NAME: a brand-new
+        # job reusing the same name and checkpoint path — the common
+        # rerun pattern — must NOT adopt the previous run's staged
+        # weights, which a name-only token would allow.
+        self._run_identity = (
+            run_identity
+            or os.environ.get(NodeEnv.RUN_ID, "")
+            or os.environ.get(NodeEnv.JOB_NAME, "")
+        )
 
         self._ocp = ocp
         ctx = get_context()
@@ -397,11 +403,28 @@ class ElasticCheckpointManager:
                 # the primary ROOT vanished after construction (the
                 # constructor makedirs it, so a fresh job always has
                 # one): storage outage — the mirror is the survivor
+                logger.warning(
+                    "adopting staged checkpoint step=%d: primary root "
+                    "%s is GONE (storage outage path). If this is a "
+                    "fresh run, these are a previous run's weights — "
+                    "clear %s to start from scratch.",
+                    step, self.directory, self._staging_root,
+                )
                 return True
             # root present but step missing: trust the mirror only for
-            # the SAME primary root (a fresh job recreating the path
+            # the SAME run identity (a fresh job recreating the path
             # must not inherit the previous job's weights)
-            return self._staging_provenance_valid()
+            ok = self._staging_provenance_valid()
+            if ok:
+                logger.warning(
+                    "adopting staged checkpoint step=%d under identity "
+                    "'%s' with an EMPTY primary %s. A same-named fresh "
+                    "run inherits the previous run's weights here — set "
+                    "%s (or pass run_identity) to fence runs apart.",
+                    step, self._primary_identity(), self.directory,
+                    NodeEnv.RUN_ID,
+                )
+            return ok
         return self._dir_digest(src) == recorded
 
     def staged_step(self) -> Optional[int]:

@@ -101,6 +101,11 @@ class NodeEnv:
 
     MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
     JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    # unique per job LAUNCH (name + launch epoch, set by the scalers):
+    # stable across worker relaunches within one job instance, rotates
+    # when a fresh job reuses the name — the checkpoint staging
+    # provenance token prefers it over the bare job name
+    RUN_ID = "DLROVER_TPU_RUN_ID"
     NODE_ID = "DLROVER_TPU_NODE_ID"
     NODE_RANK = "DLROVER_TPU_NODE_RANK"
     NODE_NUM = "DLROVER_TPU_NODE_NUM"

@@ -47,11 +47,18 @@ class ActorScaler(Scaler):
         return alive
 
     def _actor_args(self, node: Node) -> ActorArgs:
+        from dlrover_tpu.common.constants import NodeEnv
+
         env = {
             "DLROVER_MASTER_ADDR": self._master_addr,
             "NODE_TYPE": node.type,
             "NODE_ID": str(node.id),
             "NODE_RANK": str(node.rank_index),
+            # checkpoint staging provenance fence (same contract as the
+            # pod/process scalers): a same-named fresh Ray job must not
+            # adopt a previous run's staged weights
+            NodeEnv.JOB_NAME: self.job_name,
+            NodeEnv.RUN_ID: self.run_id,
         }
         if self._env_factory is not None:
             env.update(self._env_factory(node))

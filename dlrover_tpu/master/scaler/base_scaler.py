@@ -61,8 +61,30 @@ class ScalePlan:
 class Scaler(ABC):
     """Executes ScalePlans against a platform (reference: Scaler)."""
 
-    def __init__(self, job_name: str):
+    def __init__(self, job_name: str, run_id: str = ""):
+        import os
+        import time
+        import uuid
+
+        from dlrover_tpu.common.constants import NodeEnv
+
         self.job_name = job_name
+        # Run identity: the checkpoint staging provenance fence
+        # (NodeEnv.RUN_ID) handed to every node this scaler launches.
+        # Resolution order keeps it stable per JOB INSTANCE, not per
+        # master process:
+        #   1. explicit arg — a durable platform identity (k8s job UID);
+        #   2. the master's own env — on k8s the operator stamps the
+        #      master pod with the job-UID token, so a RESTARTED master
+        #      re-issues the same fence and staged mirrors stay valid;
+        #   3. generated name+epoch+nonce — local/dev fallback: a master
+        #      restart rotates the fence (staging falls back to primary
+        #      storage), the price of fencing same-named fresh reruns.
+        self.run_id = (
+            run_id
+            or os.environ.get(NodeEnv.RUN_ID, "")
+            or f"{job_name}-{int(time.time())}-{uuid.uuid4().hex[:6]}"
+        )
 
     @abstractmethod
     def scale(self, plan: ScalePlan) -> None:

@@ -81,6 +81,7 @@ class PodScaler(Scaler):
         return {
             NodeEnv.MASTER_ADDR: self._master_addr,
             NodeEnv.JOB_NAME: self.job_name,
+            NodeEnv.RUN_ID: self.run_id,
             NodeEnv.NODE_ID: str(node.id),
             NodeEnv.NODE_RANK: str(node.rank_index),
             NodeEnv.NODE_NUM: str(max(self._node_num, 1)),

@@ -47,6 +47,7 @@ class LocalProcessScaler(Scaler):
         env = {
             NodeEnv.MASTER_ADDR: self._master_addr,
             NodeEnv.JOB_NAME: self.job_name,
+            NodeEnv.RUN_ID: self.run_id,
             NodeEnv.NODE_ID: str(node.id),
             NodeEnv.NODE_RANK: str(node.rank_index),
             NodeEnv.NODE_NUM: str(node_num),

@@ -17,6 +17,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.operator.types import ElasticJob, JobPhase, ScalePlan
 from dlrover_tpu.scheduler.kubernetes import (
@@ -65,7 +66,16 @@ def build_master_pod(job: ElasticJob, master_image: str) -> Dict[str, Any]:
         ],
         cpu=2,
         memory_mb=4096,
-        env={**job.envs, "DLROVER_JOB_NAME": job.name},
+        env={
+            **job.envs,
+            "DLROVER_JOB_NAME": job.name,
+            # job-UID-based fence, inherited by the master's Scaler and
+            # re-issued to every worker: stable across master restarts
+            # within this job instance, rotates when the job is deleted
+            # and recreated (checkpoint staging provenance)
+            **({NodeEnv.RUN_ID: f"{job.name}-{job.uid}"}
+               if job.uid else {}),
+        },
     )
     pod["metadata"]["labels"]["elasticjob-role"] = "master"
     return pod

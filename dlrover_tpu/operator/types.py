@@ -67,6 +67,10 @@ class ReplicaSpec:
 class ElasticJob:
     name: str
     namespace: str = "default"
+    # k8s metadata.uid: durable per job INSTANCE — a deleted-and-
+    # recreated job gets a new one, the provenance token for
+    # checkpoint staging (NodeEnv.RUN_ID)
+    uid: str = ""
     distribution_strategy: str = "spmd"
     optimize_mode: str = "single-job"
     enable_dynamic_sharding: bool = True
@@ -86,6 +90,7 @@ class ElasticJob:
         return cls(
             name=meta.get("name", ""),
             namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid", ""),
             distribution_strategy=spec.get("distributionStrategy", "spmd"),
             optimize_mode=spec.get("optimizeMode", "single-job"),
             enable_dynamic_sharding=spec.get("enableDynamicSharding", True),

@@ -76,6 +76,7 @@ class LineIndexedFile:
         call; contiguous runs share one seek — a fully shuffled shard is
         seeks, not open/close pairs (which dominate on network fs)."""
         out: List[bytes] = []
+        dropped = 0
         with open(self.path, "rb") as f:
             i = 0
             while i < len(indices):
@@ -84,10 +85,23 @@ class LineIndexedFile:
                         indices[j + 1] == indices[j] + 1:
                     j += 1
                 if indices[i] < self.count():
-                    self._read_range_into(
-                        f, indices[i], min(indices[j] + 1, self.count()),
-                        out)
+                    upper = min(indices[j] + 1, self.count())
+                    dropped += indices[j] + 1 - upper
+                    self._read_range_into(f, indices[i], upper, out)
+                else:
+                    dropped += j + 1 - i
                 i = j + 1
+        if dropped:
+            # the sharding protocol still credits these records as
+            # consumed (report_batch_done counts batch_size regardless),
+            # so a master/reader dataset_size mismatch would otherwise
+            # shrink the epoch with no signal at all
+            logger.warning(
+                "%s: dropped %d out-of-range record indices (max index "
+                "%d >= %d records) — the master's dataset_size "
+                "over-declares this file",
+                self.path, dropped, max(indices), self.count(),
+            )
         return out
 
 
@@ -121,11 +135,18 @@ class HFTokenizerAdapter:
     production-vocabulary path."""
 
     def __init__(self, tokenizer, seq_len: int,
-                 pad_id: int = 0, bos_id: Optional[int] = None):
+                 pad_id: int = 0, bos_id: Optional[int] = None,
+                 eos_id: Optional[int] = None):
         self._tok = tokenizer
         self.seq_len = seq_len
         self.pad_id = pad_id
         self.bos_id = bos_id
+        # with eos_id set, every document gets a terminal EOS appended;
+        # ``_render`` additionally knows (via this attribute) to keep the
+        # end-of-text prediction target alive under the pad == eos
+        # convention, where the terminal EOS is otherwise folded into
+        # the trailing pad run by the position-based mask
+        self.eos_id = eos_id
         size = getattr(tokenizer, "vocab_size", None)
         if size is None and hasattr(tokenizer, "get_vocab_size"):
             size = tokenizer.get_vocab_size()
@@ -141,9 +162,12 @@ class HFTokenizerAdapter:
         except TypeError:  # raw `tokenizers.Tokenizer`: no such kwarg
             encoded = self._tok.encode(text)
         ids = encoded if isinstance(encoded, list) else encoded.ids
+        ids = list(ids)
         if self.bos_id is not None:
-            ids = [self.bos_id] + list(ids)
-        return list(ids)
+            ids = [self.bos_id] + ids
+        if self.eos_id is not None:
+            ids = ids + [self.eos_id]
+        return ids
 
     def encode(self, record: bytes) -> np.ndarray:
         return np.asarray(self._ids(record), np.int32)
@@ -211,6 +235,18 @@ class ShardedTextBatches:
         lengths = np.where(
             has_any, ids.shape[1] - np.argmax(not_pad[:, ::-1], axis=1), 0
         )
+        eos_id = getattr(self._tok, "eos_id", None)
+        if eos_id is not None and eos_id == pad_id:
+            # pad == eos convention with a known eos: the document's
+            # terminal EOS shares the pad id, so the position scan folds
+            # it into the trailing pad run — count exactly one trailing
+            # token as the real EOS so the model still gets an
+            # end-of-text prediction target. (Tokenizers without an
+            # eos_id keep the conservative mask: the terminal-EOS target
+            # is the residual gap, documented here on purpose.)
+            lengths = np.where(
+                has_any & (lengths < ids.shape[1]), lengths + 1, lengths
+            )
         # labels[t] predicts ids[t+1]: valid only while t+1 < length
         t = np.arange(ids.shape[1])[None, :]
         labels[t >= lengths[:, None] - 1] = -100

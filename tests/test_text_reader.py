@@ -43,6 +43,39 @@ class TestLineIndexedFile:
         reader = LineIndexedFile(path)
         assert reader.read_range(0, 2) == [b"one", b"two"]
 
+    def test_out_of_range_indices_warn(self, tmp_path):
+        """A master/reader dataset_size mismatch drops records — the
+        sharding protocol still credits them as consumed, so the drop
+        must be VISIBLE (a silently shrinking epoch is undebuggable)."""
+        import logging
+
+        from dlrover_tpu.common.log import get_logger
+
+        path = tmp_path / "three.txt"
+        path.write_text("a\nb\nc\n")
+        reader = LineIndexedFile(str(path))
+        messages = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                messages.append(record.getMessage())
+
+        target = get_logger("trainer.text")
+        handler = _Capture(level=logging.WARNING)
+        target.addHandler(handler)
+        try:
+            got = reader.read_indices([0, 5, 6, 2])
+            assert got == [b"a", b"c"]
+            assert any("out-of-range" in m for m in messages), messages
+            # a contiguous run straddling the boundary drops only its
+            # tail — and still warns
+            messages.clear()
+            got = reader.read_indices([1, 2, 3, 4])
+            assert got == [b"b", b"c"]
+            assert any("dropped 2 " in m for m in messages), messages
+        finally:
+            target.removeHandler(handler)
+
 
 class TestByteTokenizer:
     def test_fixed_shape_bos_pad(self):
@@ -154,6 +187,73 @@ class TestPadLabelMasking:
             client.close()
         finally:
             master.stop()
+
+    def test_terminal_eos_target_survives_pad_eq_eos(self, tmp_path):
+        """With a declared eos_id equal to pad_id, exactly one trailing
+        token is the document's real terminal EOS: the label predicting
+        it must be TRAINED, or the model never learns to stop. Without
+        an eos_id the conservative mask stands (the documented
+        residual)."""
+
+        def make_tok(declare_eos):
+            class IdTok:
+                pad_id = 7
+                eos_id = 7 if declare_eos else None
+                vocab_size = 16
+                seq_len = 8
+
+                def __call__(self, record):
+                    # doc [3, 4, 5] + terminal eos(7), then pad(7)s
+                    ids = np.full((8,), 7, np.int32)
+                    ids[:3] = [3, 4, 5]
+                    return ids
+
+            return IdTok()
+
+        for declare_eos, eos_target_trained in ((True, True),
+                                                (False, False)):
+            path = tmp_path / f"eos_{declare_eos}.txt"
+            path.write_text("x\n" * 4)
+            master = start_local_master()
+            try:
+                reader = LineIndexedFile(str(path))
+                client = MasterClient(master.addr, node_id=0)
+                sc = ShardingClient(
+                    client, dataset_name=f"eosmask{declare_eos}",
+                    batch_size=4, dataset_size=reader.count(),
+                    num_epochs=1, num_minibatches_per_shard=1,
+                )
+                source = ShardedTextBatches(
+                    sc, reader, batch_size=4,
+                    tokenizer=make_tok(declare_eos), seq_len=8)
+                labels = next(iter(source))["labels"]
+                assert (labels[:, 0] == 4).all()
+                assert (labels[:, 1] == 5).all()
+                if eos_target_trained:
+                    # label[2] predicts ids[3] == 7, the terminal EOS
+                    assert (labels[:, 2] == 7).all()
+                    assert (labels[:, 3:] == -100).all()
+                else:
+                    assert (labels[:, 2:] == -100).all()
+                client.close()
+            finally:
+                master.stop()
+
+    def test_hf_adapter_appends_terminal_eos(self):
+        from dlrover_tpu.trainer.text_reader import HFTokenizerAdapter
+
+        class RawTok:  # minimal `tokenizers.Tokenizer`-shaped stub
+            def encode(self, text):
+                return [10, 11, 12]
+
+            def get_vocab_size(self):
+                return 32
+
+        tok = HFTokenizerAdapter(RawTok(), seq_len=8, pad_id=0,
+                                 bos_id=1, eos_id=2)
+        assert tok.encode(b"abc").tolist() == [1, 10, 11, 12, 2]
+        fixed = tok(b"abc")
+        assert fixed.tolist() == [1, 10, 11, 12, 2, 0, 0, 0]
 
 
 class TestPackedBatches:
