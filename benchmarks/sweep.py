@@ -1,0 +1,103 @@
+"""Knob sweep runner over ``bench.py`` (wedge-proof by construction).
+
+Each point is one ``python bench.py`` invocation — which since round 4
+runs its measurement in a killable subprocess with a hard timeout and
+emits exactly one JSON line — so an abandoned compile can no longer
+wedge the whole sweep session (the round-3 incident,
+``docs/bench_tuning.md``).
+
+Usage:
+  python benchmarks/sweep.py --preset long \
+      --grid BENCH_BLOCK_Q=512,1024 BENCH_HEAD_CHUNK=256,512 \
+      --timeout 900
+
+Prints one result line per point and a sorted summary; writes
+``sweep_results.jsonl`` next to this file (append-only, so a killed
+sweep keeps its finished points).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def parse_grid(specs):
+    grid = {}
+    for spec in specs:
+        key, _, values = spec.partition("=")
+        if not values:
+            raise SystemExit(f"bad --grid entry {spec!r} (KEY=v1,v2)")
+        grid[key] = values.split(",")
+    return grid
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="")
+    p.add_argument("--grid", nargs="*", default=[])
+    p.add_argument("--timeout", type=float, default=1200.0,
+                   help="per-point bench timeout (BENCH_MFU_TIMEOUT)")
+    p.add_argument("--steps", default="")
+    p.add_argument("--out", default=os.path.join(HERE,
+                                                 "sweep_results.jsonl"))
+    args = p.parse_args()
+
+    grid = parse_grid(args.grid)
+    keys = sorted(grid)
+    points = list(itertools.product(*(grid[k] for k in keys))) or [()]
+    results = []
+    for values in points:
+        knobs = dict(zip(keys, values))
+        env = dict(os.environ)
+        env.update(knobs)
+        env["BENCH_SKIP_RECOVERY"] = "1"
+        env["BENCH_MFU_TIMEOUT"] = str(args.timeout)
+        if args.preset:
+            env["BENCH_PRESET"] = args.preset
+        if args.steps:
+            env["BENCH_STEPS"] = args.steps
+        label = " ".join(f"{k}={v}" for k, v in knobs.items()) or "default"
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                env=env, capture_output=True, text=True,
+                timeout=args.timeout + 420,  # probe+retry headroom
+            )
+            lines = [ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")]
+            rec = json.loads(lines[-1]) if lines else {
+                "error": f"no JSON (rc={proc.returncode})"
+            }
+        except subprocess.TimeoutExpired:
+            rec = {"error": "sweep-level timeout"}
+        rec["_knobs"] = knobs
+        rec["_wall_s"] = round(time.time() - t0, 1)
+        results.append(rec)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"{label:50s} -> "
+              f"{rec.get('value', 0.0)} {rec.get('unit', '')} "
+              f"({rec.get('error', 'ok')}) [{rec['_wall_s']}s]",
+              flush=True)
+
+    good = [r for r in results if not r.get("error")]
+    good.sort(key=lambda r: -r.get("value", 0.0))
+    print("\n== best first ==")
+    for r in good:
+        knobs = " ".join(f"{k}={v}" for k, v in r["_knobs"].items())
+        print(f"{r['value']:8.4f}  {knobs}")
+    return 0 if good else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,15 @@ def init_worker(platform: Optional[str] = None,
 
     from dlrover_tpu.utils.compile_cache import enable_compile_cache
 
+    if platform == "cpu" or "cpu" in os.environ.get(
+        "JAX_PLATFORMS", ""
+    ).lower():
+        # silent, portable persistent-cache reloads on CPU; must run
+        # before the client boots (no-op afterwards)
+        from dlrover_tpu.utils.compile_cache import cap_cpu_isa_for_cache
+
+        cap_cpu_isa_for_cache()
+
     # persistent XLA cache: a restarted worker recompiling the same
     # program hits disk instead of the compiler (<90 s restore budget)
     enable_compile_cache()

@@ -28,6 +28,67 @@ _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "dlrover_tpu", "xla_cache"
 )
 _enabled_dir: Optional[str] = None
+_fingerprint: Optional[str] = None
+
+
+def machine_fingerprint() -> str:
+    """Host/toolchain fingerprint the cache directory is keyed by.
+
+    XLA:CPU AOT executables embed the *compile-time* host machine
+    features; loading them on a host with different features logs
+    "machine features don't match … could lead to SIGILL" — harmless
+    noise at best, a crash hazard at worst. An image-baked or
+    NFS-shared cache dir therefore must not be shared verbatim across
+    hosts: every (arch, cpu flags, jaxlib version) combination gets its
+    own subdirectory. Computed WITHOUT initializing a JAX backend — the
+    cache is enabled before the (possibly slow, tunneled) backend comes
+    up, and the executable cache key already separates backends.
+    """
+    global _fingerprint
+    if _fingerprint is not None:
+        return _fingerprint
+    import hashlib
+    import platform
+
+    parts = [platform.machine(), platform.system()]
+    try:
+        import jaxlib
+
+        parts.append(getattr(jaxlib, "__version__", ""))
+    except Exception:  # noqa: BLE001 — fingerprint must never fail
+        parts.append("")
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("flags"):
+                    flags = line.split(":", 1)[1].split()
+                    parts.append(" ".join(sorted(flags)))
+                    break
+    except OSError:
+        pass
+    _fingerprint = hashlib.sha256(
+        "|".join(parts).encode()
+    ).hexdigest()[:12]
+    return _fingerprint
+
+
+def cap_cpu_isa_for_cache() -> None:
+    """Append ``--xla_cpu_max_isa=AVX2`` to ``XLA_FLAGS`` (idempotent).
+
+    Default XLA:CPU tuning embeds AVX512-only pseudo-features
+    (``+prefer-no-scatter``/``+prefer-no-gather``) that the AOT
+    loader's host-feature detection never reports, so even SAME-host
+    persistent-cache reloads log "machine features don't match …
+    SIGILL" errors. The AVX2 cap makes cached CPU executables reload
+    silently and portably. Callers decide cpu-ness (env hints differ
+    per harness) and must call this before the CPU client initializes;
+    afterwards it is a harmless no-op.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_max_isa" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_max_isa=AVX2"
+        ).strip()
 
 
 def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
@@ -35,15 +96,19 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
 
     Resolution order: explicit arg > ``DLROVER_COMPILE_CACHE_DIR`` env >
     ``~/.cache/dlrover_tpu/xla_cache``. An empty-string env value
-    disables caching. Idempotent; returns the active directory (or None
-    when disabled).
+    disables caching. The resolved directory gains a
+    ``machine_fingerprint()`` subdirectory so one shared or image-baked
+    root serves many hosts without cross-host AOT reuse. Idempotent;
+    returns the active directory (or None when disabled).
     """
     global _enabled_dir
     if cache_dir is None:
         cache_dir = os.environ.get(ENV_CACHE_DIR, _DEFAULT_DIR)
     if not cache_dir:
         return None
-    cache_dir = os.path.abspath(cache_dir)
+    cache_dir = os.path.join(
+        os.path.abspath(cache_dir), f"host-{machine_fingerprint()}"
+    )
     if _enabled_dir == cache_dir:
         return _enabled_dir
 
@@ -61,11 +126,24 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
 
 
 def cache_entries(cache_dir: Optional[str] = None) -> int:
-    """Number of cached executables on disk (0 if the dir is absent)."""
-    d = cache_dir or _enabled_dir or os.environ.get(
-        ENV_CACHE_DIR, _DEFAULT_DIR
-    )
-    if not d or not os.path.isdir(d):
+    """Number of cached executables on disk for THIS host's
+    fingerprinted subdirectory (0 if the dir is absent). ``cache_dir``
+    is the un-fingerprinted root, as passed to
+    ``enable_compile_cache``."""
+    if cache_dir is not None:
+        d = os.path.join(
+            os.path.abspath(cache_dir), f"host-{machine_fingerprint()}"
+        )
+    elif _enabled_dir:
+        d = _enabled_dir
+    else:
+        root = os.environ.get(ENV_CACHE_DIR, _DEFAULT_DIR)
+        if not root:  # empty env value = caching disabled
+            return 0
+        d = os.path.join(
+            os.path.abspath(root), f"host-{machine_fingerprint()}"
+        )
+    if not os.path.isdir(d):
         return 0
     return sum(
         1 for name in os.listdir(d)

@@ -13,6 +13,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# AVX2 ISA cap: silent, portable persistent-cache reloads on CPU (test
+# shapes are far too small for AVX512 to matter) — must precede jax
+# import; see cap_cpu_isa_for_cache for the full rationale
+from dlrover_tpu.utils.compile_cache import cap_cpu_isa_for_cache  # noqa: E402
+
+cap_cpu_isa_for_cache()
 os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
 
 # The environment's sitecustomize force-registers an experimental TPU

@@ -1,0 +1,86 @@
+"""Persistent XLA compile cache: host-fingerprinted layout.
+
+XLA:CPU AOT executables embed compile-time machine features; a cache
+directory shared verbatim across hosts (image-baked ``~/.cache`` or
+NFS) produces "machine features don't match … SIGILL" loader errors
+when another host's entries are deserialized. The cache therefore keys
+a per-host subdirectory off (arch, cpu flags, jaxlib version).
+"""
+
+import os
+import subprocess
+import sys
+
+from dlrover_tpu.utils.compile_cache import (
+    cache_entries,
+    enable_compile_cache,
+    machine_fingerprint,
+)
+
+
+def test_fingerprint_is_stable_and_cheap():
+    fp1 = machine_fingerprint()
+    fp2 = machine_fingerprint()
+    assert fp1 == fp2
+    assert len(fp1) == 12
+    int(fp1, 16)  # hex
+
+
+def test_enable_appends_host_subdir(tmp_path):
+    root = str(tmp_path / "cc")
+    active = enable_compile_cache(root)
+    assert active == os.path.join(root, f"host-{machine_fingerprint()}")
+    assert os.path.isdir(active)
+    # idempotent: same resolved dir on re-enable
+    assert enable_compile_cache(root) == active
+
+
+def test_entries_land_in_host_subdir_and_reload(tmp_path):
+    """A jitted program populates THIS host's subdir; a foreign host's
+    entries at the root are never touched. Run in subprocesses: the
+    cache config is process-global."""
+    root = str(tmp_path / "cc")
+    # plant a fake foreign-host entry at the root: the fingerprinted
+    # layout must leave it alone and never try to load it
+    os.makedirs(root, exist_ok=True)
+    foreign = os.path.join(root, "jit_f-deadbeef-cache")
+    with open(foreign, "wb") as f:
+        f.write(b"not an executable")
+    prog = (
+        "import os\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from dlrover_tpu.utils.compile_cache import enable_compile_cache\n"
+        f"enable_compile_cache({root!r})\n"
+        "import jax.numpy as jnp\n"
+        "print(jax.jit(lambda x: x * 2 + 1)(jnp.arange(4.0))[3])\n"
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    # the CPU-harness convention (conftest/dryrun/bench smoke): AVX2 cap
+    # keeps cached CPU executables free of machine-feature mismatch
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_cpu_max_isa" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
+    out = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "7.0" in out.stdout
+    assert cache_entries(root) >= 1
+    # the foreign entry is untouched and uncounted
+    assert os.path.exists(foreign)
+    host_dir = os.path.join(root, f"host-{machine_fingerprint()}")
+    assert foreign not in [
+        os.path.join(host_dir, n) for n in os.listdir(host_dir)
+    ]
+    # no cross-host loader noise on a warm re-run
+    out2 = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    assert "machine features" not in out2.stderr.lower()
