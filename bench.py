@@ -502,14 +502,23 @@ def main() -> int:
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--mfu-worker", "--out", out_path]
             try:
-                proc = subprocess.run(cmd, env=env, timeout=timeout)
+                # capture the worker's streams: its own failure JSON
+                # (e.g. _get_devices inside the worker) must not leak
+                # onto the supervisor's stdout — main() emits exactly
+                # ONE JSON line
+                proc = subprocess.run(cmd, env=env, timeout=timeout,
+                                      capture_output=True, text=True)
+                if proc.stderr:
+                    print(proc.stderr[-4000:], file=sys.stderr, end="")
                 if proc.returncode == 0 and os.path.exists(out_path):
                     with open(out_path) as f:
                         print(f.read().strip())
                     return 0
+                worker_said = (proc.stdout or "").strip().splitlines()
+                detail = f": {worker_said[-1][:160]}" if worker_said else ""
                 errors.append(
                     f"attempt {attempt}: worker exited "
-                    f"rc={proc.returncode}"
+                    f"rc={proc.returncode}{detail}"
                 )
             except subprocess.TimeoutExpired:
                 errors.append(

@@ -30,21 +30,27 @@ import numpy as np
 
 BATCH_ROWS = 8
 SEQ = 2048
-PREP_MS_TARGET = 15.0  # host preprocessing per batch
+# host preprocessing per batch; prep ~= step (SHM_BENCH_PREP_MS=24) is
+# the regime coworker feeding exists for (ideal -> ~2x)
+PREP_MS_TARGET = float(os.environ.get("SHM_BENCH_PREP_MS", "15"))
 STEP_MS = 25.0  # simulated device-bound step (process waits)
-N_BATCHES = 60
+N_BATCHES = int(os.environ.get("SHM_BENCH_BATCHES", "200"))
 N_WORKERS = 2
 
 
 def _calibrate_prep(target_ms: float) -> int:
     """Find the work size that costs ~target_ms on this host (scale by
-    the measured per-element cost instead of doubling past the target)."""
+    the measured per-element cost; median of 3 to resist scheduler
+    noise — a mis-calibrated prep silently rescales the whole ideal)."""
     n = max(1 << 15, BATCH_ROWS * (SEQ + 1))
-    for _ in range(6):
-        t0 = time.perf_counter()
-        _prep_batch(0, n)
-        dt = (time.perf_counter() - t0) * 1e3
-        if 0.7 * target_ms <= dt <= 1.5 * target_ms:
+    for _ in range(10):
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _prep_batch(0, n)
+            samples.append((time.perf_counter() - t0) * 1e3)
+        dt = sorted(samples)[1]
+        if 0.85 * target_ms <= dt <= 1.25 * target_ms:
             return n
         n = max(
             BATCH_ROWS * (SEQ + 1),
@@ -87,27 +93,46 @@ def _producer(worker_rank: int, num_workers: int):
         yield _prep_batch(i, work)
 
 
-def bench_shm_ring(work: int) -> float:
+def bench_shm_ring(work: int):
+    """Returns (steady_steps_per_s, warmup_s).
+
+    Steady state is timed from the FIRST yielded batch: coworker spawn
+    (python + numpy import, ~1 s/process) happens once per job and
+    amortizes over thousands of training steps, so folding it into a
+    200-batch window would mismeasure the regime the ring exists for.
+    It is still reported (``warmup_s``) — a job short enough that spawn
+    dominates should not use coworker feeding at all."""
     import os
 
     from dlrover_tpu.trainer.shm_dataloader import ShmDataLoader
 
     os.environ["SHM_BENCH_WORK"] = str(work)
     slot_bytes = BATCH_ROWS * (SEQ + 1) * 4 * 2 + 4096
+    t_create = time.perf_counter()
     loader = ShmDataLoader(
         _producer, num_workers=N_WORKERS, slot_bytes=slot_bytes,
         n_slots=4,
     )
     n = 0
-    t0 = time.perf_counter()
+    t0 = warmup = None
     with loader:
         for batch in loader:
+            if t0 is None:
+                t0 = time.perf_counter()
+                warmup = t0 - t_create
             assert batch["input_ids"].shape == (BATCH_ROWS, SEQ)
             n += 1
             _device_step()
+    if t0 is None:
+        raise RuntimeError(
+            "no batches arrived — producer processes died "
+            "(stdin-run parents cannot spawn; run as a script)"
+        )
     elapsed = time.perf_counter() - t0
     assert n == N_BATCHES, f"consumed {n} of {N_BATCHES}"
-    return n / elapsed
+    # the first batch's own prep is outside the timed window; the other
+    # N-1 steps are steady-state pipeline
+    return (n - 1) / elapsed, warmup
 
 
 def main() -> int:
@@ -117,7 +142,11 @@ def main() -> int:
     prep_ms = (time.perf_counter() - t0) * 1e3
 
     inproc = bench_in_process(work)
-    shm = bench_shm_ring(work)
+    shm, warmup_s = bench_shm_ring(work)
+    # the blocked-on-device regime's ceiling: prep fully hidden behind
+    # the device step (valid on ANY core count — the consumer is not on
+    # the CPU while the device runs)
+    ideal = (prep_ms + STEP_MS) / max(prep_ms, STEP_MS)
     print(json.dumps({
         "metric": "shm_ring_speedup",
         "value": round(shm / inproc, 3),
@@ -125,6 +154,8 @@ def main() -> int:
         "detail": {
             "in_process_steps_per_s": round(inproc, 2),
             "shm_ring_steps_per_s": round(shm, 2),
+            "ideal_overlap_speedup": round(ideal, 3),
+            "coworker_spawn_warmup_s": round(warmup_s, 2),
             "prep_ms_per_batch": round(prep_ms, 1),
             "simulated_step_ms": STEP_MS,
             "num_coworkers": N_WORKERS,

@@ -1,7 +1,10 @@
 """``python -m dlrover_tpu.brain.main`` — run the brain service.
 
 Role parity: the Go brain's server binary
-(``dlrover/go/brain/cmd/brain/main.go``).
+(``dlrover/go/brain/cmd/brain/main.go``); ``--watch-cluster`` folds in
+the ``k8smonitor`` role (``go/brain/pkg/platform/k8s/watcher``): a
+cluster watcher feeding the same datastore, so jobs leave history even
+without self-reporting.
 """
 
 from __future__ import annotations
@@ -24,6 +27,16 @@ def main(argv=None) -> int:
         "--config", default="",
         help="JSON config file (hot-reloaded; ConfigMap-mountable)",
     )
+    parser.add_argument(
+        "--watch-cluster", action="store_true",
+        help="run the k8s cluster watcher (the k8smonitor role) "
+             "against the in-process datastore",
+    )
+    parser.add_argument(
+        "--namespace", default="default",
+        help="namespace for --watch-cluster",
+    )
+    parser.add_argument("--watch-interval", type=float, default=30.0)
     args = parser.parse_args(argv)
 
     service = BrainService(
@@ -32,10 +45,28 @@ def main(argv=None) -> int:
         config_path=args.config or None,
     )
     service.start()
+    watcher = None
+    if args.watch_cluster:
+        from dlrover_tpu.brain.watcher import (
+            ClusterWatcher,
+            K8sClusterSource,
+        )
+        from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+        watcher = ClusterWatcher(
+            sink=service.servicer.datastore,
+            source=K8sClusterSource(
+                K8sClient.singleton_instance(args.namespace)
+            ),
+            interval=args.watch_interval,
+        )
+        watcher.start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if watcher is not None:
+        watcher.stop()
     service.stop()
     return 0
 

@@ -1,0 +1,265 @@
+"""Cluster watcher: platform state -> Brain datastore.
+
+Role parity: ``dlrover/go/brain/pkg/platform/k8s/watcher`` (the
+``k8smonitor`` command): a cluster-scoped monitor that ingests job and
+node state into the Brain's datastore INDEPENDENT of job
+self-reporting. Jobs that never wire up a ``BrainStatsReporter`` — or
+die before their exit report — still leave the history that cold-starts
+the next similar job's resource plan
+(``optimize_job_worker_resource.go:30-120``).
+
+Structure:
+- ``ClusterSource`` is the minimal platform contract (list jobs, list a
+  job's nodes with usage). ``K8sClusterSource`` adapts the operator's
+  ``K8sClient`` (ElasticJob CRs + labeled pods); tests and other
+  platforms (Ray, local) supply their own source.
+- ``ClusterWatcher`` polls the source and persists the same
+  ``MetricType`` rows the self-reporting path writes (JOB_META on first
+  sight, RUNTIME_INFO per poll, JOB_EXIT_REASON once on completion), so
+  every Brain algorithm consumes watcher-fed history transparently.
+- The sink is anything with ``persist_metrics`` — a ``BaseDatastore``
+  for an in-process Brain, a ``BrainClient`` for a remote one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Protocol
+
+from dlrover_tpu.brain.messages import BrainJobMetrics, MetricType
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("brain.watcher")
+
+# ElasticJob CR phases that mean "this job is finished"
+_TERMINAL_PHASES = {"Succeeded", "Failed", "Completed"}
+
+
+class ClusterSource(Protocol):
+    """What the watcher needs from a platform."""
+
+    def list_jobs(self) -> List[Dict]:
+        """[{"name", "uid", "phase", "user"?, "node_unit"?}, ...]"""
+        ...
+
+    def list_job_nodes(self, job_name: str) -> Dict[str, List[Dict]]:
+        """{node_type: [{"name", "cpu", "used_cpu", "memory",
+        "used_memory"}, ...]} — requests plus observed usage."""
+        ...
+
+
+class K8sClusterSource:
+    """Adapt the operator's ``K8sClient`` to the watcher contract.
+
+    Jobs come from ElasticJob custom resources; nodes from pods labeled
+    ``elasticjob-name``. Usage comes from the client's ``pod_metrics``
+    method when the cluster runs a metrics server (optional — requests
+    are still recorded without it, which is enough for the count/shape
+    dimensions of the planning algorithms).
+    """
+
+    def __init__(self, client):
+        self._client = client
+
+    def list_jobs(self) -> List[Dict]:
+        from dlrover_tpu.scheduler.kubernetes import ELASTICJOB_PLURAL
+
+        jobs = []
+        for cr in self._client.list_custom_resources(
+            ELASTICJOB_PLURAL
+        ) or []:
+            meta = cr.get("metadata", {})
+            jobs.append({
+                "name": meta.get("name", ""),
+                "uid": meta.get("uid", ""),
+                "phase": cr.get("status", {}).get("phase", ""),
+                "user": meta.get("labels", {}).get("user", ""),
+                "node_unit": int(
+                    cr.get("spec", {}).get("nodeUnit", 1) or 1
+                ),
+            })
+        return jobs
+
+    def list_job_nodes(self, job_name: str) -> Dict[str, List[Dict]]:
+        pods = self._client.list_pods(
+            label_selector=f"elasticjob-name={job_name}"
+        ) or []
+        usage = {}
+        pod_metrics = getattr(self._client, "pod_metrics", None)
+        if pod_metrics is not None:
+            try:
+                usage = pod_metrics(job_name) or {}
+            except Exception:  # noqa: BLE001 — metrics server optional
+                usage = {}
+        nodes: Dict[str, List[Dict]] = {}
+        for pod in pods:
+            meta = pod.get("metadata", {})
+            name = meta.get("name", "")
+            node_type = meta.get("labels", {}).get("node-type", "worker")
+            if node_type == "master":
+                continue
+            # the pod's effective request is the SUM across containers
+            # (sidecars included — k8s schedules on the sum)
+            cpu, mem = 0.0, 0
+            for c in pod.get("spec", {}).get("containers", []):
+                req = c.get("resources", {}).get("requests", {})
+                cpu += _cpu_cores(req.get("cpu", 0))
+                mem += _mem_mib(req.get("memory", 0))
+            used = usage.get(name, {})
+            nodes.setdefault(node_type, []).append({
+                "name": name,
+                "cpu": cpu,
+                "memory": mem,
+                "used_cpu": float(used.get("cpu", 0)),
+                "used_memory": int(used.get("memory", 0)),
+            })
+        return nodes
+
+
+def _cpu_cores(value) -> float:
+    """K8s cpu quantity -> cores: '500m' -> 0.5, '4' -> 4.0, 2 -> 2.0."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip()
+    try:
+        if s.endswith("m"):
+            return float(s[:-1]) / 1000.0
+        return float(s)
+    except ValueError:
+        return 0.0
+
+
+_MEM_SUFFIX_BYTES = {
+    "Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30, "Ti": 1 << 40,
+    "K": 10 ** 3, "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
+}
+
+
+def _mem_mib(value) -> int:
+    """K8s memory quantity -> MiB. Suffixed ('8Gi', '512Mi', decimal
+    '8G') per the k8s quantity grammar; a PLAIN number is bytes (also
+    k8s semantics), so '8589934592' and 8589934592 -> 8192 MiB."""
+    if isinstance(value, (int, float)):
+        return int(value / (1 << 20))
+    s = str(value).strip()
+    try:
+        for suffix in ("Ki", "Mi", "Gi", "Ti"):
+            if s.endswith(suffix):
+                return int(
+                    float(s[: -len(suffix)])
+                    * _MEM_SUFFIX_BYTES[suffix] / (1 << 20)
+                )
+        for suffix in ("K", "M", "G", "T"):
+            if s.endswith(suffix):
+                return int(
+                    float(s[: -len(suffix)])
+                    * _MEM_SUFFIX_BYTES[suffix] / (1 << 20)
+                )
+        return int(float(s) / (1 << 20))
+    except ValueError:
+        return 0
+
+
+class ClusterWatcher:
+    """Poll a ``ClusterSource`` and feed the Brain.
+
+    Dedup state (which jobs have META / EXIT rows) is rebuilt from the
+    sink when it is a datastore, so a restarted watcher over a durable
+    sqlite store does not duplicate one-shot rows.
+    """
+
+    def __init__(self, sink, source: ClusterSource,
+                 interval: float = 30.0):
+        self._sink = sink
+        self._source = source
+        self._interval = interval
+        self._seen_meta = set()
+        self._seen_exit = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # rebuild dedup state from a durable datastore sink
+        lister = getattr(sink, "list_job_uuids", None)
+        latest = getattr(sink, "latest", None)
+        if lister is not None and latest is not None:
+            try:
+                for uuid in lister():
+                    if latest(uuid, MetricType.JOB_META) is not None:
+                        self._seen_meta.add(uuid)
+                    if latest(
+                        uuid, MetricType.JOB_EXIT_REASON
+                    ) is not None:
+                        self._seen_exit.add(uuid)
+            except Exception:  # noqa: BLE001 — dedup is best-effort
+                pass
+
+    def _persist(self, uuid: str, name: str, metric_type: str,
+                 payload: Dict):
+        self._sink.persist_metrics(BrainJobMetrics(
+            job_uuid=uuid, job_name=name, metric_type=metric_type,
+            payload=payload, timestamp=time.time(),
+        ))
+
+    def poll_once(self) -> int:
+        """One sweep; returns the number of jobs observed."""
+        try:
+            jobs = self._source.list_jobs()
+        except Exception as e:  # noqa: BLE001 — platform hiccups
+            logger.warning("cluster source list_jobs failed: %s", e)
+            return 0
+        for job in jobs:
+            name = job.get("name", "")
+            uuid = job.get("uid") or name
+            if not name:
+                continue
+            if uuid not in self._seen_meta:
+                self._persist(uuid, name, MetricType.JOB_META, {
+                    "name": name,
+                    "user": job.get("user", ""),
+                    "node_unit": job.get("node_unit", 1),
+                    "observed_by": "cluster_watcher",
+                })
+                self._seen_meta.add(uuid)
+            phase = job.get("phase", "")
+            if phase in _TERMINAL_PHASES:
+                if uuid not in self._seen_exit:
+                    self._persist(
+                        uuid, name, MetricType.JOB_EXIT_REASON,
+                        {"reason": phase,
+                         "observed_by": "cluster_watcher"},
+                    )
+                    self._seen_exit.add(uuid)
+                continue  # no runtime sample for a finished job
+            try:
+                nodes = self._source.list_job_nodes(name)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("list_job_nodes(%s) failed: %s", name, e)
+                continue
+            workers = len(nodes.get("worker", []))
+            self._persist(uuid, name, MetricType.RUNTIME_INFO, {
+                "speed": 0.0,  # throughput is self-reported; the
+                # watcher contributes topology + usage
+                "workers": workers,
+                "nodes": nodes,
+                "observed_by": "cluster_watcher",
+            })
+        return len(jobs)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="brain-cluster-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("cluster watcher poll failed")
+            self._stop.wait(self._interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -64,6 +64,7 @@ SURFACE = {
     "dlrover_tpu.models.deepfm": ["init", "apply"],
     "dlrover_tpu.utils.prof": ["analyze_cost", "DryRunner", "AProfiler"],
     "dlrover_tpu.brain.client": ["BrainClient"],
+    "dlrover_tpu.brain.watcher": ["ClusterWatcher", "K8sClusterSource"],
 }
 
 

@@ -1,0 +1,240 @@
+"""Brain cluster watcher: platform -> datastore -> cross-job plans.
+
+Role parity: ``dlrover/go/brain/pkg/platform/k8s/watcher`` (the
+``k8smonitor`` role). The point of a CLUSTER-level Brain is that job
+B's initial plan improves because of job A's persisted history — here
+that chain is driven end-to-end: a (fake) platform is watched, the
+rows land in a durable sqlite store, the Brain restarts, and a new
+similar job's create-stage optimize returns a plan learned from the
+watched job, where an empty cluster yields the cold default.
+"""
+
+import pytest
+
+from dlrover_tpu.brain.datastore import MemoryDatastore, SqliteDatastore
+from dlrover_tpu.brain.messages import MetricType, OptimizeRequest
+from dlrover_tpu.brain.service import BrainService
+from dlrover_tpu.brain.watcher import (
+    ClusterWatcher,
+    K8sClusterSource,
+    _cpu_cores,
+    _mem_mib,
+)
+from dlrover_tpu.common.constants import JobStage, NodeType
+
+
+class FakeSource:
+    """Scripted cluster: tests mutate ``jobs``/``nodes`` between polls."""
+
+    def __init__(self):
+        self.jobs = []
+        self.nodes = {}
+
+    def list_jobs(self):
+        return [dict(j) for j in self.jobs]
+
+    def list_job_nodes(self, job_name):
+        return self.nodes.get(job_name, {})
+
+
+def _running_job_a(source, workers=6, used_cpu=5.0):
+    source.jobs = [{"name": "nlp-train-1", "uid": "uid-a",
+                    "phase": "Running", "node_unit": 1}]
+    source.nodes["nlp-train-1"] = {
+        NodeType.PS: [{"name": "ps-0", "cpu": 8.0, "used_cpu": used_cpu,
+                       "memory": 16384, "used_memory": 9000}],
+        NodeType.WORKER: [
+            {"name": f"w-{i}", "cpu": 4.0, "used_cpu": 2.0,
+             "memory": 8192, "used_memory": 4000}
+            for i in range(workers)
+        ],
+    }
+
+
+class TestClusterWatcher:
+    def test_job_lifecycle_rows(self):
+        store = MemoryDatastore()
+        source = FakeSource()
+        watcher = ClusterWatcher(store, source, interval=999)
+        _running_job_a(source)
+
+        assert watcher.poll_once() == 1
+        assert watcher.poll_once() == 1
+        # META once, RUNTIME per poll, no EXIT while running
+        assert len(store.get_job_metrics(
+            "uid-a", MetricType.JOB_META)) == 1
+        runtime = store.get_job_metrics("uid-a", MetricType.RUNTIME_INFO)
+        assert len(runtime) == 2
+        assert runtime[-1].payload["workers"] == 6
+        ps = runtime[-1].payload["nodes"][NodeType.PS][0]
+        assert ps["used_cpu"] == 5.0
+        assert not store.get_job_metrics(
+            "uid-a", MetricType.JOB_EXIT_REASON)
+
+        source.jobs[0]["phase"] = "Succeeded"
+        watcher.poll_once()
+        watcher.poll_once()
+        exits = store.get_job_metrics("uid-a", MetricType.JOB_EXIT_REASON)
+        assert len(exits) == 1 and exits[0].payload["reason"] == "Succeeded"
+
+    def test_restarted_watcher_does_not_duplicate_one_shot_rows(
+        self, tmp_path
+    ):
+        store = SqliteDatastore(str(tmp_path / "brain.db"))
+        source = FakeSource()
+        _running_job_a(source)
+        ClusterWatcher(store, source, interval=999).poll_once()
+        source.jobs[0]["phase"] = "Failed"
+        ClusterWatcher(store, source, interval=999).poll_once()
+
+        # a THIRD watcher instance over the same durable store
+        watcher = ClusterWatcher(store, source, interval=999)
+        watcher.poll_once()
+        assert len(store.get_job_metrics(
+            "uid-a", MetricType.JOB_META)) == 1
+        assert len(store.get_job_metrics(
+            "uid-a", MetricType.JOB_EXIT_REASON)) == 1
+
+    def test_source_errors_do_not_kill_the_loop(self):
+        store = MemoryDatastore()
+
+        class Flaky:
+            calls = 0
+
+            def list_jobs(self):
+                Flaky.calls += 1
+                if Flaky.calls == 1:
+                    raise ConnectionError("apiserver away")
+                return [{"name": "j", "uid": "u", "phase": "Running"}]
+
+            def list_job_nodes(self, name):
+                raise TimeoutError("metrics away")
+
+        watcher = ClusterWatcher(store, Flaky(), interval=999)
+        assert watcher.poll_once() == 0
+        assert watcher.poll_once() == 1  # meta persisted, runtime skipped
+        assert len(store.get_job_metrics("u", MetricType.JOB_META)) == 1
+        assert not store.get_job_metrics("u", MetricType.RUNTIME_INFO)
+
+
+class TestK8sSource:
+    def test_adapts_crs_and_pods(self):
+        class FakeK8s:
+            def list_custom_resources(self, plural):
+                assert plural == "elasticjobs"
+                return [{
+                    "metadata": {"name": "train-2", "uid": "u2",
+                                 "labels": {"user": "alice"}},
+                    "spec": {"nodeUnit": 4},
+                    "status": {"phase": "Running"},
+                }]
+
+            def list_pods(self, label_selector=""):
+                assert label_selector == "elasticjob-name=train-2"
+                return [
+                    {"metadata": {"name": "train-2-worker-0",
+                                  "labels": {"node-type": "worker"}},
+                     "spec": {"containers": [
+                         # sidecar first: effective request is the SUM
+                         {"resources": {"requests": {
+                             "cpu": "500m", "memory": "512Mi"}}},
+                         {"resources": {"requests": {
+                             "cpu": "4", "memory": "8Gi"}}},
+                     ]}},
+                    {"metadata": {"name": "train-2-master-0",
+                                  "labels": {"node-type": "master"}},
+                     "spec": {}},
+                ]
+
+            def pod_metrics(self, job_name):
+                return {"train-2-worker-0": {"cpu": 2.5, "memory": 5000}}
+
+        source = K8sClusterSource(FakeK8s())
+        jobs = source.list_jobs()
+        assert jobs == [{"name": "train-2", "uid": "u2",
+                         "phase": "Running", "user": "alice",
+                         "node_unit": 4}]
+        nodes = source.list_job_nodes("train-2")
+        assert "master" not in nodes
+        w = nodes["worker"][0]
+        # sidecar (500m, 512Mi) + trainer (4, 8Gi)
+        assert w["cpu"] == 4.5 and w["memory"] == 8192 + 512
+        assert w["used_cpu"] == 2.5 and w["used_memory"] == 5000
+
+    def test_quantity_parsing(self):
+        # k8s quantity grammar: binary/decimal suffixes; PLAIN numbers
+        # are bytes (memory) / cores (cpu)
+        assert _mem_mib("4Gi") == 4096
+        assert _mem_mib("512Mi") == 512
+        assert _mem_mib("8G") == 7629  # 8e9 bytes in MiB
+        assert _mem_mib("8589934592") == 8192
+        assert _mem_mib(8589934592) == 8192
+        assert _mem_mib("garbage") == 0
+        assert _cpu_cores("500m") == 0.5
+        assert _cpu_cores("4") == 4.0
+        assert _cpu_cores(2) == 2.0
+        assert _cpu_cores("oops") == 0.0
+
+
+class TestCrossJobColdStartE2E:
+    @pytest.mark.slow
+    def test_job_b_plan_learned_from_watched_job_a(self, tmp_path):
+        """The full chain: watcher observes job A -> durable store ->
+        Brain RESTART -> job B's create plan reflects A's observed
+        scale/usage; an empty cluster gives the cold default."""
+        from dlrover_tpu.brain.client import BrainClient
+
+        db = f"sqlite://{tmp_path}/cluster.db"
+
+        # epoch 1: the watcher (k8smonitor role) observes job A's life
+        store = SqliteDatastore(str(tmp_path / "cluster.db"))
+        source = FakeSource()
+        _running_job_a(source, workers=6, used_cpu=5.0)
+        watcher = ClusterWatcher(store, source, interval=999)
+        for _ in range(3):
+            watcher.poll_once()
+        source.jobs[0]["phase"] = "Succeeded"
+        watcher.poll_once()
+
+        # epoch 2: a fresh Brain over the same durable store
+        service = BrainService(port=0, datastore_spec=db)
+        service.start()
+        try:
+            client = BrainClient(f"127.0.0.1:{service.port}")
+            plan = client.optimize(OptimizeRequest(
+                job_uuid="uid-b", job_name="nlp-train-2",
+                algorithm="optimize_job_worker_create_resource",
+            ))
+            assert plan.success
+            # learned from A: 6 workers, not the cold 1
+            assert plan.group_resources[NodeType.WORKER].count == 6
+
+            ps_plan = client.optimize(OptimizeRequest(
+                job_uuid="uid-b", job_name="nlp-train-2",
+                stage=JobStage.CREATE,
+            ))
+            assert ps_plan.success
+            ps = ps_plan.group_resources[NodeType.PS]
+            # 1.25x headroom over A's hottest observed PS (5.0 cpu)
+            assert ps.cpu == pytest.approx(6.25)
+            assert ps.memory >= 9000
+            client.close()
+        finally:
+            service.stop()
+
+        # causality: the SAME requests against an empty cluster store
+        # give the cold defaults — the improvement came from A's history
+        empty = BrainService(
+            port=0, datastore_spec=f"sqlite://{tmp_path}/empty.db"
+        )
+        empty.start()
+        try:
+            client = BrainClient(f"127.0.0.1:{empty.port}")
+            cold = client.optimize(OptimizeRequest(
+                job_uuid="uid-c", job_name="nlp-train-3",
+                algorithm="optimize_job_worker_create_resource",
+            ))
+            assert cold.group_resources[NodeType.WORKER].count == 1
+            client.close()
+        finally:
+            empty.stop()
