@@ -29,6 +29,11 @@ Env knobs:
   BENCH_REMAT=policy     per-layer remat policy (default dots_saveable)
   BENCH_FLASH=0|1        Pallas flash kernel on/off (default 1)
   BENCH_BLOCK_Q/K=N      flash kernel tile sizes (default 512/1024)
+  BENCH_BLOCK_Q/K_BWD=N  backward-kernel tiles (0 = same as forward)
+  BENCH_PACKED=1         pack BENCH_DOC_LEN-token documents per row
+                         (segmented fused-mask kernel; attention FLOPs
+                         counted per document, honestly)
+  BENCH_DOC_LEN=N        packed document length (default 2048)
   BENCH_HEAD_CHUNK=N     fused chunked lm-head loss chunk size (0=off)
   BENCH_RECOVERY_DIR=D   scratch dir for --mode recovery artifacts
   BENCH_RECOVERY_PRESET  model preset for the MTTR bench (default
@@ -148,6 +153,8 @@ def _pick_config(platform: str, preset: str):
         use_flash=os.environ.get("BENCH_FLASH", "1") == "1",
         flash_block_q=int(os.environ.get("BENCH_BLOCK_Q", "512")),
         flash_block_k=int(os.environ.get("BENCH_BLOCK_K", "1024")),
+        flash_block_q_bwd=int(os.environ.get("BENCH_BLOCK_Q_BWD", "0")),
+        flash_block_k_bwd=int(os.environ.get("BENCH_BLOCK_K_BWD", "0")),
         **shape,
     )
     return cfg, batch, seq
@@ -327,6 +334,23 @@ def _build_train(devices, preset: str):
         "input_ids": jnp.asarray(ids[:, :-1]),
         "labels": jnp.asarray(ids[:, 1:]),
     }
+    doc_len = 0
+    if os.environ.get("BENCH_PACKED", "") == "1":
+        # packed-documents long-context training (the production shape
+        # of a 16k-token batch): BENCH_DOC_LEN-token documents packed
+        # into each row, cross-document attention masked INSIDE the
+        # segmented flash kernel's tiles — fully masked tiles are
+        # skipped, so attention work scales with doc_len, not seq_len
+        doc_len = int(os.environ.get("BENCH_DOC_LEN", "2048"))
+        doc_len = max(1, min(doc_len, seq_len))
+        seg = (np.arange(seq_len) // doc_len).astype(np.int32)
+        seg = np.broadcast_to(seg, (batch_size, seq_len)).copy()
+        same_next = np.concatenate(
+            [seg[:, :-1] == seg[:, 1:],
+             np.zeros((batch_size, 1), bool)], axis=1)
+        batch["segment_ids"] = jnp.asarray(seg)
+        batch["labels"] = jnp.asarray(
+            np.where(same_next, ids[:, 1:], -100))
 
     n_dev = len(devices)
     head_chunk = int(os.environ.get("BENCH_HEAD_CHUNK", "0"))
@@ -441,10 +465,17 @@ def _mfu_worker(out_path: str) -> int:
     step_time = (time.time() - t0) / steps
 
     tokens_per_step = batch_size * seq_len
-    # 6N forward+backward FLOPs per token + causal attention term
+    # 6N forward+backward FLOPs per token + causal attention term. With
+    # BENCH_PACKED, attention spans only the document (the segmented
+    # kernel skips cross-document tiles), so USEFUL attention FLOPs
+    # scale with doc_len — counting seq_len would overstate MFU
+    attn_span = seq_len
+    if os.environ.get("BENCH_PACKED", "") == "1":
+        attn_span = max(1, min(
+            int(os.environ.get("BENCH_DOC_LEN", "2048")), seq_len))
     n_params = llama.param_count(config)
     attn_flops_tok = (
-        12 * config.num_layers * config.hidden_size * seq_len * 0.5
+        12 * config.num_layers * config.hidden_size * attn_span * 0.5
     )
     flops_per_step = (6.0 * n_params + attn_flops_tok) * tokens_per_step
     achieved = flops_per_step / step_time
@@ -501,26 +532,39 @@ def main() -> int:
             out_path = os.path.join(scratch, f"result_{attempt}.json")
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--mfu-worker", "--out", out_path]
+            # Captured streams (the worker's own failure JSON must not
+            # leak onto the supervisor's stdout — main() emits exactly
+            # ONE line) via Popen in its OWN session: on timeout the
+            # whole process GROUP is killed, so a wedged grandchild
+            # holding the pipes cannot block the drain and resurrect
+            # the hang this supervisor exists to prevent.
+            import signal
+
+            proc = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+                start_new_session=True,
+            )
             try:
-                # capture the worker's streams: its own failure JSON
-                # (e.g. _get_devices inside the worker) must not leak
-                # onto the supervisor's stdout — main() emits exactly
-                # ONE JSON line
-                proc = subprocess.run(cmd, env=env, timeout=timeout,
-                                      capture_output=True, text=True)
-                if proc.stderr:
-                    print(proc.stderr[-4000:], file=sys.stderr, end="")
+                out_text, err_text = proc.communicate(timeout=timeout)
+                if err_text:
+                    print(err_text[-4000:], file=sys.stderr, end="")
                 if proc.returncode == 0 and os.path.exists(out_path):
                     with open(out_path) as f:
                         print(f.read().strip())
                     return 0
-                worker_said = (proc.stdout or "").strip().splitlines()
+                worker_said = (out_text or "").strip().splitlines()
                 detail = f": {worker_said[-1][:160]}" if worker_said else ""
                 errors.append(
                     f"attempt {attempt}: worker exited "
                     f"rc={proc.returncode}{detail}"
                 )
             except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                proc.communicate()  # group is dead: pipes are at EOF
                 errors.append(
                     f"attempt {attempt}: measurement exceeded "
                     f"{timeout:.0f}s (wedged compile?) — worker killed"
@@ -711,7 +755,9 @@ def recovery_result() -> dict:
     if "BENCH_RECOVERY_PRESET" not in os.environ:
         for knob in ("BENCH_SEQ", "BENCH_BATCH", "BENCH_REMAT",
                      "BENCH_FLASH", "BENCH_HEAD_CHUNK", "BENCH_BLOCK_Q",
-                     "BENCH_BLOCK_K", "BENCH_STEPS"):
+                     "BENCH_BLOCK_K", "BENCH_BLOCK_Q_BWD",
+                     "BENCH_BLOCK_K_BWD", "BENCH_PACKED",
+                     "BENCH_DOC_LEN", "BENCH_STEPS"):
             env.pop(knob, None)
     cmd = [
         sys.executable, os.path.abspath(__file__), "--recovery-worker",

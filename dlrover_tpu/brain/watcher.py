@@ -29,6 +29,10 @@ from typing import Dict, List, Optional, Protocol
 
 from dlrover_tpu.brain.messages import BrainJobMetrics, MetricType
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.scheduler.kubernetes import (
+    parse_cpu_cores,
+    parse_memory_mib,
+)
 
 logger = get_logger("brain.watcher")
 
@@ -95,17 +99,24 @@ class K8sClusterSource:
         nodes: Dict[str, List[Dict]] = {}
         for pod in pods:
             meta = pod.get("metadata", {})
+            labels = meta.get("labels", {}) or {}
             name = meta.get("name", "")
-            node_type = meta.get("labels", {}).get("node-type", "worker")
-            if node_type == "master":
+            # the labels OUR operator/scaler actually write
+            # (scheduler.kubernetes.build_pod_labels: "replica-type";
+            # controller.build_master_pod: "elasticjob-role: master")
+            node_type = labels.get("replica-type") or labels.get(
+                "node-type", "worker"
+            )
+            if (node_type == "master"
+                    or labels.get("elasticjob-role") == "master"):
                 continue
             # the pod's effective request is the SUM across containers
             # (sidecars included — k8s schedules on the sum)
             cpu, mem = 0.0, 0
             for c in pod.get("spec", {}).get("containers", []):
                 req = c.get("resources", {}).get("requests", {})
-                cpu += _cpu_cores(req.get("cpu", 0))
-                mem += _mem_mib(req.get("memory", 0))
+                cpu += parse_cpu_cores(req.get("cpu", 0))
+                mem += parse_memory_mib(req.get("memory", 0))
             used = usage.get(name, {})
             nodes.setdefault(node_type, []).append({
                 "name": name,
@@ -115,50 +126,6 @@ class K8sClusterSource:
                 "used_memory": int(used.get("memory", 0)),
             })
         return nodes
-
-
-def _cpu_cores(value) -> float:
-    """K8s cpu quantity -> cores: '500m' -> 0.5, '4' -> 4.0, 2 -> 2.0."""
-    if isinstance(value, (int, float)):
-        return float(value)
-    s = str(value).strip()
-    try:
-        if s.endswith("m"):
-            return float(s[:-1]) / 1000.0
-        return float(s)
-    except ValueError:
-        return 0.0
-
-
-_MEM_SUFFIX_BYTES = {
-    "Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30, "Ti": 1 << 40,
-    "K": 10 ** 3, "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
-}
-
-
-def _mem_mib(value) -> int:
-    """K8s memory quantity -> MiB. Suffixed ('8Gi', '512Mi', decimal
-    '8G') per the k8s quantity grammar; a PLAIN number is bytes (also
-    k8s semantics), so '8589934592' and 8589934592 -> 8192 MiB."""
-    if isinstance(value, (int, float)):
-        return int(value / (1 << 20))
-    s = str(value).strip()
-    try:
-        for suffix in ("Ki", "Mi", "Gi", "Ti"):
-            if s.endswith(suffix):
-                return int(
-                    float(s[: -len(suffix)])
-                    * _MEM_SUFFIX_BYTES[suffix] / (1 << 20)
-                )
-        for suffix in ("K", "M", "G", "T"):
-            if s.endswith(suffix):
-                return int(
-                    float(s[: -len(suffix)])
-                    * _MEM_SUFFIX_BYTES[suffix] / (1 << 20)
-                )
-        return int(float(s) / (1 << 20))
-    except ValueError:
-        return 0
 
 
 class ClusterWatcher:

@@ -42,22 +42,13 @@ _FATAL_EXIT_CODES = {1, 2, 126, 127, 128}
 
 
 def parse_memory_mb(quantity) -> int:
-    """Parse a k8s memory quantity ('8192Mi', '2Gi', '512M', 1024) to MiB."""
-    if isinstance(quantity, (int, float)):
-        return int(quantity)
-    s = str(quantity).strip()
-    units = {"Ki": 1 / 1024, "Mi": 1, "Gi": 1024, "Ti": 1024 * 1024,
-             "K": 1 / 1024, "M": 1, "G": 1024, "T": 1024 * 1024}
-    for suffix, factor in units.items():
-        if s.endswith(suffix):
-            try:
-                return int(float(s[: -len(suffix)]) * factor)
-            except ValueError:
-                return 0
-    try:
-        return int(float(s))
-    except ValueError:
-        return 0
+    """Parse a k8s memory quantity ('8192Mi', '2Gi', '512M', bytes-int)
+    to MiB. Delegates to the ONE shared parser
+    (``scheduler.kubernetes.parse_memory_mib``) — per the k8s grammar a
+    plain number is bytes."""
+    from dlrover_tpu.scheduler.kubernetes import parse_memory_mib
+
+    return parse_memory_mib(quantity)
 
 
 def _dig(d: Dict, *keys, default=None):

@@ -67,6 +67,11 @@ class LlamaConfig:
     # trade; sweepable via bench BENCH_BLOCK_Q/BENCH_BLOCK_K)
     flash_block_q: int = 512
     flash_block_k: int = 1024
+    # backward-kernel tiles (0 = same as forward): the dKV/dQ passes
+    # hold more live VMEM than the forward, so their optimum is often
+    # smaller — a long-context tuning lever
+    flash_block_q_bwd: int = 0
+    flash_block_k_bwd: int = 0
     # None = auto (interpret off TPU); False forces the Mosaic kernel —
     # required when TRACING on a CPU host but COMPILING for a deviceless
     # TPU topology (parallel.aot), where the backend-sniffing default
@@ -237,12 +242,16 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
                 batch_axes=("data", "fsdp"), head_axis="tensor",
                 block_q=c.flash_block_q, block_k=c.flash_block_k,
                 segment_ids=segment_ids, impl=_ring_impl(c),
+                block_q_bwd=c.flash_block_q_bwd,
+                block_k_bwd=c.flash_block_k_bwd,
             )
         elif c.seq_axis:
             out = ring_attention_local(
                 q, k, v, axis_name=c.seq_axis, causal=True,
                 block_q=c.flash_block_q, block_k=c.flash_block_k,
                 segment_ids=segment_ids, impl=_ring_impl(c),
+                block_q_bwd=c.flash_block_q_bwd,
+                block_k_bwd=c.flash_block_k_bwd,
             )
         else:
             from dlrover_tpu.ops.flash_attention import (
@@ -253,6 +262,8 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
                 q, k, v, segment_ids, c.use_flash,
                 block_q=c.flash_block_q, block_k=c.flash_block_k,
                 interpret=c.flash_interpret,
+                block_q_bwd=c.flash_block_q_bwd,
+                block_k_bwd=c.flash_block_k_bwd,
             )
     elif c.seq_axis and c.mesh is not None:
         out = ring_attention(
@@ -260,20 +271,26 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
             batch_axes=("data", "fsdp"), head_axis="tensor",
             block_q=c.flash_block_q, block_k=c.flash_block_k,
             impl=_ring_impl(c),
+            block_q_bwd=c.flash_block_q_bwd,
+            block_k_bwd=c.flash_block_k_bwd,
         )
     elif c.seq_axis:
         out = ring_attention_local(q, k, v, axis_name=c.seq_axis,
                                    causal=True,
                                    block_q=c.flash_block_q,
                                    block_k=c.flash_block_k,
-                                   impl=_ring_impl(c))
+                                   impl=_ring_impl(c),
+                                   block_q_bwd=c.flash_block_q_bwd,
+                                   block_k_bwd=c.flash_block_k_bwd)
     elif c.use_flash:
         # auto-routes through shard_map under a non-trivial mesh (GSPMD
         # cannot partition the Mosaic call itself)
         out = flash_attention_auto(q, k, v, True,
                                    block_q=c.flash_block_q,
                                    block_k=c.flash_block_k,
-                                   interpret=c.flash_interpret)
+                                   interpret=c.flash_interpret,
+                                   block_q_bwd=c.flash_block_q_bwd,
+                                   block_k_bwd=c.flash_block_k_bwd)
     else:
         out = mha_reference(q, k, v, causal=True)
     out = checkpoint_name(out, "attn_out")

@@ -269,7 +269,7 @@ def _vmem(shape):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
 def flash_attention_lse(
     q: jax.Array,  # [B, H, S, D]
@@ -280,14 +280,22 @@ def flash_attention_lse(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ):
     """Attention returning ``(out, lse)`` where ``lse[b,h,s]`` is the
     row logsumexp of the (scaled, masked) scores. Differentiable in both
     outputs — the lse cotangent folds into the backward's delta term
     (``ds = p * (dp - (delta - dlse))``), which is what makes the
-    ring-attention merge exact under autodiff."""
+    ring-attention merge exact under autodiff.
+
+    ``block_q_bwd``/``block_k_bwd`` (0 = same as forward) tile the
+    backward kernels independently: the dKV/dQ passes hold more live
+    VMEM tiles than the forward, so their optimum is usually smaller —
+    a long-context tuning lever (``BENCH_BLOCK_Q_BWD``)."""
     (out, lse), _ = _flash_attention_lse_fwd(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, causal, scale, block_q, block_k, interpret,
+        block_q_bwd, block_k_bwd,
     )
     return out, lse
 
@@ -301,11 +309,14 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """Memory-efficient attention; differentiable (blockwise recompute
     backward from the saved logsumexp, no quadratic residuals)."""
     return flash_attention_lse(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, causal, scale, block_q, block_k, interpret,
+        block_q_bwd, block_k_bwd,
     )[0]
 
 
@@ -337,6 +348,8 @@ def flash_attention_auto(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """``flash_attention`` that routes itself through the ``shard_map``
     wrapper whenever the ambient mesh is non-trivial — GSPMD cannot
@@ -348,9 +361,10 @@ def flash_attention_auto(
         return flash_attention_sharded(
             q, k, v, mesh, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
         )
     return flash_attention(q, k, v, causal, scale, block_q, block_k,
-                           interpret)
+                           interpret, block_q_bwd, block_k_bwd)
 
 
 def _shard_mapped_attention(mesh, body, q, k, v, extras=(),
@@ -403,6 +417,8 @@ def flash_attention_segmented_auto(
     interpret: Optional[bool] = None,
     batch_axes=("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """Multi-chip-safe ``flash_attention_segmented``: same shard_map
     routing discipline as ``flash_attention_auto`` — GSPMD cannot
@@ -413,12 +429,13 @@ def flash_attention_segmented_auto(
     if mesh is None:
         return flash_attention_segmented(
             q, k, v, segment_ids, causal, scale, block_q, block_k,
-            interpret,
+            interpret, block_q_bwd, block_k_bwd,
         )
 
     def body(ql, kl, vl, segl):
         return flash_attention_segmented(
-            ql, kl, vl, segl, causal, scale, block_q, block_k, interpret
+            ql, kl, vl, segl, causal, scale, block_q, block_k,
+            interpret, block_q_bwd, block_k_bwd,
         )
 
     return _shard_mapped_attention(
@@ -457,6 +474,8 @@ def flash_attention_sharded(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """The multi-chip flash path: GSPMD cannot auto-partition a Mosaic
     custom call, so the kernel runs under ``shard_map`` with batch on
@@ -467,7 +486,8 @@ def flash_attention_sharded(
 
     def body(ql, kl, vl):
         return flash_attention(ql, kl, vl, causal, scale,
-                               block_q, block_k, interpret)
+                               block_q, block_k, interpret,
+                               block_q_bwd, block_k_bwd)
 
     return _shard_mapped_attention(
         mesh, body, q, k, v, batch_axes=batch_axes, head_axis=head_axis,
@@ -482,7 +502,7 @@ def _resolve(scale, head_dim, interpret):
 
 
 def _flash_attention_lse_fwd(q, k, v, causal, scale, block_q, block_k,
-                             interpret):
+                             interpret, block_q_bwd=0, block_k_bwd=0):
     scale_v, interp = _resolve(scale, q.shape[-1], interpret)
     out, lse = _flash_forward(
         q, k, v, scale=scale_v, causal=causal,
@@ -784,12 +804,14 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
 
 
 def _flash_attention_lse_bwd(causal, scale, block_q, block_k, interpret,
-                             residuals, cotangents):
+                             block_q_bwd, block_k_bwd, residuals,
+                             cotangents):
     q, k, v, out, lse = residuals
     do, dlse = cotangents
     return _flash_backward(
         q, k, v, out, lse, do, dlse, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret,
     )
 
 
@@ -801,7 +823,7 @@ flash_attention_lse.defvjp(
 # -- packed-sequence (segmented) flash attention ----------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def flash_attention_segmented(
     q: jax.Array,  # [B, H, S, D]
     k: jax.Array,  # [B, H_kv, S, D]
@@ -812,6 +834,8 @@ def flash_attention_segmented(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """Flash attention over PACKED sequences: multiple documents share one
     row, separated by ``segment_ids``; tokens attend only within their
@@ -822,6 +846,7 @@ def flash_attention_segmented(
     CUDA kernels (``atorch/modules/transformer/layers.py:1095``
     ``flash_attn_with_mask_bias``); here the mask is fused into the
     Pallas tiles, never materializing S x S."""
+    del block_q_bwd, block_k_bwd  # backward-only (vjp reads them)
     out, _lse = _flash_seg_fwd_impl(
         q, k, v, segment_ids, causal, scale, block_q, block_k, interpret
     )
@@ -840,7 +865,7 @@ def _flash_seg_fwd_impl(q, k, v, segment_ids, causal, scale, block_q,
 
 
 def _flash_seg_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k,
-                   interpret):
+                   interpret, block_q_bwd=0, block_k_bwd=0):
     out, lse = _flash_seg_fwd_impl(
         q, k, v, segment_ids, causal, scale, block_q, block_k, interpret
     )
@@ -848,15 +873,15 @@ def _flash_seg_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k,
 
 
 def _flash_seg_bwd(causal, scale, block_q, block_k, interpret,
-                   residuals, do):
+                   block_q_bwd, block_k_bwd, residuals, do):
     import numpy as np
 
     q, k, v, segment_ids, out, lse = residuals
     dlse = jnp.zeros_like(lse)
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, do, dlse, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        segment_ids=segment_ids,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret, segment_ids=segment_ids,
     )
     # integer primal: cotangent is float0 (no gradient flows to ids)
     dseg = np.zeros(segment_ids.shape, dtype=jax.dtypes.float0)
@@ -871,7 +896,9 @@ flash_attention_segmented.defvjp(_flash_seg_fwd, _flash_seg_bwd)
 # vjps in sync with _flash_backward bought nothing.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11)
+)
 def flash_attention_segmented_pair_lse(
     q: jax.Array,
     k: jax.Array,
@@ -883,10 +910,13 @@ def flash_attention_segmented_pair_lse(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ):
     """Segmented flash where the q-side and kv-side segment ids are
     INDEPENDENT arrays — the ring-attention step shape (local queries
     against a visiting KV shard). Returns (out, lse)."""
+    del block_q_bwd, block_k_bwd  # backward-only (vjp reads them)
     return _flash_seg_pair_impl(
         q, k, v, seg_q, seg_k, causal, scale, block_q, block_k, interpret
     )
@@ -904,7 +934,8 @@ def _flash_seg_pair_impl(q, k, v, seg_q, seg_k, causal, scale, block_q,
 
 
 def _flash_seg_pair_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
-                        block_k, interpret):
+                        block_k, interpret, block_q_bwd=0,
+                        block_k_bwd=0):
     out, lse = _flash_seg_pair_impl(
         q, k, v, seg_q, seg_k, causal, scale, block_q, block_k, interpret
     )
@@ -912,15 +943,16 @@ def _flash_seg_pair_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
 
 
 def _flash_seg_pair_bwd(causal, scale, block_q, block_k, interpret,
-                        residuals, cotangents):
+                        block_q_bwd, block_k_bwd, residuals,
+                        cotangents):
     import numpy as np
 
     q, k, v, seg_q, seg_k, out, lse = residuals
     do, dlse = cotangents
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, do, dlse, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        segment_ids=seg_q, segment_ids_kv=seg_k,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret, segment_ids=seg_q, segment_ids_kv=seg_k,
     )
     f0 = jax.dtypes.float0
     return (dq, dk, dv, np.zeros(seg_q.shape, f0),
@@ -934,7 +966,7 @@ flash_attention_segmented_pair_lse.defvjp(_flash_seg_pair_fwd,
 # -- prefix-LM flash attention ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def flash_attention_prefix(
     q: jax.Array,  # [B, H, S, D]
     k: jax.Array,
@@ -944,6 +976,8 @@ def flash_attention_prefix(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """Prefix-LM flash attention (GLM's mask): token ``i`` attends key
     ``j`` iff ``j <= i`` (causal) OR ``j < prefix_len`` (the prompt is
@@ -951,6 +985,7 @@ def flash_attention_prefix(
     family's alternative to materializing an S x S bias. Reference
     counterpart: ``fa2_with_glm_mask``
     (``atorch/modules/transformer/layers.py:1191``)."""
+    del block_q_bwd, block_k_bwd  # backward-only (vjp reads them)
     out, _lse = _flash_prefix_fwd_impl(
         q, k, v, prefix_len, scale, block_q, block_k, interpret
     )
@@ -969,22 +1004,23 @@ def _flash_prefix_fwd_impl(q, k, v, prefix_len, scale, block_q, block_k,
 
 
 def _flash_prefix_fwd(q, k, v, prefix_len, scale, block_q, block_k,
-                      interpret):
+                      interpret, block_q_bwd=0, block_k_bwd=0):
     out, lse = _flash_prefix_fwd_impl(
         q, k, v, prefix_len, scale, block_q, block_k, interpret
     )
     return out, (q, k, v, prefix_len, out, lse)
 
 
-def _flash_prefix_bwd(scale, block_q, block_k, interpret, residuals, do):
+def _flash_prefix_bwd(scale, block_q, block_k, interpret, block_q_bwd,
+                      block_k_bwd, residuals, do):
     import numpy as np
 
     q, k, v, prefix_len, out, lse = residuals
     dlse = jnp.zeros_like(lse)
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, do, dlse, causal=True, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        prefix_len=prefix_len,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret, prefix_len=prefix_len,
     )
     dprefix = np.zeros(prefix_len.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, dprefix
@@ -995,7 +1031,9 @@ flash_attention_prefix.defvjp(_flash_prefix_fwd, _flash_prefix_bwd)
 
 def segmented_attention(q, k, v, segment_ids, use_flash: bool,
                         block_q: int = 512, block_k: int = 1024,
-                        interpret: Optional[bool] = None) -> jax.Array:
+                        interpret: Optional[bool] = None,
+                        block_q_bwd: int = 0,
+                        block_k_bwd: int = 0) -> jax.Array:
     """The one segmented-attention dispatch every model family shares:
     fused Pallas kernel (shard_map-routed) when flash is on, additive
     bias over the XLA reference otherwise. Centralized so the mask
@@ -1004,6 +1042,7 @@ def segmented_attention(q, k, v, segment_ids, use_flash: bool,
         return flash_attention_segmented_auto(
             q, k, v, segment_ids, causal=True,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
         )
     same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
     bias = jnp.where(same, 0.0, jnp.finfo(jnp.float32).min)

@@ -131,7 +131,7 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
 
 
 def _attend_lse(q, k, v, *, causal, scale, impl, block_q, block_k,
-                seg_q=None, seg_k=None):
+                seg_q=None, seg_k=None, block_q_bwd=0, block_k_bwd=0):
     """One (local-q x visiting-kv) shard attention -> (out f32, lse f32)."""
     if impl == "xla":
         return _xla_attend_lse(q, k, v, causal=causal, scale=scale,
@@ -146,12 +146,12 @@ def _attend_lse(q, k, v, *, causal, scale, impl, block_q, block_k,
 
         out, lse = flash_attention_segmented_pair_lse(
             q, k, v, seg_q, seg_k, causal, scale, block_q, block_k,
-            interp,
+            interp, block_q_bwd, block_k_bwd,
         )
         return out.astype(jnp.float32), lse
     out, lse = flash_attention_lse(
         q, k, v, causal, scale, block_q, block_k,
-        interpret=interp,
+        interp, block_q_bwd, block_k_bwd,
     )
     return out.astype(jnp.float32), lse
 
@@ -167,6 +167,8 @@ def ring_attention_local(
     block_q: int = 512,
     block_k: int = 1024,
     segment_ids: Optional[jax.Array] = None,  # local [B, S_local]
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """The per-device body; call inside shard_map over ``axis_name``.
 
@@ -184,6 +186,7 @@ def ring_attention_local(
     attend = functools.partial(
         _attend_lse, scale=scale, impl=impl,
         block_q=block_q, block_k=block_k,
+        block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
     )
     seg = segment_ids
 
@@ -255,6 +258,8 @@ def ring_attention(
     block_q: int = 512,
     block_k: int = 1024,
     segment_ids: Optional[jax.Array] = None,  # global [B, S]
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
 ) -> jax.Array:
     """shard_map wrapper: global arrays in, global arrays out.
 
@@ -305,6 +310,7 @@ def ring_attention(
     body = functools.partial(
         ring_attention_local, axis_name=axis_name, causal=causal,
         scale=scale, impl=impl, block_q=block_q, block_k=block_k,
+        block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
     )
     if segment_ids is not None:
         seg_spec = P(batch_axes, axis_name)

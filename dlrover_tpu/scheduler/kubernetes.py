@@ -129,6 +129,50 @@ def build_pod_labels(job_name: str, node_type: str, rank_index: int) -> Dict[str
     }
 
 
+def parse_cpu_cores(quantity) -> float:
+    """K8s cpu quantity -> cores: '500m' -> 0.5, '4' -> 4.0, 2 -> 2.0.
+
+    The ONE cpu-quantity parser (master watcher + brain watcher) — two
+    divergent copies would let the same pod spec ingest differently."""
+    if isinstance(quantity, (int, float)):
+        return float(quantity)
+    s = str(quantity).strip()
+    try:
+        if s.endswith("m"):
+            return float(s[:-1]) / 1000.0
+        return float(s)
+    except ValueError:
+        return 0.0
+
+
+_MEM_SUFFIX_BYTES = {
+    "Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30, "Ti": 1 << 40,
+    "K": 10 ** 3, "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
+}
+
+
+def parse_memory_mib(quantity) -> int:
+    """K8s memory quantity -> MiB, per the real quantity grammar:
+    binary suffixes ('8Gi', '512Mi'), decimal suffixes ('8G' = 8e9
+    bytes), and a PLAIN number is BYTES — '8589934592' and 8589934592
+    are both 8192 MiB. The ONE memory-quantity parser (see
+    ``parse_cpu_cores``)."""
+    if isinstance(quantity, (int, float)):
+        return int(quantity / (1 << 20))
+    s = str(quantity).strip()
+    try:
+        # two-char binary suffixes first: 'Mi' must not match 'M'
+        for suffix in ("Ki", "Mi", "Gi", "Ti", "K", "M", "G", "T"):
+            if s.endswith(suffix):
+                return int(
+                    float(s[: -len(suffix)])
+                    * _MEM_SUFFIX_BYTES[suffix] / (1 << 20)
+                )
+        return int(float(s) / (1 << 20))
+    except ValueError:
+        return 0
+
+
 def build_pod_spec(
     job_name: str,
     pod_name: str,

@@ -102,3 +102,20 @@ def test_smoke_mfu_through_supervisor():
     assert rec["metric"] == "llama_pretrain_mfu"
     assert rec["value"] > 0 and "error" not in rec
     assert rec["detail"]["final_loss"] > 0
+
+
+@pytest.mark.slow
+def test_smoke_packed_preset():
+    """BENCH_PACKED: segmented batches flow through the whole bench and
+    attention FLOPs are counted per document (doc_len caps the span)."""
+    proc = _run_bench({
+        "BENCH_PLATFORM": "cpu",
+        "BENCH_SKIP_RECOVERY": "1",
+        "BENCH_STEPS": "2",
+        "BENCH_PACKED": "1",
+        "BENCH_DOC_LEN": "32",
+        "JAX_PLATFORMS": "cpu",
+    })
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = _tail_json(proc)
+    assert rec["value"] > 0 and "error" not in rec

@@ -14,11 +14,10 @@ import pytest
 from dlrover_tpu.brain.datastore import MemoryDatastore, SqliteDatastore
 from dlrover_tpu.brain.messages import MetricType, OptimizeRequest
 from dlrover_tpu.brain.service import BrainService
-from dlrover_tpu.brain.watcher import (
-    ClusterWatcher,
-    K8sClusterSource,
-    _cpu_cores,
-    _mem_mib,
+from dlrover_tpu.brain.watcher import ClusterWatcher, K8sClusterSource
+from dlrover_tpu.scheduler.kubernetes import (
+    parse_cpu_cores,
+    parse_memory_mib,
 )
 from dlrover_tpu.common.constants import JobStage, NodeType
 
@@ -133,7 +132,7 @@ class TestK8sSource:
                 assert label_selector == "elasticjob-name=train-2"
                 return [
                     {"metadata": {"name": "train-2-worker-0",
-                                  "labels": {"node-type": "worker"}},
+                                  "labels": {"replica-type": "worker"}},
                      "spec": {"containers": [
                          # sidecar first: effective request is the SUM
                          {"resources": {"requests": {
@@ -142,7 +141,7 @@ class TestK8sSource:
                              "cpu": "4", "memory": "8Gi"}}},
                      ]}},
                     {"metadata": {"name": "train-2-master-0",
-                                  "labels": {"node-type": "master"}},
+                                  "labels": {"elasticjob-role": "master"}},
                      "spec": {}},
                 ]
 
@@ -164,16 +163,16 @@ class TestK8sSource:
     def test_quantity_parsing(self):
         # k8s quantity grammar: binary/decimal suffixes; PLAIN numbers
         # are bytes (memory) / cores (cpu)
-        assert _mem_mib("4Gi") == 4096
-        assert _mem_mib("512Mi") == 512
-        assert _mem_mib("8G") == 7629  # 8e9 bytes in MiB
-        assert _mem_mib("8589934592") == 8192
-        assert _mem_mib(8589934592) == 8192
-        assert _mem_mib("garbage") == 0
-        assert _cpu_cores("500m") == 0.5
-        assert _cpu_cores("4") == 4.0
-        assert _cpu_cores(2) == 2.0
-        assert _cpu_cores("oops") == 0.0
+        assert parse_memory_mib("4Gi") == 4096
+        assert parse_memory_mib("512Mi") == 512
+        assert parse_memory_mib("8G") == 7629  # 8e9 bytes in MiB
+        assert parse_memory_mib("8589934592") == 8192
+        assert parse_memory_mib(8589934592) == 8192
+        assert parse_memory_mib("garbage") == 0
+        assert parse_cpu_cores("500m") == 0.5
+        assert parse_cpu_cores("4") == 4.0
+        assert parse_cpu_cores(2) == 2.0
+        assert parse_cpu_cores("oops") == 0.0
 
 
 class TestCrossJobColdStartE2E:
