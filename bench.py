@@ -368,7 +368,10 @@ def _build_train(devices, preset: str):
         ),
         devices=devices,
     )
-    return result, batch, config, batch_size, seq_len
+    # doc_len: 0 = unpacked; packed mode's effective (clamped) document
+    # length — the MFU accounting must use EXACTLY the value the batch
+    # was built with, never a second env read that could drift
+    return result, batch, config, batch_size, seq_len, doc_len
 
 
 def _maybe_emit_mttr():
@@ -437,7 +440,7 @@ def _mfu_worker(out_path: str) -> int:
 
     from dlrover_tpu.models import llama
 
-    result, batch, config, batch_size, seq_len = _build_train(
+    result, batch, config, batch_size, seq_len, doc_len = _build_train(
         devices, preset
     )
     n_dev = len(devices)
@@ -469,10 +472,7 @@ def _mfu_worker(out_path: str) -> int:
     # BENCH_PACKED, attention spans only the document (the segmented
     # kernel skips cross-document tiles), so USEFUL attention FLOPs
     # scale with doc_len — counting seq_len would overstate MFU
-    attn_span = seq_len
-    if os.environ.get("BENCH_PACKED", "") == "1":
-        attn_span = max(1, min(
-            int(os.environ.get("BENCH_DOC_LEN", "2048")), seq_len))
+    attn_span = doc_len or seq_len
     n_params = llama.param_count(config)
     attn_flops_tok = (
         12 * config.num_layers * config.hidden_size * attn_span * 0.5
@@ -632,7 +632,7 @@ def _recovery_worker(ckpt_dir: str, status_file: str, total_steps: int,
 
     t_boot = time.time()
     phases = {"t_devices_s": round(time.time() - _T_PROC_START, 2)}
-    result, batch, config, _, _ = _build_train(devices, preset)
+    result, batch, config, _, _, _ = _build_train(devices, preset)
     sharded = result.shard_batch(batch)
     mgr = ElasticCheckpointManager(ckpt_dir, max_to_keep=2)
     phases["t_build_s"] = round(time.time() - t_boot, 2)

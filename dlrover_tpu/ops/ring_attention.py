@@ -136,7 +136,10 @@ def _attend_lse(q, k, v, *, causal, scale, impl, block_q, block_k,
     if impl == "xla":
         return _xla_attend_lse(q, k, v, causal=causal, scale=scale,
                                block_k=block_k, seg_q=seg_q, seg_k=seg_k)
-    interp = (impl == "pallas_interpret") or None
+    # "pallas" must pin interpret=False: under AOT the host backend is
+    # CPU and the _resolve sniff would lower the interpreter emulation
+    # into a TPU executable
+    interp = True if impl == "pallas_interpret" else False
     if seg_q is not None:
         # ring steps attend local q against a VISITING kv shard: the two
         # sides carry independent segment arrays

@@ -66,6 +66,73 @@ class AotReport:
         return json.dumps(d)
 
 
+_LIBTPU_LOCKFILE = "/tmp/libtpu_lockfile"
+
+
+def _get_topology_desc_serialized(topologies, topology: str,
+                                  tries: int = 20, wait_s: float = 15.0):
+    """``get_topology_desc`` with libtpu single-host serialization.
+
+    libtpu holds ``/tmp/libtpu_lockfile`` for the LIFETIME of the
+    process that initialized it; a second initialization on the same
+    host aborts ("Internal error when accessing libtpu multi-process
+    lockfile"), and a SIGKILLed holder leaves the file behind so even
+    the next solo run aborts. Distinguish the two with a non-blocking
+    flock probe: acquirable means the holder is gone (stale file —
+    remove it and retry immediately); unacquirable means a live
+    sibling compile, so wait for it to finish.
+    """
+    import time
+
+    for attempt in range(tries):
+        try:
+            return topologies.get_topology_desc(
+                platform="tpu", topology_name=topology
+            )
+        except Exception as e:  # noqa: BLE001 — only the lockfile retries
+            if "libtpu" not in str(e) or "lockfile" not in str(e):
+                raise
+            if attempt == tries - 1:
+                raise
+            stale = False
+            try:
+                import fcntl
+                import os as _os
+
+                with open(_LIBTPU_LOCKFILE) as fh:
+                    try:
+                        fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    except OSError:
+                        pass  # a sibling compile holds it: wait
+                    else:
+                        # unlink WHILE holding the lock: releasing
+                        # first would let a new compile lock this very
+                        # inode in the gap, and removing it from under
+                        # that holder would permit two live libtpu
+                        # inits — the abort this handler prevents
+                        stale = True
+                        logger.warning(
+                            "removing stale %s (no live holder; a "
+                            "killed jax process left it)",
+                            _LIBTPU_LOCKFILE,
+                        )
+                        try:
+                            _os.remove(_LIBTPU_LOCKFILE)
+                        except OSError:
+                            pass
+                        fcntl.flock(fh, fcntl.LOCK_UN)
+            except OSError:
+                continue  # file vanished: retry immediately
+            if stale:
+                continue
+            logger.info(
+                "libtpu lockfile held by a live process; waiting %.0fs "
+                "(attempt %d/%d)", wait_s, attempt + 1, tries,
+            )
+            time.sleep(wait_s)
+    raise RuntimeError("unreachable")
+
+
 def aot_compile_train_step(
     config,
     topology: str = "v5:2x2x4",
@@ -75,12 +142,18 @@ def aot_compile_train_step(
     rule_set: str = "llama",
     remat_policy: str = "",
     model_name: str = "llama",
+    ring: bool = False,
+    head_chunk: int = 0,
 ) -> AotReport:
     """Compile the full accelerate() train step for ``config`` against a
     deviceless TPU topology; assert HBM fit via memory_analysis.
 
     ``mesh_plan``: explicit MeshPlan; default = the roofline planner's
     top choice for this model/topology (``planner.plan_mesh``).
+
+    ``ring``: run ring attention over the plan's "seq" axis (requires an
+    explicit ``mesh_plan`` with seq > 1) — proves the flash-fused
+    long-context multi-chip path lowers and fits at scale, hermetically.
     """
     import time
 
@@ -96,9 +169,7 @@ def aot_compile_train_step(
     from dlrover_tpu.parallel.strategy import Strategy
 
     topology = KNOWN_TOPOLOGIES.get(topology, topology)
-    topo = topologies.get_topology_desc(
-        platform="tpu", topology_name=topology
-    )
+    topo = _get_topology_desc_serialized(topologies, topology)
     devices = list(topo.devices)
     n = len(devices)
     device_spec = planner.TPU_SPECS[tpu_gen]
@@ -122,6 +193,20 @@ def aot_compile_train_step(
             mesh_plan, scores[0].step_time_s,
         )
 
+    if ring:
+        from dataclasses import replace as _replace
+
+        seq_size = dict(mesh_plan.axis_sizes()).get("seq", 1)
+        if seq_size <= 1:
+            raise ValueError(
+                "ring=True needs an explicit mesh_plan with seq > 1"
+            )
+        # the exact mesh accelerate() will build — same plan, same
+        # device order — so the ring's shard_map axis resolves
+        config = _replace(
+            config, seq_axis="seq", mesh=mesh_plan.build(devices)
+        )
+
     rng_np = np.random.RandomState(0)
     seq = config.max_seq_len
     ids = rng_np.randint(
@@ -134,7 +219,7 @@ def aot_compile_train_step(
     def compile_plan(plan):
         result = accelerate(
             llama.make_init_fn(config),
-            llama.make_loss_fn(config),
+            llama.make_loss_fn(config, head_chunk=head_chunk),
             optax.adafactor(1e-3),
             batch,
             strategy=Strategy(
@@ -263,6 +348,13 @@ def main(argv: Optional[list] = None) -> int:
                         "the Pallas kernel")
     p.add_argument("--mesh", default="",
                    help="override the planner, e.g. data=2,fsdp=4,tensor=2")
+    p.add_argument("--ring", action="store_true",
+                   help="run ring attention over the mesh's seq axis "
+                        "(long-context path; requires --mesh with seq>1)")
+    p.add_argument("--head-chunk", type=int, default=0,
+                   help="fused chunked lm-head loss chunk size (0=off; "
+                        "required at long seq x large vocab, where full "
+                        "[B,S,V] f32 logits alone exceed HBM)")
     args = p.parse_args(argv)
 
     jax.config.update("jax_platforms", "cpu")  # AOT needs no devices
@@ -300,6 +392,8 @@ def main(argv: Optional[list] = None) -> int:
             k: int(v) for k, v in
             (kv.split("=") for kv in args.mesh.split(","))
         })
+    if args.ring and mesh_plan is None:
+        p.error("--ring requires --mesh with a seq>1 axis")
     report = aot_compile_train_step(
         config,
         topology=args.topology,
@@ -307,6 +401,8 @@ def main(argv: Optional[list] = None) -> int:
         global_batch=args.batch,
         mesh_plan=mesh_plan,
         model_name=args.model,
+        ring=args.ring,
+        head_chunk=args.head_chunk,
     )
     print(report.to_json())
     return 0 if report.fits else 1
