@@ -70,7 +70,8 @@ _LIBTPU_LOCKFILE = "/tmp/libtpu_lockfile"
 
 
 def _get_topology_desc_serialized(topologies, topology: str,
-                                  tries: int = 20, wait_s: float = 15.0):
+                                  wait_budget_s: float = 1800.0,
+                                  poll_s: float = 15.0):
     """``get_topology_desc`` with libtpu single-host serialization.
 
     libtpu holds ``/tmp/libtpu_lockfile`` for the LIFETIME of the
@@ -79,12 +80,17 @@ def _get_topology_desc_serialized(topologies, topology: str,
     lockfile"), and a SIGKILLed holder leaves the file behind so even
     the next solo run aborts. Distinguish the two with a non-blocking
     flock probe: acquirable means the holder is gone (stale file —
-    remove it and retry immediately); unacquirable means a live
-    sibling compile, so wait for it to finish.
+    unlink it while STILL holding the lock, and only if the path's
+    inode is the one we locked, so a sibling's freshly created live
+    lockfile is never deleted out from under it); unacquirable means a
+    live sibling compile, so wait. The wait is a TIME budget, not an
+    attempt count — queued compiles on one host each hold the lock for
+    their full compile (minutes), and several can be ahead of us.
     """
     import time
 
-    for attempt in range(tries):
+    deadline = time.time() + wait_budget_s
+    while True:
         try:
             return topologies.get_topology_desc(
                 platform="tpu", topology_name=topology
@@ -92,9 +98,8 @@ def _get_topology_desc_serialized(topologies, topology: str,
         except Exception as e:  # noqa: BLE001 — only the lockfile retries
             if "libtpu" not in str(e) or "lockfile" not in str(e):
                 raise
-            if attempt == tries - 1:
+            if time.time() >= deadline:
                 raise
-            stale = False
             try:
                 import fcntl
                 import os as _os
@@ -103,34 +108,41 @@ def _get_topology_desc_serialized(topologies, topology: str,
                     try:
                         fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
                     except OSError:
-                        pass  # a sibling compile holds it: wait
-                    else:
-                        # unlink WHILE holding the lock: releasing
-                        # first would let a new compile lock this very
-                        # inode in the gap, and removing it from under
-                        # that holder would permit two live libtpu
-                        # inits — the abort this handler prevents
-                        stale = True
-                        logger.warning(
-                            "removing stale %s (no live holder; a "
-                            "killed jax process left it)",
-                            _LIBTPU_LOCKFILE,
+                        # a live sibling holds it: wait within budget
+                        logger.info(
+                            "libtpu lockfile held by a live process; "
+                            "polling (%.0fs of budget left)",
+                            deadline - time.time(),
                         )
-                        try:
+                        time.sleep(
+                            max(0.0, min(poll_s,
+                                         deadline - time.time()))
+                        )
+                        continue
+                    try:
+                        # we hold the flock on OUR opened inode; only
+                        # unlink if the path still names that inode — a
+                        # sibling may have recreated the file (its live
+                        # lock is on a NEW inode our flock says nothing
+                        # about)
+                        if (_os.fstat(fh.fileno()).st_ino
+                                == _os.stat(_LIBTPU_LOCKFILE).st_ino):
+                            logger.warning(
+                                "removing stale %s (no live holder; a "
+                                "killed jax process left it)",
+                                _LIBTPU_LOCKFILE,
+                            )
                             _os.remove(_LIBTPU_LOCKFILE)
-                        except OSError:
-                            pass
+                    except OSError:
+                        pass
+                    finally:
                         fcntl.flock(fh, fcntl.LOCK_UN)
             except OSError:
-                continue  # file vanished: retry immediately
-            if stale:
-                continue
-            logger.info(
-                "libtpu lockfile held by a live process; waiting %.0fs "
-                "(attempt %d/%d)", wait_s, attempt + 1, tries,
-            )
-            time.sleep(wait_s)
-    raise RuntimeError("unreachable")
+                pass  # file vanished: retry immediately
+            # brief pause so a pathologically recreating-and-failing
+            # init cannot busy-spin the loop until the deadline
+            time.sleep(max(0.0, min(0.2, poll_s)))
+            continue
 
 
 def aot_compile_train_step(
@@ -144,6 +156,7 @@ def aot_compile_train_step(
     model_name: str = "llama",
     ring: bool = False,
     head_chunk: int = 0,
+    packed_doc_len: int = 0,
 ) -> AotReport:
     """Compile the full accelerate() train step for ``config`` against a
     deviceless TPU topology; assert HBM fit via memory_analysis.
@@ -177,6 +190,13 @@ def aot_compile_train_step(
     model = planner.model_spec_from_llama(config, global_batch)
     effective_remat = remat_policy or getattr(config, "remat_policy", "")
     fallback_plans: list = []
+    if mesh_plan is not None:
+        # a CLI/user plan may carry an unresolved data=-1 axis; resolve
+        # it against the topology NOW — estimate() and the report's
+        # mesh dict must see the same chip count accelerate() compiles
+        # for, or the artifact's predicted numbers are for a different
+        # (smaller) machine
+        mesh_plan = mesh_plan.resolve(n)
     if mesh_plan is None:
         scores = planner.plan_mesh(model, n, device_spec,
                                    remat_policy=effective_remat, top_k=3)
@@ -216,6 +236,18 @@ def aot_compile_train_step(
         "input_ids": jnp.asarray(ids[:, :-1]),
         "labels": jnp.asarray(ids[:, 1:]),
     }
+    if packed_doc_len:
+        # packed documents (segment ids + cross-document masking in the
+        # kernel tiles), the production long-context batch shape
+        doc = max(1, min(packed_doc_len, seq))
+        seg = (np.arange(seq) // doc).astype(np.int32)
+        seg = np.broadcast_to(seg, (global_batch, seq)).copy()
+        same_next = np.concatenate(
+            [seg[:, :-1] == seg[:, 1:],
+             np.zeros((global_batch, 1), bool)], axis=1)
+        batch["segment_ids"] = jnp.asarray(seg)
+        batch["labels"] = jnp.asarray(
+            np.where(same_next, ids[:, 1:], -100))
     def compile_plan(plan):
         result = accelerate(
             llama.make_init_fn(config),
@@ -355,6 +387,13 @@ def main(argv: Optional[list] = None) -> int:
                    help="fused chunked lm-head loss chunk size (0=off; "
                         "required at long seq x large vocab, where full "
                         "[B,S,V] f32 logits alone exceed HBM)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="switch-MoE with N experts (rule_set=moe: "
+                        "expert parallelism over the data x fsdp "
+                        "submesh)")
+    p.add_argument("--packed-doc-len", type=int, default=0,
+                   help="pack N-token documents per row (segmented "
+                        "fused-mask kernel; composes with --ring)")
     args = p.parse_args(argv)
 
     jax.config.update("jax_platforms", "cpu")  # AOT needs no devices
@@ -372,6 +411,8 @@ def main(argv: Optional[list] = None) -> int:
         # emulation the backend-sniffing default would pick
         flash_interpret=False,
     )
+    if args.experts:
+        overrides["num_experts"] = args.experts
     if args.flash is not None:
         # only override the factory's use_flash when the user asked
         # (llama_tiny deliberately defaults to the XLA reference path)
@@ -400,9 +441,12 @@ def main(argv: Optional[list] = None) -> int:
         tpu_gen=args.gen,
         global_batch=args.batch,
         mesh_plan=mesh_plan,
-        model_name=args.model,
+        model_name=args.model + (f"+moe{args.experts}" if args.experts
+                                 else ""),
+        rule_set="moe" if args.experts else "llama",
         ring=args.ring,
         head_chunk=args.head_chunk,
+        packed_doc_len=args.packed_doc_len,
     )
     print(report.to_json())
     return 0 if report.fits else 1

@@ -36,6 +36,31 @@ def test_known_topology_aliases_cover_v5p_sizes():
 
 
 @pytest.mark.slow
+def test_tiny_moe_and_packed_ring_compile_deviceless():
+    """The round-4 prover modes at test scale: switch-MoE with the moe
+    rule set, and packed documents flowing through the ring with the
+    segmented pair kernel — both against a virtual topology."""
+    moe = llama.llama_tiny(use_flash=False, num_experts=4, moe_top_k=1)
+    report = aot_compile_train_step(
+        moe, topology="v5p-16", tpu_gen="v5p", global_batch=16,
+        rule_set="moe", model_name="llama_tiny+moe4",
+        mesh_plan=MeshPlan(data=2, fsdp=2, tensor=2),
+    )
+    assert report.fits and report.params == llama.param_count(moe)
+
+    ring_cfg = llama.llama_tiny(
+        use_flash=True, flash_interpret=False,  # force Mosaic lowering
+        flash_block_q=64, flash_block_k=64,
+    )
+    report = aot_compile_train_step(
+        ring_cfg, topology="v5p-16", tpu_gen="v5p", global_batch=16,
+        mesh_plan=MeshPlan(fsdp=2, seq=2, tensor=2),
+        ring=True, packed_doc_len=32, model_name="llama_tiny+ring",
+    )
+    assert report.fits
+
+
+@pytest.mark.slow
 def test_llama2_7b_fits_v5p_32():
     """The BASELINE row: real 7B config, 16-chip v5p-32, the artifact's
     mesh (data=8 x tensor=2 — AOT_7B.json), PRODUCTION attention path
