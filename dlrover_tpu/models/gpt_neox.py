@@ -61,6 +61,13 @@ class GPTNeoXConfig:
     flash_block_q: int = 512
     flash_block_k: int = 1024
     flash_interpret: Any = None
+    # sequence parallelism (long context): seq_axis="seq" + the Mesh
+    # runs ring attention inside the jitted GSPMD program — same
+    # contract as LlamaConfig. NeoX is pure-causal so the ring's
+    # block-granular causality applies directly (GLM's prefix-LM mask
+    # does not compose with the ring and that family stays dense).
+    seq_axis: Any = None
+    mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -166,7 +173,31 @@ def _attention(x, layer, c: GPTNeoXConfig, positions, segment_ids=None):
     q = _partial_rope(q, positions, c.rope_theta, c.rotary_dims)
     k = _partial_rope(k, positions, c.rope_theta, c.rotary_dims)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    if segment_ids is not None:
+    if c.seq_axis is not None:
+        # long context: ring attention over the "seq" mesh axis (the
+        # llama branch semantics exactly; segment ids, when present,
+        # ride the ring with the KV shards)
+        from dlrover_tpu.ops.ring_attention import (
+            impl_from_flags,
+            ring_attention,
+            ring_attention_local,
+        )
+
+        impl = impl_from_flags(c.use_flash, c.flash_interpret)
+        if c.mesh is not None:
+            out = ring_attention(
+                q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
+                batch_axes=("data", "fsdp"), head_axis="tensor",
+                block_q=c.flash_block_q, block_k=c.flash_block_k,
+                segment_ids=segment_ids, impl=impl,
+            )
+        else:
+            out = ring_attention_local(
+                q, k, v, axis_name=c.seq_axis, causal=True,
+                block_q=c.flash_block_q, block_k=c.flash_block_k,
+                segment_ids=segment_ids, impl=impl,
+            )
+    elif segment_ids is not None:
         from dlrover_tpu.ops.flash_attention import segmented_attention
 
         out = segmented_attention(

@@ -206,22 +206,11 @@ def _rope(x, positions, theta):
 
 
 def _ring_impl(c: LlamaConfig):
-    """Map the config's flash knobs onto the ring attention impl
-    selector (mirrors what the dense branches honor): use_flash=False
-    -> blockwise XLA; flash_interpret=True -> interpreted Pallas;
-    flash_interpret=False -> FORCE Mosaic (the AOT contract: tracing on
-    a CPU host while compiling for a TPU topology, where the backend
-    sniff would silently pick the XLA attend — whose autodiff backward
-    stacks probability tiles across the ring scan, O(S^2)/step, the
-    very memory wall the flash kernel's custom VJP exists to avoid);
-    None = auto (Mosaic on TPU, XLA elsewhere)."""
-    if not c.use_flash:
-        return "xla"
-    if c.flash_interpret:
-        return "pallas_interpret"
-    if c.flash_interpret is False:
-        return "pallas"
-    return None
+    """See ``ops.ring_attention.impl_from_flags`` — the shared mapping
+    from (use_flash, flash_interpret) to the ring impl selector."""
+    from dlrover_tpu.ops.ring_attention import impl_from_flags
+
+    return impl_from_flags(c.use_flash, c.flash_interpret)
 
 
 def _attention_block(x, layer, config: LlamaConfig, positions,

@@ -44,6 +44,24 @@ from dlrover_tpu.ops.flash_attention import flash_attention_lse
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def impl_from_flags(use_flash: bool, flash_interpret) -> Optional[str]:
+    """Map a model config's flash knobs onto the ring impl selector —
+    THE one mapping every family shares: use_flash=False -> blockwise
+    XLA; flash_interpret=True -> interpreted Pallas; flash_interpret=
+    False -> FORCE Mosaic (the AOT contract: tracing on a CPU host for
+    a TPU topology, where a backend sniff would silently pick the XLA
+    attend whose autodiff backward stacks O(S^2) probability tiles
+    across the ring scan); None -> auto (Mosaic on TPU, the blockwise
+    XLA attend elsewhere)."""
+    if not use_flash:
+        return "xla"
+    if flash_interpret:
+        return "pallas_interpret"
+    if flash_interpret is False:
+        return "pallas"
+    return None
+
+
 def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
                     block_k: int = 512, seg_q=None, seg_k=None):
     """Blockwise-XLA attention returning ``(out_f32, lse_f32)``.

@@ -89,7 +89,9 @@ def _get_topology_desc_serialized(topologies, topology: str,
     """
     import time
 
-    deadline = time.time() + wait_budget_s
+    # monotonic: an NTP step or VM resume must not stretch or chop
+    # the wait budget
+    deadline = time.monotonic() + wait_budget_s
     while True:
         try:
             return topologies.get_topology_desc(
@@ -98,7 +100,7 @@ def _get_topology_desc_serialized(topologies, topology: str,
         except Exception as e:  # noqa: BLE001 — only the lockfile retries
             if "libtpu" not in str(e) or "lockfile" not in str(e):
                 raise
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 raise
             try:
                 import fcntl
@@ -112,11 +114,11 @@ def _get_topology_desc_serialized(topologies, topology: str,
                         logger.info(
                             "libtpu lockfile held by a live process; "
                             "polling (%.0fs of budget left)",
-                            deadline - time.time(),
+                            deadline - time.monotonic(),
                         )
                         time.sleep(
                             max(0.0, min(poll_s,
-                                         deadline - time.time()))
+                                         deadline - time.monotonic()))
                         )
                         continue
                     try:
