@@ -21,3 +21,26 @@ Package layout:
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if (
+    "cpu" in _os.environ.get("JAX_PLATFORMS", "").lower()
+    # empty DLROVER_COMPILE_CACHE_DIR = caching explicitly disabled:
+    # no cache, no reason to constrain codegen
+    and _os.environ.get("DLROVER_COMPILE_CACHE_DIR", None) != ""
+):
+    # CPU-pinned process: cap the XLA:CPU ISA BEFORE any jax client can
+    # initialize, so persistent-cache entries reload silently and
+    # portably (see utils/compile_cache.cap_cpu_isa_for_cache). Package
+    # import is the earliest point the library controls — call sites
+    # like accelerate() run after user code may already have built a
+    # mesh (initializing the client), where the env change is a no-op.
+    from dlrover_tpu.utils.compile_cache import (  # noqa: E402
+        cap_cpu_isa_for_cache as _cap_cpu_isa,
+    )
+
+    _cap_cpu_isa()
+    del _cap_cpu_isa
+
+del _os

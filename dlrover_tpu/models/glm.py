@@ -63,6 +63,15 @@ class GLMConfig:
     flash_block_q: int = 512
     flash_block_k: int = 1024
     flash_interpret: Any = None
+    # sequence parallelism (long context): seq_axis="seq" + the Mesh
+    # runs ring attention inside the jitted GSPMD program — the same
+    # contract as Llama/NeoX, INCLUDING prefix-LM batches: the prefix
+    # mask decomposes over the ring (past shards fully visible,
+    # diagonal runs the shifted prefix kernel, future shards contribute
+    # only their prompt columns). Packed (segment_ids) batches ride the
+    # causal packed ring.
+    seq_axis: Any = None
+    mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -178,6 +187,32 @@ def _attention(x, layer, c: GLMConfig, bias, prefix_len=None,
     v = (x @ layer["v_proj"]["kernel"] + layer["v_proj"]["bias"]
          ).reshape(b, s, h, hd)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if c.seq_axis is not None:
+        # long context: ring attention over the "seq" mesh axis — all
+        # three GLM modes (causal, packed, prefix-LM) decompose over
+        # the ring; the bias is never materialized here
+        from dlrover_tpu.ops.ring_attention import (
+            impl_from_flags,
+            ring_attention,
+            ring_attention_local,
+        )
+
+        impl = impl_from_flags(c.use_flash, c.flash_interpret)
+        common = dict(
+            axis_name=c.seq_axis, causal=True,
+            block_q=c.flash_block_q, block_k=c.flash_block_k,
+            segment_ids=segment_ids, prefix_len=prefix_len, impl=impl,
+        )
+        if c.mesh is not None:
+            out = ring_attention(
+                q, k, v, c.mesh, batch_axes=("data", "fsdp"),
+                head_axis="tensor", **common,
+            )
+        else:
+            out = ring_attention_local(q, k, v, **common)
+        out = checkpoint_name(out, "attn_out")
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+        return out @ layer["o_proj"]["kernel"] + layer["o_proj"]["bias"]
     # segment dispatch comes FIRST (the sibling families' discipline):
     # the plain-flash branch below also matches when segment_ids is set
     # (bias is None then), and taking it would silently drop the
@@ -250,9 +285,11 @@ def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
     x = params["embed_tokens"]["embedding"][input_ids]
     if prefix_len is not None:
         pos_ids, block_ids = glm_positions(s, prefix_len)
-        # the flash path fuses the prefix mask into the kernel tiles; the
-        # bias is only materialized for the reference (use_flash=False)
-        bias = (None if c.use_flash
+        # the flash path fuses the prefix mask into the kernel tiles,
+        # and the ring path decomposes it per shard; the S x S bias is
+        # only materialized for the dense reference (use_flash=False,
+        # no seq_axis)
+        bias = (None if (c.use_flash or c.seq_axis is not None)
                 else prefix_lm_bias(s, prefix_len, c.compute_dtype))
     elif segment_ids is not None:
         from dlrover_tpu.models.common import segment_positions

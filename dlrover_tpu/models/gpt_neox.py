@@ -62,10 +62,9 @@ class GPTNeoXConfig:
     flash_block_k: int = 1024
     flash_interpret: Any = None
     # sequence parallelism (long context): seq_axis="seq" + the Mesh
-    # runs ring attention inside the jitted GSPMD program — same
-    # contract as LlamaConfig. NeoX is pure-causal so the ring's
-    # block-granular causality applies directly (GLM's prefix-LM mask
-    # does not compose with the ring and that family stays dense).
+    # runs ring attention inside the jitted GSPMD program — the same
+    # contract as LlamaConfig and GLMConfig (whose prefix-LM mask gets
+    # its own ring decomposition, ops/ring_attention._ring_prefix).
     seq_axis: Any = None
     mesh: Any = None
 

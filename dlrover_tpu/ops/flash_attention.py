@@ -1029,6 +1029,57 @@ def _flash_prefix_bwd(scale, block_q, block_k, interpret, block_q_bwd,
 flash_attention_prefix.defvjp(_flash_prefix_fwd, _flash_prefix_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def flash_attention_prefix_lse(
+    q: jax.Array,  # [B, H, S, D]
+    k: jax.Array,
+    v: jax.Array,
+    prefix_len: jax.Array,  # [B] int — bidirectional over [0, prefix)
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+    block_q_bwd: int = 0,
+    block_k_bwd: int = 0,
+):
+    """``flash_attention_prefix`` returning ``(out, lse)``,
+    differentiable in both — the prefix-LM counterpart of
+    ``flash_attention_lse``, needed wherever per-shard outputs merge by
+    logsumexp (the sequence-parallel prefix ring)."""
+    del block_q_bwd, block_k_bwd  # backward-only (vjp reads them)
+    return _flash_prefix_fwd_impl(
+        q, k, v, prefix_len, scale, block_q, block_k, interpret
+    )
+
+
+def _flash_prefix_lse_fwd(q, k, v, prefix_len, scale, block_q, block_k,
+                          interpret, block_q_bwd=0, block_k_bwd=0):
+    out, lse = _flash_prefix_fwd_impl(
+        q, k, v, prefix_len, scale, block_q, block_k, interpret
+    )
+    return (out, lse), (q, k, v, prefix_len, out, lse)
+
+
+def _flash_prefix_lse_bwd(scale, block_q, block_k, interpret,
+                          block_q_bwd, block_k_bwd, residuals,
+                          cotangents):
+    import numpy as np
+
+    q, k, v, prefix_len, out, lse = residuals
+    do, dlse = cotangents
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, do, dlse, causal=True, scale=scale,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret, prefix_len=prefix_len,
+    )
+    dprefix = np.zeros(prefix_len.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dprefix
+
+
+flash_attention_prefix_lse.defvjp(_flash_prefix_lse_fwd,
+                                  _flash_prefix_lse_bwd)
+
+
 def segmented_attention(q, k, v, segment_ids, use_flash: bool,
                         block_q: int = 512, block_k: int = 1024,
                         interpret: Optional[bool] = None,

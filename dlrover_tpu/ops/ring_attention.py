@@ -63,14 +63,18 @@ def impl_from_flags(use_flash: bool, flash_interpret) -> Optional[str]:
 
 
 def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
-                    block_k: int = 512, seg_q=None, seg_k=None):
+                    block_k: int = 512, seg_q=None, seg_k=None,
+                    prefix=None):
     """Blockwise-XLA attention returning ``(out_f32, lse_f32)``.
 
     The non-TPU counterpart of the Pallas kernel: a ``lax.scan`` over
     K/V chunks carrying (acc, m, l), so peak memory is O(S_q * block_k)
     per head — linear in the sequence, like the kernel, which keeps the
     CPU-mesh long-context tests honest. GQA-aware (k/v may carry fewer
-    heads).
+    heads). ``prefix`` [B]: keys with column < prefix are visible to
+    EVERY query (OR-ed with the causal mask when ``causal`` — the
+    prefix-LM rule; with causal=False it is the pure column-bound mask
+    the prefix ring uses on wholly-future shards).
     """
     if seg_q is not None and seg_k is None:
         # self-attention shape: one id array serves both sides — never
@@ -105,9 +109,16 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
             preferred_element_type=jnp.float32,
         ) * scale
         cols = lax.broadcasted_iota(jnp.int32, s.shape, 4) + j * bk
-        if causal:
+        if causal or prefix is not None:
             rows = lax.broadcasted_iota(jnp.int32, s.shape, 3)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            allowed = (rows >= cols) if causal else jnp.zeros(
+                s.shape, bool)
+            if prefix is not None:
+                allowed = jnp.logical_or(
+                    allowed,
+                    cols < prefix[:, None, None, None, None],
+                )
+            s = jnp.where(allowed, s, NEG_INF)
         if pad:
             s = jnp.where(cols < s_k, s, NEG_INF)
         if seg_q is not None:
@@ -149,15 +160,48 @@ def _xla_attend_lse(q, k, v, *, causal: bool, scale: float,
 
 
 def _attend_lse(q, k, v, *, causal, scale, impl, block_q, block_k,
-                seg_q=None, seg_k=None, block_q_bwd=0, block_k_bwd=0):
-    """One (local-q x visiting-kv) shard attention -> (out f32, lse f32)."""
+                seg_q=None, seg_k=None, block_q_bwd=0, block_k_bwd=0,
+                prefix=None):
+    """One (local-q x visiting-kv) shard attention -> (out f32, lse f32).
+
+    ``prefix`` [B] (shard-local): with ``causal`` it is the prefix-LM
+    rule (visible iff j <= i OR j < prefix — the ring's DIAGONAL
+    shard); with causal=False it is the pure column bound (visible iff
+    j < prefix — a wholly-FUTURE shard whose prompt columns are
+    bidirectionally visible)."""
     if impl == "xla":
         return _xla_attend_lse(q, k, v, causal=causal, scale=scale,
-                               block_k=block_k, seg_q=seg_q, seg_k=seg_k)
+                               block_k=block_k, seg_q=seg_q,
+                               seg_k=seg_k, prefix=prefix)
     # "pallas" must pin interpret=False: under AOT the host backend is
     # CPU and the _resolve sniff would lower the interpreter emulation
     # into a TPU executable
     interp = True if impl == "pallas_interpret" else False
+    if prefix is not None:
+        if causal:
+            from dlrover_tpu.ops.flash_attention import (
+                flash_attention_prefix_lse,
+            )
+
+            out, lse = flash_attention_prefix_lse(
+                q, k, v, prefix, scale, block_q, block_k, interp,
+                block_q_bwd, block_k_bwd,
+            )
+            return out.astype(jnp.float32), lse
+        # column-bound-only mask, no new kernel: the pair-segmented
+        # kernel with q-side ids all 0 and k-side ids 0 iff visible
+        from dlrover_tpu.ops.flash_attention import (
+            flash_attention_segmented_pair_lse,
+        )
+
+        cols = jnp.arange(k.shape[2], dtype=jnp.int32)
+        seg_kp = (cols[None, :] >= prefix[:, None]).astype(jnp.int32)
+        seg_q0 = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
+        out, lse = flash_attention_segmented_pair_lse(
+            q, k, v, seg_q0, seg_kp, False, scale, block_q, block_k,
+            interp, block_q_bwd, block_k_bwd,
+        )
+        return out.astype(jnp.float32), lse
     if seg_q is not None:
         # ring steps attend local q against a VISITING kv shard: the two
         # sides carry independent segment arrays
@@ -190,6 +234,7 @@ def ring_attention_local(
     segment_ids: Optional[jax.Array] = None,  # local [B, S_local]
     block_q_bwd: int = 0,
     block_k_bwd: int = 0,
+    prefix_len: Optional[jax.Array] = None,  # [B] GLOBAL prefix length
 ) -> jax.Array:
     """The per-device body; call inside shard_map over ``axis_name``.
 
@@ -198,6 +243,15 @@ def ring_attention_local(
     documents may SPAN ring shards: the id arrays rotate with the KV
     shards (negligible ICI bytes next to KV) and every step masks
     cross-segment pairs.
+
+    ``prefix_len`` (GLM's prefix-LM rule — visible iff j <= i OR
+    j < prefix) decomposes over the ring exactly: a wholly-PAST
+    visiting shard is fully visible (unchanged), the DIAGONAL shard
+    runs the prefix kernel with the locally-shifted prefix, and a
+    wholly-FUTURE shard contributes only its prompt columns
+    (column-bound mask) — so unlike the causal ring, future shards are
+    attended, not skipped. Requires ``causal=True`` and no
+    ``segment_ids``.
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -210,20 +264,23 @@ def ring_attention_local(
         block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
     )
     seg = segment_ids
+    merge = _merge_lse
+
+    if prefix_len is not None:
+        if not causal or seg is not None:
+            raise ValueError(
+                "prefix_len needs causal=True and no segment_ids "
+                "(prefix-LM is a causal-family mask; packed prefix "
+                "rows use the dense segmented path)"
+            )
+        return _ring_prefix(q, k, v, attend, prefix_len, axis_name,
+                            n, my)
 
     # step 0: the local block — the only one needing an intra-block
     # causal mask, which the flash kernel applies at tile granularity
     o, lse = attend(q, k, v, causal=causal, seg_q=seg, seg_k=seg)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def merge(o, lse, o_i, lse_i):
-        lse_new = jnp.logaddexp(lse, lse_i)
-        o_new = (
-            o * jnp.exp(lse - lse_new)[..., None]
-            + o_i * jnp.exp(lse_i - lse_new)[..., None]
-        )
-        return o_new, lse_new
 
     def attend_merge(o, lse, ck, cv, cs):
         o_i, lse_i = attend(
@@ -265,6 +322,70 @@ def ring_attention_local(
     return o.astype(q.dtype)
 
 
+def _merge_lse(o, lse, o_i, lse_i):
+    """The online-softmax merge — the numerical heart of the ring,
+    shared by the causal and prefix bodies so their numerics can never
+    fork. A fully-masked contribution (lse_i == -inf / NEG_INF) merges
+    as an exact no-op."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    o_new = (
+        o * jnp.exp(lse - lse_new)[..., None]
+        + o_i * jnp.exp(lse_i - lse_new)[..., None]
+    )
+    return o_new, lse_new
+
+
+def _ring_prefix(q, k, v, attend, prefix_len, axis_name, n, my):
+    """The prefix-LM ring body (see ``ring_attention_local``)."""
+    s_local = q.shape[2]
+    p = prefix_len.astype(jnp.int32)
+
+    # diagonal: causal OR locally-shifted prefix, fused in the kernel
+    p_loc = jnp.clip(p - my * s_local, 0, s_local)
+    o, lse = attend(q, k, v, causal=True, prefix=p_loc)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        o, lse, cur_k, cur_v, owner = carry
+        cur_k = lax.ppermute(cur_k, axis_name, perm)
+        cur_v = lax.ppermute(cur_v, axis_name, perm)
+        owner = jnp.asarray((owner - 1) % n, jnp.int32)
+        # p_vis: how many of the visiting shard's columns are prompt
+        p_vis = jnp.clip(p - owner * s_local, 0, s_local)
+
+        def past(o, lse, ck, cv):
+            o_i, lse_i = attend(q, ck, cv, causal=False)
+            return _merge_lse(o, lse, o_i, lse_i)
+
+        def future(o, lse, ck, cv):
+            # only the prompt columns are visible
+            o_i, lse_i = attend(q, ck, cv, causal=False, prefix=p_vis)
+            return _merge_lse(o, lse, o_i, lse_i)
+
+        def visible(o, lse, ck, cv):
+            return lax.cond(owner < my, past, future, o, lse, ck, cv)
+
+        # a future shard wholly past the prompt (p_vis == 0 for every
+        # batch row) contributes nothing — skip the kernel entirely,
+        # like the causal ring skips future shards. The typical
+        # long-context prefix batch (short prompt, long generation)
+        # makes MOST ring steps skippable on most devices.
+        o, lse = lax.cond(
+            jnp.logical_or(owner < my, jnp.any(p_vis > 0)),
+            visible,
+            lambda o, lse, ck, cv: (o, lse),
+            o, lse, cur_k, cur_v,
+        )
+        return (o, lse, cur_k, cur_v, owner), None
+
+    (o, lse, _, _, _), _ = lax.scan(
+        step, (o, lse, k, v, jnp.asarray(my, jnp.int32)), None,
+        length=n - 1,
+    )
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,  # global [B, H, S, D], S sharded on `axis_name`
     k: jax.Array,  # global [B, H_kv, S, D]
@@ -281,13 +402,16 @@ def ring_attention(
     segment_ids: Optional[jax.Array] = None,  # global [B, S]
     block_q_bwd: int = 0,
     block_k_bwd: int = 0,
+    prefix_len: Optional[jax.Array] = None,  # [B] global prefix length
 ) -> jax.Array:
     """shard_map wrapper: global arrays in, global arrays out.
 
     Composes with the surrounding GSPMD program: batch stays sharded on the
     data axes, heads on the tensor axis, sequence on the ring axis.
     ``segment_ids`` (packed documents, which may span ring shards) shard
-    on (batch, seq) and rotate with the KV shards.
+    on (batch, seq) and rotate with the KV shards. ``prefix_len`` [B]
+    (GLM prefix-LM) shards on batch only; see ``ring_attention_local``
+    for the ring decomposition of the prefix mask.
     """
     from jax import shard_map
 
@@ -333,6 +457,23 @@ def ring_attention(
         scale=scale, impl=impl, block_q=block_q, block_k=block_k,
         block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
     )
+    if prefix_len is not None:
+        if segment_ids is not None:
+            raise ValueError(
+                "prefix_len and segment_ids are mutually exclusive in "
+                "the ring (packed prefix rows use the dense path)"
+            )
+        pl_spec = P(batch_axes)
+
+        def prefix_body(ql, kl, vl, pl_):
+            return body(ql, kl, vl, prefix_len=pl_)
+
+        fn = shard_map(
+            prefix_body, mesh=mesh,
+            in_specs=(spec, spec, spec, pl_spec), out_specs=spec,
+            **check_kw,
+        )
+        return fn(q, k, v, prefix_len.astype(jnp.int32))
     if segment_ids is not None:
         seg_spec = P(batch_axes, axis_name)
 

@@ -106,6 +106,12 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
         cache_dir = os.environ.get(ENV_CACHE_DIR, _DEFAULT_DIR)
     if not cache_dir:
         return None
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        # every cache user on a CPU-pinned process gets the ISA cap —
+        # this is the chokepoint, so ad-hoc scripts (not just
+        # conftest/bench/dryrun) produce and reload clean entries;
+        # best-effort (no-op if the CPU client already initialized)
+        cap_cpu_isa_for_cache()
     cache_dir = os.path.join(
         os.path.abspath(cache_dir), f"host-{machine_fingerprint()}"
     )
