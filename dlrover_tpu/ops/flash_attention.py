@@ -95,7 +95,7 @@ def _flash_fwd_kernel(
         jnp.logical_not(causal), j * block_k <= i * block_q + block_q - 1
     )
     if prefix:
-        p_len = prefix_ref[0, 0]
+        p_len = prefix_ref[0, 0, 0]
         block_needed = jnp.logical_or(causal_needed, j * block_k < p_len)
     else:
         block_needed = causal_needed
@@ -167,6 +167,24 @@ def _flash_fwd_kernel(
         lse_ref[0, 0, 0, :] = lse[:, 0]
 
 
+def _check_mosaic_lane_block(interpret: bool, block: int, dim: int,
+                             what: str) -> None:
+    """The lse/delta/segment-id operands ride the LANE dimension in
+    (1, 1, 1, block)-shaped VMEM blocks, and Mosaic requires a block's
+    last dim to be a multiple of 128 or cover the whole array dim.
+    Production tiles (512/1024) always satisfy this; a small block on
+    the real-TPU path must fail HERE with an actionable message, not in
+    the lowering (interpret mode never enforces tiling — the round-4
+    deviceless lowering drive is what surfaced it)."""
+    if not interpret and block != dim and block % LANES:
+        raise ValueError(
+            f"TPU Mosaic lowering needs {what}={block} to be a "
+            f"multiple of {LANES} or to cover the whole sequence "
+            f"({dim}): the per-row residuals are lane-blocked by "
+            f"{what}. Use {what}>=128 (or interpret=True off-TPU)."
+        )
+
+
 def _group_size(q, k) -> int:
     """Query heads per KV head (1 = MHA). Static, from the shapes."""
     heads, kv_heads = q.shape[1], k.shape[1]
@@ -196,6 +214,9 @@ def _flash_forward(
         )
     block_q = _fit_block(block_q, s_q)
     block_k = _fit_block(block_k, s_k)
+    _check_mosaic_lane_block(interpret, block_q, s_q, "block_q")
+    if segment_ids is not None:
+        _check_mosaic_lane_block(interpret, block_k, s_k, "block_k")
     grid = (batch, heads, s_q // block_q, s_k // block_k)
     segmented = segment_ids is not None
     prefixed = prefix_len is not None
@@ -230,12 +251,17 @@ def _flash_forward(
                                      lambda b, h, i, j: (b, 0, 0, j)))
         operands += [seg4q, seg4k]
     if prefixed:
-        # [B, LANES] broadcast so the block obeys TPU lane tiling; the
-        # kernel reads lane 0
+        # [B, 1, LANES] so the BLOCK's last two dims (1, LANES)
+        # equal the array's — Mosaic requires the trailing two block
+        # dims be (8,128)-divisible OR exactly the array dims, and a
+        # (1, LANES) block over a [B, LANES] array violates that for
+        # B > 1 (caught by deviceless lowering; interpret mode never
+        # enforces tiling). The kernel reads lane 0.
         p2 = jnp.broadcast_to(
-            prefix_len.astype(jnp.int32)[:, None], (batch, LANES))
-        in_specs.append(pl.BlockSpec((1, LANES),
-                                     lambda b, h, i, j: (b, 0)))
+            prefix_len.astype(jnp.int32)[:, None, None],
+            (batch, 1, LANES))
+        in_specs.append(pl.BlockSpec((1, 1, LANES),
+                                     lambda b, h, i, j: (b, 0, 0)))
         operands.append(p2)
     return pl.pallas_call(
         kernel,
@@ -576,7 +602,7 @@ def _flash_bwd_dkv_kernel(
     )
     if prefix:
         block_needed = jnp.logical_or(
-            block_needed, j * block_k < prefix_ref[0, 0]
+            block_needed, j * block_k < prefix_ref[0, 0, 0]
         )
 
     @pl.when(block_needed)
@@ -592,7 +618,7 @@ def _flash_bwd_dkv_kernel(
             i=i, j=j, block_q=block_q, block_k=block_k,
             seg_q=seg_q_ref[0, 0, 0, :] if segmented else None,
             seg_k=seg_k_ref[0, 0, 0, :] if segmented else None,
-            prefix_len=prefix_ref[0, 0] if prefix else None,
+            prefix_len=prefix_ref[0, 0, 0] if prefix else None,
         )
         p_lo = p.astype(do.dtype)
         # dv += p^T do  : contract over the q rows
@@ -644,7 +670,7 @@ def _flash_bwd_dq_kernel(
     )
     if prefix:
         block_needed = jnp.logical_or(
-            block_needed, j * block_k < prefix_ref[0, 0]
+            block_needed, j * block_k < prefix_ref[0, 0, 0]
         )
 
     @pl.when(block_needed)
@@ -660,7 +686,7 @@ def _flash_bwd_dq_kernel(
             i=i, j=j, block_q=block_q, block_k=block_k,
             seg_q=seg_q_ref[0, 0, 0, :] if segmented else None,
             seg_k=seg_k_ref[0, 0, 0, :] if segmented else None,
-            prefix_len=prefix_ref[0, 0] if prefix else None,
+            prefix_len=prefix_ref[0, 0, 0] if prefix else None,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -695,6 +721,9 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
     group = _group_size(q, k)
     bq = _fit_block(block_q, s_q)
     bk = _fit_block(block_k, s_k)
+    _check_mosaic_lane_block(interp, bq, s_q, "block_q")
+    if segment_ids is not None:
+        _check_mosaic_lane_block(interp, bk, s_k, "block_k")
     segmented = segment_ids is not None
     prefixed = prefix_len is not None
 
@@ -712,8 +741,9 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
         seg_kv = (segment_ids_kv if segment_ids_kv is not None
                   else segment_ids)
         seg4k = seg_kv.astype(jnp.int32).reshape(batch, 1, 1, s_k)
-    p2 = (jnp.broadcast_to(prefix_len.astype(jnp.int32)[:, None],
-                           (batch, LANES))
+    # [B, 1, LANES]: see the forward's prefix operand comment
+    p2 = (jnp.broadcast_to(prefix_len.astype(jnp.int32)[:, None, None],
+                           (batch, 1, LANES))
           if prefixed else None)
 
     # dKV grid (b, kv_head, j, g, i): g sweeps the query heads sharing
@@ -739,7 +769,7 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
         dkv_operands += [seg4q, seg4k]
     if prefixed:
         dkv_specs.append(pl.BlockSpec(
-            (1, LANES), lambda b, hk, j, g, i: (b, 0)))
+            (1, 1, LANES), lambda b, hk, j, g, i: (b, 0, 0)))
         dkv_operands.append(p2)
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -782,7 +812,7 @@ def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
         dq_operands += [seg4q, seg4k]
     if prefixed:
         dq_specs.append(pl.BlockSpec(
-            (1, LANES), lambda b, h, i, j: (b, 0)))
+            (1, 1, LANES), lambda b, h, i, j: (b, 0, 0)))
         dq_operands.append(p2)
     dq = pl.pallas_call(
         functools.partial(
@@ -966,7 +996,6 @@ flash_attention_segmented_pair_lse.defvjp(_flash_seg_pair_fwd,
 # -- prefix-LM flash attention ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def flash_attention_prefix(
     q: jax.Array,  # [B, H, S, D]
     k: jax.Array,
@@ -984,12 +1013,15 @@ def flash_attention_prefix(
     bidirectionally visible). Fused into the Pallas tiles — the GLM
     family's alternative to materializing an S x S bias. Reference
     counterpart: ``fa2_with_glm_mask``
-    (``atorch/modules/transformer/layers.py:1191``)."""
-    del block_q_bwd, block_k_bwd  # backward-only (vjp reads them)
-    out, _lse = _flash_prefix_fwd_impl(
-        q, k, v, prefix_len, scale, block_q, block_k, interpret
-    )
-    return out
+    (``atorch/modules/transformer/layers.py:1191``).
+
+    Thin wrapper over ``flash_attention_prefix_lse`` (single-vjp
+    discipline: a dropped lse output has a zero cotangent, giving the
+    identical backward — see the segmented variants' note)."""
+    return flash_attention_prefix_lse(
+        q, k, v, prefix_len, scale, block_q, block_k, interpret,
+        block_q_bwd, block_k_bwd,
+    )[0]
 
 
 def _flash_prefix_fwd_impl(q, k, v, prefix_len, scale, block_q, block_k,
@@ -1001,32 +1033,6 @@ def _flash_prefix_fwd_impl(q, k, v, prefix_len, scale, block_q, block_k,
         prefix_len=prefix_len,
     )
     return out, lse.reshape(q.shape[0], q.shape[1], q.shape[2])
-
-
-def _flash_prefix_fwd(q, k, v, prefix_len, scale, block_q, block_k,
-                      interpret, block_q_bwd=0, block_k_bwd=0):
-    out, lse = _flash_prefix_fwd_impl(
-        q, k, v, prefix_len, scale, block_q, block_k, interpret
-    )
-    return out, (q, k, v, prefix_len, out, lse)
-
-
-def _flash_prefix_bwd(scale, block_q, block_k, interpret, block_q_bwd,
-                      block_k_bwd, residuals, do):
-    import numpy as np
-
-    q, k, v, prefix_len, out, lse = residuals
-    dlse = jnp.zeros_like(lse)
-    dq, dk, dv = _flash_backward(
-        q, k, v, out, lse, do, dlse, causal=True, scale=scale,
-        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
-        interpret=interpret, prefix_len=prefix_len,
-    )
-    dprefix = np.zeros(prefix_len.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dprefix
-
-
-flash_attention_prefix.defvjp(_flash_prefix_fwd, _flash_prefix_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
