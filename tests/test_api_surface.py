@@ -38,10 +38,12 @@ SURFACE = {
         "flash_attention", "flash_attention_auto",
         "flash_attention_segmented", "flash_attention_segmented_auto",
         "flash_attention_prefix", "flash_attention_prefix_auto",
+        "flash_attention_prefix_lse",
         "segmented_attention", "flash_attention_lse",
     ],
     "dlrover_tpu.ops.ring_attention": ["ring_attention",
-                                       "ring_attention_local"],
+                                       "ring_attention_local",
+                                       "impl_from_flags"],
     "dlrover_tpu.ops.moe": ["moe_ffn"],
     "dlrover_tpu.optimizers.wsam": ["wsam"],
     "dlrover_tpu.ps.server": ["start_ps_shard", "PsShardServer"],
