@@ -192,6 +192,7 @@ def _attention(x, layer, c: GLMConfig, bias, prefix_len=None,
         # three GLM modes (causal, packed, prefix-LM) decompose over
         # the ring; the bias is never materialized here
         from dlrover_tpu.ops.ring_attention import (
+            ambient_ring_mesh,
             impl_from_flags,
             ring_attention,
             ring_attention_local,
@@ -203,9 +204,13 @@ def _attention(x, layer, c: GLMConfig, bias, prefix_len=None,
             block_q=c.flash_block_q, block_k=c.flash_block_k,
             segment_ids=segment_ids, prefix_len=prefix_len, impl=impl,
         )
-        if c.mesh is not None:
+        # explicit config mesh wins; else the ambient mesh (rebuilt by
+        # every accelerate) keeps ring configs elastic-safe
+        ring_mesh = (c.mesh if c.mesh is not None
+                     else ambient_ring_mesh(c.seq_axis))
+        if ring_mesh is not None:
             out = ring_attention(
-                q, k, v, c.mesh, batch_axes=("data", "fsdp"),
+                q, k, v, ring_mesh, batch_axes=("data", "fsdp"),
                 head_axis="tensor", **common,
             )
         else:

@@ -175,17 +175,22 @@ def _attention(x, layer, c: GPTNeoXConfig, positions, segment_ids=None):
     if c.seq_axis is not None:
         # long context: ring attention over the "seq" mesh axis (the
         # llama branch semantics exactly; segment ids, when present,
-        # ride the ring with the KV shards)
+        # ride the ring with the KV shards). An explicit config mesh
+        # wins; else the AMBIENT mesh (rebuilt by every accelerate)
+        # keeps ring configs elastic-safe.
         from dlrover_tpu.ops.ring_attention import (
+            ambient_ring_mesh,
             impl_from_flags,
             ring_attention,
             ring_attention_local,
         )
 
         impl = impl_from_flags(c.use_flash, c.flash_interpret)
-        if c.mesh is not None:
+        ring_mesh = (c.mesh if c.mesh is not None
+                     else ambient_ring_mesh(c.seq_axis))
+        if ring_mesh is not None:
             out = ring_attention(
-                q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
+                q, k, v, ring_mesh, axis_name=c.seq_axis, causal=True,
                 batch_axes=("data", "fsdp"), head_axis="tensor",
                 block_q=c.flash_block_q, block_k=c.flash_block_k,
                 segment_ids=segment_ids, impl=impl,

@@ -228,13 +228,22 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
     # rotates) only the kv heads — h/kv less traffic than the repeat
     # the reference pays before its CUDA kernel (layers.py:1268).
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
+    ring_mesh = None
+    if c.seq_axis:
+        # an explicit config mesh wins; otherwise the AMBIENT mesh
+        # (rebuilt by every accelerate) keeps ring configs elastic-safe
+        from dlrover_tpu.ops.ring_attention import ambient_ring_mesh
+
+        ring_mesh = (c.mesh if c.mesh is not None
+                     else ambient_ring_mesh(c.seq_axis))
     if segment_ids is not None:
         # packed sequences: per-document masking fused into the kernel;
         # under sequence parallelism the segment ids ride the ring with
         # the KV shards (documents may span ring shards)
-        if c.seq_axis and c.mesh is not None:
+        if c.seq_axis and ring_mesh is not None:
             out = ring_attention(
-                q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
+                q, k, v, ring_mesh, axis_name=c.seq_axis,
+                causal=True,
                 batch_axes=("data", "fsdp"), head_axis="tensor",
                 block_q=c.flash_block_q, block_k=c.flash_block_k,
                 segment_ids=segment_ids, impl=_ring_impl(c),
@@ -261,9 +270,9 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
                 block_q_bwd=c.flash_block_q_bwd,
                 block_k_bwd=c.flash_block_k_bwd,
             )
-    elif c.seq_axis and c.mesh is not None:
+    elif c.seq_axis and ring_mesh is not None:
         out = ring_attention(
-            q, k, v, c.mesh, axis_name=c.seq_axis, causal=True,
+            q, k, v, ring_mesh, axis_name=c.seq_axis, causal=True,
             batch_axes=("data", "fsdp"), head_axis="tensor",
             block_q=c.flash_block_q, block_k=c.flash_block_k,
             impl=_ring_impl(c),

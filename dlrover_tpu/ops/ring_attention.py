@@ -44,6 +44,38 @@ from dlrover_tpu.ops.flash_attention import flash_attention_lse
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def ambient_ring_mesh(axis_name: str = "seq"):
+    """The ambient mesh (``jax.sharding.set_mesh`` — what ``accelerate``
+    establishes while tracing) when it carries a non-trivial
+    ``axis_name`` axis that is NOT already manual; else None.
+
+    This is what lets a model config say just ``seq_axis="seq"`` with
+    ``mesh=None`` and stay ELASTIC-SAFE: a mesh frozen into the config
+    at startup would survive ``on_world_change``'s re-accelerate and
+    make the ring shard_map reference departed devices, while the
+    ambient mesh is rebuilt with each accelerate. A manual (already
+    inside shard_map) seq axis returns None so the caller falls back to
+    ``ring_attention_local`` — the body form — instead of illegally
+    nesting shard_maps."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:  # noqa: BLE001 — no mesh context
+        return None
+    names = tuple(getattr(mesh, "axis_names", ()) or ())
+    if axis_name not in names:
+        return None
+    sizes = dict(zip(names, mesh.axis_sizes))
+    if sizes[axis_name] <= 1:
+        return None
+    try:
+        types = dict(zip(names, mesh.axis_types))
+        if "manual" in str(types[axis_name]).lower():
+            return None
+    except Exception:  # noqa: BLE001 — axis_types absent on old jax
+        pass
+    return mesh
+
+
 def impl_from_flags(use_flash: bool, flash_interpret) -> Optional[str]:
     """Map a model config's flash knobs onto the ring impl selector —
     THE one mapping every family shares: use_flash=False -> blockwise
@@ -419,7 +451,10 @@ def ring_attention(
         # GQA kv heads must still divide the head mesh axis; when they
         # don't (e.g. 8 kv heads over tensor=16), repeat minimally so
         # the spec is legal — still cheaper than the full h/kv repeat.
-        tensor_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        # axis_sizes, not devices.shape: the mesh may be the ABSTRACT
+        # ambient mesh (jax.sharding.get_abstract_mesh), which carries
+        # sizes but no concrete device array
+        tensor_size = dict(zip(mesh.axis_names, mesh.axis_sizes)).get(
             head_axis, 1
         )
         kv_heads, heads = k.shape[1], q.shape[1]

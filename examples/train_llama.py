@@ -50,6 +50,11 @@ def main():
     p.add_argument("--seq", type=int, default=0, help="0 = preset default")
     p.add_argument("--ckpt_dir", default="")
     p.add_argument("--moe_experts", type=int, default=0)
+    p.add_argument("--ring", type=int, default=0,
+                   help="sequence-parallel ring size (long context): "
+                        "adds a 'seq' mesh axis and runs ring "
+                        "attention, e.g. --ring 2 --seq 512 on the "
+                        "8-device CPU mesh")
     args = p.parse_args()
 
     if args.preset == "tiny":
@@ -68,8 +73,21 @@ def main():
         seq = args.seq or 4096
 
     n = jax.device_count()
+    ring = max(1, args.ring)
+    # fsdp only when devices remain after the ring axis takes its share
+    fsdp = 2 if n >= 4 * ring else 1
+    plan = MeshPlan(data=-1, fsdp=fsdp, seq=ring)
+    if ring > 1:
+        # long context: the model runs ring attention over the "seq"
+        # axis. Only the AXIS NAME goes on the config — the mesh itself
+        # is picked up ambiently from whatever accelerate builds, so an
+        # elastic world change (which re-runs accelerate over the new
+        # devices) keeps working.
+        from dataclasses import replace
+
+        config = replace(config, seq_axis="seq")
     strategy = Strategy(
-        mesh=MeshPlan(data=-1, fsdp=1 if n < 4 else 2),
+        mesh=plan,
         rule_set="moe" if args.moe_experts else "llama",
         remat_policy="",  # the model remats per layer internally
     )
