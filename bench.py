@@ -630,6 +630,32 @@ def _recovery_worker(ckpt_dir: str, status_file: str, total_steps: int,
         abstract_like,
     )
 
+    # Diagnose the warm path: log WHY a compile missed the persistent
+    # cache, and issue a tiny device op concurrently with build+restore.
+    # If the accelerator is still being reclaimed from the killed
+    # predecessor (tunnel/server-side), the warmup op absorbs that wait
+    # where it overlaps useful host work instead of serializing in
+    # front of the first training step — and its timing tells us whether
+    # the first-step gap is device availability or compilation.
+    jax.config.update("jax_explain_cache_misses", True)
+    warmup = {}
+
+    def _device_warmup():
+        t0 = time.time()
+        try:
+            import jax.numpy as jnp
+
+            x = jax.jit(
+                lambda a: (a @ a).sum()
+            )(jnp.ones((256, 256), jnp.bfloat16))
+            jax.block_until_ready(x)
+        except Exception as e:  # noqa: BLE001 — diagnostic only
+            warmup["error"] = str(e)[:200]
+        warmup["t_warmup_s"] = round(time.time() - t0, 2)
+
+    warmup_thread = threading.Thread(target=_device_warmup, daemon=True)
+    warmup_thread.start()
+
     t_boot = time.time()
     phases = {"t_devices_s": round(time.time() - _T_PROC_START, 2)}
     result, batch, config, _, _, _ = _build_train(devices, preset)
@@ -654,6 +680,19 @@ def _recovery_worker(ckpt_dir: str, status_file: str, total_steps: int,
     phases["t_restore_s"] = round(
         time.time() - t_boot - phases["t_build_s"], 2
     )
+    t_join = time.time()
+    # bounded: the warmup is diagnostic — if it is STILL blocked after
+    # build+restore+30s, the device wait would hit the first step
+    # anyway; proceeding keeps the instrumentation from inflating the
+    # MTTR it measures beyond that bound
+    warmup_thread.join(timeout=30)
+    if warmup_thread.is_alive():
+        warmup["warmup_pending"] = True
+    phases["t_warmup_wait_s"] = round(time.time() - t_join, 2)
+    phases.update(warmup)
+    from dlrover_tpu.utils.compile_cache import cache_entries
+
+    phases["cache_entries_at_boot"] = cache_entries()
 
     def emit(record):
         with open(status_file, "a") as f:
@@ -662,11 +701,13 @@ def _recovery_worker(ckpt_dir: str, status_file: str, total_steps: int,
             os.fsync(f.fileno())
 
     for step in range(start, total_steps):
+        t_step = time.time()
         state, metrics = result.train_step(
             state, sharded, jax.random.PRNGKey(step)
         )
         loss = float(jax.device_get(metrics["loss"]))
         jax.block_until_ready(state)
+        phases["t_step_s"] = round(time.time() - t_step, 2)
         committed = -1
         if step > 0 and step % save_every == 0:
             if mgr.save(step, state, metadata={"step": step}, force=True):
@@ -830,7 +871,9 @@ def recovery_result() -> dict:
             "warm_boot_to_first_step_s": rec2["boot_to_step_s"],
             "warm_phases": {
                 k: rec2[k] for k in
-                ("t_devices_s", "t_build_s", "t_restore_s") if k in rec2
+                ("t_devices_s", "t_build_s", "t_restore_s",
+                 "t_warmup_s", "t_warmup_wait_s", "t_step_s",
+                 "cache_entries_at_boot", "error") if k in rec2
             },
             "loss_after_restore": rec2["loss"],
             "preset": os.environ.get("BENCH_RECOVERY_PRESET", "recovery"),

@@ -87,6 +87,7 @@ class LlamaConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    moe_dispatch: str = "gather"  # "gather" (fast) | "einsum" (reference)
 
     @property
     def head_dim(self) -> int:
@@ -304,6 +305,8 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
 
 
 def _ffn_block(x, layer, config: LlamaConfig, rng):
+    """Returns (out, aux_loss, dropped_frac, expert_load) — the last two
+    are the MoE load-balance observability signals (zeros for dense)."""
     if config.num_experts > 0:
         moe_params = {
             "router": layer["router"],
@@ -316,16 +319,17 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
             num_experts=config.num_experts,
             capacity_factor=config.moe_capacity_factor,
             top_k=config.moe_top_k,
+            dispatch=config.moe_dispatch,
         )
-        out, aux = moe_ops.moe_ffn(
+        out, aux, metrics = moe_ops.moe_ffn(
             moe_params, x, cfg, activation=jax.nn.silu, rng=rng
         )
-        return out, aux
+        return out, aux, metrics["dropped_frac"], metrics["expert_load"]
     gate = jax.nn.silu(x @ layer["gate_proj"]["kernel"])
     up = x @ layer["up_proj"]["kernel"]
-    return (gate * up) @ layer["down_proj"]["kernel"], jnp.zeros(
-        (), jnp.float32
-    )
+    zero = jnp.zeros((), jnp.float32)
+    return ((gate * up) @ layer["down_proj"]["kernel"], zero, zero,
+            jnp.zeros((1,), jnp.float32))
 
 
 
@@ -348,8 +352,10 @@ def _decoder_block(c: LlamaConfig, segment_ids=None, positions=None):
         x = x + _attention_block(attn_in, layer_params, c, pos,
                                  segment_ids)
         ffn_in = _rms_norm(x, layer_params["post_norm"]["scale"], c.rms_eps)
-        ffn_out, aux = _ffn_block(ffn_in, layer_params, c, ffn_rng)
-        return (x + ffn_out, block_rng), aux
+        ffn_out, aux, dropped, load = _ffn_block(
+            ffn_in, layer_params, c, ffn_rng
+        )
+        return (x + ffn_out, block_rng), (aux, dropped, load)
 
     return block
 
@@ -358,9 +364,12 @@ def apply_hidden(
     params: Dict, input_ids: jax.Array, config: LlamaConfig,
     rng: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
-) -> Tuple[jax.Array, jax.Array]:
+    with_moe_metrics: bool = False,
+):
     """Returns (final hidden states [B, S, D] in compute dtype,
-    moe_aux_loss scalar) — everything except the lm head.
+    moe_aux_loss scalar) — everything except the lm head. With
+    ``with_moe_metrics`` a third element is returned: the layer-averaged
+    load-balance dict {"moe_dropped_frac", "moe_expert_load" [E]}.
 
     ``segment_ids`` [B, S]: packed-sequence mode — per-document
     attention masking and segment-relative RoPE positions."""
@@ -372,20 +381,32 @@ def apply_hidden(
                  if segment_ids is not None else None)
     block = apply_remat(_decoder_block(c, segment_ids, positions),
                         c.remat_policy)
-    (x, _), aux_losses = lax.scan(block, (x, rng), params["layers"])
+    (x, _), (aux_losses, dropped, load) = lax.scan(
+        block, (x, rng), params["layers"]
+    )
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
+    if with_moe_metrics:
+        metrics = {
+            "moe_dropped_frac": jnp.mean(dropped),
+            "moe_expert_load": jnp.mean(load, axis=0),
+        }
+        return x, jnp.sum(aux_losses), metrics
     return x, jnp.sum(aux_losses)
 
 
 def apply(params: Dict, input_ids: jax.Array, config: LlamaConfig,
           rng: Optional[jax.Array] = None,
           segment_ids: Optional[jax.Array] = None,
-          ) -> Tuple[jax.Array, jax.Array]:
-    """Returns (logits [B, S, V] in f32, moe_aux_loss scalar)."""
+          with_moe_metrics: bool = False,
+          ):
+    """Returns (logits [B, S, V] in f32, moe_aux_loss scalar) — plus
+    the load-balance metrics dict when ``with_moe_metrics``."""
     c = config
-    x, aux = apply_hidden(params, input_ids, config, rng, segment_ids)
+    out = apply_hidden(params, input_ids, config, rng, segment_ids,
+                       with_moe_metrics=with_moe_metrics)
+    x = out[0]
     logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
-    return logits.astype(jnp.float32), aux
+    return (logits.astype(jnp.float32),) + out[1:]
 
 
 def apply_pipelined(
@@ -424,7 +445,7 @@ def apply_pipelined(
     def stage_fn(layers_chunk, state):
         x, aux = state
         block = apply_remat(_decoder_block(c), c.remat_policy)
-        (x, _), auxs = lax.scan(block, (x, rng), layers_chunk)
+        (x, _), (auxs, _, _) = lax.scan(block, (x, rng), layers_chunk)
         return (x, aux + jnp.sum(auxs))
 
     x_mb = split_microbatches(x, num_microbatches)
@@ -469,24 +490,31 @@ def make_loss_fn(config: LlamaConfig, z_loss_weight: float = 0.0,
 
     def loss_fn(params, batch, rng):
         segment_ids = batch.get("segment_ids")
+        moe = config.num_experts > 0
+        extra = {}
         if head_chunk > 0:
-            hidden, moe_aux = apply_hidden(
+            out = apply_hidden(
                 params, batch["input_ids"], config, rng,
-                segment_ids=segment_ids,
+                segment_ids=segment_ids, with_moe_metrics=moe,
             )
+            hidden, moe_aux = out[0], out[1]
             loss = chunked_lm_head_loss(
                 hidden, params["lm_head"]["kernel"], batch["labels"],
                 chunk_size=head_chunk, z_loss_weight=z_loss_weight,
             )
         else:
-            logits, moe_aux = apply(params, batch["input_ids"], config,
-                                    rng, segment_ids=segment_ids)
-            loss = masked_lm_loss(logits, batch["labels"], z_loss_weight)
-        if config.num_experts > 0:
+            out = apply(params, batch["input_ids"], config,
+                        rng, segment_ids=segment_ids, with_moe_metrics=moe)
+            moe_aux = out[1]
+            loss = masked_lm_loss(out[0], batch["labels"], z_loss_weight)
+        if moe:
             loss = loss + config.moe_aux_weight * moe_aux / max(
                 1, config.num_layers
             )
-        return loss, {}
+            # load-balance observability: ride the step-metrics dict
+            # (switch_gating.py:24-195 parity — overflow accounting)
+            extra = dict(out[2])
+        return loss, extra
 
     return loss_fn
 

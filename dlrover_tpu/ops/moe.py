@@ -3,17 +3,31 @@
 Role parity: ``atorch/atorch/modules/moe/moe_layer.py:22-565`` (expert
 process groups + ``_AllToAll`` autograd + ``Experts``) and
 ``switch_gating.py:24-195`` (top-1 gating with capacity and load-balance
-aux loss). TPU-first: dispatch/combine are one-hot einsums over a
-[tokens, experts, capacity] tensor; with expert weights sharded on the
-expert submesh and tokens on the data axes, XLA lowers those einsums to the
-all-to-all — no hand-written autograd collective is needed.
+aux loss). TPU-first: expert weights live on the expert submesh and XLA
+inserts the all-to-alls from shardings — no hand-written autograd
+collective is needed.
+
+Two dispatch implementations share one routing core (``_routing``):
+
+- ``"gather"`` (default, the fast path): a slot->token index map built
+  from tiny int32 scatters turns dispatch into a pure gather of the
+  token matrix and combine into a gather of the expert outputs. Data
+  movement is O(T*D); the only O(T*E) work is the router's position
+  bookkeeping. This replaces the reference's fastmoe/CUDA delegation
+  (``moe_layer.py:511``) — on TPU the win comes from NOT materializing
+  capacity-shaped dense compute, not from a custom kernel.
+- ``"einsum"`` (the reference check): one-hot [T,E,C] dispatch/combine
+  einsums, numerically transparent and GSPMD-friendly, but the einsums
+  cost T*E*C*D = capacity_factor*T^2*D FLOPs — quadratic in tokens, so
+  dispatch dominates expert FLOPs at practical T. Kept as the oracle
+  the fast path is tested against (``tests/test_ops.py``).
 """
 
 from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,10 +41,75 @@ class MoEConfig:
     top_k: int = 1  # 1 = switch routing, 2 = gshard-style
     aux_loss_weight: float = 0.01
     router_jitter: float = 0.0  # multiplicative logit noise during training
+    dispatch: str = "gather"  # "gather" (fast) | "einsum" (reference)
 
 
 def _capacity(num_tokens: int, num_experts: int, factor: float) -> int:
     return max(1, int(math.ceil(num_tokens * factor / num_experts)))
+
+
+def _routing(
+    logits: jax.Array,  # [T, E]
+    capacity: int,
+    top_k: int,
+    rng: Optional[jax.Array],
+    jitter: float,
+) -> Tuple[List[Tuple[jax.Array, ...]], jax.Array, Dict[str, jax.Array]]:
+    """Shared routing core: per-round (expert, position, keep, gate).
+
+    Round-by-round filling (all k=0 choices claim queue positions
+    before any k=1 choice) with arrival-order priority inside a round —
+    the switch/gshard semantics both dispatch paths must agree on.
+    Everything here is [T] or [T, E]; the capacity axis never
+    materializes. Returns (rounds, aux_loss, metrics) where each round
+    is (expert_idx [T]i32, pos [T]i32, keep [T]f32, gate [T]f32) and
+    metrics carries the load-balance observability signals
+    (``switch_gating.py:24-195`` parity: capacity-overflow accounting).
+    """
+    t, e = logits.shape
+    if rng is not None and jitter > 0.0:
+        noise = jax.random.uniform(
+            rng, logits.shape, minval=1.0 - jitter, maxval=1.0 + jitter
+        )
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+
+    remaining = probs
+    expert_fill = jnp.zeros((e,), jnp.int32)
+    total_onehot = jnp.zeros((t, e), jnp.float32)
+    kept_per_expert = jnp.zeros((e,), jnp.float32)
+    rounds = []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
+        # position of each token within its expert's queue (arrival order)
+        pos_in_expert = (
+            jnp.cumsum(onehot, axis=0) - onehot
+        ) * onehot  # [T, E]
+        pos_in_expert = pos_in_expert + expert_fill[None, :] * onehot
+        within = (pos_in_expert < capacity).astype(jnp.float32) * onehot
+        pos = pos_in_expert.sum(axis=-1).astype(jnp.int32)  # [T]
+        keep = within.sum(axis=-1)  # [T] 1.0 = assigned a queue slot
+        gate = (probs * onehot).sum(axis=-1)  # [T]
+        rounds.append((idx, pos, keep, gate))
+        expert_fill = expert_fill + within.sum(axis=0).astype(jnp.int32)
+        kept_per_expert = kept_per_expert + within.sum(axis=0)
+        total_onehot = total_onehot + onehot
+        remaining = remaining * (1.0 - onehot)
+
+    # load-balance auxiliary loss (switch transformer eq. 4)
+    frac_tokens = total_onehot.mean(axis=0)  # [E]
+    frac_probs = probs.mean(axis=0)  # [E]
+    aux_loss = e * jnp.sum(frac_tokens * frac_probs) / max(1, top_k)
+    routed = total_onehot.sum(axis=0)  # [E] pre-drop demand per expert
+    metrics = {
+        # fraction of (token, round) assignments that overflowed capacity
+        "dropped_frac": 1.0 - kept_per_expert.sum() / float(t * top_k),
+        # pre-drop routing demand per expert, as a fraction of tokens;
+        # uniform = 1/E. This is the signal the aux loss regularizes.
+        "expert_load": routed / float(t * top_k),
+    }
+    return rounds, aux_loss, metrics
 
 
 def router_dispatch(
@@ -42,50 +121,88 @@ def router_dispatch(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Compute (dispatch_mask [T,E,C], combine_weights [T,E,C], aux_loss).
 
-    Switch-style: each token goes to its top-k experts, subject to a
-    per-expert capacity; overflowing tokens are dropped (their combine
-    weight is zero, so the residual path carries them).
+    The reference-path materialization of ``_routing``: each token goes
+    to its top-k experts, subject to a per-expert capacity; overflowing
+    tokens are dropped (their combine weight is zero, so the residual
+    path carries them).
     """
     t, e = logits.shape
-    if rng is not None and jitter > 0.0:
-        noise = jax.random.uniform(
-            rng, logits.shape, minval=1.0 - jitter, maxval=1.0 + jitter
-        )
-        logits = logits * noise
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+    rounds, aux_loss, _ = _routing(logits, capacity, top_k, rng, jitter)
+    dispatch, combine = _materialize(rounds, t, e, capacity)
+    return dispatch, combine, aux_loss
 
+
+def _materialize(rounds, t: int, e: int, capacity: int):
+    """[T,E,C] one-hot dispatch/combine from routing rounds — the single
+    source both ``router_dispatch`` and the einsum oracle build on."""
     dispatch = jnp.zeros((t, e, capacity), jnp.float32)
     combine = jnp.zeros((t, e, capacity), jnp.float32)
-    remaining = probs
-    expert_fill = jnp.zeros((e,), jnp.int32)
-    total_onehot = jnp.zeros((t, e), jnp.float32)
-
-    for _ in range(top_k):
-        idx = jnp.argmax(remaining, axis=-1)  # [T]
-        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
-        # position of each token within its expert's queue (arrival order)
-        pos_in_expert = (
-            jnp.cumsum(onehot, axis=0) - onehot
-        ) * onehot  # [T, E]
-        pos_in_expert = pos_in_expert + expert_fill[None, :] * onehot
-        within = (pos_in_expert < capacity).astype(jnp.float32) * onehot
-        pos = pos_in_expert.sum(axis=-1).astype(jnp.int32)  # [T]
+    for idx, pos, keep, gate in rounds:
+        within = jax.nn.one_hot(idx, e, dtype=jnp.float32) * keep[:, None]
         pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
-        gate = (probs * onehot).sum(axis=-1, keepdims=True)  # [T,1]
-        # `within` is already zero for dropped/over-capacity tokens
         dispatch = dispatch + within[:, :, None] * pos_oh[:, None, :]
         combine = combine + (
-            gate[:, :, None] * within[:, :, None] * pos_oh[:, None, :]
+            gate[:, None, None] * within[:, :, None] * pos_oh[:, None, :]
         )
-        expert_fill = expert_fill + within.sum(axis=0).astype(jnp.int32)
-        total_onehot = total_onehot + onehot
-        remaining = remaining * (1.0 - onehot)
+    return dispatch, combine
 
-    # load-balance auxiliary loss (switch transformer eq. 4)
-    frac_tokens = total_onehot.mean(axis=0)  # [E]
-    frac_probs = probs.mean(axis=0)  # [E]
-    aux_loss = e * jnp.sum(frac_tokens * frac_probs) / max(1, top_k)
-    return dispatch, combine, aux_loss
+
+def _moe_compute_einsum(params, xt, rounds, capacity, e, activation):
+    """[T,E,C] one-hot dispatch/combine (the reference check)."""
+    t = xt.shape[0]
+    dispatch, combine = _materialize(rounds, t, e, capacity)
+    # all-to-all #1: tokens -> expert queues (XLA inserts the collective
+    # when experts are mesh-sharded). The SPMD partitioner may log an
+    # "involuntary full rematerialization" for the [T,1,1] gate broadcast
+    # when dispatch/combine consumers want different T shardings — that
+    # tensor is tokens*4 bytes, so the replicate-and-repartition it falls
+    # back to is noise, not a bandwidth problem.
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(xt.dtype), xt
+    )  # [E, C, D]
+    h = activation(jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["experts"]["up"]["kernel"]
+    ))
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", h, params["experts"]["down"]["kernel"]
+    )  # [E, C, D]
+    # all-to-all #2: expert queues -> tokens
+    return jnp.einsum("tec,ecd->td", combine.astype(xt.dtype), expert_out)
+
+
+def _moe_compute_gather(params, xt, rounds, capacity, e, activation):
+    """Slot-indexed dispatch/combine (the fast path).
+
+    A [E*C+1] int32 slot->token map is built with scatters whose
+    operand is tokens*4 bytes (dropped tokens write the sentinel slot);
+    the [E,C,D] expert input is then a single gather of the token
+    matrix, and combine is a gather of the expert outputs weighted by
+    the gates. Identical routing semantics to the einsum path by
+    construction — both consume the same ``_routing`` rounds.
+    """
+    t, d = xt.shape
+    n_slots = e * capacity
+    token_ids = jnp.arange(t, dtype=jnp.int32)
+    # sentinel slot n_slots absorbs dropped tokens; sentinel token t
+    # backs empty slots with a zero row
+    slot_token = jnp.full((n_slots + 1,), t, jnp.int32)
+    for idx, pos, keep, _gate in rounds:
+        flat = jnp.where(keep > 0, idx * capacity + pos, n_slots)
+        slot_token = slot_token.at[flat].set(token_ids)
+    x_pad = jnp.concatenate([xt, jnp.zeros((1, d), xt.dtype)], axis=0)
+    expert_in = x_pad[slot_token[:n_slots]].reshape(e, capacity, d)
+    h = activation(jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["experts"]["up"]["kernel"]
+    ))
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", h, params["experts"]["down"]["kernel"]
+    ).reshape(n_slots, d)
+    out = jnp.zeros((t, d), xt.dtype)
+    for idx, pos, keep, gate in rounds:
+        flat = jnp.clip(idx * capacity + pos, 0, n_slots - 1)
+        weight = (gate * keep).astype(xt.dtype)[:, None]
+        out = out + expert_out[flat] * weight
+    return out
 
 
 def moe_ffn(
@@ -95,12 +212,15 @@ def moe_ffn(
     activation: Callable = jax.nn.gelu,
     train: bool = True,
     rng: Optional[jax.Array] = None,
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Switch-FFN block. params:
       router/kernel: [D, E]
       experts/up/kernel:   [E, D, F]
       experts/down/kernel: [E, F, D]
-    Returns (output [B,S,D], aux_loss scalar).
+    Returns (output [B,S,D], aux_loss scalar, metrics dict) where
+    metrics = {"dropped_frac" scalar, "expert_load" [E]} — the
+    load-balance observability signals, computed by the router at
+    negligible cost and surfaced as step metrics by the trainer.
     """
     b, s, d = x.shape
     t = b * s
@@ -108,30 +228,15 @@ def moe_ffn(
     logits = xt @ params["router"]["kernel"]  # [T, E]
     factor = config.capacity_factor if train else config.eval_capacity_factor
     capacity = _capacity(t, config.num_experts, factor)
-    dispatch, combine, aux = router_dispatch(
+    rounds, aux, metrics = _routing(
         logits, capacity, config.top_k, rng,
         config.router_jitter if train else 0.0,
     )
-    # all-to-all #1: tokens -> expert queues (XLA inserts the collective
-    # when experts are mesh-sharded). The SPMD partitioner may log an
-    # "involuntary full rematerialization" for the [T,1,1] gate broadcast
-    # when dispatch/combine consumers want different T shardings — that
-    # tensor is tokens*4 bytes, so the replicate-and-repartition it falls
-    # back to is noise, not a bandwidth problem.
-    expert_in = jnp.einsum(
-        "tec,td->ecd", dispatch.astype(x.dtype), xt
-    )  # [E, C, D]
-    h = activation(jnp.einsum(
-        "ecd,edf->ecf", expert_in, params["experts"]["up"]["kernel"]
-    ))
-    expert_out = jnp.einsum(
-        "ecf,efd->ecd", h, params["experts"]["down"]["kernel"]
-    )  # [E, C, D]
-    # all-to-all #2: expert queues -> tokens
-    out = jnp.einsum(
-        "tec,ecd->td", combine.astype(x.dtype), expert_out
-    )
-    return out.reshape(b, s, d), aux.astype(jnp.float32)
+    compute = (_moe_compute_einsum if config.dispatch == "einsum"
+               else _moe_compute_gather)
+    out = compute(params, xt, rounds, capacity, config.num_experts,
+                  activation)
+    return out.reshape(b, s, d), aux.astype(jnp.float32), metrics
 
 
 def init_moe_params(rng, d_model: int, d_ff: int, num_experts: int,

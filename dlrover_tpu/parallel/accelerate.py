@@ -147,25 +147,28 @@ def accelerate(
         def body(carry, mb_rng):
             grad_sum, loss_sum = carry
             mb, r = mb_rng
-            (loss, _aux), grads = grad_fn(params, mb, r)
+            (loss, aux), grads = grad_fn(params, mb, r)
             carry = (
                 jax.tree.map(jnp.add, grad_sum, grads),
                 loss_sum + loss,
             )
-            return carry, None
+            return carry, aux
 
         zeros = jax.tree.map(jnp.zeros_like, params)
-        (grad_sum, loss_sum), _ = lax.scan(
+        (grad_sum, loss_sum), aux_stack = lax.scan(
             body, (zeros, jnp.zeros(())), (microbatches, rngs)
         )
         grads = jax.tree.map(lambda g: g / accum, grad_sum)
-        return grads, loss_sum / accum
+        aux = jax.tree.map(lambda a: a.mean(axis=0), aux_stack)
+        return grads, loss_sum / accum, aux
 
     def train_step(state: TrainState, batch, step_rng):
         if accum == 1:
-            (loss, _aux), grads = grad_fn(state.params, batch, step_rng)
+            (loss, aux), grads = grad_fn(state.params, batch, step_rng)
         else:
-            grads, loss = _accumulate_grads(state.params, batch, step_rng)
+            grads, loss, aux = _accumulate_grads(
+                state.params, batch, step_rng
+            )
         if hasattr(optimizer, "update_with_grad_fn"):
             # two-gradient optimizers (WSAM/SAM family): hand them a full
             # forward/backward at arbitrary params on this same batch
@@ -186,6 +189,10 @@ def accelerate(
         new_params = optax.apply_updates(state.params, updates)
         grad_norm = optax.global_norm(grads)
         metrics = {
+            # loss_fn aux entries (e.g. the MoE load-balance signals
+            # moe_dropped_frac / moe_expert_load) ride the step metrics;
+            # reserved keys below win on collision
+            **aux,
             "loss": loss,
             "grad_norm": grad_norm,
             # NaN/overflow guardrail (reference: the error monitor's

@@ -357,7 +357,11 @@ class TrainExecutor:
         self.eval_metrics = self._eval_fn(self.state)
         touch_heartbeat()
         logger.info("eval @%d: %s", step, {
-            k: float(v) for k, v in self.eval_metrics.items()
+            # vector metrics (e.g. moe_expert_load [E]) log as lists;
+            # only 0-d values convert to float
+            k: (float(v) if getattr(v, "ndim", 0) == 0
+                else [round(float(x), 4) for x in v])
+            for k, v in self.eval_metrics.items()
         })
         for hook in self._hooks:
             hook.after_evaluate(step, self.eval_metrics)

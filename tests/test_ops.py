@@ -773,11 +773,12 @@ class TestMoE:
         params = init_moe_params(jax.random.PRNGKey(0), d_model=16, d_ff=32,
                                  num_experts=4)
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
-        out, aux = moe_ffn(params, x, cfg)
+        out, aux, metrics = moe_ffn(params, x, cfg)
         assert out.shape == x.shape
+        assert metrics["expert_load"].shape == (4,)
 
         def loss(params):
-            o, a = moe_ffn(params, x, cfg)
+            o, a, _ = moe_ffn(params, x, cfg)
             return (o ** 2).mean() + 0.01 * a
 
         grads = jax.grad(loss)(params)
@@ -785,6 +786,62 @@ class TestMoE:
             (g ** 2).sum() for g in jax.tree.leaves(grads)
         ))
         assert float(gnorm) > 0
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    @pytest.mark.parametrize("capacity_factor", [0.5, 1.25])
+    def test_gather_matches_einsum_reference(self, top_k, capacity_factor):
+        """The fast slot-gather dispatch is numerically the einsum
+        oracle — including under capacity overflow (dropped tokens) and
+        top-2 round-by-round queue filling."""
+        e = 4
+        params = init_moe_params(jax.random.PRNGKey(2), d_model=16,
+                                 d_ff=32, num_experts=e)
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 24, 16))
+        outs, auxs, grads = {}, {}, {}
+        for dispatch in ("einsum", "gather"):
+            cfg = MoEConfig(num_experts=e, capacity_factor=capacity_factor,
+                            top_k=top_k, dispatch=dispatch)
+
+            def loss(p):
+                o, a, _ = moe_ffn(p, x, cfg)
+                return (o ** 2).mean() + 0.01 * a
+
+            outs[dispatch], auxs[dispatch], _ = moe_ffn(params, x, cfg)
+            grads[dispatch] = jax.grad(loss)(params)
+        np.testing.assert_allclose(outs["gather"], outs["einsum"],
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(auxs["gather"], auxs["einsum"],
+                                   atol=1e-6, rtol=1e-6)
+        for ga, ge in zip(jax.tree.leaves(grads["gather"]),
+                          jax.tree.leaves(grads["einsum"])):
+            np.testing.assert_allclose(ga, ge, atol=1e-5, rtol=1e-4)
+
+    def test_skewed_tokens_load_metrics(self):
+        """Under a skewed routing distribution, top-2 + tight capacity
+        must report the overflow: dropped_frac > 0 and expert_load
+        concentrated on the hot expert (switch_gating.py:24-195 parity:
+        capacity-overflow accounting surfaced, not silently dropped)."""
+        e, t = 4, 64
+        params = init_moe_params(jax.random.PRNGKey(4), d_model=16,
+                                 d_ff=32, num_experts=e)
+        # bias the router so ~all tokens prefer experts 0 then 1
+        params["router"]["kernel"] = params["router"]["kernel"] * 0.0 + \
+            jnp.array([[8.0, 4.0, 0.0, -4.0]] * 16)
+        # positive features: every token's logit ordering follows the
+        # biased router columns (a negative feature-sum would flip it)
+        x = jnp.abs(jax.random.normal(jax.random.PRNGKey(5), (1, t, 16)))
+        cfg = MoEConfig(num_experts=e, capacity_factor=1.0, top_k=2)
+        out, aux, metrics = moe_ffn(params, x, cfg)
+        load = np.asarray(metrics["expert_load"])
+        # every token's round-0 pick is expert 0, round-1 pick expert 1
+        assert load[0] == pytest.approx(0.5, abs=1e-6)
+        assert load[1] == pytest.approx(0.5, abs=1e-6)
+        # capacity = t*1.0/e = 16 slots/expert; 2*64 assignments want
+        # experts 0/1 but only 32 slots exist there -> 75% dropped
+        assert float(metrics["dropped_frac"]) == pytest.approx(0.75,
+                                                               abs=1e-6)
+        # the aux loss sees the imbalance: >> 1 (balanced value is 1.0)
+        assert float(aux) > 1.5
 
     def test_dropped_tokens_get_zero_combine(self):
         # capacity 1 with all tokens preferring expert 0: overflow dropped
