@@ -44,6 +44,7 @@ Env knobs:
 from __future__ import annotations
 
 import json
+import re
 import os
 import sys
 import time
@@ -258,10 +259,17 @@ def _last_good(metric: str):
                 if found:
                     return found
             return None
-        # MFU: the driver-written BENCH_r*.json artifacts, newest first
+        # MFU: the driver-written BENCH_r*.json artifacts, newest round
+        # first — sorted by the PARSED round number, not the filename
+        # (lexicographic order breaks at digit-width changes:
+        # BENCH_r100 < BENCH_r99 as strings)
+        def round_no(name):
+            m = re.search(r"BENCH_r(\d+)", name)
+            return int(m.group(1)) if m else -1
+
         names = sorted(
             (n for n in git("ls-files", "BENCH_r*.json").split()),
-            reverse=True,
+            key=round_no, reverse=True,
         )
         for name in names:
             sha = git("log", "-1", "--format=%H", "--", name).strip()

@@ -25,7 +25,11 @@ __version__ = "0.1.0"
 import os as _os
 
 if (
-    "cpu" in _os.environ.get("JAX_PLATFORMS", "").lower()
+    # PRIMARY platform is cpu — not merely present in a fallback spec
+    # like "tpu,cpu", where the accelerator path must keep default
+    # codegen and only an actual CPU client would reload CPU AOT
+    _os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip()
+    == "cpu"
     # empty DLROVER_COMPILE_CACHE_DIR = caching explicitly disabled:
     # no cache, no reason to constrain codegen
     and _os.environ.get("DLROVER_COMPILE_CACHE_DIR", None) != ""

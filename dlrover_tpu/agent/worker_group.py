@@ -178,8 +178,14 @@ class WorkerGroup:
                 out.append(WorkerFailure(local_rank=i, exit_code=code))
         return out
 
-    def stop(self, grace_secs: float = 5.0):
-        """Terminate the whole process group of every worker."""
+    def stop(self, grace_secs: float = 30.0):
+        """Terminate the whole process group of every worker.
+
+        The grace default budgets for the executor's preemption-grace
+        path (``trainer/executor.py``): SIGTERM makes a worker finish
+        its in-flight step and flush an emergency host-staged
+        checkpoint before exiting — escalating to SIGKILL sooner would
+        tear exactly the save the notice exists to enable."""
         for p in self._procs:
             if p.poll() is None:
                 try:

@@ -45,7 +45,11 @@ def parse_memory_mb(quantity) -> int:
     """Parse a k8s memory quantity ('8192Mi', '2Gi', '512M', bytes-int)
     to MiB. Delegates to the ONE shared parser
     (``scheduler.kubernetes.parse_memory_mib``) — per the k8s grammar a
-    plain number is bytes."""
+    plain number is BYTES.
+
+    Semantics break vs pre-0.1 revisions, which returned a plain
+    numeric input verbatim as MiB: callers that passed raw MiB ints
+    now get ~0 and must send '<n>Mi' (or bytes) instead."""
     from dlrover_tpu.scheduler.kubernetes import parse_memory_mib
 
     return parse_memory_mib(quantity)

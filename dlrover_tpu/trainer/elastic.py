@@ -59,6 +59,7 @@ class ElasticTrainer:
         ckpt_interval: Optional[CheckpointInterval] = None,
         master_client=None,
         report_every_steps: int = 10,
+        devices=None,
     ):
         self._init_fn = init_fn
         self._loss_fn = loss_fn
@@ -67,6 +68,10 @@ class ElasticTrainer:
         self._base_strategy = strategy or Strategy()
         self._master_client = master_client
         self._report_every = max(report_every_steps, 1)
+        # explicit device set (default: the whole jax.devices() world);
+        # the agent hands the post-change survivor subset to
+        # on_world_change, and dryruns carve sub-worlds out of one host
+        self._devices = list(devices) if devices is not None else None
 
         self._result: Optional[AccelerateResult] = None
         # Device count the base strategy was written for; grad-accum scales
@@ -103,11 +108,13 @@ class ElasticTrainer:
             self._example_batch,
             strategy=strategy,
             rng=self._rng,
+            devices=self._devices,
         )
 
     def prepare(self, state: Any = None) -> Any:
         """Compile for the current world; restore or init state."""
-        self._result = self._build(len(jax.devices()))
+        n = len(self._devices) if self._devices else len(jax.devices())
+        self._result = self._build(n)
         if state is not None:
             self._host_step = int(state.step)
             return state
@@ -155,18 +162,23 @@ class ElasticTrainer:
         announce_long_phase(600.0)  # restore window: not a hang
         return self._try_restore()
 
-    def on_world_change(self, state: Any) -> Any:
+    def on_world_change(self, state: Any, devices=None) -> Any:
         """Re-accelerate for the new device count and reshard the state.
 
         Called by the agent/bootstrap after ``jax.distributed`` re-init.
         The global batch stays fixed: ``Strategy.adjust_to_world`` shrinks
         the data axis and grows grad accumulation to compensate — the
         reference's ``_set_gradient_accumulation_steps`` semantics.
+        ``devices``: the surviving device subset (default: the full
+        post-re-init ``jax.devices()`` world — an explicit
+        construction-time subset is dropped, because after a membership
+        change those handles may be stale/dead).
         """
         from dlrover_tpu.diagnosis.hang_detector import announce_long_phase
 
         announce_long_phase(900.0)  # recompile window: not a hang
-        n = len(jax.devices())
+        self._devices = list(devices) if devices is not None else None
+        n = len(self._devices) if self._devices else len(jax.devices())
         old_accum = self._result.strategy.grad_accum_steps if self._result else 1
         self._result = self._build(n)
         logger.info(

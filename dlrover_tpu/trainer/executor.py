@@ -161,6 +161,118 @@ class TrainExecutor:
         self.state: Any = None
         self.eval_metrics: Dict[str, Any] = {}
         self._last_eval_step = -1
+        # preemption grace (reference design goal: flash checkpoint,
+        # docs/blogs/stabilize_llm_training_cn.md:215 — bound lost work
+        # by an emergency save, not the periodic cadence)
+        self._preempt_grace = bool(conf.get("preemption_grace", True))
+        self._preempted: Optional[int] = None
+        self._prev_handlers: Dict[int, Any] = {}
+
+    # -- preemption grace ----------------------------------------------------
+
+    def install_preemption_handler(self, signals=None):
+        """SIGTERM = a preemption notice (the scheduler's grace window,
+        and this framework's own agent stop path,
+        ``agent/worker_group.py:186``): finish the in-flight step, flush
+        an emergency host-staged checkpoint, then end the run cleanly —
+        lost work <= 1 step instead of the periodic save cadence.
+
+        Installed automatically by ``train_and_evaluate`` when the conf
+        knob ``preemption_grace`` is true (default); a no-op off the
+        main thread (signal handlers are main-thread-only in Python).
+
+        One-shot: the first notice re-arms the previous disposition, so
+        a SECOND SIGTERM (an impatient supervisor, or the loop blocked
+        outside the step path, e.g. in a stalled data iterator) kills
+        the process the ordinary way instead of being swallowed.
+        """
+        import signal as _signal
+
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+
+        def _handler(signum, _frame):
+            # flag only — the save runs in the loop, after the jitted
+            # step returns (handlers must not touch the device)
+            self._preempted = signum
+            self._restore_signal_dispositions()
+            logger.warning(
+                "preemption notice (signal %d): emergency checkpoint "
+                "after the in-flight step", signum,
+            )
+
+        try:
+            for s in signals:
+                prev = _signal.signal(s, _handler)
+                self._prev_handlers[s] = prev
+        except ValueError:
+            logger.warning(
+                "preemption handler unavailable off the main thread"
+            )
+
+    def _restore_signal_dispositions(self):
+        """Re-arm whatever handled the signals before install (default:
+        terminate) — from the handler itself and from run teardown, so
+        the process never ends up SIGTERM-proof."""
+        import signal as _signal
+
+        for s, prev in self._prev_handlers.items():
+            try:
+                _signal.signal(s, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev_handlers = {}
+
+    def _finish_preempted(self, step: int) -> Dict[str, Any]:
+        """Emergency save + clean end. The grace window bounds us
+        externally (SIGKILL follows); the save is host-DRAM staged, so
+        the commit is a local write, not a slow remote upload."""
+        logger.warning("preempted at step %d: flushing emergency "
+                       "checkpoint", step)
+        t0 = time.time()
+        try:
+            # same guard as the periodic path (elastic.py step()): a
+            # NaN-poisoned state must never become the newest restore
+            # target — losing the window beats corrupting the chain
+            if self._last_metrics is not None and not self._step_is_finite(
+                self._last_metrics
+            ):
+                logger.error(
+                    "skipping emergency checkpoint: non-finite state at "
+                    "step %d (an older finite checkpoint remains the "
+                    "restore target)", step,
+                )
+            else:
+                self._trainer.save(self.state, force=True)
+            saved = self._trainer.latest_checkpoint_step()  # flush
+            logger.warning(
+                "emergency checkpoint committed at step %s in %.1f s",
+                saved, time.time() - t0,
+            )
+        except Exception:  # noqa: BLE001 — still exit cleanly in grace
+            logger.exception("emergency checkpoint failed")
+        try:
+            # close the async manager even when the save above failed:
+            # an earlier in-flight save must be waited on before exit
+            self._trainer.finalize()
+        except Exception:  # noqa: BLE001
+            logger.exception("checkpoint finalize failed")
+        if self._master_client is not None:
+            try:
+                self._master_client.report_failure(
+                    node_rank=getattr(self._master_client, "node_id", 0),
+                    restart_count=0,
+                    error_data=f"preempted at step {step}",
+                    level=TrainingExceptionLevel.NODE_ERROR,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        out = dict(self._last_metrics or {})
+        out["preempted"] = True
+        out["step"] = step  # _finish() contract parity
+        for hook in self._hooks:
+            hook.end(self)
+        return out
 
     # -- failover ------------------------------------------------------------
 
@@ -256,6 +368,8 @@ class TrainExecutor:
         # hang_first_beat_grace covers setup + first-step compile, and an
         # early beat would forfeit it (beaten=True drops the allowance to
         # the bare timeout while the compile is still running)
+        if self._preempt_grace:
+            self.install_preemption_handler()
         self.state = self._trainer.prepare(self.state)
         for hook in self._hooks:
             hook.begin(self)
@@ -281,6 +395,9 @@ class TrainExecutor:
                     self._update_trace(step)
                     for hook in self._hooks:
                         hook.after_step(step, metrics)
+
+                    if self._preempted is not None:
+                        return self._finish_preempted(step)
 
                     if (
                         self._check_finite_every
@@ -312,6 +429,7 @@ class TrainExecutor:
                     return self._finish(step)
         finally:
             self._stop_trace_if_open(step)
+            self._restore_signal_dispositions()
             if self._failover is not None:
                 self._failover.stop()
 

@@ -49,11 +49,15 @@ def test_dryrun_multichip_large(n, tensor):
         f"dryrun_multichip({n}) failed:\n{proc.stderr[-3000:]}"
     )
     out = proc.stdout
-    # all four passes ran at this device count
+    # all five passes ran at this device count
     assert f"dryrun_multichip({n}): mesh=" in out, out
     assert f"dryrun_multichip({n}): interleaved-pp" in out, out
     assert f"dryrun_multichip({n}): moe" in out, out
     assert f"dryrun_multichip({n}): packed segments" in out, out
+    assert (
+        f"dryrun_multichip({n}): elastic shrink {n}->{n // 2}" in out
+    ), out
+    assert "(continuity ok)" in out, out
     # the factor row actually used all four axes at n>=16
     mesh_line = next(
         ln for ln in out.splitlines()

@@ -1,0 +1,87 @@
+"""Preemption-grace chaos worker: trains with NO periodic checkpoint
+cadence, so the ONLY restore point a SIGTERM leaves behind is the
+executor's emergency save. Emits one status line per step; on restart
+(a checkpoint exists) it logs the resumed step and exits.
+
+Env: PREEMPT_CKPT_DIR (checkpoint root), PREEMPT_STATUS (jsonl path).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.checkpoint.manager import CheckpointInterval
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.trainer.conf import Configuration
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.executor import TrainExecutor, TrainHook
+
+CKPT = os.environ["PREEMPT_CKPT_DIR"]
+STATUS = os.environ["PREEMPT_STATUS"]
+TOTAL_STEPS = int(os.environ.get("PREEMPT_TOTAL_STEPS", "200"))
+
+
+def emit(record):
+    with open(STATUS, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+cfg = llama.llama_tiny(num_layers=2, max_seq_len=64, use_flash=False)
+rng = np.random.RandomState(0)
+ids = rng.randint(0, cfg.vocab_size, size=(4, 65))
+batch = {
+    "input_ids": jnp.asarray(ids[:, :-1]),
+    "labels": jnp.asarray(ids[:, 1:]),
+}
+
+trainer = ElasticTrainer(
+    llama.make_init_fn(cfg),
+    llama.make_loss_fn(cfg),
+    optax.adamw(1e-3),
+    batch,
+    strategy=Strategy(mesh=MeshPlan(data=1, fsdp=1)),
+    ckpt_dir=CKPT,
+    # no periodic cadence: steps=0/secs=0 never fires, so only the
+    # preemption path can produce a checkpoint
+    ckpt_interval=CheckpointInterval(steps=0, secs=0.0),
+)
+
+
+class StatusHook(TrainHook):
+    def begin(self, executor):
+        emit({"event": "begin",
+              "resumed_step": int(executor.state.step)})
+
+    def after_step(self, step, metrics):
+        emit({"event": "step", "step": step,
+              "loss": float(metrics["loss"])})
+        time.sleep(0.2)  # widen the kill window
+
+
+def batches():
+    for _ in range(TOTAL_STEPS):
+        yield batch
+
+
+executor = TrainExecutor(
+    trainer,
+    train_iter_fn=batches,
+    hooks=[StatusHook()],
+    conf=Configuration({"train_steps": TOTAL_STEPS,
+                        "log_every_steps": 0}),
+)
+result = executor.train_and_evaluate()
+emit({"event": "end", "preempted": bool(result.get("preempted")),
+      "final_step": int(executor.state.step)})
+trainer.finalize()
+sys.exit(0)
