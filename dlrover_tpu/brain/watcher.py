@@ -203,9 +203,11 @@ class ClusterWatcher:
                 logger.warning("list_job_nodes(%s) failed: %s", name, e)
                 continue
             workers = len(nodes.get("worker", []))
+            # NO "speed" key: throughput is self-reported by the job; a
+            # watcher row carrying speed=0.0 could shadow a genuine
+            # sample for any consumer that reads only the latest row.
+            # The watcher contributes topology + usage only.
             self._persist(uuid, name, MetricType.RUNTIME_INFO, {
-                "speed": 0.0,  # throughput is self-reported; the
-                # watcher contributes topology + usage
                 "workers": workers,
                 "nodes": nodes,
                 "observed_by": "cluster_watcher",

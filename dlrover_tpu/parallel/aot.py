@@ -106,6 +106,15 @@ def _get_topology_desc_serialized(topologies, topology: str,
                 import fcntl
                 import os as _os
 
+                # NB: holding LOCK_EX here (even briefly, for the
+                # inode-checked unlink below) can make a CONCURRENT
+                # libtpu init abort instead of block — libtpu errors
+                # rather than waits on a held lock. Acceptable: the
+                # sibling lands back in this same retry loop and
+                # re-inits within the budget; the alternative (probe
+                # with LOCK_SH first) still needs the exclusive window
+                # for the unlink, so it only narrows the race, not
+                # closes it.
                 with open(_LIBTPU_LOCKFILE) as fh:
                     try:
                         fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
