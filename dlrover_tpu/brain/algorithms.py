@@ -7,7 +7,10 @@ unlike the per-job local optimizer — spans *all* jobs on the cluster,
 enabling cold-start plans learned from similar completed jobs).
 
 Payload conventions (``BrainJobMetrics.payload``):
-  RUNTIME_INFO: {"speed": steps/s, "workers": n,
+  RUNTIME_INFO: {"speed": steps/s (OPTIONAL — present only on
+                 self-reported rows; ClusterWatcher rows omit it, so
+                 consumers must filter with .get("speed")),
+                 "workers": n,
                  "nodes": {type: [{"name","cpu","used_cpu","memory",
                                    "used_memory"}]}}
   MODEL_FEATURE: {"param_count": n, "flops_per_step": f}

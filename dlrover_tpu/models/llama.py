@@ -23,7 +23,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field, replace
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -417,6 +417,7 @@ def apply_pipelined(
     num_microbatches: int,
     rng: Optional[jax.Array] = None,
     num_virtual: int = 1,
+    stage_depths: Optional[Sequence[int]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Forward pass with the decoder blocks run as a GPipe pipeline over
     the "pipe" mesh axis (``parallel.pipeline``); embed/final-norm/head
@@ -428,6 +429,12 @@ def apply_pipelined(
     each stage restarts the rng chain, so routing overflow/jitter
     decisions are not bit-identical to ``apply``. Use with the
     "llama_pp" rule set so the stacked layer dim lands on "pipe".
+
+    ``stage_depths``: per-stage-chunk layer counts (V*P entries in visit
+    order, summing to num_layers) for UNEVEN stage splits — a lighter
+    first/last stage, or L % (V*P) != 0. Padded layer slots are skipped
+    via a validity mask; see ``pipeline.stack_stages_uneven`` for the
+    cost model (wall-clock equals the heaviest stage either way).
     """
     from dlrover_tpu.parallel.pipeline import (
         merge_microbatches,
@@ -436,6 +443,8 @@ def apply_pipelined(
         split_microbatches,
         stack_stages,
         stack_stages_interleaved,
+        stack_stages_interleaved_uneven,
+        stack_stages_uneven,
     )
 
     c = config
@@ -448,9 +457,49 @@ def apply_pipelined(
         (x, _), (auxs, _, _) = lax.scan(block, (x, rng), layers_chunk)
         return (x, aux + jnp.sum(auxs))
 
+    def stage_fn_uneven(chunk_and_mask, state):
+        layers_chunk, mask = chunk_and_mask
+        x, aux = state
+        block = apply_remat(_decoder_block(c), c.remat_policy)
+
+        def slot(carry, inp):
+            layer, valid = inp
+            new_carry, (aux_l, _, _) = block(carry, layer)
+            x_new, rng_new = new_carry
+            x_old, _ = carry
+            # padded slot: carry the state through untouched (zero
+            # params keep the garbage compute finite, so the masked
+            # branch cannot poison the selected one's gradient); the
+            # rng chain advances regardless so depth layout never
+            # changes a real layer's dropout/jitter stream position
+            x_sel = jnp.where(valid > 0, x_new, x_old)
+            return (x_sel, rng_new), aux_l * valid
+        (x, _), auxs = lax.scan(slot, (x, rng), (layers_chunk, mask))
+        return (x, aux + jnp.sum(auxs))
+
     x_mb = split_microbatches(x, num_microbatches)
     aux_mb = jnp.zeros((num_microbatches,), jnp.float32)
-    if num_virtual > 1:
+    if stage_depths is not None:
+        if num_virtual > 1:
+            stage_params = stack_stages_interleaved_uneven(
+                params["layers"], num_stages, num_virtual, stage_depths
+            )
+            out_mb, aux_out = pipeline_apply_interleaved(
+                stage_fn_uneven, stage_params, (x_mb, aux_mb)
+            )
+        else:
+            if len(stage_depths) != num_stages:
+                raise ValueError(
+                    f"stage_depths has {len(stage_depths)} entries "
+                    f"for {num_stages} stages"
+                )
+            stage_params = stack_stages_uneven(
+                params["layers"], stage_depths
+            )
+            out_mb, aux_out = pipeline_apply(
+                stage_fn_uneven, stage_params, (x_mb, aux_mb)
+            )
+    elif num_virtual > 1:
         stage_params = stack_stages_interleaved(
             params["layers"], num_stages, num_virtual
         )

@@ -183,6 +183,82 @@ def stack_stages(layer_params: PyTree, num_stages: int) -> PyTree:
     return jax.tree.map(restack, layer_params)
 
 
+def stack_stages_uneven(
+    layer_params: PyTree, depths
+) -> Tuple[PyTree, jax.Array]:
+    """[L, ...] scan-stacked layer params -> ([P, Lmax, ...] zero-padded
+    stage chunks, [P, Lmax] float validity mask).
+
+    Per-stage layer counts (``depths``, summing to L) express UNEQUAL
+    stage splits — a deliberately lighter first/last stage, or a layer
+    count that doesn't divide by the stage count. Role parity: the
+    reference's uneven stage placement
+    (``atorch/atorch/auto/opt_lib/shard_planners/base_stage_planner.py:125``).
+
+    Cost model: any lockstep pipeline ticks at the HEAVIEST stage's
+    cost, so running every stage over Lmax = max(depths) padded slots
+    costs the same wall-clock as a ragged implementation would — the
+    light stages' padded slots burn cycles the tick-barrier would waste
+    anyway. The real overheads are (P*Lmax - L)/L extra parameter
+    memory and the masked slots' energy. The caller's ``stage_fn`` must
+    skip masked slots (carry the state through where mask == 0).
+    """
+    depths = tuple(int(d) for d in depths)
+    if not depths or any(d <= 0 for d in depths):
+        raise ValueError(f"stage depths must be positive: {depths}")
+    lmax = max(depths)
+    offsets = [0]
+    for d in depths:
+        offsets.append(offsets[-1] + d)
+    total = offsets[-1]
+
+    def restack(x):
+        if x.shape[0] != total:
+            raise ValueError(
+                f"{x.shape[0]} layers != sum(depths) = {total}"
+            )
+        chunks = []
+        for p, d in enumerate(depths):
+            chunk = lax.slice_in_dim(x, offsets[p], offsets[p] + d, axis=0)
+            if d < lmax:
+                pad = jnp.zeros((lmax - d,) + x.shape[1:], x.dtype)
+                chunk = jnp.concatenate([chunk, pad], axis=0)
+            chunks.append(chunk)
+        return jnp.stack(chunks)
+
+    mask = jnp.asarray(
+        [[1.0 if j < d else 0.0 for j in range(lmax)] for d in depths],
+        jnp.float32,
+    )
+    return jax.tree.map(restack, layer_params), mask
+
+
+def stack_stages_interleaved_uneven(
+    layer_params: PyTree, num_stages: int, num_virtual: int, depths
+) -> Tuple[PyTree, jax.Array]:
+    """[L, ...] -> ([V, P, Lmax, ...] zero-padded chunks, [V, P, Lmax]
+    mask) for the circular schedule with per-chunk layer counts.
+
+    ``depths`` has V*P entries in VISIT order — round 0 stages 0..P-1,
+    then round 1 stages 0..P-1, ... — matching the logical layer order
+    of ``stack_stages_interleaved``. Physical stage p's total layer load
+    is ``sum(depths[r*P + p] for r in range(V))``; a lighter first/last
+    stage means making those column sums smaller at the ends.
+    """
+    depths = tuple(int(d) for d in depths)
+    if len(depths) != num_stages * num_virtual:
+        raise ValueError(
+            f"need {num_virtual}x{num_stages} = "
+            f"{num_virtual * num_stages} depths, got {len(depths)}"
+        )
+    stacked, mask = stack_stages_uneven(layer_params, depths)
+
+    def to_vp(x):
+        return x.reshape((num_virtual, num_stages) + x.shape[1:])
+
+    return jax.tree.map(to_vp, stacked), to_vp(mask)
+
+
 def stack_stages_interleaved(
     layer_params: PyTree, num_stages: int, num_virtual: int
 ) -> PyTree:

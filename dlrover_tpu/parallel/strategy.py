@@ -19,7 +19,7 @@ from __future__ import annotations
 import dataclasses
 import json
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Tuple
 
 from dlrover_tpu.parallel.mesh import MeshPlan
 from dlrover_tpu.parallel.sharding_rules import (
@@ -64,6 +64,13 @@ class Strategy:
     # bubble shrinks (P-1)/(M+P-1) -> (P-1)/(V*M+P-1)). Consumed by
     # model forwards via ``apply_pipelined(..., num_virtual=...)``.
     num_virtual: int = 1
+    # uneven pipeline stage split: per-stage-chunk layer counts (V*P
+    # entries in visit order, summing to the model's layer count). None
+    # = even split. Lets the planner place a lighter first/last stage
+    # (embed/head-adjacent) or handle L % (V*P) != 0 — reference's
+    # uneven stage placement (atorch base_stage_planner.py:125).
+    # Consumed by ``apply_pipelined(..., stage_depths=...)``.
+    stage_depths: Optional[Tuple[int, ...]] = None
     # global batch row count; accelerate() validates the example batch
     # against it and adjust_to_world keeps accum a divisor of it.
     # 0 = derived from the example batch at accelerate() time.
@@ -116,6 +123,8 @@ class Strategy:
         raw = json.loads(text)
         raw["mesh"] = MeshPlan(**raw.get("mesh", {}))
         raw["dtypes"] = DtypePolicy(**raw.get("dtypes", {}))
+        if raw.get("stage_depths") is not None:
+            raw["stage_depths"] = tuple(raw["stage_depths"])
         return cls(**raw)
 
     def save(self, path: str):
