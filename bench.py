@@ -836,11 +836,13 @@ def recovery_result() -> dict:
                        proc=p1)
     if rec is None:
         p1.kill()
-        return {
-            "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
-            "vs_baseline": 0.0,
-            "error": "phase-1 worker never reached a committed checkpoint",
-        }
+        # through _error_line so the artifact embeds last_good: a
+        # wedged phase-1 must not erase the provenance chain either
+        return _error_line(
+            "recovery_mttr_s",
+            "phase-1 worker never reached a committed checkpoint",
+            unit="s",
+        )
     cold_boot_s = first_line.get("boot_to_step_s", rec["boot_to_step_s"])
 
     p1.kill()  # SIGKILL: the injected preemption
@@ -861,10 +863,9 @@ def recovery_result() -> dict:
         shutil.rmtree(scratch, ignore_errors=True)
 
     if rec2 is None:
-        return {
-            "metric": "recovery_mttr_s", "value": 0.0, "unit": "s",
-            "vs_baseline": 0.0, "error": "restarted worker never stepped",
-        }
+        return _error_line(
+            "recovery_mttr_s", "restarted worker never stepped", unit="s"
+        )
 
     result_line = {
         "metric": "recovery_mttr_s",

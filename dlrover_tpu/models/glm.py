@@ -28,7 +28,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, replace
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -313,6 +313,95 @@ def apply(params: Dict, input_ids: jax.Array, config: GLMConfig,
     block = apply_remat(_block(c, bias, prefix_len, segment_ids),
                         c.remat_policy)
     x, _ = lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["final_norm"]["scale"],
+                    params["final_norm"]["bias"], c.ln_eps)
+    logits = x @ params["lm_head"]["kernel"].astype(c.compute_dtype)
+    return logits.astype(jnp.float32)
+
+
+def apply_pipelined(
+    params: Dict,
+    input_ids: jax.Array,
+    config: GLMConfig,
+    num_stages: int,
+    num_microbatches: int,
+    prefix_len: Optional[jax.Array] = None,
+    num_virtual: int = 1,
+    stage_depths: Optional[Sequence[int]] = None,
+) -> jax.Array:
+    """Forward pass with the GLM blocks as a GPipe / interleaved
+    pipeline over the "pipe" mesh axis — including PREFIX-LM mode: the
+    per-example ``prefix_len`` rides the pipeline state beside its
+    microbatch (the mask context must travel with the microbatch around
+    the stage ring), and each stage rebuilds the mask from it — fused
+    into the Pallas tiles on the flash path, an additive bias on the
+    dense reference path. Use with the "glm_pp" rule set.
+
+    2D positions are applied at embed time (outside the pipeline) from
+    the full-batch ``prefix_len``, exactly as ``apply`` does. Packed
+    ``segment_ids`` mode rides the unpipelined ``apply``.
+    """
+    from dlrover_tpu.parallel.pipeline import (
+        dispatch_pipeline,
+        masked_layer_scan,
+        merge_microbatches,
+        pipe_batch_constraint,
+        split_microbatches,
+    )
+
+    c = config
+    b, s = input_ids.shape
+    x = params["embed_tokens"]["embedding"][input_ids]
+    if prefix_len is not None:
+        pos_ids, block_ids = glm_positions(s, prefix_len)
+    else:
+        pos_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+        block_ids = jnp.zeros((b, s), jnp.int32)
+    x = x + params["pos_embed"]["embedding"][pos_ids] \
+        + params["block_pos_embed"]["embedding"][block_ids]
+    x = x.astype(c.compute_dtype)
+
+    with_prefix = prefix_len is not None
+
+    def run_chunk(layers_chunk, x, pfx, mask=None):
+        # mirror apply()'s dispatch: the flash path fuses the prefix
+        # mask into the kernel tiles and the ring path decomposes it
+        # per shard (both take prefix_len); the S x S bias is only
+        # materialized for the dense reference
+        mask_in_kernel = c.use_flash or c.seq_axis is not None
+        bias = None
+        if with_prefix and not mask_in_kernel:
+            bias = prefix_lm_bias(x.shape[1], pfx, c.compute_dtype)
+        block = apply_remat(
+            _block(c, bias, pfx if (with_prefix and mask_in_kernel)
+                   else None),
+            c.remat_policy,
+        )
+        return masked_layer_scan(block, x, layers_chunk, mask)
+
+    if with_prefix:
+        state = (x, prefix_len)
+
+        def stage_fn(chunk_and_mask, st):
+            layers_chunk, mask = chunk_and_mask
+            x, pfx = st
+            return (run_chunk(layers_chunk, x, pfx, mask), pfx)
+    else:
+        state = x
+
+        def stage_fn(chunk_and_mask, x):
+            layers_chunk, mask = chunk_and_mask
+            return run_chunk(layers_chunk, x, None, mask)
+
+    state_mb = split_microbatches(state, num_microbatches)
+    out_mb = dispatch_pipeline(
+        stage_fn, params["layers"], state_mb,
+        num_stages, num_virtual, stage_depths,
+    )
+    out_state = merge_microbatches(out_mb)
+    x = out_state[0] if with_prefix else out_state
+
+    x = pipe_batch_constraint(x)
     x = _layer_norm(x, params["final_norm"]["scale"],
                     params["final_norm"]["bias"], c.ln_eps)
     logits = x @ params["lm_head"]["kernel"].astype(c.compute_dtype)

@@ -23,7 +23,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, replace
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -267,6 +267,56 @@ def apply(params: Dict, input_ids: jax.Array, config: GPTNeoXConfig,
         positions = segment_positions(segment_ids)
     block = apply_remat(_block(c, segment_ids, positions), c.remat_policy)
     x, _ = lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["final_norm"]["scale"],
+                    params["final_norm"]["bias"], c.ln_eps)
+    logits = x @ params["lm_head"]["kernel"].astype(c.compute_dtype)
+    return logits.astype(jnp.float32)
+
+
+def apply_pipelined(
+    params: Dict,
+    input_ids: jax.Array,
+    config: GPTNeoXConfig,
+    num_stages: int,
+    num_microbatches: int,
+    num_virtual: int = 1,
+    stage_depths: Optional[Sequence[int]] = None,
+) -> jax.Array:
+    """Forward pass with the NeoX blocks run as a GPipe / interleaved
+    pipeline over the "pipe" mesh axis (``parallel.pipeline``), the same
+    formulation as ``models.llama.apply_pipelined``: embed and
+    final-norm/head stay outside in the surrounding GSPMD program (the
+    head spread over pipe as extra data parallelism), stages are the
+    scan-stacked layer chunks. Use with the "neox_pp" rule set.
+
+    ``stage_depths``: per-stage-chunk layer counts (visit order) for
+    uneven splits; see ``pipeline.stack_stages_uneven``. Plain causal
+    mode only (packed segments ride the unpipelined ``apply``).
+    """
+    from dlrover_tpu.parallel.pipeline import (
+        dispatch_pipeline,
+        masked_layer_scan,
+        merge_microbatches,
+        pipe_batch_constraint,
+        split_microbatches,
+    )
+
+    c = config
+    x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
+
+    def stage_fn(chunk_and_mask, x):
+        layers_chunk, mask = chunk_and_mask
+        block = apply_remat(_block(c), c.remat_policy)
+        return masked_layer_scan(block, x, layers_chunk, mask)
+
+    x_mb = split_microbatches(x, num_microbatches)
+    out_mb = dispatch_pipeline(
+        stage_fn, params["layers"], x_mb,
+        num_stages, num_virtual, stage_depths,
+    )
+    x = merge_microbatches(out_mb)
+
+    x = pipe_batch_constraint(x)
     x = _layer_norm(x, params["final_norm"]["scale"],
                     params["final_norm"]["bias"], c.ln_eps)
     logits = x @ params["lm_head"]["kernel"].astype(c.compute_dtype)

@@ -437,30 +437,23 @@ def apply_pipelined(
     cost model (wall-clock equals the heaviest stage either way).
     """
     from dlrover_tpu.parallel.pipeline import (
+        dispatch_pipeline,
         merge_microbatches,
-        pipeline_apply,
-        pipeline_apply_interleaved,
+        pipe_batch_constraint,
         split_microbatches,
-        stack_stages,
-        stack_stages_interleaved,
-        stack_stages_interleaved_uneven,
-        stack_stages_uneven,
     )
 
     c = config
     x = params["embed_tokens"]["embedding"][input_ids].astype(c.compute_dtype)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    def stage_fn(layers_chunk, state):
-        x, aux = state
-        block = apply_remat(_decoder_block(c), c.remat_policy)
-        (x, _), (auxs, _, _) = lax.scan(block, (x, rng), layers_chunk)
-        return (x, aux + jnp.sum(auxs))
-
-    def stage_fn_uneven(chunk_and_mask, state):
+    def stage_fn(chunk_and_mask, state):
         layers_chunk, mask = chunk_and_mask
         x, aux = state
         block = apply_remat(_decoder_block(c), c.remat_policy)
+        if mask is None:  # even split: plain scan over the chunk
+            (x, _), (auxs, _, _) = lax.scan(block, (x, rng), layers_chunk)
+            return (x, aux + jnp.sum(auxs))
 
         def slot(carry, inp):
             layer, valid = inp
@@ -479,40 +472,16 @@ def apply_pipelined(
 
     x_mb = split_microbatches(x, num_microbatches)
     aux_mb = jnp.zeros((num_microbatches,), jnp.float32)
-    if stage_depths is not None:
-        if num_virtual > 1:
-            stage_params = stack_stages_interleaved_uneven(
-                params["layers"], num_stages, num_virtual, stage_depths
-            )
-            out_mb, aux_out = pipeline_apply_interleaved(
-                stage_fn_uneven, stage_params, (x_mb, aux_mb)
-            )
-        else:
-            if len(stage_depths) != num_stages:
-                raise ValueError(
-                    f"stage_depths has {len(stage_depths)} entries "
-                    f"for {num_stages} stages"
-                )
-            stage_params = stack_stages_uneven(
-                params["layers"], stage_depths
-            )
-            out_mb, aux_out = pipeline_apply(
-                stage_fn_uneven, stage_params, (x_mb, aux_mb)
-            )
-    elif num_virtual > 1:
-        stage_params = stack_stages_interleaved(
-            params["layers"], num_stages, num_virtual
-        )
-        out_mb, aux_out = pipeline_apply_interleaved(
-            stage_fn, stage_params, (x_mb, aux_mb)
-        )
-    else:
-        stage_params = stack_stages(params["layers"], num_stages)
-        out_mb, aux_out = pipeline_apply(
-            stage_fn, stage_params, (x_mb, aux_mb)
-        )
+    out_mb, aux_out = dispatch_pipeline(
+        stage_fn, params["layers"], (x_mb, aux_mb),
+        num_stages, num_virtual, stage_depths,
+    )
     x = merge_microbatches(out_mb)
     aux = jnp.sum(aux_out)
+
+    # the outer final-norm/head must not replicate over the pipe axis
+    # (see pipe_batch_constraint: comm-free slice, head FLOPs / pipe)
+    x = pipe_batch_constraint(x)
 
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
     logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))

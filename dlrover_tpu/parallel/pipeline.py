@@ -43,6 +43,33 @@ def _context_has_axis(axis_name: str) -> bool:
     return axis_name in getattr(mesh, "axis_names", ())
 
 
+def pipe_batch_constraint(
+    x: jax.Array,
+    axis_name: str = "pipe",
+    batch_axes: Tuple = ("data", "fsdp"),
+) -> jax.Array:
+    """Spread dim 0 of a post-pipeline activation over the pipe axis too.
+
+    The surrounding GSPMD program (embed / final-norm / lm head) has no
+    operand sharded on "pipe", so XLA replicates that compute across
+    every pipe group — at scale the head is a large fraction of a
+    stage's FLOPs. Constraining the batch dim over (batch_axes + pipe)
+    is comm-free at this point (replicated -> sharded lowers to a local
+    slice) and cuts the outer compute by the pipe degree; the backward
+    pays one activation-size all-gather over pipe to re-replicate the
+    gradient entering the pipeline. No-op without a pipe mesh axis.
+    """
+    if not _context_has_axis(axis_name):
+        return x
+    from jax.sharding import PartitionSpec as P
+
+    return lax.with_sharding_constraint(
+        x,
+        P((*batch_axes, axis_name),
+          *(P.UNCONSTRAINED for _ in range(x.ndim - 1))),
+    )
+
+
 def split_microbatches(tree: PyTree, num_microbatches: int) -> PyTree:
     """[B, ...] leaves -> [M, B/M, ...] microbatch-stacked leaves."""
 
@@ -257,6 +284,70 @@ def stack_stages_interleaved_uneven(
         return x.reshape((num_virtual, num_stages) + x.shape[1:])
 
     return jax.tree.map(to_vp, stacked), to_vp(mask)
+
+
+def dispatch_pipeline(
+    stage_fn: Callable[[PyTree, PyTree], PyTree],
+    layer_params: PyTree,
+    state_mb: PyTree,
+    num_stages: int,
+    num_virtual: int = 1,
+    stage_depths=None,
+) -> PyTree:
+    """Shared stacking + schedule dispatch for model ``apply_pipelined``
+    implementations: picks gpipe vs interleaved vs their uneven-depth
+    variants, stacks ``layer_params`` accordingly, and runs the
+    schedule. ``stage_fn((layers_chunk, mask), state)`` receives
+    ``mask=None`` on the even paths (None is an empty pytree, so vmap
+    passes it through untouched); with a mask it must skip masked slots
+    (carry the state through where mask == 0, e.g. via
+    ``masked_layer_scan``)."""
+    if stage_depths is not None:
+        if num_virtual > 1:
+            stage_params = stack_stages_interleaved_uneven(
+                layer_params, num_stages, num_virtual, stage_depths
+            )
+            return pipeline_apply_interleaved(
+                stage_fn, stage_params, state_mb
+            )
+        if len(stage_depths) != num_stages:
+            raise ValueError(
+                f"stage_depths has {len(stage_depths)} entries "
+                f"for {num_stages} stages"
+            )
+        stage_params = stack_stages_uneven(layer_params, stage_depths)
+        return pipeline_apply(stage_fn, stage_params, state_mb)
+    if num_virtual > 1:
+        stage_params = (stack_stages_interleaved(
+            layer_params, num_stages, num_virtual
+        ), None)
+        return pipeline_apply_interleaved(stage_fn, stage_params, state_mb)
+    stage_params = (stack_stages(layer_params, num_stages), None)
+    return pipeline_apply(stage_fn, stage_params, state_mb)
+
+
+def masked_layer_scan(
+    block: Callable, x: jax.Array, layers_chunk: PyTree,
+    mask: Optional[jax.Array],
+) -> jax.Array:
+    """Scan ``block(carry, layer) -> (new_carry, _)`` over a stage
+    chunk. ``mask=None`` (even split) is a plain scan; with a mask
+    (zero-padded uneven chunk) masked slots carry the state through
+    untouched (the zero params keep the masked branch finite, so it
+    cannot poison the selected branch's gradient). For blocks whose
+    carry is the activation alone; models with richer carries write
+    their own slot loop."""
+    if mask is None:
+        x, _ = lax.scan(block, x, layers_chunk)
+        return x
+
+    def slot(carry, inp):
+        layer, valid = inp
+        new_x, _ = block(carry, layer)
+        return jnp.where(valid > 0, new_x, carry), None
+
+    x, _ = lax.scan(slot, x, (layers_chunk, mask))
+    return x
 
 
 def stack_stages_interleaved(

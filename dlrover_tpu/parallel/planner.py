@@ -464,6 +464,23 @@ def plan_stages(
     return list(reversed(spans))
 
 
+def plan_stage_depths(
+    layer_costs: List[float], num_stages: int, num_virtual: int = 1
+) -> Tuple[int, ...]:
+    """Per-stage-chunk layer counts for ``Strategy.stage_depths``.
+
+    Runs the ``plan_stages`` DP over V*P contiguous chunks (visit
+    order), minimizing the max chunk cost — the quantity a lockstep
+    tick pays. With uniform layer costs this is the balanced
+    ceil/floor split of L % (V*P) != 0; with heterogeneous costs
+    (e.g. a future mixed dense/MoE stack) it shifts layer counts off
+    the expensive chunks. Feed the result to
+    ``Strategy(stage_depths=...)`` / ``apply_pipelined``.
+    """
+    spans = plan_stages(layer_costs, num_stages * num_virtual)
+    return tuple(j - i for i, j in spans)
+
+
 def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
     """Convenience: derive a ModelSpec from a LlamaConfig."""
     import numpy as np

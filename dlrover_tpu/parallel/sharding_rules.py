@@ -259,6 +259,31 @@ def glm_rules() -> ShardingRules:
     return neox_rules()
 
 
+def neox_pp_rules() -> ShardingRules:
+    """Pipeline-parallel NeoX/GLM: like ``llama_pp_rules``, the stacked
+    layer dim lands on "pipe" (each stage holds its chunk locally); bias
+    vectors follow their kernels' tensor split."""
+    return ShardingRules(rules=[
+        (r"layers/.*(q_proj|k_proj|v_proj|up_proj)/kernel$",
+         ("pipe", None, "tensor")),
+        (r"layers/.*(q_proj|k_proj|v_proj|up_proj)/bias$",
+         ("pipe", "tensor")),
+        (r"layers/.*(o_proj|down_proj)/kernel$", ("pipe", "tensor", None)),
+        (r"layers/.*(o_proj|down_proj)/bias$", ("pipe", None)),
+        (r"layers/.*(input_norm|post_norm)/(scale|bias)$", ("pipe", None)),
+        (r"embed_tokens/embedding$", ("tensor", "fsdp")),
+        (r"(pos|block_pos)_embed/embedding$", (None, "fsdp")),
+        (r"lm_head/kernel$", ("fsdp", "tensor")),
+        (r"(norm|ln|final_norm)[^/]*/(scale|bias)$", REPLICATED),
+        (r".*", FSDP_AUTO),
+    ])
+
+
+def glm_pp_rules() -> ShardingRules:
+    """GLM pipeline layout = NeoX's (same biased-projection family)."""
+    return neox_pp_rules()
+
+
 def moe_rules() -> ShardingRules:
     """Expert-parallel MoE: expert weight blocks sharded on the expert
     (data x fsdp) submesh; router replicated."""

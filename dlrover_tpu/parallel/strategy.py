@@ -26,10 +26,12 @@ from dlrover_tpu.parallel.sharding_rules import (
     ShardingRules,
     bert_rules,
     clip_rules,
+    glm_pp_rules,
     glm_rules,
     llama_pp_rules,
     llama_rules,
     moe_rules,
+    neox_pp_rules,
     neox_rules,
 )
 
@@ -41,7 +43,9 @@ RULE_SETS = {
     "bert": bert_rules,
     "clip": clip_rules,
     "neox": neox_rules,
+    "neox_pp": neox_pp_rules,
     "glm": glm_rules,
+    "glm_pp": glm_pp_rules,
 }
 
 

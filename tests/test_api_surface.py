@@ -11,7 +11,8 @@ SURFACE = {
     "dlrover_tpu.parallel.strategy": ["Strategy", "RULE_SETS"],
     "dlrover_tpu.parallel.mesh": ["MeshPlan"],
     "dlrover_tpu.parallel.planner": ["plan_mesh", "estimate",
-                                     "plan_stages", "ModelSpec"],
+                                     "plan_stages", "plan_stage_depths",
+                                     "ModelSpec"],
     "dlrover_tpu.parallel.aot": ["aot_compile_train_step"],
     "dlrover_tpu.parallel.auto_tune": ["dryrun", "search_strategy"],
     "dlrover_tpu.trainer.run": ["main"],

@@ -458,3 +458,24 @@ class TestUnevenStages:
             np.asarray(mask_vp),
             [[[1, 0], [1, 1]], [[1, 1], [1, 0]]],
         )
+
+    def test_outer_head_sharded_over_pipe(self):
+        """The post-pipeline final-norm/head must not replicate over the
+        pipe axis: with a pipe mesh in scope the logits carry "pipe" on
+        the batch dim (the replicated->sharded hop is a comm-free local
+        slice, and it cuts norm+head compute by the pipe degree)."""
+        config = llama.llama_tiny(num_layers=4)
+        params = llama.init(jax.random.PRNGKey(0), config)
+        ids = jnp.zeros((8, 16), jnp.int32)
+        mesh = MeshPlan(pipe=2, data=2, tensor=2).build()
+        with jax.sharding.set_mesh(mesh):
+            logits, _ = jax.jit(
+                lambda p, i: llama.apply_pipelined(
+                    p, i, config, num_stages=2, num_microbatches=2
+                )
+            )(params, ids)
+        spec = logits.sharding.spec
+        batch_spec = spec[0] if len(spec) else None
+        flat = (batch_spec if isinstance(batch_spec, tuple)
+                else (batch_spec,))
+        assert "pipe" in flat, f"head output not pipe-sharded: {spec}"

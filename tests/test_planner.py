@@ -293,3 +293,41 @@ class TestDevicePreloader:
     def test_invalid_prefetch(self):
         with pytest.raises(ValueError):
             DevicePreloader([], prefetch=0)
+
+
+class TestPlanStageDepths:
+    """plan_stage_depths bridges the stage-split DP to
+    Strategy.stage_depths (reference base_stage_planner.py:125)."""
+
+    def test_uniform_costs_balanced_split(self):
+        from dlrover_tpu.parallel.planner import plan_stage_depths
+
+        # 30 layers over 4 stages: ceil/floor split, max chunk 8
+        d = plan_stage_depths([1.0] * 30, num_stages=4)
+        assert sum(d) == 30 and len(d) == 4
+        assert max(d) == 8 and min(d) >= 7
+
+    def test_interleaved_chunks(self):
+        from dlrover_tpu.parallel.planner import plan_stage_depths
+
+        d = plan_stage_depths([1.0] * 6, num_stages=2, num_virtual=2)
+        assert len(d) == 4 and sum(d) == 6
+        assert max(d) == 2  # balanced: (2, 2, 1, 1) up to rotation
+
+    def test_heterogeneous_costs_shift_layers(self):
+        from dlrover_tpu.parallel.planner import plan_stage_depths
+
+        # one 4x-cost layer at the front: the DP gives its chunk fewer
+        # layers so the max chunk cost stays near the mean
+        costs = [4.0] + [1.0] * 7
+        d = plan_stage_depths(costs, num_stages=2)
+        assert sum(d) == 8
+        assert d[0] < d[1]  # expensive front chunk carries fewer layers
+
+    def test_feeds_strategy(self):
+        from dlrover_tpu.parallel.planner import plan_stage_depths
+        from dlrover_tpu.parallel.strategy import Strategy
+
+        d = plan_stage_depths([1.0] * 6, num_stages=2, num_virtual=2)
+        s = Strategy(rule_set="llama_pp", num_virtual=2, stage_depths=d)
+        assert Strategy.from_json(s.to_json()).stage_depths == d
