@@ -836,6 +836,9 @@ def recovery_result() -> dict:
                        proc=p1)
     if rec is None:
         p1.kill()
+        p1.wait()  # reap: a wedged host may retry many times
+        if not base:
+            shutil.rmtree(scratch, ignore_errors=True)
         # through _error_line so the artifact embeds last_good: a
         # wedged phase-1 must not erase the provenance chain either
         return _error_line(

@@ -44,7 +44,7 @@ from dlrover_tpu.models.common import (
 from dlrover_tpu.models.losses import masked_lm_loss
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
-from dlrover_tpu.ops.remat import apply_remat
+from dlrover_tpu.ops.remat import apply_remat, remat_enabled
 
 
 @dataclass(frozen=True)
@@ -397,6 +397,7 @@ def apply_pipelined(
     out_mb = dispatch_pipeline(
         stage_fn, params["layers"], state_mb,
         num_stages, num_virtual, stage_depths,
+        remat_stage=remat_enabled(c.remat_policy),
     )
     out_state = merge_microbatches(out_mb)
     x = out_state[0] if with_prefix else out_state

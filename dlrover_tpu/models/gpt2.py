@@ -10,7 +10,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, replace
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -21,7 +21,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
-from dlrover_tpu.ops.remat import apply_remat
+from dlrover_tpu.ops.remat import apply_remat, remat_enabled
 
 
 @dataclass(frozen=True)
@@ -96,16 +96,13 @@ def _layer_norm(x, scale, bias, eps):
     return ((xf - mean) * lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
 
 
-def apply(params: Dict, input_ids: jax.Array, config: GPT2Config,
-          rng: Optional[jax.Array] = None) -> jax.Array:
-    """Returns logits [B, S, V] (f32); LM head tied to token embedding."""
-    c = config
-    b, s = input_ids.shape
-    x = params["embed_tokens"]["embedding"][input_ids]
-    x = x + params["embed_pos"]["embedding"][:s][None]
-    x = x.astype(c.compute_dtype)
+def _block(c: GPT2Config):
+    """Scan body over stacked layer params; shared by the plain and the
+    pipelined forward so the two cannot drift (shapes read from the
+    running activation, which is the microbatch inside a pipeline)."""
 
-    def _block(x, layer):
+    def block(x, layer):
+        b, s = x.shape[0], x.shape[1]
         h = _layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"],
                         c.ln_eps)
         q = (h @ layer["q_proj"]["kernel"]).reshape(b, s, c.num_heads,
@@ -131,8 +128,70 @@ def apply(params: Dict, input_ids: jax.Array, config: GPT2Config,
         x = x + h @ layer["down_proj"]["kernel"] + layer["down_proj"]["bias"]
         return x, None
 
-    block = apply_remat(_block, c.remat_policy)
+    return block
+
+
+def apply(params: Dict, input_ids: jax.Array, config: GPT2Config,
+          rng: Optional[jax.Array] = None) -> jax.Array:
+    """Returns logits [B, S, V] (f32); LM head tied to token embedding."""
+    c = config
+    s = input_ids.shape[1]
+    x = params["embed_tokens"]["embedding"][input_ids]
+    x = x + params["embed_pos"]["embedding"][:s][None]
+    x = x.astype(c.compute_dtype)
+
+    block = apply_remat(_block(c), c.remat_policy)
     x, _ = lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                    c.ln_eps)
+    logits = x @ params["embed_tokens"]["embedding"].astype(
+        c.compute_dtype).T
+    return logits.astype(jnp.float32)
+
+
+def apply_pipelined(
+    params: Dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    num_stages: int,
+    num_microbatches: int,
+    num_virtual: int = 1,
+    stage_depths: Optional[Sequence[int]] = None,
+) -> jax.Array:
+    """Forward pass with the GPT-2 blocks as a GPipe / interleaved
+    pipeline over the "pipe" mesh axis, same formulation as the other
+    decoder families (``models.llama.apply_pipelined``): embed and the
+    tied final-norm/head stay outside in the surrounding GSPMD program
+    (the head spread over pipe). Use with the "gpt2_pp" rule set.
+    ``stage_depths``: uneven per-chunk layer counts in visit order."""
+    from dlrover_tpu.parallel.pipeline import (
+        dispatch_pipeline,
+        masked_layer_scan,
+        merge_microbatches,
+        pipe_batch_constraint,
+        split_microbatches,
+    )
+
+    c = config
+    s = input_ids.shape[1]
+    x = params["embed_tokens"]["embedding"][input_ids]
+    x = x + params["embed_pos"]["embedding"][:s][None]
+    x = x.astype(c.compute_dtype)
+
+    def stage_fn(chunk_and_mask, x):
+        layers_chunk, mask = chunk_and_mask
+        block = apply_remat(_block(c), c.remat_policy)
+        return masked_layer_scan(block, x, layers_chunk, mask)
+
+    x_mb = split_microbatches(x, num_microbatches)
+    out_mb = dispatch_pipeline(
+        stage_fn, params["layers"], x_mb,
+        num_stages, num_virtual, stage_depths,
+        remat_stage=remat_enabled(c.remat_policy),
+    )
+    x = merge_microbatches(out_mb)
+
+    x = pipe_batch_constraint(x)
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
                     c.ln_eps)
     logits = x @ params["embed_tokens"]["embedding"].astype(

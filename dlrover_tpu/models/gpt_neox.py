@@ -39,7 +39,7 @@ from dlrover_tpu.models.common import (
 from dlrover_tpu.models.losses import masked_lm_loss
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
-from dlrover_tpu.ops.remat import apply_remat
+from dlrover_tpu.ops.remat import apply_remat, remat_enabled
 
 
 @dataclass(frozen=True)
@@ -313,6 +313,7 @@ def apply_pipelined(
     out_mb = dispatch_pipeline(
         stage_fn, params["layers"], x_mb,
         num_stages, num_virtual, stage_depths,
+        remat_stage=remat_enabled(c.remat_policy),
     )
     x = merge_microbatches(out_mb)
 

@@ -41,7 +41,7 @@ from dlrover_tpu.models.losses import chunked_lm_head_loss, masked_lm_loss
 from dlrover_tpu.ops import moe as moe_ops
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
-from dlrover_tpu.ops.remat import apply_remat
+from dlrover_tpu.ops.remat import apply_remat, remat_enabled
 from dlrover_tpu.ops.ring_attention import (
     ring_attention,
     ring_attention_local,
@@ -475,6 +475,7 @@ def apply_pipelined(
     out_mb, aux_out = dispatch_pipeline(
         stage_fn, params["layers"], (x_mb, aux_mb),
         num_stages, num_virtual, stage_depths,
+        remat_stage=remat_enabled(c.remat_policy),
     )
     x = merge_microbatches(out_mb)
     aux = jnp.sum(aux_out)

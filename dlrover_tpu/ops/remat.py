@@ -12,6 +12,14 @@ from typing import Callable, Optional
 
 import jax
 
+def remat_enabled(policy) -> bool:
+    """Single source of truth for 'does this policy value mean remat':
+    shared by ``apply_remat`` and the models' pipeline ``remat_stage``
+    plumbing so the per-layer and stage-boundary layers cannot disagree
+    (e.g. on a falsy ``None`` policy)."""
+    return bool(policy) and policy != "none"
+
+
 def apply_remat(fn: Callable, policy: str = "dots_saveable",
                 prevent_cse: bool = True) -> Callable:
     """Wrap a block function with a remat policy.
@@ -22,7 +30,7 @@ def apply_remat(fn: Callable, policy: str = "dots_saveable",
     outputs, recompute elementwise — the usual TPU sweet spot),
     "nothing_saveable", "dots_with_no_batch_dims_saveable", ...
     """
-    if not policy or policy == "none":
+    if not remat_enabled(policy):
         return fn
     if policy == "full":
         return jax.checkpoint(fn, prevent_cse=prevent_cse)

@@ -168,6 +168,7 @@ def aot_compile_train_step(
     ring: bool = False,
     head_chunk: int = 0,
     packed_doc_len: int = 0,
+    pipeline: Optional[dict] = None,
 ) -> AotReport:
     """Compile the full accelerate() train step for ``config`` against a
     deviceless TPU topology; assert HBM fit via memory_analysis.
@@ -178,6 +179,12 @@ def aot_compile_train_step(
     ``ring``: run ring attention over the plan's "seq" axis (requires an
     explicit ``mesh_plan`` with seq > 1) — proves the flash-fused
     long-context multi-chip path lowers and fits at scale, hermetically.
+
+    ``pipeline``: {"num_stages", "num_microbatches", "num_virtual"?,
+    "stage_depths"?} — run the decoder through ``apply_pipelined``
+    (GPipe / circular interleaved, optionally uneven per-chunk layer
+    counts) instead of the plain forward; pair with the "llama_pp"
+    rule set and a mesh_plan with pipe > 1.
     """
     import time
 
@@ -259,10 +266,36 @@ def aot_compile_train_step(
         batch["segment_ids"] = jnp.asarray(seg)
         batch["labels"] = jnp.asarray(
             np.where(same_next, ids[:, 1:], -100))
+    if pipeline:
+        from dlrover_tpu.models.losses import masked_lm_loss
+
+        def loss_fn(params, batch, rng):
+            logits, moe_aux = llama.apply_pipelined(
+                params, batch["input_ids"], config,
+                num_stages=pipeline["num_stages"],
+                num_microbatches=pipeline["num_microbatches"],
+                rng=rng,
+                num_virtual=pipeline.get("num_virtual", 1),
+                stage_depths=pipeline.get("stage_depths"),
+            )
+            loss = masked_lm_loss(logits, batch["labels"])
+            if config.num_experts > 0:
+                # apply_pipelined sums the (token-count-invariant)
+                # load-balance aux over MICROBATCHES as well as layers;
+                # divide by both so the regularizer weight matches the
+                # unpipelined make_loss_fn path exactly
+                loss = loss + config.moe_aux_weight * moe_aux / (
+                    max(1, config.num_layers)
+                    * pipeline["num_microbatches"]
+                )
+            return loss, {}
+    else:
+        loss_fn = llama.make_loss_fn(config, head_chunk=head_chunk)
+
     def compile_plan(plan):
         result = accelerate(
             llama.make_init_fn(config),
-            llama.make_loss_fn(config, head_chunk=head_chunk),
+            loss_fn,
             optax.adafactor(1e-3),
             batch,
             strategy=Strategy(
@@ -405,6 +438,20 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--packed-doc-len", type=int, default=0,
                    help="pack N-token documents per row (segmented "
                         "fused-mask kernel; composes with --ring)")
+    p.add_argument("--pipe-stages", type=int, default=0,
+                   help="run the decoder as a pipeline with N stages "
+                        "(rule_set=llama_pp; requires --mesh with "
+                        "pipe=N)")
+    p.add_argument("--pipe-microbatches", type=int, default=0,
+                   help="microbatches for the pipeline schedule "
+                        "(default: 2*stages)")
+    p.add_argument("--pipe-virtual", type=int, default=1,
+                   help="virtual stages per physical stage (V>1 = the "
+                        "circular interleaved schedule)")
+    p.add_argument("--pipe-depths", default="",
+                   help="comma-separated per-chunk layer counts in "
+                        "visit order (uneven stage split; default "
+                        "even)")
     args = p.parse_args(argv)
 
     jax.config.update("jax_platforms", "cpu")  # AOT needs no devices
@@ -446,6 +493,42 @@ def main(argv: Optional[list] = None) -> int:
         })
     if args.ring and mesh_plan is None:
         p.error("--ring requires --mesh with a seq>1 axis")
+    pipeline = None
+    if args.pipe_stages:
+        if mesh_plan is None:
+            p.error("--pipe-stages requires --mesh with a pipe axis "
+                    "matching the stage count")
+        pipe_size = dict(mesh_plan.axis_sizes()).get("pipe", 1)
+        if pipe_size != args.pipe_stages:
+            # a mismatched (or absent) pipe axis would silently compile
+            # an artifact whose stage dim never lands on "pipe" — the
+            # same hard validation the ring path applies to "seq"
+            p.error(f"--pipe-stages {args.pipe_stages} needs --mesh "
+                    f"with pipe={args.pipe_stages} (got pipe="
+                    f"{pipe_size})")
+        if args.packed_doc_len:
+            p.error("--packed-doc-len does not compose with "
+                    "--pipe-stages: apply_pipelined has no segment_ids "
+                    "path (packed batches ride the unpipelined apply)")
+        if args.head_chunk:
+            p.error("--head-chunk does not compose with --pipe-stages: "
+                    "the pipelined loss materializes full logits "
+                    "(pipe-sharded over the batch dim instead)")
+        pipeline = {
+            "num_stages": args.pipe_stages,
+            "num_microbatches": (args.pipe_microbatches
+                                 or 2 * args.pipe_stages),
+            "num_virtual": args.pipe_virtual,
+        }
+        if args.pipe_depths:
+            pipeline["stage_depths"] = tuple(
+                int(d) for d in args.pipe_depths.split(",")
+            )
+    # llama_pp carries BOTH the pipe-leading layer rules and the expert
+    # submesh rules, so MoE+PP must resolve to it — "moe" has no pipe
+    # entry and would compile stage params off the pipe axis silently
+    rule_set = ("llama_pp" if pipeline
+                else ("moe" if args.experts else "llama"))
     report = aot_compile_train_step(
         config,
         topology=args.topology,
@@ -453,11 +536,12 @@ def main(argv: Optional[list] = None) -> int:
         global_batch=args.batch,
         mesh_plan=mesh_plan,
         model_name=args.model + (f"+moe{args.experts}" if args.experts
-                                 else ""),
-        rule_set="moe" if args.experts else "llama",
+                                 else "") + ("+pp" if pipeline else ""),
+        rule_set=rule_set,
         ring=args.ring,
         head_chunk=args.head_chunk,
         packed_doc_len=args.packed_doc_len,
+        pipeline=pipeline,
     )
     print(report.to_json())
     return 0 if report.fits else 1

@@ -118,12 +118,18 @@ def pipeline_apply(
     axis_name: str = "pipe",
     batch_axes: Optional[Tuple] = ("data", "fsdp"),
     constrain: bool = True,
+    remat_stage: bool = False,
 ) -> PyTree:
     """Run M microbatches through P homogeneous stages; returns outputs
     with the same [M, ...] layout as ``x_mb``.
 
     ``stage_fn`` sees one stage's params (dim 0 of ``stage_params``
     stripped by vmap) and one microbatch-shaped ``state``.
+
+    ``remat_stage``: checkpoint each stage application so the tick
+    scan's backward stores one stage-boundary state per tick instead
+    of every inner layer-scan carry. The stage params here are a scan
+    constant, so the checkpoint's saved inputs do not stack per tick.
     """
     stage_leaves = jax.tree.leaves(stage_params)
     if not stage_leaves:
@@ -144,6 +150,8 @@ def pipeline_apply(
     num_mb = x_leaves[0].shape[0]
     num_ticks = num_mb + num_stages - 1
 
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
     vstage = jax.vmap(stage_fn)
 
     def maybe_constrain(tree):
@@ -293,6 +301,7 @@ def dispatch_pipeline(
     num_stages: int,
     num_virtual: int = 1,
     stage_depths=None,
+    remat_stage: bool = False,
 ) -> PyTree:
     """Shared stacking + schedule dispatch for model ``apply_pipelined``
     implementations: picks gpipe vs interleaved vs their uneven-depth
@@ -301,14 +310,28 @@ def dispatch_pipeline(
     ``mask=None`` on the even paths (None is an empty pytree, so vmap
     passes it through untouched); with a mask it must skip masked slots
     (carry the state through where mask == 0, e.g. via
-    ``masked_layer_scan``)."""
+    ``masked_layer_scan``).
+
+    ``remat_stage``: checkpoint each stage application so the tick
+    scan's backward saves only STAGE-BOUNDARY activations (one state
+    per tick), not every inner layer-scan carry — without it a deep
+    stage saves ticks x layers-per-stage residuals, which at 70B scale
+    is tens of GB per device and OOMs where plain PP activation math
+    (microbatches x stage boundaries) fits comfortably. The checkpoint
+    is applied INSIDE the schedules (around the round-selection in the
+    interleaved case) so the saved inputs are the loop-INVARIANT
+    params plus the per-tick state — wrapping the stage fn itself
+    would stack the dynamically-selected param chunk per tick, ~20 GB
+    of param copies at 70B. The model's per-layer remat policy still
+    shapes the recompute inside the stage."""
     if stage_depths is not None:
         if num_virtual > 1:
             stage_params = stack_stages_interleaved_uneven(
                 layer_params, num_stages, num_virtual, stage_depths
             )
             return pipeline_apply_interleaved(
-                stage_fn, stage_params, state_mb
+                stage_fn, stage_params, state_mb,
+                remat_stage=remat_stage,
             )
         if len(stage_depths) != num_stages:
             raise ValueError(
@@ -316,14 +339,17 @@ def dispatch_pipeline(
                 f"for {num_stages} stages"
             )
         stage_params = stack_stages_uneven(layer_params, stage_depths)
-        return pipeline_apply(stage_fn, stage_params, state_mb)
+        return pipeline_apply(stage_fn, stage_params, state_mb,
+                              remat_stage=remat_stage)
     if num_virtual > 1:
         stage_params = (stack_stages_interleaved(
             layer_params, num_stages, num_virtual
         ), None)
-        return pipeline_apply_interleaved(stage_fn, stage_params, state_mb)
+        return pipeline_apply_interleaved(stage_fn, stage_params, state_mb,
+                                          remat_stage=remat_stage)
     stage_params = (stack_stages(layer_params, num_stages), None)
-    return pipeline_apply(stage_fn, stage_params, state_mb)
+    return pipeline_apply(stage_fn, stage_params, state_mb,
+                          remat_stage=remat_stage)
 
 
 def masked_layer_scan(
@@ -384,6 +410,7 @@ def pipeline_apply_interleaved(
     axis_name: str = "pipe",
     batch_axes: Optional[Tuple] = ("data", "fsdp"),
     constrain: bool = True,
+    remat_stage: bool = False,
 ) -> PyTree:
     """Circular (interleaved virtual stage) schedule.
 
@@ -436,6 +463,14 @@ def pipeline_apply_interleaved(
             params_v,
         )
         return stage_fn(chunk, state)
+
+    if remat_stage:
+        # checkpoint OUTSIDE the round selection: the saved inputs are
+        # then the loop-invariant [V, ...] params (a scan constant, not
+        # stacked per tick) + the scalar round + the per-tick state —
+        # checkpointing stage_fn itself would stack the dynamically
+        # selected param chunk for every tick (~20 GB at 70B)
+        chunk_select = jax.checkpoint(chunk_select)
 
     # vmap over stages: params [V, P, ...] -> per-stage [V, ...]
     vstage = jax.vmap(chunk_select, in_axes=(1, 0, 0))

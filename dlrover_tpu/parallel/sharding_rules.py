@@ -284,6 +284,24 @@ def glm_pp_rules() -> ShardingRules:
     return neox_pp_rules()
 
 
+def gpt2_pp_rules() -> ShardingRules:
+    """Pipeline-parallel GPT-2: stacked layer dim on "pipe", Megatron
+    column/row split on "tensor"; the tied embed/head table and learned
+    positions stay outside the pipe (fsdp/tensor sharded)."""
+    return ShardingRules(rules=[
+        (r"layers/.*(q_proj|k_proj|v_proj|up_proj)/kernel$",
+         ("pipe", None, "tensor")),
+        (r"layers/.*up_proj/bias$", ("pipe", "tensor")),
+        (r"layers/.*(o_proj|down_proj)/kernel$", ("pipe", "tensor", None)),
+        (r"layers/.*down_proj/bias$", ("pipe", None)),
+        (r"layers/.*(ln_1|ln_2)/(scale|bias)$", ("pipe", None)),
+        (r"embed_tokens/embedding$", ("tensor", "fsdp")),
+        (r"embed_pos/embedding$", (None, "fsdp")),
+        (r"(norm|ln)[^/]*/(scale|bias)$", REPLICATED),
+        (r".*", FSDP_AUTO),
+    ])
+
+
 def moe_rules() -> ShardingRules:
     """Expert-parallel MoE: expert weight blocks sharded on the expert
     (data x fsdp) submesh; router replicated."""

@@ -28,6 +28,7 @@ from dlrover_tpu.parallel.sharding_rules import (
     clip_rules,
     glm_pp_rules,
     glm_rules,
+    gpt2_pp_rules,
     llama_pp_rules,
     llama_rules,
     moe_rules,
@@ -46,6 +47,7 @@ RULE_SETS = {
     "neox_pp": neox_pp_rules,
     "glm": glm_rules,
     "glm_pp": glm_pp_rules,
+    "gpt2_pp": gpt2_pp_rules,
 }
 
 

@@ -122,3 +122,28 @@ def test_smoke_packed_preset():
     assert proc.returncode == 0, proc.stderr[-2000:]
     rec = _tail_json(proc)
     assert rec["value"] > 0 and "error" not in rec
+
+
+def test_phase1_wedge_preserves_last_good():
+    """A phase-1 recovery worker that never reaches a committed
+    checkpoint (the observed mid-session tunnel wedge: device client up,
+    first compile never returns) must produce an error artifact that
+    still embeds the last committed MTTR — the in-function error
+    returns go through _error_line like every other failure path."""
+    import bench
+
+    env_keys = {"BENCH_PLATFORM": "cpu", "BENCH_RECOVERY_TIMEOUT": "2"}
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+    try:
+        rec = bench.recovery_result()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rec["metric"] == "recovery_mttr_s"
+    assert rec["value"] == 0.0 and rec["error"]
+    assert 0 < rec["last_good"]["value"] < float("inf"), rec
+    assert rec["last_good"]["commit"], rec
