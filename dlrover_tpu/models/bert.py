@@ -13,7 +13,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, replace
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +30,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from dlrover_tpu.ops.attention_ref import mha_reference
 from dlrover_tpu.ops.flash_attention import flash_attention_auto
-from dlrover_tpu.ops.remat import apply_remat
+from dlrover_tpu.ops.remat import apply_remat, remat_enabled
 
 
 @dataclass(frozen=True)
@@ -186,6 +186,81 @@ def apply(
     block = apply_remat(_encoder_block(c, attention_mask), c.remat_policy)
     x, _ = lax.scan(block, x, params["layers"])
 
+    pooled = jnp.tanh(
+        x[:, 0, :] @ params["pooler"]["kernel"] + params["pooler"]["bias"]
+    )
+    return x, pooled
+
+
+def apply_pipelined(
+    params: Dict,
+    input_ids: jax.Array,
+    config: BertConfig,
+    num_stages: int,
+    num_microbatches: int,
+    token_type_ids: Optional[jax.Array] = None,
+    attention_mask: Optional[jax.Array] = None,
+    num_virtual: int = 1,
+    stage_depths: Optional[Sequence[int]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Encoder blocks as a GPipe / interleaved pipeline over the "pipe"
+    mesh axis — the same formulation as the decoder families
+    (``models.llama.apply_pipelined``). The per-example attention mask
+    rides the pipeline state beside its microbatch (like GLM's
+    prefix-LM context); embeddings, pooler and the MLM head stay
+    outside, the post-pipeline compute spread over pipe. Use with the
+    "bert_pp" rule set. ``stage_depths``: uneven per-chunk layer
+    counts in visit order."""
+    from dlrover_tpu.parallel.pipeline import (
+        dispatch_pipeline,
+        masked_layer_scan,
+        merge_microbatches,
+        pipe_batch_constraint,
+        split_microbatches,
+    )
+
+    c = config
+    s = input_ids.shape[1]
+    emb = params["embeddings"]
+    x = emb["word"]["embedding"][input_ids]
+    x = x + emb["position"]["embedding"][None, :s, :]
+    types = token_type_ids if token_type_ids is not None else (
+        jnp.zeros_like(input_ids)
+    )
+    x = x + emb["token_type"]["embedding"][types]
+    x = _layer_norm(x, emb["norm"]["scale"], emb["norm"]["bias"],
+                    c.layer_norm_eps).astype(c.compute_dtype)
+
+    with_mask = attention_mask is not None
+
+    def run_chunk(layers_chunk, x, mask, slot_mask):
+        block = apply_remat(_encoder_block(c, mask), c.remat_policy)
+        return masked_layer_scan(block, x, layers_chunk, slot_mask)
+
+    if with_mask:
+        state = (x, attention_mask)
+
+        def stage_fn(chunk_and_mask, st):
+            layers_chunk, slot_mask = chunk_and_mask
+            x, mask = st
+            return (run_chunk(layers_chunk, x, mask, slot_mask), mask)
+    else:
+        state = x
+
+        def stage_fn(chunk_and_mask, x):
+            layers_chunk, slot_mask = chunk_and_mask
+            return run_chunk(layers_chunk, x, None, slot_mask)
+
+    state_mb = split_microbatches(state, num_microbatches)
+    out_mb = dispatch_pipeline(
+        stage_fn, params["layers"], state_mb,
+        num_stages, num_virtual, stage_depths,
+        remat_stage=remat_enabled(c.remat_policy),
+    )
+    out_state = merge_microbatches(out_mb)
+    x = out_state[0] if with_mask else out_state
+
+    x = pipe_batch_constraint(x)
     pooled = jnp.tanh(
         x[:, 0, :] @ params["pooler"]["kernel"] + params["pooler"]["bias"]
     )

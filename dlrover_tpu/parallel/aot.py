@@ -363,8 +363,16 @@ def aot_compile_train_step(
     # physical — the round-2 artifact claimed 1.31 from an uncalibrated
     # compute term).
     costs = compiled.cost_analysis() or {}
+    pipe_kwargs = {}
+    if pipeline:
+        pipe_kwargs = dict(
+            pipe_microbatches=pipeline["num_microbatches"],
+            pipe_virtual=pipeline.get("num_virtual", 1),
+            stage_depths=pipeline.get("stage_depths"),
+        )
     score = planner.estimate(mesh_plan, model, device_spec,
-                             remat_policy=effective_remat)
+                             remat_policy=effective_remat,
+                             **pipe_kwargs)
     flops = max(float(costs.get("flops", 0.0)) * n,
                 score.breakdown["exec_flops"])
     step_time = score.step_time_s

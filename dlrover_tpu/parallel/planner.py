@@ -210,6 +210,9 @@ def estimate(
     device: DeviceSpec = DeviceSpec(),
     remat_policy: str = "",
     efficiency: Optional[float] = None,
+    pipe_microbatches: int = 0,
+    pipe_virtual: int = 1,
+    stage_depths=None,
 ) -> PlanScore:
     """Analytic step-time + memory estimate for one mesh factorization.
 
@@ -241,16 +244,32 @@ def estimate(
     # ---- compute (executed flops at calibrated efficiency)
     flops = _flops_per_step(model)
     recompute = REMAT_RECOMPUTE.get(remat_policy or "", 1.0)
+    if pipe > 1 and recompute > 1.0:
+        # pipelined stages run under STAGE-BOUNDARY remat (the tick
+        # scan stores only one state per tick; dispatch_pipeline's
+        # remat_stage): the backward replays each stage's forward, so
+        # executed FLOPs are at least the save-nothing factor (8/6 =
+        # fwd + fwd-replay + bwd over fwd + bwd) regardless of how
+        # much the inner per-layer policy saves during the replay
+        recompute = max(recompute, REMAT_RECOMPUTE["full"])
     eff = min(
         efficiency if efficiency is not None else calibrated_efficiency(),
         MAX_EFFICIENCY,
     )
     exec_flops = flops * recompute
     compute_s = exec_flops / (n_chips * device.flops_per_s * eff)
-    # GPipe bubble with M = max(2*pipe, 4) microbatches
     if pipe > 1:
-        microbatches = max(2 * pipe, 4)
-        compute_s *= 1.0 + (pipe - 1) / microbatches
+        # circular interleaved bubble (P-1)/(V*M+P-1); V=1 reduces to
+        # the GPipe factor (M+P-1)/M this branch always modeled
+        microbatches = pipe_microbatches or max(2 * pipe, 4)
+        v = max(pipe_virtual, 1)
+        compute_s *= 1.0 + (pipe - 1) / (v * microbatches)
+        if stage_depths:
+            # uneven split: every tick runs max(depths) padded layer
+            # slots per chunk — the slots beyond L/(V*P) are idle-time
+            # overhead on the light stages (pipeline.stack_stages_uneven)
+            d = tuple(stage_depths)
+            compute_s *= (v * pipe * max(d)) / max(1, sum(d))
 
     # ---- per-chip batch rows (data-ish axes shard the batch)
     rows = model.global_batch / max(data * fsdp, 1)
@@ -287,8 +306,12 @@ def estimate(
     # axis: on multi-slice topologies this rides DCN, not ICI.
     pipe_comm_s = 0.0
     if pipe > 1:
+        # the circular schedule wraps each microbatch around the ring
+        # V times, so every stage link carries V x the activation
+        # traffic of the plain GPipe schedule
         pipe_comm_s = (
-            2 * act_elems * model.dtype_bytes / device.dcn_bw
+            2 * max(pipe_virtual, 1) * act_elems * model.dtype_bytes
+            / device.dcn_bw
         )
 
     # ---- ring attention (seq axis): K/V circulate once per layer; GQA

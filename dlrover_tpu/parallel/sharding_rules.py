@@ -226,6 +226,27 @@ def bert_rules() -> ShardingRules:
     ])
 
 
+def bert_pp_rules() -> ShardingRules:
+    """Pipeline-parallel BERT: stacked layer dim on "pipe" (layer bias
+    vectors and the o/down biases included); embeddings, pooler and the
+    MLM head stay outside the pipe."""
+    return ShardingRules(rules=[
+        (r"layers/.*(q_proj|k_proj|v_proj|up_proj)/kernel$",
+         ("pipe", None, "tensor")),
+        (r"layers/.*(q_proj|k_proj|v_proj|up_proj)/bias$",
+         ("pipe", "tensor")),
+        (r"layers/.*(o_proj|down_proj)/kernel$", ("pipe", "tensor", None)),
+        (r"layers/.*(o_proj|down_proj)/bias$", ("pipe", None)),
+        (r"layers/.*(attn_norm|ffn_norm)/(scale|bias)$", ("pipe", None)),
+        (r"embeddings/word/embedding$", ("tensor", "fsdp")),
+        (r"embeddings/(position|token_type)/embedding$", (None, "fsdp")),
+        (r"mlm_head/kernel$", ("fsdp", "tensor")),
+        (r"mlm_head/bias$", ("tensor",)),
+        (r"(norm|ln)[^/]*/(scale|bias)$", REPLICATED),
+        (r".*", FSDP_AUTO),
+    ])
+
+
 def clip_rules() -> ShardingRules:
     """CLIP dual encoder: both towers' stacked blocks reuse the llama
     TP/FSDP layout (paths are nested under text/ and vision/)."""

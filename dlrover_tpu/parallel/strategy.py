@@ -24,6 +24,7 @@ from typing import Optional, Tuple
 from dlrover_tpu.parallel.mesh import MeshPlan
 from dlrover_tpu.parallel.sharding_rules import (
     ShardingRules,
+    bert_pp_rules,
     bert_rules,
     clip_rules,
     glm_pp_rules,
@@ -42,6 +43,7 @@ RULE_SETS = {
     "llama_pp": llama_pp_rules,
     "moe": moe_rules,
     "bert": bert_rules,
+    "bert_pp": bert_pp_rules,
     "clip": clip_rules,
     "neox": neox_rules,
     "neox_pp": neox_pp_rules,

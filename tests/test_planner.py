@@ -331,3 +331,68 @@ class TestPlanStageDepths:
         d = plan_stage_depths([1.0] * 6, num_stages=2, num_virtual=2)
         s = Strategy(rule_set="llama_pp", num_virtual=2, stage_depths=d)
         assert Strategy.from_json(s.to_json()).stage_depths == d
+
+
+class TestPipeEstimateRefinements:
+    """The pipeline compute model prices the circular schedule, uneven
+    slot overhead, and the stage-boundary remat floor."""
+
+    def _spec(self):
+        from dlrover_tpu.models import llama
+        from dlrover_tpu.parallel import planner
+
+        cfg = llama.llama3_70b()
+        return (planner.model_spec_from_llama(cfg, 32),
+                planner.TPU_SPECS["v5p"])
+
+    def test_interleaving_shrinks_bubble(self):
+        from dlrover_tpu.parallel import planner
+        from dlrover_tpu.parallel.mesh import MeshPlan
+
+        m, spec = self._spec()
+        plan = MeshPlan(pipe=4, data=4, tensor=4)
+        v1 = planner.estimate(plan, m, spec, remat_policy="dots_saveable",
+                              pipe_microbatches=8, pipe_virtual=1)
+        v2 = planner.estimate(plan, m, spec, remat_policy="dots_saveable",
+                              pipe_microbatches=8, pipe_virtual=2)
+        assert v2.step_time_s < v1.step_time_s
+
+    def test_uneven_depths_cost_slot_overhead(self):
+        from dlrover_tpu.parallel import planner
+        from dlrover_tpu.parallel.mesh import MeshPlan
+
+        m, spec = self._spec()
+        plan = MeshPlan(pipe=4, data=4, tensor=4)
+        even = planner.estimate(plan, m, spec,
+                                remat_policy="dots_saveable",
+                                pipe_microbatches=8, pipe_virtual=2)
+        uneven = planner.estimate(
+            plan, m, spec, remat_policy="dots_saveable",
+            pipe_microbatches=8, pipe_virtual=2,
+            stage_depths=(9, 11, 11, 9, 9, 11, 11, 9),
+        )
+        # 8 chunks x Lmax 11 slots over 80 real layers = 1.10x compute
+        ratio = uneven.step_time_s / even.step_time_s
+        assert 1.05 < ratio < 1.15, ratio
+
+    def test_pipelined_remat_floors_at_save_nothing(self):
+        from dlrover_tpu.parallel import planner
+        from dlrover_tpu.parallel.mesh import MeshPlan
+
+        m, spec = self._spec()
+        pp = MeshPlan(pipe=4, data=4, tensor=4)
+        flat = MeshPlan(data=4, fsdp=4, tensor=4)
+        pp_score = planner.estimate(pp, m, spec,
+                                    remat_policy="dots_saveable")
+        flat_score = planner.estimate(flat, m, spec,
+                                      remat_policy="dots_saveable")
+        full = planner.REMAT_RECOMPUTE["full"]
+        saveable = planner.REMAT_RECOMPUTE["dots_saveable"]
+        assert pp_score.breakdown["exec_flops"] == pytest.approx(
+            flat_score.breakdown["exec_flops"] * full / saveable
+        )
+        # no remat -> no stage replay, no floor
+        none_pp = planner.estimate(pp, m, spec, remat_policy="none")
+        assert none_pp.breakdown["exec_flops"] == pytest.approx(
+            flat_score.breakdown["exec_flops"] / saveable
+        )
