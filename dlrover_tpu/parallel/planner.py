@@ -243,8 +243,10 @@ def estimate(
 
     # ---- compute (executed flops at calibrated efficiency)
     flops = _flops_per_step(model)
+    from dlrover_tpu.ops.remat import remat_enabled
+
     recompute = REMAT_RECOMPUTE.get(remat_policy or "", 1.0)
-    if pipe > 1 and recompute > 1.0:
+    if pipe > 1 and remat_enabled(remat_policy):
         # pipelined stages run under STAGE-BOUNDARY remat (the tick
         # scan stores only one state per tick; dispatch_pipeline's
         # remat_stage): the backward replays each stage's forward, so
