@@ -48,6 +48,9 @@ def main():
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=0, help="0 = preset default")
+    p.add_argument("--layers", type=int, default=0,
+                   help="override the preset's layer count (e.g. 6 for "
+                        "an uneven --pipe 2 --pipe_virtual 2 demo)")
     p.add_argument("--ckpt_dir", default="")
     p.add_argument("--moe_experts", type=int, default=0)
     p.add_argument("--ring", type=int, default=0,
@@ -55,28 +58,53 @@ def main():
                         "adds a 'seq' mesh axis and runs ring "
                         "attention, e.g. --ring 2 --seq 512 on the "
                         "8-device CPU mesh")
+    p.add_argument("--pipe", type=int, default=0,
+                   help="pipeline stages: adds a 'pipe' mesh axis and "
+                        "runs the decoder as a GPipe/interleaved "
+                        "pipeline, e.g. --pipe 2 on the 8-device mesh. "
+                        "NB: the pipelined MoE loss does not surface "
+                        "the per-step load-balance metrics the plain "
+                        "path reports (apply_pipelined has no metrics "
+                        "output)")
+    p.add_argument("--pipe_virtual", type=int, default=1,
+                   help="virtual stages per physical stage (V>1 = "
+                        "circular interleaved schedule)")
+    p.add_argument("--pipe_depths", default="",
+                   help="comma-separated per-chunk layer counts in "
+                        "visit order (uneven stage split; default: "
+                        "planner-balanced via plan_stage_depths)")
     args = p.parse_args()
+    if args.pipe and args.ring:
+        p.error("--pipe and --ring compose via a custom Strategy; this "
+                "example drives one at a time")
+    if args.pipe_virtual < 1:
+        p.error(f"--pipe_virtual must be >= 1 (got {args.pipe_virtual})")
 
+    layer_kw = {"num_layers": args.layers} if args.layers else {}
     if args.preset == "tiny":
-        config = llama.llama_tiny(num_experts=args.moe_experts)
+        config = llama.llama_tiny(num_experts=args.moe_experts,
+                                  **layer_kw)
         seq = args.seq or 128
     elif args.preset == "1b":
         config = llama.llama2_7b(
-            hidden_size=2048, intermediate_size=5504, num_layers=16,
+            hidden_size=2048, intermediate_size=5504,
             num_heads=16, num_kv_heads=16,
             param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16,
             num_experts=args.moe_experts,
+            num_layers=args.layers or 16,
         )
         seq = args.seq or 2048
     else:
-        config = llama.llama2_7b(num_experts=args.moe_experts)
+        config = llama.llama2_7b(num_experts=args.moe_experts,
+                                 **layer_kw)
         seq = args.seq or 4096
 
     n = jax.device_count()
     ring = max(1, args.ring)
-    # fsdp only when devices remain after the ring axis takes its share
-    fsdp = 2 if n >= 4 * ring else 1
-    plan = MeshPlan(data=-1, fsdp=fsdp, seq=ring)
+    pipe = max(1, args.pipe)
+    # fsdp only when devices remain after the ring/pipe axes take theirs
+    fsdp = 2 if n >= 4 * ring * pipe else 1
+    plan = MeshPlan(data=-1, fsdp=fsdp, seq=ring, pipe=pipe)
     if ring > 1:
         # long context: the model runs ring attention over the "seq"
         # axis. Only the AXIS NAME goes on the config — the mesh itself
@@ -86,15 +114,54 @@ def main():
         from dataclasses import replace
 
         config = replace(config, seq_axis="seq")
+    stage_depths = None
+    if pipe > 1:
+        if args.pipe_depths:
+            stage_depths = tuple(
+                int(d) for d in args.pipe_depths.split(",")
+            )
+        elif config.num_layers % (args.pipe_virtual * pipe):
+            # indivisible layer count: planner-balanced uneven split
+            from dlrover_tpu.parallel.planner import plan_stage_depths
+
+            stage_depths = plan_stage_depths(
+                [1.0] * config.num_layers, pipe, args.pipe_virtual
+            )
     strategy = Strategy(
         mesh=plan,
-        rule_set="moe" if args.moe_experts else "llama",
+        # llama_pp carries both the pipe-leading layer rules and the
+        # expert submesh rules, so pipelined MoE resolves to it too
+        rule_set=("llama_pp" if pipe > 1
+                  else ("moe" if args.moe_experts else "llama")),
         remat_policy="",  # the model remats per layer internally
+        num_virtual=args.pipe_virtual,
+        stage_depths=stage_depths,
     )
+    if pipe > 1:
+        from dlrover_tpu.models.losses import masked_lm_loss
+
+        num_mb = 2 * pipe
+
+        def loss_fn(params, batch, rng):
+            logits, aux = llama.apply_pipelined(
+                params, batch["input_ids"], config,
+                num_stages=pipe, num_microbatches=num_mb, rng=rng,
+                num_virtual=strategy.num_virtual,
+                stage_depths=strategy.stage_depths,
+            )
+            loss = masked_lm_loss(logits, batch["labels"])
+            if config.num_experts > 0:
+                # aux sums over microbatches as well as layers
+                loss = loss + config.moe_aux_weight * aux / (
+                    max(1, config.num_layers) * num_mb
+                )
+            return loss, {}
+    else:
+        loss_fn = llama.make_loss_fn(config)
     batches = synthetic_batches(config.vocab_size, args.batch, seq)
     trainer = ElasticTrainer(
         llama.make_init_fn(config),
-        llama.make_loss_fn(config),
+        loss_fn,
         optax.adamw(3e-4, weight_decay=0.1),
         next(batches()),
         strategy=strategy,
