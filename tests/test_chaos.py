@@ -403,14 +403,11 @@ def test_explicit_step_restore_still_raises_on_corruption(tmp_path):
     mgr.close()
 
 
-@pytest.mark.slow
-def test_preemption_grace_saves_at_killed_step(tmp_path):
-    """SIGTERM mid-training with NO periodic checkpoint cadence: the
-    executor's preemption-grace handler flushes an emergency save at
-    the in-flight step and exits cleanly; a restarted worker resumes at
-    exactly that step — lost work <= 1 step, not the save cadence
-    (reference design goal: flash checkpoint,
-    ``docs/blogs/stabilize_llm_training_cn.md:215``)."""
+def _preempt_cycle(tmp_path, extra_env=None, step_deadline=120,
+                   exit_wait=60, restart_timeout=180):
+    """Shared preemption-grace protocol: run the preempt worker to >= 3
+    steps, SIGTERM it, assert a clean in-grace exit, restart it against
+    the emergency checkpoint, and return (killed_step, records)."""
     import json
     import signal
     import subprocess
@@ -423,9 +420,11 @@ def test_preemption_grace_saves_at_killed_step(tmp_path):
         "PREEMPT_CKPT_DIR": str(tmp_path / "ckpt"),
         "PREEMPT_STATUS": str(status),
         "JAX_PLATFORMS": "cpu",
-        # single-device worker: the conftest's 8-device forcing would
-        # make ElasticTrainer adjust the 1x1 mesh to the full world
+        # default single-device worker: the conftest's 8-device forcing
+        # would make ElasticTrainer adjust the 1x1 mesh to the full
+        # world; pipelined callers override XLA_FLAGS themselves
         "XLA_FLAGS": "",
+        **(extra_env or {}),
     }
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
 
@@ -442,7 +441,8 @@ def test_preemption_grace_saves_at_killed_step(tmp_path):
 
     p = subprocess.Popen([sys.executable, script], env=env)
     try:
-        deadline = time.time() + 120
+        deadline = time.time() + step_deadline
+        steps = []
         while time.time() < deadline:
             steps = [r for r in read_status() if r.get("event") == "step"]
             if len(steps) >= 3:
@@ -454,7 +454,7 @@ def test_preemption_grace_saves_at_killed_step(tmp_path):
             time.sleep(0.2)
         assert len(steps) >= 3, "worker never reached 3 steps"
         p.send_signal(signal.SIGTERM)  # the preemption notice
-        rc = p.wait(timeout=60)
+        rc = p.wait(timeout=exit_wait)
     finally:
         if p.poll() is None:
             p.kill()
@@ -472,15 +472,46 @@ def test_preemption_grace_saves_at_killed_step(tmp_path):
     # restart: the worker must resume from the emergency checkpoint
     env["PREEMPT_TOTAL_STEPS"] = str(killed_step + 2)
     p2 = subprocess.run(
-        [sys.executable, script], env=env, timeout=180,
+        [sys.executable, script], env=env, timeout=restart_timeout,
     )
     assert p2.returncode == 0
     records = read_status()
     begins = [r for r in records if r.get("event") == "begin"]
     assert len(begins) == 2, begins
+    # the restart RESUMED from the emergency save, not from scratch,
+    # and ran exactly the remaining steps
     assert begins[1]["resumed_step"] == killed_step, (
         f"resumed at {begins[1]['resumed_step']}, emergency save was at "
         f"{killed_step}"
     )
     ends = [r for r in records if r.get("event") == "end"]
     assert ends[-1]["final_step"] == killed_step + 2
+    return killed_step, records
+
+
+@pytest.mark.slow
+def test_preemption_grace_saves_at_killed_step(tmp_path):
+    """SIGTERM mid-training with NO periodic checkpoint cadence: the
+    executor's preemption-grace handler flushes an emergency save at
+    the in-flight step and exits cleanly; a restarted worker resumes at
+    exactly that step — lost work <= 1 step, not the save cadence
+    (reference design goal: flash checkpoint,
+    ``docs/blogs/stabilize_llm_training_cn.md:215``)."""
+    _preempt_cycle(tmp_path)
+
+
+@pytest.mark.slow
+def test_preemption_grace_under_pipeline(tmp_path):
+    """The SIGTERM preemption-grace save also holds when the worker is
+    mid-PIPELINED training on a pipe mesh: the emergency checkpoint
+    flushes pipe-sharded stage-stacked state, and the restarted worker
+    resumes at the killed step through the same pipelined shardings."""
+    killed_step, records = _preempt_cycle(
+        tmp_path,
+        extra_env={
+            "PREEMPT_PIPELINE": "1",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+        step_deadline=180, exit_wait=90, restart_timeout=240,
+    )
+    assert killed_step >= 2  # the cycle's invariants all ran pipelined

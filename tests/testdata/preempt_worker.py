@@ -36,20 +36,43 @@ def emit(record):
         os.fsync(f.fileno())
 
 
-cfg = llama.llama_tiny(num_layers=2, max_seq_len=64, use_flash=False)
+# PREEMPT_PIPELINE=1: run the PIPELINED path on the 8-device mesh so
+# the emergency save flushes pipe-sharded state (stage-stacked layer
+# params on "pipe") rather than the single-device layout
+PIPELINED = os.environ.get("PREEMPT_PIPELINE", "") == "1"
+
+cfg = llama.llama_tiny(num_layers=4 if PIPELINED else 2,
+                       max_seq_len=64, use_flash=False)
 rng = np.random.RandomState(0)
-ids = rng.randint(0, cfg.vocab_size, size=(4, 65))
+rows = 8 if PIPELINED else 4
+ids = rng.randint(0, cfg.vocab_size, size=(rows, 65))
 batch = {
     "input_ids": jnp.asarray(ids[:, :-1]),
     "labels": jnp.asarray(ids[:, 1:]),
 }
 
+if PIPELINED:
+    from dlrover_tpu.models.losses import masked_lm_loss
+
+    def loss_fn(params, b, rng_key):
+        logits, _ = llama.apply_pipelined(
+            params, b["input_ids"], cfg,
+            num_stages=2, num_microbatches=2, rng=rng_key,
+        )
+        return masked_lm_loss(logits, b["labels"]), {}
+
+    strategy = Strategy(mesh=MeshPlan(pipe=2, data=2, tensor=2),
+                        rule_set="llama_pp")
+else:
+    loss_fn = llama.make_loss_fn(cfg)
+    strategy = Strategy(mesh=MeshPlan(data=1, fsdp=1))
+
 trainer = ElasticTrainer(
     llama.make_init_fn(cfg),
-    llama.make_loss_fn(cfg),
+    loss_fn,
     optax.adamw(1e-3),
     batch,
-    strategy=Strategy(mesh=MeshPlan(data=1, fsdp=1)),
+    strategy=strategy,
     ckpt_dir=CKPT,
     # no periodic cadence: steps=0/secs=0 never fires, so only the
     # preemption path can produce a checkpoint
