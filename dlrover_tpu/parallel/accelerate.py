@@ -51,8 +51,41 @@ class AccelerateResult:
     strategy: Strategy
 
     def shard_batch(self, batch):
-        """Host batch -> mesh-sharded global batch."""
-        return jax.device_put(batch, self.batch_spec)
+        """Host batch -> mesh-sharded global batch.
+
+        Single-process: ``batch`` is the whole global batch
+        (``device_put``). Multi-process (real multi-host): each process
+        passes its PROCESS-LOCAL rows — the shard its data loader owns
+        under the master's data-sharding service — and the global
+        array is assembled across hosts
+        (``jax.make_array_from_process_local_data``); ``device_put``
+        with a global sharding would raise on non-addressable devices.
+        This is the multi-host data plane the reference reaches via
+        per-rank torch DataLoader sharding + NCCL.
+        """
+        if jax.process_count() == 1:
+            return jax.device_put(batch, self.batch_spec)
+        import numpy as np
+
+        # the contract CHANGES under multi-process (local rows, not the
+        # global batch) — validate loudly, because feeding the global
+        # batch here would silently assemble a process_count-times
+        # larger batch of duplicated rows
+        rows = jax.tree.leaves(batch)[0].shape[0]
+        expected = self.strategy.global_batch_size // jax.process_count()
+        if rows != expected:
+            raise ValueError(
+                f"multi-process shard_batch takes PROCESS-LOCAL rows: "
+                f"expected {expected} rows/process (global batch "
+                f"{self.strategy.global_batch_size} over "
+                f"{jax.process_count()} processes), got {rows}"
+            )
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                self.batch_spec, np.asarray(x)
+            ),
+            batch,
+        )
 
 
 def _remat_wrap(loss_fn: LossFn, policy_name: str) -> LossFn:

@@ -57,6 +57,17 @@ def dryrun(result: AccelerateResult, example_batch, rng=None,
     report = DryrunReport(strategy=result.strategy)
     try:
         state = result.init_fn(rng)
+        if jax.process_count() > 1:
+            # shard_batch's multi-process contract takes PROCESS-LOCAL
+            # rows; every engine node holds the same GLOBAL example, so
+            # slice this process's share (otherwise the dryrun would
+            # assemble — and time — a process_count-times larger batch)
+            pc, pid = jax.process_count(), jax.process_index()
+            example_batch = jax.tree.map(
+                lambda x: x[(x.shape[0] // pc) * pid:
+                            (x.shape[0] // pc) * (pid + 1)],
+                example_batch,
+            )
         batch = result.shard_batch(example_batch)
 
         t0 = time.time()
