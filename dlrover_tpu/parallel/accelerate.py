@@ -53,39 +53,51 @@ class AccelerateResult:
     def shard_batch(self, batch):
         """Host batch -> mesh-sharded global batch.
 
-        Single-process: ``batch`` is the whole global batch
-        (``device_put``). Multi-process (real multi-host): each process
-        passes its PROCESS-LOCAL rows — the shard its data loader owns
-        under the master's data-sharding service — and the global
-        array is assembled across hosts
-        (``jax.make_array_from_process_local_data``); ``device_put``
-        with a global sharding would raise on non-addressable devices.
-        This is the multi-host data plane the reference reaches via
-        per-rank torch DataLoader sharding + NCCL.
+        Fully-addressable mesh (single process, or a local-subset
+        mesh): ``batch`` is the whole global batch. Multi-host mesh:
+        each process passes its PROCESS-LOCAL rows — the shard its
+        data loader owns under the master's data-sharding service —
+        and the global array is assembled across hosts
+        (``put_global_batch``). This is the multi-host data plane the
+        reference reaches via per-rank torch DataLoader sharding +
+        NCCL.
         """
-        if jax.process_count() == 1:
-            return jax.device_put(batch, self.batch_spec)
-        import numpy as np
+        return put_global_batch(batch, self.batch_spec,
+                                self.strategy.global_batch_size)
 
-        # the contract CHANGES under multi-process (local rows, not the
-        # global batch) — validate loudly, because feeding the global
-        # batch here would silently assemble a process_count-times
-        # larger batch of duplicated rows
-        rows = jax.tree.leaves(batch)[0].shape[0]
-        expected = self.strategy.global_batch_size // jax.process_count()
-        if rows != expected:
-            raise ValueError(
-                f"multi-process shard_batch takes PROCESS-LOCAL rows: "
-                f"expected {expected} rows/process (global batch "
-                f"{self.strategy.global_batch_size} over "
-                f"{jax.process_count()} processes), got {rows}"
-            )
-        return jax.tree.map(
-            lambda x: jax.make_array_from_process_local_data(
-                self.batch_spec, np.asarray(x)
-            ),
-            batch,
+
+def put_global_batch(batch, sharding, global_rows: int = 0):
+    """Host rows -> a sharded global batch.
+
+    A fully-addressable sharding (single process, or a mesh of only
+    this process's devices) goes through plain ``device_put`` with the
+    batch as the whole global batch. A sharding spanning OTHER
+    processes' devices — the real multi-host case, where ``device_put``
+    raises on non-addressable devices — assembles the global array
+    from each process's PROCESS-LOCAL rows
+    (``jax.make_array_from_process_local_data``). When ``global_rows``
+    is known, the local row count is validated loudly: feeding the
+    global batch on the multi-host path would otherwise silently
+    assemble a process_count-times larger batch of duplicated rows.
+    """
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(batch, sharding)
+    import numpy as np
+
+    rows = jax.tree.leaves(batch)[0].shape[0]
+    expected = global_rows // jax.process_count() if global_rows else 0
+    if expected and rows != expected:
+        raise ValueError(
+            f"a multi-host sharding takes PROCESS-LOCAL rows: expected "
+            f"{expected} rows/process (global batch {global_rows} over "
+            f"{jax.process_count()} processes), got {rows}"
         )
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        batch,
+    )
 
 
 def _remat_wrap(loss_fn: LossFn, policy_name: str) -> LossFn:

@@ -207,7 +207,12 @@ class DevicePreloader:
         import jax
 
         if self._sharding is not None:
-            return jax.device_put(batch, self._sharding)
+            # multi-host shardings assemble from PROCESS-LOCAL rows;
+            # fully-addressable ones (incl. every single-process case,
+            # any sharding type) stay on plain device_put
+            from dlrover_tpu.parallel.accelerate import put_global_batch
+
+            return put_global_batch(batch, self._sharding)
         return jax.device_put(batch)
 
     def __iter__(self):

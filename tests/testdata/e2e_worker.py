@@ -66,6 +66,18 @@ def main() -> int:
             losses.append(float(jax.device_get(metrics["loss"])))
         assert all(np.isfinite(v) for v in losses), losses
         assert losses[1] < losses[0], losses
+
+        # DevicePreloader's multi-host branch: local rows in, global
+        # pre-sharded batch out, consumable by the same train step
+        from dlrover_tpu.trainer.data import DevicePreloader
+
+        (preloaded,) = list(
+            DevicePreloader([local_batch], sharding=result.batch_spec)
+        )
+        state, metrics = result.train_step(
+            state, preloaded, jax.random.PRNGKey(9)
+        )
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
         print(f"worker {ctx.process_id}: global-mesh train step ok "
               f"losses={losses}", flush=True)
 
