@@ -549,3 +549,39 @@ class TestElasticPipelined:
         assert abs(loss_shrunk - loss_ctrl) < max(
             5e-3, 5e-3 * abs(loss_ctrl)
         ), f"pipelined trajectory diverged: {loss_shrunk} vs {loss_ctrl}"
+
+
+class TestUnevenConfigSweep:
+    """Schedule-shape sweep for the uneven paths: corner configs that
+    the targeted tests don't hit — single-layer chunks everywhere,
+    M > P, V=3 rounds, heaviest-chunk-first vs -last layouts."""
+
+    @pytest.mark.parametrize(
+        "num_layers,num_stages,num_mb,num_virtual,depths",
+        [
+            (5, 4, 8, 1, (2, 1, 1, 1)),   # heaviest first, M > P
+            (5, 4, 4, 1, (1, 1, 1, 2)),   # heaviest last
+            (4, 2, 4, 1, (3, 1)),          # strongly skewed
+            (7, 2, 3, 3, (2, 1, 1, 1, 1, 1)),  # V=3, mostly single-layer
+            (10, 3, 3, 3, (2, 1, 1, 1, 1, 1, 1, 1, 1)),  # V=3, P=3
+        ],
+    )
+    def test_matches_plain(self, num_layers, num_stages, num_mb,
+                           num_virtual, depths):
+        assert sum(depths) == num_layers
+        config = llama.llama_tiny(num_layers=num_layers)
+        params = llama.init(jax.random.PRNGKey(num_layers), config)
+        ids = jnp.asarray(
+            np.random.RandomState(num_layers).randint(
+                0, config.vocab_size, (num_mb * 2, 16)
+            )
+        )
+        rng = jax.random.PRNGKey(7)
+        plain, _ = llama.apply(params, ids, config, rng)
+        piped, _ = llama.apply_pipelined(
+            params, ids, config, num_stages=num_stages,
+            num_microbatches=num_mb, rng=rng, num_virtual=num_virtual,
+            stage_depths=depths,
+        )
+        np.testing.assert_allclose(np.asarray(piped), np.asarray(plain),
+                                   rtol=2e-4, atol=2e-4)
