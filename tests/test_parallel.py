@@ -357,3 +357,32 @@ class TestAutoTune:
         assert best.mesh.axis_sizes() == expected.axis_sizes()
         # and it is NOT simply the first enumerated candidate
         assert best.mesh.axis_sizes() != cands[0].axis_sizes()
+
+
+class TestPutGlobalBatch:
+    """put_global_batch: fully-addressable shardings stay on device_put;
+    the multi-host assembly path validates its process-local row
+    contract loudly."""
+
+    def test_fully_addressable_device_put(self):
+        from dlrover_tpu.parallel.accelerate import put_global_batch
+        from dlrover_tpu.parallel.sharding_rules import batch_sharding
+
+        mesh = MeshPlan(data=4, fsdp=2).build()
+        spec = batch_sharding(mesh)
+        out = put_global_batch({"x": jnp.ones((8, 4))}, spec,
+                               global_rows=8)
+        # pinned to the REQUESTED sharding, not merely any placement
+        assert out["x"].sharding == spec
+        assert out["x"].shape == (8, 4)
+
+    def test_non_addressable_wrong_rows_raises(self):
+        from dlrover_tpu.parallel.accelerate import put_global_batch
+
+        class StubSharding:
+            is_fully_addressable = False
+
+        with pytest.raises(ValueError, match="PROCESS-LOCAL rows"):
+            put_global_batch(
+                {"x": jnp.ones((8, 4))}, StubSharding(), global_rows=4
+            )
