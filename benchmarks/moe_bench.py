@@ -111,6 +111,11 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
     t_dense = _time_step(dense_step, dense_p, x)
     t_gather = _time_step(moe_loss("gather"), params, x)
     t_einsum = _time_step(moe_loss("einsum"), params, x)
+    # the DROPLESS grouped kernel only times meaningfully on real
+    # hardware — the CPU run would measure the Pallas interpreter, not
+    # the kernel (correctness on CPU is tests/test_ops.py's job)
+    t_grouped = (None if jax.devices()[0].platform == "cpu"
+                 else _time_step(moe_loss("grouped"), params, x))
     return {
         "config": {"batch": b, "seq": s, "d_model": d, "d_ff": f,
                    "experts": e, "top_k": k},
@@ -122,6 +127,10 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
         "gather_overhead": round((t_gather - t_dense) / t_dense, 3),
         "einsum_overhead": round((t_einsum - t_dense) / t_dense, 3),
         "gather_speedup_vs_einsum": round(t_einsum / t_gather, 2),
+        **({} if t_grouped is None else {
+            "moe_grouped_dropless_ms": round(t_grouped * 1e3, 3),
+            "grouped_overhead": round((t_grouped - t_dense) / t_dense, 3),
+        }),
     }
 
 

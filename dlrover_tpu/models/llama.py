@@ -320,6 +320,9 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
             capacity_factor=config.moe_capacity_factor,
             top_k=config.moe_top_k,
             dispatch=config.moe_dispatch,
+            # the grouped kernel follows the flash knob: False forces
+            # Mosaic (deviceless-AOT tracing), None auto-detects
+            kernel_interpret=config.flash_interpret,
         )
         out, aux, metrics = moe_ops.moe_ffn(
             moe_params, x, cfg, activation=jax.nn.silu, rng=rng

@@ -1,0 +1,186 @@
+"""Grouped matmul Pallas kernel — the dropless-MoE expert compute.
+
+``y[i] = x[i] @ w[expert_of_row_i]`` where rows are SORTED by expert and
+every expert's group is padded to a multiple of the row-tile, so each
+row-tile belongs to exactly one expert. The per-tile expert index rides
+scalar prefetch (``PrefetchScalarGridSpec``), and the kernel picks that
+expert's weight block via the BlockSpec index map — no [T, E, C]
+one-hot tensors, no capacity, no dropped tokens.
+
+Role parity: the reference delegates its MoE hot path to a fused CUDA
+backend (``atorch/atorch/modules/moe/moe_layer.py:511`` fastmoe); the
+public megablocks line of work frames the same computation as
+block-sparse "grouped GEMM". The TPU formulation here: tile-aligned
+group padding costs at most ``E * (block_t - 1)`` pad rows — versus the
+capacity approach's ``(factor - 1) * T`` padded slots PLUS dropped
+overflow tokens — and the MXU sees plain dense [block_t, D] x
+[D, block_f] tiles.
+
+Backward is a custom VJP:
+  dx = dy @ w[e]^T       — the same kernel over transposed weights;
+  dw[e] = sum over e's tiles of x_tile^T @ dy_tile — an accumulation
+  kernel whose grid runs row-tiles FASTEST so consecutive steps that
+  share an expert keep the output block resident and accumulate
+  (tiles of one expert are contiguous by construction, so no output
+  block is ever revisited after being left).
+
+Everything accumulates in f32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block(dim: int, want: int) -> int:
+    """Largest tile <= ``want`` that divides ``dim``, preferring
+    lane-aligned multiples of 128 (Mosaic's happy path); falls back to
+    any divisor, then to ``dim`` itself."""
+    want = min(want, dim)
+    for cand in range(want - want % 128, 0, -128):
+        if dim % cand == 0:
+            return cand
+    for cand in range(want, 0, -1):
+        if dim % cand == 0:
+            return cand
+    return dim
+
+
+def _auto_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(tile_expert_ref, x_ref, w_ref, y_ref):
+    del tile_expert_ref  # consumed by the index maps
+    y_ref[...] = jax.lax.dot_general(
+        x_ref[...], w_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(y_ref.dtype)
+
+
+def _dw_kernel(tile_expert_ref, x_ref, dy_ref, dw_ref):
+    i = pl.program_id(1)  # row-tile index (fastest grid dim)
+    e_here = tile_expert_ref[i]
+    e_prev = tile_expert_ref[jnp.maximum(i - 1, 0)]
+    first = jnp.logical_or(i == 0, e_here != e_prev)
+    contrib = jax.lax.dot_general(
+        x_ref[...], dy_ref[...],
+        (((0,), (0,)), ((), ())),  # [block_t, D]^T @ [block_t, F]
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(first)
+    def _init():
+        dw_ref[0] = contrib.astype(dw_ref.dtype)
+
+    @pl.when(jnp.logical_not(first))
+    def _acc():
+        dw_ref[0] = (dw_ref[0] + contrib).astype(dw_ref.dtype)
+
+
+def _grouped_matmul_fwd(x, w, tile_expert, block_t, block_f, interpret):
+    tp, d = x.shape
+    e, dw_, f = w.shape
+    assert d == dw_, (x.shape, w.shape)
+    assert tp % block_t == 0, (tp, block_t)
+    num_t = tp // block_t
+    bf = _pick_block(f, block_f)
+    num_f = f // bf
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_t, num_f),
+        in_specs=[
+            pl.BlockSpec((block_t, d), lambda i, j, te: (i, 0)),
+            pl.BlockSpec((1, d, bf), lambda i, j, te: (te[i], 0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_t, bf), lambda i, j, te: (i, j)),
+    )
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tp, f), x.dtype),
+        interpret=interpret,
+    )(tile_expert, x, w)
+
+
+def _grouped_matmul_dw(x, dy, tile_expert, num_experts, block_t, block_f,
+                       interpret):
+    tp, d = x.shape
+    _, f = dy.shape
+    num_t = tp // block_t
+    bf = _pick_block(f, block_f)
+    num_f = f // bf
+
+    # row-tiles FASTEST (innermost): consecutive steps sharing an expert
+    # accumulate into the resident output block; a left block is never
+    # revisited because each expert's tiles are contiguous
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_f, num_t),
+        in_specs=[
+            pl.BlockSpec((block_t, d), lambda j, i, te: (i, 0)),
+            pl.BlockSpec((block_t, bf), lambda j, i, te: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, d, bf), lambda j, i, te: (te[i], 0, j)),
+    )
+    return pl.pallas_call(
+        _dw_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_experts, d, f), jnp.float32),
+        interpret=interpret,
+    )(tile_expert, x, dy)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def grouped_matmul(x, w, tile_expert, block_t=128, block_f=512,
+                   interpret=None):
+    """``y[i] = x[i] @ w[tile_expert[i // block_t]]``.
+
+    Args:
+      x: [Tp, D] rows sorted by expert, each expert's group padded to a
+        multiple of ``block_t`` (pad rows may be garbage; their outputs
+        are garbage and must be masked by the caller's un-sort).
+      w: [E, D, F] per-expert weights.
+      tile_expert: [Tp // block_t] int32, the expert owning each
+        row-tile — every row in a tile MUST share the expert (the
+        tile-aligned padding guarantees it).
+      interpret: None = auto (interpreter off TPU, Mosaic on TPU);
+        False forces Mosaic (the deviceless-AOT contract).
+    Returns [Tp, F] in x's dtype (f32 accumulation inside).
+    """
+    interp = _auto_interpret(interpret)
+    return _grouped_matmul_fwd(x, w, tile_expert, block_t, block_f,
+                               interp)
+
+
+def _gm_fwd(x, w, tile_expert, block_t, block_f, interpret):
+    y = grouped_matmul(x, w, tile_expert, block_t, block_f, interpret)
+    return y, (x, w, tile_expert)
+
+
+def _gm_bwd(block_t, block_f, interpret, res, dy):
+    x, w, tile_expert = res
+    interp = _auto_interpret(interpret)
+    # dx: the same grouped product against w^T ([E, F, D])
+    w_t = jnp.swapaxes(w, 1, 2)
+    dx = _grouped_matmul_fwd(
+        dy.astype(x.dtype), w_t, tile_expert, block_t, block_f, interp
+    )
+    dw = _grouped_matmul_dw(
+        x, dy.astype(x.dtype), tile_expert, w.shape[0], block_t,
+        block_f, interp
+    ).astype(w.dtype)
+    return dx.astype(x.dtype), dw, None
+
+
+grouped_matmul.defvjp(_gm_fwd, _gm_bwd)

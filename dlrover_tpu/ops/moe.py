@@ -7,7 +7,7 @@ aux loss). TPU-first: expert weights live on the expert submesh and XLA
 inserts the all-to-alls from shardings — no hand-written autograd
 collective is needed.
 
-Two dispatch implementations share one routing core (``_routing``):
+Three dispatch implementations share one routing core (``_routing``):
 
 - ``"gather"`` (default, the fast path): a slot->token index map built
   from tiny int32 scatters turns dispatch into a pure gather of the
@@ -21,6 +21,13 @@ Two dispatch implementations share one routing core (``_routing``):
   cost T*E*C*D = capacity_factor*T^2*D FLOPs — quadratic in tokens, so
   dispatch dominates expert FLOPs at practical T. Kept as the oracle
   the fast path is tested against (``tests/test_ops.py``).
+- ``"grouped"`` (DROPLESS): the Pallas grouped-matmul kernel
+  (``ops.grouped_matmul``) — megablocks-style. No capacity and no
+  dropped tokens: rows sort by expert, groups pad to the row-tile, and
+  the expert FFN runs as grouped GEMMs with the per-tile expert index
+  on scalar prefetch. The per-shard (data-parallel experts) hot path;
+  EP submesh sharding stays on gather/einsum (the kernel is opaque to
+  GSPMD).
 """
 
 from __future__ import annotations
@@ -41,7 +48,12 @@ class MoEConfig:
     top_k: int = 1  # 1 = switch routing, 2 = gshard-style
     aux_loss_weight: float = 0.01
     router_jitter: float = 0.0  # multiplicative logit noise during training
-    dispatch: str = "gather"  # "gather" (fast) | "einsum" (reference)
+    # "gather" (fast, capacity-based) | "einsum" (reference oracle) |
+    # "grouped" (DROPLESS Pallas grouped matmul — per-shard experts)
+    dispatch: str = "gather"
+    # grouped-dispatch kernel mode: None = auto (interpreter off TPU),
+    # False forces Mosaic (the deviceless-AOT contract)
+    kernel_interpret: Optional[bool] = None
 
 
 def _capacity(num_tokens: int, num_experts: int, factor: float) -> int:
@@ -205,6 +217,82 @@ def _moe_compute_gather(params, xt, rounds, capacity, e, activation):
     return out
 
 
+def _moe_compute_grouped(params, xt, rounds, e, activation,
+                         block_t: int = 128,
+                         interpret: Optional[bool] = None):
+    """DROPLESS dispatch via the grouped-matmul Pallas kernel
+    (``ops.grouped_matmul``) — megablocks-style: NO capacity, NO
+    dropped tokens.
+
+    Every (token, round) assignment is served: rows are sorted by
+    expert with each group padded up to the row-tile, and the expert
+    FFN runs as two grouped matmuls whose per-tile expert index rides
+    scalar prefetch. Static shapes throughout — padded rows are the
+    upper bound ceil(T*k / bt)*bt + E*bt, so XLA sees one program
+    regardless of the routing. Pad overhead is at most E*(block_t-1)
+    rows vs the capacity approach's (factor-1)*T slots plus overflow
+    drops.
+
+    Scope: the per-shard (data-parallel experts) hot path. With experts
+    sharded over an expert submesh (EP), use the "gather"/"einsum"
+    dispatches — the kernel is opaque to GSPMD, so EP sharding of its
+    operands would force replication instead of all-to-alls.
+    """
+    from dlrover_tpu.ops.grouped_matmul import grouped_matmul
+
+    t, d = xt.shape
+    k = len(rounds)
+    n = t * k
+    # assignments in round-major arrival order (matches _routing's
+    # queue discipline: every k=0 choice precedes any k=1 choice)
+    expert_a = jnp.concatenate([r[0] for r in rounds])  # [n] int32
+    gate_a = jnp.concatenate([r[3] for r in rounds])  # [n] f32
+    token_a = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+    # with capacity == T nothing overflows, so _routing's queue
+    # positions ARE each assignment's within-expert arrival rank
+    # (cross-round fill included) — no second [n, E] cumsum needed
+    rank = jnp.concatenate([r[1] for r in rounds])  # [n] int32
+    counts = jnp.zeros((e,), jnp.int32).at[expert_a].add(1)  # [E]
+    # every expert gets AT LEAST one tile, even with zero routed
+    # tokens: its sentinel-zero rows make the dw kernel INITIALIZE that
+    # expert's gradient block to zero — an unvisited output block would
+    # be uninitialized garbage on real TPU (interpret mode zero-fills,
+    # which would mask the bug)
+    padded = jnp.maximum(
+        ((counts + block_t - 1) // block_t), 1
+    ) * block_t  # [E]
+    ends = jnp.cumsum(padded).astype(jnp.int32)  # [E]
+    offsets = ends - padded.astype(jnp.int32)  # [E] exclusive
+    row = offsets[expert_a] + rank  # [n] destination row, unique
+    # static padded-row bound: every group full + its tile padding
+    tp = ((n + block_t - 1) // block_t) * block_t + e * block_t
+    # row -> token map; pad rows read the zero sentinel row of x_pad
+    row_token = jnp.full((tp,), t, jnp.int32).at[row].set(token_a)
+    x_pad = jnp.concatenate([xt, jnp.zeros((1, d), xt.dtype)], axis=0)
+    x_sorted = x_pad[row_token]
+    # tile i belongs to the expert whose [offset, end) span covers it;
+    # tiles past the last real group clip to the final expert (their
+    # rows are all sentinel zeros — garbage compute, masked by unsort)
+    tile_start = jnp.arange(tp // block_t, dtype=jnp.int32) * block_t
+    tile_expert = jnp.clip(
+        jnp.searchsorted(ends, tile_start, side="right"), 0, e - 1
+    ).astype(jnp.int32)
+
+    h = activation(grouped_matmul(
+        x_sorted, params["experts"]["up"]["kernel"], tile_expert,
+        block_t, 512, interpret,
+    ))
+    y_sorted = grouped_matmul(
+        h, params["experts"]["down"]["kernel"], tile_expert,
+        block_t, 512, interpret,
+    )
+    # combine: unsort + gate weight, summing each token's k rounds
+    y_a = y_sorted[row] * gate_a[:, None].astype(y_sorted.dtype)
+    return jnp.zeros((t, d), xt.dtype).at[token_a].add(
+        y_a.astype(xt.dtype)
+    )
+
+
 def moe_ffn(
     params: dict,
     x: jax.Array,  # [B, S, D]
@@ -222,20 +310,38 @@ def moe_ffn(
     load-balance observability signals, computed by the router at
     negligible cost and surfaced as step metrics by the trainer.
     """
+    if config.dispatch not in ("gather", "einsum", "grouped"):
+        raise ValueError(
+            f"unknown MoE dispatch {config.dispatch!r}; choose "
+            f"'gather' (fast, capacity), 'einsum' (reference oracle) "
+            f"or 'grouped' (dropless Pallas kernel)"
+        )
     b, s, d = x.shape
     t = b * s
     xt = x.reshape(t, d)
     logits = xt @ params["router"]["kernel"]  # [T, E]
     factor = config.capacity_factor if train else config.eval_capacity_factor
-    capacity = _capacity(t, config.num_experts, factor)
+    if config.dispatch == "grouped":
+        # DROPLESS: no capacity limit — every assignment is served, so
+        # route with capacity = T (nothing can overflow) and the
+        # metrics honestly report dropped_frac == 0
+        capacity = t
+    else:
+        capacity = _capacity(t, config.num_experts, factor)
     rounds, aux, metrics = _routing(
         logits, capacity, config.top_k, rng,
         config.router_jitter if train else 0.0,
     )
-    compute = (_moe_compute_einsum if config.dispatch == "einsum"
-               else _moe_compute_gather)
-    out = compute(params, xt, rounds, capacity, config.num_experts,
-                  activation)
+    if config.dispatch == "grouped":
+        out = _moe_compute_grouped(
+            params, xt, rounds, config.num_experts, activation,
+            interpret=config.kernel_interpret,
+        )
+    else:
+        compute = (_moe_compute_einsum if config.dispatch == "einsum"
+                   else _moe_compute_gather)
+        out = compute(params, xt, rounds, capacity, config.num_experts,
+                      activation)
     return out.reshape(b, s, d), aux.astype(jnp.float32), metrics
 
 
