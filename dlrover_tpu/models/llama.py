@@ -21,7 +21,7 @@ Numerics follow Llama-2: RMSNorm (f32), RoPE, GQA, SwiGLU, untied head.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -87,7 +87,9 @@ class LlamaConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
-    moe_dispatch: str = "gather"  # "gather" (fast) | "einsum" (reference)
+    # "gather" (fast, capacity) | "einsum" (reference oracle) |
+    # "grouped" (dropless Pallas kernel — per-shard experts)
+    moe_dispatch: str = "gather"
 
     @property
     def head_dim(self) -> int:

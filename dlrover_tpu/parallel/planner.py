@@ -16,10 +16,9 @@ the candidate-ordering prior for the measured search.
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.mesh import MeshPlan, candidate_plans
