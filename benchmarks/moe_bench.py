@@ -15,6 +15,20 @@ Measures fwd+bwd step time of
     (top_k * d_ff wide) — the iso-FLOPs floor,
 and reports dispatch overhead = (moe - dense) / dense.
 
+Row provenance (which rows mean what, where):
+  - dense / gather / einsum: timed on any platform; CPU uses reduced
+    shapes (per-op overheads inflate ratios there — labeled).
+  - grouped (dropless, per-shard): HARDWARE-ONLY — on CPU the Pallas
+    kernel runs under the interpreter, so a CPU time would measure the
+    interpreter, not the kernel. The row is omitted off-TPU.
+  - grouped_ep (dropless, expert-parallel all-to-all): timed on TPU;
+    on a multi-device CPU mesh (XLA_FLAGS=
+    --xla_force_host_platform_device_count=8) the row RUNS in
+    interpret mode and is emitted with "interpret": true — it proves
+    the shard_map + all_to_all wiring end to end (correctness/recompile
+    behavior), but its milliseconds measure the interpreter and must
+    not be compared against the hardware rows.
+
 Run: ``python benchmarks/moe_bench.py`` (TPU host or CPU).
 Prints one JSON line per config.
 """
@@ -65,6 +79,17 @@ def _time_step(fn, *args):
     return (time.perf_counter() - t0) / STEPS
 
 
+def _ep_mesh():
+    """An expert submesh over every local device (None when the host
+    has a single device or the expert count wouldn't divide it)."""
+    n = jax.device_count()
+    if n < 2:
+        return None
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()).reshape(n), ("expert",))
+
+
 def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(b, s, d), dtype)
@@ -73,8 +98,9 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
         init_moe_params(jax.random.PRNGKey(0), d, f, e),
     )
 
-    def moe_loss(dispatch):
-        cfg = MoEConfig(num_experts=e, top_k=k, dispatch=dispatch)
+    def moe_loss(dispatch, **cfg_kw):
+        cfg = MoEConfig(num_experts=e, top_k=k, dispatch=dispatch,
+                        **cfg_kw)
 
         def loss(p, x):
             o, aux, _ = moe_ffn(p, x, cfg, activation=jax.nn.silu)
@@ -108,14 +134,29 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
             for a in jax.tree.leaves(g)
         )
 
+    on_cpu = jax.devices()[0].platform == "cpu"
     t_dense = _time_step(dense_step, dense_p, x)
     t_gather = _time_step(moe_loss("gather"), params, x)
     t_einsum = _time_step(moe_loss("einsum"), params, x)
-    # the DROPLESS grouped kernel only times meaningfully on real
-    # hardware — the CPU run would measure the Pallas interpreter, not
-    # the kernel (correctness on CPU is tests/test_ops.py's job)
-    t_grouped = (None if jax.devices()[0].platform == "cpu"
+    # the per-shard DROPLESS grouped kernel only times meaningfully on
+    # real hardware — the CPU run would measure the Pallas interpreter,
+    # not the kernel (correctness on CPU is tests/test_ops.py's job).
+    # HARDWARE-ONLY row.
+    t_grouped = (None if on_cpu
                  else _time_step(moe_loss("grouped"), params, x))
+    # the EXPERT-PARALLEL dropless path (shard_map + all_to_all around
+    # the kernel): real timing on TPU; on a multi-device CPU mesh it
+    # runs in interpret mode — wiring proof, interpreter milliseconds
+    t_ep, ep_interpret, ep_degree = None, on_cpu, 0
+    mesh = _ep_mesh()
+    if mesh is not None and e % mesh.devices.size == 0 \
+            and (b * s) % mesh.devices.size == 0:
+        ep_degree = int(mesh.devices.size)
+        t_ep = _time_step(
+            moe_loss("grouped_ep", ep_axes=("expert",), mesh=mesh,
+                     kernel_interpret=True if on_cpu else None),
+            params, x,
+        )
     return {
         "config": {"batch": b, "seq": s, "d_model": d, "d_ff": f,
                    "experts": e, "top_k": k},
@@ -131,12 +172,25 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
             "moe_grouped_dropless_ms": round(t_grouped * 1e3, 3),
             "grouped_overhead": round((t_grouped - t_dense) / t_dense, 3),
         }),
+        **({} if t_ep is None else {
+            "moe_grouped_ep_ms": round(t_ep * 1e3, 3),
+            "grouped_ep_degree": ep_degree,
+            # True = Pallas interpreter on the CPU mesh: wiring proof
+            # only, NOT comparable to hardware rows
+            "grouped_ep_interpret": bool(ep_interpret),
+        }),
     }
 
 
 def main():
-    configs = (CONFIGS_CPU if jax.devices()[0].platform == "cpu"
-               else CONFIGS)
+    on_cpu = jax.devices()[0].platform == "cpu"
+    configs = CONFIGS_CPU if on_cpu else CONFIGS
+    if on_cpu and jax.device_count() < 2:
+        print(json.dumps({"note": (
+            "single CPU device: the grouped_ep row needs a device mesh"
+            " — rerun with XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=8 to exercise it in interpret mode"
+        )}), flush=True)
     for cfg in configs:
         print(json.dumps(bench_config(*cfg)), flush=True)
 

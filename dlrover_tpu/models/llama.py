@@ -88,8 +88,15 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     # "gather" (fast, capacity) | "einsum" (reference oracle) |
-    # "grouped" (dropless Pallas kernel — per-shard experts)
+    # "grouped" (dropless Pallas kernel — per-shard experts) |
+    # "grouped_ep" (dropless + expert-parallel: shard_map + all_to_all
+    # over the ``moe_ep_axes`` expert submesh; pair with the "moe_ep"
+    # rule set so expert weights shard where the all-to-all lands them)
     moe_dispatch: str = "gather"
+    # "grouped_ep" only: the expert submesh axes. Defaults to the
+    # canonical (data x fsdp) expert submesh; the mesh itself resolves
+    # ambiently per accelerate (elastic-safe), or from ``mesh`` above.
+    moe_ep_axes: Tuple[str, ...] = ("data", "fsdp")
 
     @property
     def head_dim(self) -> int:
@@ -325,6 +332,12 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
             # the grouped kernel follows the flash knob: False forces
             # Mosaic (deviceless-AOT tracing), None auto-detects
             kernel_interpret=config.flash_interpret,
+            # grouped_ep: an explicit config mesh wins; otherwise the
+            # AMBIENT mesh (rebuilt by every accelerate) keeps the
+            # expert-parallel shard_map elastic-safe, mirroring the
+            # ring-attention mesh convention above
+            ep_axes=tuple(config.moe_ep_axes),
+            mesh=config.mesh,
         )
         out, aux, metrics = moe_ops.moe_ffn(
             moe_params, x, cfg, activation=jax.nn.silu, rng=rng

@@ -347,22 +347,15 @@ def flash_attention(
 
 
 def ambient_shard_mesh():
-    """The ambient mesh when tracing under ``jax.sharding.set_mesh``
-    with >1 device on the flash-relevant (data/fsdp/tensor) axes; None
-    when single-device, unsharded, or under a partial mesh missing one
-    of those axes (the sharded wrapper's PartitionSpec names all
-    three)."""
-    try:
-        mesh = jax.sharding.get_abstract_mesh()
-    except Exception:  # noqa: BLE001 — no mesh context
-        return None
-    names = tuple(getattr(mesh, "axis_names", ()) or ())
-    if not all(a in names for a in ("data", "fsdp", "tensor")):
-        return None
-    sizes = dict(zip(names, mesh.axis_sizes))
-    if sum(sizes[a] for a in ("data", "fsdp", "tensor")) <= 3:
-        return None  # all three axes trivial (size 1 each)
-    return mesh
+    """The ambient mesh when tracing under a mesh context (``set_mesh``
+    or the legacy ``with mesh:`` thread-resources form — see
+    ``shard_compat.ambient_mesh_with_axes``) with >1 device on the
+    flash-relevant (data/fsdp/tensor) axes; None when single-device,
+    unsharded, or under a partial mesh missing one of those axes (the
+    sharded wrapper's PartitionSpec names all three)."""
+    from dlrover_tpu.ops.shard_compat import ambient_mesh_with_axes
+
+    return ambient_mesh_with_axes(("data", "fsdp", "tensor"))
 
 
 def flash_attention_auto(
@@ -403,7 +396,12 @@ def _shard_mapped_attention(mesh, body, q, k, v, extras=(),
     ``extra_ndims`` gives each one's rank so its spec pads with None."""
     from jax.sharding import PartitionSpec as P
 
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_compat import (
+        get_shard_map,
+        shard_map_check_kwargs,
+    )
+
+    shard_map = get_shard_map()
 
     if head_axis is not None:
         sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))
@@ -416,14 +414,7 @@ def _shard_mapped_attention(mesh, body, q, k, v, extras=(),
     extra_specs = tuple(
         P(batch_axes, *([None] * (nd - 1))) for nd in extra_ndims
     )
-    import inspect
-
-    params = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False} if "check_vma" in params
-        else {"check_rep": False} if "check_rep" in params
-        else {}
-    )
+    check_kw = shard_map_check_kwargs(shard_map)
     return shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec) + extra_specs, out_specs=spec,

@@ -141,6 +141,43 @@ def _grouped_matmul_dw(x, dy, tile_expert, num_experts, block_t, block_f,
     )(tile_expert, x, dy)
 
 
+def _check_tile_expert(tile_expert, num_experts: int):
+    """Cheap debug-mode contract check, CONCRETE values only (a traced
+    ``tile_expert`` — the jitted production path — skips it for free).
+
+    The two contract violations it catches produce silent garbage on
+    real TPU but NOT in interpret mode: the interpreter zero-fills
+    pallas output buffers, so (a) an expert absent from ``tile_expert``
+    reads back a zero dw block instead of the uninitialized garbage
+    Mosaic would leave, and (b) a non-monotone ``tile_expert`` revisits
+    a dw block the accumulation kernel already left, whose first-tile
+    predicate then re-INITIALIZES it, silently dropping the earlier
+    tiles' contributions.
+    """
+    if isinstance(tile_expert, jax.core.Tracer):
+        return
+    import numpy as np
+
+    te = np.asarray(tile_expert)
+    if te.size and np.any(np.diff(te) < 0):
+        raise ValueError(
+            "grouped_matmul: tile_expert must be NON-DECREASING (each "
+            "expert's tiles contiguous) — the dw kernel accumulates "
+            "into the resident output block and never revisits one; "
+            f"got {te.tolist()}"
+        )
+    missing = sorted(set(range(num_experts)) - set(int(v) for v in te))
+    if missing:
+        raise ValueError(
+            "grouped_matmul: every expert 0..E-1 must own at least one "
+            f"row-tile, but experts {missing} are absent from "
+            "tile_expert — their dw output blocks would be "
+            "UNINITIALIZED garbage on real TPU (interpret mode "
+            "zero-fills, masking the bug). Give each empty expert one "
+            "sentinel tile of zero rows (see ops.moe._moe_compute_grouped)"
+        )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def grouped_matmul(x, w, tile_expert, block_t=128, block_f=512,
                    interpret=None):
@@ -153,11 +190,26 @@ def grouped_matmul(x, w, tile_expert, block_t=128, block_f=512,
       w: [E, D, F] per-expert weights.
       tile_expert: [Tp // block_t] int32, the expert owning each
         row-tile — every row in a tile MUST share the expert (the
-        tile-aligned padding guarantees it).
+        tile-aligned padding guarantees it). Two further contract
+        requirements exist for the BACKWARD pass and are invisible in
+        interpret mode (which zero-fills output buffers):
+        * every expert 0..E-1 must appear at least once — an expert
+          owning no tile leaves its dw output block UNINITIALIZED
+          (garbage) on real TPU, because the accumulation grid never
+          visits it. Callers give empty experts one sentinel tile of
+          zero rows (``ops.moe._moe_compute_grouped``).
+        * values must be NON-DECREASING (each expert's tiles
+          contiguous) — the dw kernel initializes an expert's block on
+          its first tile and accumulates while resident; a revisited
+          block would be re-initialized, dropping earlier tiles.
+        Concrete (non-traced) ``tile_expert`` values are validated at
+        call time (``_check_tile_expert``); traced values are the
+        caller's responsibility.
       interpret: None = auto (interpreter off TPU, Mosaic on TPU);
         False forces Mosaic (the deviceless-AOT contract).
     Returns [Tp, F] in x's dtype (f32 accumulation inside).
     """
+    _check_tile_expert(tile_expert, w.shape[0])
     interp = _auto_interpret(interpret)
     return _grouped_matmul_fwd(x, w, tile_expert, block_t, block_f,
                                interp)

@@ -7,7 +7,7 @@ aux loss). TPU-first: expert weights live on the expert submesh and XLA
 inserts the all-to-alls from shardings — no hand-written autograd
 collective is needed.
 
-Three dispatch implementations share one routing core (``_routing``):
+Four dispatch implementations share one routing core (``_routing``):
 
 - ``"gather"`` (default, the fast path): a slot->token index map built
   from tiny int32 scatters turns dispatch into a pure gather of the
@@ -20,24 +20,48 @@ Three dispatch implementations share one routing core (``_routing``):
   einsums, numerically transparent and GSPMD-friendly, but the einsums
   cost T*E*C*D = capacity_factor*T^2*D FLOPs — quadratic in tokens, so
   dispatch dominates expert FLOPs at practical T. Kept as the oracle
-  the fast path is tested against (``tests/test_ops.py``).
-- ``"grouped"`` (DROPLESS): the Pallas grouped-matmul kernel
+  the fast paths are tested against (``tests/test_ops.py``).
+- ``"grouped"`` (DROPLESS, per-shard): the Pallas grouped-matmul kernel
   (``ops.grouped_matmul``) — megablocks-style. No capacity and no
   dropped tokens: rows sort by expert, groups pad to the row-tile, and
   the expert FFN runs as grouped GEMMs with the per-tile expert index
-  on scalar prefetch. The per-shard (data-parallel experts) hot path;
-  EP submesh sharding stays on gather/einsum (the kernel is opaque to
-  GSPMD).
+  on scalar prefetch. The data-parallel-experts hot path; the kernel is
+  opaque to GSPMD, so EP submesh sharding of its operands would force
+  replication.
+- ``"grouped_ep"`` (DROPLESS, expert-parallel): a ``shard_map`` over the
+  expert submesh wrapping the same grouped kernel with EXPLICIT
+  collectives — the TPU rendering of the reference's ``_AllToAll``
+  expert process groups (``moe_layer.py:87``). Each shard routes its
+  local tokens, exchanges per-(shard, expert) COUNTS with a tiny
+  ``all_to_all`` so row padding stays tile-aligned and static-shaped,
+  exchanges the token rows themselves with a second ``all_to_all``,
+  runs the dropless grouped GEMMs on its local experts, and returns
+  outputs through the reverse ``all_to_all`` and local combine. MoE
+  FLOPs stay linear in tokens even with experts on different chips;
+  the price is two all-to-alls each way, which ``parallel.planner``
+  estimates against the capacity paths' quadratic dispatch.
+
+Planner guidance (``parallel/planner.py`` prices all four): "grouped" on
+a per-shard (no-EP) mesh; "grouped_ep" when experts shard across chips
+and per-chip token counts are large (all-to-all comm is linear in T
+where the capacity fallback's dispatch is quadratic); "gather" for
+small-token EP configs; "einsum" only as the testing oracle.
 """
 
 from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+# metric keys surfaced to callers of ``moe_ffn``; _routing may carry
+# additional internal entries (per-expert routing fractions the EP path
+# pmean-reduces to reproduce the GLOBAL aux loss exactly)
+PUBLIC_METRICS = ("dropped_frac", "expert_load")
 
 
 @dataclass
@@ -49,11 +73,22 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     router_jitter: float = 0.0  # multiplicative logit noise during training
     # "gather" (fast, capacity-based) | "einsum" (reference oracle) |
-    # "grouped" (DROPLESS Pallas grouped matmul — per-shard experts)
+    # "grouped" (DROPLESS Pallas grouped matmul — per-shard experts) |
+    # "grouped_ep" (DROPLESS + expert-parallel: shard_map + all_to_all
+    # around the grouped kernel — experts sharded over ``ep_axes``)
     dispatch: str = "gather"
     # grouped-dispatch kernel mode: None = auto (interpreter off TPU),
     # False forces Mosaic (the deviceless-AOT contract)
     kernel_interpret: Optional[bool] = None
+    # "grouped_ep" only: mesh axis name(s) forming the expert submesh
+    # (tokens shard their batch dim and expert weights their expert dim
+    # over these axes). The default matches the canonical rule sets'
+    # (data x fsdp) expert submesh (``sharding_rules.moe_rules``).
+    ep_axes: Tuple[str, ...] = ("data", "fsdp")
+    # "grouped_ep" only: explicit mesh; None = the AMBIENT mesh
+    # (``jax.sharding.set_mesh``, what accelerate establishes while
+    # tracing) — rebuilt by every accelerate, so elastic-safe.
+    mesh: Any = None
 
 
 def _capacity(num_tokens: int, num_experts: int, factor: float,
@@ -127,6 +162,12 @@ def _routing(
         # pre-drop routing demand per expert, as a fraction of tokens;
         # uniform = 1/E. This is the signal the aux loss regularizes.
         "expert_load": routed / float(t * top_k),
+        # internal (not in PUBLIC_METRICS): the aux loss's two per-expert
+        # fraction vectors. The expert-parallel path pmean-reduces these
+        # across token shards — means of equal-sized local means ARE the
+        # global means, so the reduced aux equals the single-shard oracle
+        "frac_tokens": frac_tokens,
+        "frac_probs": frac_probs,
     }
     return rounds, aux_loss, metrics
 
@@ -300,6 +341,245 @@ def _moe_compute_grouped(params, xt, rounds, e, activation,
     )
 
 
+def ambient_ep_mesh(axes: Tuple[str, ...]):
+    """The ambient mesh (``shard_compat.ambient_mesh`` — what
+    ``accelerate`` establishes while tracing, on either jax era) when it
+    carries every axis in ``axes`` with none of them already manual;
+    else None.
+
+    Mirrors ``ops.ring_attention.ambient_ring_mesh``: a mesh frozen into
+    a config at startup would survive ``on_world_change``'s
+    re-accelerate and make the shard_map reference departed devices; the
+    ambient mesh is rebuilt with each accelerate, so ``dispatch=
+    "grouped_ep"`` stays elastic-safe with ``mesh=None``.
+    """
+    from dlrover_tpu.ops.shard_compat import ambient_mesh_with_axes
+
+    return ambient_mesh_with_axes(axes)
+
+
+def _resolve_ep_mesh(config: "MoEConfig"):
+    """(mesh, axes, ep_degree) for ``dispatch="grouped_ep"``.
+
+    ``(None, axes, 1)`` when no usable expert submesh exists — the
+    caller degrades to the per-shard "grouped" path (identical math;
+    the elastic world may legitimately have shrunk the submesh to 1).
+    """
+    axes = tuple(config.ep_axes)
+    mesh = config.mesh
+    if mesh is None:
+        mesh = ambient_ep_mesh(axes)
+        if mesh is None:
+            return None, axes, 1
+    sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))
+    missing = [a for a in axes if a not in sizes]
+    if missing:
+        raise ValueError(
+            f"grouped_ep: mesh {tuple(mesh.axis_names)} lacks expert "
+            f"submesh axes {missing}"
+        )
+    ep = math.prod(sizes[a] for a in axes)
+    return (mesh, axes, ep) if ep > 1 else (None, axes, 1)
+
+
+def _moe_compute_grouped_ep(params, xt, config: "MoEConfig", activation,
+                            mesh, axes: Tuple[str, ...], ep: int,
+                            rng, jitter: float,
+                            block_t: int = 128):
+    """DROPLESS dispatch with experts SHARDED over the ``axes`` submesh:
+    shard_map + two ``lax.all_to_all`` exchanges around the grouped
+    Pallas kernel — megablocks-style droplessness with MoE FLOPs linear
+    in tokens even when experts live on different chips.
+
+    Per shard (P = ep shards, el = E/P local experts, Tl local tokens,
+    n = Tl * top_k local assignments):
+
+      1. route the LOCAL tokens over all E experts (router replicated);
+         aux-loss fractions pmean across shards so the loss equals the
+         single-shard oracle exactly;
+      2. exchange per-(dest shard, local expert) COUNTS with a tiny
+         int32 all_to_all — the receiver can then compute every row's
+         tile-aligned destination locally, so all row buffers keep
+         STATIC shapes (zero recompiles across steps);
+      3. exchange token rows with a [P, n, D] all_to_all (block s =
+         rows destined to shard s, grouped by that shard's local
+         experts in local arrival order). n is the static worst case —
+         all local assignments to one shard — which is what droplessness
+         without dynamic shapes costs; the planner prices exactly these
+         bytes (``planner`` "moe_disp_comm_s");
+      4. regroup received rows by local expert, pad each group to the
+         row tile, run the two grouped GEMMs (the per-shard kernel,
+         unchanged — every local expert owns >= 1 tile so dw blocks
+         initialize, see ``grouped_matmul``);
+      5. reverse all_to_all and combine locally (unsort + gate, summing
+         each token's top_k rounds).
+
+    Differentiable end to end: the collectives transpose to their
+    reverses and the kernel brings its custom VJP, so the backward runs
+    the same two all-to-alls in the opposite direction.
+
+    Returns (out [T, D], aux_loss, metrics) — metrics are the pmean'd
+    global load-balance signals, ``dropped_frac`` identically 0.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.ops.shard_compat import (
+        get_shard_map,
+        shard_map_check_kwargs,
+    )
+
+    shard_map = get_shard_map()
+
+    t, d = xt.shape
+    e = config.num_experts
+    top_k = config.top_k
+    if e % ep:
+        raise ValueError(
+            f"grouped_ep: num_experts={e} not divisible by the expert "
+            f"submesh of {ep} shards ({axes})"
+        )
+    if t % ep:
+        raise ValueError(
+            f"grouped_ep: {t} tokens not divisible by the expert "
+            f"submesh of {ep} shards ({axes})"
+        )
+    el = e // ep
+    interpret = config.kernel_interpret
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def body(xt_l, router_k, up_l, down_l, rng_l):
+        tl = xt_l.shape[0]
+        shard = lax.axis_index(axes)
+        # decorrelate router jitter across token shards
+        rng_s = jax.random.fold_in(rng_l, shard)
+        logits = xt_l @ router_k  # [Tl, E]
+        # capacity = Tl: dropless — nothing can overflow, and the
+        # round positions ARE per-expert local arrival ranks
+        rounds, _, metrics_l = _routing(logits, tl, top_k, rng_s, jitter)
+
+        k = len(rounds)
+        n = tl * k
+        expert_a = jnp.concatenate([r[0] for r in rounds])  # [n] i32
+        gate_a = jnp.concatenate([r[3] for r in rounds])  # [n] f32
+        rank_a = jnp.concatenate([r[1] for r in rounds])  # [n] i32
+        token_a = jnp.tile(jnp.arange(tl, dtype=jnp.int32), k)
+        # contiguous expert ownership: expert g lives on shard g // el
+        # as local expert g % el — exactly how PartitionSpec shards the
+        # leading [E] dim over the (row-major) combined axis index
+        dest = expert_a // el  # [n] owner shard
+        le_a = expert_a % el  # [n] owner's local expert
+        counts = jnp.zeros((ep, el), jnp.int32).at[dest, le_a].add(1)
+        # send layout: per-dest block of n rows; within a block, rows
+        # group by the dest's local expert in local arrival order
+        block_off = jnp.cumsum(counts, axis=1) - counts  # [P, el]
+        send_pos = dest * n + block_off[dest, le_a] + rank_a  # unique
+        send_token = jnp.full((ep * n,), tl, jnp.int32).at[send_pos].set(
+            token_a
+        )
+        x_pad = jnp.concatenate(
+            [xt_l, jnp.zeros((1, d), xt_l.dtype)], axis=0
+        )
+        x_send = x_pad[send_token]  # [P*n, D]; pad rows = zero sentinel
+
+        # all-to-all #1 (tiny): counts — recv[s, le] = rows shard s is
+        # sending for my local expert le
+        recv = lax.all_to_all(counts, axes, 0, 0)  # [P, el]
+        # all-to-all #2: the token rows themselves
+        x_recv = lax.all_to_all(
+            x_send.reshape(ep, n, d), axes, 0, 0
+        )  # [P, n, D]; block s = rows from shard s
+
+        # regroup incoming rows by local expert, tile-aligned — all
+        # index math from the exchanged counts, shapes all static
+        csum = jnp.cumsum(recv, axis=1)  # [P, el]
+        tot = csum[:, -1]  # [P] real rows per source block
+        r_idx = jnp.arange(n, dtype=jnp.int32)
+        le_r = jax.vmap(
+            lambda c, r: jnp.searchsorted(c, r, side="right")
+        )(csum, jnp.broadcast_to(r_idx, (ep, n)))  # [P, n]
+        valid = r_idx[None, :] < tot[:, None]  # [P, n]
+        le_r = jnp.clip(le_r, 0, el - 1).astype(jnp.int32)
+        src_rows = jnp.arange(ep, dtype=jnp.int32)[:, None]
+        within = r_idx[None, :] - (csum - recv)[src_rows, le_r]
+        pre = jnp.cumsum(recv, axis=0) - recv  # rows from earlier shards
+        rank_r = pre[src_rows, le_r] + within  # [P, n] arrival rank
+        m_le = recv.sum(axis=0)  # [el] rows per local expert
+        padded = jnp.maximum(
+            (m_le + block_t - 1) // block_t, 1
+        ) * block_t
+        ends = jnp.cumsum(padded).astype(jnp.int32)
+        offs = (ends - padded).astype(jnp.int32)
+        # static bound: every group full + its tile padding (and every
+        # zero-row expert still owns one sentinel tile — dw init)
+        tp = ((ep * n + block_t - 1) // block_t) * block_t + el * block_t
+        dest_row = jnp.where(valid, offs[le_r] + rank_r, tp)  # [P, n]
+        q_flat = jnp.arange(ep * n, dtype=jnp.int32)
+        row_src = jnp.full((tp + 1,), ep * n, jnp.int32).at[
+            dest_row.reshape(-1)
+        ].set(q_flat)[:tp]
+        x_recv_pad = jnp.concatenate(
+            [x_recv.reshape(ep * n, d),
+             jnp.zeros((1, d), x_recv.dtype)], axis=0
+        )
+        x_sorted = x_recv_pad[row_src]  # [tp, D] expert-sorted
+        tile_start = jnp.arange(tp // block_t, dtype=jnp.int32) * block_t
+        tile_expert = jnp.clip(
+            jnp.searchsorted(ends, tile_start, side="right"), 0, el - 1
+        ).astype(jnp.int32)
+
+        from dlrover_tpu.ops.grouped_matmul import grouped_matmul
+
+        h = activation(grouped_matmul(
+            x_sorted, up_l, tile_expert, block_t, 512, interpret,
+        ))
+        y_sorted = grouped_matmul(
+            h, down_l, tile_expert, block_t, 512, interpret,
+        )
+
+        # back to the recv layout (invalid slots zero), reverse
+        # all-to-all returns each block to its source shard
+        y_flat = y_sorted[jnp.clip(dest_row, 0, tp - 1).reshape(-1)]
+        y_flat = jnp.where(
+            valid.reshape(-1)[:, None], y_flat, 0
+        ).astype(xt_l.dtype)
+        y_ret = lax.all_to_all(y_flat.reshape(ep, n, d), axes, 0, 0)
+        # combine: each assignment's result sits at its own send_pos
+        y_a = y_ret.reshape(ep * n, d)[send_pos]  # [n, D]
+        out_l = jnp.zeros((tl, d), xt_l.dtype).at[token_a].add(
+            (y_a * gate_a[:, None].astype(y_a.dtype)).astype(xt_l.dtype)
+        )
+
+        # aux loss from GLOBAL routing fractions: pmean of equal-sized
+        # local means == the global mean, so this equals the oracle
+        ft = lax.pmean(metrics_l["frac_tokens"], axes)
+        fp = lax.pmean(metrics_l["frac_probs"], axes)
+        aux = e * jnp.sum(ft * fp) / max(1, top_k)
+        load = lax.pmean(metrics_l["expert_load"], axes)
+        return out_l, aux, load
+
+    spec_tok = P(axes)  # dim 0 over the combined expert submesh
+    spec_exp = P(axes)  # weights: expert dim over the same submesh
+    rep = P()
+    check_kw = shard_map_check_kwargs(shard_map)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_tok, rep, spec_exp, spec_exp, rep),
+        out_specs=(spec_tok, rep, rep),
+        **check_kw,
+    )
+    out, aux, load = fn(
+        xt, params["router"]["kernel"],
+        params["experts"]["up"]["kernel"],
+        params["experts"]["down"]["kernel"],
+        rng,
+    )
+    metrics = {
+        "dropped_frac": jnp.zeros((), jnp.float32),  # dropless
+        "expert_load": load,
+    }
+    return out, aux.astype(jnp.float32), metrics
+
+
 def moe_ffn(
     params: dict,
     x: jax.Array,  # [B, S, D]
@@ -317,18 +597,34 @@ def moe_ffn(
     load-balance observability signals, computed by the router at
     negligible cost and surfaced as step metrics by the trainer.
     """
-    if config.dispatch not in ("gather", "einsum", "grouped"):
+    dispatch = config.dispatch
+    if dispatch not in ("gather", "einsum", "grouped", "grouped_ep"):
         raise ValueError(
             f"unknown MoE dispatch {config.dispatch!r}; choose "
-            f"'gather' (fast, capacity), 'einsum' (reference oracle) "
-            f"or 'grouped' (dropless Pallas kernel)"
+            f"'gather' (fast, capacity), 'einsum' (reference oracle), "
+            f"'grouped' (dropless Pallas kernel, per-shard experts) or "
+            f"'grouped_ep' (dropless + expert-parallel all-to-all)"
         )
     b, s, d = x.shape
     t = b * s
     xt = x.reshape(t, d)
+    jitter = config.router_jitter if train else 0.0
+    if dispatch == "grouped_ep":
+        mesh, axes, ep = _resolve_ep_mesh(config)
+        if ep > 1:
+            # routing happens INSIDE the shard_map (per local shard) so
+            # the two all-to-alls move rows straight to owner experts
+            out, aux, metrics = _moe_compute_grouped_ep(
+                params, xt, config, activation, mesh, axes, ep,
+                rng, jitter,
+            )
+            return out.reshape(b, s, d), aux, metrics
+        # no usable expert submesh (single shard, elastic shrink, or no
+        # mesh context): the per-shard dropless path is the same math
+        dispatch = "grouped"
     logits = xt @ params["router"]["kernel"]  # [T, E]
     factor = config.capacity_factor if train else config.eval_capacity_factor
-    if config.dispatch == "grouped":
+    if dispatch == "grouped":
         # DROPLESS: no capacity limit — every assignment is served, so
         # route with capacity = T (nothing can overflow) and the
         # metrics honestly report dropped_frac == 0
@@ -337,16 +633,16 @@ def moe_ffn(
         capacity = _capacity(t, config.num_experts, factor,
                              config.top_k)
     rounds, aux, metrics = _routing(
-        logits, capacity, config.top_k, rng,
-        config.router_jitter if train else 0.0,
+        logits, capacity, config.top_k, rng, jitter,
     )
-    if config.dispatch == "grouped":
+    metrics = {k: metrics[k] for k in PUBLIC_METRICS}
+    if dispatch == "grouped":
         out = _moe_compute_grouped(
             params, xt, rounds, config.num_experts, activation,
             interpret=config.kernel_interpret,
         )
     else:
-        compute = (_moe_compute_einsum if config.dispatch == "einsum"
+        compute = (_moe_compute_einsum if dispatch == "einsum"
                    else _moe_compute_gather)
         out = compute(params, xt, rounds, capacity, config.num_experts,
                       activation)

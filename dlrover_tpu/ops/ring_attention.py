@@ -56,24 +56,12 @@ def ambient_ring_mesh(axis_name: str = "seq"):
     ambient mesh is rebuilt with each accelerate. A manual (already
     inside shard_map) seq axis returns None so the caller falls back to
     ``ring_attention_local`` — the body form — instead of illegally
-    nesting shard_maps."""
-    try:
-        mesh = jax.sharding.get_abstract_mesh()
-    except Exception:  # noqa: BLE001 — no mesh context
-        return None
-    names = tuple(getattr(mesh, "axis_names", ()) or ())
-    if axis_name not in names:
-        return None
-    sizes = dict(zip(names, mesh.axis_sizes))
-    if sizes[axis_name] <= 1:
-        return None
-    try:
-        types = dict(zip(names, mesh.axis_types))
-        if "manual" in str(types[axis_name]).lower():
-            return None
-    except Exception:  # noqa: BLE001 — axis_types absent on old jax
-        pass
-    return mesh
+    nesting shard_maps. Both jax eras (``set_mesh`` abstract mesh, or
+    the legacy ``with mesh:`` thread-resources context) resolve through
+    ``shard_compat.ambient_mesh_with_axes``."""
+    from dlrover_tpu.ops.shard_compat import ambient_mesh_with_axes
+
+    return ambient_mesh_with_axes((axis_name,))
 
 
 def impl_from_flags(use_flash: bool, flash_interpret) -> Optional[str]:
@@ -285,7 +273,8 @@ def ring_attention_local(
     attended, not skipped. Requires ``causal=True`` and no
     ``segment_ids``.
     """
-    n = lax.axis_size(axis_name)
+    n = (lax.axis_size(axis_name) if hasattr(lax, "axis_size")
+         else lax.psum(1, axis_name))  # old jax: constant-folded psum
     my = lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if impl is None:
@@ -445,7 +434,12 @@ def ring_attention(
     (GLM prefix-LM) shards on batch only; see ``ring_attention_local``
     for the ring decomposition of the prefix mask.
     """
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_compat import (
+        get_shard_map,
+        shard_map_check_kwargs,
+    )
+
+    shard_map = get_shard_map()
 
     if head_axis is not None:
         # GQA kv heads must still divide the head mesh axis; when they
@@ -476,17 +470,7 @@ def ring_attention(
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
     spec = P(batch_axes, head_axis, axis_name, None)
-    # pallas_call out_shapes carry no varying-mesh-axes metadata, so
-    # vma/replication checking cannot see through the kernel; the knob
-    # is check_vma on current jax, check_rep on older shard_map
-    import inspect
-
-    params = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False} if "check_vma" in params
-        else {"check_rep": False} if "check_rep" in params
-        else {}
-    )
+    check_kw = shard_map_check_kwargs(shard_map)
     body = functools.partial(
         ring_attention_local, axis_name=axis_name, causal=causal,
         scale=scale, impl=impl, block_q=block_q, block_k=block_k,

@@ -363,12 +363,25 @@ def aot_compile_train_step(
     # physical — the round-2 artifact claimed 1.31 from an uncalibrated
     # compute term).
     costs = compiled.cost_analysis() or {}
+    if isinstance(costs, (list, tuple)):  # old jax: one dict per program
+        costs = costs[0] if costs else {}
     pipe_kwargs = {}
     if pipeline:
+        from dlrover_tpu.ops.remat import remat_enabled
+
         pipe_kwargs = dict(
             pipe_microbatches=pipeline["num_microbatches"],
             pipe_virtual=pipeline.get("num_virtual", 1),
             stage_depths=pipeline.get("stage_depths"),
+            # whether the compiled program ACTUALLY replays each
+            # stage's forward: apply_pipelined keys remat_stage off the
+            # MODEL config's policy, not the strategy-level string the
+            # estimate would otherwise infer from (ADVICE r5 #4 — a
+            # blank strategy policy with model-internal remat on used
+            # to drop the replay factor from the prediction)
+            stage_remat=remat_enabled(
+                getattr(config, "remat_policy", "") or ""
+            ),
         )
     score = planner.estimate(mesh_plan, model, device_spec,
                              remat_policy=effective_remat,

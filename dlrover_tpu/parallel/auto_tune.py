@@ -45,6 +45,31 @@ class DryrunReport:
         return self.flops_per_step / self.step_time_s
 
 
+def _process_local_slice(batch, process_count: int, process_index: int):
+    """This process's contiguous row share of a GLOBAL example batch.
+
+    Raises when the rows don't divide evenly: floor division would
+    silently drop the trailing ``rows % process_count`` rows, and the
+    assembled global batch would no longer match
+    ``strategy.global_batch_size`` (the dryrun would then profile a
+    different program than production runs).
+    """
+    rows = jax.tree.leaves(batch)[0].shape[0]
+    if rows % process_count:
+        raise ValueError(
+            f"dryrun example batch has {rows} rows, not divisible by "
+            f"process_count={process_count}: the per-process slice "
+            f"would silently drop the trailing {rows % process_count} "
+            f"row(s). Pad or trim the example batch to a multiple of "
+            f"the process count."
+        )
+    share = rows // process_count
+    return jax.tree.map(
+        lambda x: x[share * process_index: share * (process_index + 1)],
+        batch,
+    )
+
+
 def dryrun(result: AccelerateResult, example_batch, rng=None,
            warmup_steps: int = 1, profile_steps: int = 3,
            trace_dir: str = "") -> DryrunReport:
@@ -62,11 +87,8 @@ def dryrun(result: AccelerateResult, example_batch, rng=None,
             # rows; every engine node holds the same GLOBAL example, so
             # slice this process's share (otherwise the dryrun would
             # assemble — and time — a process_count-times larger batch)
-            pc, pid = jax.process_count(), jax.process_index()
-            example_batch = jax.tree.map(
-                lambda x: x[(x.shape[0] // pc) * pid:
-                            (x.shape[0] // pc) * (pid + 1)],
-                example_batch,
+            example_batch = _process_local_slice(
+                example_batch, jax.process_count(), jax.process_index()
             )
         batch = result.shard_batch(example_batch)
 

@@ -65,6 +65,13 @@ class ModelSpec:
     # (0 = MHA, kv bytes == activation bytes)
     num_heads: int = 0
     kv_heads: int = 0
+    # switch-MoE shape (0 experts = dense). The dispatch choice changes
+    # the cost STRUCTURE, not just a constant: see _moe_dispatch_terms.
+    num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    # "gather" | "einsum" | "grouped" | "grouped_ep" (ops.moe dispatches)
+    moe_dispatch: str = "gather"
 
 
 # Recompute multiplier on executed FLOPs per remat policy: "full" re-runs
@@ -203,6 +210,69 @@ def ring_kv_repeat(kv_heads: int, num_heads: int,
     return None
 
 
+def _moe_dispatch_terms(
+    model: ModelSpec,
+    device: DeviceSpec,
+    eff: float,
+    tokens_per_chip: float,
+    ep: int,
+) -> Tuple[float, float]:
+    """(extra compute seconds, extra ICI seconds) the MoE DISPATCH adds
+    per step — the term that ranks ``grouped_ep`` against the capacity
+    paths honestly (the expert GEMMs themselves ride the 6N model-FLOPs
+    compute term like every other matmul).
+
+    Cost structure per layer (t = tokens/chip, k = top_k, cf =
+    capacity_factor, D = hidden, P = expert-parallel degree):
+
+      einsum, and gather when experts shard over the EP submesh (P>1):
+        the one-hot [T,E,C] dispatch/combine einsums — the gather
+        path's data-dependent scatters are opaque to GSPMD across the
+        expert axis, so the EP-sharded lowering falls back to exactly
+        this capacity-shaped movement. 2 einsums x 2TECD FLOPs x 3
+        (fwd+bwd) with E*C = cf*k*t  =>  12*cf*k*t^2*D — QUADRATIC in
+        tokens.
+      gather / grouped per-shard (P==1): slot-map gathers, O(t*D) HBM
+        bytes — linear and tiny.
+      grouped_ep: two all_to_alls fwd + their transposes bwd moving the
+        static dropless row buffer [P, t*k, D] => 4*P*t*k*D bytes on
+        ICI — LINEAR in tokens. (The buffer is the static-shape worst
+        case the implementation actually exchanges; see
+        ``ops.moe._moe_compute_grouped_ep``.)
+
+    The quadratic-vs-linear structure crosses over: below ~12k
+    tokens/chip (v5e numbers) the capacity fallback wins, above it
+    ``grouped_ep`` does — ``tests/test_planner.py`` pins the flip.
+    """
+    if model.num_experts <= 0:
+        return 0.0, 0.0
+    t = tokens_per_chip
+    d = model.hidden_size
+    k = max(1, model.moe_top_k)
+    cf = model.moe_capacity_factor
+    layers = model.num_layers
+    dispatch = model.moe_dispatch
+    if dispatch == "einsum" or (dispatch == "gather" and ep > 1):
+        flops = 12.0 * cf * k * t * t * d * layers
+        return flops / (device.flops_per_s * eff), 0.0
+    if dispatch == "grouped_ep" and ep > 1:
+        ici_bytes = 4.0 * ep * t * k * d * model.dtype_bytes * layers
+        return 0.0, ici_bytes / device.ici_bw
+    if dispatch == "grouped" and ep > 1:
+        # the kernel is opaque to GSPMD: EP-sharded expert weights get
+        # all-gathered to every chip each layer (fwd + the grad
+        # reduce-scatter bwd) — price that honestly so the planner
+        # steers EP meshes to grouped_ep/gather instead
+        w_bytes = (2.0 * model.num_experts * d * (model.ffn_mult * d)
+                   * model.dtype_bytes)
+        ici_bytes = 3.0 * w_bytes * (ep - 1) / ep * layers
+        return 0.0, ici_bytes / device.ici_bw
+    # per-shard gather/grouped (and grouped_ep degraded to P==1):
+    # slot-gather/sort data movement, a few passes over the token rows
+    hbm_bytes = 4.0 * cf * k * t * d * model.dtype_bytes * layers
+    return hbm_bytes / device.hbm_bw, 0.0
+
+
 def estimate(
     plan: MeshPlan,
     model: ModelSpec,
@@ -212,6 +282,7 @@ def estimate(
     pipe_microbatches: int = 0,
     pipe_virtual: int = 1,
     stage_depths=None,
+    stage_remat: Optional[bool] = None,
 ) -> PlanScore:
     """Analytic step-time + memory estimate for one mesh factorization.
 
@@ -230,8 +301,19 @@ def estimate(
       dp comm  : gradient allreduce over the data axis.
       seq comm : ring-attention KV rotation — only the (possibly
                  repeated, ``ring_kv_repeat``) kv heads travel.
+      moe disp : MoE dispatch overhead per ``model.moe_dispatch`` —
+                 quadratic one-hot einsums for the capacity paths under
+                 EP, linear all-to-all bytes for "grouped_ep"
+                 (``_moe_dispatch_terms``; ep degree = data x fsdp, the
+                 expert submesh of the canonical rule sets).
       memory   : params+optimizer sharded over (fsdp x tensor x pipe),
                  activations for one microbatch per layer (remat floor).
+
+    ``stage_remat``: whether the model ACTUALLY applies stage-boundary
+    remat when pipelined (``apply_pipelined`` derives it from the MODEL
+    config's remat_policy, not the strategy's) — pass it from aot/
+    callers that know; None falls back to inferring from
+    ``remat_policy``.
     """
     pipe = max(getattr(plan, "pipe", 1), 1)
     data = max(getattr(plan, "data", 1), 1)
@@ -245,13 +327,19 @@ def estimate(
     from dlrover_tpu.ops.remat import remat_enabled
 
     recompute = REMAT_RECOMPUTE.get(remat_policy or "", 1.0)
-    if pipe > 1 and remat_enabled(remat_policy):
+    stage_remat_on = (stage_remat if stage_remat is not None
+                      else remat_enabled(remat_policy))
+    if pipe > 1 and stage_remat_on:
         # pipelined stages run under STAGE-BOUNDARY remat (the tick
         # scan stores only one state per tick; dispatch_pipeline's
         # remat_stage): the backward replays each stage's forward, so
         # executed FLOPs are at least the save-nothing factor (8/6 =
         # fwd + fwd-replay + bwd over fwd + bwd) regardless of how
-        # much the inner per-layer policy saves during the replay
+        # much the inner per-layer policy saves during the replay.
+        # The models key remat_stage off the MODEL config's policy, so
+        # callers that know it pass stage_remat explicitly — the
+        # strategy-level string may be empty while the model remats
+        # (examples/train_llama.py), or vice versa.
         recompute = max(recompute, REMAT_RECOMPUTE["full"])
     eff = min(
         efficiency if efficiency is not None else calibrated_efficiency(),
@@ -336,9 +424,19 @@ def estimate(
         kv_bytes = 2 * act_elems * model.dtype_bytes * kv_frac
         seq_comm_s = model.num_layers * (seq - 1) * kv_bytes / device.ici_bw
 
+    # ---- MoE dispatch overhead (quadratic capacity einsums vs linear
+    # all-to-all bytes): ep degree = data x fsdp, the expert submesh of
+    # the canonical rule sets (mesh.py: "expert" aliases data x fsdp)
+    tokens_per_chip = rows * (model.seq_len / seq)
+    moe_disp_comp_s, moe_disp_comm_s = _moe_dispatch_terms(
+        model, device, eff, tokens_per_chip, data * fsdp
+    )
+    compute_s += moe_disp_comp_s
+
     # comm overlaps with compute imperfectly; charge the max of compute
     # and total comm plus a fraction of the smaller (conservative)
-    comm_s = tp_comm_s + fsdp_comm_s + dp_comm_s + seq_comm_s + pipe_comm_s
+    comm_s = (tp_comm_s + fsdp_comm_s + dp_comm_s + seq_comm_s
+              + pipe_comm_s + moe_disp_comm_s)
     step_s = max(compute_s, comm_s) + 0.25 * min(compute_s, comm_s)
 
     # ---- memory (modeled on the production path: flash attention, so
@@ -412,6 +510,8 @@ def estimate(
             "dp_comm_s": dp_comm_s,
             "seq_comm_s": seq_comm_s,
             "pipe_comm_s": pipe_comm_s,
+            "moe_disp_comp_s": moe_disp_comp_s,
+            "moe_disp_comm_s": moe_disp_comm_s,
             "param_shard_bytes": param_shard,
             "grad_temp_bytes": grad_temp,
             "gather_buf_bytes": gather_buf,
@@ -522,4 +622,8 @@ def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
         ffn_mult=config.intermediate_size / config.hidden_size,
         num_heads=config.num_heads,
         kv_heads=config.num_kv_heads,
+        num_experts=config.num_experts,
+        moe_top_k=config.moe_top_k,
+        moe_capacity_factor=config.moe_capacity_factor,
+        moe_dispatch=config.moe_dispatch,
     )

@@ -333,3 +333,24 @@ def moe_rules() -> ShardingRules:
         (r"router/kernel$", REPLICATED),
         *rules,
     ])
+
+
+def moe_ep_rules() -> ShardingRules:
+    """Expert-parallel MoE for the DROPLESS ``dispatch="grouped_ep"``
+    path (``ops.moe._moe_compute_grouped_ep``): expert weight blocks
+    sharded on the (data x fsdp) expert submesh like ``moe_rules``, but
+    the expert FFN dims stay UNSHARDED — the grouped Pallas kernel runs
+    per shard inside a shard_map, so a "tensor" split of d_ff would
+    force an all-gather at the shard_map boundary every layer instead
+    of a partitioned matmul. Dense (attention) params keep the llama
+    TP/FSDP layout."""
+    rules = llama_rules().rules
+    return ShardingRules(rules=[
+        # stacked [L, E, D, F] layer variants first (rank-4 binds here)
+        (r"layers/.*experts/(up|down)/kernel$",
+         (None, ("data", "fsdp"), None, None)),
+        # unstacked [E, D, F] module trees (direct moe_ffn params)
+        (r"experts/(up|down)/kernel$", (("data", "fsdp"), None, None)),
+        (r"router/kernel$", REPLICATED),
+        *rules,
+    ])

@@ -32,6 +32,7 @@ from dlrover_tpu.parallel.sharding_rules import (
     gpt2_pp_rules,
     llama_pp_rules,
     llama_rules,
+    moe_ep_rules,
     moe_rules,
     neox_pp_rules,
     neox_rules,
@@ -42,6 +43,10 @@ RULE_SETS = {
     "llama": llama_rules,
     "llama_pp": llama_pp_rules,
     "moe": moe_rules,
+    # dropless expert-parallel ("grouped_ep" dispatch): expert FFN dims
+    # unsharded so the grouped Pallas kernel stays per-shard inside its
+    # shard_map; experts over (data x fsdp) as in "moe"
+    "moe_ep": moe_ep_rules,
     "bert": bert_rules,
     "bert_pp": bert_pp_rules,
     "clip": clip_rules,

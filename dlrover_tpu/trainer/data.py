@@ -194,14 +194,24 @@ class DevicePreloader:
     computes hides the PCIe/host time. ``sharding`` may be a
     NamedSharding (the accelerate batch spec) so the prefetch lands
     pre-sharded on the mesh.
+
+    ``global_rows``: the GLOBAL batch row count (e.g.
+    ``strategy.global_batch_size``). On a multi-host sharding each
+    process feeds its PROCESS-LOCAL rows; with ``global_rows`` known,
+    ``put_global_batch`` validates that loudly — a caller feeding the
+    global batch on every host would otherwise silently assemble a
+    process_count-times larger batch of duplicated rows. 0 skips the
+    check (single-process shardings are unaffected either way).
     """
 
-    def __init__(self, iterable, sharding=None, prefetch: int = 2):
+    def __init__(self, iterable, sharding=None, prefetch: int = 2,
+                 global_rows: int = 0):
         if prefetch < 1:
             raise ValueError("prefetch must be >= 1")
         self._iterable = iterable
         self._sharding = sharding
         self._prefetch = prefetch
+        self._global_rows = int(global_rows)
 
     def _put(self, batch):
         import jax
@@ -212,7 +222,8 @@ class DevicePreloader:
             # any sharding type) stay on plain device_put
             from dlrover_tpu.parallel.accelerate import put_global_batch
 
-            return put_global_batch(batch, self._sharding)
+            return put_global_batch(batch, self._sharding,
+                                    self._global_rows)
         return jax.device_put(batch)
 
     def __iter__(self):

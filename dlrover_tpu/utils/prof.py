@@ -48,6 +48,8 @@ def analyze_cost(fn: Callable, *args, **kwargs) -> CostReport:
     """Compile ``fn`` for the given args and read XLA's cost model."""
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
     cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):  # old jax: one dict per program
+        cost = cost[0] if cost else {}
     report = CostReport(
         flops=float(cost.get("flops", 0.0)),
         bytes_accessed=float(cost.get("bytes accessed", 0.0)),

@@ -8,6 +8,15 @@ virtual CPU mesh, mirroring the reference's gloo-on-CPU test strategy
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# libtpu's init (reached by the deviceless-AOT tests through
+# jax.experimental.topologies) probes the GCE metadata server for TPU
+# worker hostnames; off-GCE that probe is a ~460 s silent network
+# timeout at ~0% CPU — nearly half the tier-1 wall budget. Skip the
+# query and point the metadata addresses at a fast-refusing local port
+# (setdefault: a real TPU host can still override).
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
+os.environ.setdefault("GCE_METADATA_IP", "127.0.0.1:1")
+os.environ.setdefault("GCE_METADATA_HOST", "127.0.0.1:1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -28,3 +37,11 @@ os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def mesh_ctx(mesh):
+    """Context establishing ``mesh`` as the ambient mesh for a test:
+    ``jax.sharding.set_mesh`` when present; on legacy jax the Mesh
+    itself is the (thread-resources) ambient-mesh context manager."""
+    set_mesh = getattr(jax.sharding, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh

@@ -15,6 +15,7 @@ from dlrover_tpu.parallel.sharding_rules import (
     llama_rules,
 )
 from dlrover_tpu.parallel.strategy import Strategy
+from conftest import mesh_ctx
 
 
 class TestMeshPlan:
@@ -243,7 +244,7 @@ class TestShardedFlashAttention:
         )
 
         devices = np.asarray(jax.devices()).reshape(8)
-        with jax.sharding.set_mesh(Mesh(devices, ("data",))):
+        with mesh_ctx(Mesh(devices, ("data",))):
             assert ambient_shard_mesh() is None
             q = jnp.ones((2, 4, 64, 32), jnp.float32)
             out = flash_attention_auto(q, q, q, True)

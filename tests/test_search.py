@@ -133,3 +133,25 @@ class TestModuleReplace:
     def test_catalog(self):
         assert "flash_attention" in available_replacements("llama")
         assert "ring_attention" not in available_replacements("gpt2")
+
+
+class TestDryrunProcessSlice:
+    """dryrun's per-process slice of the GLOBAL example batch must
+    never silently drop trailing rows (the assembled global batch would
+    stop matching strategy.global_batch_size)."""
+
+    def test_even_rows_slice_cleanly(self):
+        from dlrover_tpu.parallel.auto_tune import _process_local_slice
+
+        batch = {"x": np.arange(12).reshape(6, 2)}
+        for pid in range(3):
+            out = _process_local_slice(batch, 3, pid)
+            assert out["x"].shape == (2, 2)
+            assert out["x"][0, 0] == pid * 4  # contiguous shares
+
+    def test_indivisible_rows_raise(self):
+        from dlrover_tpu.parallel.auto_tune import _process_local_slice
+
+        batch = {"x": np.zeros((7, 2))}
+        with pytest.raises(ValueError, match="not divisible"):
+            _process_local_slice(batch, 3, 0)

@@ -4,6 +4,16 @@ executor's emergency save. Emits one status line per step; on restart
 (a checkpoint exists) it logs the resumed step and exits.
 
 Env: PREEMPT_CKPT_DIR (checkpoint root), PREEMPT_STATUS (jsonl path).
+
+PREEMPT_SLOW_AFTER=N (>0): slow-step mode — step N blocks the loop for
+PREEMPT_SLOW_SECS (default 300) INSIDE the step path, before the
+executor can reach its preemption-flag check, emitting a "slow" event
+first. This emulates a wedged/ tens-of-seconds device step on real TPU:
+the first SIGTERM is flagged-and-swallowed (the loop never returns to
+check it), and only the second-SIGTERM escape hatch — the handler
+re-arms the default disposition after the first notice — can end the
+process. Slow mode also saves a checkpoint EVERY step (steps=1) so the
+kill lands with a staged/committed save chain to corrupt-or-not.
 """
 
 import json
@@ -40,6 +50,8 @@ def emit(record):
 # the emergency save flushes pipe-sharded state (stage-stacked layer
 # params on "pipe") rather than the single-device layout
 PIPELINED = os.environ.get("PREEMPT_PIPELINE", "") == "1"
+SLOW_AFTER = int(os.environ.get("PREEMPT_SLOW_AFTER", "0"))
+SLOW_SECS = float(os.environ.get("PREEMPT_SLOW_SECS", "300"))
 
 cfg = llama.llama_tiny(num_layers=4 if PIPELINED else 2,
                        max_seq_len=64, use_flash=False)
@@ -74,9 +86,12 @@ trainer = ElasticTrainer(
     batch,
     strategy=strategy,
     ckpt_dir=CKPT,
-    # no periodic cadence: steps=0/secs=0 never fires, so only the
-    # preemption path can produce a checkpoint
-    ckpt_interval=CheckpointInterval(steps=0, secs=0.0),
+    # default: no periodic cadence (steps=0/secs=0 never fires), so
+    # only the preemption path can produce a checkpoint. Slow-step mode
+    # saves EVERY step instead: the hard kill must leave the committed
+    # chain restorable.
+    ckpt_interval=(CheckpointInterval(steps=1, secs=0.0) if SLOW_AFTER
+                   else CheckpointInterval(steps=0, secs=0.0)),
 )
 
 
@@ -88,6 +103,14 @@ class StatusHook(TrainHook):
     def after_step(self, step, metrics):
         emit({"event": "step", "step": step,
               "loss": float(metrics["loss"])})
+        if SLOW_AFTER and step == SLOW_AFTER:
+            # block INSIDE the step path, before the executor's
+            # preempted-flag check: a SIGTERM arriving now is flagged
+            # but never acted on (PEP 475 resumes the sleep after the
+            # handler returns) — only a second SIGTERM, restored to the
+            # default disposition by the first, can end the process
+            emit({"event": "slow", "step": step})
+            time.sleep(SLOW_SECS)
         time.sleep(0.2)  # widen the kill window
 
 
