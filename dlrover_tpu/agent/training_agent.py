@@ -199,7 +199,14 @@ class ElasticTrainingAgent:
     def _membership_changed(self) -> bool:
         try:
             return self._rdzv_handler.num_nodes_waiting() > 0
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — master briefly unreachable
+            # "no change" is the safe answer for one poll, but say so: a
+            # master that stays unreachable makes the agent blind to
+            # scale-ups, which reads as "elasticity silently off" (DLR002)
+            logger.warning(
+                "num_nodes_waiting failed, assuming no membership change "
+                "this poll (%s: %s)", type(e).__name__, e,
+            )
             return False
 
     def _report_failure(self):
@@ -281,7 +288,13 @@ class NetworkCheckAgent:
         """Ranks the master's 2-round diagnosis marks as failed."""
         try:
             return self._client.abnormal_ranks()
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — master briefly unreachable
+            # an empty answer admits this node to training; log it so a
+            # flaky master can be distinguished from a clean bill (DLR002)
+            logger.warning(
+                "abnormal_ranks query failed, treating diagnosis as clean "
+                "(%s: %s)", type(e).__name__, e,
+            )
             return []
 
     def _run_probe(self, group: RendezvousInfo) -> tuple:

@@ -1,0 +1,24 @@
+"""Static analysis for the framework and for compiled SPMD programs.
+
+Two passes, one gate (ISSUE 2):
+
+* ``ast_rules`` — rule-based lint over the ``dlrover_tpu`` sources for
+  distributed-correctness pitfalls (RPCs without deadlines, swallowed
+  exceptions on failover paths, non-daemon control threads, host
+  impurity inside jit, shared mutable defaults).
+* ``graph_lint`` — SPMD lint of the lowered/compiled train step via the
+  same ``accelerate()``/AOT path production uses: host callbacks,
+  recompile hazards, dtype drift, dropped donation, silently replicated
+  params, and the planner-vs-HLO collective byte audit
+  (``parallel.planner.predicted_collective_bytes``).
+
+Run it: ``python -m dlrover_tpu.analysis`` (alias: ``tpulint``,
+``tpurun lint``). Keep it green: ``tests/test_lint_clean.py`` runs the
+AST pass in tier-1; the checked-in ``baseline.json`` allowlists legacy
+sites and ratchets down as they are fixed.
+
+This package must stay import-light (no jax at module scope): the CLI
+configures the virtual CPU mesh before jax loads.
+"""
+
+from dlrover_tpu.analysis.findings import Baseline, Finding  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m dlrover_tpu.analysis`` entry point."""
+
+import sys
+
+from dlrover_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,353 @@
+"""Framework AST linter: distributed-correctness pitfalls as rules.
+
+Each rule has a stable id (``DLR0xx``), a one-line message, and a fix-it
+hint. The rules encode the control-plane discipline the ElasWave /
+fault-tolerant-HSDP line of work (PAPERS.md) identifies as the dominant
+source of silent hangs and mystery slowdowns at scale:
+
+  DLR001 grpc-no-timeout       an RPC invocation that can block forever
+  DLR002 swallowed-exception   ``except Exception`` that hides the error
+  DLR003 non-daemon-thread     a background thread that pins shutdown
+  DLR004 impure-in-jit         host time/randomness captured at trace time
+  DLR005 shared-mutable-default mutable defaults aliased across instances
+
+Rules are deliberately syntactic (no type inference): they over-approximate
+in ways the checked-in baseline absorbs, and under-approximate in ways unit
+fixtures pin (``tests/test_analysis.py`` has one firing and one clean case
+per rule id).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from dlrover_tpu.analysis.findings import Finding
+
+LOG_METHODS_OK = {"exception", "error", "warning", "critical", "info",
+                  "debug", "log", "print_exc"}
+GRPC_FACTORY_METHODS = {"unary_unary", "unary_stream", "stream_unary",
+                        "stream_stream"}
+IMPURE_CALLS = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("datetime", "now"), ("datetime", "utcnow"),
+    ("np", "random"), ("numpy", "random"),
+    ("random", "random"), ("random", "randint"), ("random", "uniform"),
+    ("random", "choice"), ("random", "shuffle"), ("random", "sample"),
+    ("os", "urandom"),
+}
+MUTABLE_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict",
+                 "Counter", "deque"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords) or any(
+        kw.arg is None for kw in call.keywords  # **kwargs may carry it
+    )
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func).rsplit(".", 1)[-1]
+        return name in MUTABLE_CALLS and not node.args and not node.keywords
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module,
+                 enabled: Optional[Set[str]] = None):
+        self.path = path
+        self.tree = tree
+        self.enabled = enabled
+        self.findings: List[Finding] = []
+        self._scopes: List[str] = []
+        self._jit_depth = 0
+        self._imports_grpc = any(
+            isinstance(n, (ast.Import, ast.ImportFrom))
+            and "grpc" in ast.dump(n)
+            for n in tree.body
+        )
+        # names bound (anywhere in the module) from channel.unary_unary(..)
+        # factories: later bare calls through them must carry timeout=
+        self._grpc_callables: Set[str] = set()
+        if self._imports_grpc:
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                value = node.value
+                if (isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Attribute)
+                        and value.func.attr in GRPC_FACTORY_METHODS):
+                    for tgt in node.targets:
+                        name = _dotted(tgt)
+                        if name:
+                            self._grpc_callables.add(name)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _emit(self, rule_id: str, node: ast.AST, message: str,
+              fixit: str = ""):
+        if self.enabled is not None and rule_id not in self.enabled:
+            return
+        self.findings.append(Finding(
+            rule_id=rule_id, path=self.path,
+            line=getattr(node, "lineno", 0), message=message, fixit=fixit,
+            scope=".".join(self._scopes),
+        ))
+
+    def _visit_scope(self, node, name: str):
+        self._scopes.append(name)
+        jit = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and self._is_jitted(node)
+        if jit:
+            self._jit_depth += 1
+        self.generic_visit(node)
+        if jit:
+            self._jit_depth -= 1
+        self._scopes.pop()
+
+    @staticmethod
+    def _is_jitted(node) -> bool:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = _dotted(target)
+            if name.endswith("jit") or name in ("pjit", "jax.pjit"):
+                return True
+            # functools.partial(jax.jit, ...)
+            if (isinstance(dec, ast.Call) and name.endswith("partial")
+                    and dec.args
+                    and _dotted(dec.args[0]).endswith("jit")):
+                return True
+        return False
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._scopes.append(node.name)
+        self._check_class_mutable_defaults(node)
+        self._scopes.pop()
+        self._visit_scope(node, node.name)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._check_mutable_defaults(node)
+        self._visit_scope(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._check_mutable_defaults(node)
+        self._visit_scope(node, node.name)
+
+    # -- DLR001: grpc calls without a deadline ------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        if self._imports_grpc:
+            self._check_grpc_timeout(node)
+        if self._jit_depth > 0:
+            self._check_impure_in_jit(node)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "Thread"):
+            self._check_thread_daemon(node)
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id == "Thread"):
+            self._check_thread_daemon(node)
+        self.generic_visit(node)
+
+    def _check_grpc_timeout(self, node: ast.Call):
+        name = _dotted(node.func)
+        is_stub_call = name in self._grpc_callables
+        # .future(...) on a multicallable (async fan-out idiom): the
+        # deadline must ride the .future() call — .result() alone cannot
+        # cancel the in-flight RPC
+        is_future_call = (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "future"
+                          and not name.startswith(("concurrent.",
+                                                   "asyncio.")))
+        if (is_stub_call or is_future_call) and not _has_kwarg(
+                node, "timeout"):
+            self._emit(
+                "DLR001", node,
+                f"gRPC invocation `{name or node.func.attr}(...)` without "
+                f"a timeout= deadline: a dead peer blocks this call (and "
+                f"the failover logic behind it) forever",
+                "pass timeout=<seconds>; route it from the caller's "
+                "config rather than hardcoding",
+            )
+
+    # -- DLR002: except Exception that swallows -----------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")
+        ) or (
+            isinstance(node.type, ast.Attribute)
+            and node.type.attr in ("Exception", "BaseException")
+        )
+        if broad and not self._handler_surfaces_error(node):
+            self._emit(
+                "DLR002", node,
+                "broad `except Exception` swallows the error silently: on "
+                "a failover/rendezvous path this converts a crash into a "
+                "hang or a wrong decision with no trace",
+                "narrow the exception type, or log the error "
+                "(logger.warning/.exception) before continuing, or "
+                "re-raise",
+            )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _handler_surfaces_error(node: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call):
+                attr = (sub.func.attr
+                        if isinstance(sub.func, ast.Attribute) else
+                        sub.func.id if isinstance(sub.func, ast.Name)
+                        else "")
+                if attr in LOG_METHODS_OK:
+                    return True
+        return False
+
+    # -- DLR003: background threads that outlive shutdown -------------------
+
+    def _check_thread_daemon(self, node: ast.Call):
+        name = _dotted(node.func)
+        if name and not (name == "Thread"
+                         or name.endswith(".Thread")):
+            return
+        if not _has_kwarg(node, "daemon"):
+            self._emit(
+                "DLR003", node,
+                "Thread(...) without daemon=: a non-daemon background "
+                "thread blocks interpreter exit, turning a master/agent "
+                "crash-restart into a hang",
+                "pass daemon=True (or daemon=False with an explicit "
+                "join on the shutdown path)",
+            )
+
+    # -- DLR004: host impurity inside jit -----------------------------------
+
+    def _check_impure_in_jit(self, node: ast.Call):
+        name = _dotted(node.func)
+        parts = tuple(name.split("."))
+        hit = tuple(parts[-2:]) in IMPURE_CALLS or name.startswith(
+            ("np.random.", "numpy.random.")
+        )
+        if hit:
+            self._emit(
+                "DLR004", node,
+                f"`{name}()` inside a jit-compiled function is evaluated "
+                f"once at trace time and frozen into the graph — every "
+                f"step reuses the same 'current' time / random draw",
+                "thread host values in as arguments, or use jax.random "
+                "with an explicit key",
+            )
+
+    # -- DLR005: shared mutable defaults ------------------------------------
+
+    def _check_mutable_defaults(self, node):
+        args = node.args
+        for default in list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None
+        ]:
+            if _is_mutable_literal(default):
+                self._emit(
+                    "DLR005", default,
+                    f"mutable default argument in `{node.name}(...)`: the "
+                    f"object is created once and shared by every call",
+                    "default to None and construct inside the body (or "
+                    "use dataclasses.field(default_factory=...))",
+                )
+
+    def _check_class_mutable_defaults(self, node: ast.ClassDef):
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign) or stmt.value is None:
+                continue
+            ann = ast.dump(stmt.annotation)
+            if "ClassVar" in ann:
+                continue
+            if _is_mutable_literal(stmt.value):
+                target = (stmt.target.id
+                          if isinstance(stmt.target, ast.Name) else "?")
+                self._emit(
+                    "DLR005", stmt,
+                    f"class attribute `{node.name}.{target}` holds a "
+                    f"mutable default shared by every instance (and, in a "
+                    f"dataclass, silently aliased across configs)",
+                    "annotate as ClassVar[...] if sharing is intended, "
+                    "else use field(default_factory=...)",
+                )
+
+
+ALL_AST_RULES = ("DLR001", "DLR002", "DLR003", "DLR004", "DLR005")
+
+RULE_DOCS: Dict[str, str] = {
+    "DLR001": "gRPC invocation without a timeout= deadline",
+    "DLR002": "broad `except Exception` that swallows the error silently",
+    "DLR003": "threading.Thread(...) without an explicit daemon= choice",
+    "DLR004": "host time/randomness called inside a jit-compiled function",
+    "DLR005": "mutable default shared across calls/instances",
+}
+
+
+def lint_source(
+    source: str, path: str, rules: Optional[Set[str]] = None
+) -> List[Finding]:
+    """Run every (or the selected) AST rule over one file's source."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            rule_id="DLR000", path=path, line=e.lineno or 0,
+            message=f"syntax error: {e.msg}",
+        )]
+    linter = _Linter(path, tree, enabled=rules)
+    linter.visit(tree)
+    linter.findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return linter.findings
+
+
+def lint_paths(
+    paths: List[str], root: str, rules: Optional[Set[str]] = None
+) -> List[Finding]:
+    """Lint every ``.py`` file under ``paths``; finding paths are
+    reported relative to ``root`` so baseline keys are checkout-stable."""
+    findings: List[Finding] = []
+    for path in paths:
+        files: List[str] = []
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                )
+                files.extend(
+                    os.path.join(dirpath, f) for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        elif path.endswith(".py"):
+            files.append(path)
+        for fname in files:
+            with open(fname, encoding="utf-8") as fh:
+                src = fh.read()
+            rel = os.path.relpath(os.path.abspath(fname),
+                                  os.path.abspath(root))
+            findings.extend(
+                lint_source(src, rel.replace(os.sep, "/"), rules=rules)
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings

@@ -1,0 +1,180 @@
+"""``python -m dlrover_tpu.analysis`` / ``tpurun lint`` / ``tpulint``.
+
+Runs both static passes and exits non-zero on any finding outside the
+checked-in baseline:
+
+  AST pass    rule-based lint over the framework sources (DLR0xx)
+  graph pass  SPMD lint of the compiled train step (G10x), including the
+              planner-vs-HLO collective byte audit over all four MoE
+              dispatches
+
+The graph pass needs no accelerator: it compiles tiny models against the
+host CPU backend (8 virtual devices) exactly like tier-1 CI, so operators
+can run the full gate pre-submit in under a minute.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _ensure_cpu_mesh_env():
+    """Graph lint wants >= 8 devices; must run before jax is imported.
+    A no-op when jax is already loaded (tests: conftest did this)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="dlrover_tpu static analysis: framework AST lint + "
+                    "SPMD graph lint",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs for the AST pass (default: the "
+                        "dlrover_tpu package)")
+    p.add_argument("--baseline", default="",
+                   help="baseline JSON (default: the checked-in "
+                        "dlrover_tpu/analysis/baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current AST findings "
+                        "and exit 0 (ratchet reset — review the diff!)")
+    p.add_argument("--ast-only", action="store_true",
+                   help="skip the graph pass (pure-python, sub-second)")
+    p.add_argument("--graph-only", action="store_true",
+                   help="skip the AST pass")
+    p.add_argument("--no-moe-audit", action="store_true",
+                   help="graph pass on the dense model only (skips the "
+                        "four MoE dispatch compiles, ~20s saved)")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--tol", type=float, default=0.0,
+                   help="override the G106 collective-audit tolerance "
+                        "factor")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    _ensure_cpu_mesh_env()
+    args = build_parser().parse_args(argv)
+
+    import dlrover_tpu
+    from dlrover_tpu.analysis import ast_rules, findings as fmod
+
+    pkg_dir = os.path.dirname(os.path.abspath(dlrover_tpu.__file__))
+    root = os.path.dirname(pkg_dir)
+    baseline_path = args.baseline or os.path.join(
+        pkg_dir, "analysis", "baseline.json"
+    )
+    rules = set(r.strip() for r in args.rules.split(",") if r.strip()) \
+        or None
+    if args.write_baseline and (rules or args.paths or args.graph_only):
+        # the baseline is the FULL AST allowlist: regenerating it from a
+        # rule subset or a path subset would silently drop every other
+        # entry, and --graph-only has no baseline to write at all
+        print("--write-baseline regenerates the whole allowlist: run it "
+              "without --rules/--graph-only and without explicit paths",
+              file=sys.stderr)
+        return 2
+    # a --rules subset naming no DLR/G rule makes the matching pass a
+    # guaranteed no-op; skip it (the graph pass costs five compiles)
+    run_ast = not args.graph_only and (
+        rules is None or any(r.startswith("DLR") for r in rules)
+    )
+    run_graph = not args.ast_only and (
+        rules is None or any(r.startswith("G") for r in rules)
+    )
+
+    all_findings = []
+    stale: List[str] = []
+
+    if run_ast:
+        paths = args.paths or [pkg_dir]
+        ast_findings = ast_rules.lint_paths(paths, root=root, rules=rules)
+        baseline = fmod.Baseline.load(baseline_path)
+        new, stale = baseline.filter(ast_findings)
+        if args.paths or rules is not None:
+            # partial scope (explicit paths / a rule subset): entries for
+            # the unscanned remainder naturally consume no budget — that
+            # is not staleness, so the ratchet only runs full-scope
+            stale = []
+        if args.write_baseline:
+            fmod.Baseline.from_findings(ast_findings).save(baseline_path)
+            print(f"wrote {baseline_path} with "
+                  f"{len(ast_findings)} entries")
+            return 0
+        all_findings.extend(new)
+
+    reports = []
+    if run_graph:
+        from dlrover_tpu.analysis import graph_lint
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        tol = args.tol or graph_lint.DEFAULT_AUDIT_TOL
+        reports.append(graph_lint.lint_train_step(
+            rules=rules, audit_tol=tol
+        ))
+        # the four-dispatch MoE sweep exists for the G106 byte audit;
+        # a rule subset without G106 makes those compiles pure waste
+        if not args.no_moe_audit and (rules is None or "G106" in rules):
+            reports.extend(graph_lint.moe_dispatch_audit(
+                rules=rules, audit_tol=tol
+            ))
+        for rep in reports:
+            all_findings.extend(rep.findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in all_findings],
+            "stale_baseline_keys": stale,
+            "graph_reports": [
+                {
+                    "label": r.label,
+                    "measured_bytes": r.measured_bytes,
+                    "predicted_bytes": r.predicted_bytes,
+                    "build_seconds": round(r.build_seconds, 2),
+                }
+                for r in reports
+            ],
+        }, indent=2))
+    else:
+        for f in all_findings:
+            print(f.render())
+        for rep in reports:
+            ratio = rep.measured_total / max(rep.predicted_total, 1.0)
+            print(
+                f"graph {rep.label}: {len(rep.findings)} findings, "
+                f"{rep.measured_total / 1e6:.2f} MB collectives vs "
+                f"{rep.predicted_total / 1e6:.2f} MB predicted "
+                f"(ratio {ratio:.2f}x) in {rep.build_seconds:.1f}s"
+            )
+        for key in stale:
+            print(f"stale baseline entry (site fixed — remove it): {key}")
+        n = len(all_findings)
+        print(f"{n} finding{'s' if n != 1 else ''} outside the baseline"
+              + (f", {len(stale)} stale baseline entries" if stale
+                 else ""))
+    if stale and not all_findings:
+        # ratchet down: fixing a site must shrink the allowlist in the
+        # same change, or the key masks the next regression there
+        return 1
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

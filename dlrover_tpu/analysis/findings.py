@@ -1,0 +1,103 @@
+"""Findings + baseline: the shared currency of both static passes.
+
+A ``Finding`` is one rule violation at one site. The checked-in
+``baseline.json`` is the allowlist of findings that existed when a rule
+was introduced: the linter exits non-zero only on findings *outside* the
+baseline, so the repo is lint-clean at HEAD and every new violation fails
+loudly while legacy sites are paid down incrementally (the
+ratchet-baseline pattern of ruff/ESLint ``--add-noqa`` workflows, but as
+one reviewable JSON file).
+
+Baseline entries are keyed by ``rule_id::path::scope`` (scope = the
+enclosing ``Class.method`` qualname) with a *count*, not a line number —
+unrelated edits that shift lines don't churn the baseline, while adding a
+second violation inside an already-baselined scope still fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Tuple
+
+BASELINE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    path: str  # repo-relative (or fixture-relative) posix path
+    line: int
+    message: str
+    fixit: str = ""
+    scope: str = ""  # enclosing Class.method qualname ("" = module level)
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule_id}::{self.path}::{self.scope}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        out = f"{self.rule_id} {loc}{scope}: {self.message}"
+        if self.fixit:
+            out += f"\n    fix: {self.fixit}"
+        return out
+
+
+@dataclass
+class Baseline:
+    """Allowlist of pre-existing findings, keyed scope-wise with counts."""
+
+    entries: Dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as fh:
+            data = json.load(fh)
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"baseline {path} has version {data.get('version')!r}; "
+                f"this linter writes version {BASELINE_VERSION} "
+                f"(regenerate with --write-baseline)"
+            )
+        return cls(entries=dict(data.get("entries", {})))
+
+    def save(self, path: str):
+        payload = {
+            "version": BASELINE_VERSION,
+            "entries": {k: self.entries[k] for k in sorted(self.entries)},
+        }
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        entries: Dict[str, int] = {}
+        for f in findings:
+            entries[f.baseline_key] = entries.get(f.baseline_key, 0) + 1
+        return cls(entries=entries)
+
+    def filter(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[str]]:
+        """(new findings not covered by the baseline, stale keys).
+
+        Stale keys — baseline entries with no remaining finding — are
+        reported so the allowlist ratchets DOWN as sites get fixed
+        (a stale entry would otherwise mask a future regression at the
+        same scope).
+        """
+        budget = dict(self.entries)
+        new: List[Finding] = []
+        for f in findings:
+            if budget.get(f.baseline_key, 0) > 0:
+                budget[f.baseline_key] -= 1
+            else:
+                new.append(f)
+        stale = sorted(k for k, v in budget.items() if v > 0)
+        return new, stale

@@ -15,7 +15,7 @@ import os
 import threading
 import time
 from abc import ABC, abstractmethod
-from typing import Any, Dict, List, Optional
+from typing import Any, ClassVar, Dict, List, Optional
 
 
 class StateBackend(ABC):
@@ -129,7 +129,7 @@ class StoreManager:
     stores, each independently backed."""
 
     _lock = threading.Lock()
-    _stores: Dict[str, StateBackend] = {}
+    _stores: ClassVar[Dict[str, StateBackend]] = {}
 
     @classmethod
     def build_store(cls, name: str, backend: str = "memory",

@@ -10,7 +10,7 @@ over RPC (``dlrover_tpu/brain``).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import ClassVar, Dict, List, Optional
 
 from dlrover_tpu.master.stats.training_metrics import (
     DatasetMetric,
@@ -22,7 +22,7 @@ from dlrover_tpu.master.stats.training_metrics import (
 class StatsReporter:
     """Interface; also the registry keyed by job name."""
 
-    _instances: Dict[str, "StatsReporter"] = {}
+    _instances: ClassVar[Dict[str, "StatsReporter"]] = {}
     _lock = threading.Lock()
 
     def report_dataset_metric(self, metric: DatasetMetric):

@@ -58,16 +58,16 @@ def ambient_mesh():
         mesh = jax.sharding.get_abstract_mesh()
         if tuple(getattr(mesh, "axis_names", ()) or ()):
             return mesh
-    except Exception:  # noqa: BLE001 — no mesh context / old jax
-        pass
+    except (AttributeError, ValueError, TypeError):
+        pass  # API absent (old jax) / no mesh context
     try:  # legacy jax: the "with mesh:" thread-resources context
         from jax._src import mesh as mesh_lib
 
         phys = mesh_lib.thread_resources.env.physical_mesh
         if phys is not None and not phys.empty:
             return phys
-    except Exception:  # noqa: BLE001
-        pass
+    except (ImportError, AttributeError):
+        pass  # private module moved / no thread-resources mesh
     return None
 
 
@@ -81,8 +81,8 @@ def manual_axis_names(mesh=None, candidates=()) -> Set[str]:
             names |= {
                 a for a, t in types.items() if "manual" in str(t).lower()
             }
-        except Exception:  # noqa: BLE001 — axis_types absent on old jax
-            pass
+        except (AttributeError, TypeError, ValueError):
+            pass  # axis_types absent on old jax
     for a in candidates:
         if a in names:
             continue

@@ -54,9 +54,19 @@ class AotReport:
     predicted_step_time_s: float
     predicted_mfu: float
     compile_time_s: float
+    # graph-lint findings (dlrover_tpu.analysis) when the caller asked
+    # for the lint pass; None = pass not run
+    lint_findings: Optional[list] = None
 
     def to_json(self) -> str:
         d = dict(self.__dict__)
+        if d.get("lint_findings") is None:
+            d.pop("lint_findings", None)
+        else:
+            d["lint_findings"] = [
+                {"rule": f.rule_id, "message": f.message}
+                for f in d["lint_findings"]
+            ]
         d["hbm_per_device_gb"] = round(d.pop("hbm_per_device_bytes") / 1e9, 2)
         d["hbm_capacity_gb"] = round(d.pop("hbm_capacity_bytes") / 1e9, 2)
         d["flops_per_step"] = float(f"{d['flops_per_step']:.4g}")
@@ -169,6 +179,7 @@ def aot_compile_train_step(
     head_chunk: int = 0,
     packed_doc_len: int = 0,
     pipeline: Optional[dict] = None,
+    graph_lint: bool = False,
 ) -> AotReport:
     """Compile the full accelerate() train step for ``config`` against a
     deviceless TPU topology; assert HBM fit via memory_analysis.
@@ -185,6 +196,12 @@ def aot_compile_train_step(
     (GPipe / circular interleaved, optionally uneven per-chunk layer
     counts) instead of the plain forward; pair with the "llama_pp"
     rule set and a mesh_plan with pipe > 1.
+
+    ``graph_lint``: run the SPMD graph lint (``dlrover_tpu.analysis``)
+    over the winning plan's lowered/compiled artifacts — host callbacks,
+    dtype drift, dropped donation, replicated params, and the
+    planner-vs-HLO collective byte audit; findings land on
+    ``report.lint_findings``.
     """
     import time
 
@@ -315,13 +332,14 @@ def aot_compile_train_step(
             abstract_state, abstract_batch, key
         )
         compiled = lowered.compile()
-        return compiled, time.time() - t0
+        return compiled, time.time() - t0, lowered, result, abstract_state
 
-    best = None  # (per_device, compiled, compile_time, plan) — min memory
+    best = None  # (per_device, compiled, compile_time, plan, artifacts)
     last_exc: Optional[Exception] = None
     for plan in [mesh_plan] + fallback_plans:
         try:
-            compiled_i, compile_time_i = compile_plan(plan)
+            (compiled_i, compile_time_i, lowered_i, result_i,
+             abstract_state_i) = compile_plan(plan)
         except Exception as e:  # noqa: BLE001 — plan infeasible for XLA
             last_exc = e
             logger.warning(
@@ -339,7 +357,12 @@ def aot_compile_train_step(
             - mem.alias_size_in_bytes
         )
         if best is None or per_device_i < best[0]:
-            best = (per_device_i, compiled_i, compile_time_i, plan)
+            # the lowering artifacts (full StableHLO + traced closures)
+            # are only worth keeping alive past the loop when the lint
+            # pass will read them
+            best = (per_device_i, compiled_i, compile_time_i, plan,
+                    (lowered_i, result_i, abstract_state_i)
+                    if graph_lint else None)
         if per_device_i <= device_spec.hbm_bytes:
             break
         logger.warning(
@@ -352,7 +375,7 @@ def aot_compile_train_step(
         raise last_exc if last_exc is not None else RuntimeError(
             "no plan compiled"
         )
-    per_device, compiled, compile_time, mesh_plan = best
+    per_device, compiled, compile_time, mesh_plan, artifacts = best
     fits = per_device <= device_spec.hbm_bytes
 
     # XLA cost_analysis does not multiply FLOPs by loop trip counts, so
@@ -415,6 +438,32 @@ def aot_compile_train_step(
         predicted_mfu=float(predicted_mfu),
         compile_time_s=compile_time,
     )
+    if graph_lint:
+        from dlrover_tpu.analysis import graph_lint as gl
+
+        lowered, result, abstract_state = artifacts
+        param_bytes = sum(
+            a.size * a.dtype.itemsize
+            for a in jax.tree.leaves(abstract_state.params)
+        )
+        lint = gl.lint_artifacts(
+            stablehlo=lowered.as_text(),
+            optimized_hlo=compiled.as_text(),
+            args_info=getattr(lowered, "args_info", None),
+            state_sharding=result.state_sharding,
+            abstract_state=abstract_state,
+            mesh_plan=mesh_plan,
+            model_spec=model,
+            device_spec=device_spec,
+            compute_dtype=jnp.dtype(config.compute_dtype).name,
+            total_param_bytes=param_bytes,
+            n_state_leaves=len(jax.tree.leaves(abstract_state)),
+            pipe_virtual=(pipeline or {}).get("num_virtual", 1),
+            label=f"{model_name}@{topology}",
+        )
+        report.lint_findings = lint.findings
+        for f in lint.findings:
+            logger.warning("graph lint: %s", f.render())
     logger.info("AOT report: %s", report.to_json())
     return report
 
@@ -473,6 +522,10 @@ def main(argv: Optional[list] = None) -> int:
                    help="comma-separated per-chunk layer counts in "
                         "visit order (uneven stage split; default "
                         "even)")
+    p.add_argument("--lint", action="store_true",
+                   help="run the SPMD graph lint (dlrover_tpu.analysis) "
+                        "over the compiled artifact; findings print and "
+                        "flip the exit code")
     args = p.parse_args(argv)
 
     jax.config.update("jax_platforms", "cpu")  # AOT needs no devices
@@ -563,8 +616,13 @@ def main(argv: Optional[list] = None) -> int:
         head_chunk=args.head_chunk,
         packed_doc_len=args.packed_doc_len,
         pipeline=pipeline,
+        graph_lint=args.lint,
     )
     print(report.to_json())
+    if report.lint_findings:
+        for f in report.lint_findings:
+            print(f.render())
+        return 1
     return 0 if report.fits else 1
 
 

@@ -217,7 +217,7 @@ def _moe_dispatch_terms(
     tokens_per_chip: float,
     ep: int,
 ) -> Tuple[float, float]:
-    """(extra compute seconds, extra ICI seconds) the MoE DISPATCH adds
+    """(extra compute seconds, extra ICI *bytes*) the MoE DISPATCH adds
     per step — the term that ranks ``grouped_ep`` against the capacity
     paths honestly (the expert GEMMs themselves ride the 6N model-FLOPs
     compute term like every other matmul).
@@ -257,7 +257,7 @@ def _moe_dispatch_terms(
         return flops / (device.flops_per_s * eff), 0.0
     if dispatch == "grouped_ep" and ep > 1:
         ici_bytes = 4.0 * ep * t * k * d * model.dtype_bytes * layers
-        return 0.0, ici_bytes / device.ici_bw
+        return 0.0, ici_bytes
     if dispatch == "grouped" and ep > 1:
         # the kernel is opaque to GSPMD: EP-sharded expert weights get
         # all-gathered to every chip each layer (fwd + the grad
@@ -266,11 +266,84 @@ def _moe_dispatch_terms(
         w_bytes = (2.0 * model.num_experts * d * (model.ffn_mult * d)
                    * model.dtype_bytes)
         ici_bytes = 3.0 * w_bytes * (ep - 1) / ep * layers
-        return 0.0, ici_bytes / device.ici_bw
+        return 0.0, ici_bytes
     # per-shard gather/grouped (and grouped_ep degraded to P==1):
     # slot-gather/sort data movement, a few passes over the token rows
     hbm_bytes = 4.0 * cf * k * t * d * model.dtype_bytes * layers
     return hbm_bytes / device.hbm_bw, 0.0
+
+
+def predicted_collective_bytes(
+    plan: MeshPlan,
+    model: ModelSpec,
+    device: DeviceSpec = DeviceSpec(),
+    efficiency: Optional[float] = None,
+    pipe_virtual: int = 1,
+) -> Dict[str, float]:
+    """Per-step collective traffic (bytes, per link/chip) the cost model
+    prices for one mesh — the SAME formulas ``estimate`` divides by link
+    bandwidth, exposed so the graph lint (``dlrover_tpu.analysis``) can
+    audit the compiled HLO's actual collective bytes against the plan the
+    planner scored. If the two drift by more than the audit tolerance,
+    either XLA is executing a different program than the one we priced
+    (plan/graph divergence) or the cost model has rotted — both must fail
+    loudly (ISSUE 2 / ElasWave's silent-divergence failure class).
+
+    Keys: ``tp`` (activation allreduces), ``fsdp`` (param gather + grad
+    scatter), ``dp`` (grad allreduce), ``seq`` (ring-attention KV
+    rotation), ``pipe`` (stage-boundary activation handoff — DCN, not
+    ICI), ``moe_dispatch`` (all-to-all / weight-gather bytes of the MoE
+    dispatch; 0 for the capacity paths, whose overhead is compute-shaped).
+    """
+    pipe = max(getattr(plan, "pipe", 1), 1)
+    data = max(getattr(plan, "data", 1), 1)
+    fsdp = max(getattr(plan, "fsdp", 1), 1)
+    seq = max(getattr(plan, "seq", 1), 1)
+    tensor = max(getattr(plan, "tensor", 1), 1)
+
+    rows = model.global_batch / max(data * fsdp, 1)
+    act_elems = rows * (model.seq_len / seq) * model.hidden_size
+
+    out = {"tp": 0.0, "fsdp": 0.0, "dp": 0.0, "seq": 0.0, "pipe": 0.0,
+           "moe_dispatch": 0.0}
+    if tensor > 1:
+        bytes_per_ar = 2 * (tensor - 1) / tensor * (
+            act_elems * model.dtype_bytes
+        )
+        out["tp"] = 4 * model.num_layers * bytes_per_ar
+    if fsdp > 1:
+        shard_bytes = model.param_count * model.param_bytes / (
+            tensor * pipe
+        )
+        out["fsdp"] = 3 * shard_bytes * (fsdp - 1) / fsdp
+    if data > 1:
+        grad_bytes = model.param_count * model.param_bytes / (
+            tensor * pipe * fsdp
+        )
+        out["dp"] = 2 * grad_bytes * (data - 1) / data
+    if pipe > 1:
+        out["pipe"] = (
+            2 * max(pipe_virtual, 1) * act_elems * model.dtype_bytes
+        )
+    if seq > 1:
+        kv_frac = 1.0
+        if model.kv_heads and model.num_heads:
+            rep = ring_kv_repeat(model.kv_heads, model.num_heads, tensor)
+            # rep None = infeasible heads (estimate marks the plan
+            # unbuildable); keep the rep=1 bytes so the breakdown stays
+            # finite and comparable
+            kv_frac = model.kv_heads * (rep or 1) / model.num_heads
+        kv_bytes = 2 * act_elems * model.dtype_bytes * kv_frac
+        out["seq"] = model.num_layers * (seq - 1) * kv_bytes
+    eff = min(
+        efficiency if efficiency is not None else calibrated_efficiency(),
+        MAX_EFFICIENCY,
+    )
+    _, moe_bytes = _moe_dispatch_terms(
+        model, device, eff, rows * (model.seq_len / seq), data * fsdp
+    )
+    out["moe_dispatch"] = moe_bytes
+    return out
 
 
 def estimate(
@@ -364,73 +437,43 @@ def estimate(
     rows = model.global_batch / max(data * fsdp, 1)
     act_elems = rows * (model.seq_len / seq) * model.hidden_size
 
-    # ---- tensor-parallel activation allreduces (2/layer fwd + 2 bwd)
-    tp_comm_s = 0.0
-    if tensor > 1:
-        bytes_per_ar = 2 * (tensor - 1) / tensor * (
-            act_elems * model.dtype_bytes
-        )
-        tp_comm_s = 4 * model.num_layers * bytes_per_ar / device.ici_bw
+    # ---- collective traffic: all byte quantities come from
+    # predicted_collective_bytes — the ONE set of formulas the graph
+    # lint's HLO audit also reads, so the seconds priced here and the
+    # bytes audited there cannot drift apart.
+    #   tp   : 2 allreduces of activations per layer fwd + 2 bwd (ICI)
+    #   fsdp : param all-gather + grad reduce-scatter per step (ICI)
+    #   dp   : plain gradient allreduce (ICI)
+    #   seq  : ring-attention KV rotation, GQA- and repeat-aware (ICI)
+    #   pipe : stage-boundary activation handoff, per-link; pipe is the
+    #          outermost axis so on multi-slice topologies it rides DCN
+    #          (V>1: the circular schedule wraps each microbatch around
+    #          the ring V times)
+    comm_bytes = predicted_collective_bytes(
+        plan, model, device, efficiency=eff, pipe_virtual=pipe_virtual
+    )
+    tp_comm_s = comm_bytes["tp"] / device.ici_bw
+    fsdp_comm_s = comm_bytes["fsdp"] / device.ici_bw
+    dp_comm_s = comm_bytes["dp"] / device.ici_bw
+    seq_comm_s = comm_bytes["seq"] / device.ici_bw
+    pipe_comm_s = comm_bytes["pipe"] / device.dcn_bw
 
-    # ---- fsdp param all-gather + grad reduce-scatter
-    fsdp_comm_s = 0.0
-    if fsdp > 1:
-        shard_bytes = model.param_count * model.param_bytes / (
-            tensor * pipe
-        )
-        fsdp_comm_s = 3 * shard_bytes * (fsdp - 1) / fsdp / device.ici_bw
-
-    # ---- plain dp grad allreduce
-    dp_comm_s = 0.0
-    if data > 1:
-        grad_bytes = model.param_count * model.param_bytes / (
-            tensor * pipe * fsdp
-        )
-        dp_comm_s = 2 * grad_bytes * (data - 1) / data / device.ici_bw
-
-    # ---- pipeline activation handoff: the full per-device batch of
-    # activations crosses a stage boundary once fwd + once bwd; distinct
-    # boundaries transfer concurrently on distinct host pairs, so (like
-    # every other term) this is PER-LINK time. Pipe is the outermost
-    # axis: on multi-slice topologies this rides DCN, not ICI.
-    pipe_comm_s = 0.0
-    if pipe > 1:
-        # the circular schedule wraps each microbatch around the ring
-        # V times, so every stage link carries V x the activation
-        # traffic of the plain GPipe schedule
-        pipe_comm_s = (
-            2 * max(pipe_virtual, 1) * act_elems * model.dtype_bytes
-            / device.dcn_bw
-        )
-
-    # ---- ring attention (seq axis): K/V circulate once per layer; GQA
-    # rotates only kv_heads/num_heads of the activation bytes, times the
-    # head-divisibility repeat factor when kv_heads % tensor != 0
-    seq_comm_s = 0.0
+    # feasibility: the runtime head-shard legalizer raises when no legal
+    # KV repeat exists for this head/tensor combination; any mesh relying
+    # on it must never win the ranking
     heads_shardable = True
-    kv_rep = 1
-    if model.kv_heads and model.num_heads:
-        rep = ring_kv_repeat(model.kv_heads, model.num_heads, tensor)
-        if rep is None:
-            # the runtime head-shard legalizer raises for these inputs;
-            # any mesh relying on them must never win the ranking
-            heads_shardable = False
-        else:
-            kv_rep = rep
-    if seq > 1:
-        kv_frac = 1.0
-        if model.kv_heads and model.num_heads:
-            kv_frac = model.kv_heads * kv_rep / model.num_heads
-        kv_bytes = 2 * act_elems * model.dtype_bytes * kv_frac
-        seq_comm_s = model.num_layers * (seq - 1) * kv_bytes / device.ici_bw
+    if model.kv_heads and model.num_heads and ring_kv_repeat(
+            model.kv_heads, model.num_heads, tensor) is None:
+        heads_shardable = False
 
     # ---- MoE dispatch overhead (quadratic capacity einsums vs linear
     # all-to-all bytes): ep degree = data x fsdp, the expert submesh of
     # the canonical rule sets (mesh.py: "expert" aliases data x fsdp)
     tokens_per_chip = rows * (model.seq_len / seq)
-    moe_disp_comp_s, moe_disp_comm_s = _moe_dispatch_terms(
+    moe_disp_comp_s, _moe_bytes = _moe_dispatch_terms(
         model, device, eff, tokens_per_chip, data * fsdp
     )
+    moe_disp_comm_s = comm_bytes["moe_dispatch"] / device.ici_bw
     compute_s += moe_disp_comp_s
 
     # comm overlaps with compute imperfectly; charge the max of compute

@@ -54,8 +54,19 @@ class PsClusterClient:
     """Talks to every PS shard; presents one logical parameter dict."""
 
     def __init__(self, addrs: Sequence[str],
-                 master_client=None):
+                 master_client=None, rpc_timeout: float = 60.0,
+                 bulk_timeout: float = 600.0):
         self._master = master_client
+        # every shard RPC carries a deadline: the fan-out blocks on
+        # fut.result() for ALL shards, so one dead PS without a deadline
+        # would hang the training step forever instead of raising into
+        # the failover path (DLR001). Step-shaped ops (push/pull/stats)
+        # ride rpc_timeout; bulk ops whose latency scales with MODEL
+        # size, not step RTT (init streaming full params, checkpoint
+        # writing to storage), get the larger bulk_timeout so a healthy
+        # slow transfer is not misread as a dead shard.
+        self._rpc_timeout = rpc_timeout
+        self._bulk_timeout = max(bulk_timeout, rpc_timeout)
         self._addrs: List[str] = list(addrs)
         self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
         self._channels: Dict[int, grpc.Channel] = {}
@@ -151,10 +162,13 @@ class PsClusterClient:
 
     # -- logical parameter ops --------------------------------------------
 
-    def _fanout(self, frames: Dict[int, bytes], op: str) -> Dict[int, tuple]:
+    def _fanout(self, frames: Dict[int, bytes], op: str,
+                timeout: float = 0.0) -> Dict[int, tuple]:
         """Issue one call per shard concurrently (step latency = max shard
-        RTT, not the sum — the point of sharding the PS) and collect."""
-        futs = {shard: self._stub(shard).future(frame)
+        RTT, not the sum — the point of sharding the PS) and collect.
+        ``timeout`` overrides the step-shaped default (bulk ops)."""
+        futs = {shard: self._stub(shard).future(
+                    frame, timeout=timeout or self._rpc_timeout)
                 for shard, frame in frames.items()}
         out = {}
         for shard, fut in futs.items():
@@ -172,7 +186,7 @@ class PsClusterClient:
                 {"op": "init"}, {n: params[n] for n in names})
             for shard, names in self._by_shard.items()
         }
-        self._fanout(frames, "init")
+        self._fanout(frames, "init", timeout=self._bulk_timeout)
 
     def pull(self) -> Tuple[Dict[str, np.ndarray], int]:
         """Fetch all params; returns (params, max shard version)."""
@@ -203,7 +217,7 @@ class PsClusterClient:
         frames = {shard: wire.pack_frame({"op": "checkpoint",
                                           "dir": directory})
                   for shard in range(self.num_shards)}
-        self._fanout(frames, "checkpoint")
+        self._fanout(frames, "checkpoint", timeout=self._bulk_timeout)
 
     def total_params(self) -> int:
         """Parameters held across every shard (0 = nothing restored)."""

@@ -9,7 +9,7 @@ behaves as both attribute- and dict-style config.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Type
+from typing import Any, ClassVar, Dict, List, Optional, Type
 
 
 def _is_config_attr(name: str) -> bool:
@@ -107,7 +107,9 @@ class ConfigurationManagerMeta(type):
     self-registers; ``merged_configuration`` folds them in definition
     order (reference: ConfigurationManagerMeta collecting conf classes)."""
 
-    _registry: List[type] = []
+    # deliberately ONE registry shared by every manager subclass
+    # (ClassVar, not an instance default — DLR005)
+    _registry: ClassVar[List[type]] = []
 
     def __new__(mcls, name, bases, namespace):
         cls = super().__new__(mcls, name, bases, namespace)

@@ -113,14 +113,19 @@ class TrainingFailover:
                 self._last_ps_addrs = addrs
                 return True
             self._last_ps_addrs = addrs
-        except Exception:  # noqa: BLE001 — master briefly unreachable
-            pass
+        except Exception as e:  # noqa: BLE001 — master briefly unreachable
+            # tolerated (the next poll retries) but never silent: a
+            # permanently failing query here means the watcher is blind
+            # to PS membership changes (DLR002)
+            logger.warning("query_ps_nodes failed, skipping PS-drift "
+                           "check this poll (%s: %s)", type(e).__name__, e)
         # SPMD strategy: nodes waiting at the rendezvous
         try:
             if self._client.num_nodes_waiting() > 0:
                 return True
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — master briefly unreachable
+            logger.warning("num_nodes_waiting failed, skipping rendezvous "
+                           "check this poll (%s: %s)", type(e).__name__, e)
         return False
 
     def _run(self):

@@ -125,6 +125,14 @@ def _run_without_master(args, script_args: List[str]) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "lint":
+        # `tpurun lint [...]` — the pre-submit static-analysis gate
+        # (framework AST lint + SPMD graph lint); see
+        # docs/static_analysis.md
+        from dlrover_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     script_args = list(args.args)
     if script_args and script_args[0] == "--":
