@@ -10,6 +10,8 @@ source of silent hangs and mystery slowdowns at scale:
   DLR003 non-daemon-thread     a background thread that pins shutdown
   DLR004 impure-in-jit         host time/randomness captured at trace time
   DLR005 shared-mutable-default mutable defaults aliased across instances
+  DLR006 host-sync-on-metrics  float()/.item()/np.asarray() on step
+                               metrics — a device sync on the hot loop
 
 Rules are deliberately syntactic (no type inference): they over-approximate
 in ways the checked-in baseline absorbs, and under-approximate in ways unit
@@ -39,6 +41,12 @@ IMPURE_CALLS = {
 }
 MUTABLE_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict",
                  "Counter", "deque"}
+# DLR006: host-materialization calls that block on the device when
+# applied to step metrics (each forces jax's async dispatch queue to
+# drain up to that value — the exact stall the executor's lagged
+# metrics window exists to avoid)
+SYNC_CALLS = {"float", "int", "bool"}
+SYNC_ARRAY_CALLS = {"asarray", "array", "device_get"}
 
 
 def _dotted(node: ast.AST) -> str:
@@ -157,6 +165,7 @@ class _Linter(ast.NodeVisitor):
             self._check_grpc_timeout(node)
         if self._jit_depth > 0:
             self._check_impure_in_jit(node)
+        self._check_host_sync_on_metrics(node)
         if (isinstance(node.func, ast.Attribute)
                 and node.func.attr == "Thread"):
             self._check_thread_daemon(node)
@@ -257,6 +266,54 @@ class _Linter(ast.NodeVisitor):
                 "with an explicit key",
             )
 
+    # -- DLR006: host sync on step metrics ----------------------------------
+
+    @staticmethod
+    def _mentions_metrics(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                sub.id == "metrics" or sub.id.endswith("_metrics")
+            ):
+                return True
+            if isinstance(sub, ast.Attribute) and (
+                sub.attr == "metrics" or sub.attr.endswith("_metrics")
+            ):
+                return True
+        return False
+
+    def _check_host_sync_on_metrics(self, node: ast.Call):
+        """float()/.item()/np.asarray()/jax.device_get() applied to a
+        step-metric value: each one blocks the host on the device queue,
+        so in the hot loop it caps in-flight dispatch at one step. The
+        rule is name-based (values reached through ``metrics`` /
+        ``*_metrics``) — deliberately over-approximate; the lagged
+        materialization sites the async executor keeps ON PURPOSE live
+        in the baseline ratchet."""
+        name = _dotted(node.func)
+        short = name.rsplit(".", 1)[-1]
+        target: Optional[ast.AST] = None
+        if short in SYNC_CALLS and "." not in name and node.args:
+            target = node.args[0]
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args):
+            # .item() hangs off arbitrary expressions (subscripts,
+            # calls) that _dotted cannot name — match the attr itself
+            target = node.func.value
+            short = "item"
+        elif short in SYNC_ARRAY_CALLS and "." in name and node.args:
+            target = node.args[0]
+        if target is None or not self._mentions_metrics(target):
+            return
+        self._emit(
+            "DLR006", node,
+            f"`{name or short}(...)` on a step-metric value forces a "
+            f"host-device sync: the dispatch queue drains to one step "
+            f"in flight, putting Python/RPC overhead on the critical "
+            f"path",
+            "consume metrics through the executor's lagged window "
+            "(train_window) or move the read off the per-step path",
+        )
+
     # -- DLR005: shared mutable defaults ------------------------------------
 
     def _check_mutable_defaults(self, node):
@@ -293,7 +350,8 @@ class _Linter(ast.NodeVisitor):
                 )
 
 
-ALL_AST_RULES = ("DLR001", "DLR002", "DLR003", "DLR004", "DLR005")
+ALL_AST_RULES = ("DLR001", "DLR002", "DLR003", "DLR004", "DLR005",
+                 "DLR006")
 
 RULE_DOCS: Dict[str, str] = {
     "DLR001": "gRPC invocation without a timeout= deadline",
@@ -301,6 +359,9 @@ RULE_DOCS: Dict[str, str] = {
     "DLR003": "threading.Thread(...) without an explicit daemon= choice",
     "DLR004": "host time/randomness called inside a jit-compiled function",
     "DLR005": "mutable default shared across calls/instances",
+    "DLR006": "host-device sync (float/int/bool, .item(), np.asarray/"
+              "np.array, jax.device_get) on step-metric values in the "
+              "hot loop",
 }
 
 

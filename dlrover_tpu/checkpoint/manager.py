@@ -150,6 +150,12 @@ class ElasticCheckpointManager:
             os.makedirs(self._staging_root, exist_ok=True)
         self._mirror_lock = threading.Lock()
         self._mirror_threads: list = []
+        # mirror THREAD OBJECTS that already consumed a full join
+        # timeout (wait() only polls these afterwards). Keyed by object,
+        # never by ident: idents are recycled after a thread exits, and
+        # a fresh healthy mirror inheriting a stale flag would get a
+        # 0-second join on the preemption exit path
+        self._mirror_timed_out: set = set()
 
     # -- save ----------------------------------------------------------------
 
@@ -192,19 +198,66 @@ class ElasticCheckpointManager:
                 thread.start()
         return bool(saved)
 
-    def wait(self):
+    def wait(self, mirror_timeout: float = 120.0) -> bool:
         """Block until queued async saves hit disk (and their staging
-        mirrors complete)."""
+        mirrors complete).
+
+        Returns ``timed_out``: True when a staging-mirror thread was
+        still alive after ``mirror_timeout`` — the host-DRAM mirror for
+        some step never committed, so a storage-outage restore would
+        fall back to an OLDER staged step. Callers on exit paths (the
+        preemption drain, ``finalize``) surface this instead of
+        silently proceeding; the primary (Orbax) copy is unaffected
+        either way."""
         self._manager.wait_until_finished()
+        timed_out = False
+        pending: list = []
         for thread in self._mirror_threads:
             if thread.is_alive():
-                thread.join(timeout=120)
-        self._mirror_threads = []
+                # a thread that already burned one full timeout is only
+                # POLLED afterwards: repeated wait() calls (e.g. the
+                # preemption drain's latest_checkpoint_step + finalize
+                # back-to-back) must not stack 120s stalls inside the
+                # bounded grace window
+                already_flagged = thread in self._mirror_timed_out
+                thread.join(timeout=0.0 if already_flagged
+                            else mirror_timeout)
+            if thread.is_alive():
+                timed_out = True
+                pending.append(thread)
+                if thread not in self._mirror_timed_out:
+                    self._mirror_timed_out.add(thread)
+                    logger.error(
+                        "[CKPT_MIRROR_TIMEOUT] staging mirror thread %s "
+                        "still running after %.0fs: the host-DRAM mirror "
+                        "for its step never committed (primary "
+                        "checkpoint unaffected)",
+                        thread.name, mirror_timeout,
+                    )
+            else:
+                self._mirror_timed_out.discard(thread)
+        # keep only the still-alive threads: a later wait() can still
+        # observe them instead of forgetting the in-flight mirror
+        self._mirror_threads = pending
+        self._mirror_timed_out &= set(pending)
+        return timed_out
 
     # -- host-DRAM staging ----------------------------------------------------
 
     def _step_dir(self, root: str, step: int) -> str:
         return os.path.join(root, str(step))
+
+    def _newer_step_committed(self, step: int) -> bool:
+        """Filesystem-only (the mirror thread must never touch the
+        non-thread-safe Orbax manager): a committed step dir numbered
+        above ``step``."""
+        try:
+            return any(
+                name.isdigit() and int(name) > step
+                for name in os.listdir(self.directory)
+            )
+        except OSError:
+            return False
 
     def _wait_and_mirror(self, step: int, deadline_s: float = 600.0):
         """Mirror once the step commits. Orbax's CheckpointManager is not
@@ -220,6 +273,17 @@ class ElasticCheckpointManager:
                 if _time.monotonic() > deadline:
                     logger.warning(
                         "step %d never committed; skipping staging", step
+                    )
+                    return
+                if self._newer_step_committed(step):
+                    # commits are ordered, so a NEWER numbered dir with
+                    # this one absent means max_to_keep already deleted
+                    # it (or will): stop polling instead of spinning to
+                    # the deadline and stalling wait() — the newer
+                    # step's own mirror supersedes this one anyway
+                    logger.info(
+                        "step %d superseded before mirroring; skipping",
+                        step,
                     )
                     return
                 _time.sleep(0.5)

@@ -60,6 +60,15 @@ class Context:
         # each check reads one device scalar, so keep it off the per-step
         # hot path
         self.check_finite_every_steps = 10
+        # async dispatch pipeline: how many train-step dispatches may be
+        # in flight before the oldest one's metrics are materialized
+        # (hooks/logging/finite-check consume LAGGED host values; 0 =
+        # fully synchronous — materialize right after each dispatch)
+        self.train_window = 4
+        # multi-step fusion: optimizer steps per compiled call (K>1 =
+        # a lax.scan over K stacked batches; one host dispatch per K
+        # steps). Consumed by ElasticTrainer at construction.
+        self.steps_per_call = 1
         # what to do on a non-finite step after reporting the failure:
         # "halt" | "rollback" (restore last checkpoint) | "ignore"
         self.on_nonfinite = "halt"

@@ -185,8 +185,18 @@ class ElasticDataLoader:
         return n // bs if self._drop_last else -(-n // bs)
 
 
+def stack_batches(batches: List[Any]):
+    """Stack K host batches along a new leading axis (tree-wise) — the
+    input shape of ``accelerate``'s ``train_step_multi``."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
+
+
 class DevicePreloader:
-    """Overlap host→device transfer with compute.
+    """Overlap host→device transfer with compute — the ONE H2D
+    prefetcher for both data paths (the in-process loader here and the
+    shm coworker ring, which wraps it in background mode).
 
     Role parity: ``atorch/atorch/data/preloader.py:8`` (``GpuPreLoader``
     — a CUDA-stream H2D prefetcher). On TPU, ``jax.device_put`` is
@@ -202,18 +212,49 @@ class DevicePreloader:
     global batch on every host would otherwise silently assemble a
     process_count-times larger batch of duplicated rows. 0 skips the
     check (single-process shardings are unaffected either way).
+
+    ``steps_per_call``: K > 1 groups K consecutive batches and stacks
+    them along a new leading axis before the device put, so each
+    yielded item feeds one ``train_step_multi`` call. Pass the STACKED
+    batch spec (``AccelerateResult.stacked_batch_spec``) as
+    ``sharding`` in that mode; a trailing group short of K is dropped
+    (fixed shapes only — a partial stack would recompile the scan).
+    Leave stacking off when the iterator feeds ``TrainExecutor``,
+    which does its own grouping.
+
+    ``put_fn``: overrides the transfer entirely (the shm path's hook).
+    ``background=True`` runs the puts on a daemon thread feeding a
+    bounded queue (depth ``prefetch``) — the shm coworker mode, where
+    ring reads must not serialize with the training loop.
     """
 
     def __init__(self, iterable, sharding=None, prefetch: int = 2,
-                 global_rows: int = 0):
+                 global_rows: int = 0, steps_per_call: int = 1,
+                 put_fn: Optional[Callable[[Any], Any]] = None,
+                 background: bool = False):
         if prefetch < 1:
             raise ValueError("prefetch must be >= 1")
+        if steps_per_call < 1:
+            raise ValueError("steps_per_call must be >= 1")
         self._iterable = iterable
         self._sharding = sharding
         self._prefetch = prefetch
         self._global_rows = int(global_rows)
+        self._steps_per_call = int(steps_per_call)
+        self._put_fn = put_fn
+        self._background = background
+        # background-mode pump state, created ONCE on first iteration:
+        # re-entering __iter__ (the executor's restart path) must resume
+        # draining the same queue — a second pump racing the first over
+        # one shared source iterator would drop and interleave batches
+        self._bg_queue = None
+        self._bg_done = object()
+        self._bg_error: List[BaseException] = []
+        self._bg_exhausted = False
 
     def _put(self, batch):
+        if self._put_fn is not None:
+            return self._put_fn(batch)
         import jax
 
         if self._sharding is not None:
@@ -222,15 +263,37 @@ class DevicePreloader:
             # any sharding type) stay on plain device_put
             from dlrover_tpu.parallel.accelerate import put_global_batch
 
-            return put_global_batch(batch, self._sharding,
-                                    self._global_rows)
+            return put_global_batch(
+                batch, self._sharding, self._global_rows,
+                row_axis=1 if self._steps_per_call > 1 else 0,
+            )
         return jax.device_put(batch)
 
+    def _host_items(self):
+        """Raw batches, or K-stacked groups when steps_per_call > 1."""
+        if self._steps_per_call == 1:
+            yield from self._iterable
+            return
+        group: List[Any] = []
+        for batch in self._iterable:
+            group.append(batch)
+            if len(group) == self._steps_per_call:
+                yield stack_batches(group)
+                group = []
+        if group:
+            logger.warning(
+                "dropping %d trailing batches short of steps_per_call=%d "
+                "(fixed shapes only)", len(group), self._steps_per_call,
+            )
+
     def __iter__(self):
+        if self._background:
+            yield from self._background_iter()
+            return
         import collections
 
         queue = collections.deque()
-        it = iter(self._iterable)
+        it = iter(self._host_items())
         try:
             for _ in range(self._prefetch):
                 queue.append(self._put(next(it)))
@@ -243,6 +306,41 @@ class DevicePreloader:
             except StopIteration:
                 pass
             yield out
+
+    def _background_iter(self):
+        """Puts run on ONE daemon thread feeding a bounded queue:
+        ``prefetch`` transfers stay in flight while the consumer
+        computes (the shm path's DevicePrefetcher behavior, now
+        shared). The pump starts on first iteration and is shared by
+        every subsequent ``__iter__`` — re-entry resumes mid-stream."""
+        import queue as _queue
+        import threading
+
+        if self._bg_queue is None:
+            self._bg_queue = _queue.Queue(maxsize=self._prefetch)
+
+            def pump():
+                try:
+                    for b in self._host_items():
+                        self._bg_queue.put(self._put(b))
+                except BaseException as e:  # surface in the consumer
+                    logger.warning(
+                        "prefetch pump failed (%s); re-raising in the "
+                        "consumer", type(e).__name__,
+                    )
+                    self._bg_error.append(e)
+                finally:
+                    self._bg_queue.put(self._bg_done)
+
+            threading.Thread(target=pump, daemon=True).start()
+        while not self._bg_exhausted:
+            item = self._bg_queue.get()
+            if item is self._bg_done:
+                self._bg_exhausted = True
+                break
+            yield item
+        if self._bg_error:
+            raise self._bg_error[0]
 
 
 def _default_collate(samples: List[Any]):

@@ -60,6 +60,7 @@ class ElasticTrainer:
         master_client=None,
         report_every_steps: int = 10,
         devices=None,
+        steps_per_call: Optional[int] = None,
     ):
         self._init_fn = init_fn
         self._loss_fn = loss_fn
@@ -68,6 +69,17 @@ class ElasticTrainer:
         self._base_strategy = strategy or Strategy()
         self._master_client = master_client
         self._report_every = max(report_every_steps, 1)
+        # multi-step fusion degree: K>1 compiles an extra K-step scan
+        # (accelerate train_step_multi) so the executor can dispatch K
+        # optimizer steps per host call. None defers to the global
+        # context knob (DLROVER_TPU_STEPS_PER_CALL / tpurun flag).
+        if steps_per_call is None:
+            from dlrover_tpu.common.config import get_context
+
+            steps_per_call = int(getattr(
+                get_context(), "steps_per_call", 1
+            ))
+        self.steps_per_call = max(1, int(steps_per_call))
         # explicit device set (default: the whole jax.devices() world);
         # the agent hands the post-change survivor subset to
         # on_world_change, and dryruns carve sub-worlds out of one host
@@ -109,6 +121,7 @@ class ElasticTrainer:
             strategy=strategy,
             rng=self._rng,
             devices=self._devices,
+            steps_per_call=self.steps_per_call,
         )
 
     def prepare(self, state: Any = None) -> Any:
@@ -219,6 +232,69 @@ class ElasticTrainer:
                 )
         return state, metrics
 
+    def step_multi(self, state: Any, batches: Any) -> Tuple[Any, Dict]:
+        """Dispatch ``steps_per_call`` optimizer steps as ONE compiled
+        call (the ``lax.scan`` multi-step of ``accelerate``).
+
+        ``batches``: a sequence of exactly ``steps_per_call`` host
+        batches, or a pytree already stacked along a leading K axis
+        (e.g. from ``DevicePreloader(steps_per_call=K)``). The rng
+        stream advances by one split per optimizer step — identical to
+        K calls of ``step`` — so a multi-step run is bit-identical to
+        the synchronous loop on the same batch stream. Metrics return
+        stacked ``[K, ...]`` leaves.
+        """
+        k = self.steps_per_call
+        multi = self._result.train_step_multi
+        if multi is None or k <= 1:
+            raise RuntimeError(
+                "step_multi needs steps_per_call > 1 at construction "
+                f"(got steps_per_call={k})"
+            )
+        if isinstance(batches, (list, tuple)):
+            if len(batches) != k:
+                raise ValueError(
+                    f"step_multi takes exactly steps_per_call={k} "
+                    f"batches, got {len(batches)}"
+                )
+            from dlrover_tpu.trainer.data import stack_batches
+
+            batches = stack_batches(list(batches))
+        import jax.numpy as jnp
+
+        rngs = []
+        for _ in range(k):
+            self._rng, r = jax.random.split(self._rng)
+            rngs.append(r)
+        sharded = self._result.shard_batch(batches, stacked=True)
+        state, metrics = multi(state, sharded, jnp.stack(rngs))
+        prev = self._host_step
+        self._host_step += k
+        step = self._host_step
+        if self._master_client is not None and (
+            step // self._report_every > prev // self._report_every
+        ):
+            try:
+                from dlrover_tpu.common import comm
+
+                self._master_client.report(
+                    comm.GlobalStep(step=step, timestamp=time.time())
+                )
+            except Exception:  # noqa: BLE001 - reporting must never kill training
+                logger.debug("global-step report failed", exc_info=True)
+        if self._ckpt is not None and self._ckpt.interval.should_save(step):
+            # the finite guard reads the stacked flags — one device sync,
+            # only on save steps, covering every step in the group
+            finite = metrics.get("finite")
+            if finite is None or bool(jnp.all(finite)):
+                self.save(state)
+            else:
+                logger.warning(
+                    "skipping checkpoint at step %d: non-finite state "
+                    "inside the %d-step group", step, k,
+                )
+        return state, metrics
+
     # -- checkpoint ----------------------------------------------------------
 
     def latest_checkpoint_step(self) -> Optional[int]:
@@ -255,7 +331,13 @@ class ElasticTrainer:
             force=force,
         )
 
-    def finalize(self):
+    def finalize(self) -> bool:
+        """Flush + close checkpointing. Returns True when a staging
+        mirror timed out (``ElasticCheckpointManager.wait``) — surfaced
+        so exit paths (preemption drain) can report that the host-DRAM
+        mirror never committed."""
+        timed_out = False
         if self._ckpt is not None:
-            self._ckpt.wait()
+            timed_out = bool(self._ckpt.wait())
             self._ckpt.close()
+        return timed_out

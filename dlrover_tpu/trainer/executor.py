@@ -13,8 +13,10 @@ Hooks observe the loop at the same points the TF SessionRunHooks did.
 
 from __future__ import annotations
 
+import collections
 import time
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import TrainingExceptionLevel
@@ -30,6 +32,16 @@ logger = get_logger("trainer.executor")
 class NonFiniteLossError(RuntimeError):
     """Raised when the guardrail sees a NaN/Inf loss or gradient and the
     configured policy is \"halt\"."""
+
+
+@dataclass
+class _Inflight:
+    """One dispatched-but-unmaterialized train-step call: ``count``
+    optimizer steps ending at ``last_step``, metrics still on device."""
+
+    last_step: int
+    count: int
+    metrics: Dict[str, Any]
 
 
 class TrainHook:
@@ -136,6 +148,30 @@ class TrainExecutor:
         self._check_finite_every = int(conf.get(
             "check_finite_every_steps", ctx.check_finite_every_steps
         ))
+        # async dispatch pipeline: up to ``train_window`` step calls stay
+        # in flight before the oldest call's metrics are materialized on
+        # host; 0 = synchronous (materialize right after each dispatch).
+        # Hooks, the finite check, speed logging and master reporting all
+        # consume LAGGED host values, so the device queue never drains on
+        # Python/RPC overhead — and non-finite detection can fire up to
+        # train_window * steps_per_call steps late (rollback unchanged).
+        self._train_window = max(0, int(conf.get(
+            "train_window", getattr(ctx, "train_window", 4)
+        )))
+        self._window: "collections.deque[_Inflight]" = collections.deque()
+        self._last_log = time.time()
+        # the COMPILED multi-step degree lives on the trainer (it owns
+        # the K-step scan program); a conf knob that disagrees can only
+        # warn — honoring it would recompile mid-construction
+        conf_k = int(conf.get("steps_per_call", 0))
+        trainer_k = int(getattr(trainer, "steps_per_call", 1))
+        if conf_k and conf_k != trainer_k:
+            logger.warning(
+                "conf steps_per_call=%d ignored: the trainer was built "
+                "with steps_per_call=%d (pass it to ElasticTrainer, or "
+                "set DLROVER_TPU_STEPS_PER_CALL before construction)",
+                conf_k, trainer_k,
+            )
         self._on_nonfinite = str(conf.get("on_nonfinite", ctx.on_nonfinite))
         self._max_rollbacks = int(conf.get("max_nonfinite_rollbacks", 3))
         # xprof trace capture (SURVEY §5 tracing): a bounded window of
@@ -251,12 +287,19 @@ class TrainExecutor:
             )
         except Exception:  # noqa: BLE001 — still exit cleanly in grace
             logger.exception("emergency checkpoint failed")
+        mirror_timed_out = False
         try:
             # close the async manager even when the save above failed:
             # an earlier in-flight save must be waited on before exit
-            self._trainer.finalize()
+            mirror_timed_out = bool(self._trainer.finalize())
         except Exception:  # noqa: BLE001
             logger.exception("checkpoint finalize failed")
+        if mirror_timed_out:
+            logger.error(
+                "[CKPT_MIRROR_TIMEOUT] preemption drain: the host-DRAM "
+                "staging mirror never committed before exit; a storage-"
+                "outage restore will fall back to an older staged step"
+            )
         if self._master_client is not None:
             try:
                 self._master_client.report_failure(
@@ -269,6 +312,7 @@ class TrainExecutor:
                 pass
         out = dict(self._last_metrics or {})
         out["preempted"] = True
+        out["mirror_timed_out"] = mirror_timed_out
         out["step"] = step  # _finish() contract parity
         for hook in self._hooks:
             hook.end(self)
@@ -363,6 +407,71 @@ class TrainExecutor:
 
     # -- loop ----------------------------------------------------------------
 
+    def _take_batches(self, data_iter: Iterator, n: int) -> List[Any]:
+        out: List[Any] = []
+        for _ in range(n):
+            try:
+                out.append(next(data_iter))
+            except StopIteration:
+                break
+        return out
+
+    def _materialize_oldest(self, handle_nonfinite: bool = True) -> bool:
+        """Pop the oldest in-flight call, pull its metrics to host (the
+        ONE device sync of the pipeline — it waits only on work that is
+        already ``train_window`` calls old), and run the lagged per-step
+        consumers: after-step hooks, the finite check, speed logging.
+        Returns True when a non-finite step triggered a rollback (the
+        remaining in-flight steps descend from the poisoned state, so
+        the window is discarded wholesale)."""
+        import jax
+
+        entry = self._window.popleft()
+        host = jax.device_get(entry.metrics)
+        touch_heartbeat()
+        stacked = entry.count > 1
+        for i in range(entry.count):
+            s = entry.last_step - entry.count + 1 + i
+            if stacked:
+                sub = {
+                    k: (v[i] if getattr(v, "ndim", 0) > 0 else v)
+                    for k, v in host.items()
+                }
+            else:
+                sub = host
+            self._last_metrics = sub
+            for hook in self._hooks:
+                hook.after_step(s, sub)
+            if (
+                handle_nonfinite
+                and self._check_finite_every
+                and s % self._check_finite_every == 0
+                and not self._step_is_finite(sub)
+            ):
+                if self._handle_nonfinite(s, sub):
+                    self._window.clear()
+                    return True
+            if self._log_every and s % self._log_every == 0:
+                dt = time.time() - self._last_log
+                self._last_log = time.time()
+                logger.info(
+                    "step %d loss=%.4f (%.2f steps/s)", s,
+                    float(sub.get("loss", float("nan"))),
+                    self._log_every / max(dt, 1e-9),
+                )
+        return False
+
+    def _trim_window(self, limit: int, handle_nonfinite: bool = True) -> bool:
+        while len(self._window) > limit:
+            if self._materialize_oldest(handle_nonfinite):
+                return True
+        return False
+
+    def _drain_window(self, handle_nonfinite: bool = True) -> bool:
+        """Materialize every in-flight step (eval/exit/preemption/restart
+        boundaries). Returns True when the drain hit a rollback."""
+        return self._trim_window(0, handle_nonfinite)
+
     def train_and_evaluate(self) -> Dict[str, Any]:
         # NB: no heartbeat before the first step — the agent's
         # hang_first_beat_grace covers setup + first-step compile, and an
@@ -377,55 +486,102 @@ class TrainExecutor:
             self._failover.start()
 
         step = int(self.state.step)
-        last_log = time.time()
+        self._last_log = time.time()
         self._last_eval_step = -1
+        window = self._train_window
+        k_call = max(1, int(getattr(self._trainer, "steps_per_call", 1)))
+        self._window.clear()
         try:
             while True:
                 data_iter = iter(self._train_iter_fn())
                 restarted = False
-                for batch in data_iter:
-                    for hook in self._hooks:
-                        hook.before_step(step + 1)
-                    self.state, metrics = self._trainer.step(
-                        self.state, batch
-                    )
-                    self._last_metrics = metrics
-                    step += 1
+                while True:
+                    take = k_call
+                    if self._train_steps:
+                        take = min(take, self._train_steps - step)
+                    group = self._take_batches(data_iter, take)
+                    if not group:
+                        break  # data source exhausted
+                    if len(group) == k_call and k_call > 1:
+                        for i in range(k_call):
+                            for hook in self._hooks:
+                                hook.before_step(step + 1 + i)
+                        self.state, metrics = self._trainer.step_multi(
+                            self.state, group
+                        )
+                        step += k_call
+                        self._window.append(
+                            _Inflight(step, k_call, metrics)
+                        )
+                    else:
+                        # a group short of steps_per_call (stream tail,
+                        # or the last train_steps remainder) dispatches
+                        # as single steps. Under K>1 every prior call
+                        # went through the multi-step program, so the
+                        # FIRST short group traces+compiles the
+                        # single-step jit — minutes at scale; lease a
+                        # no-beat window so the hang detector doesn't
+                        # misread the compile as a stall
+                        if k_call > 1:
+                            from dlrover_tpu.diagnosis.hang_detector \
+                                import announce_long_phase
+
+                            announce_long_phase(900.0)
+                        for batch in group:
+                            for hook in self._hooks:
+                                hook.before_step(step + 1)
+                            self.state, metrics = self._trainer.step(
+                                self.state, batch
+                            )
+                            step += 1
+                            self._window.append(
+                                _Inflight(step, 1, metrics)
+                            )
                     touch_heartbeat()  # hang-relaunch liveness beacon
                     self._update_trace(step)
-                    for hook in self._hooks:
-                        hook.after_step(step, metrics)
+
+                    if self._trim_window(window):
+                        step = int(self.state.step)
+                        restarted = True
+                        break  # rollback: fresh iterator + old state
 
                     if self._preempted is not None:
+                        # drain first: the emergency save must cover the
+                        # last MATERIALIZED (completed-on-device) step,
+                        # and the finite guard in _finish_preempted needs
+                        # real host metrics to judge
+                        self._drain_window(handle_nonfinite=False)
                         return self._finish_preempted(step)
 
-                    if (
-                        self._check_finite_every
-                        and step % self._check_finite_every == 0
-                        and not self._step_is_finite(metrics)
+                    if self._eval_every and (
+                        step // self._eval_every
+                        > (step - len(group)) // self._eval_every
                     ):
-                        if self._handle_nonfinite(step, metrics):
+                        if self._drain_window():
                             step = int(self.state.step)
                             restarted = True
-                            break  # rollback: fresh iterator + old state
-                    if self._log_every and step % self._log_every == 0:
-                        dt = time.time() - last_log
-                        last_log = time.time()
-                        logger.info(
-                            "step %d loss=%.4f (%.2f steps/s)", step,
-                            float(metrics.get("loss", float("nan"))),
-                            self._log_every / max(dt, 1e-9),
-                        )
-                    if self._eval_every and step % self._eval_every == 0:
+                            break
                         self._evaluate(step)
                     if self._train_steps and step >= self._train_steps:
+                        if self._drain_window():
+                            step = int(self.state.step)
+                            restarted = True
+                            break
                         return self._finish(step)
                     if self._restart_requested:
+                        if self._drain_window():
+                            step = int(self.state.step)
+                            restarted = True
+                            break
                         self._maybe_restart()
                         restarted = True
                         break  # re-enter with a fresh data iterator
                 if not restarted:
-                    # data source exhausted
+                    # data source exhausted: drain, then finish (a drain
+                    # that rolled back re-enters with a fresh iterator)
+                    if self._drain_window():
+                        step = int(self.state.step)
+                        continue
                     return self._finish(step)
         finally:
             self._stop_trace_if_open(step)

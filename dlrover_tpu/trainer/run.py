@@ -76,6 +76,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's --relaunch_on_hanging mode")
     p.add_argument("--log_dir", default="",
                    help="redirect per-worker stdout/err to this directory")
+    p.add_argument("--train_window", type=int, default=None,
+                   help="in-flight dispatch window of the async train "
+                        "loop (0 = synchronous; workers see it as "
+                        "DLROVER_TPU_TRAIN_WINDOW)")
+    p.add_argument("--steps_per_call", type=int, default=None,
+                   help="optimizer steps fused per compiled call "
+                        "(lax.scan multi-step; workers see it as "
+                        "DLROVER_TPU_STEPS_PER_CALL)")
     p.add_argument("entrypoint", help="training script or executable")
     p.add_argument("args", nargs=argparse.REMAINDER)
     return p
@@ -137,6 +145,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     script_args = list(args.args)
     if script_args and script_args[0] == "--":
         script_args = script_args[1:]  # strip only the leading separator
+    # dispatch-pipeline knobs ride the worker environment: the Context
+    # singleton reads DLROVER_TPU_* overrides at import, so every
+    # executor/trainer the entrypoint builds picks them up without code
+    # changes (and the degraded no-master path inherits them too)
+    if args.train_window is not None:
+        os.environ["DLROVER_TPU_TRAIN_WINDOW"] = str(args.train_window)
+    if args.steps_per_call is not None:
+        os.environ["DLROVER_TPU_STEPS_PER_CALL"] = str(args.steps_per_call)
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     nproc = 1 if args.nproc_per_node == "auto" else int(args.nproc_per_node)
     if nproc < 1:

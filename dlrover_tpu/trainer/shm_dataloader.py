@@ -18,8 +18,6 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
-import queue
-import threading
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
@@ -30,6 +28,7 @@ from dlrover_tpu.native.shm_ring import (
     RingTimeout,
     ShmBatchRing,
 )
+from dlrover_tpu.trainer.data import DevicePreloader
 
 logger = get_logger("trainer.shm")
 
@@ -127,33 +126,13 @@ class ShmDataLoader:
         self.shutdown()
 
 
-class DevicePrefetcher:
+class DevicePrefetcher(DevicePreloader):
     """Overlap host->device transfer with compute: keeps ``depth`` batches
-    in flight via ``jax.device_put`` (async) on a background thread."""
+    in flight via ``put_fn`` (async ``jax.device_put``) on a background
+    thread — the shm-path face of the ONE sharding-aware prefetcher
+    (``trainer.data.DevicePreloader`` in background mode)."""
 
     def __init__(self, batches: Iterator[Any], put_fn: Callable[[Any], Any],
                  depth: int = 2):
-        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
-        self._done = object()
-        self._error: Optional[BaseException] = None
-
-        def pump():
-            try:
-                for b in batches:
-                    self._q.put(put_fn(b))
-            except BaseException as e:  # surface in the consumer, not lost
-                self._error = e
-            finally:
-                self._q.put(self._done)
-
-        self._thread = threading.Thread(target=pump, daemon=True)
-        self._thread.start()
-
-    def __iter__(self):
-        while True:
-            item = self._q.get()
-            if item is self._done:
-                if self._error is not None:
-                    raise self._error
-                return
-            yield item
+        super().__init__(batches, prefetch=depth, put_fn=put_fn,
+                         background=True)

@@ -501,6 +501,25 @@ def test_preemption_grace_saves_at_killed_step(tmp_path):
 
 
 @pytest.mark.slow
+def test_preemption_mid_window_drains_and_resumes(tmp_path):
+    """SIGTERM while the ASYNC loop (train_window=4) has several step
+    dispatches in flight: the executor drains the window — every
+    dispatched step materializes — then flushes the emergency save at
+    the last materialized step, and a restarted worker resumes exactly
+    there. The shared cycle's invariants (clean in-grace exit, <= 1
+    step lost, resume-at-killed-step, completion) all run against the
+    pipelined loop."""
+    killed_step, records = _preempt_cycle(
+        tmp_path, extra_env={"PREEMPT_WINDOW": "4"},
+    )
+    # the drain materialized the full in-flight chain before the save:
+    # the per-step status events reach the killed step with no holes
+    step_events = [r["step"] for r in records if r.get("event") == "step"]
+    pre_kill = [s for s in step_events if s <= killed_step]
+    assert pre_kill == list(range(1, killed_step + 1)), pre_kill
+
+
+@pytest.mark.slow
 def test_preemption_grace_under_pipeline(tmp_path):
     """The SIGTERM preemption-grace save also holds when the worker is
     mid-PIPELINED training on a pipe mesh: the emergency checkpoint

@@ -5,6 +5,8 @@ native: save at one world size, restore at another
 (``fsdp_save_util.py``'s reshard-on-load), via GSPMD + Orbax.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -232,6 +234,52 @@ class TestElasticCheckpoint:
         mgr.wait()
         out = mgr.restore(abstract_like(state, res.state_sharding))
         assert out["shard_checkpoint"] == '{"todo": [[0, 64]]}'
+        mgr.close()
+
+    def test_wait_surfaces_mirror_timeout(self, tmp_path):
+        """A staging mirror that never commits must not be silently
+        forgotten: wait() returns timed_out=True, logs the
+        CKPT_MIRROR_TIMEOUT error code, and keeps the thread joinable
+        for a later wait (ISSUE 3 satellite — the preemption drain
+        needs to TELL that the mirror never committed)."""
+        import threading
+
+        mgr = ElasticCheckpointManager(str(tmp_path), async_save=False)
+        release = threading.Event()
+        stuck = threading.Thread(target=release.wait, daemon=True,
+                                 name="stuck-mirror")
+        stuck.start()
+        mgr._mirror_threads = [stuck]
+        assert mgr.wait(mirror_timeout=0.05) is True
+        assert mgr._mirror_threads == [stuck]  # observable, not dropped
+        # an already-flagged thread is only POLLED: back-to-back waits
+        # (the preemption drain) must not re-pay the join timeout
+        t0 = time.monotonic()
+        assert mgr.wait(mirror_timeout=60.0) is True
+        assert time.monotonic() - t0 < 5.0
+        release.set()
+        stuck.join(timeout=5.0)
+        assert mgr.wait(mirror_timeout=5.0) is False
+        assert mgr._mirror_threads == []
+        mgr.close()
+
+    def test_superseded_step_mirror_stops_polling(self, tmp_path):
+        """max_to_keep can delete a step dir before its mirror thread
+        ever sees it; the poll must bail when a NEWER step committed
+        instead of spinning to the 600 s deadline (and stalling wait()
+        for the full join timeout on every exit path)."""
+        import time as _time
+
+        mgr = ElasticCheckpointManager(
+            str(tmp_path / "ckpt"), async_save=False,
+            staging_dir=str(tmp_path / "shm"),
+        )
+        # a newer committed step exists; step 1 never will
+        (tmp_path / "ckpt" / "5").mkdir()
+        t0 = _time.monotonic()
+        mgr._wait_and_mirror(1, deadline_s=30.0)
+        assert _time.monotonic() - t0 < 5.0
+        assert mgr.staged_step() != 1
         mgr.close()
 
 

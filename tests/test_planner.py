@@ -294,6 +294,112 @@ class TestDevicePreloader:
         with pytest.raises(ValueError):
             DevicePreloader([], prefetch=0)
 
+    def test_steps_per_call_stacks_k_batches(self):
+        # 5 batches at K=2 -> two stacked [2, ...] items, trailing
+        # partial group dropped (fixed shapes only)
+        batches = [{"x": np.full((4, 3), i)} for i in range(5)]
+        out = list(DevicePreloader(batches, steps_per_call=2))
+        assert len(out) == 2
+        assert out[0]["x"].shape == (2, 4, 3)
+        assert int(out[1]["x"][1][0, 0]) == 3
+
+    def test_steps_per_call_with_stacked_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = MeshPlan(data=-1).build()
+        sharding = NamedSharding(mesh, PartitionSpec(None, "data"))
+        n = mesh.devices.size
+        out = list(DevicePreloader(
+            [{"x": np.zeros((n, 3))} for _ in range(2)],
+            sharding=sharding, steps_per_call=2,
+        ))
+        assert out[0]["x"].shape == (2, n, 3)
+        assert out[0]["x"].sharding == sharding
+
+    def test_background_mode_yields_all_and_surfaces_errors(self):
+        # the consolidated prefetcher's shm-path mode: background
+        # thread + bounded queue, errors re-raised in the consumer
+        out = list(DevicePreloader(
+            iter(range(10)), put_fn=lambda x: x * 2, background=True,
+        ))
+        assert out == [x * 2 for x in range(10)]
+
+        def boom():
+            yield 1
+            raise RuntimeError("producer died")
+
+        it = iter(DevicePreloader(
+            boom(), put_fn=lambda x: x, background=True,
+        ))
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="producer died"):
+            list(it)
+
+    def test_shm_device_prefetcher_is_the_same_implementation(self):
+        from dlrover_tpu.trainer.shm_dataloader import DevicePrefetcher
+
+        assert issubclass(DevicePrefetcher, DevicePreloader)
+        out = list(DevicePrefetcher(iter(range(4)), lambda x: x + 1))
+        assert out == [1, 2, 3, 4]
+
+
+class TestDispatchOverheadTerm:
+    """estimate() prices the host dispatch floor, amortized by
+    steps_per_call (ISSUE 3: the planner knows why multi-step fusion
+    helps tiny/fast steps and why big models don't care)."""
+
+    def _tiny_model(self):
+        return ModelSpec(
+            param_count=1_000_000, num_layers=2, hidden_size=64,
+            seq_len=128, global_batch=8,
+        )
+
+    def test_tiny_model_is_dispatch_bound_and_k_amortizes(self):
+        from dlrover_tpu.parallel.planner import (
+            HOST_DISPATCH_OVERHEAD_S,
+            estimate,
+        )
+
+        plan = MeshPlan(data=1)
+        a = estimate(plan, self._tiny_model())
+        b = estimate(plan, self._tiny_model(), steps_per_call=8)
+        assert a.breakdown["dispatch_s"] == pytest.approx(
+            HOST_DISPATCH_OVERHEAD_S)
+        assert b.breakdown["dispatch_s"] == pytest.approx(
+            HOST_DISPATCH_OVERHEAD_S / 8)
+        # floor-bound (plus the 1% device-time ranking residual)
+        assert HOST_DISPATCH_OVERHEAD_S <= a.step_time_s \
+            <= 1.1 * HOST_DISPATCH_OVERHEAD_S
+        assert b.step_time_s < a.step_time_s
+
+    def test_dispatch_floor_preserves_plan_ranking(self):
+        # every tiny-model mesh hits the same host floor; the ranking
+        # must still order by device time, not collapse into a tie
+        from dlrover_tpu.parallel.planner import estimate
+
+        spec = self._tiny_model()
+        times = [
+            estimate(p, spec).step_time_s
+            for p in (MeshPlan(tensor=8), MeshPlan(data=2, tensor=4),
+                      MeshPlan(data=8))
+        ]
+        assert len(set(times)) == len(times)
+
+    def test_compute_bound_model_sees_a_floor_not_a_tax(self):
+        from dlrover_tpu.parallel.planner import estimate
+
+        model = ModelSpec(
+            param_count=7_000_000_000, num_layers=32, hidden_size=4096,
+            seq_len=4096, global_batch=64,
+        )
+        plan = MeshPlan(data=2, fsdp=4)
+        a = estimate(plan, model)
+        b = estimate(plan, model, steps_per_call=8)
+        # a 7B step is orders of magnitude above the dispatch floor:
+        # fusing steps must not change its predicted time at all
+        assert a.step_time_s == b.step_time_s
+        assert a.step_time_s > 100 * a.breakdown["dispatch_s"]
+
 
 class TestPlanStageDepths:
     """plan_stage_depths bridges the stage-split DP to

@@ -52,6 +52,11 @@ def emit(record):
 PIPELINED = os.environ.get("PREEMPT_PIPELINE", "") == "1"
 SLOW_AFTER = int(os.environ.get("PREEMPT_SLOW_AFTER", "0"))
 SLOW_SECS = float(os.environ.get("PREEMPT_SLOW_SECS", "300"))
+# PREEMPT_WINDOW=W (>0): run the ASYNC dispatch-pipelined loop with W
+# step calls in flight — the mid-window preemption chaos test. Default
+# 0 keeps the legacy synchronous timing these tests' kill windows and
+# per-step save assertions were written against.
+WINDOW = int(os.environ.get("PREEMPT_WINDOW", "0"))
 
 cfg = llama.llama_tiny(num_layers=4 if PIPELINED else 2,
                        max_seq_len=64, use_flash=False)
@@ -124,7 +129,8 @@ executor = TrainExecutor(
     train_iter_fn=batches,
     hooks=[StatusHook()],
     conf=Configuration({"train_steps": TOTAL_STEPS,
-                        "log_every_steps": 0}),
+                        "log_every_steps": 0,
+                        "train_window": WINDOW}),
 )
 result = executor.train_and_evaluate()
 emit({"event": "end", "preempted": bool(result.get("preempted")),
