@@ -19,6 +19,14 @@ from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import (
+    EventKind,
+    SpanName,
+    emit_event,
+    get_registry,
+    names as tm,
+    span,
+)
 
 logger = get_logger("agent.rdzv")
 
@@ -121,25 +129,49 @@ class MasterRendezvousHandler:
         self._reserved_sock = reserve_port()
         coord_port = self._reserved_sock.getsockname()[1]
         addr = f"{self._host_ip}:{coord_port}"
-        self._client.join_rendezvous(
-            self.node_rank, self.local_world_size,
-            rdzv_name=self.rdzv_name, addr=addr,
-        )
-        deadline = time.time() + timeout
-        while True:
-            world_msg = self._client.get_comm_world(
-                self.rdzv_name, self.node_rank
+        t0 = time.monotonic()
+        emit_event(EventKind.RDZV_JOIN, rdzv=self.rdzv_name,
+                   node_rank=self.node_rank)
+        with span(SpanName.RENDEZVOUS, category="rdzv",
+                  rdzv=self.rdzv_name):
+            self._client.join_rendezvous(
+                self.node_rank, self.local_world_size,
+                rdzv_name=self.rdzv_name, addr=addr,
             )
-            world = world_msg.world or {}
-            if self.node_rank in world:
-                return self._build_info(world_msg.round, world,
-                                        world_msg.coordinator_addr)
-            if time.time() > deadline:
-                raise RendezvousTimeoutError(
-                    f"{self.rdzv_name}: rank {self.node_rank} not admitted "
-                    f"within {timeout}s (world={world})"
+            deadline = time.time() + timeout
+            while True:
+                world_msg = self._client.get_comm_world(
+                    self.rdzv_name, self.node_rank
                 )
-            time.sleep(self._poll_interval)
+                world = world_msg.world or {}
+                if self.node_rank in world:
+                    elapsed = time.monotonic() - t0
+                    reg = get_registry()
+                    reg.counter(
+                        tm.RDZV_ROUNDS,
+                        help="completed rendezvous rounds").inc()
+                    reg.histogram(
+                        tm.RDZV_TIME,
+                        help="join -> completed-world wall time",
+                    ).observe(elapsed)
+                    emit_event(EventKind.RDZV_COMPLETE,
+                               rdzv=self.rdzv_name,
+                               round=world_msg.round,
+                               world_size=len(world),
+                               wait_seconds=round(elapsed, 3))
+                    return self._build_info(world_msg.round, world,
+                                            world_msg.coordinator_addr)
+                if time.time() > deadline:
+                    emit_event(EventKind.RDZV_TIMEOUT,
+                               error_code="RDZV_TIMEOUT",
+                               rdzv=self.rdzv_name,
+                               node_rank=self.node_rank,
+                               timeout_seconds=timeout)
+                    raise RendezvousTimeoutError(
+                        f"{self.rdzv_name}: rank {self.node_rank} not "
+                        f"admitted within {timeout}s (world={world})"
+                    )
+                time.sleep(self._poll_interval)
 
     def _build_info(self, rdzv_round: int, world: Dict[int, int],
                     coordinator_addr: str) -> RendezvousInfo:

@@ -41,6 +41,12 @@ from dlrover_tpu.common.constants import (
     TrainingExceptionLevel,
 )
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
 
 logger = get_logger("agent.training")
 
@@ -98,6 +104,13 @@ class ElasticTrainingAgent:
         self._remaining_restarts = config.max_restarts
         self._host_ip = host_ip
         self.last_rdzv: Optional[RendezvousInfo] = None
+        reg = get_registry()
+        self._c_restarts = reg.counter(
+            tm.AGENT_WORKER_RESTARTS, help="worker-group restarts")
+        self._c_hangs = reg.counter(
+            tm.AGENT_HANG_DETECTIONS, help="heartbeat-gap hangs detected")
+        self._c_failures = reg.counter(
+            tm.AGENT_WORKER_FAILURES, help="worker process failures seen")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -126,9 +139,19 @@ class ElasticTrainingAgent:
         self._worker_group.start(
             rdzv, self._client.addr, self._config.node_id
         )
+        # the MTTR recovery edge: for every failure-class event before
+        # it (worker death, hang), this marks workers running again
+        emit_event(EventKind.WORKERS_STARTED,
+                   round=rdzv.round,
+                   restart_round=self._worker_group.restart_round,
+                   world_size=rdzv.group_world_size)
 
     def _restart_workers(self):
         logger.info("restarting workers into a new rendezvous round")
+        self._c_restarts.inc()
+        emit_event(EventKind.AGENT_RESTART,
+                   restart_round=self._worker_group.restart_round,
+                   remaining_restarts=self._remaining_restarts)
         self._worker_group.stop()
         self._worker_group.restart_count_up()
         self._initialize_workers()
@@ -189,6 +212,10 @@ class ElasticTrainingAgent:
             "no worker heartbeat for %.1f s (timeout %.1f s): treating "
             "as hang", gap, self._config.hang_timeout,
         )
+        self._c_hangs.inc()
+        emit_event(EventKind.HANG_DETECTED, error_code="HANG",
+                   gap_seconds=round(gap, 1),
+                   timeout_seconds=self._config.hang_timeout)
         self._client.report_failure(
             node_rank=self._config.node_rank,
             restart_count=self._worker_group.restart_round,
@@ -215,6 +242,11 @@ class ElasticTrainingAgent:
                 "worker local_rank=%d exited with code %d",
                 failure.local_rank, failure.exit_code,
             )
+            self._c_failures.inc()
+            emit_event(EventKind.WORKER_FAILED,
+                       error_code=f"EXIT_{failure.exit_code}",
+                       local_rank=failure.local_rank,
+                       restart_round=self._worker_group.restart_round)
             self._client.report_failure(
                 node_rank=self._config.node_rank,
                 restart_count=self._worker_group.restart_round,

@@ -12,6 +12,9 @@ source of silent hangs and mystery slowdowns at scale:
   DLR005 shared-mutable-default mutable defaults aliased across instances
   DLR006 host-sync-on-metrics  float()/.item()/np.asarray() on step
                                metrics — a device sync on the hot loop
+  DLR007 unregistered-metric-name  a string literal handed to a
+                               telemetry API instead of a
+                               telemetry.names constant
 
 Rules are deliberately syntactic (no type inference): they over-approximate
 in ways the checked-in baseline absorbs, and under-approximate in ways unit
@@ -47,6 +50,12 @@ MUTABLE_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict",
 # metrics window exists to avoid)
 SYNC_CALLS = {"float", "int", "bool"}
 SYNC_ARRAY_CALLS = {"asarray", "array", "device_get"}
+# DLR007: telemetry APIs whose FIRST argument is a metric/event name.
+# Lowercase method names only — collections.Counter(...) etc. don't
+# collide. The telemetry package itself (names.py + registry internals)
+# is exempt: it is where names are allowed to be literal.
+TELEMETRY_NAME_CALLS = {"counter", "gauge", "histogram", "emit_event"}
+TELEMETRY_PKG_FRAGMENT = "telemetry/"
 
 
 def _dotted(node: ast.AST) -> str:
@@ -166,6 +175,7 @@ class _Linter(ast.NodeVisitor):
         if self._jit_depth > 0:
             self._check_impure_in_jit(node)
         self._check_host_sync_on_metrics(node)
+        self._check_telemetry_name_literal(node)
         if (isinstance(node.func, ast.Attribute)
                 and node.func.attr == "Thread"):
             self._check_thread_daemon(node)
@@ -314,6 +324,43 @@ class _Linter(ast.NodeVisitor):
             "(train_window) or move the read off the per-step path",
         )
 
+    # -- DLR007: ad-hoc metric/event names ----------------------------------
+
+    def _check_telemetry_name_literal(self, node: ast.Call):
+        """A string literal as the name argument of ``counter()`` /
+        ``gauge()`` / ``histogram()`` / ``emit_event()``: names minted
+        at the call site drift apart ("step_time" vs "step_time_s"
+        claiming to be the same series), never reach the documented
+        name table, and can silently collide with another subsystem's
+        metric. All names must come from ``dlrover_tpu.telemetry.names``
+        (which the rule exempts, along with the registry internals)."""
+        if TELEMETRY_PKG_FRAGMENT in self.path:
+            return
+        name = _dotted(node.func)
+        short = name.rsplit(".", 1)[-1] if name else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        )
+        if short not in TELEMETRY_NAME_CALLS:
+            return
+        target = None
+        if node.args:
+            target = node.args[0]
+        else:
+            for kw in node.keywords:
+                if kw.arg in ("name", "kind"):
+                    target = kw.value
+                    break
+        if isinstance(target, ast.Constant) and isinstance(
+                target.value, str):
+            self._emit(
+                "DLR007", node,
+                f"`{short}({target.value!r})` mints a metric/event name "
+                f"at the call site: unregistered names drift, collide, "
+                f"and never reach the docs/observability.md name table",
+                "add a constant to dlrover_tpu/telemetry/names.py and "
+                "pass it instead of the literal",
+            )
+
     # -- DLR005: shared mutable defaults ------------------------------------
 
     def _check_mutable_defaults(self, node):
@@ -351,7 +398,7 @@ class _Linter(ast.NodeVisitor):
 
 
 ALL_AST_RULES = ("DLR001", "DLR002", "DLR003", "DLR004", "DLR005",
-                 "DLR006")
+                 "DLR006", "DLR007")
 
 RULE_DOCS: Dict[str, str] = {
     "DLR001": "gRPC invocation without a timeout= deadline",
@@ -362,6 +409,8 @@ RULE_DOCS: Dict[str, str] = {
     "DLR006": "host-device sync (float/int/bool, .item(), np.asarray/"
               "np.array, jax.device_get) on step-metric values in the "
               "hot loop",
+    "DLR007": "string-literal metric/event name at a telemetry call "
+              "site (must be a dlrover_tpu.telemetry.names constant)",
 }
 
 

@@ -33,6 +33,14 @@ import jax
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import (
+    EventKind,
+    SpanName,
+    emit_event,
+    get_registry,
+    names as tm,
+    span,
+)
 
 logger = get_logger("checkpoint.manager")
 
@@ -148,6 +156,22 @@ class ElasticCheckpointManager:
         if staging_dir:
             self._staging_root = os.path.abspath(staging_dir)
             os.makedirs(self._staging_root, exist_ok=True)
+        reg = get_registry()
+        self._c_saves = reg.counter(
+            tm.CKPT_SAVES, help="checkpoint saves queued")
+        self._h_save = reg.histogram(
+            tm.CKPT_SAVE_TIME,
+            help="host time staging a save (async: device->host copy "
+                 "before the background write)")
+        self._h_mirror = reg.histogram(
+            tm.CKPT_MIRROR_TIME, help="host-DRAM staging mirror copy time")
+        self._c_mirror_timeouts = reg.counter(
+            tm.CKPT_MIRROR_TIMEOUTS,
+            help="staging mirrors still uncommitted at a wait() deadline")
+        self._h_restore = reg.histogram(
+            tm.CKPT_RESTORE_TIME, help="restore wall time")
+        self._c_restores = reg.counter(
+            tm.CKPT_RESTORES, help="successful restores")
         self._mirror_lock = threading.Lock()
         self._mirror_threads: list = []
         # mirror THREAD OBJECTS that already consumed a full join
@@ -183,8 +207,16 @@ class ElasticCheckpointManager:
             args["data_shards"] = ocp.args.JsonSave(
                 {"checkpoint": shard_checkpoint}
             )
-        saved = self._manager.save(step, args=ocp.args.Composite(**args))
+        t0 = time.monotonic()
+        with span(SpanName.CKPT_SAVE_STAGE, step=step):
+            saved = self._manager.save(
+                step, args=ocp.args.Composite(**args))
         if saved:
+            stage_s = time.monotonic() - t0
+            self._c_saves.inc()
+            self._h_save.observe(stage_s)
+            emit_event(EventKind.CKPT_SAVE, step=step,
+                       stage_seconds=round(stage_s, 3), forced=force)
             self.interval.mark_saved(step)
             logger.info("checkpoint %d queued to %s", step, self.directory)
             if self._staging_root is not None:
@@ -227,6 +259,10 @@ class ElasticCheckpointManager:
                 pending.append(thread)
                 if thread not in self._mirror_timed_out:
                     self._mirror_timed_out.add(thread)
+                    self._c_mirror_timeouts.inc()
+                    emit_event(EventKind.CKPT_MIRROR_TIMEOUT,
+                               error_code="CKPT_MIRROR_TIMEOUT",
+                               timeout_seconds=mirror_timeout)
                     logger.error(
                         "[CKPT_MIRROR_TIMEOUT] staging mirror thread %s "
                         "still running after %.0fs: the host-DRAM mirror "
@@ -342,11 +378,13 @@ class ElasticCheckpointManager:
             tmp = os.path.join(self._staging_root, f".tmp_{step}")
             dst = self._step_dir(self._staging_root, step)
             shutil.rmtree(tmp, ignore_errors=True)
+            t0 = time.monotonic()
             try:
-                digest = self._dir_digest(src)
-                shutil.copytree(src, tmp)
-                shutil.rmtree(dst, ignore_errors=True)
-                os.rename(tmp, dst)
+                with span(SpanName.CKPT_MIRROR, step=step):
+                    digest = self._dir_digest(src)
+                    shutil.copytree(src, tmp)
+                    shutil.rmtree(dst, ignore_errors=True)
+                    os.rename(tmp, dst)
                 with open(dst + ".digest", "w") as f:
                     f.write(digest)
                 self._write_provenance()
@@ -362,6 +400,10 @@ class ElasticCheckpointManager:
                                 os.remove(path)
                             except OSError:
                                 pass
+                mirror_s = time.monotonic() - t0
+                self._h_mirror.observe(mirror_s)
+                emit_event(EventKind.CKPT_MIRROR, step=step,
+                           mirror_seconds=round(mirror_s, 3))
                 logger.info("checkpoint %d staged to %s", step,
                             self._staging_root)
             except OSError as e:  # tmpfs full, races — never fail the job
@@ -518,6 +560,22 @@ class ElasticCheckpointManager:
         step (no storage round-trip). Returns {"state": ..., "meta":
         {...}, "shard_checkpoint": str}, or None if no checkpoint exists.
         """
+        t0 = time.monotonic()
+        with span(SpanName.CKPT_RESTORE):
+            out = self._restore_any(abstract_state, step)
+        if out is not None:
+            restore_s = time.monotonic() - t0
+            self._h_restore.observe(restore_s)
+            self._c_restores.inc()
+            emit_event(EventKind.CKPT_RESTORE, step=out.get("step"),
+                       restore_seconds=round(restore_s, 3))
+        return out
+
+    def _restore_any(
+        self,
+        abstract_state: Any,
+        step: Optional[int] = None,
+    ) -> Optional[Dict[str, Any]]:
         staging_only = False
         explicit_step = step is not None
         if step is None:

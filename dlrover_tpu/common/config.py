@@ -79,6 +79,20 @@ class Context:
         self.trace_dir = ""
         self.trace_start_step = 5
         self.trace_num_steps = 3
+        # telemetry (dlrover_tpu.telemetry / docs/observability.md):
+        # master switch for the metrics registry, event timeline, and
+        # host-span tracing (each instrument site holds handles fetched
+        # through get_registry(), which goes null when this is off)
+        self.telemetry_enabled = True
+        # append-only JSONL event-timeline sink ("" = in-memory ring
+        # only); DLROVER_TPU_EVENTS_FILE overrides per process and is
+        # what the agent hands its workers so one file holds the job
+        self.telemetry_events_file = ""
+        # Prometheus exposition port on the agent/master (0 = off)
+        self.telemetry_metrics_port = 0
+        # signal name ("" = off, e.g. "USR2") that opens an on-demand
+        # bounded jax.profiler trace window in the executor
+        self.profile_signal = ""
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -23,6 +23,12 @@ from dlrover_tpu.common.constants import (
     TrainingExceptionLevel,
 )
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
 
 logger = get_logger("diagnosis.errors")
 
@@ -54,26 +60,71 @@ class ErrorRecord:
 class ErrorLogMonitor:
     max_records: int = 200
     records: List[ErrorRecord] = field(default_factory=list)
+    # repeated IDENTICAL errors (same node + classified code) inside
+    # this window are counted, not logged: a crash-looping rank at a
+    # 2s monitor cadence otherwise floods the master log at ~30
+    # lines/min/rank and buries the first, informative, occurrence
+    dedup_window_secs: float = 60.0
 
     def __post_init__(self):
         self._lock = threading.Lock()
+        # (node_id, reason) -> [window_start_ts, suppressed_count]
+        self._recent: Dict[tuple, list] = {}
+        reg = get_registry()
+        self._c_errors = reg.counter(
+            tm.ERROR_REPORTS, help="failure reports classified")
+        self._c_deduped = reg.counter(
+            tm.ERRORS_DEDUPED,
+            help="repeated identical errors suppressed from the log "
+                 "inside the dedup window")
 
     def process_error(
         self, node_id: int, restart_count: int, error_data: str, level: str
     ) -> str:
         """Classify and record; returns the inferred NodeExitReason."""
         reason = classify_error(error_data)
+        now = time.time()
         record = ErrorRecord(
-            timestamp=time.time(),
+            timestamp=now,
             node_id=node_id,
             level=level,
             reason=reason,
             message=error_data[:2048],
         )
+        self._c_errors.inc()
+        key = (node_id, reason)
         with self._lock:
             self.records.append(record)
             if len(self.records) > self.max_records:
                 del self.records[: -self.max_records]
+            window = self._recent.get(key)
+            if window is not None and (
+                now - window[0] < self.dedup_window_secs
+            ):
+                window[1] += 1
+                suppressed = window[1]
+            else:
+                prior = window[1] if window is not None else 0
+                self._recent[key] = [now, 0]
+                suppressed = 0
+        if suppressed:
+            # duplicate inside the window: count it, keep the log quiet
+            self._c_deduped.inc()
+            logger.debug(
+                "node %d repeat failure (reason=%s, %d suppressed in "
+                "window)", node_id, reason, suppressed,
+            )
+            return reason
+        # first occurrence (or window expired): log + event-timeline
+        # record; the log line carries the event seq so operators can
+        # jump from the log to the structured record
+        event = emit_event(
+            EventKind.ERROR_REPORT, error_code=reason,
+            failed_node=node_id, level=level,
+            restart_count=restart_count,
+            message=error_data[:512],
+            repeats_last_window=prior,
+        )
         log = (
             logger.error
             if level in (TrainingExceptionLevel.NODE_ERROR,
@@ -81,8 +132,12 @@ class ErrorLogMonitor:
             else logger.warning
         )
         log(
-            "node %d failure (level=%s restarts=%d reason=%s): %s",
-            node_id, level, restart_count, reason, error_data[:512],
+            "node %d failure (level=%s restarts=%d reason=%s)"
+            "%s [event #%s]: %s",
+            node_id, level, restart_count, reason,
+            (f" (+{prior} identical suppressed in the last "
+             f"{self.dedup_window_secs:.0f}s)" if prior else ""),
+            event.get("seq", "-"), error_data[:512],
         )
         return reason
 

@@ -33,6 +33,10 @@ logger = get_logger("master.local")
 
 class LocalJobMaster:
     def __init__(self, port: int = 0, job_name: str = "local"):
+        from dlrover_tpu.master.stats.job_collector import (
+            JobMetricCollector,
+        )
+
         self.job_name = job_name
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager(self.speed_monitor)
@@ -43,6 +47,10 @@ class LocalJobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
         self.elastic_ps_service = ElasticPsService()
+        # model/dataset facts + the periodic runtime series land in the
+        # stats reporter — the store the local optimizer and the Brain
+        # watcher read, so they consume REAL series in standalone mode
+        self.metric_collector = JobMetricCollector(job_name)
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             rdzv_managers=self.rdzv_managers,
@@ -50,15 +58,43 @@ class LocalJobMaster:
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
+            metric_collector=self.metric_collector,
         )
         self._server, self.port = build_server(self.servicer, port=port)
         self.addr = f"127.0.0.1:{self.port}"
         self._stopped = threading.Event()
+        self._stats_thread: threading.Thread = threading.Thread(
+            target=self._collect_runtime_stats,
+            name="runtime-stats", daemon=True,
+        )
+        self._exporter = None
 
     def prepare(self):
+        from dlrover_tpu.telemetry.exporter import maybe_start_exporter
+
         self._server.start()
         self.task_manager.start()
+        self._stats_thread.start()
+        # Prometheus exposition (off unless telemetry_metrics_port /
+        # DLROVER_TPU_METRICS_PORT is set)
+        self._exporter = maybe_start_exporter()
         logger.info("local master serving at %s", self.addr)
+
+    def _collect_runtime_stats(self):
+        """Periodic RuntimeMetric samples (global step + speed) into the
+        stats reporter — the standalone counterpart of the dist
+        master's node-resource collection loop."""
+        from dlrover_tpu.common.config import get_context
+
+        interval = max(
+            1.0, float(get_context().seconds_interval_to_report))
+        while not self._stopped.wait(interval):
+            try:
+                self.metric_collector.collect_runtime_stats(
+                    self.speed_monitor, {}
+                )
+            except Exception:  # noqa: BLE001 — stats must not kill serving
+                logger.exception("runtime stats collection failed")
 
     def run(self, poll_secs: float = 1.0) -> int:
         """Block until the job exits; returns an exit code."""
@@ -76,6 +112,8 @@ class LocalJobMaster:
     def stop(self):
         self._stopped.set()
         self.task_manager.stop()
+        if self._exporter is not None:
+            self._exporter.stop()
         self._server.stop(grace=1)
 
 

@@ -14,6 +14,7 @@ from collections import deque
 from typing import Deque, List, Optional, Set, Tuple
 
 from dlrover_tpu.common.config import get_context
+from dlrover_tpu.telemetry import get_registry, names as tm
 
 
 class SpeedMonitor:
@@ -21,6 +22,13 @@ class SpeedMonitor:
         self._lock = threading.Lock()
         ctx = get_context()
         self._max_records = ctx.train_speed_record_num
+        reg = get_registry()
+        self._g_step = reg.gauge(
+            tm.MASTER_GLOBAL_STEP,
+            help="newest global step reported by any worker")
+        self._g_speed = reg.gauge(
+            tm.MASTER_TRAIN_SPEED,
+            help="steps/s over the master's report window")
         # (timestamp, global_step) samples
         self._global_step_records: Deque[Tuple[float, int]] = deque(
             maxlen=self._max_records
@@ -44,6 +52,8 @@ class SpeedMonitor:
             self._global_step = max(self._global_step, step)
             self._global_step_records.append((ts, step))
             self._sample_count += 1
+            self._g_step.set(self._global_step)
+        self._g_speed.set(self.running_speed())
 
     def mark_task_completed(self, record_count: int):
         with self._lock:

@@ -88,7 +88,10 @@ class JobAutoScaler:
     def execute_job_optimization_plan(self, plan: ScalePlan):
         import time
 
+        from dlrover_tpu.telemetry import EventKind, emit_event
+
         logger.info("executing optimization plan: %s", plan.to_dict())
         self._speed_monitor.reset_running_speed_monitor()
         self._last_plan_time = time.monotonic()
         self._job_manager.execute_scale_plan(plan)
+        emit_event(EventKind.SCALE_PLAN_APPLIED, plan=plan.to_dict())

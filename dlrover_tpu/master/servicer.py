@@ -50,10 +50,14 @@ class MasterServicer:
         # handle_training_failure records there), else our own so the
         # local master can still answer failed-node queries
         from dlrover_tpu.diagnosis.error_monitor import ErrorLogMonitor
+        from dlrover_tpu.telemetry import get_registry, names as tm
 
         self.error_monitor = getattr(
             job_manager, "error_monitor", None
         ) or ErrorLogMonitor()
+        self._c_failure_reports = get_registry().counter(
+            tm.MASTER_FAILURE_REPORTS,
+            help="NodeFailure reports ingested by the master")
         self.job_exit_requested = False
         self.job_success: Optional[bool] = None
 
@@ -295,6 +299,7 @@ class MasterServicer:
     # -- failures / monitoring ---------------------------------------------
 
     def _report_failure(self, req: comm.NodeFailure):
+        self._c_failure_reports.inc()
         logger.warning(
             "node %d (rank %d) failure level=%s restart=%d: %s",
             req.node_id, req.node_rank, req.level, req.restart_count,

@@ -33,6 +33,17 @@ class JobMetricCollector:
             DatasetMetric(name=name, size=size, storage_size=storage_size)
         )
 
+    def collect_model_info(self, info):
+        """Servicer-facing adapter: a ``comm.ModelInfo`` report becomes
+        a ModelMetric (the servicer hands the raw message through)."""
+        self.collect_model_metric(
+            param_count=int(getattr(info, "num_params", 0) or 0),
+            flops_per_step=float(
+                getattr(info, "flops_per_step", 0.0) or 0.0),
+            activation_bytes=int(
+                getattr(info, "activation_bytes", 0) or 0),
+        )
+
     def collect_model_metric(
         self, param_count: int, flops_per_step: float,
         activation_bytes: int = 0, extra: Dict[str, float] = None,

@@ -17,6 +17,7 @@ from dlrover_tpu.master.stats.training_metrics import (
     ModelMetric,
     RuntimeMetric,
 )
+from dlrover_tpu.telemetry import get_registry, names as tm
 
 
 class StatsReporter:
@@ -50,6 +51,9 @@ class LocalStatsReporter(StatsReporter):
         self.dataset_metric: Optional[DatasetMetric] = None
         self.model_metric: Optional[ModelMetric] = None
         self.runtime_stats: List[RuntimeMetric] = []
+        self._c_samples = get_registry().counter(
+            tm.MASTER_RUNTIME_SAMPLES,
+            help="RuntimeMetric samples ingested by the stats store")
 
     def report_dataset_metric(self, metric: DatasetMetric):
         with self._lock:
@@ -60,6 +64,7 @@ class LocalStatsReporter(StatsReporter):
             self.model_metric = metric
 
     def report_runtime_stats(self, metric: RuntimeMetric):
+        self._c_samples.inc()
         with self._lock:
             self.runtime_stats.append(metric)
             # Bound memory: optimizers only look at recent windows.

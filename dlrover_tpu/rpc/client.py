@@ -78,11 +78,21 @@ class RpcChannel:
 
     @retry_rpc()
     def get(self, msg: Any) -> Any:
-        return self._get(msg, timeout=self._timeout)
+        # spans cover every master RPC — shard-dispatch get_task, comm
+        # world polls, kv ops — at the one choke point (SpanName.RPC)
+        from dlrover_tpu.telemetry import SpanName, span
+
+        with span(f"{SpanName.RPC}.get.{type(msg).__name__}",
+                  category="rpc"):
+            return self._get(msg, timeout=self._timeout)
 
     @retry_rpc()
     def report(self, msg: Any) -> Response:
-        return self._report(msg, timeout=self._timeout)
+        from dlrover_tpu.telemetry import SpanName, span
+
+        with span(f"{SpanName.RPC}.report.{type(msg).__name__}",
+                  category="rpc"):
+            return self._report(msg, timeout=self._timeout)
 
     def close(self):
         self._channel.close()

@@ -1,0 +1,60 @@
+"""dlrover_tpu.telemetry — unified observability substrate.
+
+Three planes, one package (see docs/observability.md):
+
+  metrics   lock-light registry (counters/gauges/histograms) with
+            Prometheus text exposition (``exporter``)
+  events    append-only JSONL lifecycle timeline; MTTR and recovery
+            counts are DERIVED from it (``mttr``, the CLI)
+  tracing   cheap host spans -> Chrome/Perfetto JSON, plus the
+            executor's on-demand ``jax.profiler`` window
+
+All metric/event/span names live in ``names`` (enforced by lint rule
+DLR007).
+"""
+
+from dlrover_tpu.telemetry import names
+from dlrover_tpu.telemetry.events import (
+    emit_event,
+    read_events,
+    recent_events,
+)
+from dlrover_tpu.telemetry.metrics import (
+    DURATION_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+    get_registry,
+    process_registry,
+)
+from dlrover_tpu.telemetry.mttr import derive_incidents, mttr_report
+from dlrover_tpu.telemetry.names import EventKind, SpanName
+from dlrover_tpu.telemetry.tracing import (
+    add_instant,
+    export_chrome_trace,
+    span,
+)
+
+__all__ = [
+    "names",
+    "EventKind",
+    "SpanName",
+    "emit_event",
+    "read_events",
+    "recent_events",
+    "DURATION_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NullRegistry",
+    "get_registry",
+    "process_registry",
+    "derive_incidents",
+    "mttr_report",
+    "add_instant",
+    "export_chrome_trace",
+    "span",
+]

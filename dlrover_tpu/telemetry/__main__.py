@@ -1,0 +1,6 @@
+from dlrover_tpu.telemetry.cli import main
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

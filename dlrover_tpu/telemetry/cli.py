@@ -1,0 +1,127 @@
+"""``python -m dlrover_tpu.telemetry`` — the observability CLI.
+
+  mttr     derive the MTTR / recovery-count report from an event
+           timeline (replaces hand-maintained MTTR.json artifacts)
+  events   pretty-print a timeline (newest last)
+  metrics  dump Prometheus exposition: a live endpoint via --addr, or
+           this process's registry (useful under ``tpurun metrics``)
+  trace    export the current process's span ring as Chrome/Perfetto
+           trace JSON
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dlrover_tpu.telemetry",
+        description="dlrover_tpu observability: MTTR derivation, event "
+                    "timeline, metrics exposition, trace export",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    mttr = sub.add_parser(
+        "mttr", help="derive MTTR from an event timeline JSONL")
+    mttr.add_argument("--events", default="",
+                      help="timeline path (default: the configured "
+                           "DLROVER_TPU_EVENTS_FILE sink)")
+    mttr.add_argument("--out", default="",
+                      help="also write the JSON report to this path")
+    mttr.add_argument("--target", type=float, default=90.0,
+                      help="MTTR target seconds for vs_baseline "
+                           "(default 90)")
+
+    ev = sub.add_parser("events", help="print a timeline")
+    ev.add_argument("--events", default="", help="timeline path")
+    ev.add_argument("--tail", type=int, default=0,
+                    help="only the last N records")
+    ev.add_argument("--kind", default="",
+                    help="filter to one event kind")
+
+    met = sub.add_parser("metrics", help="dump Prometheus exposition")
+    met.add_argument("--addr", default="",
+                     help="scrape a live exporter at host:port instead "
+                          "of dumping this process's registry")
+
+    tr = sub.add_parser("trace", help="export span ring as Chrome JSON")
+    tr.add_argument("--out", default="trace.json")
+    return p
+
+
+def _resolve_events_path(arg: str) -> Optional[str]:
+    from dlrover_tpu.telemetry import events as events_mod
+
+    return arg or events_mod.default_events_path()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.cmd == "mttr":
+        from dlrover_tpu.telemetry import events as events_mod
+        from dlrover_tpu.telemetry.mttr import mttr_report
+
+        path = _resolve_events_path(args.events)
+        if not path:
+            print("mttr: no timeline (pass --events or set "
+                  "DLROVER_TPU_EVENTS_FILE)", file=sys.stderr)
+            return 2
+        records = events_mod.read_events(path)
+        report = mttr_report(records, target_s=args.target)
+        line = json.dumps(report)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return 1 if report.get("error") else 0
+
+    if args.cmd == "events":
+        from dlrover_tpu.telemetry import events as events_mod
+
+        path = _resolve_events_path(args.events)
+        records = (
+            events_mod.read_events(path) if path
+            else events_mod.recent_events()
+        )
+        if args.kind:
+            records = [r for r in records if r.get("kind") == args.kind]
+        if args.tail:
+            records = records[-args.tail:]
+        for rec in records:
+            print(json.dumps(rec, sort_keys=True))
+        return 0
+
+    if args.cmd == "metrics":
+        if args.addr:
+            from dlrover_tpu.telemetry.exporter import fetch_metrics
+
+            try:
+                status, body = fetch_metrics(args.addr)
+            except OSError as e:
+                print(f"metrics: scrape of {args.addr} failed: {e}",
+                      file=sys.stderr)
+                return 2
+            sys.stdout.write(body)
+            return 0 if status == 200 else 1
+        from dlrover_tpu.telemetry.metrics import process_registry
+
+        sys.stdout.write(process_registry().render_prometheus())
+        return 0
+
+    if args.cmd == "trace":
+        from dlrover_tpu.telemetry import tracing
+
+        n = tracing.export_chrome_trace(args.out)
+        print(f"wrote {n} span(s) to {args.out}")
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+"""Structured event timeline: append-only JSONL of lifecycle edges.
+
+Every record carries:
+
+  ``kind``        an ``EventKind`` constant (names.py)
+  ``ts``          wall-clock epoch seconds (cross-process ordering)
+  ``mono``        ``time.monotonic()`` of the emitting process (exact
+                  in-process deltas; NOT comparable across processes)
+  ``pid``         emitting process id (tells mttr which clock to trust)
+  ``node``        node identity from the NodeEnv contract
+  ``error_code``  stable machine-readable code ("" when not an error)
+
+plus free-form per-kind fields. The sink is one ``os.write`` of a
+single line onto an ``O_APPEND`` fd — POSIX guarantees small appends
+are atomic, so the agent and every worker process it spawns can share
+one timeline file (the env var rides the worker environment) without
+locks or interleaving. MTTR and recovery-count reports are *derived*
+from this file (``python -m dlrover_tpu.telemetry mttr``) instead of
+being hand-assembled.
+
+The file path comes from ``DLROVER_TPU_EVENTS_FILE`` (or the Context
+knob ``telemetry_events_file``), resolved per emit — cheap, and it
+keeps tests with different tmp paths honest. No file configured ⇒
+records land only in the bounded in-memory ring.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("telemetry.events")
+
+EVENTS_FILE_ENV = "DLROVER_TPU_EVENTS_FILE"
+_RING_CAP = 4096
+
+_ring: Deque[Dict] = collections.deque(maxlen=_RING_CAP)
+_ring_lock = threading.Lock()
+_seq = 0
+# one fd per resolved path, kept open for the process lifetime
+_fds: Dict[str, int] = {}
+_fd_lock = threading.Lock()
+
+
+def _events_path() -> str:
+    path = os.environ.get(EVENTS_FILE_ENV, "")
+    if path:
+        return path
+    from dlrover_tpu.common.config import get_context
+
+    return str(getattr(get_context(), "telemetry_events_file", "") or "")
+
+
+def _node_identity() -> str:
+    return (
+        os.environ.get(NodeEnv.NODE_RANK)
+        or os.environ.get(NodeEnv.NODE_ID)
+        or "0"
+    )
+
+
+def emit_event(kind: str, error_code: str = "", **fields) -> Dict:
+    """Append one record to the timeline; returns the record (its
+    ``seq`` tags log lines that reference it). Never raises — a full
+    disk or revoked fd must not take training down with it."""
+    global _seq
+    from dlrover_tpu.common.config import get_context
+
+    if not getattr(get_context(), "telemetry_enabled", True):
+        return {}
+    with _ring_lock:
+        _seq += 1
+        seq = _seq
+    record: Dict = {
+        "kind": kind,
+        "ts": time.time(),
+        "mono": time.monotonic(),
+        "pid": os.getpid(),
+        "node": _node_identity(),
+        "seq": seq,
+    }
+    if error_code:
+        record["error_code"] = error_code
+    for k, v in fields.items():
+        if v is not None:
+            record[k] = v
+    with _ring_lock:
+        _ring.append(record)
+    path = _events_path()
+    if path:
+        try:
+            fd = _fds.get(path)
+            if fd is None:
+                with _fd_lock:
+                    fd = _fds.get(path)
+                    if fd is None:
+                        d = os.path.dirname(os.path.abspath(path))
+                        os.makedirs(d, exist_ok=True)
+                        fd = os.open(
+                            path,
+                            os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                            0o644,
+                        )
+                        _fds[path] = fd
+            line = json.dumps(record, separators=(",", ":")) + "\n"
+            os.write(fd, line.encode("utf-8"))
+        except OSError:
+            logger.warning("event sink write failed for %s", path,
+                           exc_info=True)
+    return record
+
+
+def recent_events(n: int = 0) -> List[Dict]:
+    """The in-memory ring (newest last); ``n`` limits to the tail."""
+    with _ring_lock:
+        out = list(_ring)
+    return out[-n:] if n else out
+
+
+def clear_ring() -> None:
+    with _ring_lock:
+        _ring.clear()
+
+
+def read_events(path: str) -> List[Dict]:
+    """Parse a timeline file; malformed lines (torn writes from a
+    killed process) are skipped, not fatal."""
+    out: List[Dict] = []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "kind" in rec:
+                    out.append(rec)
+    except OSError:
+        return []
+    out.sort(key=lambda r: r.get("ts", 0.0))
+    return out
+
+
+def default_events_path() -> Optional[str]:
+    """Where emits currently land, or None."""
+    return _events_path() or None

@@ -1,0 +1,123 @@
+"""Prometheus text exposition over HTTP, served from the agent/master.
+
+A stdlib ``ThreadingHTTPServer`` on a daemon thread — no new
+dependencies, good enough for a per-process scrape endpoint:
+
+  GET /metrics   Prometheus text format (the process registry)
+  GET /events    last N timeline records as JSON (?n=100)
+  GET /healthz   200 ok
+
+Wire-up: the local master starts one when the Context knob
+``telemetry_metrics_port`` is > 0 (env ``DLROVER_TPU_METRICS_PORT``),
+and ``tpurun`` passes ``--metrics_port`` through to the agent process.
+``tpurun metrics [--addr host:port]`` scrapes and prints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import urlparse, parse_qs
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import events as events_mod
+from dlrover_tpu.telemetry.metrics import process_registry
+
+logger = get_logger("telemetry.exporter")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        parsed = urlparse(self.path)
+        if parsed.path == "/metrics":
+            body = process_registry().render_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif parsed.path == "/events":
+            try:
+                n = int(parse_qs(parsed.query).get("n", ["100"])[0])
+            except ValueError:
+                n = 100
+            body = json.dumps(events_mod.recent_events(n)).encode()
+            ctype = "application/json"
+        elif parsed.path == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet: scrapes are periodic
+        logger.debug("exporter: " + fmt, *args)
+
+
+class MetricsExporter:
+    """Owns the server + its daemon serving thread."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="metrics-exporter", daemon=True,
+        )
+
+    def start(self) -> "MetricsExporter":
+        self._thread.start()
+        logger.info("metrics exporter serving on :%d", self.port)
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            logger.warning("exporter shutdown raced", exc_info=True)
+
+
+def maybe_start_exporter(port: Optional[int] = None) -> Optional[
+        MetricsExporter]:
+    """Start an exporter if configured; None when off. ``port`` None
+    defers to the ``telemetry_metrics_port`` Context knob (0 = off;
+    tests may pass an explicit 0 for an ephemeral port)."""
+    from dlrover_tpu.common.config import get_context
+
+    ctx = get_context()
+    if not getattr(ctx, "telemetry_enabled", True):
+        return None
+    if port is None:
+        # the short env spelling is the documented operator surface
+        # (DLROVER_TPU_METRICS_PORT, like DLROVER_TPU_EVENTS_FILE) and
+        # wins when present — including an explicit "0" = off; absent,
+        # the Context knob (env-overridable as
+        # DLROVER_TPU_TELEMETRY_METRICS_PORT) decides
+        env = os.environ.get("DLROVER_TPU_METRICS_PORT")
+        try:
+            port = (int(env) if env not in (None, "")
+                    else int(getattr(ctx, "telemetry_metrics_port", 0)))
+        except ValueError:
+            logger.error("malformed DLROVER_TPU_METRICS_PORT=%r", env)
+            return None
+        if port <= 0:
+            return None
+    try:
+        return MetricsExporter(port=port).start()
+    except OSError as e:
+        logger.error("metrics exporter failed to bind :%s (%s)", port, e)
+        return None
+
+
+def fetch_metrics(addr: str, timeout: float = 5.0) -> Tuple[int, str]:
+    """Scrape ``host:port`` (or a full URL); returns (status, body)."""
+    import urllib.request
+
+    url = addr if "://" in addr else f"http://{addr}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8", "replace")

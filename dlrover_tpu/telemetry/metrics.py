@@ -1,0 +1,294 @@
+"""Lock-light metrics registry: counters, gauges, fixed-bucket histograms.
+
+Design parity: the reference DLRover feeds runtime stats through a
+master-side reporter into Brain; ElasWave-class elastic systems
+(PAPERS.md) additionally need *worker-local* low-overhead series (step
+time, window occupancy, recovery counters) scrapeable without touching
+the hot loop. This registry is that substrate.
+
+Lock discipline: the registry lock guards metric *creation* only. The
+per-sample paths (``inc``/``set``/``observe``) are plain attribute
+updates — under CPython's GIL a concurrent race can at worst lose an
+increment, which is an acceptable error for monitoring series and keeps
+the hot-loop cost to ~1µs. Nothing on the sample path allocates, locks,
+or syscalls.
+
+Enable/disable: ``get_registry()`` consults the Context knob
+``telemetry_enabled`` and hands back a null registry when off — call
+sites hold metric handles with an identical API either way, so
+instrumentation carries zero branches.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Default duration buckets (seconds): 0.5ms .. 60s, roughly log-spaced.
+# Chosen to straddle both the CPU-mesh tiny-model regime (tier-1, ~ms
+# steps) and real TPU steps (~100ms-10s).
+DURATION_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+
+class Gauge:
+    """A value that goes up and down."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def dec(self, v: float = 1.0) -> None:
+        self.value -= v
+
+
+def percentile_from_counts(bounds: Sequence[float],
+                           counts: Sequence[int],
+                           q: float) -> Optional[float]:
+    """Approximate quantile (0 < q <= 1) over per-bucket counts
+    (``len(counts) == len(bounds) + 1``, +Inf bucket last), with linear
+    interpolation inside the winning bucket; None when empty. Taking
+    counts explicitly lets callers diff two snapshots and quote the
+    quantiles of just the last window (the executor's speed log)."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0
+    lo = 0.0
+    for i, bound in enumerate(bounds):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            frac = (rank - prev) / max(counts[i], 1)
+            return lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+        lo = bound
+    return bounds[-1]  # landed in the +Inf bucket
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative exposition, Prometheus-style).
+
+    ``percentile(q)`` interpolates inside the winning bucket — exact
+    enough for operator-facing p50/p95 step-time lines; observations
+    landing in the +Inf bucket report the largest finite bound.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DURATION_BUCKETS):
+        self.name = name
+        self.help = help
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds:
+            raise ValueError(f"histogram {name}: empty bucket list")
+        self.bounds: Tuple[float, ...] = tuple(bounds)
+        # per-bucket (non-cumulative) counts; the +Inf bucket is last
+        self.counts: List[int] = [0] * (len(bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        # linear scan: bucket lists are short (<= ~16) and the common
+        # case (sub-ms host ops) exits in the first few probes
+        for i, bound in enumerate(self.bounds):
+            if v <= bound:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Approximate quantile (0 < q <= 1); None when empty."""
+        return percentile_from_counts(self.bounds, self.counts, q)
+
+    def snapshot_counts(self) -> Tuple[int, ...]:
+        """Point-in-time copy of the per-bucket counts — diff two of
+        these (``percentile_from_counts``) for windowed quantiles."""
+        return tuple(self.counts)
+
+
+class _NullMetric:
+    """No-op stand-in with the union of the real APIs."""
+
+    kind = "null"
+    name = ""
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        pass
+
+    def dec(self, v: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def percentile(self, q: float) -> Optional[float]:
+        return None
+
+    def snapshot_counts(self) -> None:
+        return None
+
+
+_NULL_METRIC = _NullMetric()
+
+
+class MetricsRegistry:
+    """Name -> metric; creation is idempotent and thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        metric = self._metrics.get(name)
+        if metric is None:
+            with self._lock:
+                metric = self._metrics.get(name)
+                if metric is None:
+                    metric = cls(name, help=help, **kwargs)
+                    self._metrics[name] = metric
+        if not isinstance(metric, cls):
+            raise ValueError(
+                f"metric {name!r} already registered as {metric.kind}, "
+                f"requested {cls.__name__.lower()}"
+            )
+        return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DURATION_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def reset(self) -> None:
+        """Drop every metric (tests / bench A-B runs)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- exposition ----------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        for name in sorted(self.snapshot()):
+            m = self._metrics.get(name)
+            if m is None:
+                continue
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                cum = 0
+                for i, bound in enumerate(m.bounds):
+                    cum += m.counts[i]
+                    lines.append(
+                        f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}'
+                    )
+                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{name}_sum {_fmt(m.sum)}")
+                lines.append(f"{name}_count {m.count}")
+            else:
+                lines.append(f"{name} {_fmt(m.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class NullRegistry:
+    """API-compatible black hole handed out when telemetry is off."""
+
+    def counter(self, name: str, help: str = "") -> _NullMetric:
+        return _NULL_METRIC
+
+    def gauge(self, name: str, help: str = "") -> _NullMetric:
+        return _NULL_METRIC
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DURATION_BUCKETS) -> _NullMetric:
+        return _NULL_METRIC
+
+    def get(self, name: str):
+        return None
+
+    def snapshot(self) -> Dict[str, object]:
+        return {}
+
+    def reset(self) -> None:
+        pass
+
+    def render_prometheus(self) -> str:
+        return ""
+
+
+_REGISTRY = MetricsRegistry()
+_NULL_REGISTRY = NullRegistry()
+
+
+def get_registry():
+    """The process registry — or the null registry when the Context
+    knob ``telemetry_enabled`` is off. Call sites fetch handles once
+    (at construction), so toggling the knob affects components built
+    AFTER the toggle; the bench's A/B runs rely on exactly that."""
+    from dlrover_tpu.common.config import get_context
+
+    if not getattr(get_context(), "telemetry_enabled", True):
+        return _NULL_REGISTRY
+    return _REGISTRY
+
+
+def process_registry() -> MetricsRegistry:
+    """The real registry regardless of the enable knob (exposition/CLI
+    paths, which must dump whatever was recorded)."""
+    return _REGISTRY

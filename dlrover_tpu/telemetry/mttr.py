@@ -1,0 +1,145 @@
+"""MTTR + recovery-count reports derived from the event timeline.
+
+Replaces hand-assembled artifacts: instead of a bench script timing one
+staged kill, recovery time is *derived* from the same JSONL the
+production components emit at every lifecycle edge. Each failure-edge
+event is paired with the first later recovery-edge event of a
+compatible kind:
+
+  failure edge            recovery edge            scenario
+  ---------------------   ----------------------   ----------------------
+  worker_failed           workers_started          crash/SIGKILL relaunch
+  hang_detected           workers_started          hang relaunch
+  nonfinite_step          rollback_restored        NaN rollback
+  preempt_notice          preempt_drain_done       preemption drain
+
+Durations use the monotonic clock when both events came from the same
+process (exact), else wall clocks (cross-process, e.g. agent-side
+relaunch edges vs worker-side failure edges). Multiple failure edges
+before one recovery edge collapse into ONE incident (a burst of
+per-rank failure reports is one recovery), anchored at the first edge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dlrover_tpu.telemetry.names import EventKind
+
+# failure kind -> {recovery kinds}, with a scenario label for the report
+_PAIRINGS = {
+    EventKind.WORKER_FAILED: (
+        {EventKind.WORKERS_STARTED}, "worker_failure"),
+    EventKind.HANG_DETECTED: (
+        {EventKind.WORKERS_STARTED}, "hang"),
+    EventKind.NONFINITE_STEP: (
+        {EventKind.ROLLBACK_RESTORED}, "nonfinite_rollback"),
+    EventKind.PREEMPT_NOTICE: (
+        {EventKind.PREEMPT_DRAIN_DONE}, "preemption_drain"),
+}
+
+
+def _delta_seconds(failure: Dict, recovery: Dict) -> float:
+    if (
+        failure.get("pid") == recovery.get("pid")
+        and "mono" in failure and "mono" in recovery
+    ):
+        return max(0.0, recovery["mono"] - failure["mono"])
+    return max(0.0, recovery.get("ts", 0.0) - failure.get("ts", 0.0))
+
+
+def derive_incidents(events: List[Dict]) -> List[Dict]:
+    """Pair failure edges with recovery edges into incident records."""
+    ordered = sorted(events, key=lambda r: r.get("ts", 0.0))
+    incidents: List[Dict] = []
+    open_incident: Dict[str, Optional[Dict]] = {
+        scenario: None for _, (_r, scenario) in _PAIRINGS.items()
+    }
+    for rec in ordered:
+        kind = rec.get("kind", "")
+        pairing = _PAIRINGS.get(kind)
+        if pairing is not None:
+            _, scenario = pairing
+            # a burst of failure edges before recovery = ONE incident,
+            # anchored at the FIRST edge (that is when downtime began)
+            if open_incident.get(scenario) is None:
+                open_incident[scenario] = rec
+            continue
+        for scenario, failure in list(open_incident.items()):
+            if failure is None:
+                continue
+            recovery_kinds = next(
+                rk for fk, (rk, sc) in _PAIRINGS.items() if sc == scenario
+            )
+            if kind in recovery_kinds:
+                incidents.append({
+                    "scenario": scenario,
+                    "failure_kind": failure.get("kind"),
+                    "recovery_kind": kind,
+                    "error_code": failure.get("error_code", ""),
+                    "node": failure.get("node", ""),
+                    "started_ts": failure.get("ts"),
+                    "recovered_ts": rec.get("ts"),
+                    "recovery_seconds": round(
+                        _delta_seconds(failure, rec), 3),
+                })
+                open_incident[scenario] = None
+    # unrecovered failures are reported too — a dashboard that hides
+    # the incident still in progress is worse than none
+    for scenario, failure in open_incident.items():
+        if failure is not None:
+            incidents.append({
+                "scenario": scenario,
+                "failure_kind": failure.get("kind"),
+                "recovery_kind": None,
+                "error_code": failure.get("error_code", ""),
+                "node": failure.get("node", ""),
+                "started_ts": failure.get("ts"),
+                "recovered_ts": None,
+                "recovery_seconds": None,
+            })
+    incidents.sort(key=lambda i: i.get("started_ts") or 0.0)
+    return incidents
+
+
+def mttr_report(events: List[Dict], target_s: float = 90.0) -> Dict:
+    """The machine-verifiable recovery artifact, derived."""
+    incidents = derive_incidents(events)
+    recovered = [
+        i for i in incidents if i["recovery_seconds"] is not None
+    ]
+    durations = [i["recovery_seconds"] for i in recovered]
+    by_scenario: Dict[str, Dict] = {}
+    for inc in recovered:
+        s = by_scenario.setdefault(
+            inc["scenario"], {"count": 0, "total_s": 0.0, "max_s": 0.0}
+        )
+        s["count"] += 1
+        s["total_s"] += inc["recovery_seconds"]
+        s["max_s"] = max(s["max_s"], inc["recovery_seconds"])
+    for s in by_scenario.values():
+        s["mean_s"] = round(s["total_s"] / s["count"], 3)
+        s["total_s"] = round(s["total_s"], 3)
+    value = (
+        round(sum(durations) / len(durations), 3) if durations else 0.0
+    )
+    report = {
+        "metric": "recovery_mttr_s",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": round(value / target_s, 3) if durations else 0.0,
+        "detail": {
+            "incidents": len(incidents),
+            "recovered": len(recovered),
+            "unrecovered": len(incidents) - len(recovered),
+            "max_s": round(max(durations), 3) if durations else 0.0,
+            "by_scenario": by_scenario,
+            "source": "event_timeline",
+        },
+    }
+    if len(incidents) > len(recovered):
+        report["error"] = (
+            f"{len(incidents) - len(recovered)} incident(s) without a "
+            f"recovery edge in the timeline"
+        )
+    return report

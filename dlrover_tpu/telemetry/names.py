@@ -1,0 +1,121 @@
+"""The metric/event/span name registry — the ONE place observability
+names live.
+
+Every metric name handed to the registry (``counter()``, ``gauge()``,
+``histogram()``) and every event kind handed to ``emit_event()`` must be
+a constant from this module: the AST lint rule DLR007 rejects string
+literals at telemetry call sites anywhere else in the package, so the
+name table in ``docs/observability.md`` can never silently drift from
+the code, and two subsystems can never claim the same series with
+slightly different spellings.
+
+Prometheus conventions: ``_total`` counters, ``_seconds`` durations,
+unitless gauges named for what they measure.
+"""
+
+from __future__ import annotations
+
+# -- worker / executor --------------------------------------------------------
+
+# per-optimizer-step wall time, observed at materialization (the lagged
+# window means one observation per step, dt shared across a drained group)
+STEP_TIME = "dlrover_step_time_seconds"
+# host time spent DISPATCHING one train-step call (tracing + enqueue,
+# never device compute): the async-pipeline "is Python the bottleneck?"
+# series PR 3 made invisible
+STEP_DISPATCH_TIME = "dlrover_step_dispatch_seconds"
+# host time blocked in device_get materializing the oldest in-flight
+# call — the ONE device sync of the pipeline (≈ device-bound time)
+STEP_HOST_SYNC_TIME = "dlrover_step_host_sync_seconds"
+# in-flight dispatch window occupancy right after a dispatch
+DISPATCH_WINDOW_OCCUPANCY = "dlrover_dispatch_window_occupancy"
+# how many steps behind the newest dispatch the just-materialized
+# metrics are (the "lagged-metric age" of the PR 3 ring)
+LAGGED_METRIC_AGE = "dlrover_lagged_metric_age_steps"
+TRAIN_STEPS = "dlrover_train_steps_total"
+NONFINITE_STEPS = "dlrover_nonfinite_steps_total"
+NONFINITE_ROLLBACKS = "dlrover_nonfinite_rollbacks_total"
+PREEMPT_NOTICES = "dlrover_preemption_notices_total"
+EVAL_TIME = "dlrover_eval_seconds"
+
+# -- master reporting from the worker ----------------------------------------
+
+MASTER_REPORTS = "dlrover_master_reports_total"
+MASTER_REPORT_FAILURES = "dlrover_master_report_failures_total"
+
+# -- checkpoint ---------------------------------------------------------------
+
+CKPT_SAVES = "dlrover_checkpoint_saves_total"
+CKPT_SAVE_TIME = "dlrover_checkpoint_save_stage_seconds"
+CKPT_MIRROR_TIME = "dlrover_checkpoint_mirror_seconds"
+CKPT_MIRROR_TIMEOUTS = "dlrover_checkpoint_mirror_timeouts_total"
+CKPT_RESTORE_TIME = "dlrover_checkpoint_restore_seconds"
+CKPT_RESTORES = "dlrover_checkpoint_restores_total"
+
+# -- agent --------------------------------------------------------------------
+
+AGENT_WORKER_RESTARTS = "dlrover_agent_worker_restarts_total"
+AGENT_HANG_DETECTIONS = "dlrover_agent_hang_detections_total"
+AGENT_WORKER_FAILURES = "dlrover_agent_worker_failures_total"
+RDZV_ROUNDS = "dlrover_rendezvous_rounds_total"
+RDZV_TIME = "dlrover_rendezvous_seconds"
+
+# -- master -------------------------------------------------------------------
+
+MASTER_GLOBAL_STEP = "dlrover_master_global_step"
+MASTER_TRAIN_SPEED = "dlrover_master_train_speed_steps_per_second"
+MASTER_FAILURE_REPORTS = "dlrover_master_failure_reports_total"
+MASTER_RUNTIME_SAMPLES = "dlrover_master_runtime_samples_total"
+
+# -- diagnosis ----------------------------------------------------------------
+
+ERROR_REPORTS = "dlrover_error_reports_total"
+ERRORS_DEDUPED = "dlrover_error_reports_deduped_total"
+
+
+class EventKind:
+    """Event-timeline record kinds (``telemetry.events``). Failure-edge
+    kinds pair with recovery-edge kinds in the MTTR derivation
+    (``telemetry.mttr``)."""
+
+    # rendezvous lifecycle
+    RDZV_JOIN = "rdzv_join"
+    RDZV_COMPLETE = "rdzv_complete"
+    RDZV_TIMEOUT = "rdzv_timeout"
+    # scaling
+    SCALE_PLAN_APPLIED = "scale_plan_applied"
+    # preemption (failure edge -> recovery edge)
+    PREEMPT_NOTICE = "preempt_notice"
+    PREEMPT_DRAIN_DONE = "preempt_drain_done"
+    # checkpoint
+    CKPT_SAVE = "ckpt_save"
+    CKPT_MIRROR = "ckpt_mirror"
+    CKPT_MIRROR_TIMEOUT = "ckpt_mirror_timeout"
+    CKPT_RESTORE = "ckpt_restore"
+    # numerics (failure edge -> recovery edge)
+    NONFINITE_STEP = "nonfinite_step"
+    ROLLBACK_RESTORED = "rollback_restored"
+    # agent lifecycle (failure edges -> WORKERS_STARTED recovery edge)
+    HANG_DETECTED = "hang_detected"
+    WORKER_FAILED = "worker_failed"
+    AGENT_RESTART = "agent_restart"
+    WORKERS_STARTED = "workers_started"
+    # run lifecycle
+    TRAIN_START = "train_start"
+    TRAIN_END = "train_end"
+    # diagnosis
+    ERROR_REPORT = "error_report"
+
+
+class SpanName:
+    """Span names for the Chrome/Perfetto trace export
+    (``telemetry.tracing``)."""
+
+    STEP_DISPATCH = "step_dispatch"
+    HOST_SYNC = "host_sync"
+    CKPT_SAVE_STAGE = "ckpt_save_stage"
+    CKPT_MIRROR = "ckpt_mirror"
+    CKPT_RESTORE = "ckpt_restore"
+    RENDEZVOUS = "rendezvous"
+    EVALUATE = "evaluate"
+    RPC = "rpc"  # prefix; full name is "rpc.<MessageType>"

@@ -30,6 +30,7 @@ from dlrover_tpu.checkpoint import (
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.accelerate import AccelerateResult, accelerate
 from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.telemetry import get_registry, names as tm
 
 logger = get_logger("trainer.elastic")
 
@@ -93,6 +94,12 @@ class ElasticTrainer:
         # step would force a host-device sync in the hot loop.
         self._host_step = 0
         self._rng = jax.random.PRNGKey(0)
+        reg = get_registry()
+        self._c_reports = reg.counter(
+            tm.MASTER_REPORTS, help="global-step/model reports sent")
+        self._c_report_failures = reg.counter(
+            tm.MASTER_REPORT_FAILURES,
+            help="reports the master never acked (counted, never raised)")
         self._ckpt: Optional[ElasticCheckpointManager] = None
         if ckpt_dir:
             self._ckpt = ElasticCheckpointManager(
@@ -218,8 +225,9 @@ class ElasticTrainer:
                 self._master_client.report(
                     comm.GlobalStep(step=step, timestamp=time.time())
                 )
+                self._c_reports.inc()
             except Exception:  # noqa: BLE001 - reporting must never kill training
-                pass
+                self._c_report_failures.inc()
         if self._ckpt is not None and self._ckpt.interval.should_save(step):
             # never checkpoint a NaN-poisoned state: it would corrupt the
             # rollback/restore target (the one device sync this costs
@@ -280,7 +288,9 @@ class ElasticTrainer:
                 self._master_client.report(
                     comm.GlobalStep(step=step, timestamp=time.time())
                 )
+                self._c_reports.inc()
             except Exception:  # noqa: BLE001 - reporting must never kill training
+                self._c_report_failures.inc()
                 logger.debug("global-step report failed", exc_info=True)
         if self._ckpt is not None and self._ckpt.interval.should_save(step):
             # the finite guard reads the stacked flags — one device sync,

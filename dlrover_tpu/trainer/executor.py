@@ -14,6 +14,8 @@ Hooks observe the loop at the same points the TF SessionRunHooks did.
 from __future__ import annotations
 
 import collections
+import os
+import tempfile
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
@@ -22,6 +24,15 @@ from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import TrainingExceptionLevel
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.diagnosis.hang_detector import touch_heartbeat
+from dlrover_tpu.telemetry import (
+    EventKind,
+    SpanName,
+    emit_event,
+    get_registry,
+    names as tm,
+    span,
+)
+from dlrover_tpu.telemetry.metrics import percentile_from_counts
 from dlrover_tpu.trainer.conf import Configuration
 from dlrover_tpu.trainer.elastic import ElasticTrainer
 from dlrover_tpu.trainer.failover import FailoverClient, TrainingFailover
@@ -88,6 +99,12 @@ class ReportModelInfoHook(TrainHook):
         self._param_count = param_count
         self._flops = flops_per_step
         self._every = max(every_steps, 1)
+        reg = get_registry()
+        self._c_reports = reg.counter(
+            tm.MASTER_REPORTS, help="global-step/model reports sent")
+        self._c_report_failures = reg.counter(
+            tm.MASTER_REPORT_FAILURES,
+            help="reports the master never acked (counted, never raised)")
 
     def begin(self, executor: "TrainExecutor"):
         if self._param_count <= 0:
@@ -99,16 +116,22 @@ class ReportModelInfoHook(TrainHook):
                 num_params=self._param_count,
                 flops_per_step=self._flops,
             ))
+            self._c_reports.inc()
         except Exception:  # noqa: BLE001
+            self._c_report_failures.inc()
             logger.exception("model info report failed")
 
     def after_step(self, step: int, metrics: Dict[str, Any]):
+        # runs at MATERIALIZATION (the executor's lagged window), so the
+        # reported step is never ahead of host-visible metrics
         if step % self._every:
             return
         try:
             self._client.report_global_step(step)
-        except Exception:  # noqa: BLE001
-            pass
+            self._c_reports.inc()
+        except Exception:  # noqa: BLE001 — a dead master must not kill
+            # training; the failure is counted so operators see the gap
+            self._c_report_failures.inc()
 
 
 class TrainExecutor:
@@ -159,7 +182,51 @@ class TrainExecutor:
             "train_window", getattr(ctx, "train_window", 4)
         )))
         self._window: "collections.deque[_Inflight]" = collections.deque()
-        self._last_log = time.time()
+        # monotonic: the speed line must survive wall-clock jumps (NTP
+        # slews on long jobs) and a drain/resume boundary
+        self._last_log = time.monotonic()
+        self._last_materialize = time.monotonic()
+        # bucket-count snapshot at the previous speed-log line, so the
+        # quoted p50/p95 cover just the last window
+        self._log_counts_snapshot = None
+        # telemetry handles (null objects when the knob is off — the
+        # hot loop carries no branches either way)
+        reg = get_registry()
+        self._h_step_time = reg.histogram(
+            tm.STEP_TIME, help="per-optimizer-step wall time, observed "
+                               "at (lagged) materialization")
+        self._h_dispatch = reg.histogram(
+            tm.STEP_DISPATCH_TIME,
+            help="host time dispatching one train-step call")
+        self._h_host_sync = reg.histogram(
+            tm.STEP_HOST_SYNC_TIME,
+            help="host time blocked materializing the oldest in-flight "
+                 "call (the pipeline's one device sync)")
+        self._g_window = reg.gauge(
+            tm.DISPATCH_WINDOW_OCCUPANCY,
+            help="in-flight dispatches right after a dispatch")
+        self._g_lag = reg.gauge(
+            tm.LAGGED_METRIC_AGE,
+            help="steps between the newest dispatch and the metrics "
+                 "just materialized")
+        self._c_steps = reg.counter(
+            tm.TRAIN_STEPS, help="optimizer steps materialized")
+        self._c_nonfinite = reg.counter(
+            tm.NONFINITE_STEPS, help="non-finite steps detected")
+        self._c_rollbacks = reg.counter(
+            tm.NONFINITE_ROLLBACKS, help="checkpoint rollbacks taken")
+        self._c_preempt = reg.counter(
+            tm.PREEMPT_NOTICES, help="preemption notices received")
+        self._h_eval = reg.histogram(
+            tm.EVAL_TIME, help="eval_fn wall time")
+        # newest dispatched (not yet necessarily materialized) step —
+        # the minuend of the lagged-metric age
+        self._dispatched_step = 0
+        # on-demand device profiling: the profile_signal knob arms a
+        # handler that opens one bounded jax.profiler window mid-run
+        self._profile_signal = str(conf.get(
+            "profile_signal", getattr(ctx, "profile_signal", "")))
+        self._profile_requested = False
         # the COMPILED multi-step degree lives on the trainer (it owns
         # the K-step scan program); a conf knob that disagrees can only
         # warn — honoring it would recompile mid-construction
@@ -266,6 +333,7 @@ class TrainExecutor:
         logger.warning("preempted at step %d: flushing emergency "
                        "checkpoint", step)
         t0 = time.time()
+        t0_mono = time.monotonic()
         try:
             # same guard as the periodic path (elastic.py step()): a
             # NaN-poisoned state must never become the newest restore
@@ -310,6 +378,12 @@ class TrainExecutor:
                 )
             except Exception:  # noqa: BLE001
                 pass
+        emit_event(
+            EventKind.PREEMPT_DRAIN_DONE,
+            error_code="CKPT_MIRROR_TIMEOUT" if mirror_timed_out else "",
+            step=step,
+            drain_seconds=round(time.monotonic() - t0_mono, 3),
+        )
         out = dict(self._last_metrics or {})
         out["preempted"] = True
         out["mirror_timed_out"] = mirror_timed_out
@@ -356,6 +430,9 @@ class TrainExecutor:
             "reason": "non-finite loss/gradients",
         })
         logger.error("non-finite training step: %s", detail)
+        self._c_nonfinite.inc()
+        emit_event(EventKind.NONFINITE_STEP, error_code="NONFINITE",
+                   step=step, policy=self._on_nonfinite)
         if self._master_client is not None:
             try:
                 self._master_client.report_failure(
@@ -400,6 +477,10 @@ class TrainExecutor:
             restored = restore() if restore is not None else None
             self.state = (restored if restored is not None
                           else self._trainer.prepare(None))
+            self._c_rollbacks.inc()
+            emit_event(EventKind.ROLLBACK_RESTORED, step=step,
+                       restored_step=int(self.state.step),
+                       rollback=self._rollbacks)
             return True
         if self._on_nonfinite == "ignore":
             return False
@@ -427,7 +508,17 @@ class TrainExecutor:
         import jax
 
         entry = self._window.popleft()
-        host = jax.device_get(entry.metrics)
+        t_sync = time.monotonic()
+        with span(SpanName.HOST_SYNC, step=entry.last_step):
+            host = jax.device_get(entry.metrics)
+        now = time.monotonic()
+        self._h_host_sync.observe(now - t_sync)
+        # per-step wall time: the interval since the previous
+        # materialization, amortized over the steps this call carried
+        # (exact for K=1; the group average for a fused K-step call)
+        per_step = (now - self._last_materialize) / max(entry.count, 1)
+        self._last_materialize = now
+        self._g_lag.set(self._dispatched_step - entry.last_step)
         touch_heartbeat()
         stacked = entry.count > 1
         for i in range(entry.count):
@@ -440,6 +531,8 @@ class TrainExecutor:
             else:
                 sub = host
             self._last_metrics = sub
+            self._h_step_time.observe(per_step)
+            self._c_steps.inc()
             for hook in self._hooks:
                 hook.after_step(s, sub)
             if (
@@ -452,12 +545,35 @@ class TrainExecutor:
                     self._window.clear()
                     return True
             if self._log_every and s % self._log_every == 0:
-                dt = time.time() - self._last_log
-                self._last_log = time.time()
+                # monotonic, and quantiles from the step-time histogram
+                # DELTA since the previous log line: a log_every/dt
+                # average under-reports jitter and reads garbage across
+                # a drain/resume boundary, and lifetime-cumulative
+                # quantiles would stop tracking a late regression once
+                # old observations dominate
+                dt = time.monotonic() - self._last_log
+                self._last_log = time.monotonic()
+                quantiles = ""
+                cur = self._h_step_time.snapshot_counts()
+                if cur is not None:
+                    prev = self._log_counts_snapshot
+                    self._log_counts_snapshot = cur
+                    window_counts = (
+                        [c - p for c, p in zip(cur, prev)]
+                        if prev is not None else cur
+                    )
+                    bounds = self._h_step_time.bounds
+                    p50 = percentile_from_counts(
+                        bounds, window_counts, 0.50)
+                    p95 = percentile_from_counts(
+                        bounds, window_counts, 0.95)
+                    if p50 is not None and p95 is not None:
+                        quantiles = (" p50=%.1fms p95=%.1fms"
+                                     % (p50 * 1e3, p95 * 1e3))
                 logger.info(
-                    "step %d loss=%.4f (%.2f steps/s)", s,
+                    "step %d loss=%.4f (%.2f steps/s%s)", s,
                     float(sub.get("loss", float("nan"))),
-                    self._log_every / max(dt, 1e-9),
+                    self._log_every / max(dt, 1e-9), quantiles,
                 )
         return False
 
@@ -479,6 +595,7 @@ class TrainExecutor:
         # the bare timeout while the compile is still running)
         if self._preempt_grace:
             self.install_preemption_handler()
+        self._install_profile_signal_handler()
         self.state = self._trainer.prepare(self.state)
         for hook in self._hooks:
             hook.begin(self)
@@ -486,11 +603,16 @@ class TrainExecutor:
             self._failover.start()
 
         step = int(self.state.step)
-        self._last_log = time.time()
+        self._last_log = time.monotonic()
+        self._last_materialize = time.monotonic()
+        self._log_counts_snapshot = None
         self._last_eval_step = -1
         window = self._train_window
         k_call = max(1, int(getattr(self._trainer, "steps_per_call", 1)))
+        self._dispatched_step = step
         self._window.clear()
+        emit_event(EventKind.TRAIN_START, step=step,
+                   train_window=window, steps_per_call=k_call)
         try:
             while True:
                 data_iter = iter(self._train_iter_fn())
@@ -506,9 +628,14 @@ class TrainExecutor:
                         for i in range(k_call):
                             for hook in self._hooks:
                                 hook.before_step(step + 1 + i)
-                        self.state, metrics = self._trainer.step_multi(
-                            self.state, group
-                        )
+                        t_disp = time.monotonic()
+                        with span(SpanName.STEP_DISPATCH,
+                                  step=step + k_call, k=k_call):
+                            self.state, metrics = self._trainer.step_multi(
+                                self.state, group
+                            )
+                        self._h_dispatch.observe(
+                            time.monotonic() - t_disp)
                         step += k_call
                         self._window.append(
                             _Inflight(step, k_call, metrics)
@@ -530,13 +657,19 @@ class TrainExecutor:
                         for batch in group:
                             for hook in self._hooks:
                                 hook.before_step(step + 1)
-                            self.state, metrics = self._trainer.step(
-                                self.state, batch
-                            )
+                            t_disp = time.monotonic()
+                            with span(SpanName.STEP_DISPATCH,
+                                      step=step + 1):
+                                self.state, metrics = self._trainer.step(
+                                    self.state, batch
+                                )
+                            self._h_dispatch.observe(
+                                time.monotonic() - t_disp)
                             step += 1
                             self._window.append(
                                 _Inflight(step, 1, metrics)
                             )
+                    self._dispatched_step = step
                     touch_heartbeat()  # hang-relaunch liveness beacon
                     self._update_trace(step)
 
@@ -544,8 +677,14 @@ class TrainExecutor:
                         step = int(self.state.step)
                         restarted = True
                         break  # rollback: fresh iterator + old state
+                    # steady-state occupancy (post-trim): 0..train_window
+                    self._g_window.set(len(self._window))
 
                     if self._preempted is not None:
+                        self._c_preempt.inc()
+                        emit_event(EventKind.PREEMPT_NOTICE,
+                                   error_code="PREEMPTED", step=step,
+                                   signum=int(self._preempted))
                         # drain first: the emergency save must cover the
                         # last MATERIALIZED (completed-on-device) step,
                         # and the finite guard in _finish_preempted needs
@@ -589,23 +728,62 @@ class TrainExecutor:
             if self._failover is not None:
                 self._failover.stop()
 
+    def _install_profile_signal_handler(self):
+        """Arm the on-demand device-profile window: the configured
+        signal (conf/Context ``profile_signal``, e.g. "USR2") requests
+        one bounded ``jax.profiler.trace`` capture starting at the next
+        step — so a production job can be profiled without a restart
+        (``kill -USR2 <worker pid>``). Main-thread-only, like the
+        preemption handler; a no-op when the knob is empty."""
+        if not self._profile_signal:
+            return
+        import signal as _signal
+
+        name = self._profile_signal.upper().removeprefix("SIG")
+        signum = getattr(_signal, f"SIG{name}", None)
+        if signum is None:
+            logger.warning("unknown profile_signal %r",
+                           self._profile_signal)
+            return
+
+        def _handler(_signum, _frame):
+            # flag only: start_trace must run from the loop, not a
+            # signal frame racing the dispatch path
+            self._profile_requested = True
+
+        try:
+            self._prev_handlers[signum] = _signal.signal(signum, _handler)
+        except ValueError:
+            logger.warning(
+                "profile_signal handler unavailable off the main thread"
+            )
+
+    def _profile_dir(self) -> str:
+        return self._trace_dir or os.path.join(
+            tempfile.gettempdir(), f"dlrover_tpu_xprof_{os.getpid()}"
+        )
+
     def _update_trace(self, step: int):
         """Start/stop the bounded xprof window around the step counter.
         Capture begins after ``trace_start_step`` completed steps (past
-        compile + warmup) and spans ``trace_num_steps`` steps."""
-        if not self._trace_dir:
+        compile + warmup), or immediately when the profile signal asked
+        for a window, and spans ``trace_num_steps`` steps."""
+        requested = self._profile_requested
+        if not self._tracing and not self._trace_dir and not requested:
             return
-        if not self._tracing and step >= self._trace_start:
+        if not self._tracing and (requested or step >= self._trace_start):
             # ">=", not "==": a checkpoint-resumed run enters with the
             # restored global step already past trace_start_step, and
             # profiling a restored production job is a primary use
             import jax
 
-            jax.profiler.start_trace(self._trace_dir)
+            target = self._profile_dir()
+            self._profile_requested = False
+            jax.profiler.start_trace(target)
             self._tracing = True
             self._trace_stop_at = step + self._trace_steps
             logger.info("xprof trace started at step %d -> %s", step,
-                        self._trace_dir)
+                        target)
         elif self._tracing and step >= self._trace_stop_at:
             self._stop_trace_if_open(step)
 
@@ -628,7 +806,10 @@ class TrainExecutor:
         # reset the hang clock at eval ENTRY so the allowance covers the
         # eval from its start (a beat after it would land too late)
         touch_heartbeat()
-        self.eval_metrics = self._eval_fn(self.state)
+        t0 = time.monotonic()
+        with span(SpanName.EVALUATE, step=step):
+            self.eval_metrics = self._eval_fn(self.state)
+        self._h_eval.observe(time.monotonic() - t0)
         touch_heartbeat()
         logger.info("eval @%d: %s", step, {
             # vector metrics (e.g. moe_expert_load [E]) log as lists;
@@ -660,6 +841,7 @@ class TrainExecutor:
             if self._on_nonfinite == "halt":
                 raise NonFiniteLossError(f"final step non-finite: {detail}")
         self._trainer.finalize()
+        emit_event(EventKind.TRAIN_END, step=step)
         for hook in self._hooks:
             hook.end(self)
         return {"step": step, **self.eval_metrics}

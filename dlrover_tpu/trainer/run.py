@@ -84,6 +84,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optimizer steps fused per compiled call "
                         "(lax.scan multi-step; workers see it as "
                         "DLROVER_TPU_STEPS_PER_CALL)")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve Prometheus /metrics from the agent on "
+                        "this port (also DLROVER_TPU_METRICS_PORT; "
+                        "0/unset = off)")
+    p.add_argument("--events_file", default=None,
+                   help="append the structured event timeline (JSONL) "
+                        "here; workers inherit it via "
+                        "DLROVER_TPU_EVENTS_FILE so one file holds "
+                        "the whole job")
     p.add_argument("entrypoint", help="training script or executable")
     p.add_argument("args", nargs=argparse.REMAINDER)
     return p
@@ -141,6 +150,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from dlrover_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] in ("metrics", "mttr", "events"):
+        # `tpurun metrics [--addr host:port]` / `tpurun mttr ...` —
+        # the observability CLI (docs/observability.md)
+        from dlrover_tpu.telemetry.cli import main as telemetry_main
+
+        return telemetry_main(argv)
     args = build_parser().parse_args(argv)
     script_args = list(args.args)
     if script_args and script_args[0] == "--":
@@ -153,6 +168,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.environ["DLROVER_TPU_TRAIN_WINDOW"] = str(args.train_window)
     if args.steps_per_call is not None:
         os.environ["DLROVER_TPU_STEPS_PER_CALL"] = str(args.steps_per_call)
+    if args.events_file is not None:
+        # workers inherit os.environ (worker_group), so the agent's and
+        # every worker's lifecycle edges land in ONE timeline file
+        os.environ["DLROVER_TPU_EVENTS_FILE"] = args.events_file
+    exporter = None
+    if args.metrics_port is not None and args.metrics_port > 0:
+        from dlrover_tpu.telemetry.exporter import maybe_start_exporter
+
+        exporter = maybe_start_exporter(port=args.metrics_port)
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     nproc = 1 if args.nproc_per_node == "auto" else int(args.nproc_per_node)
     if nproc < 1:
@@ -198,6 +222,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         monitor.stop()
         return rc
     finally:
+        if exporter is not None:
+            exporter.stop()
         if master_proc is not None:
             time.sleep(0.2)
             master_proc.terminate()

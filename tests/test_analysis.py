@@ -246,6 +246,54 @@ class TestDLR006HostSyncOnMetrics:
         assert findings == []
 
 
+class TestDLR007UnregisteredMetricName:
+    def test_fires_on_literal_names(self):
+        findings = lint_snip("""
+            from dlrover_tpu.telemetry import emit_event, get_registry
+
+            def instrument(reg):
+                c = reg.counter("my_adhoc_total")
+                g = get_registry().gauge(name="my_gauge")
+                emit_event("my_event", step=1)
+                return c, g
+        """)
+        assert rules_of(findings) == ["DLR007"]
+        assert len(findings) == 3
+
+    def test_clean_with_names_constants(self):
+        findings = lint_snip("""
+            from dlrover_tpu.telemetry import (
+                emit_event, get_registry, names as tm,
+            )
+
+            def instrument(reg):
+                c = reg.counter(tm.TRAIN_STEPS)
+                emit_event(tm.EventKind.TRAIN_START, step=1)
+                return c
+        """)
+        assert findings == []
+
+    def test_telemetry_package_itself_is_exempt(self):
+        from dlrover_tpu.analysis.ast_rules import lint_source
+
+        findings = lint_source(
+            'def counter(name):\n    return counter("literal")\n',
+            "dlrover_tpu/telemetry/metrics.py",
+        )
+        assert findings == []
+
+    def test_unrelated_counter_class_is_not_matched(self):
+        # collections.Counter / .count() must not trip the rule
+        findings = lint_snip("""
+            from collections import Counter
+
+            def tally(words):
+                c = Counter("abc")
+                return c, words.count("x")
+        """)
+        assert findings == []
+
+
 class TestBaseline:
     def test_filter_allows_counts_and_reports_stale(self):
         f1 = Finding("DLR002", "a.py", 10, "m", scope="A.f")

@@ -68,6 +68,13 @@ SURFACE = {
     "dlrover_tpu.utils.prof": ["analyze_cost", "DryRunner", "AProfiler"],
     "dlrover_tpu.brain.client": ["BrainClient"],
     "dlrover_tpu.brain.watcher": ["ClusterWatcher", "K8sClusterSource"],
+    "dlrover_tpu.telemetry": ["get_registry", "emit_event",
+                              "read_events", "span",
+                              "export_chrome_trace", "mttr_report",
+                              "EventKind", "SpanName", "names"],
+    "dlrover_tpu.telemetry.exporter": ["MetricsExporter",
+                                       "maybe_start_exporter"],
+    "dlrover_tpu.telemetry.cli": ["main"],
 }
 
 

@@ -38,10 +38,20 @@ def master():
     m.stop()
 
 
-def test_external_sigkill_triggers_restart(master):
+def _derived_mttr(events_path):
+    """Run the real CLI derivation over a chaos run's event timeline."""
+    from dlrover_tpu.telemetry import read_events
+    from dlrover_tpu.telemetry.mttr import mttr_report
+
+    return mttr_report(read_events(events_path))
+
+
+def test_external_sigkill_triggers_restart(master, tmp_path, monkeypatch):
     """A worker killed from OUTSIDE (SIGKILL, like an OOM killer or
     preemption — not a polite exception) must be detected by the monitor
     loop and restarted within the budget."""
+    events_path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("DLROVER_TPU_EVENTS_FILE", events_path)
     client = MasterClient(master.addr, node_id=0)
     config = AgentConfig(
         node_rank=0, node_id=0, nproc_per_node=1, min_nodes=1, max_nodes=1,
@@ -75,13 +85,23 @@ def test_external_sigkill_triggers_restart(master):
     assert not thread.is_alive(), "agent did not finish after chaos kill"
     assert result["rc"] == 0
     assert agent._worker_group.restart_round >= 1
+    # the MTTR artifact is DERIVED from the timeline this run produced:
+    # worker_failed (SIGKILL classified by exit code) -> workers_started
+    report = _derived_mttr(events_path)
+    wf = report["detail"]["by_scenario"].get("worker_failure")
+    assert wf and wf["count"] >= 1, report
+    assert report["value"] > 0
+    assert "error" not in report, report
 
 
-def test_hang_without_heartbeat_triggers_relaunch(master):
+def test_hang_without_heartbeat_triggers_relaunch(master, tmp_path,
+                                                  monkeypatch):
     """A worker whose process stays alive but whose step loop freezes
     (the TPU hang mode: a collective waiting on a dead peer) must be
     detected via the heartbeat gap and relaunched — the reference's
     --relaunch_on_hanging semantics."""
+    events_path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("DLROVER_TPU_EVENTS_FILE", events_path)
     client = MasterClient(master.addr, node_id=0)
     config = AgentConfig(
         node_rank=0, node_id=0, nproc_per_node=1, min_nodes=1, max_nodes=1,
@@ -101,6 +121,17 @@ def test_hang_without_heartbeat_triggers_relaunch(master):
     # the hang was reported to the master's failure log as node 0
     assert 0 in client.failed_nodes()
     client.close()
+    # derived MTTR: hang_detected -> workers_started, with the HANG
+    # error code carried on the failure edge
+    from dlrover_tpu.telemetry import read_events
+
+    report = _derived_mttr(events_path)
+    hang = report["detail"]["by_scenario"].get("hang")
+    assert hang and hang["count"] >= 1, report
+    assert report["value"] > 0
+    hang_edges = [r for r in read_events(events_path)
+                  if r["kind"] == "hang_detected"]
+    assert hang_edges and hang_edges[0]["error_code"] == "HANG"
 
 
 def test_long_phase_lease_defers_hang_judgment(tmp_path):
