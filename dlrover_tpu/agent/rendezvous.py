@@ -100,6 +100,9 @@ class MasterRendezvousHandler:
         self._host_ip = host_ip if host_ip is not None else local_host_ip()
         self._poll_interval = poll_interval
         self._reserved_sock: Optional[socket.socket] = None
+        # True while a renegotiate() round is in flight: tags the
+        # round's timeline events as live-reshard traffic
+        self._live_round = False
 
     def release_coordinator_port(self):
         """Free the reserved port right before the coordinator binds it."""
@@ -131,7 +134,8 @@ class MasterRendezvousHandler:
         addr = f"{self._host_ip}:{coord_port}"
         t0 = time.monotonic()
         emit_event(EventKind.RDZV_JOIN, rdzv=self.rdzv_name,
-                   node_rank=self.node_rank)
+                   node_rank=self.node_rank,
+                   live=self._live_round or None)
         with span(SpanName.RENDEZVOUS, category="rdzv",
                   rdzv=self.rdzv_name):
             self._client.join_rendezvous(
@@ -158,7 +162,8 @@ class MasterRendezvousHandler:
                                rdzv=self.rdzv_name,
                                round=world_msg.round,
                                world_size=len(world),
-                               wait_seconds=round(elapsed, 3))
+                               wait_seconds=round(elapsed, 3),
+                               live=self._live_round or None)
                     return self._build_info(world_msg.round, world,
                                             world_msg.coordinator_addr)
                 if time.time() > deadline:
@@ -199,3 +204,25 @@ class MasterRendezvousHandler:
 
     def num_nodes_waiting(self) -> int:
         return self._client.num_nodes_waiting(self.rdzv_name)
+
+    def renegotiate(self, timeout: Optional[float] = None) -> RendezvousInfo:
+        """Re-join the rendezvous from a SURVIVING process — the live
+        elastic recovery path.
+
+        A classic restart tears the worker down and lets a fresh
+        process call ``next_rendezvous``; a live reshard keeps the
+        process (and its host-DRAM snapshot + compiled programs) and
+        only needs the new world's coordinates: re-join, wait for the
+        master to complete the round at the new size, and hand the
+        coordinates to the in-process rebuild
+        (``jax.distributed.shutdown()`` + ``initialize()`` with the new
+        coordinator, then ``ElasticTrainer.live_reshard``). Identical
+        wire protocol to ``next_rendezvous`` — the master cannot tell a
+        renegotiating survivor from a restarted worker — but tagged in
+        the event timeline so MTTR derivation can attribute the round
+        to a live reshard instead of a restart."""
+        self._live_round = True
+        try:
+            return self.next_rendezvous(timeout=timeout)
+        finally:
+            self._live_round = False

@@ -74,6 +74,15 @@ class AgentConfig:
     # compile looks like a hang and restarts burn the budget on a
     # healthy job (each round recompiling into the same false flag)
     hang_first_beat_grace: float = 600.0
+    # live elastic recovery: when a membership change arrives while this
+    # host's workers are HEALTHY, delegate to their in-process reshard
+    # (TrainExecutor.request_live_reshard via the failover monitor)
+    # instead of stopping and respawning them — the agent only falls
+    # back to a worker restart if the change is still unabsorbed after
+    # live_reshard_grace seconds. Off (default) = classic restart-on-
+    # change (tpurun --live_recovery turns it on).
+    live_recovery: bool = False
+    live_reshard_grace: float = 120.0
 
 
 class ElasticTrainingAgent:
@@ -104,6 +113,9 @@ class ElasticTrainingAgent:
         self._remaining_restarts = config.max_restarts
         self._host_ip = host_ip
         self.last_rdzv: Optional[RendezvousInfo] = None
+        # deadline for a delegated in-process reshard to absorb the
+        # current membership change; None = nothing delegated
+        self._reshard_deadline: Optional[float] = None
         reg = get_registry()
         self._c_restarts = reg.counter(
             tm.AGENT_WORKER_RESTARTS, help="worker-group restarts")
@@ -189,9 +201,16 @@ class ElasticTrainingAgent:
                 self._client.report_node_status(NodeStatus.FAILED)
                 return 1
             # healthy: check whether membership changed (new/rejoined nodes
-            # waiting) and restart into a bigger/smaller world if so.
+            # waiting) and restart into a bigger/smaller world if so —
+            # unless live recovery delegates the change to the workers'
+            # in-process reshard first (docs/operations.md ladder).
             if self._membership_changed():
-                self._restart_workers()
+                if not self._maybe_delegate_reshard():
+                    self._restart_workers()
+            else:
+                # the change was absorbed (or none pending): clear any
+                # delegation window so the next event gets a fresh grace
+                self._reshard_deadline = None
 
     def _hang_gap(self) -> Optional[float]:
         """Stale-heartbeat gap in seconds, or None if healthy/disabled.
@@ -222,6 +241,50 @@ class ElasticTrainingAgent:
             error_data=f"hang: no heartbeat for {gap:.1f}s",
             level=TrainingExceptionLevel.NODE_ERROR,
         )
+
+    def _maybe_delegate_reshard(self) -> bool:
+        """Live recovery at the agent: a membership change while this
+        host's workers are healthy is SURVIVABLE (failover.py
+        classify_recovery) — the workers' failover monitor will reshard
+        in place, so stopping them here would throw away live state and
+        compiled programs for nothing. Returns True when the restart
+        should be SKIPPED this poll (delegation active), False when the
+        agent must restart (knob off, classification says restart, or
+        the grace window expired without the change being absorbed)."""
+        if not self._config.live_recovery:
+            return False
+        from dlrover_tpu.trainer.failover import (
+            RecoveryDecision,
+            classify_recovery,
+        )
+
+        decision = classify_recovery(EventKind.RDZV_JOIN,
+                                     self_affected=False)
+        if decision != RecoveryDecision.LIVE_RESHARD:
+            return False
+        now = time.time()
+        if self._reshard_deadline is None:
+            self._reshard_deadline = (
+                now + self._config.live_reshard_grace
+            )
+            logger.info(
+                "membership change delegated to in-process reshard "
+                "(%.0fs grace before falling back to a worker restart)",
+                self._config.live_reshard_grace,
+            )
+            emit_event(EventKind.LIVE_RESHARD_DELEGATED,
+                       grace_seconds=self._config.live_reshard_grace,
+                       restart_round=self._worker_group.restart_round)
+            return True
+        if now < self._reshard_deadline:
+            return True  # still inside the grace window
+        logger.warning(
+            "delegated reshard did not absorb the membership change "
+            "within %.0fs; falling back to a worker restart",
+            self._config.live_reshard_grace,
+        )
+        self._reshard_deadline = None
+        return False
 
     def _membership_changed(self) -> bool:
         try:

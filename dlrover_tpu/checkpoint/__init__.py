@@ -8,11 +8,13 @@ Role parity: the reference's elastic FSDP checkpoint
 from dlrover_tpu.checkpoint.manager import (
     CheckpointInterval,
     ElasticCheckpointManager,
+    HostSnapshot,
     abstract_like,
 )
 
 __all__ = [
     "CheckpointInterval",
     "ElasticCheckpointManager",
+    "HostSnapshot",
     "abstract_like",
 ]

@@ -89,6 +89,112 @@ def abstract_like(state: Any, sharding_tree: Any = None) -> Any:
     )
 
 
+@dataclass
+class HostSnapshot:
+    """An in-process, host-DRAM copy of a TrainState — the live-recovery
+    analogue of the staging mirror, with the storage round-trip removed.
+
+    Where the mirror layer copies a *committed Orbax step* into tmpfs so
+    a restarted process restores from DRAM, ``HostSnapshot`` keeps the
+    *live* state in this process's own heap so a surviving process never
+    restores at all: the executor drains its in-flight window, takes one
+    snapshot (a single ``device_get``), rebuilds the mesh for the new
+    world, and ``device_put``s the snapshot against the new shardings —
+    GSPMD lays the global arrays out for the survivor topology exactly
+    as an Orbax reshard-on-load would, minus serialization, storage, and
+    process boot. Leaves are host numpy arrays: donation-safe (XLA never
+    owned them) and immune to peer/device loss.
+    """
+
+    step: int
+    tree: Any
+    meta: Dict[str, Any]
+
+    @classmethod
+    def take(cls, state: Any, **meta) -> "HostSnapshot":
+        """One device sync: pull every leaf to host DRAM. Callers drain
+        in-flight work first so this waits only on the last step."""
+        reg = get_registry()
+        t0 = time.monotonic()
+        with span(SpanName.STATE_SNAPSHOT):
+            tree = jax.device_get(state)
+        snap_s = time.monotonic() - t0
+        reg.histogram(
+            tm.SNAPSHOT_TIME,
+            help="host-DRAM TrainState snapshot (device_get) seconds",
+        ).observe(snap_s)
+        step = int(tree.step) if hasattr(tree, "step") else -1
+        emit_event(EventKind.STATE_SNAPSHOT, step=step,
+                   snapshot_seconds=round(snap_s, 3))
+        return cls(step=step, tree=tree, meta=dict(meta))
+
+    def restore(self, sharding_tree: Any) -> Any:
+        """Materialize the snapshot into ``sharding_tree`` — the new
+        mesh's NamedShardings. ``device_put`` against them IS the
+        reshard: XLA scatters each host array into the survivor
+        topology's layout (the in-memory twin of Orbax's
+        reshard-on-load)."""
+        return jax.device_put(self.tree, sharding_tree)
+
+    def nbytes(self) -> int:
+        return sum(
+            getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(self.tree)
+        )
+
+
+def _rematerialize(state: Any) -> Any:
+    """Copy restored arrays into fresh XLA-owned buffers.
+
+    Orbax materializes restored ``jax.Array``s over buffers that (on the
+    CPU backend) can alias tensorstore-owned host memory. The train step
+    is compiled with ``donate_argnums``, so the first step after a
+    restore would DONATE those aliased buffers — XLA then writes into /
+    frees memory it does not own. Observed as a segfault or a wedged
+    dispatch once another Orbax manager has touched the process (the
+    tests/test_checkpoint_trainer.py + tests/test_executor.py adjacency
+    hang). One cheap copy per restore makes every restored leaf
+    donation-safe; sharding is preserved. The whole tree goes through
+    ONE jitted program (not a per-leaf ``jnp.copy`` — that would
+    compile hundreds of trivial executables on a large model's first
+    restore, a real MTTR tax)."""
+    return _copy_tree(state)
+
+
+@jax.jit
+def _copy_tree(tree: Any) -> Any:
+    import jax.numpy as jnp
+
+    return jax.tree.map(jnp.copy, tree)
+
+
+def _decouple_from_donation(state: Any) -> Any:
+    """The WRITE-side twin of ``_rematerialize``: on the CPU backend,
+    Orbax's async save zero-copy-references the live device buffers
+    (host memory IS device memory there), while the training loop's
+    next step DONATES those same buffers — the background write then
+    persists whatever the donated computation scribbled over them. A
+    NaN landing one step after a save used to poison the freshly
+    "committed" checkpoint this way (the rollback target!), surfacing
+    as the rollback tests failing only after another Orbax manager had
+    warmed the background pools enough for the write to lose the race.
+    One device-side copy per save hands Orbax buffers nothing ever
+    donates. TPU/GPU backends skip it: there Orbax's async save stages
+    a host copy before returning, which decouples donation already."""
+    leaves = [x for x in jax.tree.leaves(state) if isinstance(x, jax.Array)]
+    if not leaves:
+        return state
+    try:
+        platforms = {d.platform for d in leaves[0].devices()}
+    except Exception as e:  # noqa: BLE001 — conservative: copy when unsure
+        logger.warning("could not read device platform before save; "
+                       "taking the donation-safety copy (%s: %s)",
+                       type(e).__name__, e)
+        platforms = {"cpu"}
+    if platforms != {"cpu"}:
+        return state
+    return _copy_tree(state)
+
+
 class ElasticCheckpointManager:
     """Save/restore TrainState + metadata, async by default.
 
@@ -198,6 +304,7 @@ class ElasticCheckpointManager:
         """
         if not force and not self.interval.should_save(step):
             return False
+        state = _decouple_from_donation(state)
         ocp = self._ocp
         meta = dict(metadata or {})
         meta["save_wall_time"] = time.time()
@@ -549,6 +656,44 @@ class ElasticCheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()
 
+    def restore_from_staging(
+        self, abstract_state: Any
+    ) -> Optional[Dict[str, Any]]:
+        """Warm-restart fast path: restore the newest staged step from
+        the host-DRAM mirror WITHOUT touching the primary directory.
+
+        ``restore()`` consults the primary's step listing first; on a
+        remote/flaky store that round-trip alone can dominate a restart
+        budget. A same-host process restart (the agent's default
+        recovery for a survivable failure when no process survived) can
+        skip it: the mirror holds the newest step this host committed,
+        digest/provenance-validated like any staged restore. Returns
+        None when there is nothing staged or validation fails — callers
+        fall back to ``restore()``.
+        """
+        if self._staging_root is None:
+            return None
+        step = self.staged_step()
+        if step is None or not self._staged_digest_valid(step):
+            return None
+        t0 = time.monotonic()
+        try:
+            with span(SpanName.CKPT_RESTORE, source="staging"):
+                out = self._restore_from(self._staging_root, step,
+                                         abstract_state)
+        except Exception:  # noqa: BLE001 — callers fall back to restore()
+            logger.exception(
+                "staging fast-path restore of step %d failed", step)
+            return None
+        restore_s = time.monotonic() - t0
+        self._h_restore.observe(restore_s)
+        self._c_restores.inc()
+        emit_event(EventKind.CKPT_RESTORE, step=step,
+                   restore_seconds=round(restore_s, 3), source="staging")
+        logger.info("restored step %d from host-DRAM staging (no "
+                    "primary round-trip)", step)
+        return out
+
     def restore(
         self,
         abstract_state: Any,
@@ -728,7 +873,7 @@ class ElasticCheckpointManager:
                 args["data_shards"] = ocp.args.JsonRestore()
             restored = manager.restore(step, args=ocp.args.Composite(**args))
             out = {
-                "state": restored["state"],
+                "state": _rematerialize(restored["state"]),
                 "meta": restored["meta"] or {},
                 "shard_checkpoint": "",
                 "step": step,

@@ -69,6 +69,14 @@ class Context:
         # a lax.scan over K stacked batches; one host dispatch per K
         # steps). Consumed by ElasticTrainer at construction.
         self.steps_per_call = 1
+        # live elastic recovery: survivable membership changes (peer
+        # lost, scale plan, another node preempted) are absorbed
+        # IN-PROCESS — drain the dispatch window, snapshot TrainState to
+        # host DRAM, rebuild the mesh for the survivor world, reshard
+        # via device_put — instead of restarting the worker process
+        # (docs/operations.md decision tree). Off = every change takes
+        # the process-restart path.
+        self.live_recovery = True
         # what to do on a non-finite step after reporting the failure:
         # "halt" | "rollback" (restore last checkpoint) | "ignore"
         self.on_nonfinite = "halt"

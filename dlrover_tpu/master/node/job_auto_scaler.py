@@ -90,6 +90,13 @@ class JobAutoScaler:
 
         from dlrover_tpu.telemetry import EventKind, emit_event
 
+        if not plan.recovery and plan.resizes_world_only():
+            # a pure world resize is survivable by every node the plan
+            # keeps: stamp the live fast path so workers reshard in
+            # place instead of restarting (docs/operations.md ladder)
+            from dlrover_tpu.trainer.failover import RecoveryDecision
+
+            plan.recovery = RecoveryDecision.LIVE_RESHARD
         logger.info("executing optimization plan: %s", plan.to_dict())
         self._speed_monitor.reset_running_speed_monitor()
         self._last_plan_time = time.monotonic()

@@ -27,6 +27,27 @@ class ScalePlan:
     migrate_nodes: Dict[str, "NodeResource"] = field(default_factory=dict)
     # PS addresses for the next PS cluster version (PS jobs only).
     ps_addrs: List[str] = field(default_factory=list)
+    # Preferred recovery rung for the SURVIVING nodes while this plan
+    # executes (failover.RecoveryDecision values): "live_reshard" marks
+    # a pure world-resize plan — survivors should drain + snapshot +
+    # reshard in place instead of restarting; "" leaves the workers'
+    # own classification in charge. Rides to_dict() into the
+    # scale_plan_applied event so the timeline records which path the
+    # master asked for.
+    recovery: str = ""
+
+    def resizes_world_only(self) -> bool:
+        """True when the plan concretely adds/removes nodes and changes
+        nothing else — no PS topology change, no in-place migration.
+        Exactly the shape a surviving SPMD worker can absorb by
+        resharding. Deliberately NOT satisfied by a group-resource-only
+        plan: without the previous counts a plan object cannot tell a
+        count bump from a cpu/memory re-spec, and a re-spec needs a pod
+        relaunch — stamping it live would be wrong, so those plans
+        leave the workers' own classification in charge."""
+        return bool(self.launch_nodes or self.remove_nodes) and not (
+            self.ps_addrs or self.migrate_nodes
+        )
 
     def empty(self) -> bool:
         return not (
@@ -44,6 +65,8 @@ class ScalePlan:
         self.migrate_nodes.update(other.migrate_nodes)
         if other.ps_addrs:
             self.ps_addrs = other.ps_addrs
+        if other.recovery:
+            self.recovery = other.recovery
 
     def to_dict(self) -> Dict:
         return {
@@ -55,6 +78,7 @@ class ScalePlan:
             "launch": [n.name for n in self.launch_nodes],
             "remove": [n.name for n in self.remove_nodes],
             "ps_addrs": list(self.ps_addrs),
+            "recovery": self.recovery,
         }
 
 

@@ -56,6 +56,22 @@ class AccelerateResult:
     steps_per_call: int = 1
     stacked_batch_spec: Any = None
 
+    def compiled_cache_size(self) -> int:
+        """Executables held by this result's jitted programs (the
+        train step and, when built, the K-step scan). A loop that ran
+        N steps with an unchanged delta here recompiled nothing — the
+        zero-recompile gate of the warm-restart / live-reshard paths
+        and of ``bench.py``'s timed regions."""
+        total = 0
+        for fn in (self.train_step, self.train_step_multi):
+            if fn is None:
+                continue
+            inner = getattr(fn, "__wrapped__", fn)
+            size = getattr(inner, "_cache_size", None)
+            if callable(size):
+                total += int(size())
+        return total
+
     def shard_batch(self, batch, stacked: bool = False):
         """Host batch -> mesh-sharded global batch.
 

@@ -127,6 +127,27 @@ class MeshPlan:
         return max(1, self.data) * max(1, self.fsdp)
 
 
+def topology_key(devices: Optional[Sequence] = None) -> str:
+    """Stable identity of a device set, for compiled-program caching.
+
+    Two worlds with the same key can reuse each other's compiled SPMD
+    programs verbatim (same platform, same device identities, same
+    order ⇒ same HLO, same executable). ``ElasticTrainer`` keys its
+    in-process program cache on this so a live reshard BACK to a
+    topology it already compiled for — the scale-down-then-recover
+    pattern — pays zero recompiles; ``utils.compile_cache`` keys the
+    persistent on-disk cache on the env-derived analogue
+    (``topology_hint``), which needs no backend.
+    """
+    import jax
+
+    devices = list(devices) if devices is not None else jax.devices()
+    return "|".join(
+        f"{getattr(d, 'platform', '?')}:{getattr(d, 'id', '?')}"
+        for d in devices
+    )
+
+
 def single_device_plan() -> MeshPlan:
     return MeshPlan(pipe=1, data=1, fsdp=1, seq=1, tensor=1)
 

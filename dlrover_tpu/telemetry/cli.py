@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     tr = sub.add_parser("trace", help="export span ring as Chrome JSON")
     tr.add_argument("--out", default="trace.json")
+
+    cache = sub.add_parser(
+        "cache", help="persistent XLA compile-cache stats (dir, entry "
+                      "count, this process's hit/miss traffic)")
+    cache.add_argument("--dir", default=None,
+                       help="un-fingerprinted cache root (default: the "
+                            "active/env-configured one)")
     return p
 
 
@@ -119,6 +126,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         n = tracing.export_chrome_trace(args.out)
         print(f"wrote {n} span(s) to {args.out}")
         return 0
+
+    if args.cmd == "cache":
+        from dlrover_tpu.utils.compile_cache import cache_stats
+
+        stats = cache_stats(args.dir)
+        print(json.dumps(stats))
+        return 0 if stats["configured"] else 1
 
     return 2
 

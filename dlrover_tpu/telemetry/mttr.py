@@ -12,6 +12,7 @@ compatible kind:
   hang_detected           workers_started          hang relaunch
   nonfinite_step          rollback_restored        NaN rollback
   preempt_notice          preempt_drain_done       preemption drain
+  live_reshard_begin      live_reshard_done        in-process reshard
 
 Durations use the monotonic clock when both events came from the same
 process (exact), else wall clocks (cross-process, e.g. agent-side
@@ -36,6 +37,8 @@ _PAIRINGS = {
         {EventKind.ROLLBACK_RESTORED}, "nonfinite_rollback"),
     EventKind.PREEMPT_NOTICE: (
         {EventKind.PREEMPT_DRAIN_DONE}, "preemption_drain"),
+    EventKind.LIVE_RESHARD_BEGIN: (
+        {EventKind.LIVE_RESHARD_DONE}, "live_reshard"),
 }
 
 

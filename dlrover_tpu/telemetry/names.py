@@ -38,6 +38,25 @@ NONFINITE_ROLLBACKS = "dlrover_nonfinite_rollbacks_total"
 PREEMPT_NOTICES = "dlrover_preemption_notices_total"
 EVAL_TIME = "dlrover_eval_seconds"
 
+# -- live elastic recovery ----------------------------------------------------
+
+# in-process scale events absorbed without a process restart
+LIVE_RESHARDS = "dlrover_live_reshards_total"
+# drain -> snapshot -> rebuild -> reshard -> ready, wall seconds
+LIVE_RESHARD_TIME = "dlrover_live_reshard_seconds"
+# host-DRAM TrainState snapshot (device_get) wall seconds
+SNAPSHOT_TIME = "dlrover_state_snapshot_seconds"
+# in-process compiled-program cache of ElasticTrainer: a same-topology
+# resume that hits it pays ZERO recompiles
+PROGRAM_CACHE_HITS = "dlrover_program_cache_hits_total"
+PROGRAM_CACHE_MISSES = "dlrover_program_cache_misses_total"
+
+# -- persistent XLA compile cache ---------------------------------------------
+
+COMPILE_CACHE_HITS = "dlrover_compile_cache_hits_total"
+COMPILE_CACHE_MISSES = "dlrover_compile_cache_misses_total"
+COMPILE_CACHE_ENTRIES = "dlrover_compile_cache_entries"
+
 # -- master reporting from the worker ----------------------------------------
 
 MASTER_REPORTS = "dlrover_master_reports_total"
@@ -84,6 +103,16 @@ class EventKind:
     RDZV_TIMEOUT = "rdzv_timeout"
     # scaling
     SCALE_PLAN_APPLIED = "scale_plan_applied"
+    # live in-process recovery (failure edge -> recovery edge): the
+    # world changed under a surviving process; drain + snapshot +
+    # rebuild + reshard happen without a restart
+    LIVE_RESHARD_BEGIN = "live_reshard_begin"
+    LIVE_RESHARD_DONE = "live_reshard_done"
+    # host-DRAM TrainState snapshot taken (the reshard/rollback source)
+    STATE_SNAPSHOT = "state_snapshot"
+    # agent chose to delegate a survivable membership change to the
+    # workers' in-process reshard instead of restarting them
+    LIVE_RESHARD_DELEGATED = "live_reshard_delegated"
     # preemption (failure edge -> recovery edge)
     PREEMPT_NOTICE = "preempt_notice"
     PREEMPT_DRAIN_DONE = "preempt_drain_done"
@@ -113,6 +142,8 @@ class SpanName:
 
     STEP_DISPATCH = "step_dispatch"
     HOST_SYNC = "host_sync"
+    LIVE_RESHARD = "live_reshard"
+    STATE_SNAPSHOT = "state_snapshot"
     CKPT_SAVE_STAGE = "ckpt_save_stage"
     CKPT_MIRROR = "ckpt_mirror"
     CKPT_RESTORE = "ckpt_restore"

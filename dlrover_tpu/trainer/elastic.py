@@ -16,6 +16,7 @@ native (``dlrover_tpu.checkpoint``). The per-step hot loop stays pure.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -25,12 +26,21 @@ import jax
 from dlrover_tpu.checkpoint import (
     CheckpointInterval,
     ElasticCheckpointManager,
+    HostSnapshot,
     abstract_like,
 )
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.accelerate import AccelerateResult, accelerate
+from dlrover_tpu.parallel.mesh import topology_key
 from dlrover_tpu.parallel.strategy import Strategy
-from dlrover_tpu.telemetry import get_registry, names as tm
+from dlrover_tpu.telemetry import (
+    EventKind,
+    SpanName,
+    emit_event,
+    get_registry,
+    names as tm,
+    span,
+)
 
 logger = get_logger("trainer.elastic")
 
@@ -87,6 +97,19 @@ class ElasticTrainer:
         self._devices = list(devices) if devices is not None else None
 
         self._result: Optional[AccelerateResult] = None
+        # Compiled-program cache, keyed by mesh topology: a live reshard
+        # BACK to a topology this trainer already compiled for (scale
+        # down on a failure, scale up when the node returns) reuses the
+        # whole AccelerateResult — jitted step(s), shardings, mesh —
+        # with ZERO recompiles. Bounded: each entry pins its compiled
+        # executables in host memory, and elastic jobs oscillate between
+        # a handful of worlds, not dozens.
+        self._programs: "collections.OrderedDict[str, AccelerateResult]" = (
+            collections.OrderedDict()
+        )
+        self._program_cache_cap = 4
+        # accelerate() invocations that actually compiled (cache misses)
+        self.compile_count = 0
         # Device count the base strategy was written for; grad-accum scales
         # relative to this (the reference's max_workers anchor).
         self._initial_devices: Optional[int] = None
@@ -114,27 +137,53 @@ class ElasticTrainer:
             raise RuntimeError("call prepare() first")
         return self._result
 
-    def _build(self, num_devices: int) -> AccelerateResult:
+    def _build(self, devices: Optional[list]) -> AccelerateResult:
+        """Compile (or fetch from the program cache) for ``devices``
+        (None = the whole ``jax.devices()`` world)."""
+        actual = list(devices) if devices else jax.devices()
+        num_devices = len(actual)
         if self._initial_devices is None:
             self._initial_devices = num_devices
+        key = topology_key(actual)
+        reg = get_registry()
+        cached = self._programs.get(key)
+        if cached is not None:
+            # LRU touch: the topology we are running on must be the
+            # last evicted when the cap trims standby entries
+            self._programs.move_to_end(key)
+            reg.counter(
+                tm.PROGRAM_CACHE_HITS,
+                help="rebuilds served from the compiled-program cache "
+                     "(zero recompiles)").inc()
+            logger.info("program cache hit for %d devices (zero "
+                        "recompiles)", num_devices)
+            return cached
+        reg.counter(
+            tm.PROGRAM_CACHE_MISSES,
+            help="rebuilds that had to compile").inc()
         strategy = self._base_strategy.adjust_to_world(
             num_devices, prev_num_devices=self._initial_devices
         )
-        return accelerate(
+        result = accelerate(
             self._init_fn,
             self._loss_fn,
             self._optimizer,
             self._example_batch,
             strategy=strategy,
             rng=self._rng,
-            devices=self._devices,
+            devices=devices,
             steps_per_call=self.steps_per_call,
         )
+        self.compile_count += 1
+        self._programs[key] = result
+        while len(self._programs) > self._program_cache_cap:
+            evicted, _ = self._programs.popitem(last=False)
+            logger.info("program cache evicted topology %.40s...", evicted)
+        return result
 
     def prepare(self, state: Any = None) -> Any:
         """Compile for the current world; restore or init state."""
-        n = len(self._devices) if self._devices else len(jax.devices())
-        self._result = self._build(n)
+        self._result = self._build(self._devices)
         if state is not None:
             self._host_step = int(state.step)
             return state
@@ -182,33 +231,128 @@ class ElasticTrainer:
         announce_long_phase(600.0)  # restore window: not a hang
         return self._try_restore()
 
-    def on_world_change(self, state: Any, devices=None) -> Any:
-        """Re-accelerate for the new device count and reshard the state.
+    def snapshot(self, state: Any) -> HostSnapshot:
+        """Host-DRAM copy of the live state (one ``device_get``). The
+        reshard source of ``live_reshard`` and a rollback anchor that
+        survives the loss of any peer's devices."""
+        return HostSnapshot.take(
+            state, strategy=self._result.strategy.to_json()
+            if self._result else "",
+        )
 
-        Called by the agent/bootstrap after ``jax.distributed`` re-init.
-        The global batch stays fixed: ``Strategy.adjust_to_world`` shrinks
-        the data axis and grows grad accumulation to compensate — the
-        reference's ``_set_gradient_accumulation_steps`` semantics.
-        ``devices``: the surviving device subset (default: the full
-        post-re-init ``jax.devices()`` world — an explicit
-        construction-time subset is dropped, because after a membership
-        change those handles may be stale/dead).
+    def live_reshard(self, state: Any, devices=None,
+                     snapshot: Optional[HostSnapshot] = None,
+                     reason: str = "", emit_events: bool = True) -> Any:
+        """The live recovery fast path: absorb a world change WITHOUT
+        leaving the process.
+
+        snapshot (host DRAM) → rebuild (program cache, often zero
+        recompiles) → reshard (``device_put`` against the new
+        shardings) → resume. Callers (the executor) drain their
+        in-flight window first so the snapshot covers the last
+        completed optimizer step. ``devices``: the surviving device
+        subset (default: the full post-change ``jax.devices()`` world —
+        an explicit construction-time subset is dropped, because after
+        a membership change those handles may be stale/dead).
+        ``snapshot``: a pre-taken HostSnapshot (e.g. from a caller that
+        snapshotted before re-rendezvous); default is to take one now.
+
+        The global batch stays fixed: ``Strategy.adjust_to_world``
+        shrinks the data axis and grows grad accumulation to compensate
+        — the reference's ``_set_gradient_accumulation_steps``
+        semantics.
         """
         from dlrover_tpu.diagnosis.hang_detector import announce_long_phase
 
-        announce_long_phase(900.0)  # recompile window: not a hang
-        self._devices = list(devices) if devices is not None else None
-        n = len(self._devices) if self._devices else len(jax.devices())
-        old_accum = self._result.strategy.grad_accum_steps if self._result else 1
-        self._result = self._build(n)
-        logger.info(
-            "world changed -> %d devices; grad_accum %d -> %d",
-            n, old_accum, self._result.strategy.grad_accum_steps,
+        announce_long_phase(900.0)  # rebuild window: not a hang
+        old_result = self._result
+        old_n = (
+            old_result.mesh.devices.size if old_result is not None else 0
         )
-        # Reshard the live state onto the new mesh. device_put with the new
-        # NamedShardings is an all-gather/reshard XLA program, not a host
-        # round-trip.
-        return jax.device_put(state, self._result.state_sharding)
+        t0 = time.monotonic()
+        if emit_events:
+            emit_event(EventKind.LIVE_RESHARD_BEGIN, world_from=old_n,
+                       reason=reason, step=int(self._host_step))
+        with span(SpanName.LIVE_RESHARD, world_from=old_n):
+            if snapshot is None:
+                snapshot = self.snapshot(state)
+            self._devices = list(devices) if devices is not None else None
+            n = len(self._devices) if self._devices else len(jax.devices())
+            compiles_before = self.compile_count
+            self._result = self._build(self._devices)
+            state = snapshot.restore(self._result.state_sharding)
+            # the reshard program must have RUN before we claim
+            # recovered (and before the timing below means anything)
+            jax.block_until_ready(state)
+        reshard_s = time.monotonic() - t0
+        reg = get_registry()
+        reg.counter(
+            tm.LIVE_RESHARDS,
+            help="world changes absorbed in-process (no restart)").inc()
+        reg.histogram(
+            tm.LIVE_RESHARD_TIME,
+            help="snapshot -> rebuild -> reshard wall seconds",
+        ).observe(reshard_s)
+        recompiled = self.compile_count - compiles_before
+        old_accum = (
+            old_result.strategy.grad_accum_steps if old_result else 1
+        )
+        logger.info(
+            "live reshard: %d -> %d devices in %.2fs (grad_accum "
+            "%d -> %d, %s)", old_n, n, reshard_s, old_accum,
+            self._result.strategy.grad_accum_steps,
+            "program cache hit" if not recompiled else "recompiled",
+        )
+        if emit_events:
+            emit_event(EventKind.LIVE_RESHARD_DONE, world_from=old_n,
+                       world_to=n, reshard_seconds=round(reshard_s, 3),
+                       recompiled=recompiled, step=snapshot.step)
+        return state
+
+    def prewarm(self, devices=None, execute: bool = True) -> bool:
+        """Standby-compile the program for a topology we may reshard to
+        (e.g. the (N - node_unit)-device survivor world), so the live
+        reshard that follows a real failure hits the program cache and
+        pays zero recompiles. Returns True when a compile happened,
+        False on a cache hit. Does NOT switch the trainer's active
+        program or device set.
+
+        ``execute`` (default): run one throwaway step on the standby
+        topology — jit is lazy, so merely building the program object
+        would still leave trace + XLA compile to the first post-failure
+        step. The dummy step costs a transient extra copy of the state
+        on the standby submesh; pass ``execute=False`` on models too
+        large to double-book (the reshard then pays the compile, but
+        still skips the strategy/mesh rebuild)."""
+        before = self.compile_count
+        result = self._build(list(devices) if devices is not None else None)
+        compiled = self.compile_count > before
+        if execute and compiled:
+            from dlrover_tpu.diagnosis.hang_detector import (
+                announce_long_phase,
+            )
+
+            announce_long_phase(900.0)  # standby compile: not a hang
+            rng = jax.random.PRNGKey(0)
+            dummy = result.init_fn(rng)
+            sharded = result.shard_batch(self._example_batch)
+            dummy, _metrics = result.train_step(dummy, sharded, rng)
+            jax.block_until_ready(dummy)
+            logger.info("prewarmed standby topology (%d devices): one "
+                        "dummy step executed",
+                        result.mesh.devices.size)
+        return compiled
+
+    def on_world_change(self, state: Any, devices=None) -> Any:
+        """The process-restart rebuild entrypoint (agent/bootstrap,
+        after ``jax.distributed`` re-init; also the executor's classic
+        ``request_restart`` path). Same mechanics as ``live_reshard``
+        but WITHOUT the live-reshard timeline events: a restart-path
+        rebuild must pair with the restart scenarios in the MTTR
+        derivation, not inflate the ``live_reshard`` one."""
+        return self.live_reshard(state, devices=devices,
+                                 reason="on_world_change",
+                                 emit_events=False)
 
     # -- hot loop ------------------------------------------------------------
 

@@ -155,6 +155,7 @@ class TrainExecutor:
         conf: Optional[Configuration] = None,
         master_client=None,
         failover_client: Optional[FailoverClient] = None,
+        reshard_world_fn: Optional[Callable[[], Optional[List[Any]]]] = None,
     ):
         self._trainer = trainer
         self._train_iter_fn = train_iter_fn
@@ -253,6 +254,22 @@ class TrainExecutor:
         self._last_metrics: Optional[Dict[str, Any]] = None
         self._master_client = master_client
         self._restart_requested = False
+        # live recovery (the in-process scale path): a survivable
+        # membership change drains the window, snapshots to host DRAM,
+        # rebuilds the mesh and reshards — all without process death.
+        # The knob gates whether the failover monitor may route
+        # survivable changes here instead of request_restart.
+        self._live_recovery = bool(conf.get(
+            "live_recovery", getattr(ctx, "live_recovery", True)
+        ))
+        self._reshard_requested = False
+        self._reshard_devices: Optional[List[Any]] = None
+        # multi-host: called at reshard time to renegotiate membership
+        # and return the survivor device list (e.g. re-join via
+        # MasterRendezvousHandler.renegotiate + jax.distributed re-init,
+        # then jax.devices()). None = single-host / tests, where the
+        # requester passes the devices explicitly.
+        self._reshard_world_fn = reshard_world_fn
         self._failover: Optional[TrainingFailover] = None
         if master_client is not None:
             if failover_client is not None:
@@ -260,6 +277,8 @@ class TrainExecutor:
             self._failover = TrainingFailover(
                 master_client, self.request_restart,
                 failover_client=failover_client,
+                on_reshard=(self.request_live_reshard
+                            if self._live_recovery else None),
             )
         self.state: Any = None
         self.eval_metrics: Dict[str, Any] = {}
@@ -398,12 +417,67 @@ class TrainExecutor:
         """Membership changed: finish the current step, then rebuild."""
         self._restart_requested = True
 
+    def request_live_reshard(self, devices=None):
+        """A SURVIVABLE world change (peer lost with a viable survivor
+        world, a scale plan, another node's preemption): drain the
+        in-flight window at the next loop boundary, then snapshot →
+        reshard → resume inside this process. ``devices``: the survivor
+        device subset (None = the full post-change world)."""
+        self._reshard_devices = list(devices) if devices is not None else None
+        self._reshard_requested = True
+
     def _maybe_restart(self):
+        if self._reshard_requested:
+            self._reshard_requested = False
+            devices = self._reshard_devices
+            self._reshard_devices = None
+            if devices is None and self._reshard_world_fn is not None:
+                # multi-host: renegotiate membership first — the new
+                # world's devices are only visible after the re-join
+                devices = self._reshard_world_fn()
+            if devices is None and not self._world_actually_changed():
+                # the failover monitor re-fires while nodes sit at the
+                # rendezvous, but without new coordinates (no explicit
+                # devices, no reshard_world_fn, ambient world unchanged)
+                # a reshard would be a snapshot + device_put onto the
+                # IDENTICAL topology — churn, not recovery. Skip; the
+                # agent's grace-window fallback restart handles a change
+                # this process cannot absorb.
+                logger.info(
+                    "live reshard requested but the visible world is "
+                    "unchanged; skipping (no renegotiated coordinates)"
+                )
+                return
+            # the drain already ran at the loop boundary, so the
+            # snapshot inside live_reshard covers the last completed
+            # optimizer step — nothing is skipped or replayed
+            self.state = self._trainer.live_reshard(
+                self.state, devices=devices, reason="executor"
+            )
+            return
         if not self._restart_requested:
             return
         self._restart_requested = False
         logger.info("rebuilding training session (membership change)")
         self.state = self._trainer.on_world_change(self.state)
+
+    def _world_actually_changed(self) -> bool:
+        """Whether the ambient device world differs from the mesh the
+        trainer is currently compiled for (set-compare on device ids —
+        ``mesh_utils`` is free to reorder within a topology)."""
+        import jax
+
+        try:
+            result = self._trainer.accelerated
+        except (RuntimeError, AttributeError):
+            return True  # nothing compiled yet: let the rebuild decide
+        mesh_devices = result.mesh.devices.flatten().tolist()
+        ambient = jax.devices()
+        return (
+            len(mesh_devices) != len(ambient)
+            or {getattr(d, "id", None) for d in mesh_devices}
+            != {getattr(d, "id", None) for d in ambient}
+        )
 
     # -- NaN/overflow guardrail ----------------------------------------------
 
@@ -707,7 +781,7 @@ class TrainExecutor:
                             restarted = True
                             break
                         return self._finish(step)
-                    if self._restart_requested:
+                    if self._restart_requested or self._reshard_requested:
                         if self._drain_window():
                             step = int(self.state.step)
                             restarted = True

@@ -18,6 +18,7 @@ import time
 from typing import Callable, List, Optional
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.names import EventKind
 
 logger = get_logger("trainer.failover")
 
@@ -26,6 +27,56 @@ class VersionType:
     LOCAL = "local"
     GLOBAL = "global"
     RESTORED = "restored"
+
+
+class RecoveryDecision:
+    """The three rungs of the recovery ladder (docs/operations.md),
+    cheapest first. Each rung strictly contains the next's cost: a live
+    reshard is a drain + snapshot + (often cached) rebuild; a process
+    restart adds boot + warm compile + staged restore; a pod restart
+    adds scheduling + image pull + cold everything."""
+
+    LIVE_RESHARD = "live_reshard"
+    PROCESS_RESTART = "process_restart"
+    POD_RESTART = "pod_restart"
+
+
+# event kinds a *surviving* process can absorb by resharding in place:
+# the world changed around it, but its own step loop, devices, and
+# compiled programs are intact
+_SURVIVABLE_KINDS = frozenset({
+    EventKind.SCALE_PLAN_APPLIED,   # planned scale up/down
+    EventKind.WORKER_FAILED,        # a PEER's worker died
+    EventKind.PREEMPT_NOTICE,       # a PEER node is being preempted
+    EventKind.RDZV_JOIN,            # nodes waiting to (re)join
+})
+
+
+def classify_recovery(
+    event_kind: str,
+    self_affected: bool = False,
+    host_healthy: bool = True,
+    world_viable: bool = True,
+) -> str:
+    """Pick the cheapest recovery rung that is actually safe.
+
+    ``event_kind``: the triggering EventKind. ``self_affected``: the
+    failure is on THIS node (own worker death, own preemption notice,
+    own devices wedged) — an in-process reshard cannot help a process
+    that is itself the casualty. ``host_healthy``: the node's
+    host/accelerator diagnosis; False escalates past process restart
+    (a restarted process on a sick host just fails again).
+    ``world_viable``: the post-event world still satisfies min_nodes /
+    node_unit (the master's rendezvous constraints) — without a viable
+    survivor world there is nothing to reshard onto.
+    """
+    if not host_healthy:
+        return RecoveryDecision.POD_RESTART
+    if self_affected:
+        return RecoveryDecision.PROCESS_RESTART
+    if event_kind in _SURVIVABLE_KINDS and world_viable:
+        return RecoveryDecision.LIVE_RESHARD
+    return RecoveryDecision.PROCESS_RESTART
 
 
 class FailoverClient:
@@ -84,9 +135,16 @@ class TrainingFailover:
         on_change: Callable[[], None],
         failover_client: Optional[FailoverClient] = None,
         poll_interval: float = 5.0,
+        on_reshard: Optional[Callable[[], None]] = None,
     ):
         self._client = master_client
         self._on_change = on_change
+        # the live fast path: survivable membership changes (nodes
+        # waiting at the rendezvous while this process is healthy) go
+        # here instead of on_change, so the executor reshards in place.
+        # PS-cluster changes always take on_change — a PS session
+        # rebuild is not an SPMD reshard.
+        self._on_reshard = on_reshard
         self._failover = failover_client
         self._interval = poll_interval
         self._thread: Optional[threading.Thread] = None
@@ -99,10 +157,12 @@ class TrainingFailover:
         )
         self._thread.start()
 
-    def _changed(self) -> bool:
+    def _changed(self) -> str:
+        """What changed: "" = nothing; "ps" = PS cluster (session
+        rebuild); "rdzv" = SPMD membership (reshardable)."""
         # PS strategy: version handshake
         if self._failover is not None and self._failover.ps_cluster_changed():
-            return True
+            return "ps"
         # PS address list drift (reference: address_changed via TF_CONFIG)
         try:
             ps_nodes = self._client.query_ps_nodes()
@@ -111,7 +171,7 @@ class TrainingFailover:
             )
             if self._last_ps_addrs is not None and addrs != self._last_ps_addrs:
                 self._last_ps_addrs = addrs
-                return True
+                return "ps"
             self._last_ps_addrs = addrs
         except Exception as e:  # noqa: BLE001 — master briefly unreachable
             # tolerated (the next poll retries) but never silent: a
@@ -122,20 +182,35 @@ class TrainingFailover:
         # SPMD strategy: nodes waiting at the rendezvous
         try:
             if self._client.num_nodes_waiting() > 0:
-                return True
+                return "rdzv"
         except Exception as e:  # noqa: BLE001 — master briefly unreachable
             logger.warning("num_nodes_waiting failed, skipping rendezvous "
                            "check this poll (%s: %s)", type(e).__name__, e)
-        return False
+        return ""
 
     def _run(self):
         while not self._stopped.wait(self._interval):
             try:
-                if self._changed():
-                    logger.info("membership change detected; firing restart")
+                what = self._changed()
+                if what:
                     if self._failover is not None:
                         self._failover.sync_to_global()
-                    self._on_change()
+                    decision = (
+                        classify_recovery(EventKind.RDZV_JOIN)
+                        if what == "rdzv"
+                        else RecoveryDecision.PROCESS_RESTART
+                    )
+                    if (
+                        decision == RecoveryDecision.LIVE_RESHARD
+                        and self._on_reshard is not None
+                    ):
+                        logger.info("membership change detected; firing "
+                                    "live reshard (survivable)")
+                        self._on_reshard()
+                    else:
+                        logger.info(
+                            "membership change detected; firing restart")
+                        self._on_change()
             except Exception:  # noqa: BLE001
                 logger.exception("failover monitor iteration failed")
 

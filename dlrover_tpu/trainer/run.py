@@ -84,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optimizer steps fused per compiled call "
                         "(lax.scan multi-step; workers see it as "
                         "DLROVER_TPU_STEPS_PER_CALL)")
+    p.add_argument("--live_recovery", "--live-recovery",
+                   dest="live_recovery", action="store_true",
+                   help="absorb survivable membership changes with an "
+                        "in-process snapshot -> reshard -> resume "
+                        "instead of restarting workers; the agent only "
+                        "falls back to a restart after a grace window "
+                        "(docs/operations.md)")
     p.add_argument("--metrics_port", type=int, default=None,
                    help="serve Prometheus /metrics from the agent on "
                         "this port (also DLROVER_TPU_METRICS_PORT; "
@@ -150,9 +157,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         from dlrover_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
-    if argv and argv[0] in ("metrics", "mttr", "events"):
-        # `tpurun metrics [--addr host:port]` / `tpurun mttr ...` —
-        # the observability CLI (docs/observability.md)
+    if argv and argv[0] in ("metrics", "mttr", "events", "cache"):
+        # `tpurun metrics [--addr host:port]` / `tpurun mttr ...` /
+        # `tpurun cache` — the observability CLI (docs/observability.md)
         from dlrover_tpu.telemetry.cli import main as telemetry_main
 
         return telemetry_main(argv)
@@ -168,6 +175,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.environ["DLROVER_TPU_TRAIN_WINDOW"] = str(args.train_window)
     if args.steps_per_call is not None:
         os.environ["DLROVER_TPU_STEPS_PER_CALL"] = str(args.steps_per_call)
+    if args.live_recovery:
+        # workers' executors route survivable changes to the in-process
+        # reshard path (Context.live_recovery reads this at import)
+        os.environ["DLROVER_TPU_LIVE_RECOVERY"] = "1"
     if args.events_file is not None:
         # workers inherit os.environ (worker_group), so the agent's and
         # every worker's lifecycle edges land in ONE timeline file
@@ -206,6 +217,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             network_check=args.network_check,
             probe_platform=args.probe_platform,
             hang_timeout=args.relaunch_on_hang,
+            live_recovery=args.live_recovery,
         )
         spec = WorkerSpec(
             entrypoint=args.entrypoint,

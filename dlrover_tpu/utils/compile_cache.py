@@ -7,55 +7,105 @@ XLA recompilation after the restart (SURVEY §7: the <90 s restore budget
 "forces aggressive compile caching"). Writing compiled executables to a
 persistent on-disk cache makes the second compile of the same (program,
 topology) a file read: a preempted-and-rescheduled worker skips straight
-to restore + step.
+to restore + step — the warm half of the recovery decision tree in
+``docs/operations.md`` (the live half never leaves the process at all,
+``ElasticTrainer.live_reshard``).
 
 Enabled automatically by ``trainer.bootstrap.init_worker`` and
 ``parallel.accelerate``; override the location with
-``DLROVER_COMPILE_CACHE_DIR`` (empty string disables).
+``DLROVER_COMPILE_CACHE_DIR`` (empty string disables). Cache traffic is
+observable: hit/miss counters ride the telemetry registry
+(``jax.monitoring`` listener) and ``tpurun cache`` prints the live
+stats.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Dict, Optional
 
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("utils.compile_cache")
 
 ENV_CACHE_DIR = "DLROVER_COMPILE_CACHE_DIR"
+# the one place the CPU ISA cap is spelled (cap_cpu_isa_for_cache and
+# every harness that builds a child-process XLA_FLAGS from scratch)
+CPU_ISA_CAP_FLAG = "--xla_cpu_max_isa=AVX2"
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "dlrover_tpu", "xla_cache"
 )
 _enabled_dir: Optional[str] = None
-_fingerprint: Optional[str] = None
+# fingerprint memo, keyed by the topology hint it was computed under (a
+# worker that re-rendezvouses at a new world size must not reuse the old
+# topology's fingerprint)
+_fingerprints: Dict[str, str] = {}
+_monitor_registered = False
+# process-local cache traffic, mirrored into the telemetry registry by
+# the monitoring listener; kept here too so cache_stats() works even
+# with telemetry off
+_traffic = {"hits": 0, "misses": 0, "requests": 0}
+
+
+def topology_hint() -> str:
+    """Deterministic description of the topology this process compiles
+    for, WITHOUT initializing a JAX backend (the cache is enabled before
+    the — possibly slow, tunneled — backend comes up).
+
+    Derived from the launch environment: the platform pin, the virtual
+    host-device count, and the distributed process count the agent
+    injects. Two processes whose hints differ can never share AOT
+    artifacts; a jax upgrade changes the fingerprint through the
+    version component, so stale executables are structurally
+    unreachable rather than relied on to key-miss.
+    """
+    parts = [os.environ.get("JAX_PLATFORMS", "")]
+    flags = os.environ.get("XLA_FLAGS", "")
+    for token in flags.split():
+        if "xla_force_host_platform_device_count" in token:
+            parts.append(token.split("=", 1)[-1])
+    # the jax.distributed coordinates the agent hands its workers
+    for env in ("DLROVER_NUM_PROCESSES", "TPU_WORKER_HOSTNAMES"):
+        val = os.environ.get(env, "")
+        if val:
+            parts.append(f"{env}={val}")
+    return "|".join(p for p in parts if p)
 
 
 def machine_fingerprint() -> str:
-    """Host/toolchain fingerprint the cache directory is keyed by.
+    """Host/toolchain/topology fingerprint the cache directory is keyed
+    by.
 
     XLA:CPU AOT executables embed the *compile-time* host machine
     features; loading them on a host with different features logs
     "machine features don't match … could lead to SIGILL" — harmless
     noise at best, a crash hazard at worst. An image-baked or
     NFS-shared cache dir therefore must not be shared verbatim across
-    hosts: every (arch, cpu flags, jaxlib version) combination gets its
-    own subdirectory. Computed WITHOUT initializing a JAX backend — the
-    cache is enabled before the (possibly slow, tunneled) backend comes
-    up, and the executable cache key already separates backends.
+    hosts: every (arch, cpu flags, jax/jaxlib version, topology hint)
+    combination gets its own subdirectory. The jax *and* jaxlib
+    versions are both included so an upgrade of either can never serve
+    a stale artifact, and the topology hint keys same-host processes
+    compiled for different worlds apart. Computed WITHOUT initializing
+    a JAX backend — the cache is enabled before the (possibly slow,
+    tunneled) backend comes up.
     """
-    global _fingerprint
-    if _fingerprint is not None:
-        return _fingerprint
+    hint = topology_hint()
+    cached = _fingerprints.get(hint)
+    if cached is not None:
+        return cached
     import hashlib
     import platform
 
-    parts = [platform.machine(), platform.system()]
+    parts = [platform.machine(), platform.system(), hint]
     try:
+        import jax
         import jaxlib
 
+        parts.append(getattr(jax, "__version__", ""))
         parts.append(getattr(jaxlib, "__version__", ""))
-    except Exception:  # noqa: BLE001 — fingerprint must never fail
+    except Exception as e:  # noqa: BLE001 — fingerprint must never fail
+        logger.warning("jax version unavailable for cache fingerprint "
+                       "(%s: %s)", type(e).__name__, e)
         parts.append("")
     try:
         with open("/proc/cpuinfo") as f:
@@ -66,10 +116,9 @@ def machine_fingerprint() -> str:
                     break
     except OSError:
         pass
-    _fingerprint = hashlib.sha256(
-        "|".join(parts).encode()
-    ).hexdigest()[:12]
-    return _fingerprint
+    fp = hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+    _fingerprints[hint] = fp
+    return fp
 
 
 def cap_cpu_isa_for_cache() -> None:
@@ -86,9 +135,46 @@ def cap_cpu_isa_for_cache() -> None:
     """
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_cpu_max_isa" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_cpu_max_isa=AVX2"
-        ).strip()
+        os.environ["XLA_FLAGS"] = (flags + " " + CPU_ISA_CAP_FLAG).strip()
+
+
+def _register_cache_monitor() -> None:
+    """Mirror jax's compilation-cache monitoring events into the
+    telemetry registry (and the process-local traffic counters), once.
+
+    A warm restart that truly skipped recompilation shows hits > 0 and
+    misses == 0 here — the machine-checkable form of the "zero
+    recompiles on a same-topology resume" recovery claim.
+    """
+    global _monitor_registered
+    if _monitor_registered:
+        return
+    try:
+        from jax import monitoring
+    except Exception as e:  # noqa: BLE001 — observability must not gate
+        logger.warning("jax.monitoring unavailable; compile-cache "
+                       "traffic not exported (%s: %s)",
+                       type(e).__name__, e)
+        return
+    from dlrover_tpu.telemetry import get_registry, names as tm
+
+    def _on_event(event: str, **_kw) -> None:
+        reg = get_registry()
+        if event == "/jax/compilation_cache/cache_hits":
+            _traffic["hits"] += 1
+            reg.counter(tm.COMPILE_CACHE_HITS,
+                        help="persistent-cache compiles served from "
+                             "disk").inc()
+        elif event == "/jax/compilation_cache/cache_misses":
+            _traffic["misses"] += 1
+            reg.counter(tm.COMPILE_CACHE_MISSES,
+                        help="compiles that went to XLA and were "
+                             "written back").inc()
+        elif event == "/jax/compilation_cache/compile_requests_use_cache":
+            _traffic["requests"] += 1
+
+    monitoring.register_event_listener(_on_event)
+    _monitor_registered = True
 
 
 def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
@@ -115,6 +201,7 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     cache_dir = os.path.join(
         os.path.abspath(cache_dir), f"host-{machine_fingerprint()}"
     )
+    _register_cache_monitor()
     if _enabled_dir == cache_dir:
         return _enabled_dir
 
@@ -131,27 +218,62 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     return cache_dir
 
 
+def _resolve_host_dir(cache_dir: Optional[str]) -> Optional[str]:
+    """The fingerprinted per-host directory for ``cache_dir`` (the
+    un-fingerprinted root), the active dir, or the env/default root."""
+    if cache_dir is not None:
+        return os.path.join(
+            os.path.abspath(cache_dir), f"host-{machine_fingerprint()}"
+        )
+    if _enabled_dir:
+        return _enabled_dir
+    root = os.environ.get(ENV_CACHE_DIR, _DEFAULT_DIR)
+    if not root:  # empty env value = caching disabled
+        return None
+    return os.path.join(
+        os.path.abspath(root), f"host-{machine_fingerprint()}"
+    )
+
+
 def cache_entries(cache_dir: Optional[str] = None) -> int:
     """Number of cached executables on disk for THIS host's
     fingerprinted subdirectory (0 if the dir is absent). ``cache_dir``
     is the un-fingerprinted root, as passed to
     ``enable_compile_cache``."""
-    if cache_dir is not None:
-        d = os.path.join(
-            os.path.abspath(cache_dir), f"host-{machine_fingerprint()}"
-        )
-    elif _enabled_dir:
-        d = _enabled_dir
-    else:
-        root = os.environ.get(ENV_CACHE_DIR, _DEFAULT_DIR)
-        if not root:  # empty env value = caching disabled
-            return 0
-        d = os.path.join(
-            os.path.abspath(root), f"host-{machine_fingerprint()}"
-        )
-    if not os.path.isdir(d):
+    d = _resolve_host_dir(cache_dir)
+    if not d or not os.path.isdir(d):
         return 0
     return sum(
         1 for name in os.listdir(d)
         if os.path.isfile(os.path.join(d, name))
     )
+
+
+def cache_stats(cache_dir: Optional[str] = None) -> Dict:
+    """One snapshot for operators (``tpurun cache``): where the cache
+    lives, how many executables it holds, and this process's traffic.
+    Also refreshes the entry-count gauge in the telemetry registry."""
+    from dlrover_tpu.telemetry import get_registry, names as tm
+
+    entries = cache_entries(cache_dir)
+    get_registry().gauge(
+        tm.COMPILE_CACHE_ENTRIES,
+        help="executables in this host's persistent compile cache",
+    ).set(entries)
+    return {
+        "dir": _resolve_host_dir(cache_dir),
+        # configured: a cache root resolves (explicit, env, or default)
+        # — an empty DLROVER_COMPILE_CACHE_DIR is the only way off.
+        # active: enable_compile_cache() ran in THIS process — the
+        # difference matters when debugging "why did the warm restart
+        # recompile": configured-but-not-active means nothing ever
+        # pointed jax at the cache here.
+        "configured": _resolve_host_dir(cache_dir) is not None,
+        "active": _enabled_dir is not None,
+        "entries": entries,
+        "fingerprint": machine_fingerprint(),
+        "topology_hint": topology_hint(),
+        "hits": _traffic["hits"],
+        "misses": _traffic["misses"],
+        "requests": _traffic["requests"],
+    }

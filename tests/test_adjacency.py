@@ -1,0 +1,45 @@
+"""Regression gate for the checkpoint+executor same-process adjacency
+hang.
+
+Running ``tests/test_checkpoint_trainer.py`` and ``tests/test_executor.py``
+in ONE pytest process used to wedge (or segfault) inside the first
+donated train-step dispatch after an Orbax restore: on the CPU backend,
+restored ``jax.Array``s could alias tensorstore-owned host buffers, and
+``donate_argnums`` handed those buffers to XLA for reuse — a
+use-after-donate that surfaced only once another Orbax manager had
+touched the process's allocator state. Fixed by re-materializing every
+restored state into XLA-owned buffers (``checkpoint.manager
+._rematerialize``); this test pins EXACTLY the failing combination so
+the hang cannot quietly return.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_checkpoint_and_executor_files_share_one_process():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["PYTHONFAULTHANDLER"] = "1"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_checkpoint_trainer.py",
+            "tests/test_executor.py",
+            "-q", "-p", "no:cacheprovider", "-p", "no:randomly",
+            "-m", "not slow",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        # generous vs the ~13 s healthy runtime, far below the historic
+        # infinite hang; a timeout here IS the regression signal
+        timeout=300,
+    )
+    tail = (proc.stdout + proc.stderr)[-3000:]
+    assert proc.returncode == 0, (
+        f"two-file adjacency run failed (rc={proc.returncode}) — the "
+        f"restore/donation hang may be back:\n{tail}"
+    )
+    assert " passed" in proc.stdout, tail

@@ -1,14 +1,14 @@
-"""Fault-recovery (MTTR) e2e: kill a training worker, restart, measure.
+"""Fault-recovery e2e: the CPU-mesh recovery wedge.
 
-The BASELINE.json target is <90 s restore after an injected host
-preemption (reference rationale: ``docs/blogs/
-stabilize_llm_training_cn.md:209-216`` — process restart beats job
-restart). The bench driver (``bench.py --mode recovery``) SIGKILLs a
-checkpointing worker and times kill → first completed post-restore step;
-this test runs it end-to-end on CPU and asserts both correctness (the
-restart resumed from a committed Orbax step, not from scratch) and the
-bound. The persistent XLA compile cache is what keeps the warm boot
-fast; the test asserts it actually collapsed the restart compile time.
+``bench.py --mode recovery`` with BENCH_PLATFORM=cpu runs the three-way
+wedge from docs/operations.md: in-process live reshard vs warm
+(compile-cached) process restart vs cold process restart, on the same
+tiny model (ISSUE 5 acceptance). This test runs it end-to-end and
+asserts the wedge's own gates: live reshard >= 3x faster than a warm
+restart (paired median), zero persistent-cache misses on the warm
+same-topology restart legs, and post-reshard params bit-identical to
+the drained snapshot. On real accelerators the same mode keeps the
+kill-and-restore MTTR measurement against the BASELINE <90 s target.
 """
 
 import json
@@ -22,17 +22,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
-def test_kill_and_restore_within_budget(tmp_path):
+def test_recovery_wedge_live_vs_restart(tmp_path):
     env = dict(os.environ)
     env.update(
         BENCH_PLATFORM="cpu",
-        BENCH_PRESET="tiny",
-        BENCH_STEPS="500",  # plenty; the driver kills long before this
-        BENCH_SAVE_EVERY="5",
+        BENCH_WEDGE_PAIRS="3",
         BENCH_RECOVERY_DIR=str(tmp_path),
         BENCH_RECOVERY_TIMEOUT="240",
+        BENCH_WEDGE_ARTIFACT=str(tmp_path / "BENCH_r07.json"),
+        BENCH_WEDGE_MTTR=str(tmp_path / "MTTR_r02.json"),
         JAX_PLATFORMS="cpu",
     )
+    # the wedge pins its own XLA_FLAGS (8-device live mesh, 1-device
+    # restart legs); a pytest-inherited 8-device flag is fine
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--mode",
          "recovery"],
@@ -41,27 +43,26 @@ def test_kill_and_restore_within_budget(tmp_path):
     lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
     assert lines, f"no bench output; stderr tail: {proc.stderr[-2000:]}"
     rec = json.loads(lines[-1])
-    assert rec["metric"] == "recovery_mttr_s"
+    assert rec["metric"] == "live_reshard_speedup"
     assert "error" not in rec, rec
 
     detail = rec["detail"]
-    # correctness: resumed from a committed checkpoint, stepped past it
-    assert detail["restored_from_step"] >= 5
-    assert detail["first_post_restore_step"] == (
-        detail["restored_from_step"] + 1
-    )
-    assert detail["loss_after_restore"] == pytest.approx(
-        detail["loss_after_restore"]
-    )  # finite
-
-    # the target bound (generous on a 1-core CPU; ~6 s typical)
-    assert rec["value"] < 90.0, rec
-
-    # the compile cache must have made the warm boot faster than cold
-    assert detail["warm_boot_to_first_step_s"] < (
-        detail["cold_boot_to_first_step_s"]
+    # the acceptance wedge: live reshard >= 3x a warm process restart
+    assert rec["value"] >= 3.0, rec
+    # zero recompiles on every warm same-topology restart leg
+    assert detail["warm_zero_recompiles"] is True, detail
+    assert all(m == 0 for m in detail["warm_cache_misses"]), detail
+    # correctness: the resharded params ARE the drained snapshot
+    assert detail["params_bit_identical"] is True, detail
+    # every restart leg resumed from a committed checkpoint
+    assert all(s >= 5 for s in detail["restored_from"]), detail
+    # the warm compile cache also pays off for plain restarts
+    assert detail["cold_restart_mttr_s"] > min(
+        detail["warm_restart_mttr_s"]
     ), detail
 
-    # the cache is populated on disk
-    cache = tmp_path / "xla_cache"
-    assert cache.is_dir() and any(cache.iterdir())
+    # artifacts: the wedge line and the DERIVED live_reshard MTTR report
+    wedge = json.loads((tmp_path / "BENCH_r07.json").read_text())
+    assert wedge["metric"] == "live_reshard_speedup"
+    mttr = json.loads((tmp_path / "MTTR_r02.json").read_text())
+    assert mttr["detail"]["by_scenario"]["live_reshard"]["count"] >= 1
