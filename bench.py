@@ -1445,7 +1445,10 @@ def _write_wedge_artifacts(result_line: dict):
     """BENCH_r07.json: the wedge line. MTTR_r02.json: the DERIVED MTTR
     report (telemetry.mttr) over this process's event ring — the
     live_reshard incidents the wedge just generated, attributed by the
-    same pairing the production timeline uses."""
+    same pairing the production timeline uses. GOODPUT_r01.json: the
+    derived goodput/badput ledger over the same ring (telemetry.goodput
+    — productive / reshard / checkpoint / compile / idle buckets
+    partitioning the wedge's wall clock)."""
     here = os.path.dirname(os.path.abspath(__file__))
     artifact = os.environ.get(
         "BENCH_WEDGE_ARTIFACT", os.path.join(here, "BENCH_r07.json"))
@@ -1453,6 +1456,7 @@ def _write_wedge_artifacts(result_line: dict):
         with open(artifact, "w") as f:
             f.write(json.dumps(result_line) + "\n")
     from dlrover_tpu.telemetry.events import recent_events
+    from dlrover_tpu.telemetry.goodput import derive_goodput
     from dlrover_tpu.telemetry.mttr import mttr_report
 
     report = mttr_report(recent_events(), target_s=MTTR_TARGET_S)
@@ -1461,6 +1465,12 @@ def _write_wedge_artifacts(result_line: dict):
     if mttr_path:
         with open(mttr_path, "w") as f:
             f.write(json.dumps(report) + "\n")
+    ledger = derive_goodput(recent_events())
+    goodput_path = os.environ.get(
+        "BENCH_WEDGE_GOODPUT", os.path.join(here, "GOODPUT_r01.json"))
+    if goodput_path:
+        with open(goodput_path, "w") as f:
+            f.write(json.dumps(ledger) + "\n")
 
 
 def recovery_main() -> int:

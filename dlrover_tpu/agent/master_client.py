@@ -191,11 +191,31 @@ class MasterClient:
         ))
 
     def report_global_step(self, step: int,
-                           elapsed_per_step: float = 0.0) -> comm.Response:
+                           elapsed_per_step: float = 0.0,
+                           reset: bool = False) -> comm.Response:
         return self._channel.report(comm.GlobalStep(
             step=step, timestamp=time.time(),
-            elapsed_time_per_step=elapsed_per_step,
+            elapsed_time_per_step=elapsed_per_step, reset=reset,
         ))
+
+    def report_node_runtime(self, **kwargs) -> comm.Response:
+        """Push a node-tagged runtime snapshot (the cluster diagnosis
+        plane's input; see NodeRuntimeReportHook in trainer/executor)."""
+        kwargs.setdefault("node_id", self.node_id)
+        kwargs.setdefault("node_type", self.node_type)
+        kwargs.setdefault("timestamp", time.time())
+        return self._channel.report(comm.NodeRuntimeReport(**kwargs))
+
+    def get_diagnosis(self, node_id: int = -1) -> dict:
+        """The master's cluster diagnosis: per-node latest samples plus
+        straggler/hang verdicts (``tpurun diagnose --addr`` view)."""
+        import json
+
+        resp = self._channel.get(comm.DiagnosisRequest(node_id=node_id))
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
 
     def report_heartbeat(self) -> comm.Response:
         return self._channel.report(comm.NodeHeartbeat(

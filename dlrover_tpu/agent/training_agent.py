@@ -47,6 +47,12 @@ from dlrover_tpu.telemetry import (
     get_registry,
     names as tm,
 )
+from dlrover_tpu.telemetry.trace_context import (
+    TRACE_ID_ENV,
+    clear_trace_id,
+    new_trace_id,
+    set_trace_id,
+)
 
 logger = get_logger("agent.training")
 
@@ -113,6 +119,12 @@ class ElasticTrainingAgent:
         self._remaining_restarts = config.max_restarts
         self._host_ip = host_ip
         self.last_rdzv: Optional[RendezvousInfo] = None
+        # the open incident's trace id (minted at failure detection;
+        # closed when the recovery edge lands): ambient for every event
+        # this agent emits, attached to master RPCs as metadata, and
+        # handed to relaunched workers via their environment so the
+        # whole recovery round correlates to ONE incident
+        self._incident_trace: Optional[str] = None
         # deadline for a delegated in-process reshard to absorb the
         # current membership change; None = nothing delegated
         self._reshard_deadline: Optional[float] = None
@@ -144,12 +156,34 @@ class ElasticTrainingAgent:
             if self._owned_hb_dir:
                 shutil.rmtree(self._owned_hb_dir, ignore_errors=True)
 
+    def _open_incident(self):
+        """Mint the incident trace id at FAILURE DETECTION (once per
+        incident — a burst of failures is one incident, like the MTTR
+        pairing): every later event in this thread, every master RPC's
+        ingress events, and the relaunched workers' startup all carry
+        it."""
+        if self._incident_trace is None:
+            self._incident_trace = new_trace_id()
+            set_trace_id(self._incident_trace)
+
+    def _close_incident(self):
+        if self._incident_trace is not None:
+            self._incident_trace = None
+            clear_trace_id()
+
     def _initialize_workers(self):
         rdzv = self._rdzv_handler.next_rendezvous()
         self.last_rdzv = rdzv
         self._rdzv_handler.release_coordinator_port()
+        # workers relaunched as part of an incident inherit its trace
+        # id: their startup events land in the same correlated view
+        extra_env = (
+            {TRACE_ID_ENV: self._incident_trace}
+            if self._incident_trace else None
+        )
         self._worker_group.start(
-            rdzv, self._client.addr, self._config.node_id
+            rdzv, self._client.addr, self._config.node_id,
+            extra_env=extra_env,
         )
         # the MTTR recovery edge: for every failure-class event before
         # it (worker death, hang), this marks workers running again
@@ -157,6 +191,9 @@ class ElasticTrainingAgent:
                    round=rdzv.round,
                    restart_round=self._worker_group.restart_round,
                    world_size=rdzv.group_world_size)
+        # the recovery edge closes the incident: later events (and the
+        # NEXT incident) must not inherit this id
+        self._close_incident()
 
     def _restart_workers(self):
         logger.info("restarting workers into a new rendezvous round")
@@ -231,6 +268,7 @@ class ElasticTrainingAgent:
             "no worker heartbeat for %.1f s (timeout %.1f s): treating "
             "as hang", gap, self._config.hang_timeout,
         )
+        self._open_incident()
         self._c_hangs.inc()
         emit_event(EventKind.HANG_DETECTED, error_code="HANG",
                    gap_seconds=round(gap, 1),
@@ -300,6 +338,7 @@ class ElasticTrainingAgent:
             return False
 
     def _report_failure(self):
+        self._open_incident()
         for failure in self._worker_group.failures():
             logger.error(
                 "worker local_rank=%d exited with code %d",

@@ -98,8 +98,11 @@ class WorkerGroup:
                     latest = max(latest, min(deadline, now))
         return latest, beaten
 
-    def start(self, rdzv: RendezvousInfo, master_addr: str, node_id: int):
-        """Spawn ``nproc_per_node`` processes with SPMD coordinates."""
+    def start(self, rdzv: RendezvousInfo, master_addr: str, node_id: int,
+              extra_env=None):
+        """Spawn ``nproc_per_node`` processes with SPMD coordinates.
+        ``extra_env``: per-round additions (e.g. the open incident's
+        trace id) layered over the spec's static env."""
         if self.spec.nproc_per_node < 1:
             raise ValueError(
                 f"nproc_per_node must be >= 1, got {self.spec.nproc_per_node}"
@@ -113,6 +116,8 @@ class WorkerGroup:
         for local_rank in range(self.spec.nproc_per_node):
             env = dict(os.environ)
             env.update(self.spec.env)
+            if extra_env:
+                env.update(extra_env)
             if self.spec.heartbeat_dir:
                 env[NodeEnv.HEARTBEAT_DIR] = self.spec.heartbeat_dir
             env.update({

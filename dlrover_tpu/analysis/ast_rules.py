@@ -15,6 +15,8 @@ source of silent hangs and mystery slowdowns at scale:
   DLR007 unregistered-metric-name  a string literal handed to a
                                telemetry API instead of a
                                telemetry.names constant
+  DLR008 failure-event-no-code a failure-class event emitted without a
+                               machine-readable error_code
 
 Rules are deliberately syntactic (no type inference): they over-approximate
 in ways the checked-in baseline absorbs, and under-approximate in ways unit
@@ -56,6 +58,22 @@ SYNC_ARRAY_CALLS = {"asarray", "array", "device_get"}
 # is exempt: it is where names are allowed to be literal.
 TELEMETRY_NAME_CALLS = {"counter", "gauge", "histogram", "emit_event"}
 TELEMETRY_PKG_FRAGMENT = "telemetry/"
+# DLR008: EventKind constants that mark a FAILURE edge. A failure
+# record without a stable error_code cannot be classified by the MTTR /
+# goodput derivations or rate-limited by the error monitor — operators
+# get an incident with no machine-readable cause. The attribute names
+# below (and their string values, for sites that inline the literal)
+# must carry a non-empty error_code at every emit site.
+FAILURE_EVENT_ATTRS = {
+    "NONFINITE_STEP", "WORKER_FAILED", "HANG_DETECTED",
+    "PREEMPT_NOTICE", "RDZV_TIMEOUT", "CKPT_MIRROR_TIMEOUT",
+    "ERROR_REPORT", "DIAG_STRAGGLER", "DIAG_NODE_HANG",
+}
+FAILURE_EVENT_VALUES = {
+    "nonfinite_step", "worker_failed", "hang_detected",
+    "preempt_notice", "rdzv_timeout", "ckpt_mirror_timeout",
+    "error_report", "diag_straggler", "diag_node_hang",
+}
 
 
 def _dotted(node: ast.AST) -> str:
@@ -176,6 +194,7 @@ class _Linter(ast.NodeVisitor):
             self._check_impure_in_jit(node)
         self._check_host_sync_on_metrics(node)
         self._check_telemetry_name_literal(node)
+        self._check_failure_event_code(node)
         if (isinstance(node.func, ast.Attribute)
                 and node.func.attr == "Thread"):
             self._check_thread_daemon(node)
@@ -361,6 +380,63 @@ class _Linter(ast.NodeVisitor):
                 "pass it instead of the literal",
             )
 
+    # -- DLR008: failure-class events without an error code -----------------
+
+    def _check_failure_event_code(self, node: ast.Call):
+        """``emit_event(EventKind.<failure-kind>, ...)`` must carry a
+        non-empty ``error_code``: failure edges without a stable machine
+        code cannot be classified by the derived MTTR/goodput reports or
+        deduped by the error monitor. A dynamic expression passes (the
+        code is computed); only a MISSING kwarg or a constant empty
+        string fires. Unlike DLR007, the telemetry package is NOT
+        exempt — its own emits must carry codes too."""
+        name = _dotted(node.func)
+        short = name.rsplit(".", 1)[-1] if name else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        )
+        if short != "emit_event":
+            return
+        kind_arg: Optional[ast.AST] = None
+        if node.args:
+            kind_arg = node.args[0]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "kind":
+                    kind_arg = kw.value
+                    break
+        is_failure = False
+        kind_label = ""
+        if isinstance(kind_arg, ast.Attribute):
+            is_failure = kind_arg.attr in FAILURE_EVENT_ATTRS
+            kind_label = kind_arg.attr
+        elif isinstance(kind_arg, ast.Constant) and isinstance(
+                kind_arg.value, str):
+            is_failure = kind_arg.value in FAILURE_EVENT_VALUES
+            kind_label = kind_arg.value
+        if not is_failure:
+            return
+        code_kw = next(
+            (kw for kw in node.keywords if kw.arg == "error_code"), None
+        )
+        if code_kw is None and any(
+                kw.arg is None for kw in node.keywords):
+            return  # **kwargs may carry it — over-approximation cut
+        empty_literal = (
+            code_kw is not None
+            and isinstance(code_kw.value, ast.Constant)
+            and code_kw.value.value in ("", None)
+        )
+        if code_kw is None or empty_literal:
+            self._emit(
+                "DLR008", node,
+                f"failure-class event `{kind_label}` emitted without a "
+                f"non-empty error_code: the incident cannot be "
+                f"classified by the MTTR/goodput derivations or deduped "
+                f"by the error monitor",
+                "pass error_code=<stable machine code> (e.g. \"HANG\", "
+                "\"EXIT_<n>\", \"NONFINITE\") on the failure edge",
+            )
+
     # -- DLR005: shared mutable defaults ------------------------------------
 
     def _check_mutable_defaults(self, node):
@@ -398,7 +474,7 @@ class _Linter(ast.NodeVisitor):
 
 
 ALL_AST_RULES = ("DLR001", "DLR002", "DLR003", "DLR004", "DLR005",
-                 "DLR006", "DLR007")
+                 "DLR006", "DLR007", "DLR008")
 
 RULE_DOCS: Dict[str, str] = {
     "DLR001": "gRPC invocation without a timeout= deadline",
@@ -411,6 +487,9 @@ RULE_DOCS: Dict[str, str] = {
               "hot loop",
     "DLR007": "string-literal metric/event name at a telemetry call "
               "site (must be a dlrover_tpu.telemetry.names constant)",
+    "DLR008": "failure-class event emitted without a non-empty "
+              "error_code (unclassifiable by the MTTR/goodput "
+              "derivations)",
 }
 
 

@@ -276,6 +276,48 @@ class GlobalStep:
     step: int = 0
     timestamp: float = 0.0
     elapsed_time_per_step: float = 0.0
+    # True when the reported step REWINDS the truth (non-finite
+    # rollback, live reshard resuming from a snapshot): the master's
+    # monotone max() gauge and speed window must reset, not ignore it
+    reset: bool = False
+
+
+@message
+class NodeRuntimeReport:
+    """Node-tagged snapshot of the worker's runtime instruments
+    (cumulative histogram bucket counts — the master diffs consecutive
+    reports into per-window series; see master/monitor/node_series.py).
+    """
+
+    node_id: int = -1
+    node_type: str = "worker"
+    timestamp: float = 0.0
+    step: int = 0
+    steps_total: float = 0.0
+    # shared bucket bounds (+Inf bucket is the extra last count)
+    bounds: Optional[List[float]] = None
+    step_time_counts: Optional[List[int]] = None
+    dispatch_counts: Optional[List[int]] = None
+    host_sync_counts: Optional[List[int]] = None
+    window_occupancy: float = 0.0
+    lagged_age: float = 0.0
+    rss_mb: float = 0.0
+    device_mem_mb: float = 0.0
+
+
+@message
+class DiagnosisRequest:
+    """Query the master's cluster diagnosis: node series summaries plus
+    straggler/hang verdicts (node_id -1 = whole cluster)."""
+
+    node_id: int = -1
+
+
+@message
+class DiagnosisReport:
+    # JSON blob (nodes, verdicts, stragglers, hung) — the diagnosis
+    # schema is owned by master/monitor, not the wire layer
+    report_json: str = ""
 
 
 @message

@@ -98,6 +98,23 @@ class Context:
         self.telemetry_events_file = ""
         # Prometheus exposition port on the agent/master (0 = off)
         self.telemetry_metrics_port = 0
+        # event-timeline rotation cap in MB (0 = never rotate): past
+        # this size the file rotates to <path>.1 and a fresh file opens;
+        # read_events / mttr / goodput read the rotated pair
+        self.telemetry_events_max_mb = 64
+        # cluster diagnosis plane (master-side, docs/observability.md):
+        # cadence of the workers' NodeRuntimeReport pushes (optimizer
+        # steps between reports; 0 disables the hook)
+        self.runtime_report_steps = 32
+        # straggler verdict: a node is flagged when its windowed
+        # step-time p50 exceeds the median of its peers by this ratio...
+        self.diagnosis_straggler_ratio = 2.0
+        # ...for this many CONSECUTIVE report windows (rides out the
+        # one-off box-noise spikes a single window would flag)
+        self.diagnosis_confirm_windows = 3
+        # a node whose last runtime report is older than this while a
+        # peer is still reporting is diagnosed hung (0 = off)
+        self.diagnosis_hang_secs = 120.0
         # signal name ("" = off, e.g. "USR2") that opens an on-demand
         # bounded jax.profiler trace window in the executor
         self.profile_signal = ""

@@ -93,6 +93,9 @@ class LocalJobMaster:
                 self.metric_collector.collect_runtime_stats(
                     self.speed_monitor, {}
                 )
+                # a hung node stops reporting, so the hang judgement
+                # must run on a clock, not only on report ingest
+                self.servicer.straggler_detector.scan_hangs()
             except Exception:  # noqa: BLE001 — stats must not kill serving
                 logger.exception("runtime stats collection failed")
 

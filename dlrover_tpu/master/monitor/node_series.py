@@ -1,0 +1,227 @@
+"""Per-node runtime time series on the master.
+
+The cluster-blindness fix: workers push node-tagged
+``comm.NodeRuntimeReport`` snapshots of their PR 4 instruments
+(cumulative step-time / dispatch / host-sync histogram counts, window
+occupancy, RSS, device memory) through the ordinary report RPC; this
+store diffs consecutive cumulative snapshots into per-window samples,
+keeps a bounded series per node, and mirrors the latest sample into
+labeled registry gauges so the master's ``/metrics`` exporter serves a
+``{node="<id>"}`` series for every reporting node.
+
+The straggler/hang detector (``straggler.py``) reads these series; the
+``tpurun diagnose`` CLI and the ``DiagnosisRequest`` RPC read the
+summaries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Deque, Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import get_registry, names as tm
+from dlrover_tpu.telemetry.metrics import percentile_from_counts
+
+logger = get_logger("master.node_series")
+
+
+@dataclass
+class NodeSample:
+    """One windowed sample for one node (the diff of two consecutive
+    cumulative reports; the first report of a node is its own window).
+    ``overflow`` marks a +Inf-bucket clamped p95 — the value is a LOWER
+    bound, and verdicts must not treat it as a measurement."""
+
+    ts: float
+    step: int
+    steps_total: float
+    window_steps: float  # steps covered by THIS window
+    step_p50: Optional[float] = None
+    step_p95: Optional[float] = None
+    dispatch_p50: Optional[float] = None
+    host_sync_p50: Optional[float] = None
+    window_occupancy: float = 0.0
+    lagged_age: float = 0.0
+    rss_mb: float = 0.0
+    device_mem_mb: float = 0.0
+    overflow: bool = False
+
+
+@dataclass
+class _NodeState:
+    samples: Deque[NodeSample] = field(default_factory=deque)
+    # previous CUMULATIVE counts per instrument, for the window diff
+    prev_counts: Dict[str, List[int]] = field(default_factory=dict)
+    prev_steps_total: float = 0.0
+    node_type: str = "worker"
+
+
+def _window_counts(prev: Optional[List[int]],
+                   cur: Optional[List[int]]) -> Optional[List[int]]:
+    if cur is None:
+        return None
+    if prev is None or len(prev) != len(cur):
+        return list(cur)
+    window = [c - p for c, p in zip(cur, prev)]
+    if any(w < 0 for w in window):
+        # the worker restarted (counters reset): its fresh cumulative
+        # counts ARE the window
+        return list(cur)
+    return window
+
+
+class NodeRuntimeStore:
+    """Bounded per-node runtime series, fed by the servicer."""
+
+    def __init__(self, max_samples: int = 256):
+        self._lock = threading.Lock()
+        self._max_samples = max_samples
+        self._nodes: Dict[int, _NodeState] = {}
+        reg = get_registry()
+        self._c_ingested = reg.counter(
+            tm.NODE_REPORTS_INGESTED,
+            help="NodeRuntimeReport snapshots ingested by the master")
+
+    def ingest(self, report, now: Optional[float] = None) -> NodeSample:
+        """Diff a cumulative report into a windowed NodeSample, append
+        it to the node's series, and refresh the labeled gauges.
+
+        Samples are stamped with the MASTER's receive clock (``now``),
+        not the worker's ``report.timestamp``: report ages drive the
+        hang diagnosis and peer-freshness cuts, and a worker whose wall
+        clock is skewed by minutes would otherwise forge (or mask) a
+        DIAG_NODE_HANG on its very first report."""
+        self._c_ingested.inc()
+        ts = float(now if now is not None else time.time())
+        bounds = list(report.bounds or [])
+        with self._lock:
+            state = self._nodes.setdefault(int(report.node_id),
+                                           _NodeState())
+            state.node_type = report.node_type or state.node_type
+            windows = {}
+            for key, cur in (
+                ("step_time", report.step_time_counts),
+                ("dispatch", report.dispatch_counts),
+                ("host_sync", report.host_sync_counts),
+            ):
+                windows[key] = _window_counts(state.prev_counts.get(key),
+                                              cur)
+                if cur is not None:
+                    state.prev_counts[key] = list(cur)
+            window_steps = float(report.steps_total) - state.prev_steps_total
+            if window_steps < 0:  # worker restart
+                window_steps = float(report.steps_total)
+            state.prev_steps_total = float(report.steps_total)
+
+            def pct(key: str, q: float):
+                counts = windows.get(key)
+                if not bounds or counts is None:
+                    return None, False
+                return percentile_from_counts(bounds, counts, q,
+                                              with_overflow=True)
+
+            p50, of50 = pct("step_time", 0.50)
+            p95, of95 = pct("step_time", 0.95)
+            d50, _ = pct("dispatch", 0.50)
+            s50, _ = pct("host_sync", 0.50)
+            sample = NodeSample(
+                ts=ts,
+                step=int(report.step),
+                steps_total=float(report.steps_total),
+                window_steps=window_steps,
+                step_p50=p50,
+                step_p95=p95,
+                dispatch_p50=d50,
+                host_sync_p50=s50,
+                window_occupancy=float(report.window_occupancy),
+                lagged_age=float(report.lagged_age),
+                rss_mb=float(report.rss_mb),
+                device_mem_mb=float(report.device_mem_mb),
+                overflow=bool(of50 or of95),
+            )
+            state.samples.append(sample)
+            while len(state.samples) > self._max_samples:
+                state.samples.popleft()
+        self._export_gauges(int(report.node_id), sample)
+        return sample
+
+    def _export_gauges(self, node_id: int, s: NodeSample) -> None:
+        reg = get_registry()
+        labels = {"node": str(node_id)}
+        if s.step_p50 is not None:
+            reg.gauge(tm.NODE_STEP_P50, labels=labels,
+                      help="per-node windowed step-time p50").set(s.step_p50)
+        if s.step_p95 is not None:
+            reg.gauge(tm.NODE_STEP_P95, labels=labels,
+                      help="per-node windowed step-time p95").set(s.step_p95)
+        if s.dispatch_p50 is not None:
+            reg.gauge(tm.NODE_DISPATCH_P50, labels=labels,
+                      help="per-node windowed dispatch p50").set(
+                          s.dispatch_p50)
+        if s.host_sync_p50 is not None:
+            reg.gauge(tm.NODE_HOST_SYNC_P50, labels=labels,
+                      help="per-node windowed host-sync p50").set(
+                          s.host_sync_p50)
+        reg.gauge(tm.NODE_WINDOW_OCCUPANCY, labels=labels,
+                  help="per-node dispatch-window occupancy").set(
+                      s.window_occupancy)
+        reg.gauge(tm.NODE_RSS_MB, labels=labels,
+                  help="per-node worker process RSS (MB)").set(s.rss_mb)
+        reg.gauge(tm.NODE_DEVICE_MEM_MB, labels=labels,
+                  help="per-node accelerator bytes_in_use (MB)").set(
+                      s.device_mem_mb)
+        reg.gauge(tm.NODE_STEPS_TOTAL, labels=labels,
+                  help="per-node optimizer steps materialized").set(
+                      s.steps_total)
+
+    # -- queries -------------------------------------------------------------
+
+    def node_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def forget(self, node_id: int) -> None:
+        """Drop a departed node's series (the detector's cleanup; a
+        returning node re-primes from its first fresh report)."""
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def latest(self, node_id: int) -> Optional[NodeSample]:
+        with self._lock:
+            state = self._nodes.get(node_id)
+            if state is None or not state.samples:
+                return None
+            return state.samples[-1]
+
+    def series(self, node_id: int, n: int = 0) -> List[NodeSample]:
+        with self._lock:
+            state = self._nodes.get(node_id)
+            if state is None:
+                return []
+            out = list(state.samples)
+        return out[-n:] if n else out
+
+    def last_report_age(self, node_id: int,
+                        now: Optional[float] = None) -> Optional[float]:
+        latest = self.latest(node_id)
+        if latest is None:
+            return None
+        return max(0.0, (now or time.time()) - latest.ts)
+
+    def summary(self, now: Optional[float] = None) -> Dict[int, Dict]:
+        """Per-node latest-sample dicts (the diagnose CLI / RPC view)."""
+        now = now or time.time()
+        out: Dict[int, Dict] = {}
+        for node_id in self.node_ids():
+            latest = self.latest(node_id)
+            if latest is None:
+                continue
+            d = asdict(latest)
+            d["report_age_s"] = round(now - latest.ts, 3)
+            d["samples"] = len(self.series(node_id))
+            out[node_id] = d
+        return out

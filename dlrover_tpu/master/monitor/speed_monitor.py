@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Deque, List, Optional, Set, Tuple
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.telemetry import get_registry, names as tm
@@ -41,10 +41,17 @@ class SpeedMonitor:
         self._running_workers: Set[int] = set()
         self._worker_adjust_time = time.time()
         self._max_worker_num = 0
+        # per-node diagnosis verdicts pushed by the straggler detector
+        # (node_id -> "healthy" | "straggler" | "hung"); the auto-scaler
+        # reads these before judging speed
+        self._node_verdicts: Dict[int, str] = {}
 
     # -- step reports -------------------------------------------------------
 
     def collect_global_step(self, step: int, timestamp: Optional[float] = None):
+        # gauge updates stay INSIDE the lock: a second reporter racing
+        # this method could otherwise publish a stale speed over a newer
+        # one (the old code computed running_speed() after release)
         with self._lock:
             if self._start_training_time is None:
                 self._start_training_time = time.time()
@@ -53,7 +60,21 @@ class SpeedMonitor:
             self._global_step_records.append((ts, step))
             self._sample_count += 1
             self._g_step.set(self._global_step)
-        self._g_speed.set(self.running_speed())
+            self._g_speed.set(self._running_speed_locked())
+
+    def reset_step(self, step: int, timestamp: Optional[float] = None):
+        """The truth REWOUND (non-finite rollback restored an older
+        checkpoint, or a live reshard resumed from a snapshot): the
+        monotone ``max()`` would keep the gauge and speed series
+        stale-high forever. Reset to the reported step and restart the
+        speed window from here."""
+        with self._lock:
+            ts = timestamp or time.time()
+            self._global_step = int(step)
+            self._global_step_records.clear()
+            self._global_step_records.append((ts, int(step)))
+            self._g_step.set(self._global_step)
+            self._g_speed.set(0.0)
 
     def mark_task_completed(self, record_count: int):
         with self._lock:
@@ -70,13 +91,16 @@ class SpeedMonitor:
     def running_speed(self) -> float:
         """steps/s over the recorded window (0 if not enough samples)."""
         with self._lock:
-            if len(self._global_step_records) < 2:
-                return 0.0
-            (t0, s0) = self._global_step_records[0]
-            (t1, s1) = self._global_step_records[-1]
-            if t1 <= t0:
-                return 0.0
-            return (s1 - s0) / (t1 - t0)
+            return self._running_speed_locked()
+
+    def _running_speed_locked(self) -> float:
+        if len(self._global_step_records) < 2:
+            return 0.0
+        (t0, s0) = self._global_step_records[0]
+        (t1, s1) = self._global_step_records[-1]
+        if t1 <= t0:
+            return 0.0
+        return (s1 - s0) / (t1 - t0)
 
     # -- worker membership --------------------------------------------------
 
@@ -109,3 +133,27 @@ class SpeedMonitor:
     def reset_running_speed_monitor(self):
         with self._lock:
             self._global_step_records.clear()
+
+    # -- per-node diagnosis verdicts ----------------------------------------
+
+    def update_node_verdict(self, node_id: int, verdict: str,
+                            evidence: Optional[Dict] = None):
+        """Fed by the straggler detector; ``evidence`` is accepted for
+        interface parity but the monitor stores only the verdict (the
+        detector keeps the full evidence)."""
+        with self._lock:
+            if verdict == "healthy":
+                self._node_verdicts.pop(node_id, None)
+            else:
+                self._node_verdicts[node_id] = verdict
+
+    @property
+    def straggler_nodes(self) -> List[int]:
+        with self._lock:
+            return sorted(n for n, v in self._node_verdicts.items()
+                          if v == "straggler")
+
+    @property
+    def unhealthy_nodes(self) -> List[int]:
+        with self._lock:
+            return sorted(self._node_verdicts)

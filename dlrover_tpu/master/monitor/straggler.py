@@ -1,0 +1,308 @@
+"""Master-side straggler & node-hang diagnosis over the node series.
+
+The verdict the control loop (ROADMAP item 1) actuates on: each node's
+windowed step-time p50 is compared against the MEDIAN of its peers'
+(excluding itself — robust down to 2-node clusters); a node must exceed
+``diagnosis_straggler_ratio`` for ``diagnosis_confirm_windows``
+CONSECUTIVE report windows before it is flagged, so one box-noise spike
+cannot brand a healthy node. A node whose reports stop arriving while a
+peer is still reporting is diagnosed hung after ``diagnosis_hang_secs``.
+
+Verdicts are:
+
+  * emitted as ``DIAG_STRAGGLER`` / ``DIAG_NODE_HANG`` timeline events
+    with the full evidence attached (node p50/p95, peer median, ratio,
+    confirm windows, overflow marker) and a freshly minted incident
+    trace id;
+  * pushed into ``SpeedMonitor`` (``update_node_verdict``) so speed
+    judgements and the auto-scaler see the per-node health; and
+  * queryable via ``verdicts()`` / the master's ``DiagnosisRequest``
+    RPC / ``tpurun diagnose``.
+
+A p50 clamped by the histogram's +Inf bucket (``overflow``) is treated
+as a LOWER bound: it can confirm a straggler (the node is at least that
+slow) but the evidence carries ``overflow: true`` so operators know the
+magnitude is censored.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
+from dlrover_tpu.telemetry.trace_context import new_trace_id
+
+logger = get_logger("master.straggler")
+
+VERDICT_HEALTHY = "healthy"
+VERDICT_STRAGGLER = "straggler"
+VERDICT_HUNG = "hung"
+
+
+@dataclass
+class NodeVerdict:
+    node_id: int
+    verdict: str = VERDICT_HEALTHY
+    since_ts: float = 0.0
+    trace_id: str = ""
+    evidence: Dict = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        return {
+            "node_id": self.node_id,
+            "verdict": self.verdict,
+            "since_ts": self.since_ts,
+            "trace_id": self.trace_id,
+            "evidence": dict(self.evidence),
+        }
+
+
+class StragglerDetector:
+    def __init__(
+        self,
+        store: NodeRuntimeStore,
+        speed_monitor=None,
+        ratio: Optional[float] = None,
+        confirm_windows: Optional[int] = None,
+        hang_secs: Optional[float] = None,
+        freshness_secs: float = 600.0,
+    ):
+        ctx = get_context()
+        self._store = store
+        self._speed_monitor = speed_monitor
+        self._ratio = float(
+            ratio if ratio is not None
+            else getattr(ctx, "diagnosis_straggler_ratio", 2.0))
+        self._confirm = max(1, int(
+            confirm_windows if confirm_windows is not None
+            else getattr(ctx, "diagnosis_confirm_windows", 3)))
+        self._hang_secs = float(
+            hang_secs if hang_secs is not None
+            else getattr(ctx, "diagnosis_hang_secs", 120.0))
+        # how old a peer's latest window may be and still anchor the
+        # cluster median (stale peers would skew the comparison)
+        self._freshness = float(freshness_secs)
+        # a node silent this long has DEPARTED (deleted pod, scaled
+        # away): its verdict and series are dropped so a stale "hung"
+        # flag cannot pin the auto-scaler disabled for the rest of the
+        # job — the very mechanism that could replace the node
+        self._departed_after = max(4 * self._hang_secs, 300.0)
+        self._lock = threading.Lock()
+        self._over_counts: Dict[int, int] = {}
+        self._verdicts: Dict[int, NodeVerdict] = {}
+        reg = get_registry()
+        self._c_stragglers = reg.counter(
+            tm.DIAG_STRAGGLERS, help="straggler verdicts confirmed")
+        self._c_hangs = reg.counter(
+            tm.DIAG_NODE_HANGS, help="node-hang verdicts confirmed")
+        self._c_recoveries = reg.counter(
+            tm.DIAG_RECOVERIES, help="verdicts cleared by recovery")
+
+    # -- evaluation ----------------------------------------------------------
+
+    def observe(self, node_id: int, now: Optional[float] = None) -> None:
+        """Evaluate after one node's report landed: that node's
+        straggler window advances, a hung verdict on it clears (it just
+        reported), and the cluster hang scan runs."""
+        now = now or time.time()
+        with self._lock:
+            self._clear_if_hung(node_id, now)
+            self._judge_straggler(node_id, now)
+        self.scan_hangs(now)
+
+    def scan_hangs(self, now: Optional[float] = None) -> None:
+        """Flag nodes whose reports stopped while a peer still reports
+        (called from observe() and the master's periodic stats loop, so
+        a hang is noticed even when NO report arrives to trigger it)."""
+        if self._hang_secs <= 0:
+            return
+        now = now or time.time()
+        ages = {
+            nid: self._store.last_report_age(nid, now)
+            for nid in self._store.node_ids()
+        }
+        ages = {n: a for n, a in ages.items() if a is not None}
+        if not ages:
+            return
+        freshest = min(ages.values())
+        if freshest > self._hang_secs:
+            # EVERY node went quiet: the job ended or the master is
+            # partitioned — a per-node hang verdict would be noise
+            return
+        with self._lock:
+            for nid, age in ages.items():
+                if age > self._departed_after:
+                    self._forget(nid, age)
+                    continue
+                if age <= self._hang_secs:
+                    continue
+                cur = self._verdicts.get(nid)
+                if cur is not None and cur.verdict == VERDICT_HUNG:
+                    continue
+                self._flag(
+                    nid, VERDICT_HUNG, now,
+                    evidence={
+                        "report_age_s": round(age, 1),
+                        "hang_secs": self._hang_secs,
+                        "freshest_peer_age_s": round(freshest, 1),
+                    },
+                )
+
+    def _judge_straggler(self, node_id: int, now: float) -> None:
+        mine = self._store.latest(node_id)
+        if mine is None or mine.step_p50 is None or mine.window_steps <= 0:
+            return
+        peers = []
+        for nid in self._store.node_ids():
+            if nid == node_id:
+                continue
+            s = self._store.latest(nid)
+            if (s is None or s.step_p50 is None
+                    or now - s.ts > self._freshness):
+                continue
+            peers.append(s.step_p50)
+        if not peers:
+            # no fresh peer anchors a median: there is no evidence
+            # basis, so an existing straggler verdict must not outlive
+            # the comparison that produced it
+            self._over_counts[node_id] = 0
+            self._clear_if(node_id, VERDICT_STRAGGLER, now,
+                           reason="no_fresh_peers")
+            return
+        peer_median = statistics.median(peers)
+        if peer_median <= 0:
+            return
+        ratio = mine.step_p50 / peer_median
+        if ratio < self._ratio:
+            self._over_counts[node_id] = 0
+            self._clear_if(node_id, VERDICT_STRAGGLER, now, ratio=ratio)
+            return
+        self._over_counts[node_id] = self._over_counts.get(node_id, 0) + 1
+        over = self._over_counts[node_id]
+        cur = self._verdicts.get(node_id)
+        already = cur is not None and cur.verdict == VERDICT_STRAGGLER
+        if over < self._confirm or already:
+            return
+        self._flag(
+            node_id, VERDICT_STRAGGLER, now,
+            evidence={
+                "step_p50_s": round(mine.step_p50, 6),
+                "step_p95_s": (round(mine.step_p95, 6)
+                               if mine.step_p95 is not None else None),
+                "peer_median_p50_s": round(peer_median, 6),
+                "ratio": round(ratio, 3),
+                "threshold": self._ratio,
+                "confirm_windows": over,
+                "window_steps": mine.window_steps,
+                "overflow": mine.overflow,
+            },
+        )
+
+    # -- verdict bookkeeping (lock held) -------------------------------------
+
+    def _flag(self, node_id: int, verdict: str, now: float,
+              evidence: Dict) -> None:
+        tid = new_trace_id()
+        self._verdicts[node_id] = NodeVerdict(
+            node_id=node_id, verdict=verdict, since_ts=now,
+            trace_id=tid, evidence=evidence,
+        )
+        if verdict == VERDICT_STRAGGLER:
+            self._c_stragglers.inc()
+            emit_event(EventKind.DIAG_STRAGGLER, error_code="STRAGGLER",
+                       trace_id=tid, diag_node=node_id, **evidence)
+        else:
+            self._c_hangs.inc()
+            emit_event(EventKind.DIAG_NODE_HANG, error_code="NODE_HANG",
+                       trace_id=tid, diag_node=node_id, **evidence)
+        logger.warning("node %d diagnosed %s [%s]: %s",
+                       node_id, verdict, tid, evidence)
+        self._push_verdict(node_id)
+
+    def _clear_if(self, node_id: int, verdict: str, now: float,
+                  **extra) -> None:
+        cur = self._verdicts.get(node_id)
+        if cur is None or cur.verdict != verdict:
+            return
+        # recovered nodes are POPPED, not parked as "healthy" rows: the
+        # verdict map (and so DiagnosisRequest / `tpurun diagnose`)
+        # holds only ACTIVE judgements, and an operator never reads a
+        # stale VERDICT line for a node that recovered an hour ago
+        self._verdicts.pop(node_id)
+        self._c_recoveries.inc()
+        emit_event(EventKind.DIAG_RECOVERED, trace_id=cur.trace_id,
+                   diag_node=node_id, was=verdict,
+                   flagged_seconds=round(now - cur.since_ts, 1), **extra)
+        logger.info("node %d recovered from %s verdict", node_id, verdict)
+        if self._speed_monitor is not None:
+            try:
+                self._speed_monitor.update_node_verdict(
+                    node_id, VERDICT_HEALTHY)
+            except Exception:  # noqa: BLE001 — verdicts must not kill ingest
+                logger.exception("failed to push verdict to speed monitor")
+
+    def _clear_if_hung(self, node_id: int, now: float) -> None:
+        self._clear_if(node_id, VERDICT_HUNG, now)
+
+    def _forget(self, node_id: int, age: float) -> None:
+        """Drop a DEPARTED node entirely (verdict, window counter, and
+        series): it is no longer part of the cluster being judged."""
+        cur = self._verdicts.pop(node_id, None)
+        self._over_counts.pop(node_id, None)
+        self._store.forget(node_id)
+        if cur is not None and cur.verdict != VERDICT_HEALTHY:
+            self._c_recoveries.inc()
+            emit_event(EventKind.DIAG_RECOVERED, trace_id=cur.trace_id,
+                       diag_node=node_id, was=cur.verdict,
+                       departed=True, report_age_s=round(age, 1))
+        logger.info("node %d departed (silent %.0fs): series and "
+                    "verdict dropped", node_id, age)
+        if self._speed_monitor is not None:
+            try:
+                self._speed_monitor.update_node_verdict(
+                    node_id, VERDICT_HEALTHY)
+            except Exception:  # noqa: BLE001 — cleanup must not raise
+                logger.exception("failed to clear departed verdict")
+
+    def _push_verdict(self, node_id: int) -> None:
+        if self._speed_monitor is None:
+            return
+        v = self._verdicts[node_id]
+        try:
+            self._speed_monitor.update_node_verdict(
+                node_id, v.verdict, evidence=v.evidence)
+        except Exception:  # noqa: BLE001 — verdicts must not kill ingest
+            logger.exception("failed to push verdict to speed monitor")
+
+    # -- queries -------------------------------------------------------------
+
+    def verdicts(self) -> Dict[int, Dict]:
+        with self._lock:
+            return {n: v.to_dict() for n, v in self._verdicts.items()}
+
+    def stragglers(self) -> List[int]:
+        with self._lock:
+            return sorted(n for n, v in self._verdicts.items()
+                          if v.verdict == VERDICT_STRAGGLER)
+
+    def hung_nodes(self) -> List[int]:
+        with self._lock:
+            return sorted(n for n, v in self._verdicts.items()
+                          if v.verdict == VERDICT_HUNG)
+
+    def unhealthy(self) -> List[int]:
+        with self._lock:
+            return sorted(n for n, v in self._verdicts.items()
+                          if v.verdict != VERDICT_HEALTHY)

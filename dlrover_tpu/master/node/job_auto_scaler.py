@@ -80,6 +80,19 @@ class JobAutoScaler:
         if not self._speed_monitor.worker_adjustment_finished():
             logger.info("waiting for worker count to stabilize")
             return
+        # per-node diagnosis verdicts (straggler detector via the speed
+        # monitor): an unhealthy node poisons the speed series, so a
+        # resize judged on it would chase the symptom — recovery owns
+        # the incident; the scaler resumes once the verdicts clear
+        unhealthy = list(
+            getattr(self._speed_monitor, "unhealthy_nodes", []) or []
+        )
+        if unhealthy:
+            logger.info(
+                "skipping speed-based optimization: diagnosis verdicts "
+                "active on nodes %s", unhealthy,
+            )
+            return
         plan = self._job_optimizer.get_job_resource_plan()
         if plan is None or plan.empty():
             return

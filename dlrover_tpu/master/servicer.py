@@ -36,7 +36,12 @@ class MasterServicer:
         elastic_ps_service: Optional[ElasticPsService] = None,
         job_manager=None,
         metric_collector=None,
+        node_runtime_store=None,
+        straggler_detector=None,
     ):
+        from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
+        from dlrover_tpu.master.monitor.straggler import StragglerDetector
+
         self._task_manager = task_manager
         self._rdzv_managers = rdzv_managers or {}
         self._speed_monitor = speed_monitor
@@ -45,6 +50,14 @@ class MasterServicer:
         self._elastic_ps_service = elastic_ps_service
         self._job_manager = job_manager
         self._metric_collector = metric_collector
+        # the cluster diagnosis plane: every master ingests per-node
+        # runtime series and judges stragglers/hangs over them
+        self.node_runtime_store = (
+            node_runtime_store or NodeRuntimeStore()
+        )
+        self.straggler_detector = straggler_detector or StragglerDetector(
+            self.node_runtime_store, speed_monitor=speed_monitor
+        )
         self._parallel_configs: Dict[int, comm.ParallelConfig] = {}
         # one failure record store: the job manager's when present (its
         # handle_training_failure records there), else our own so the
@@ -77,6 +90,7 @@ class MasterServicer:
             comm.ClusterVersionRequest: self._get_cluster_version,
             comm.QueryPsNodesRequest: self._query_ps_nodes,
             comm.ParallelConfigRequest: self._get_parallel_config,
+            comm.DiagnosisRequest: self._get_diagnosis,
         }
         self._report_handlers = {
             comm.DatasetShardParams: self._new_dataset,
@@ -92,6 +106,7 @@ class MasterServicer:
             comm.NodeFailure: self._report_failure,
             comm.ResourceStats: self._report_resource,
             comm.GlobalStep: self._report_global_step,
+            comm.NodeRuntimeReport: self._report_node_runtime,
             comm.ShardCheckpoint: self._restore_shard_checkpoint,
             comm.NodeHeartbeat: self._report_heartbeat,
             comm.NodeStatusReport: self._report_node_status,
@@ -251,11 +266,14 @@ class MasterServicer:
         return comm.NodeRankList(ranks=ranks)
 
     def _straggler_exist(self, req: comm.StragglerExistRequest):
+        # union of the pre-training network-check diagnosis and the
+        # RUNTIME verdicts from the node-series detector
         mgr = self._manager(RendezvousName.NETWORK_CHECK)
-        stragglers = mgr.straggler_nodes() if mgr else []
+        stragglers = set(mgr.straggler_nodes() if mgr else [])
+        stragglers.update(self.straggler_detector.stragglers())
         return comm.Response(
             success=bool(stragglers),
-            reason=",".join(str(s) for s in stragglers),
+            reason=",".join(str(s) for s in sorted(stragglers)),
         )
 
     # -- kv store / sync ----------------------------------------------------
@@ -338,10 +356,41 @@ class MasterServicer:
 
     def _report_global_step(self, req: comm.GlobalStep):
         if self._speed_monitor is not None:
-            self._speed_monitor.collect_global_step(
-                req.step, req.timestamp or time.time()
-            )
+            if getattr(req, "reset", False):
+                # the true step REWOUND (rollback / live reshard): the
+                # monotone max() path would pin the gauge stale-high
+                self._speed_monitor.reset_step(
+                    req.step, req.timestamp or time.time()
+                )
+            else:
+                self._speed_monitor.collect_global_step(
+                    req.step, req.timestamp or time.time()
+                )
         return comm.Response(success=True)
+
+    def _report_node_runtime(self, req: comm.NodeRuntimeReport):
+        """Ingest a worker's node-tagged runtime snapshot and run the
+        straggler/hang judgement over the refreshed series."""
+        self.node_runtime_store.ingest(req)
+        self.straggler_detector.observe(req.node_id)
+        return comm.Response(success=True)
+
+    def _get_diagnosis(self, req: comm.DiagnosisRequest):
+        import json as _json
+
+        summary = self.node_runtime_store.summary()
+        if req.node_id >= 0:
+            summary = {req.node_id: summary.get(req.node_id)}
+        report = {
+            "nodes": {str(k): v for k, v in summary.items()},
+            "verdicts": {
+                str(k): v
+                for k, v in self.straggler_detector.verdicts().items()
+            },
+            "stragglers": self.straggler_detector.stragglers(),
+            "hung": self.straggler_detector.hung_nodes(),
+        }
+        return comm.DiagnosisReport(report_json=_json.dumps(report))
 
     def _report_heartbeat(self, req: comm.NodeHeartbeat):
         if self._job_manager is not None:

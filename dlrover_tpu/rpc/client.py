@@ -76,6 +76,19 @@ class RpcChannel:
             response_deserializer=serialize.loads,
         )
 
+    @staticmethod
+    def _trace_metadata():
+        """Invocation metadata carrying the ambient incident trace id
+        (if any), so the server side stamps its ingress events with the
+        same id (cross-process incident correlation)."""
+        from dlrover_tpu.telemetry.trace_context import (
+            TRACE_ID_METADATA_KEY,
+            current_trace_id,
+        )
+
+        tid = current_trace_id()
+        return ((TRACE_ID_METADATA_KEY, tid),) if tid else None
+
     @retry_rpc()
     def get(self, msg: Any) -> Any:
         # spans cover every master RPC — shard-dispatch get_task, comm
@@ -84,7 +97,8 @@ class RpcChannel:
 
         with span(f"{SpanName.RPC}.get.{type(msg).__name__}",
                   category="rpc"):
-            return self._get(msg, timeout=self._timeout)
+            return self._get(msg, timeout=self._timeout,
+                             metadata=self._trace_metadata())
 
     @retry_rpc()
     def report(self, msg: Any) -> Response:
@@ -92,7 +106,8 @@ class RpcChannel:
 
         with span(f"{SpanName.RPC}.report.{type(msg).__name__}",
                   category="rpc"):
-            return self._report(msg, timeout=self._timeout)
+            return self._report(msg, timeout=self._timeout,
+                                metadata=self._trace_metadata())
 
     def close(self):
         self._channel.close()

@@ -39,7 +39,30 @@ class _GenericHandler(grpc.GenericRpcHandler):
             return None
 
         def behavior(request, context):
-            return method(request, context)
+            # restore the caller's incident trace id (if the client
+            # attached one) around the handler, so every event the
+            # master emits while serving this request carries it
+            from dlrover_tpu.telemetry.trace_context import (
+                TRACE_ID_METADATA_KEY,
+                reset_trace_id,
+                set_trace_id,
+            )
+
+            tid = ""
+            try:
+                for key, value in context.invocation_metadata() or ():
+                    if key == TRACE_ID_METADATA_KEY:
+                        tid = value
+                        break
+            except (AttributeError, TypeError):
+                tid = ""  # non-grpc test doubles without metadata
+            if not tid:
+                return method(request, context)
+            token = set_trace_id(tid)
+            try:
+                return method(request, context)
+            finally:
+                reset_trace_id(token)
 
         return grpc.unary_unary_rpc_method_handler(
             behavior,

@@ -29,8 +29,18 @@ from dlrover_tpu.telemetry.metrics import (
     get_registry,
     process_registry,
 )
+from dlrover_tpu.telemetry.correlate import (
+    export_merged_trace,
+    incident_records,
+)
+from dlrover_tpu.telemetry.goodput import derive_goodput
 from dlrover_tpu.telemetry.mttr import derive_incidents, mttr_report
 from dlrover_tpu.telemetry.names import EventKind, SpanName
+from dlrover_tpu.telemetry.trace_context import (
+    current_trace_id,
+    new_trace_id,
+    trace_scope,
+)
 from dlrover_tpu.telemetry.tracing import (
     add_instant,
     export_chrome_trace,
@@ -54,6 +64,12 @@ __all__ = [
     "process_registry",
     "derive_incidents",
     "mttr_report",
+    "derive_goodput",
+    "export_merged_trace",
+    "incident_records",
+    "current_trace_id",
+    "new_trace_id",
+    "trace_scope",
     "add_instant",
     "export_chrome_trace",
     "span",

@@ -2,11 +2,19 @@
 
   mttr     derive the MTTR / recovery-count report from an event
            timeline (replaces hand-maintained MTTR.json artifacts)
+  goodput  derive the goodput/badput wall-clock ledger from an event
+           timeline (productive / compile / reshard / restart /
+           checkpoint / rendezvous / idle buckets)
+  diagnose cluster diagnosis: straggler/hang verdicts + node series —
+           live from a master (--addr) or forensically from a
+           timeline (--events)
   events   pretty-print a timeline (newest last)
   metrics  dump Prometheus exposition: a live endpoint via --addr, or
            this process's registry (useful under ``tpurun metrics``)
   trace    export the current process's span ring as Chrome/Perfetto
-           trace JSON
+           trace JSON; with --events, merge a multi-process event
+           timeline into ONE Perfetto view (incident spans + trace-id
+           flows across master/agent/workers)
 """
 
 from __future__ import annotations
@@ -36,6 +44,26 @@ def build_parser() -> argparse.ArgumentParser:
                       help="MTTR target seconds for vs_baseline "
                            "(default 90)")
 
+    gp = sub.add_parser(
+        "goodput", help="derive the goodput/badput ledger from an "
+                        "event timeline JSONL")
+    gp.add_argument("--events", default="",
+                    help="timeline path (default: the configured "
+                         "DLROVER_TPU_EVENTS_FILE sink)")
+    gp.add_argument("--out", default="",
+                    help="also write the JSON ledger to this path")
+
+    dg = sub.add_parser(
+        "diagnose", help="cluster diagnosis: node series + "
+                         "straggler/hang verdicts")
+    dg.add_argument("--addr", default="",
+                    help="query a live master at host:port")
+    dg.add_argument("--events", default="",
+                    help="derive forensically from a timeline JSONL "
+                         "(default: the configured events sink)")
+    dg.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
     ev = sub.add_parser("events", help="print a timeline")
     ev.add_argument("--events", default="", help="timeline path")
     ev.add_argument("--tail", type=int, default=0,
@@ -50,6 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     tr = sub.add_parser("trace", help="export span ring as Chrome JSON")
     tr.add_argument("--out", default="trace.json")
+    tr.add_argument("--events", default=None,
+                    help="merge THIS event timeline (all processes) "
+                         "into one Perfetto view instead of exporting "
+                         "the local span ring")
 
     cache = sub.add_parser(
         "cache", help="persistent XLA compile-cache stats (dir, entry "
@@ -64,6 +96,89 @@ def _resolve_events_path(arg: str) -> Optional[str]:
     from dlrover_tpu.telemetry import events as events_mod
 
     return arg or events_mod.default_events_path()
+
+
+def _cmd_diagnose(args) -> int:
+    """Live (master RPC) or forensic (timeline) cluster diagnosis."""
+    if args.addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(args.addr)
+        try:
+            report = client.get_diagnosis()
+        finally:
+            client.close()
+        report["source"] = args.addr
+    else:
+        from dlrover_tpu.telemetry import events as events_mod
+        from dlrover_tpu.telemetry.names import EventKind
+
+        path = _resolve_events_path(args.events)
+        if not path:
+            print("diagnose: no master --addr and no timeline (pass "
+                  "--events or set DLROVER_TPU_EVENTS_FILE)",
+                  file=sys.stderr)
+            return 2
+        records = events_mod.read_events(path)
+        diag_kinds = {EventKind.DIAG_STRAGGLER: "straggler",
+                      EventKind.DIAG_NODE_HANG: "hung"}
+        verdicts = {}
+        incidents = []
+        for rec in records:
+            kind = rec.get("kind", "")
+            if kind in diag_kinds:
+                node = rec.get("diag_node")
+                verdicts[str(node)] = {
+                    "node_id": node,
+                    "verdict": diag_kinds[kind],
+                    "since_ts": rec.get("ts"),
+                    "trace_id": rec.get("trace_id", ""),
+                    "evidence": {
+                        k: v for k, v in rec.items()
+                        if k not in ("kind", "ts", "mono", "pid",
+                                     "node", "seq", "trace_id",
+                                     "diag_node")
+                    },
+                }
+                incidents.append(verdicts[str(node)])
+            elif kind == EventKind.DIAG_RECOVERED:
+                verdicts.pop(str(rec.get("diag_node")), None)
+        report = {
+            "source": path,
+            "events": len(records),
+            "verdicts": verdicts,
+            "stragglers": sorted(
+                v["node_id"] for v in verdicts.values()
+                if v["verdict"] == "straggler"),
+            "hung": sorted(
+                v["node_id"] for v in verdicts.values()
+                if v["verdict"] == "hung"),
+            "incident_history": incidents,
+        }
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    stragglers = report.get("stragglers") or []
+    hung = report.get("hung") or []
+    nodes = report.get("nodes") or {}
+    for node_id, sample in sorted(nodes.items()):
+        if not sample:
+            continue
+        p50 = sample.get("step_p50")
+        print(
+            f"node {node_id}: step={sample.get('step')} "
+            f"p50={p50 if p50 is not None else '-'}s "
+            f"rss={sample.get('rss_mb')}MB "
+            f"age={sample.get('report_age_s')}s"
+        )
+    for v in (report.get("verdicts") or {}).values():
+        print(f"VERDICT node {v.get('node_id')}: {v.get('verdict')} "
+              f"[{v.get('trace_id', '')}] evidence={v.get('evidence')}")
+    if not stragglers and not hung:
+        print("diagnosis: all reporting nodes healthy"
+              + ("" if nodes or report.get("verdicts")
+                 else " (no diagnosis records)"))
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -86,6 +201,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             with open(args.out, "w") as fh:
                 fh.write(line + "\n")
         return 1 if report.get("error") else 0
+
+    if args.cmd == "goodput":
+        from dlrover_tpu.telemetry import events as events_mod
+        from dlrover_tpu.telemetry.goodput import derive_goodput
+
+        path = _resolve_events_path(args.events)
+        if not path:
+            print("goodput: no timeline (pass --events or set "
+                  "DLROVER_TPU_EVENTS_FILE)", file=sys.stderr)
+            return 2
+        report = derive_goodput(events_mod.read_events(path))
+        line = json.dumps(report)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return 1 if report.get("error") else 0
+
+    if args.cmd == "diagnose":
+        return _cmd_diagnose(args)
 
     if args.cmd == "events":
         from dlrover_tpu.telemetry import events as events_mod
@@ -121,6 +256,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.cmd == "trace":
+        if args.events is not None:
+            from dlrover_tpu.telemetry import events as events_mod
+            from dlrover_tpu.telemetry.correlate import (
+                export_merged_trace,
+            )
+
+            records = events_mod.read_events(args.events)
+            n = export_merged_trace(records, args.out)
+            print(f"merged {len(records)} event(s) into {n} trace "
+                  f"event(s) at {args.out}")
+            return 0 if records else 1
         from dlrover_tpu.telemetry import tracing
 
         n = tracing.export_chrome_trace(args.out)

@@ -1,0 +1,133 @@
+"""Cross-process trace correlation: merge an event timeline into one
+Perfetto view.
+
+The host-span export (``tracing.export_chrome_trace``) covers ONE
+process. An incident, though, threads through three: the agent detects
+the failure, the master ingests the report, the relaunched worker
+recovers — each appending to the shared JSONL timeline with its own
+``pid`` and (when an incident trace id was ambient, see
+``trace_context``) a shared ``trace_id``.
+
+``export_merged_trace`` renders that file as Trace Event Format JSON
+that https://ui.perfetto.dev opens directly:
+
+  * every record becomes an instant event on its emitting process's
+    track (named ``node<id>/pid<pid>``), args carrying the full record;
+  * each failure→recovery incident (the MTTR pairing) becomes a
+    complete-event span on a synthetic "incidents" track, so downtime
+    is visible as a bar, not two dots;
+  * records sharing a ``trace_id`` are joined by flow arrows in emit
+    order — the causally-ordered path of the incident across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from dlrover_tpu.telemetry.mttr import derive_incidents
+
+# Perfetto wants process-scoped ids; the synthetic incident track uses
+# a pid real processes cannot take
+INCIDENT_TRACK_PID = 0
+
+
+def merged_trace_events(events: List[Dict]) -> List[Dict]:
+    ordered = sorted(events, key=lambda r: r.get("ts", 0.0))
+    out: List[Dict] = []
+    seen_pids: Dict[int, str] = {}
+    flows: Dict[str, List[Dict]] = {}
+
+    for rec in ordered:
+        pid = int(rec.get("pid", 0) or 0)
+        node = rec.get("node", "?")
+        seen_pids.setdefault(pid, f"node{node}/pid{pid}")
+        ev = {
+            "name": rec.get("kind", "event"),
+            "cat": "events",
+            "ph": "i",
+            "s": "p",  # process-scoped instant
+            "ts": int(rec.get("ts", 0.0) * 1e6),
+            "pid": pid,
+            "tid": pid,
+            "args": {k: v for k, v in rec.items() if k != "kind"},
+        }
+        out.append(ev)
+        tid = rec.get("trace_id")
+        if tid:
+            flows.setdefault(tid, []).append(ev)
+
+    # incident spans (downtime bars) on the synthetic track
+    seen_pids[INCIDENT_TRACK_PID] = "incidents"
+    for i, inc in enumerate(derive_incidents(ordered)):
+        if inc["started_ts"] is None or inc["recovered_ts"] is None:
+            continue
+        out.append({
+            "name": inc["scenario"],
+            "cat": "incident",
+            "ph": "X",
+            "ts": int(inc["started_ts"] * 1e6),
+            "dur": max(1, int(
+                (inc["recovered_ts"] - inc["started_ts"]) * 1e6)),
+            "pid": INCIDENT_TRACK_PID,
+            "tid": i,
+            "args": {k: v for k, v in inc.items()},
+        })
+
+    # flow arrows: consecutive records of one trace_id, in emit order
+    flow_id = 0
+    for tid, chain in flows.items():
+        if len(chain) < 2:
+            continue
+        flow_id += 1
+        for j, ev in enumerate(chain):
+            out.append({
+                "name": tid,
+                "cat": "trace_id",
+                "ph": "s" if j == 0 else ("f" if j == len(chain) - 1
+                                          else "t"),
+                "bp": "e",
+                "id": flow_id,
+                "ts": ev["ts"],
+                "pid": ev["pid"],
+                "tid": ev["tid"],
+            })
+
+    # process-name metadata so tracks read as nodes, not raw pids
+    for pid, name in seen_pids.items():
+        out.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {"name": name},
+        })
+    return out
+
+
+def export_merged_trace(events: List[Dict], path: str) -> int:
+    """Write the merged view; returns the number of trace events."""
+    trace_events = merged_trace_events(events)
+    payload = {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"exporter": "dlrover_tpu.telemetry.correlate"},
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+    return len(trace_events)
+
+
+def incident_records(events: List[Dict],
+                     trace_id: Optional[str] = None) -> Dict[str, List[Dict]]:
+    """Records grouped by trace id (one incident each); ``trace_id``
+    narrows to a single incident."""
+    groups: Dict[str, List[Dict]] = {}
+    for rec in sorted(events, key=lambda r: r.get("ts", 0.0)):
+        tid = rec.get("trace_id")
+        if not tid or (trace_id is not None and tid != trace_id):
+            continue
+        groups.setdefault(tid, []).append(rec)
+    return groups

@@ -22,6 +22,23 @@ The file path comes from ``DLROVER_TPU_EVENTS_FILE`` (or the Context
 knob ``telemetry_events_file``), resolved per emit — cheap, and it
 keeps tests with different tmp paths honest. No file configured ⇒
 records land only in the bounded in-memory ring.
+
+Rotation: the timeline would otherwise grow unboundedly on a
+long-running job. When the file passes ``DLROVER_TPU_EVENTS_MAX_MB``
+(Context knob ``telemetry_events_max_mb``, default 64) it is renamed to
+``<path>.1`` (replacing any previous ``.1``) and a fresh file is
+opened. Every emitter re-verifies its cached fd against the path's
+inode before writing, so the agent and all its workers — each holding
+its own ``O_APPEND`` fd onto the shared path — migrate to the fresh
+file on their next emit no matter which process performed the rename;
+a write racing the rename lands in ``.1`` (same inode), never lost.
+``read_events`` reads the ``.1``/current pair, so MTTR/goodput
+derivations see the full retained window.
+
+Incident correlation: when an incident trace id is ambient
+(``trace_context`` — set in-process, restored from gRPC metadata, or
+inherited from the worker environment), every record is stamped with
+``trace_id`` so cross-process timelines merge per incident.
 """
 
 from __future__ import annotations
@@ -33,20 +50,31 @@ import threading
 import time
 from typing import Deque, Dict, List, Optional
 
+try:
+    import fcntl
+except ImportError:  # non-posix: rotation loses cross-process exclusion
+    fcntl = None  # type: ignore[assignment]
+
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("telemetry.events")
 
 EVENTS_FILE_ENV = "DLROVER_TPU_EVENTS_FILE"
+EVENTS_MAX_MB_ENV = "DLROVER_TPU_EVENTS_MAX_MB"
+ROTATED_SUFFIX = ".1"
 _RING_CAP = 4096
 
 _ring: Deque[Dict] = collections.deque(maxlen=_RING_CAP)
 _ring_lock = threading.Lock()
 _seq = 0
-# one fd per resolved path, kept open for the process lifetime
+# one fd per resolved path, kept open for the process lifetime.
+# Reentrant: emit_event holds it across resolve→rotate→write so a
+# racing rotation cannot close the fd under a writer (a closed — or
+# worse, OS-reused — descriptor number would drop or misdirect the
+# record); the inner helpers re-acquire it.
 _fds: Dict[str, int] = {}
-_fd_lock = threading.Lock()
+_fd_lock = threading.RLock()
 
 
 def _events_path() -> str:
@@ -64,6 +92,117 @@ def _node_identity() -> str:
         or os.environ.get(NodeEnv.NODE_ID)
         or "0"
     )
+
+
+def _max_bytes() -> int:
+    """The rotation cap in bytes (0 disables rotation)."""
+    env = os.environ.get(EVENTS_MAX_MB_ENV)
+    if env not in (None, ""):
+        try:
+            return max(0, int(float(env) * 1024 * 1024))
+        except ValueError:
+            logger.warning("malformed %s=%r", EVENTS_MAX_MB_ENV, env)
+    from dlrover_tpu.common.config import get_context
+
+    mb = getattr(get_context(), "telemetry_events_max_mb", 64)
+    try:
+        return max(0, int(float(mb) * 1024 * 1024))
+    except (TypeError, ValueError):
+        return 64 * 1024 * 1024
+
+
+def _open_sink(path: str) -> int:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    return os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+
+
+def _sink_fd(path: str) -> int:
+    """The per-path append fd, re-validated against the path's inode:
+    after any process rotates (rename + fresh file) the cached fd points
+    at the rotated inode and must be reopened. Events are lifecycle-rate
+    (not per-step), so the two stat syscalls per emit are cheap."""
+    with _fd_lock:
+        fd = _fds.get(path)
+        if fd is not None:
+            try:
+                if os.stat(path).st_ino == os.fstat(fd).st_ino:
+                    return fd
+            except OSError:
+                pass  # path unlinked/renamed: fall through and reopen
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        fd = _open_sink(path)
+        _fds[path] = fd
+        return fd
+
+
+def _maybe_rotate(path: str, fd: int) -> int:
+    """Size-capped rotation keeping the shared-append semantics: rename
+    the full file to ``<path>.1`` and open a fresh one. Returns the fd
+    to write through (the fresh file after a rotation)."""
+    cap = _max_bytes()
+    if cap <= 0:
+        return fd
+    try:
+        if os.fstat(fd).st_size < cap:
+            return fd
+        with _fd_lock:
+            # EVERYTHING re-validates under the lock against the
+            # registry's CURRENT fd, not the caller's: a racing thread
+            # may have rotated already and the OS may have reused our
+            # old fd number for the fresh file — re-checking size+inode
+            # on the caller's fd could rotate twice (clobbering the
+            # just-rotated full file with a near-empty one) or close an
+            # unrelated descriptor
+            fd = _fds.get(path, fd)
+            # the agent and its workers each run this check: an
+            # exclusive flock on the FULL file's inode serializes the
+            # rename across processes, and the post-lock re-validation
+            # turns the loser's rotation into a no-op (path now names a
+            # different, fresh inode) instead of a second rename that
+            # would clobber the just-rotated history
+            locked = False
+            if fcntl is not None:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX)
+                    locked = True
+                except OSError:
+                    pass
+            try:
+                try:
+                    st = os.fstat(fd)
+                    same = (st.st_size >= cap
+                            and os.stat(path).st_ino == st.st_ino)
+                except OSError:
+                    same = False
+                if not same:
+                    # already rotated (or externally renamed): write
+                    # through the registry's fd — an append onto the
+                    # rotated inode still lands in the retained pair,
+                    # and the next emit's _sink_fd re-syncs to the
+                    # fresh file
+                    return fd
+                os.replace(path, path + ROTATED_SUFFIX)
+            finally:
+                if locked:
+                    try:
+                        fcntl.flock(fd, fcntl.LOCK_UN)
+                    except OSError:
+                        pass
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            fd = _open_sink(path)
+            _fds[path] = fd
+            return fd
+    except OSError:
+        logger.warning("event sink rotation failed for %s", path,
+                       exc_info=True)
+    return fd
 
 
 def emit_event(kind: str, error_code: str = "", **fields) -> Dict:
@@ -88,6 +227,11 @@ def emit_event(kind: str, error_code: str = "", **fields) -> Dict:
     }
     if error_code:
         record["error_code"] = error_code
+    from dlrover_tpu.telemetry.trace_context import current_trace_id
+
+    tid = current_trace_id()
+    if tid:
+        record["trace_id"] = tid
     for k, v in fields.items():
         if v is not None:
             record[k] = v
@@ -96,21 +240,16 @@ def emit_event(kind: str, error_code: str = "", **fields) -> Dict:
     path = _events_path()
     if path:
         try:
-            fd = _fds.get(path)
-            if fd is None:
-                with _fd_lock:
-                    fd = _fds.get(path)
-                    if fd is None:
-                        d = os.path.dirname(os.path.abspath(path))
-                        os.makedirs(d, exist_ok=True)
-                        fd = os.open(
-                            path,
-                            os.O_WRONLY | os.O_CREAT | os.O_APPEND,
-                            0o644,
-                        )
-                        _fds[path] = fd
             line = json.dumps(record, separators=(",", ":")) + "\n"
-            os.write(fd, line.encode("utf-8"))
+            # the lock spans resolve→rotate→write: a concurrent
+            # rotation closes registry fds, and writing outside the
+            # lock could hit a closed (or OS-reused) descriptor.
+            # Events are lifecycle-rate, so serializing emitters is
+            # cheap; cross-PROCESS interleaving still needs no lock
+            # (single O_APPEND write per record).
+            with _fd_lock:
+                fd = _maybe_rotate(path, _sink_fd(path))
+                os.write(fd, line.encode("utf-8"))
         except OSError:
             logger.warning("event sink write failed for %s", path,
                            exc_info=True)
@@ -129,9 +268,7 @@ def clear_ring() -> None:
         _ring.clear()
 
 
-def read_events(path: str) -> List[Dict]:
-    """Parse a timeline file; malformed lines (torn writes from a
-    killed process) are skipped, not fatal."""
+def _read_one(path: str) -> List[Dict]:
     out: List[Dict] = []
     try:
         with open(path, encoding="utf-8", errors="replace") as fh:
@@ -147,6 +284,14 @@ def read_events(path: str) -> List[Dict]:
                     out.append(rec)
     except OSError:
         return []
+    return out
+
+
+def read_events(path: str) -> List[Dict]:
+    """Parse a timeline (the rotated ``<path>.1`` predecessor included,
+    so derivations span the full retained window); malformed lines
+    (torn writes from a killed process) are skipped, not fatal."""
+    out = _read_one(path + ROTATED_SUFFIX) + _read_one(path)
     out.sort(key=lambda r: r.get("ts", 0.0))
     return out
 

@@ -1,0 +1,203 @@
+"""Goodput/badput ledger derived from the event timeline.
+
+Where did the job's wall-clock go? The 100k-GPU HSDP line of work
+(PAPERS.md) treats this accounting as the precondition for fault-
+tolerant training at scale: a job that recovers but spends 30% of its
+life rendezvousing is still a broken job. Like ``mttr``, the ledger is
+DERIVED from the JSONL timeline the production components already emit
+— no bench script assembles it by hand.
+
+Wall time (first event → last event) is partitioned into buckets by an
+interval sweep:
+
+  restart          failure edge (worker death / hang) → workers running
+  reshard          live in-process reshard (begin → done)
+  rollback         non-finite step → checkpoint rollback restored
+  preempt_drain    preemption notice → drain done
+  rendezvous       join → completed world (``wait_seconds`` on the
+                   complete/timeout records)
+  checkpoint       save staging + restore wall time (the async mirror
+                   overlaps training and is deliberately NOT counted)
+  compile          TRAIN_START → first materialized step
+                   (``compile_first_step.seconds``)
+  productive_step  time inside a TRAIN_START→TRAIN_END span not claimed
+                   by any bucket above
+  idle             everything else (setup gaps, time between a worker's
+                   death and its failure edge, post-training teardown)
+
+Overlapping claims resolve by the order above (downtime wins over a
+train span that brackets it), so the buckets PARTITION the wall clock:
+they sum to job wall-time by construction — the acceptance gate's
+"≥99%" allows only for rounding.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.telemetry.mttr import derive_incidents
+from dlrover_tpu.telemetry.names import EventKind
+
+# highest priority first: an instant of wall time goes to the FIRST
+# bucket that claims it
+BUCKET_PRIORITY = (
+    "restart",
+    "reshard",
+    "rollback",
+    "preempt_drain",
+    "rendezvous",
+    "checkpoint",
+    "compile",
+    "productive_step",
+)
+IDLE = "idle"
+
+_SCENARIO_BUCKET = {
+    "worker_failure": "restart",
+    "hang": "restart",
+    "live_reshard": "reshard",
+    "nonfinite_rollback": "rollback",
+    "preemption_drain": "preempt_drain",
+}
+
+_FAILURE_EDGES = {EventKind.WORKER_FAILED, EventKind.HANG_DETECTED}
+
+# (kind, duration-field) pairs whose records carry their own wall cost
+_DURATION_EVENTS = {
+    EventKind.RDZV_COMPLETE: ("wait_seconds", "rendezvous"),
+    EventKind.RDZV_TIMEOUT: ("timeout_seconds", "rendezvous"),
+    EventKind.CKPT_SAVE: ("stage_seconds", "checkpoint"),
+    EventKind.CKPT_RESTORE: ("restore_seconds", "checkpoint"),
+    EventKind.COMPILE_FIRST_STEP: ("seconds", "compile"),
+}
+
+
+def _train_spans(ordered: List[Dict], t_end: float) -> List[
+        Tuple[float, float]]:
+    """Per-worker TRAIN_START→TRAIN_END spans, keyed by (node, pid) —
+    containerized workers on different hosts routinely share a pid
+    (often 1), and pairing on pid alone would cross-close spans between
+    nodes. A re-entered TRAIN_START on the same worker closes the
+    previous span at the new start. An unclosed span (the worker died
+    mid-training) ends at the next observed failure edge — the moment
+    the cluster learned the training stopped — or at the timeline's end
+    when no failure edge follows."""
+    spans: List[Tuple[float, float]] = []
+    open_starts: Dict[Tuple[str, int], float] = {}
+    failure_ts = [r.get("ts", 0.0) for r in ordered
+                  if r.get("kind") in _FAILURE_EDGES]
+    for rec in ordered:
+        kind = rec.get("kind")
+        key = (str(rec.get("node", "")), rec.get("pid", 0))
+        ts = rec.get("ts", 0.0)
+        if kind == EventKind.TRAIN_START:
+            prev = open_starts.get(key)
+            if prev is not None:
+                spans.append((prev, ts))
+            open_starts[key] = ts
+        elif kind == EventKind.TRAIN_END and key in open_starts:
+            spans.append((open_starts.pop(key), ts))
+    for _key, start in open_starts.items():
+        later_failures = [t for t in failure_ts if t > start]
+        spans.append((start, min(later_failures) if later_failures
+                      else t_end))
+    return spans
+
+
+def derive_goodput(events: List[Dict]) -> Dict:
+    """The ledger: bucket seconds + fractions over the timeline's wall
+    clock (empty report when fewer than two timestamped events)."""
+    ordered = sorted(events, key=lambda r: r.get("ts", 0.0))
+    stamps = [r["ts"] for r in ordered if r.get("ts") is not None]
+    if len(stamps) < 2 or stamps[-1] <= stamps[0]:
+        return {
+            "metric": "goodput_fraction",
+            "value": 0.0,
+            "unit": "fraction",
+            "error": "timeline too short to derive a ledger",
+            "detail": {"wall_s": 0.0, "events": len(events),
+                       "buckets": {}},
+        }
+    t0, t1 = stamps[0], stamps[-1]
+    wall = t1 - t0
+
+    intervals: List[Tuple[float, float, str]] = []
+
+    # incident downtime: reuse the MTTR pairing (bursts collapse, edges
+    # pair per scenario); unrecovered incidents cost until the end
+    for inc in derive_incidents(ordered):
+        bucket = _SCENARIO_BUCKET.get(inc["scenario"])
+        if bucket is None or inc["started_ts"] is None:
+            continue
+        end = inc["recovered_ts"] if inc["recovered_ts"] is not None else t1
+        intervals.append((inc["started_ts"], end, bucket))
+
+    # self-costed records (the emitting component measured its own wall)
+    for rec in ordered:
+        spec = _DURATION_EVENTS.get(rec.get("kind", ""))
+        if spec is None:
+            continue
+        field_name, bucket = spec
+        try:
+            dur = float(rec.get(field_name, 0.0) or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if dur > 0:
+            ts = rec.get("ts", 0.0)
+            intervals.append((ts - dur, ts, bucket))
+
+    for start, end in _train_spans(ordered, t1):
+        intervals.append((start, end, "productive_step"))
+
+    # clip to the wall window and sweep: per boundary point, per-rank
+    # open-interval deltas; each segment between consecutive points is
+    # charged to the highest-priority bucket active over it. O(n log n)
+    # in the interval count — a per-segment scan of all intervals would
+    # go quadratic on a long retained timeline.
+    clipped = [
+        (max(s, t0), min(e, t1), b)
+        for s, e, b in intervals if min(e, t1) > max(s, t0)
+    ]
+    rank = {b: i for i, b in enumerate(BUCKET_PRIORITY)}
+    deltas: Dict[float, List[int]] = {}
+    for s, e, bucket in clipped:
+        r = rank[bucket]
+        deltas.setdefault(s, [0] * len(BUCKET_PRIORITY))[r] += 1
+        deltas.setdefault(e, [0] * len(BUCKET_PRIORITY))[r] -= 1
+    points = sorted({t0, t1, *deltas})
+    seconds: Dict[str, float] = {b: 0.0 for b in BUCKET_PRIORITY}
+    seconds[IDLE] = 0.0
+    active = [0] * len(BUCKET_PRIORITY)
+    for a, b in zip(points, points[1:]):
+        d = deltas.get(a)
+        if d is not None:
+            active = [n + dn for n, dn in zip(active, d)]
+        best: Optional[str] = next(
+            (name for name, n in zip(BUCKET_PRIORITY, active) if n > 0),
+            None)
+        seconds[best if best is not None else IDLE] += b - a
+
+    buckets = {
+        name: {
+            "seconds": round(secs, 3),
+            "fraction": round(secs / wall, 4),
+        }
+        for name, secs in seconds.items()
+    }
+    covered = sum(s for s in seconds.values())
+    productive = seconds["productive_step"]
+    return {
+        "metric": "goodput_fraction",
+        "value": round(productive / wall, 4),
+        "unit": "fraction",
+        "detail": {
+            "wall_s": round(wall, 3),
+            "buckets": buckets,
+            # buckets partition the wall by construction; quoted so the
+            # acceptance gate (≥0.99) is checkable from the artifact
+            "coverage": round(covered / wall, 4),
+            "badput_s": round(wall - productive - seconds[IDLE], 3),
+            "events": len(ordered),
+            "source": "event_timeline",
+        },
+    }

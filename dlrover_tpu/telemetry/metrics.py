@@ -34,14 +34,26 @@ DURATION_BUCKETS: Tuple[float, ...] = (
 )
 
 
+def _label_suffix(labels: Optional[Dict[str, str]]) -> str:
+    """Prometheus-style sorted label block ('' when unlabeled)."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{v}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
 class Counter:
     """Monotonically increasing count."""
 
     kind = "counter"
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else None
         self.value = 0.0
 
     def inc(self, v: float = 1.0) -> None:
@@ -53,9 +65,11 @@ class Gauge:
 
     kind = "gauge"
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else None
         self.value = 0.0
 
     def set(self, v: float) -> None:
@@ -70,15 +84,23 @@ class Gauge:
 
 def percentile_from_counts(bounds: Sequence[float],
                            counts: Sequence[int],
-                           q: float) -> Optional[float]:
+                           q: float,
+                           with_overflow: bool = False):
     """Approximate quantile (0 < q <= 1) over per-bucket counts
     (``len(counts) == len(bounds) + 1``, +Inf bucket last), with linear
     interpolation inside the winning bucket; None when empty. Taking
     counts explicitly lets callers diff two snapshots and quote the
-    quantiles of just the last window (the executor's speed log)."""
+    quantiles of just the last window (the executor's speed log).
+
+    A quantile landing in the +Inf bucket can only be reported as the
+    last finite bound — a silent clamp that would understate a pathology
+    precisely when it is worst. ``with_overflow=True`` returns
+    ``(value, overflow)`` instead, with ``overflow=True`` on a clamped
+    tail, so consumers (the straggler detector, diagnosis verdicts)
+    can treat the value as a LOWER bound rather than a measurement."""
     total = sum(counts)
     if total <= 0:
-        return None
+        return (None, False) if with_overflow else None
     rank = q * total
     cum = 0
     lo = 0.0
@@ -87,9 +109,11 @@ def percentile_from_counts(bounds: Sequence[float],
         cum += counts[i]
         if cum >= rank:
             frac = (rank - prev) / max(counts[i], 1)
-            return lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+            value = lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+            return (value, False) if with_overflow else value
         lo = bound
-    return bounds[-1]  # landed in the +Inf bucket
+    # landed in the +Inf bucket: the last finite bound is a CLAMP
+    return (bounds[-1], True) if with_overflow else bounds[-1]
 
 
 class Histogram:
@@ -103,9 +127,11 @@ class Histogram:
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "",
-                 buckets: Sequence[float] = DURATION_BUCKETS):
+                 buckets: Sequence[float] = DURATION_BUCKETS,
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else None
         bounds = sorted(float(b) for b in buckets)
         if not bounds:
             raise ValueError(f"histogram {name}: empty bucket list")
@@ -126,9 +152,12 @@ class Histogram:
                 return
         self.counts[-1] += 1
 
-    def percentile(self, q: float) -> Optional[float]:
-        """Approximate quantile (0 < q <= 1); None when empty."""
-        return percentile_from_counts(self.bounds, self.counts, q)
+    def percentile(self, q: float, with_overflow: bool = False):
+        """Approximate quantile (0 < q <= 1); None when empty. With
+        ``with_overflow=True`` returns ``(value, overflow)`` — overflow
+        marks a +Inf-bucket clamp (value is a lower bound)."""
+        return percentile_from_counts(
+            self.bounds, self.counts, q, with_overflow=with_overflow)
 
     def snapshot_counts(self) -> Tuple[int, ...]:
         """Point-in-time copy of the per-bucket counts — diff two of
@@ -157,8 +186,8 @@ class _NullMetric:
     def observe(self, v: float) -> None:
         pass
 
-    def percentile(self, q: float) -> Optional[float]:
-        return None
+    def percentile(self, q: float, with_overflow: bool = False):
+        return (None, False) if with_overflow else None
 
     def snapshot_counts(self) -> None:
         return None
@@ -168,39 +197,62 @@ _NULL_METRIC = _NullMetric()
 
 
 class MetricsRegistry:
-    """Name -> metric; creation is idempotent and thread-safe."""
+    """Name -> metric; creation is idempotent and thread-safe.
+
+    A metric may carry a label set (``labels={"node": "3"}``) — each
+    distinct (name, labels) pair is its own series (the per-node runtime
+    series the master exposes), rendered Prometheus-style as
+    ``name{node="3"}``. The NAME must still be a ``telemetry.names``
+    constant (DLR007); labels carry the per-entity dimension."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        # family name -> kind: one exposition family must hold ONE
+        # metric kind, or the rendered TYPE header lies for every
+        # labeled sibling (scrapers reject the whole family)
+        self._family_kinds: Dict[str, str] = {}
 
-    def _get_or_create(self, cls, name: str, help: str, **kwargs):
-        metric = self._metrics.get(name)
+    def _get_or_create(self, cls, name: str, help: str,
+                       labels: Optional[Dict[str, str]] = None, **kwargs):
+        key = name + _label_suffix(labels)
+        metric = self._metrics.get(key)
         if metric is None:
             with self._lock:
-                metric = self._metrics.get(name)
+                metric = self._metrics.get(key)
                 if metric is None:
-                    metric = cls(name, help=help, **kwargs)
-                    self._metrics[name] = metric
+                    family_kind = self._family_kinds.get(name)
+                    if family_kind is not None and family_kind != cls.kind:
+                        raise ValueError(
+                            f"metric family {name!r} already registered "
+                            f"as {family_kind}, requested {cls.kind}"
+                        )
+                    metric = cls(name, help=help, labels=labels, **kwargs)
+                    self._metrics[key] = metric
+                    self._family_kinds[name] = cls.kind
         if not isinstance(metric, cls):
             raise ValueError(
-                f"metric {name!r} already registered as {metric.kind}, "
+                f"metric {key!r} already registered as {metric.kind}, "
                 f"requested {cls.__name__.lower()}"
             )
         return metric
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get_or_create(Counter, name, help, labels=labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels=labels)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Sequence[float] = DURATION_BUCKETS) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+                  buckets: Sequence[float] = DURATION_BUCKETS,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels=labels,
+                                   buckets=buckets)
 
-    def get(self, name: str):
-        return self._metrics.get(name)
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None):
+        return self._metrics.get(name + _label_suffix(labels))
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
@@ -210,31 +262,41 @@ class MetricsRegistry:
         """Drop every metric (tests / bench A-B runs)."""
         with self._lock:
             self._metrics.clear()
+            self._family_kinds.clear()
 
     # -- exposition ----------------------------------------------------------
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4. Series sharing a
+        family name (labeled variants) render under ONE HELP/TYPE
+        header, each line carrying its label block."""
         lines: List[str] = []
-        for name in sorted(self.snapshot()):
-            m = self._metrics.get(name)
-            if m is None:
-                continue
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            lines.append(f"# TYPE {name} {m.kind}")
-            if isinstance(m, Histogram):
-                cum = 0
-                for i, bound in enumerate(m.bounds):
-                    cum += m.counts[i]
-                    lines.append(
-                        f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}'
-                    )
-                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
-                lines.append(f"{name}_sum {_fmt(m.sum)}")
-                lines.append(f"{name}_count {m.count}")
-            else:
-                lines.append(f"{name} {_fmt(m.value)}")
+        families: Dict[str, List] = {}
+        for key in sorted(self.snapshot()):
+            m = self._metrics.get(key)
+            if m is not None:
+                families.setdefault(m.name, []).append(m)
+        for name in sorted(families):
+            series = families[name]
+            help_text = next((m.help for m in series if m.help), "")
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {series[0].kind}")
+            for m in series:
+                base = dict(m.labels or {})
+                lbl = _label_suffix(base)
+                if isinstance(m, Histogram):
+                    cum = 0
+                    for i, bound in enumerate(m.bounds):
+                        cum += m.counts[i]
+                        le = _label_suffix({**base, "le": _fmt(bound)})
+                        lines.append(f"{name}_bucket{le} {cum}")
+                    le = _label_suffix({**base, "le": "+Inf"})
+                    lines.append(f"{name}_bucket{le} {m.count}")
+                    lines.append(f"{name}_sum{lbl} {_fmt(m.sum)}")
+                    lines.append(f"{name}_count{lbl} {m.count}")
+                else:
+                    lines.append(f"{name}{lbl} {_fmt(m.value)}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -249,17 +311,20 @@ def _fmt(v: float) -> str:
 class NullRegistry:
     """API-compatible black hole handed out when telemetry is off."""
 
-    def counter(self, name: str, help: str = "") -> _NullMetric:
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> _NullMetric:
         return _NULL_METRIC
 
-    def gauge(self, name: str, help: str = "") -> _NullMetric:
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> _NullMetric:
         return _NULL_METRIC
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Sequence[float] = DURATION_BUCKETS) -> _NullMetric:
+                  buckets: Sequence[float] = DURATION_BUCKETS,
+                  labels: Optional[Dict[str, str]] = None) -> _NullMetric:
         return _NULL_METRIC
 
-    def get(self, name: str):
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None):
         return None
 
     def snapshot(self) -> Dict[str, object]:

@@ -91,6 +91,29 @@ MASTER_RUNTIME_SAMPLES = "dlrover_master_runtime_samples_total"
 ERROR_REPORTS = "dlrover_error_reports_total"
 ERRORS_DEDUPED = "dlrover_error_reports_deduped_total"
 
+# -- cluster diagnosis plane (per-node runtime series on the master) ----------
+
+# worker-side: NodeRuntimeReport pushes sent / lost (the hook never
+# raises into the train loop)
+NODE_RUNTIME_REPORTS = "dlrover_node_runtime_reports_total"
+NODE_RUNTIME_REPORT_FAILURES = "dlrover_node_runtime_report_failures_total"
+# master-side per-node gauges (labeled {node="<id>"}), refreshed on
+# every ingested report — the /metrics view of the node series
+NODE_STEP_P50 = "dlrover_node_step_time_p50_seconds"
+NODE_STEP_P95 = "dlrover_node_step_time_p95_seconds"
+NODE_DISPATCH_P50 = "dlrover_node_dispatch_p50_seconds"
+NODE_HOST_SYNC_P50 = "dlrover_node_host_sync_p50_seconds"
+NODE_WINDOW_OCCUPANCY = "dlrover_node_dispatch_window_occupancy"
+NODE_RSS_MB = "dlrover_node_rss_mb"
+NODE_DEVICE_MEM_MB = "dlrover_node_device_mem_mb"
+NODE_STEPS_TOTAL = "dlrover_node_steps_total"
+NODE_REPORT_AGE = "dlrover_node_report_age_seconds"
+# master-side ingest counter + verdict counters
+NODE_REPORTS_INGESTED = "dlrover_master_node_reports_total"
+DIAG_STRAGGLERS = "dlrover_diagnosis_stragglers_total"
+DIAG_NODE_HANGS = "dlrover_diagnosis_node_hangs_total"
+DIAG_RECOVERIES = "dlrover_diagnosis_recoveries_total"
+
 
 class EventKind:
     """Event-timeline record kinds (``telemetry.events``). Failure-edge
@@ -132,8 +155,16 @@ class EventKind:
     # run lifecycle
     TRAIN_START = "train_start"
     TRAIN_END = "train_end"
+    # first materialized step after TRAIN_START: its latency is the
+    # trace+compile(+restore) cost — the goodput ledger's compile bucket
+    COMPILE_FIRST_STEP = "compile_first_step"
     # diagnosis
     ERROR_REPORT = "error_report"
+    # cluster diagnosis verdicts (master-side detector, evidence
+    # attached: node p50/p95, peer median, ratio, confirm windows)
+    DIAG_STRAGGLER = "diag_straggler"
+    DIAG_NODE_HANG = "diag_node_hang"
+    DIAG_RECOVERED = "diag_recovered"
 
 
 class SpanName:

@@ -16,6 +16,7 @@ from __future__ import annotations
 import collections
 import os
 import tempfile
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
@@ -132,6 +133,158 @@ class ReportModelInfoHook(TrainHook):
         except Exception:  # noqa: BLE001 — a dead master must not kill
             # training; the failure is counted so operators see the gap
             self._c_report_failures.inc()
+
+
+class NodeRuntimeReportHook(TrainHook):
+    """Push node-tagged snapshots of the PR 4 instruments to the master
+    every ``runtime_report_steps`` materialized steps — the input of the
+    cluster diagnosis plane (``master/monitor/node_series.py``).
+
+    Snapshots are CUMULATIVE histogram bucket counts (the master diffs
+    consecutive reports into per-window series), plus window occupancy,
+    lagged-metric age, process RSS and accelerator ``bytes_in_use``
+    where the backend exposes it.
+
+    The step path only SNAPSHOTS (a few tuple copies) and enqueues; the
+    RPC, the ``/proc`` RSS read, and the device memory query run on a
+    background daemon sender thread. Backpressure drops the report (the
+    next cadence supersedes it) — monitoring must never stall the loop,
+    and a dead master is a counted gap, not a crash. The send rate is
+    additionally floored by ``min_interval_s`` (default: the master's
+    ``seconds_interval_to_report``), so a fast-stepping job cannot
+    flood the master — or tax itself — with per-step-scale report
+    traffic: reporting overhead scales with WALL time, not step count.
+    """
+
+    def __init__(self, master_client, every_steps: Optional[int] = None,
+                 registry=None, min_interval_s: Optional[float] = None):
+        import queue
+
+        ctx = get_context()
+        self._client = master_client
+        self._every = int(
+            every_steps if every_steps is not None
+            else getattr(ctx, "runtime_report_steps", 32))
+        self._min_interval = float(
+            min_interval_s if min_interval_s is not None
+            else getattr(ctx, "seconds_interval_to_report", 15))
+        self._last_send = 0.0
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._sender: Optional[threading.Thread] = None
+        # the instruments this hook snapshots (same handles the
+        # executor observes into); a test may pass a private registry
+        # to simulate several nodes in one process
+        reg = registry if registry is not None else get_registry()
+        self._h_step = reg.histogram(tm.STEP_TIME)
+        self._h_dispatch = reg.histogram(tm.STEP_DISPATCH_TIME)
+        self._h_sync = reg.histogram(tm.STEP_HOST_SYNC_TIME)
+        self._g_window = reg.gauge(tm.DISPATCH_WINDOW_OCCUPANCY)
+        self._g_lag = reg.gauge(tm.LAGGED_METRIC_AGE)
+        self._c_steps = reg.counter(tm.TRAIN_STEPS)
+        self._c_sent = get_registry().counter(
+            tm.NODE_RUNTIME_REPORTS,
+            help="node runtime snapshots pushed to the master")
+        self._c_failed = get_registry().counter(
+            tm.NODE_RUNTIME_REPORT_FAILURES,
+            help="runtime snapshots the master never acked")
+        self._devices = None
+
+    def _rss_mb(self) -> float:
+        try:
+            import psutil
+
+            return psutil.Process().memory_info().rss / (1024 * 1024)
+        except Exception:  # noqa: BLE001 — psutil-less hosts
+            logger.debug("psutil rss read failed; using getrusage",
+                         exc_info=True)
+            import resource
+
+            # ru_maxrss is KB on Linux (peak, not current — good enough)
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+    def _device_mem_mb(self) -> float:
+        try:
+            import jax
+
+            if self._devices is None:
+                self._devices = jax.local_devices()
+            total = 0
+            for d in self._devices:
+                stats_fn = getattr(d, "memory_stats", None)
+                stats = stats_fn() if stats_fn is not None else None
+                if stats:
+                    total += int(stats.get("bytes_in_use", 0))
+            return total / (1024 * 1024)
+        except Exception:  # noqa: BLE001 — CPU backends return nothing
+            logger.debug("device memory_stats unavailable",
+                         exc_info=True)
+            return 0.0
+
+    def after_step(self, step: int, metrics: Dict[str, Any]):
+        if self._every <= 0 or step % self._every:
+            return
+        now = time.monotonic()
+        if now - self._last_send < self._min_interval:
+            return
+        self._last_send = now
+        import queue
+
+        bounds = getattr(self._h_step, "bounds", None)  # null when off
+        counts = self._h_step.snapshot_counts()
+        payload = dict(
+            step=step,
+            steps_total=float(self._c_steps.value),
+            bounds=list(bounds) if bounds else None,
+            step_time_counts=list(counts) if counts else None,
+            dispatch_counts=(
+                list(self._h_dispatch.snapshot_counts() or []) or None),
+            host_sync_counts=(
+                list(self._h_sync.snapshot_counts() or []) or None),
+            window_occupancy=float(self._g_window.value),
+            lagged_age=float(self._g_lag.value),
+        )
+        if self._sender is None or not self._sender.is_alive():
+            self._sender = threading.Thread(
+                target=self._send_loop, name="node-runtime-report",
+                daemon=True,
+            )
+            self._sender.start()
+        try:
+            self._queue.put_nowait(payload)
+        except queue.Full:
+            # sender is behind (slow/dead master): drop — the next
+            # cadence's cumulative snapshot supersedes this one
+            self._c_failed.inc()
+
+    def _send_loop(self):
+        while True:
+            payload = self._queue.get()
+            if payload is None:
+                return
+            try:
+                payload["rss_mb"] = round(self._rss_mb(), 1)
+                payload["device_mem_mb"] = round(
+                    self._device_mem_mb(), 1)
+                self._client.report_node_runtime(**payload)
+                self._c_sent.inc()
+            except Exception:  # noqa: BLE001 — a dead master must not
+                # kill reporting; the gap is counted for operators
+                self._c_failed.inc()
+                logger.debug("node runtime report failed",
+                             exc_info=True)
+
+    def end(self, executor: "TrainExecutor"):
+        """Flush: stop the sender after the queued reports drain (join
+        bounded — exit must not hang on a dead master)."""
+        if self._sender is None or not self._sender.is_alive():
+            return
+        try:
+            self._queue.put_nowait(None)
+        except Exception:  # noqa: BLE001 — full queue: sender is wedged
+            logger.debug("runtime report queue full at end", exc_info=True)
+            return
+        self._sender.join(timeout=5.0)
 
 
 class TrainExecutor:
@@ -253,6 +406,20 @@ class TrainExecutor:
         self._rollbacks = 0
         self._last_metrics: Optional[Dict[str, Any]] = None
         self._master_client = master_client
+        # cluster diagnosis: node-tagged runtime snapshots ride the
+        # master connection automatically (runtime_report_steps=0 or an
+        # explicit hook instance opts out)
+        report_steps = int(conf.get(
+            "runtime_report_steps",
+            getattr(ctx, "runtime_report_steps", 32)))
+        if master_client is not None and report_steps > 0 and not any(
+            isinstance(h, NodeRuntimeReportHook) for h in self._hooks
+        ):
+            self._hooks.append(NodeRuntimeReportHook(
+                master_client, every_steps=report_steps))
+        # time-to-first-materialized-step after TRAIN_START: the
+        # trace+compile(+restore) cost, the goodput compile bucket
+        self._train_started_mono: Optional[float] = None
         self._restart_requested = False
         # live recovery (the in-process scale path): a survivable
         # membership change drains the window, snapshots to host DRAM,
@@ -454,12 +621,29 @@ class TrainExecutor:
             self.state = self._trainer.live_reshard(
                 self.state, devices=devices, reason="executor"
             )
+            # the resumed step may be behind the max() the master saw
+            # (the snapshot covers the last DRAINED step): reset the
+            # speed monitor so its gauge/series track the truth
+            self._report_step_reset()
             return
         if not self._restart_requested:
             return
         self._restart_requested = False
         logger.info("rebuilding training session (membership change)")
         self.state = self._trainer.on_world_change(self.state)
+
+    def _report_step_reset(self):
+        """Tell the master the true global step REWOUND (rollback / live
+        reshard) so ``SpeedMonitor.reset_step`` unpins the monotone
+        max() gauge and restarts the speed window."""
+        if self._master_client is None:
+            return
+        try:
+            self._master_client.report_global_step(
+                int(self.state.step), reset=True)
+        except Exception:  # noqa: BLE001 — a dead master must not block
+            # the recovery path; the gap only stales the speed gauge
+            logger.debug("step reset report failed", exc_info=True)
 
     def _world_actually_changed(self) -> bool:
         """Whether the ambient device world differs from the mesh the
@@ -521,7 +705,17 @@ class TrainExecutor:
 
     def _handle_nonfinite(self, step: int, metrics: Dict[str, Any]) -> bool:
         """Report the failure and apply the policy. Returns True when the
-        loop must re-enter (rollback restored an older state)."""
+        loop must re-enter (rollback restored an older state). The whole
+        failure → recovery edge runs under one freshly minted incident
+        trace id, so the NONFINITE_STEP / ROLLBACK_RESTORED events and
+        the master's ingress-side records correlate."""
+        from dlrover_tpu.telemetry.trace_context import trace_scope
+
+        with trace_scope():
+            return self._handle_nonfinite_scoped(step, metrics)
+
+    def _handle_nonfinite_scoped(self, step: int,
+                                 metrics: Dict[str, Any]) -> bool:
         detail = self._report_nonfinite(step, metrics)
         if self._on_nonfinite == "rollback":
             latest = getattr(
@@ -555,6 +749,7 @@ class TrainExecutor:
             emit_event(EventKind.ROLLBACK_RESTORED, step=step,
                        restored_step=int(self.state.step),
                        rollback=self._rollbacks)
+            self._report_step_reset()
             return True
         if self._on_nonfinite == "ignore":
             return False
@@ -587,6 +782,22 @@ class TrainExecutor:
             host = jax.device_get(entry.metrics)
         now = time.monotonic()
         self._h_host_sync.observe(now - t_sync)
+        if self._train_started_mono is not None:
+            # first materialization of the run: its latency is
+            # dominated by trace+compile (+restore) — the goodput
+            # ledger's compile bucket reads it from this event
+            emit_event(EventKind.COMPILE_FIRST_STEP,
+                       step=entry.last_step,
+                       seconds=round(now - self._train_started_mono, 3))
+            self._train_started_mono = None
+            # an incident trace id inherited from the agent's
+            # environment covers the RECOVERY (startup → first step),
+            # not the rest of this worker's life: consume it here so
+            # hours-later routine events don't mis-correlate to a
+            # closed incident
+            from dlrover_tpu.telemetry.trace_context import TRACE_ID_ENV
+
+            os.environ.pop(TRACE_ID_ENV, None)
         # per-step wall time: the interval since the previous
         # materialization, amortized over the steps this call carried
         # (exact for K=1; the group average for a fused K-step call)
@@ -685,6 +896,7 @@ class TrainExecutor:
         k_call = max(1, int(getattr(self._trainer, "steps_per_call", 1)))
         self._dispatched_step = step
         self._window.clear()
+        self._train_started_mono = time.monotonic()
         emit_event(EventKind.TRAIN_START, step=step,
                    train_window=window, steps_per_call=k_call)
         try:

@@ -157,9 +157,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from dlrover_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
-    if argv and argv[0] in ("metrics", "mttr", "events", "cache"):
+    if argv and argv[0] in ("metrics", "mttr", "goodput", "diagnose",
+                            "events", "trace", "cache"):
         # `tpurun metrics [--addr host:port]` / `tpurun mttr ...` /
-        # `tpurun cache` — the observability CLI (docs/observability.md)
+        # `tpurun goodput` / `tpurun diagnose` / `tpurun cache` — the
+        # observability CLI (docs/observability.md)
         from dlrover_tpu.telemetry.cli import main as telemetry_main
 
         return telemetry_main(argv)

@@ -294,6 +294,54 @@ class TestDLR007UnregisteredMetricName:
         assert findings == []
 
 
+class TestDLR008FailureEventErrorCode:
+    def test_fires_on_missing_or_empty_code(self):
+        findings = lint_snip("""
+            from dlrover_tpu.telemetry import EventKind, emit_event
+
+            def report(rank):
+                emit_event(EventKind.WORKER_FAILED, local_rank=rank)
+                emit_event(EventKind.HANG_DETECTED, error_code="")
+        """)
+        assert rules_of(findings) == ["DLR008"]
+        assert len(findings) == 2
+
+    def test_fires_on_string_literal_kind(self):
+        # inside the telemetry package a literal kind is DLR007-exempt,
+        # but the failure-class code requirement still applies
+        findings = lint_source(
+            "from dlrover_tpu.telemetry import emit_event\n"
+            "def f():\n"
+            "    emit_event('diag_straggler', diag_node=2)\n",
+            "dlrover_tpu/telemetry/whatever.py",
+        )
+        assert rules_of(findings) == ["DLR008"]
+
+    def test_clean_with_codes_and_on_non_failure_kinds(self):
+        findings = lint_snip("""
+            from dlrover_tpu.telemetry import EventKind, emit_event
+
+            def report(rc, reason):
+                emit_event(EventKind.WORKER_FAILED,
+                           error_code=f"EXIT_{rc}")
+                emit_event(EventKind.ERROR_REPORT, error_code=reason)
+                emit_event(EventKind.TRAIN_START, step=0)
+                emit_event(EventKind.WORKERS_STARTED, round=1)
+        """)
+        assert findings == []
+
+    def test_telemetry_package_is_not_exempt(self):
+        # unlike DLR007, a failure emit inside the telemetry package
+        # itself must still carry a code
+        findings = lint_source(
+            "from dlrover_tpu.telemetry import EventKind, emit_event\n"
+            "def f():\n"
+            "    emit_event(EventKind.NONFINITE_STEP, step=1)\n",
+            "dlrover_tpu/telemetry/whatever.py",
+        )
+        assert rules_of(findings) == ["DLR008"]
+
+
 class TestBaseline:
     def test_filter_allows_counts_and_reports_stale(self):
         f1 = Finding("DLR002", "a.py", 10, "m", scope="A.f")

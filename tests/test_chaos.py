@@ -93,6 +93,62 @@ def test_external_sigkill_triggers_restart(master, tmp_path, monkeypatch):
     assert report["value"] > 0
     assert "error" not in report, report
 
+    # -- cross-process trace correlation: the incident id minted at
+    # failure detection must stamp the AGENT's failure edge, the
+    # MASTER's ingress-side error_report (propagated through gRPC
+    # metadata), the recovery edge, and the relaunched WORKER's own
+    # startup events (propagated through the worker environment)
+    from dlrover_tpu.telemetry import read_events
+
+    records = read_events(events_path)
+    failed = [r for r in records if r["kind"] == "worker_failed"]
+    assert failed, records
+    tid = failed[0].get("trace_id", "")
+    assert tid.startswith("inc-"), failed[0]
+    stamped = {r["kind"] for r in records if r.get("trace_id") == tid}
+    assert "error_report" in stamped, stamped  # master ingress (RPC md)
+    assert "workers_started" in stamped, stamped  # agent recovery edge
+    assert "train_start" in stamped, stamped  # relaunched worker (env)
+    stamped_pids = {r["pid"] for r in records
+                    if r.get("trace_id") == tid}
+    assert len(stamped_pids) >= 2, (
+        "the incident id never crossed a process boundary")
+
+    # -- merged Perfetto trace: the incident's master/agent/worker
+    # records land in ONE view, joined by the shared trace id
+    from dlrover_tpu.telemetry.correlate import (
+        export_merged_trace,
+        incident_records,
+    )
+
+    merged_path = str(tmp_path / "merged_trace.json")
+    n = export_merged_trace(records, merged_path)
+    assert n > 0
+    import json
+
+    payload = json.load(open(merged_path))
+    names_seen = {e["name"] for e in payload["traceEvents"]}
+    assert "worker_failure" in names_seen  # incident downtime span
+    chain = incident_records(records)[tid]
+    assert len(chain) >= 3
+
+    # -- goodput ledger over the same timeline: buckets partition the
+    # job wall-time (>= 99%) and the restart downtime is attributed
+    from dlrover_tpu.telemetry.goodput import derive_goodput
+
+    ledger = derive_goodput(records)
+    assert ledger["detail"]["coverage"] >= 0.99, ledger
+    assert ledger["detail"]["buckets"]["restart"]["seconds"] > 0, ledger
+
+    # -- the CLI gate: `tpurun goodput` / `tpurun diagnose` must keep
+    # working against a real chaos timeline (exit 0, parseable output)
+    from dlrover_tpu.trainer.run import main as tpurun
+
+    assert tpurun(["goodput", "--events", events_path]) == 0
+    assert tpurun(["diagnose", "--events", events_path]) == 0
+    assert tpurun(["trace", "--events", events_path,
+                   "--out", str(tmp_path / "cli_trace.json")]) == 0
+
 
 def test_hang_without_heartbeat_triggers_relaunch(master, tmp_path,
                                                   monkeypatch):

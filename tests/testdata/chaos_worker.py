@@ -13,5 +13,12 @@ if restart_round == 0:
     for _ in range(100):  # ~20 s — the test kills us long before
         time.sleep(0.2)
     sys.exit(0)
+# the relaunched round emits its lifecycle edges into the shared
+# timeline: with the agent's incident trace id riding the worker env,
+# these records correlate the WORKER side of the recovery
+from dlrover_tpu.telemetry import EventKind, emit_event  # noqa: E402
+
+emit_event(EventKind.TRAIN_START, step=0)
 print(f"chaos worker: round {restart_round}, finishing", flush=True)
+emit_event(EventKind.TRAIN_END, step=0)
 sys.exit(0)
