@@ -217,6 +217,23 @@ class MasterClient:
         except ValueError:
             return {}
 
+    def report_trainer_config(self, **kwargs) -> comm.Response:
+        """Report the config the trainer actually runs (the runtime
+        optimizer's input; a non-empty plan_id acks an applied plan)."""
+        kwargs.setdefault("node_id", self.node_id)
+        return self._channel.report(comm.TrainerConfigReport(**kwargs))
+
+    def get_plan(self, limit: int = 0) -> dict:
+        """The master's runtime-optimizer report: running config,
+        calibration factors, decision trail (``tpurun plan --addr``)."""
+        import json
+
+        resp = self._channel.get(comm.PlanRequest(limit=limit))
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
+
     def report_heartbeat(self) -> comm.Response:
         return self._channel.report(comm.NodeHeartbeat(
             node_id=self.node_id, timestamp=time.time()

@@ -351,17 +351,71 @@ class ModelInfo:
 
 @message
 class ParallelConfig:
-    """Mesh/partition decisions the master can push to agents at runtime."""
+    """Mesh/partition decisions the master can push to agents at runtime.
+
+    The runtime optimizer (``master/optimizer``) publishes its chosen
+    plans through this message: a non-empty ``plan_id`` marks an
+    optimizer plan, and workers polling ``get_parallel_config``
+    (``OptimizerPlanHook``) apply it LIVE — ``restart=False`` means
+    drain the window and retune/reshard in place; sentinel values
+    (``train_window=-1``, ``steps_per_call=0``) leave a knob unchanged.
+    """
 
     mesh_shape: Optional[Dict[str, int]] = None
     remat_policy: str = ""
     grad_accum_steps: int = 1
     restart: bool = False
+    # -1 / 0 / "" = leave the knob as the worker currently runs it
+    train_window: int = -1
+    steps_per_call: int = 0
+    moe_dispatch: str = ""
+    # optimizer decision identity: the worker echoes plan_id back in its
+    # TrainerConfigReport ack, and every OPTIMIZER_* event on both sides
+    # carries trace_id so the decision trail merges per incident
+    plan_id: str = ""
+    trace_id: str = ""
+    predicted_speedup: float = 0.0
+    # standby-compile the candidate program before swapping, so the swap
+    # itself pays zero recompiles (ElasticTrainer.prewarm)
+    prewarm: bool = True
 
 
 @message
 class ParallelConfigRequest:
     node_id: int = -1
+
+
+@message
+class TrainerConfigReport:
+    """Worker -> master: the config the trainer is ACTUALLY running —
+    the runtime optimizer's running-config input (sent at train start
+    and after every live reshard/retune). A non-empty ``plan_id`` acks
+    an applied optimizer plan, carrying the realized speedup the
+    post-apply window measured."""
+
+    node_id: int = -1
+    world: int = 0  # devices in the active mesh
+    mesh_shape: Optional[Dict[str, int]] = None
+    train_window: int = 0
+    steps_per_call: int = 1
+    moe_dispatch: str = ""
+    global_batch: int = 0
+    plan_id: str = ""
+    predicted_speedup: float = 0.0
+    realized_speedup: float = 0.0
+    # negative ack: the plan could not be applied (rebuild failed, or
+    # the knobs are unsupported on this deployment) — the optimizer
+    # blacklists the knob tuple instead of re-proposing it forever
+    apply_failed: bool = False
+
+
+@message
+class PlanRequest:
+    """Query the master's runtime optimizer: running config, calibration
+    factors, candidate tables and the decision trail (the ``tpurun plan
+    --addr`` view). Answered with a DiagnosisReport-style JSON blob."""
+
+    limit: int = 0  # 0 = the full retained decision trail
 
 
 # --------------------------------------------------------------------------

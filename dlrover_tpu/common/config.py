@@ -118,6 +118,27 @@ class Context:
         # signal name ("" = off, e.g. "USR2") that opens an on-demand
         # bounded jax.profiler trace window in the executor
         self.profile_signal = ""
+        # runtime optimization loop (master/optimizer; the telemetry ->
+        # planner -> live-reshard control loop, docs/operations.md
+        # "Self-tuning"): master switch for re-planning on diagnosis
+        # verdicts / world changes
+        self.runtime_optimizer_enabled = True
+        # hysteresis: a candidate plan must predict at least this
+        # speedup over the calibrated estimate of the CURRENT config to
+        # be published (1.2 = 20% — below that the drain + swap churn
+        # outweighs the win)
+        self.replan_min_speedup = 1.2
+        # cooldown/dedup window: the identical plan proposed twice
+        # within this many seconds is suppressed (flapping triggers
+        # cannot thrash the job through the same plan)
+        self.replan_cooldown_secs = 60.0
+        # worker-side: wall seconds between get_parallel_config polls
+        # for a master-published plan (0 = the OptimizerPlanHook is off)
+        self.plan_poll_secs = 30.0
+        # worker-side: materialized steps after a live plan apply
+        # before the realized speedup is measured and OPTIMIZER_APPLIED
+        # is emitted (the post-convergence window)
+        self.plan_measure_steps = 16
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

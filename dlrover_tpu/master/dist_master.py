@@ -111,9 +111,19 @@ class DistributedJobMaster:
         )
         self._server, self.port = build_server(self.servicer, port=port)
         self.addr = f"127.0.0.1:{self.port}"
+        # a RECOVERED diagnosis verdict re-evaluates the auto-scaler
+        # immediately: optimize_once defers while verdicts are active,
+        # so waiting out the periodic tick after the incident clears
+        # would add up to a full scaler period of recovery latency
+        self.servicer.straggler_detector.add_verdict_listener(
+            self._on_diag_verdict)
         self._stopped = threading.Event()
         self._exit_reason = ""
         self._ctx = get_context()
+
+    def _on_diag_verdict(self, node_id: int, verdict: str):
+        if verdict == "healthy":
+            self.job_auto_scaler.request_immediate_evaluation()
 
     def _build_backend(self, platform, scaler, watcher):
         if scaler is not None and watcher is not None:

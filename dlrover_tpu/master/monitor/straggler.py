@@ -42,7 +42,7 @@ from dlrover_tpu.telemetry import (
     get_registry,
     names as tm,
 )
-from dlrover_tpu.telemetry.trace_context import new_trace_id
+from dlrover_tpu.telemetry.trace_context import new_trace_id, trace_scope
 
 logger = get_logger("master.straggler")
 
@@ -102,6 +102,15 @@ class StragglerDetector:
         self._lock = threading.Lock()
         self._over_counts: Dict[int, int] = {}
         self._verdicts: Dict[int, NodeVerdict] = {}
+        # verdict-change listeners (runtime optimizer re-plan trigger,
+        # auto-scaler immediate re-evaluation on recovery): called with
+        # (node_id, verdict) where verdict is "healthy" on clear/depart.
+        # Registered post-construction (add_verdict_listener) so owners
+        # built after the detector (dist master's scaler) can attach.
+        self._listeners: List = []
+        # (node_id, verdict, trace_id) queued under the lock, delivered
+        # outside it by _drain_notices
+        self._pending_notices: List = []
         reg = get_registry()
         self._c_stragglers = reg.counter(
             tm.DIAG_STRAGGLERS, help="straggler verdicts confirmed")
@@ -109,6 +118,36 @@ class StragglerDetector:
             tm.DIAG_NODE_HANGS, help="node-hang verdicts confirmed")
         self._c_recoveries = reg.counter(
             tm.DIAG_RECOVERIES, help="verdicts cleared by recovery")
+
+    def add_verdict_listener(self, fn) -> None:
+        """Register a ``fn(node_id, verdict)`` callback fired on every
+        verdict CHANGE (flag, recovery, departure — the latter two as
+        "healthy"). Listeners run OUTSIDE the detector lock (a slow or
+        re-entrant listener — the runtime optimizer's full re-plan pass
+        — must neither block other nodes' report ingest nor deadlock),
+        under the verdict's trace scope so everything they emit joins
+        the incident's trail. Listener failures are logged, never
+        raised into the ingest path."""
+        self._listeners.append(fn)
+
+    def _notify(self, node_id: int, verdict: str, trace_id: str) -> None:
+        """Queue a verdict-change notification (lock held); delivered by
+        ``_drain_notices`` after the locked region exits."""
+        self._pending_notices.append((node_id, verdict, trace_id))
+
+    def _drain_notices(self) -> None:
+        with self._lock:
+            pending, self._pending_notices = self._pending_notices, []
+        for node_id, verdict, tid in pending:
+            with trace_scope(tid or None):
+                for fn in self._listeners:
+                    try:
+                        fn(node_id, verdict)
+                    except Exception:  # noqa: BLE001 — must not kill ingest
+                        logger.exception(
+                            "verdict listener failed for node %d (%s)",
+                            node_id, verdict,
+                        )
 
     # -- evaluation ----------------------------------------------------------
 
@@ -120,6 +159,7 @@ class StragglerDetector:
         with self._lock:
             self._clear_if_hung(node_id, now)
             self._judge_straggler(node_id, now)
+        self._drain_notices()
         self.scan_hangs(now)
 
     def scan_hangs(self, now: Optional[float] = None) -> None:
@@ -159,6 +199,7 @@ class StragglerDetector:
                         "freshest_peer_age_s": round(freshest, 1),
                     },
                 )
+        self._drain_notices()
 
     def _judge_straggler(self, node_id: int, now: float) -> None:
         mine = self._store.latest(node_id)
@@ -230,6 +271,7 @@ class StragglerDetector:
         logger.warning("node %d diagnosed %s [%s]: %s",
                        node_id, verdict, tid, evidence)
         self._push_verdict(node_id)
+        self._notify(node_id, verdict, tid)
 
     def _clear_if(self, node_id: int, verdict: str, now: float,
                   **extra) -> None:
@@ -252,6 +294,7 @@ class StragglerDetector:
                     node_id, VERDICT_HEALTHY)
             except Exception:  # noqa: BLE001 — verdicts must not kill ingest
                 logger.exception("failed to push verdict to speed monitor")
+        self._notify(node_id, VERDICT_HEALTHY, cur.trace_id)
 
     def _clear_if_hung(self, node_id: int, now: float) -> None:
         self._clear_if(node_id, VERDICT_HUNG, now)
@@ -275,6 +318,8 @@ class StragglerDetector:
                     node_id, VERDICT_HEALTHY)
             except Exception:  # noqa: BLE001 — cleanup must not raise
                 logger.exception("failed to clear departed verdict")
+        if cur is not None and cur.verdict != VERDICT_HEALTHY:
+            self._notify(node_id, VERDICT_HEALTHY, cur.trace_id)
 
     def _push_verdict(self, node_id: int) -> None:
         if self._speed_monitor is None:

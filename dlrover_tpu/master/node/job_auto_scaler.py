@@ -38,6 +38,11 @@ class JobAutoScaler:
         ctx = get_context()
         self._interval = interval_secs or ctx.seconds_interval_to_optimize
         self._stopped = threading.Event()
+        # out-of-band wakeup: a cleared diagnosis verdict (DIAG_RECOVERED
+        # / verdict pop) schedules an IMMEDIATE re-evaluation instead of
+        # waiting out the rest of the scaler period — recovery latency
+        # must not be bounded by the periodic tick
+        self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_plan_time = 0.0
         self.started = False
@@ -53,10 +58,18 @@ class JobAutoScaler:
 
     def stop(self):
         self._stopped.set()
+        self._wake.set()  # unblock a loop parked mid-interval
+
+    def request_immediate_evaluation(self):
+        """Wake the control loop NOW (verdict recovery listener): the
+        next optimize_once runs as soon as the loop services the event
+        instead of after the remaining scaler period."""
+        self._wake.set()
 
     def _periodic_optimize(self):
         while not self._stopped.is_set():
-            self._stopped.wait(self._interval)
+            self._wake.wait(self._interval)
+            self._wake.clear()
             if self._stopped.is_set():
                 return
             try:

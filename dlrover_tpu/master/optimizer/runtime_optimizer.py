@@ -1,0 +1,712 @@
+"""Master-side runtime optimizer: the closed control loop.
+
+Triggers (straggler/hang verdicts from ``master/monitor/straggler.py``,
+``DIAG_RECOVERED``, world changes reported by resharded workers) run a
+re-plan pass: calibrate the planner's cost model against the measured
+node series (``calibration``), enumerate candidate configs — mesh shape
+for the current world, ``train_window``, ``steps_per_call``, MoE
+dispatch mode — price every one through the calibrated estimate, and
+publish the winner as a ``ParallelConfig`` plan the workers apply LIVE
+(``OptimizerPlanHook`` → executor retune → program cache / live
+reshard; no process restart).
+
+Guard rails so the loop cannot oscillate:
+
+  hysteresis   a plan must predict ≥ ``replan_min_speedup`` over the
+               calibrated estimate of the CURRENT config;
+  cooldown     the identical candidate proposed twice within
+               ``replan_cooldown_secs`` is suppressed
+               (``parallel.search.ProposalCooldown``);
+  tie-break    equal-price candidates sort by distance from the current
+               knobs, so "no change" always beats gratuitous churn.
+
+Every decision (candidates priced, plan chosen/rejected, calibration
+factors, predicted-vs-realized speedup) lands in the event timeline as
+``OPTIMIZER_*`` records under one incident trace id; ``tpurun plan``
+renders the live table (``PlanRequest`` RPC) and the forensic trail
+(``decision_trail_from_events``).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.optimizer.calibration import CostCalibrator
+from dlrover_tpu.parallel.mesh import (
+    MeshPlan,
+    candidate_plans,
+    mesh_axes_key,
+)
+from dlrover_tpu.parallel.planner import DeviceSpec, ModelSpec
+from dlrover_tpu.parallel.search import ProposalCooldown
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
+from dlrover_tpu.telemetry.trace_context import (
+    current_trace_id,
+    trace_scope,
+)
+
+logger = get_logger("master.optimizer")
+
+# how many top-priced candidates ride along in events / the plan report
+_TABLE_ROWS = 8
+# bound on the retained decision trail
+_MAX_DECISIONS = 64
+# a node's latest sample older than this does not anchor calibration
+_CALIBRATION_FRESHNESS_S = 600.0
+
+STEPS_PER_CALL_OPTIONS = (1, 2, 4, 8)
+# priced by the cost model, but NOT yet live-appliable: a dispatch-mode
+# change rebuilds the model, and enumeration is gated on the calibrator
+# seeing num_experts > 0 — which comm.ModelInfo does not carry yet, so
+# today every candidate keeps the running mode. Wire ModelInfo experts
+# + a model-rebuild apply path before enabling this knob for real.
+MOE_DISPATCH_OPTIONS = ("gather", "einsum", "grouped", "grouped_ep")
+
+
+def _mesh_dict(mesh: MeshPlan) -> Dict[str, int]:
+    return {k: int(v) for k, v in mesh.axis_sizes().items()}
+
+
+@dataclass
+class RunningConfig:
+    """What the workers report they are actually running."""
+
+    mesh: MeshPlan
+    world: int
+    train_window: int = 4
+    steps_per_call: int = 1
+    moe_dispatch: str = ""
+    global_batch: int = 0
+
+    @classmethod
+    def from_report(cls, report: comm.TrainerConfigReport
+                    ) -> "RunningConfig":
+        shape = dict(report.mesh_shape or {})
+        mesh = MeshPlan(**{
+            k: int(v) for k, v in shape.items()
+            if k in ("pipe", "data", "fsdp", "seq", "tensor")
+        }) if shape else MeshPlan(data=max(1, report.world))
+        return cls(
+            mesh=mesh,
+            world=int(report.world or 0),
+            train_window=int(report.train_window),
+            steps_per_call=max(1, int(report.steps_per_call)),
+            moe_dispatch=report.moe_dispatch or "",
+            global_batch=int(report.global_batch or 0),
+        )
+
+    def to_dict(self) -> Dict:
+        return {
+            "mesh": _mesh_dict(self.mesh),
+            "world": self.world,
+            "train_window": self.train_window,
+            "steps_per_call": self.steps_per_call,
+            "moe_dispatch": self.moe_dispatch,
+            "global_batch": self.global_batch,
+        }
+
+
+@dataclass
+class CandidateScore:
+    """One priced candidate config."""
+
+    mesh: MeshPlan
+    steps_per_call: int
+    train_window: int
+    moe_dispatch: str
+    predicted_step_s: float = 0.0
+    speedup: float = 0.0  # current predicted / this predicted
+
+    @property
+    def key(self) -> str:
+        return (
+            f"mesh={mesh_axes_key(self.mesh)}"
+            f"|k={self.steps_per_call}|w={self.train_window}"
+            f"|moe={self.moe_dispatch}"
+        )
+
+    def to_dict(self) -> Dict:
+        return {
+            "mesh": _mesh_dict(self.mesh),
+            "steps_per_call": self.steps_per_call,
+            "train_window": self.train_window,
+            "moe_dispatch": self.moe_dispatch,
+            "predicted_step_s": round(self.predicted_step_s, 6),
+            "speedup": round(self.speedup, 3),
+        }
+
+
+@dataclass
+class Decision:
+    """One re-plan pass: what was priced, what was decided, and — once
+    the worker's post-apply window lands — what it actually bought."""
+
+    trigger: str
+    trace_id: str
+    ts: float
+    outcome: str = "rejected"  # "chosen" | "rejected"
+    reason: str = ""
+    plan_id: str = ""
+    current: Dict = field(default_factory=dict)
+    current_predicted_s: float = 0.0
+    candidates: List[Dict] = field(default_factory=list)
+    chosen: Optional[Dict] = None
+    predicted_speedup: float = 0.0
+    corrections: Dict = field(default_factory=dict)
+    applied: bool = False
+    apply_failed: bool = False
+    realized_speedup: Optional[float] = None
+    # the chosen candidate's knob-tuple key (blacklist identity on a
+    # failed apply); not part of the reported dict
+    chosen_key: str = ""
+
+    def to_dict(self) -> Dict:
+        return {
+            "trigger": self.trigger,
+            "trace_id": self.trace_id,
+            "ts": self.ts,
+            "outcome": self.outcome,
+            "reason": self.reason,
+            "plan_id": self.plan_id,
+            "current": dict(self.current),
+            "current_predicted_s": round(self.current_predicted_s, 6),
+            "candidates": list(self.candidates),
+            "chosen": dict(self.chosen) if self.chosen else None,
+            "predicted_speedup": round(self.predicted_speedup, 3),
+            "corrections": dict(self.corrections),
+            "applied": self.applied,
+            "apply_failed": self.apply_failed,
+            "realized_speedup": self.realized_speedup,
+        }
+
+
+class RuntimeOptimizer:
+    """The loop brain. Thread-safe: triggers arrive from RPC handler
+    threads and the master's periodic stats loop."""
+
+    def __init__(
+        self,
+        store,
+        publish: Optional[Callable[[comm.ParallelConfig], None]] = None,
+        retract: Optional[Callable[[str], None]] = None,
+        device: Optional[DeviceSpec] = None,
+        min_speedup: Optional[float] = None,
+        cooldown_secs: Optional[float] = None,
+        enabled: Optional[bool] = None,
+        mesh_candidates: bool = True,
+    ):
+        ctx = get_context()
+        self._store = store
+        self._publish = publish
+        self._retract = retract
+        self._device = device or DeviceSpec()
+        self._min_speedup = float(
+            min_speedup if min_speedup is not None
+            else getattr(ctx, "replan_min_speedup", 1.2))
+        self._cooldown = ProposalCooldown(float(
+            cooldown_secs if cooldown_secs is not None
+            else getattr(ctx, "replan_cooldown_secs", 60.0)))
+        self._enabled = bool(
+            enabled if enabled is not None
+            else getattr(ctx, "runtime_optimizer_enabled", True))
+        self._mesh_candidates = mesh_candidates
+        self._lock = threading.RLock()
+        self._running: Optional[RunningConfig] = None
+        # last reported world PER NODE (the world-change trigger input)
+        self._node_worlds: Dict[int, int] = {}
+        # knob tuples a worker negative-acked (rebuild failed /
+        # unsupported): excluded from the candidate ranking for this
+        # optimizer's lifetime — the model priced them feasible once
+        # and reality disagreed, so re-proposing every cooldown window
+        # would stall the job with a failed rebuild each cycle
+        self._failed_keys: set = set()
+        self._model_info: Optional[comm.ModelInfo] = None
+        self._calibrator: Optional[CostCalibrator] = None
+        self._decisions: "collections.deque[Decision]" = (
+            collections.deque(maxlen=_MAX_DECISIONS)
+        )
+        self._pending: Optional[comm.ParallelConfig] = None
+        self._plan_seq = 0
+        reg = get_registry()
+        self._c_replans = reg.counter(
+            tm.OPTIMIZER_REPLANS, help="re-plan passes evaluated")
+        self._c_chosen = reg.counter(
+            tm.OPTIMIZER_PLANS_CHOSEN, help="plans published to workers")
+        self._c_rejected = reg.counter(
+            tm.OPTIMIZER_PLANS_REJECTED,
+            help="plans suppressed (hysteresis / cooldown / optimal)")
+        self._c_calibrations = reg.counter(
+            tm.OPTIMIZER_CALIBRATIONS,
+            help="cost-model calibration passes")
+
+    # -- inputs --------------------------------------------------------------
+
+    def update_model_info(self, info: comm.ModelInfo) -> None:
+        with self._lock:
+            self._model_info = info
+            self._calibrator = None  # respec; corrections re-fit fast
+
+    def update_running_config(self, report: comm.TrainerConfigReport
+                              ) -> None:
+        """A worker reported the config it actually runs (train start,
+        post-reshard, post-retune, or a plan-apply ack)."""
+        with self._lock:
+            cfg = RunningConfig.from_report(report)
+            # the world-change trigger compares a node against ITS OWN
+            # previous report: during a reshard the survivors re-report
+            # at different times, and judging consecutive reports from
+            # DIFFERENT nodes against one global slot would fire
+            # spurious 8->4->8->4 replans off a laggard's stale view
+            nid = int(report.node_id)
+            prev_world = self._node_worlds.get(nid)
+            self._node_worlds[nid] = cfg.world
+            world_changed = (
+                prev_world is not None and prev_world != cfg.world
+                and cfg.world > 0
+            )
+            # adopt the report as the running view unless it is a
+            # laggard's STALE minority world: after an 8->4 shrink a
+            # queued pre-shrink report (world=8, no per-node change)
+            # must not rewind _running — the next replan would price
+            # and publish candidates for a world that no longer exists
+            if (
+                self._running is None or world_changed
+                or cfg.world == self._running.world
+            ):
+                self._running = cfg
+            if report.plan_id:
+                self._record_applied(report)
+        if world_changed:
+            # ScalePlan / live-reshard world change: the knobs tuned for
+            # the old world may be wrong for the survivor one
+            self.replan(f"world_change:{prev_world}->{cfg.world}")
+
+    def _record_applied(self, report: comm.TrainerConfigReport) -> None:
+        failed = bool(getattr(report, "apply_failed", False))
+        for d in reversed(self._decisions):
+            if d.plan_id == report.plan_id:
+                if failed:
+                    d.apply_failed = True
+                    if d.chosen_key:
+                        self._failed_keys.add(d.chosen_key)
+                        logger.warning(
+                            "plan %s (%s) failed to apply on node %d; "
+                            "knob tuple blacklisted",
+                            report.plan_id, d.chosen_key, report.node_id,
+                        )
+                else:
+                    d.applied = True
+                    if report.realized_speedup:
+                        d.realized_speedup = round(
+                            float(report.realized_speedup), 3)
+                break
+        # a consumed plan is RETRACTED from the broadcast slot: a worker
+        # restarted later (fresh _seen_plan) must not replay a plan the
+        # running job already absorbed — it would retune the job off a
+        # judgment the optimizer no longer stands behind and corrupt
+        # the decision trail with a second apply/measurement cycle
+        if (
+            self._pending is not None
+            and self._pending.plan_id == report.plan_id
+        ):
+            self._pending = None
+            if self._retract is not None:
+                try:
+                    self._retract(report.plan_id)
+                except Exception:  # noqa: BLE001 — ack path must not die
+                    logger.exception("failed to retract consumed plan")
+
+    def on_verdict(self, node_id: int, verdict: str) -> None:
+        """Straggler-detector listener: a flagged verdict (and its
+        recovery) is a re-plan trigger. Recovery replans IMMEDIATELY —
+        the degraded-config workaround should not outlive the incident
+        by a scaler period (ISSUE 7 satellite; the auto-scaler gets the
+        same kick through its own listener)."""
+        if verdict == "healthy":
+            self.replan(f"recovered:{node_id}")
+        else:
+            self.replan(f"{verdict}:{node_id}")
+
+    # -- calibration ---------------------------------------------------------
+
+    def _ensure_calibrator(self) -> Optional[CostCalibrator]:
+        if self._running is None:
+            return None
+        if self._calibrator is not None:
+            return self._calibrator
+        info = self._model_info
+        batch = self._running.global_batch or 8
+        if info is not None and info.num_params > 0:
+            spec = ModelSpec(
+                param_count=int(info.num_params),
+                num_layers=max(1, int(info.num_layers or 2)),
+                hidden_size=max(8, int(info.hidden_size or 256)),
+                seq_len=max(1, int(info.seq_len or 128)),
+                global_batch=batch,
+            )
+        else:
+            # no ModelInfo reported: a minimal placeholder spec — the
+            # corrections anchor absolute scale, the analytic model only
+            # contributes relative structure across knobs
+            spec = ModelSpec(
+                param_count=1_000_000, num_layers=2, hidden_size=256,
+                seq_len=128, global_batch=batch,
+            )
+        self._calibrator = CostCalibrator(model=spec, device=self._device)
+        return self._calibrator
+
+    def _measured_anchor(self) -> Dict[str, Optional[float]]:
+        """The step/dispatch p50 the JOB actually paces at: the MAX
+        over fresh nodes. A synchronous SPMD job runs at its slowest
+        member, so a degraded-but-alive straggler IS the job's step
+        time — the HSDP-at-100k position (PAPERS.md 2602.00277): treat
+        it as a config-search input, not just a restart trigger. Each
+        node's windowed p50 already rides out single-sample noise."""
+        now = time.time()
+        steps: List[float] = []
+        dispatches: List[float] = []
+        for nid in self._store.node_ids():
+            s = self._store.latest(nid)
+            if s is None or now - s.ts > _CALIBRATION_FRESHNESS_S:
+                continue
+            if s.step_p50 is not None:
+                steps.append(s.step_p50)
+            if s.dispatch_p50 is not None:
+                dispatches.append(s.dispatch_p50)
+        return {
+            "step_p50": max(steps) if steps else None,
+            "dispatch_p50": max(dispatches) if dispatches else None,
+        }
+
+    def calibrate(self) -> Optional[Dict]:
+        """One predicted-vs-observed fit for the current config;
+        returns the correction factors (None without a running config
+        or any fresh measurement)."""
+        with self._lock:
+            cal = self._ensure_calibrator()
+            if cal is None:
+                return None
+            measured = self._measured_anchor()
+            if (measured["step_p50"] is None
+                    and measured["dispatch_p50"] is None):
+                return None
+            run = self._running
+            corr = cal.observe(
+                run.mesh, run.steps_per_call,
+                measured_step_p50=measured["step_p50"],
+                measured_dispatch_p50=measured["dispatch_p50"],
+            )
+            self._c_calibrations.inc()
+            out = corr.to_dict()
+            emit_event(
+                EventKind.OPTIMIZER_CALIBRATED,
+                measured_step_p50_s=measured["step_p50"],
+                measured_dispatch_p50_s=measured["dispatch_p50"],
+                steps_per_call=run.steps_per_call,
+                **{f"factor_{k}": v for k, v in out.items()
+                   if k in ("compute", "comm", "dispatch")},
+            )
+            return out
+
+    # -- candidate enumeration / pricing -------------------------------------
+
+    def _knob_options(self, run: RunningConfig):
+        meshes: List[MeshPlan] = [run.mesh]
+        if self._mesh_candidates and run.world > 1:
+            seen = {mesh_axes_key(run.mesh)}
+            for m in candidate_plans(run.world):
+                k = mesh_axes_key(m)
+                if k not in seen:
+                    seen.add(k)
+                    meshes.append(m)
+        ks = sorted({run.steps_per_call, *STEPS_PER_CALL_OPTIONS})
+        windows = [run.train_window]
+        if run.train_window == 0:
+            windows.append(4)  # enable dispatch/compute overlap
+        cal = self._ensure_calibrator()
+        moes = [run.moe_dispatch]
+        if cal is not None and cal.model.num_experts > 0:
+            moes = sorted({run.moe_dispatch, *MOE_DISPATCH_OPTIONS})
+        return meshes, ks, windows, moes
+
+    def _price_candidates(self, run: RunningConfig
+                          ) -> List[CandidateScore]:
+        cal = self._ensure_calibrator()
+        if cal is None:
+            return []
+        meshes, ks, windows, moes = self._knob_options(run)
+        out: List[CandidateScore] = []
+        for mesh in meshes:
+            for k in ks:
+                for w in windows:
+                    for moe in moes:
+                        try:
+                            s = cal.price(
+                                mesh, steps_per_call=k, train_window=w,
+                                moe_dispatch=moe)
+                        except (ValueError, KeyError) as e:
+                            logger.debug("candidate %s unpriceable: %s",
+                                         mesh, e)
+                            continue
+                        out.append(CandidateScore(
+                            mesh=mesh, steps_per_call=k, train_window=w,
+                            moe_dispatch=moe, predicted_step_s=s,
+                        ))
+        return out
+
+    @staticmethod
+    def _churn(c: CandidateScore, run: RunningConfig) -> int:
+        """Tie-break distance from the current knobs: equal-price plans
+        must prefer NOT changing anything."""
+        cur = _mesh_dict(run.mesh)
+        cand = _mesh_dict(c.mesh)
+        return (
+            int(cand != cur)
+            + int(c.steps_per_call != run.steps_per_call)
+            + int(c.train_window != run.train_window)
+            + int((c.moe_dispatch or "") != (run.moe_dispatch or ""))
+        )
+
+    # -- the re-plan pass ----------------------------------------------------
+
+    def replan(self, trigger: str) -> Optional[Decision]:
+        """Calibrate, enumerate, price, decide, publish. Returns the
+        recorded Decision (None when disabled or nothing is known yet
+        about the running job)."""
+        if not self._enabled:
+            return None
+        with self._lock:
+            run = self._running
+            if run is None:
+                logger.info("replan(%s) skipped: no running config "
+                            "reported yet", trigger)
+                return None
+            # adopt the ambient incident id when one is open (the
+            # verdict listener fires inside the verdict's trace scope,
+            # an RPC-triggered replan inside the caller's) so the
+            # DIAG_* verdict and the OPTIMIZER_* decision trail merge
+            # into ONE incident in `tpurun trace`
+            with trace_scope(current_trace_id() or None) as tid:
+                return self._replan_locked(trigger, run, tid)
+
+    def _replan_locked(self, trigger: str, run: RunningConfig,
+                       tid: str) -> Optional[Decision]:
+        self._c_replans.inc()
+        corrections = self.calibrate() or (
+            self._calibrator.corrections.to_dict()
+            if self._calibrator is not None else {}
+        )
+        cal = self._ensure_calibrator()
+        if cal is None:
+            return None
+        # require_fit=False: the current config is OBSERVABLY running,
+        # whatever the analytic memory model thinks of it
+        current_s = cal.price(
+            run.mesh, steps_per_call=run.steps_per_call,
+            train_window=run.train_window,
+            moe_dispatch=run.moe_dispatch, require_fit=False,
+        )
+        candidates = [c for c in self._price_candidates(run)
+                      if c.key not in self._failed_keys]
+        if not candidates:
+            return None
+        for c in candidates:
+            c.speedup = current_s / max(c.predicted_step_s, 1e-12)
+        candidates.sort(
+            key=lambda c: (c.predicted_step_s, self._churn(c, run)))
+        table = [c.to_dict() for c in candidates[:_TABLE_ROWS]]
+        decision = Decision(
+            trigger=trigger, trace_id=tid, ts=time.time(),
+            current=run.to_dict(), current_predicted_s=current_s,
+            candidates=table, corrections=corrections,
+        )
+        best = candidates[0]
+        decision.predicted_speedup = best.speedup
+        emit_event(
+            EventKind.OPTIMIZER_REPLAN, trigger=trigger,
+            candidates_priced=len(candidates),
+            current_predicted_s=round(current_s, 6),
+            best_predicted_s=round(best.predicted_step_s, 6),
+            best_speedup=round(best.speedup, 3),
+        )
+        if self._churn(best, run) == 0:
+            self._reject(decision, "already_optimal")
+        elif best.speedup < self._min_speedup:
+            self._reject(
+                decision,
+                f"hysteresis:{best.speedup:.2f}<{self._min_speedup:.2f}",
+            )
+        elif not self._cooldown.check(best.key):
+            self._reject(
+                decision,
+                "cooldown:%.0fs" % self._cooldown.seconds_remaining(
+                    best.key),
+            )
+        else:
+            self._choose(decision, best)
+        self._decisions.append(decision)
+        return decision
+
+    def _reject(self, decision: Decision, reason: str) -> None:
+        decision.outcome = "rejected"
+        decision.reason = reason
+        self._c_rejected.inc()
+        emit_event(
+            EventKind.OPTIMIZER_PLAN_REJECTED,
+            trigger=decision.trigger, reason=reason,
+            predicted_speedup=round(decision.predicted_speedup, 3),
+        )
+        logger.info("replan(%s): no plan published (%s)",
+                    decision.trigger, reason)
+
+    def _choose(self, decision: Decision, best: CandidateScore) -> None:
+        self._plan_seq += 1
+        plan_id = f"plan-{self._plan_seq}"
+        decision.outcome = "chosen"
+        decision.plan_id = plan_id
+        decision.chosen = best.to_dict()
+        decision.chosen_key = best.key
+        self._c_chosen.inc()
+        # UNCHANGED knobs are published as their "leave it alone"
+        # sentinels (None / -1 / 0 / ""), so the worker can tell a
+        # host-knob-only plan from a compiled-program change (the
+        # multi-host guard keys off exactly that)
+        cur = decision.current
+        mesh_changed = _mesh_dict(best.mesh) != cur.get("mesh")
+        cfg = comm.ParallelConfig(
+            mesh_shape=_mesh_dict(best.mesh) if mesh_changed else None,
+            train_window=(best.train_window
+                          if best.train_window != cur.get("train_window")
+                          else -1),
+            steps_per_call=(
+                best.steps_per_call
+                if best.steps_per_call != cur.get("steps_per_call")
+                else 0),
+            moe_dispatch=(best.moe_dispatch
+                          if (best.moe_dispatch or "")
+                          != (cur.get("moe_dispatch") or "") else ""),
+            plan_id=plan_id,
+            trace_id=decision.trace_id,
+            predicted_speedup=round(best.speedup, 3),
+            prewarm=True,
+        )
+        self._pending = cfg
+        emit_event(
+            EventKind.OPTIMIZER_PLAN_CHOSEN,
+            plan_id=plan_id, trigger=decision.trigger,
+            predicted_speedup=round(best.speedup, 3),
+            predicted_step_s=round(best.predicted_step_s, 6),
+            **{f"knob_{k}": v for k, v in best.to_dict().items()
+               if k in ("steps_per_call", "train_window",
+                        "moe_dispatch")},
+            mesh=_mesh_dict(best.mesh),
+        )
+        logger.info(
+            "replan(%s): chose %s (predicted %.2fx, plan %s)",
+            decision.trigger, best.key, best.speedup, plan_id,
+        )
+        if self._publish is not None:
+            self._publish(cfg)
+
+    # -- queries -------------------------------------------------------------
+
+    def pending_plan(self) -> Optional[comm.ParallelConfig]:
+        with self._lock:
+            return self._pending
+
+    def decisions(self, limit: int = 0) -> List[Dict]:
+        with self._lock:
+            out = [d.to_dict() for d in self._decisions]
+        return out[-limit:] if limit else out
+
+    def to_report(self, limit: int = 0) -> Dict:
+        """The ``tpurun plan --addr`` payload."""
+        with self._lock:
+            running = self._running.to_dict() if self._running else None
+            corr = (self._calibrator.corrections.to_dict()
+                    if self._calibrator is not None else None)
+            pending = self._pending
+        return {
+            "enabled": self._enabled,
+            "running": running,
+            "corrections": corr,
+            "min_speedup": self._min_speedup,
+            "cooldown_secs": self._cooldown.cooldown_secs,
+            "pending_plan": {
+                "plan_id": pending.plan_id,
+                "mesh": dict(pending.mesh_shape or {}),
+                "train_window": pending.train_window,
+                "steps_per_call": pending.steps_per_call,
+                "moe_dispatch": pending.moe_dispatch,
+                "predicted_speedup": pending.predicted_speedup,
+                "trace_id": pending.trace_id,
+            } if pending is not None else None,
+            "decisions": self.decisions(limit),
+        }
+
+
+# -- forensic decision trail (tpurun plan --events) ---------------------------
+
+_OPTIMIZER_KINDS = (
+    EventKind.OPTIMIZER_REPLAN,
+    EventKind.OPTIMIZER_CALIBRATED,
+    EventKind.OPTIMIZER_PLAN_CHOSEN,
+    EventKind.OPTIMIZER_PLAN_REJECTED,
+    EventKind.OPTIMIZER_APPLY_BEGIN,
+    EventKind.OPTIMIZER_APPLY_DONE,
+    EventKind.OPTIMIZER_APPLIED,
+)
+
+
+def decision_trail_from_events(records: List[Dict]) -> Dict:
+    """Reconstruct the decision trail from a (merged, multi-process)
+    event timeline: master-side decisions joined to worker-side applies
+    by plan id / trace id — the forensic ``tpurun plan --events`` view.
+    """
+    trail = [r for r in records if r.get("kind") in _OPTIMIZER_KINDS]
+    plans: Dict[str, Dict] = {}
+    for rec in trail:
+        kind = rec.get("kind")
+        pid = rec.get("plan_id", "")
+        if not pid:
+            continue
+        p = plans.setdefault(pid, {"plan_id": pid})
+        if kind == EventKind.OPTIMIZER_PLAN_CHOSEN:
+            p.update(
+                chosen_ts=rec.get("ts"),
+                trigger=rec.get("trigger", ""),
+                trace_id=rec.get("trace_id", ""),
+                predicted_speedup=rec.get("predicted_speedup"),
+                mesh=rec.get("mesh"),
+                steps_per_call=rec.get("knob_steps_per_call"),
+                train_window=rec.get("knob_train_window"),
+            )
+        elif kind == EventKind.OPTIMIZER_APPLY_BEGIN:
+            p["apply_begin_ts"] = rec.get("ts")
+        elif kind == EventKind.OPTIMIZER_APPLY_DONE:
+            p["apply_done_ts"] = rec.get("ts")
+            p["apply_seconds"] = rec.get("seconds")
+            p["recompiled"] = rec.get("recompiled")
+            if rec.get("error_code"):
+                p["apply_error"] = rec.get("error_code")
+        elif kind == EventKind.OPTIMIZER_APPLIED:
+            p["realized_speedup"] = rec.get("realized_speedup")
+            p["applied_predicted_speedup"] = rec.get("predicted_speedup")
+    return {
+        "events": len(trail),
+        "plans": [plans[k] for k in sorted(
+            plans, key=lambda k: plans[k].get("chosen_ts") or 0.0)],
+        "trail": trail,
+    }

@@ -38,9 +38,11 @@ class MasterServicer:
         metric_collector=None,
         node_runtime_store=None,
         straggler_detector=None,
+        runtime_optimizer=None,
     ):
         from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
         from dlrover_tpu.master.monitor.straggler import StragglerDetector
+        from dlrover_tpu.master.optimizer import RuntimeOptimizer
 
         self._task_manager = task_manager
         self._rdzv_managers = rdzv_managers or {}
@@ -59,6 +61,23 @@ class MasterServicer:
             self.node_runtime_store, speed_monitor=speed_monitor
         )
         self._parallel_configs: Dict[int, comm.ParallelConfig] = {}
+        # the runtime optimization loop (telemetry -> planner -> live
+        # reshard): verdict changes trigger re-plans; chosen plans are
+        # published through the ParallelConfig broadcast slot workers
+        # already poll (get_parallel_config)
+        self.runtime_optimizer = runtime_optimizer or RuntimeOptimizer(
+            self.node_runtime_store,
+            publish=lambda cfg: self._parallel_configs.__setitem__(
+                -1, cfg),
+            # a worker's apply ack retracts the consumed plan so a
+            # later-restarted worker cannot replay it from the slot —
+            # but only while the slot still holds THAT plan: an
+            # operator/brain config pushed meanwhile must not be
+            # deleted by a late ack
+            retract=self._retract_plan,
+        )
+        self.straggler_detector.add_verdict_listener(
+            self.runtime_optimizer.on_verdict)
         # one failure record store: the job manager's when present (its
         # handle_training_failure records there), else our own so the
         # local master can still answer failed-node queries
@@ -91,6 +110,7 @@ class MasterServicer:
             comm.QueryPsNodesRequest: self._query_ps_nodes,
             comm.ParallelConfigRequest: self._get_parallel_config,
             comm.DiagnosisRequest: self._get_diagnosis,
+            comm.PlanRequest: self._get_plan,
         }
         self._report_handlers = {
             comm.DatasetShardParams: self._new_dataset,
@@ -115,6 +135,7 @@ class MasterServicer:
             comm.ModelInfo: self._collect_model_info,
             comm.JobExitRequest: self._request_job_exit,
             comm.ParallelConfig: self._set_parallel_config,
+            comm.TrainerConfigReport: self._report_trainer_config,
         }
 
     # -- entry points (bound to the two-method gRPC service) ----------------
@@ -445,7 +466,26 @@ class MasterServicer:
     def _collect_model_info(self, req: comm.ModelInfo):
         if self._metric_collector is not None:
             self._metric_collector.collect_model_info(req)
+        self.runtime_optimizer.update_model_info(req)
         return comm.Response(success=True)
+
+    def _report_trainer_config(self, req: comm.TrainerConfigReport):
+        """A worker reported its ACTUAL running config (train start /
+        post-reshard / plan ack) — the optimizer's running-config input
+        and its world-change re-plan trigger."""
+        self.runtime_optimizer.update_running_config(req)
+        return comm.Response(success=True)
+
+    def _get_plan(self, req: comm.PlanRequest):
+        import json as _json
+
+        report = self.runtime_optimizer.to_report(limit=req.limit)
+        return comm.DiagnosisReport(report_json=_json.dumps(report))
+
+    def _retract_plan(self, plan_id: str):
+        cur = self._parallel_configs.get(-1)
+        if cur is not None and getattr(cur, "plan_id", "") == plan_id:
+            self._parallel_configs.pop(-1, None)
 
     def _set_parallel_config(self, req: comm.ParallelConfig):
         # master-pushed config applies to all nodes (node_id -1 = broadcast)

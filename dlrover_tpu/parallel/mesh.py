@@ -148,6 +148,15 @@ def topology_key(devices: Optional[Sequence] = None) -> str:
     )
 
 
+def mesh_axes_key(plan: MeshPlan) -> str:
+    """Stable identity of a mesh FACTORIZATION ("pipe.data.fsdp.seq.
+    tensor") — the one format shared by the trainer's program-cache key,
+    the runtime optimizer's candidate/cooldown keys, and mesh dedup, so
+    an axis added to MeshPlan cannot silently diverge them."""
+    return (f"{plan.pipe}.{plan.data}.{plan.fsdp}"
+            f".{plan.seq}.{plan.tensor}")
+
+
 def single_device_plan() -> MeshPlan:
     return MeshPlan(pipe=1, data=1, fsdp=1, seq=1, tensor=1)
 

@@ -195,6 +195,39 @@ class PlanScore:
     predicted_mfu: float = 0.0
 
 
+# breakdown keys that are ICI/DCN collective seconds — the "comm" term
+# of combine_step_time (and the term the runtime calibrator scales as
+# one family; see master/optimizer/calibration.py)
+COMM_BREAKDOWN_KEYS = (
+    "tp_comm_s", "fsdp_comm_s", "dp_comm_s", "seq_comm_s",
+    "pipe_comm_s", "moe_disp_comm_s",
+)
+
+
+def combine_step_time(compute_s: float, comm_s: float,
+                      dispatch_s: float,
+                      overlapped: bool = True) -> float:
+    """The ONE formula turning cost terms into a predicted step time —
+    used by ``estimate`` and by the runtime optimizer's calibrated
+    re-pricing (``master/optimizer/calibration.py``), so the two can
+    never drift apart.
+
+    Comm overlaps compute imperfectly: charge the max plus a quarter of
+    the smaller (conservative). The host dispatch cost enters as a
+    FLOOR when the executor's in-flight window overlaps it with device
+    work (``overlapped=True``, the production default); a synchronous
+    loop (``train_window=0``) pays it additively. Dispatch-bound plans
+    keep a 1% residual of their device time so the ranking still
+    prefers the faster compiled program instead of collapsing every
+    tiny-model mesh into a tie."""
+    step_s = max(compute_s, comm_s) + 0.25 * min(compute_s, comm_s)
+    if not overlapped:
+        return step_s + dispatch_s
+    if dispatch_s > step_s:
+        step_s = dispatch_s + 0.01 * step_s
+    return step_s
+
+
 def _flops_per_step(m: ModelSpec) -> float:
     tokens = m.global_batch * m.seq_len
     attn = 12 * m.num_layers * m.hidden_size * m.seq_len * 0.5
@@ -487,22 +520,12 @@ def estimate(
     moe_disp_comm_s = comm_bytes["moe_dispatch"] / device.ici_bw
     compute_s += moe_disp_comp_s
 
-    # comm overlaps with compute imperfectly; charge the max of compute
-    # and total comm plus a fraction of the smaller (conservative)
+    # comm + dispatch fold into the step time through the shared
+    # combiner (overlap max + dispatch floor; see combine_step_time)
     comm_s = (tp_comm_s + fsdp_comm_s + dp_comm_s + seq_comm_s
               + pipe_comm_s + moe_disp_comm_s)
-    step_s = max(compute_s, comm_s) + 0.25 * min(compute_s, comm_s)
-
-    # ---- host dispatch floor: one dispatch per steps_per_call steps,
-    # overlapped with device work by the executor's in-flight window —
-    # a per-step FLOOR, never an additive tax on compute-bound models.
-    # Dispatch-bound plans keep a 1% residual of their device time so
-    # the ranking still prefers the faster compiled program (identical
-    # throughput at the floor, but headroom when K or the window grows)
-    # instead of collapsing every tiny-model mesh into a tie.
     dispatch_s = HOST_DISPATCH_OVERHEAD_S / max(1, steps_per_call)
-    if dispatch_s > step_s:
-        step_s = dispatch_s + 0.01 * step_s
+    step_s = combine_step_time(compute_s, comm_s, dispatch_s)
 
     # ---- memory (modeled on the production path: flash attention, so
     # no S^2 tile; dots_saveable-style per-layer saves). Terms validated

@@ -120,6 +120,44 @@ def combination_candidates(
     return out
 
 
+class ProposalCooldown:
+    """Re-plan cooldown/dedup guard: an IDENTICAL candidate proposed
+    twice within the cooldown window is suppressed, so a flapping
+    trigger (a straggler verdict re-confirmed every report window, a
+    rendezvous that oscillates) cannot thrash the job through the same
+    plan over and over. Keys are caller-chosen strings (the runtime
+    optimizer uses the serialized knob tuple); a DIFFERENT candidate is
+    never suppressed — only the exact repeat is.
+
+    ``check(key, now)`` returns True when the proposal may proceed (and
+    records it); False when it is inside the cooldown of an identical
+    earlier proposal. The clock is injected for testability."""
+
+    def __init__(self, cooldown_secs: float = 60.0):
+        self.cooldown_secs = float(cooldown_secs)
+        self._last: Dict[str, float] = {}
+
+    def check(self, key: str, now: Optional[float] = None) -> bool:
+        import time
+
+        now = float(now if now is not None else time.monotonic())
+        last = self._last.get(key)
+        if last is not None and now - last < self.cooldown_secs:
+            return False
+        self._last[key] = now
+        return True
+
+    def seconds_remaining(self, key: str,
+                         now: Optional[float] = None) -> float:
+        import time
+
+        now = float(now if now is not None else time.monotonic())
+        last = self._last.get(key)
+        if last is None:
+            return 0.0
+        return max(0.0, self.cooldown_secs - (now - last))
+
+
 # -- encoding ----------------------------------------------------------------
 
 

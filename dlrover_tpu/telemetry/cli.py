@@ -8,6 +8,10 @@
   diagnose cluster diagnosis: straggler/hang verdicts + node series —
            live from a master (--addr) or forensically from a
            timeline (--events)
+  plan     the runtime optimizer's decision trail: running config,
+           calibration factors, candidate table, chosen/rejected
+           plans, predicted-vs-realized speedups — live (--addr) or
+           forensically from a timeline (--events)
   events   pretty-print a timeline (newest last)
   metrics  dump Prometheus exposition: a live endpoint via --addr, or
            this process's registry (useful under ``tpurun metrics``)
@@ -62,6 +66,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="derive forensically from a timeline JSONL "
                          "(default: the configured events sink)")
     dg.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
+    pl = sub.add_parser(
+        "plan", help="runtime-optimizer decision trail: candidate "
+                     "table, chosen/rejected plans, calibration")
+    pl.add_argument("--addr", default="",
+                    help="query a live master at host:port")
+    pl.add_argument("--events", default="",
+                    help="derive forensically from a timeline JSONL "
+                         "(default: the configured events sink)")
+    pl.add_argument("--limit", type=int, default=0,
+                    help="only the last N decisions")
+    pl.add_argument("--json", action="store_true",
                     help="machine-readable output")
 
     ev = sub.add_parser("events", help="print a timeline")
@@ -181,8 +198,93 @@ def _cmd_diagnose(args) -> int:
     return 0
 
 
+def _cmd_plan(args) -> int:
+    """Live (master RPC) or forensic (timeline) optimizer trail."""
+    if args.addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(args.addr)
+        try:
+            report = client.get_plan(limit=args.limit)
+        finally:
+            client.close()
+        report["source"] = args.addr
+    else:
+        from dlrover_tpu.master.optimizer import (
+            decision_trail_from_events,
+        )
+        from dlrover_tpu.telemetry import events as events_mod
+
+        path = _resolve_events_path(args.events)
+        if not path:
+            print("plan: no master --addr and no timeline (pass "
+                  "--events or set DLROVER_TPU_EVENTS_FILE)",
+                  file=sys.stderr)
+            return 2
+        report = decision_trail_from_events(events_mod.read_events(path))
+        report["source"] = path
+        if args.limit:
+            report["plans"] = report["plans"][-args.limit:]
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    running = report.get("running")
+    if running:
+        print(f"running: mesh={running.get('mesh')} "
+              f"window={running.get('train_window')} "
+              f"K={running.get('steps_per_call')} "
+              f"world={running.get('world')}")
+    corr = report.get("corrections")
+    if corr:
+        print(f"calibration: compute x{corr.get('compute')} "
+              f"comm x{corr.get('comm')} "
+              f"dispatch x{corr.get('dispatch')} "
+              f"({corr.get('samples')} passes)")
+    # live view: full decision records; forensic view: per-plan rows
+    for d in report.get("decisions") or []:
+        line = (f"[{d.get('trace_id', '')}] {d.get('trigger')}: "
+                f"{d.get('outcome')}")
+        if d.get("outcome") == "chosen":
+            c = d.get("chosen") or {}
+            line += (f" plan={d.get('plan_id')} -> "
+                     f"K={c.get('steps_per_call')} "
+                     f"window={c.get('train_window')} "
+                     f"mesh={c.get('mesh')} "
+                     f"predicted {d.get('predicted_speedup')}x")
+            if d.get("applied"):
+                line += (f" (applied, realized "
+                         f"{d.get('realized_speedup')}x)")
+        else:
+            line += f" ({d.get('reason')})"
+        print(line)
+        for c in (d.get("candidates") or [])[:4]:
+            print(f"    candidate K={c.get('steps_per_call')} "
+                  f"window={c.get('train_window')} mesh={c.get('mesh')}"
+                  f" -> {c.get('predicted_step_s')}s/step "
+                  f"({c.get('speedup')}x)")
+    for p in report.get("plans") or []:
+        line = (f"plan {p.get('plan_id')} [{p.get('trigger', '')}]: "
+                f"K={p.get('steps_per_call')} "
+                f"window={p.get('train_window')} "
+                f"predicted {p.get('predicted_speedup')}x")
+        if "apply_seconds" in p:
+            line += (f", applied in {p.get('apply_seconds')}s "
+                     f"(recompiled={p.get('recompiled')})")
+        if p.get("apply_error"):
+            line += f", FAILED ({p['apply_error']})"
+        if p.get("realized_speedup") is not None:
+            line += f", realized {p.get('realized_speedup')}x"
+        print(line)
+    if not (report.get("decisions") or report.get("plans")):
+        print("plan: no optimizer decisions recorded")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.cmd == "plan":
+        return _cmd_plan(args)
 
     if args.cmd == "mttr":
         from dlrover_tpu.telemetry import events as events_mod

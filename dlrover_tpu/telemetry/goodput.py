@@ -12,6 +12,8 @@ interval sweep:
 
   restart          failure edge (worker death / hang) → workers running
   reshard          live in-process reshard (begin → done)
+  replan           runtime-optimizer plan applying live (apply begin →
+                   done: the drain + retune/reshard the loop chose)
   rollback         non-finite step → checkpoint rollback restored
   preempt_drain    preemption notice → drain done
   rendezvous       join → completed world (``wait_seconds`` on the
@@ -43,6 +45,7 @@ from dlrover_tpu.telemetry.names import EventKind
 BUCKET_PRIORITY = (
     "restart",
     "reshard",
+    "replan",
     "rollback",
     "preempt_drain",
     "rendezvous",
@@ -56,6 +59,8 @@ _SCENARIO_BUCKET = {
     "worker_failure": "restart",
     "hang": "restart",
     "live_reshard": "reshard",
+    # a runtime-optimizer plan applying live (drain -> retune -> resume)
+    "replan": "replan",
     "nonfinite_rollback": "rollback",
     "preemption_drain": "preempt_drain",
 }

@@ -13,6 +13,7 @@ compatible kind:
   nonfinite_step          rollback_restored        NaN rollback
   preempt_notice          preempt_drain_done       preemption drain
   live_reshard_begin      live_reshard_done        in-process reshard
+  optimizer_apply_begin   optimizer_apply_done     live re-plan apply
 
 Durations use the monotonic clock when both events came from the same
 process (exact), else wall clocks (cross-process, e.g. agent-side
@@ -39,6 +40,11 @@ _PAIRINGS = {
         {EventKind.PREEMPT_DRAIN_DONE}, "preemption_drain"),
     EventKind.LIVE_RESHARD_BEGIN: (
         {EventKind.LIVE_RESHARD_DONE}, "live_reshard"),
+    # a runtime-optimizer plan applying live (drain -> retune/reshard ->
+    # resume): not a failure, but downtime the loop chose to spend — the
+    # ledger and the recovery report must both see it
+    EventKind.OPTIMIZER_APPLY_BEGIN: (
+        {EventKind.OPTIMIZER_APPLY_DONE}, "replan"),
 }
 
 

@@ -114,6 +114,21 @@ DIAG_STRAGGLERS = "dlrover_diagnosis_stragglers_total"
 DIAG_NODE_HANGS = "dlrover_diagnosis_node_hangs_total"
 DIAG_RECOVERIES = "dlrover_diagnosis_recoveries_total"
 
+# -- runtime optimizer (the telemetry -> planner -> live-reshard loop) --------
+
+# re-plan passes run by the master-side optimizer (one per trigger that
+# survived the cooldown gate: straggler verdict, recovery, world change)
+OPTIMIZER_REPLANS = "dlrover_optimizer_replans_total"
+# plans published to workers / suppressed by hysteresis-cooldown-dedup
+OPTIMIZER_PLANS_CHOSEN = "dlrover_optimizer_plans_chosen_total"
+OPTIMIZER_PLANS_REJECTED = "dlrover_optimizer_plans_rejected_total"
+# calibration passes fitting the planner's cost terms to measured series
+OPTIMIZER_CALIBRATIONS = "dlrover_optimizer_calibrations_total"
+# worker-side: live plan applications (drain -> retune/reshard -> resume)
+OPTIMIZER_PLANS_APPLIED = "dlrover_optimizer_plans_applied_total"
+# wall seconds of one live plan application on the worker
+OPTIMIZER_APPLY_TIME = "dlrover_optimizer_apply_seconds"
+
 
 class EventKind:
     """Event-timeline record kinds (``telemetry.events``). Failure-edge
@@ -165,6 +180,22 @@ class EventKind:
     DIAG_STRAGGLER = "diag_straggler"
     DIAG_NODE_HANG = "diag_node_hang"
     DIAG_RECOVERED = "diag_recovered"
+    # runtime optimization loop. Master side: one REPLAN per evaluated
+    # trigger (candidate table attached), then CHOSEN (plan published to
+    # workers) or REJECTED (hysteresis / cooldown-dedup / already
+    # optimal); CALIBRATED records the predicted-vs-observed correction
+    # factors each pass fits. Worker side: APPLY_BEGIN -> APPLY_DONE
+    # bracket the live drain -> retune/reshard -> resume (the mttr
+    # "replan" scenario pairs them), and APPLIED lands once the
+    # post-plan window measured the realized speedup against the
+    # decision's prediction.
+    OPTIMIZER_REPLAN = "optimizer_replan"
+    OPTIMIZER_CALIBRATED = "optimizer_calibrated"
+    OPTIMIZER_PLAN_CHOSEN = "optimizer_plan_chosen"
+    OPTIMIZER_PLAN_REJECTED = "optimizer_plan_rejected"
+    OPTIMIZER_APPLY_BEGIN = "optimizer_apply_begin"
+    OPTIMIZER_APPLY_DONE = "optimizer_apply_done"
+    OPTIMIZER_APPLIED = "optimizer_applied"
 
 
 class SpanName:

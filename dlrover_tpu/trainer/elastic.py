@@ -95,15 +95,21 @@ class ElasticTrainer:
         # the agent hands the post-change survivor subset to
         # on_world_change, and dryruns carve sub-worlds out of one host
         self._devices = list(devices) if devices is not None else None
+        # runtime-optimizer mesh override: a specific factorization for
+        # the CURRENT world (e.g. trade data for fsdp) chosen by the
+        # master's re-planner, applied via retune(). None = the base
+        # strategy's adjust_to_world derivation.
+        self._mesh_override = None
 
         self._result: Optional[AccelerateResult] = None
-        # Compiled-program cache, keyed by mesh topology: a live reshard
-        # BACK to a topology this trainer already compiled for (scale
-        # down on a failure, scale up when the node returns) reuses the
-        # whole AccelerateResult — jitted step(s), shardings, mesh —
-        # with ZERO recompiles. Bounded: each entry pins its compiled
-        # executables in host memory, and elastic jobs oscillate between
-        # a handful of worlds, not dozens.
+        # Compiled-program cache, keyed by (mesh topology, multi-step
+        # degree, mesh override): a live reshard BACK to a program this
+        # trainer already compiled for (scale down on a failure, scale
+        # up when the node returns, a retune back to earlier knobs)
+        # reuses the whole AccelerateResult — jitted step(s), shardings,
+        # mesh — with ZERO recompiles. Bounded: each entry pins its
+        # compiled executables in host memory, and elastic jobs
+        # oscillate between a handful of worlds, not dozens.
         self._programs: "collections.OrderedDict[str, AccelerateResult]" = (
             collections.OrderedDict()
         )
@@ -137,6 +143,50 @@ class ElasticTrainer:
             raise RuntimeError("call prepare() first")
         return self._result
 
+    @property
+    def devices(self) -> Optional[list]:
+        """The explicit device subset this trainer runs on (None = the
+        whole ambient world) — what a same-world prewarm must target."""
+        return list(self._devices) if self._devices is not None else None
+
+    def _resolved_strategy(self, num_devices: int):
+        """The strategy a build for ``num_devices`` will actually
+        compile: the base strategy's world derivation, with the
+        optimizer's mesh override (when set and it fits) replacing the
+        derived factorization."""
+        strategy = self._base_strategy.adjust_to_world(
+            num_devices, prev_num_devices=self._initial_devices
+        )
+        if self._mesh_override is not None:
+            try:
+                strategy = dataclasses.replace(
+                    strategy,
+                    mesh=self._mesh_override.resolve(num_devices),
+                )
+            except ValueError:
+                # the override was chosen for a different world size:
+                # fall back to the derived mesh rather than fail the
+                # rebuild (the optimizer re-plans for the new world)
+                logger.warning(
+                    "mesh override %s does not fit %d devices; using "
+                    "the derived mesh", self._mesh_override, num_devices,
+                )
+        return strategy
+
+    def _program_key(self, devices: list, strategy) -> str:
+        """Program-cache identity: device topology x the knobs that
+        change the compiled program (multi-step degree, RESOLVED mesh
+        factorization). Keyed on what the build will actually compile —
+        not on how the knobs were requested — so a retune back to the
+        startup config hits the program the trainer began with."""
+        from dlrover_tpu.parallel.mesh import mesh_axes_key
+
+        return (
+            topology_key(devices)
+            + f"|k={self.steps_per_call}"
+            + f"|mesh={mesh_axes_key(strategy.mesh)}"
+        )
+
     def _build(self, devices: Optional[list]) -> AccelerateResult:
         """Compile (or fetch from the program cache) for ``devices``
         (None = the whole ``jax.devices()`` world)."""
@@ -144,7 +194,8 @@ class ElasticTrainer:
         num_devices = len(actual)
         if self._initial_devices is None:
             self._initial_devices = num_devices
-        key = topology_key(actual)
+        strategy = self._resolved_strategy(num_devices)
+        key = self._program_key(actual, strategy)
         reg = get_registry()
         cached = self._programs.get(key)
         if cached is not None:
@@ -161,9 +212,6 @@ class ElasticTrainer:
         reg.counter(
             tm.PROGRAM_CACHE_MISSES,
             help="rebuilds that had to compile").inc()
-        strategy = self._base_strategy.adjust_to_world(
-            num_devices, prev_num_devices=self._initial_devices
-        )
         result = accelerate(
             self._init_fn,
             self._loss_fn,
@@ -309,39 +357,102 @@ class ElasticTrainer:
                        recompiled=recompiled, step=snapshot.step)
         return state
 
-    def prewarm(self, devices=None, execute: bool = True) -> bool:
-        """Standby-compile the program for a topology we may reshard to
-        (e.g. the (N - node_unit)-device survivor world), so the live
-        reshard that follows a real failure hits the program cache and
-        pays zero recompiles. Returns True when a compile happened,
-        False on a cache hit. Does NOT switch the trainer's active
-        program or device set.
+    def prewarm(self, devices=None, execute: bool = True,
+                steps_per_call: Optional[int] = None,
+                mesh=None) -> bool:
+        """Standby-compile the program for a topology OR knob set we may
+        swap to — the (N - node_unit)-device survivor world before a
+        failure, or an optimizer-chosen (``steps_per_call``, mesh
+        override) before the retune that applies it — so the live
+        reshard/retune that follows hits the program cache and pays
+        zero recompiles. Returns True when a compile happened, False on
+        a cache hit. Does NOT switch the trainer's active program,
+        device set, or knobs (the temporary knob swap is restored).
 
         ``execute`` (default): run one throwaway step on the standby
-        topology — jit is lazy, so merely building the program object
-        would still leave trace + XLA compile to the first post-failure
+        program — jit is lazy, so merely building the program object
+        would still leave trace + XLA compile to the first post-swap
         step. The dummy step costs a transient extra copy of the state
         on the standby submesh; pass ``execute=False`` on models too
-        large to double-book (the reshard then pays the compile, but
+        large to double-book (the swap then pays the compile, but
         still skips the strategy/mesh rebuild)."""
-        before = self.compile_count
-        result = self._build(list(devices) if devices is not None else None)
-        compiled = self.compile_count > before
-        if execute and compiled:
-            from dlrover_tpu.diagnosis.hang_detector import (
-                announce_long_phase,
-            )
-
-            announce_long_phase(900.0)  # standby compile: not a hang
-            rng = jax.random.PRNGKey(0)
-            dummy = result.init_fn(rng)
-            sharded = result.shard_batch(self._example_batch)
-            dummy, _metrics = result.train_step(dummy, sharded, rng)
-            jax.block_until_ready(dummy)
-            logger.info("prewarmed standby topology (%d devices): one "
-                        "dummy step executed",
-                        result.mesh.devices.size)
+        prev_k, prev_mesh = self.steps_per_call, self._mesh_override
+        if steps_per_call is not None:
+            self.steps_per_call = max(1, int(steps_per_call))
+        if mesh is not None:
+            self._mesh_override = mesh
+        try:
+            before = self.compile_count
+            result = self._build(
+                list(devices) if devices is not None else None)
+            compiled = self.compile_count > before
+            if execute and compiled:
+                self._execute_dummy_step(result)
+        finally:
+            self.steps_per_call = prev_k
+            self._mesh_override = prev_mesh
         return compiled
+
+    def _execute_dummy_step(self, result: AccelerateResult) -> None:
+        """Force the lazy jit through trace + XLA compile by running one
+        throwaway step on the standby program — the MULTI-step scan when
+        that is what the knobs will dispatch."""
+        from dlrover_tpu.diagnosis.hang_detector import (
+            announce_long_phase,
+        )
+
+        announce_long_phase(900.0)  # standby compile: not a hang
+        import jax.numpy as jnp
+
+        rng = jax.random.PRNGKey(0)
+        dummy = result.init_fn(rng)
+        k = max(1, self.steps_per_call)
+        if k > 1 and result.train_step_multi is not None:
+            from dlrover_tpu.trainer.data import stack_batches
+
+            stacked = stack_batches([self._example_batch] * k)
+            sharded = result.shard_batch(stacked, stacked=True)
+            rngs = jnp.stack([rng] * k)
+            dummy, _unused = result.train_step_multi(
+                dummy, sharded, rngs)
+        else:
+            sharded = result.shard_batch(self._example_batch)
+            dummy, _unused = result.train_step(dummy, sharded, rng)
+        jax.block_until_ready(dummy)
+        logger.info(
+            "prewarmed standby program (%d devices, K=%d): one dummy "
+            "step executed", result.mesh.devices.size, k,
+        )
+
+    def retune(self, state: Any, steps_per_call: Optional[int] = None,
+               mesh=None, reason: str = "optimizer") -> Any:
+        """Apply optimizer-chosen PROGRAM knobs on the current world
+        without a restart: ``steps_per_call`` (the lax.scan multi-step
+        degree) and/or a mesh override (a different factorization of
+        the same devices). Same mechanics as ``live_reshard`` — the
+        caller drains its window first; snapshot → rebuild → reshard —
+        but against the unchanged device set, and through the program
+        cache keyed on these very knobs, so a prewarmed knob set swaps
+        with ZERO recompiles. On failure the previous knobs (and the
+        previously compiled program) are restored and the error
+        propagates — the job keeps running the old config."""
+        prev_k, prev_mesh = self.steps_per_call, self._mesh_override
+        if steps_per_call is not None:
+            self.steps_per_call = max(1, int(steps_per_call))
+        if mesh is not None:
+            self._mesh_override = mesh
+        try:
+            return self.live_reshard(
+                state, devices=self._devices, reason=reason,
+                emit_events=False,
+            )
+        except Exception:
+            self.steps_per_call = prev_k
+            self._mesh_override = prev_mesh
+            # re-point at the old program (cache hit) so the trainer
+            # stays runnable with the pre-retune config
+            self._result = self._build(self._devices)
+            raise
 
     def on_world_change(self, state: Any, devices=None) -> Any:
         """The process-restart rebuild entrypoint (agent/bootstrap,

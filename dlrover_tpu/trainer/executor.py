@@ -287,6 +287,119 @@ class NodeRuntimeReportHook(TrainHook):
         self._sender.join(timeout=5.0)
 
 
+class OptimizerPlanHook(TrainHook):
+    """Poll the master for a runtime-optimizer plan and apply it LIVE.
+
+    The master's re-planner (``master/optimizer``) publishes chosen
+    plans through the ``ParallelConfig`` broadcast (a non-empty
+    ``plan_id`` marks one). A background daemon thread polls
+    ``get_parallel_config`` on a WALL-TIME cadence — a dead master's
+    RPC timeout must never park the step loop — and routes a fresh plan
+    to ``executor.request_retune`` (live: drain → retune/reshard →
+    resume) or ``request_restart`` when the master explicitly asked for
+    one. Each plan id is applied at most once per process."""
+
+    def __init__(self, master_client, poll_secs: Optional[float] = None):
+        ctx = get_context()
+        self._client = master_client
+        self._poll = float(
+            poll_secs if poll_secs is not None
+            else getattr(ctx, "plan_poll_secs", 30.0))
+        self._executor: Optional["TrainExecutor"] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seen_plan = ""
+
+    def begin(self, executor: "TrainExecutor"):
+        self._executor = executor
+        if self._poll <= 0:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="optimizer-plan-poll",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _poll_loop(self):
+        while not self._stop.wait(self._poll):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — master briefly away
+                logger.warning(
+                    "optimizer plan poll failed, retrying next cadence "
+                    "(%s: %s)", type(e).__name__, e)
+
+    def poll_once(self):
+        """One poll (also the test entry): fetch the broadcast config
+        and hand any UNSEEN optimizer plan to the executor."""
+        if self._executor is None:
+            return
+        cfg = self._client.get_parallel_config()
+        plan_id = getattr(cfg, "plan_id", "") or ""
+        if not plan_id or plan_id == self._seen_plan:
+            return
+        self._seen_plan = plan_id
+        if getattr(cfg, "restart", False):
+            logger.info("optimizer plan %s requests a restart", plan_id)
+            self._executor.request_restart()
+            return
+        import jax
+
+        wants_program = bool(cfg.steps_per_call) or bool(cfg.mesh_shape)
+        if wants_program and jax.process_count() > 1:
+            # each process polls on its own clock: an in-place program
+            # swap applied at different wall times would diverge the
+            # collective schedule across hosts (host A dispatching the
+            # K=8 fused scan against host B's K=1 program deadlocks the
+            # mesh). Until the apply is barriered through a rendezvous,
+            # multi-host jobs take only the host-local knob live.
+            logger.warning(
+                "optimizer plan %s changes the compiled program; "
+                "in-place swaps are not synchronized across hosts yet "
+                "— applying only train_window", plan_id)
+            if cfg.train_window >= 0:
+                # host-local knob only, WITHOUT the plan identity: an
+                # ack would mark the full K/mesh plan applied on the
+                # master (bogus ~1.0x realized + retraction) when its
+                # program knobs never took effect
+                self._executor.request_retune(
+                    train_window=cfg.train_window,
+                    trace_id=getattr(cfg, "trace_id", "") or "",
+                )
+            # negative-ack the program plan so the master blacklists
+            # it instead of re-publishing every cooldown window
+            self._executor._report_trainer_config(
+                plan_id=plan_id, apply_failed=True)
+            return
+        if getattr(cfg, "moe_dispatch", ""):
+            # a dispatch-mode change rebuilds the MODEL (the mode lives
+            # in the model config, not a trainer knob) — not appliable
+            # live yet, and silently acking it as applied would lie to
+            # the decision trail
+            logger.warning(
+                "optimizer plan %s carries moe_dispatch=%s, which "
+                "cannot be applied live yet; ignoring that knob",
+                plan_id, cfg.moe_dispatch)
+        self._executor.request_retune(
+            steps_per_call=(cfg.steps_per_call or None),
+            train_window=(cfg.train_window
+                          if cfg.train_window >= 0 else None),
+            mesh_shape=(dict(cfg.mesh_shape) if cfg.mesh_shape
+                        else None),
+            plan_id=plan_id,
+            trace_id=getattr(cfg, "trace_id", "") or "",
+            predicted_speedup=float(
+                getattr(cfg, "predicted_speedup", 0.0) or 0.0),
+            prewarm=bool(getattr(cfg, "prewarm", True)),
+        )
+
+    def end(self, executor: "TrainExecutor"):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
 class TrainExecutor:
     """train_and_evaluate over an ElasticTrainer.
 
@@ -417,6 +530,36 @@ class TrainExecutor:
         ):
             self._hooks.append(NodeRuntimeReportHook(
                 master_client, every_steps=report_steps))
+        # runtime-optimizer plan channel: poll the master for published
+        # plans and apply them live (plan_poll_secs=0 or an explicit
+        # hook instance opts out)
+        plan_poll = float(conf.get(
+            "plan_poll_secs", getattr(ctx, "plan_poll_secs", 30.0)))
+        if (
+            master_client is not None and plan_poll > 0
+            and hasattr(master_client, "get_parallel_config")
+            and not any(isinstance(h, OptimizerPlanHook)
+                        for h in self._hooks)
+        ):
+            self._hooks.append(OptimizerPlanHook(
+                master_client, poll_secs=plan_poll))
+        # a pending optimizer plan (applied at the next loop boundary,
+        # after the window drains) and the post-apply measurement window
+        # feeding the OPTIMIZER_APPLIED predicted-vs-realized record
+        self._retune_request: Optional[Dict[str, Any]] = None
+        self._pending_applied: Optional[Dict[str, Any]] = None
+        self._applied_probe_counts = None
+        # rolling step-time snapshots (refreshed every plan_measure_steps
+        # materialized steps): the pre-apply p50 is measured against the
+        # most recent CLOSED window, not the whole-run cumulative
+        # histogram — on a long job whose degradation started late, the
+        # since-start p50 would be healthy-dominated and the realized
+        # speedup meaningless
+        self._recent_counts = None
+        self._recent_counts_prev = None
+        self._plan_measure_steps = max(1, int(conf.get(
+            "plan_measure_steps",
+            getattr(ctx, "plan_measure_steps", 16))))
         # time-to-first-materialized-step after TRAIN_START: the
         # trace+compile(+restore) cost, the goodput compile bucket
         self._train_started_mono: Optional[float] = None
@@ -593,6 +736,27 @@ class TrainExecutor:
         self._reshard_devices = list(devices) if devices is not None else None
         self._reshard_requested = True
 
+    def request_retune(self, steps_per_call: Optional[int] = None,
+                       train_window: Optional[int] = None,
+                       mesh_shape: Optional[Dict[str, int]] = None,
+                       plan_id: str = "", trace_id: str = "",
+                       predicted_speedup: float = 0.0,
+                       prewarm: bool = True):
+        """A runtime-optimizer plan arrived (``OptimizerPlanHook``):
+        apply it at the next loop boundary — drain the window, then
+        retune the host knob (``train_window``) in place and swap the
+        compiled program (``steps_per_call`` / mesh override) through
+        the program cache. No process restart."""
+        self._retune_request = {
+            "steps_per_call": steps_per_call,
+            "train_window": train_window,
+            "mesh_shape": dict(mesh_shape) if mesh_shape else None,
+            "plan_id": plan_id,
+            "trace_id": trace_id,
+            "predicted_speedup": float(predicted_speedup or 0.0),
+            "prewarm": bool(prewarm),
+        }
+
     def _maybe_restart(self):
         if self._reshard_requested:
             self._reshard_requested = False
@@ -625,12 +789,238 @@ class TrainExecutor:
             # (the snapshot covers the last DRAINED step): reset the
             # speed monitor so its gauge/series track the truth
             self._report_step_reset()
+            # the master's optimizer re-plans on world changes: tell it
+            # what this worker now actually runs
+            self._report_trainer_config()
+            return
+        if self._retune_request is not None:
+            req = self._retune_request
+            self._retune_request = None
+            self._apply_plan(req)
             return
         if not self._restart_requested:
             return
         self._restart_requested = False
         logger.info("rebuilding training session (membership change)")
         self.state = self._trainer.on_world_change(self.state)
+
+    # -- optimizer plan application ------------------------------------------
+
+    def _window_p50(self, counts, baseline) -> Optional[float]:
+        """Step-time p50 over the histogram DELTA between two snapshots
+        (baseline None = since the start of the run)."""
+        if counts is None:
+            return None
+        window = (
+            [c - b for c, b in zip(counts, baseline)]
+            if baseline is not None else list(counts)
+        )
+        bounds = getattr(self._h_step_time, "bounds", None)
+        if not bounds:
+            return None
+        return percentile_from_counts(bounds, window, 0.50)
+
+    def _mesh_override_from(self, mesh_shape) -> Optional[Any]:
+        """The MeshPlan override a plan's mesh_shape asks for — None
+        when it matches what the trainer already runs (an identical
+        override would only churn the program-cache key)."""
+        if not mesh_shape:
+            return None
+        from dlrover_tpu.parallel.mesh import MESH_AXES, MeshPlan
+
+        wanted = {a: int(mesh_shape.get(a, 1)) for a in MESH_AXES}
+        try:
+            current = self._trainer.accelerated.strategy.mesh.axis_sizes()
+        except (RuntimeError, AttributeError):
+            current = None
+        if current is not None and {
+            a: int(v) for a, v in current.items()
+        } == wanted:
+            return None
+        return MeshPlan(**wanted)
+
+    def _apply_plan(self, req: Dict[str, Any]):
+        """Apply one optimizer plan at a drained boundary: host knobs
+        retune in place, program knobs swap through the trainer's
+        program cache (prewarmed first so the swap itself pays zero
+        recompiles). Failure keeps the previous config running — a bad
+        plan must never take the job down."""
+        from dlrover_tpu.telemetry.trace_context import trace_scope
+
+        plan_id = req.get("plan_id", "")
+        with trace_scope(req.get("trace_id") or None):
+            self._apply_plan_scoped(req, plan_id)
+
+    def _apply_plan_scoped(self, req: Dict[str, Any], plan_id: str):
+        k = req.get("steps_per_call")
+        w = req.get("train_window")
+        mesh = self._mesh_override_from(req.get("mesh_shape"))
+        cur_k = max(1, int(getattr(self._trainer, "steps_per_call", 1)))
+        if k is not None and int(k) == cur_k:
+            k = None
+        needs_program = k is not None or mesh is not None
+        emit_event(
+            EventKind.OPTIMIZER_APPLY_BEGIN, plan_id=plan_id,
+            steps_per_call=k, train_window=w,
+            mesh=req.get("mesh_shape") if mesh is not None else None,
+            step=int(getattr(self.state, "step", 0)),
+        )
+        t0 = time.monotonic()
+        pre_counts = self._h_step_time.snapshot_counts()
+        # baseline: the start of the last CLOSED rolling window (falls
+        # back to the since-start histogram early in a short run)
+        baseline = (self._recent_counts_prev
+                    if self._recent_counts_prev is not None
+                    else self._recent_counts)
+        if baseline is None:
+            baseline = self._applied_probe_counts
+        pre_p50 = self._window_p50(pre_counts, baseline)
+        recompiled = 0
+        prewarmed = False
+        try:
+            if needs_program:
+                if req.get("prewarm", True):
+                    prewarmed = self._trainer.prewarm(
+                        devices=getattr(self._trainer, "devices", None),
+                        steps_per_call=k, mesh=mesh,
+                    )
+                compiles_before = self._trainer.compile_count
+                self.state = self._trainer.retune(
+                    self.state, steps_per_call=k, mesh=mesh,
+                )
+                recompiled = (
+                    self._trainer.compile_count - compiles_before
+                )
+                self._report_step_reset()
+            if w is not None:
+                self._train_window = max(0, int(w))
+        except Exception:  # noqa: BLE001 — a bad plan must not kill the job
+            logger.exception(
+                "optimizer plan %s failed to apply; continuing with "
+                "the previous config", plan_id,
+            )
+            emit_event(
+                EventKind.OPTIMIZER_APPLY_DONE, error_code="APPLY_FAILED",
+                plan_id=plan_id,
+                seconds=round(time.monotonic() - t0, 3),
+            )
+            # negative ack: without it the master re-chooses the same
+            # deterministically-failing plan after every cooldown
+            # window, stalling the job with a drain + failed rebuild
+            # each cycle
+            self._report_trainer_config(plan_id=plan_id,
+                                        apply_failed=True)
+            return
+        seconds = time.monotonic() - t0
+        # the apply stall (prewarm compile, snapshot/reshard) must not
+        # bleed into the FIRST post-apply step's measured wall time —
+        # it would poison the realized-speedup window
+        self._last_materialize = time.monotonic()
+        reg = get_registry()
+        reg.counter(
+            tm.OPTIMIZER_PLANS_APPLIED,
+            help="optimizer plans applied live (no restart)").inc()
+        reg.histogram(
+            tm.OPTIMIZER_APPLY_TIME,
+            help="wall seconds of one live plan application",
+        ).observe(seconds)
+        emit_event(
+            EventKind.OPTIMIZER_APPLY_DONE, plan_id=plan_id,
+            seconds=round(seconds, 3), recompiled=recompiled,
+            prewarmed=prewarmed, train_window=self._train_window,
+            steps_per_call=int(getattr(
+                self._trainer, "steps_per_call", 1)),
+        )
+        logger.info(
+            "optimizer plan %s applied in %.2fs (recompiled=%d, "
+            "prewarmed=%s)", plan_id, seconds, recompiled, prewarmed,
+        )
+        counts_after = self._h_step_time.snapshot_counts()
+        if counts_after is not None:
+            self._pending_applied = {
+                "plan_id": plan_id,
+                "trace_id": req.get("trace_id", ""),
+                "predicted_speedup": req.get("predicted_speedup", 0.0),
+                "pre_p50": pre_p50,
+                "counts_at_apply": counts_after,
+                "target_steps": (
+                    self._c_steps.value + self._plan_measure_steps),
+            }
+        # ack the APPLY immediately (so the master marks the decision
+        # applied and retracts the broadcast even if the job ends — or
+        # telemetry is off — before the measurement window closes); the
+        # realized-speedup measurement follows as a best-effort second
+        # report from _finish_applied
+        self._report_trainer_config(
+            plan_id=plan_id,
+            predicted_speedup=req.get("predicted_speedup", 0.0),
+        )
+
+    def _finish_applied(self, step: int):
+        """The post-apply measurement window closed: emit the
+        predicted-vs-realized OPTIMIZER_APPLIED record and ack the plan
+        to the master."""
+        pa = self._pending_applied
+        self._pending_applied = None
+        if pa is None:
+            return
+        cur = self._h_step_time.snapshot_counts()
+        post_p50 = self._window_p50(cur, pa["counts_at_apply"])
+        realized = None
+        if pa["pre_p50"] and post_p50:
+            realized = round(pa["pre_p50"] / post_p50, 3)
+        from dlrover_tpu.telemetry.trace_context import trace_scope
+
+        # re-enter the plan's incident scope: the measurement window
+        # closes steps after the apply, but the APPLIED record must
+        # join the same decision trail
+        with trace_scope(pa.get("trace_id") or None):
+            emit_event(
+                EventKind.OPTIMIZER_APPLIED, plan_id=pa["plan_id"],
+                predicted_speedup=round(pa["predicted_speedup"], 3),
+                realized_speedup=realized,
+                pre_step_p50_s=pa["pre_p50"], post_step_p50_s=post_p50,
+                step=step,
+            )
+        self._applied_probe_counts = cur
+        self._report_trainer_config(
+            plan_id=pa["plan_id"],
+            predicted_speedup=pa["predicted_speedup"],
+            realized_speedup=realized or 0.0,
+        )
+
+    def _report_trainer_config(self, plan_id: str = "",
+                               predicted_speedup: float = 0.0,
+                               realized_speedup: float = 0.0,
+                               apply_failed: bool = False):
+        """Tell the master what this worker ACTUALLY runs (the runtime
+        optimizer's running-config input and plan-apply ack)."""
+        if self._master_client is None or not hasattr(
+            self._master_client, "report_trainer_config"
+        ):
+            return
+        try:
+            result = self._trainer.accelerated
+            mesh_shape = {
+                a: int(v)
+                for a, v in result.strategy.mesh.axis_sizes().items()
+            }
+            self._master_client.report_trainer_config(
+                world=int(result.mesh.devices.size),
+                mesh_shape=mesh_shape,
+                train_window=int(self._train_window),
+                steps_per_call=int(getattr(
+                    self._trainer, "steps_per_call", 1)),
+                global_batch=int(
+                    result.strategy.global_batch_size or 0),
+                plan_id=plan_id,
+                predicted_speedup=float(predicted_speedup or 0.0),
+                realized_speedup=float(realized_speedup or 0.0),
+                apply_failed=bool(apply_failed),
+            )
+        except Exception:  # noqa: BLE001 — a dead master must not block
+            # training; the optimizer just runs on a staler config view
+            logger.debug("trainer config report failed", exc_info=True)
 
     def _report_step_reset(self):
         """Tell the master the true global step REWOUND (rollback / live
@@ -818,6 +1208,15 @@ class TrainExecutor:
             self._last_metrics = sub
             self._h_step_time.observe(per_step)
             self._c_steps.inc()
+            if self._c_steps.value % self._plan_measure_steps == 0:
+                self._recent_counts_prev = self._recent_counts
+                self._recent_counts = self._h_step_time.snapshot_counts()
+            if (
+                self._pending_applied is not None
+                and self._c_steps.value
+                >= self._pending_applied["target_steps"]
+            ):
+                self._finish_applied(s)
             for hook in self._hooks:
                 hook.after_step(s, sub)
             if (
@@ -891,16 +1290,25 @@ class TrainExecutor:
         self._last_log = time.monotonic()
         self._last_materialize = time.monotonic()
         self._log_counts_snapshot = None
+        self._applied_probe_counts = None
+        self._recent_counts = None
+        self._recent_counts_prev = None
         self._last_eval_step = -1
-        window = self._train_window
-        k_call = max(1, int(getattr(self._trainer, "steps_per_call", 1)))
         self._dispatched_step = step
         self._window.clear()
         self._train_started_mono = time.monotonic()
         emit_event(EventKind.TRAIN_START, step=step,
-                   train_window=window, steps_per_call=k_call)
+                   train_window=self._train_window,
+                   steps_per_call=max(1, int(getattr(
+                       self._trainer, "steps_per_call", 1))))
+        self._report_trainer_config()
         try:
             while True:
+                # re-read per iterator epoch: a live retune (optimizer
+                # plan) changes these between boundary re-entries
+                window = self._train_window
+                k_call = max(1, int(getattr(
+                    self._trainer, "steps_per_call", 1)))
                 data_iter = iter(self._train_iter_fn())
                 restarted = False
                 while True:
@@ -993,7 +1401,8 @@ class TrainExecutor:
                             restarted = True
                             break
                         return self._finish(step)
-                    if self._restart_requested or self._reshard_requested:
+                    if (self._restart_requested or self._reshard_requested
+                            or self._retune_request is not None):
                         if self._drain_window():
                             step = int(self.state.step)
                             restarted = True

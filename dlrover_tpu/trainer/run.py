@@ -158,10 +158,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return lint_main(argv[1:])
     if argv and argv[0] in ("metrics", "mttr", "goodput", "diagnose",
-                            "events", "trace", "cache"):
+                            "plan", "events", "trace", "cache"):
         # `tpurun metrics [--addr host:port]` / `tpurun mttr ...` /
-        # `tpurun goodput` / `tpurun diagnose` / `tpurun cache` — the
-        # observability CLI (docs/observability.md)
+        # `tpurun goodput` / `tpurun diagnose` / `tpurun plan` /
+        # `tpurun cache` — the observability CLI
+        # (docs/observability.md)
         from dlrover_tpu.telemetry.cli import main as telemetry_main
 
         return telemetry_main(argv)
