@@ -660,10 +660,16 @@ def dispatch_result() -> dict:
                 self.t0 = time.perf_counter()
 
     def run_mode(mode_window, mode_spc, telemetry=True,
-                 mode_steps=None):
+                 mode_steps=None, attribution=True):
         from dlrover_tpu.common.config import get_context
 
         get_context().telemetry_enabled = telemetry
+        # the telemetry A/B arms pin attribution OFF on BOTH sides so
+        # the pair isolates exactly the instrumentation cost it was
+        # designed to measure (the attribution plane's own ≤5% paired
+        # gate lives in tests/test_attribution.py); the wedge legs keep
+        # it on, which is where the per-leg mfu/exposed numbers come from
+        get_context().attribution_enabled = attribution
         n_steps = steps if mode_steps is None else mode_steps
         trainer = ElasticTrainer(
             init_fn, loss_fn, optax.sgd(0.05), batch,
@@ -689,13 +695,37 @@ def dispatch_result() -> dict:
         params = jax.device_get(executor.state.params)
         return n_steps / dt, recompiles, params
 
+    def attr_gauges(telemetry=True):
+        """The leg's derived attribution gauges (MFU / exposed-comm
+        fraction), read right after its executor finished; None when
+        telemetry was off (no capture ran — absent, not 0)."""
+        if not telemetry:
+            return {"mfu": None, "exposed_comm_frac": None}
+        from dlrover_tpu.telemetry import names as tmn
+        from dlrover_tpu.telemetry.metrics import process_registry
+
+        reg = process_registry()
+        mfu = reg.get(tmn.ATTR_MFU)
+        frac = reg.get(tmn.ATTR_EXPOSED_COMM_FRAC)
+        return {
+            # 12 digits: a tiny CPU-mesh model against a datasheet TPU
+            # peak is ~1e-9 MFU — 6 digits would floor it to a fake 0
+            "mfu": round(mfu.value, 12) if mfu is not None else None,
+            "exposed_comm_frac": (round(frac.value, 6)
+                                  if frac is not None else None),
+        }
+
     from dlrover_tpu.common.config import get_context as _get_ctx
 
     prev_telemetry = _get_ctx().telemetry_enabled
+    prev_attribution = _get_ctx().attribution_enabled
     try:
         sync_rate, sync_rc, sync_params = run_mode(0, 1)
+        sync_attr = attr_gauges()
         win_rate, win_rc, win_params = run_mode(window, 1)
+        win_attr = attr_gauges()
         scan_rate, scan_rc, scan_params = run_mode(window, spc)
+        scan_attr = attr_gauges()
         # telemetry overhead wedge: same window+scan loop,
         # instrumentation off (null registry handles, no spans/events)
         # vs on. Back-to-back PAIRS, alternating order, median of
@@ -711,14 +741,18 @@ def dispatch_result() -> dict:
         for i in range(3):
             if i % 2 == 0:
                 r_bare, rc_b, bare_params = run_mode(
-                    window, spc, telemetry=False, mode_steps=ab_steps)
+                    window, spc, telemetry=False, mode_steps=ab_steps,
+                    attribution=False)
                 r_inst, rc_i, inst_params = run_mode(
-                    window, spc, mode_steps=ab_steps)
+                    window, spc, mode_steps=ab_steps,
+                    attribution=False)
             else:
                 r_inst, rc_i, inst_params = run_mode(
-                    window, spc, mode_steps=ab_steps)
+                    window, spc, mode_steps=ab_steps,
+                    attribution=False)
                 r_bare, rc_b, bare_params = run_mode(
-                    window, spc, telemetry=False, mode_steps=ab_steps)
+                    window, spc, telemetry=False, mode_steps=ab_steps,
+                    attribution=False)
             bare_rates.append(r_bare)
             inst_rates.append(r_inst)
             pair_ratios.append(r_bare / max(r_inst, 1e-9))
@@ -728,6 +762,7 @@ def dispatch_result() -> dict:
         # mid-run must not leave telemetry silently off (in-process
         # callers like tests/test_bench_wedge.py share the singleton)
         _get_ctx().telemetry_enabled = prev_telemetry
+        _get_ctx().attribution_enabled = prev_attribution
     scan_best = max(inst_rates)
     bare_best = max(bare_rates)
     median_ratio = sorted(pair_ratios)[len(pair_ratios) // 2]
@@ -778,6 +813,13 @@ def dispatch_result() -> dict:
             "telemetry_on_steps_per_s": round(scan_best, 1),
             "telemetry_off_steps_per_s": round(bare_best, 1),
             "telemetry_overhead_pct": telemetry_overhead_pct,
+            # per-leg performance attribution (derived from the same
+            # compiled-program record + measured step times)
+            "attribution_per_leg": {
+                "sync": sync_attr,
+                "window": win_attr,
+                "window_scan": scan_attr,
+            },
         },
     }
     if not parity:
@@ -1641,11 +1683,22 @@ def _replan_leg(slow_s: float, steps: int, poll: bool,
         chosen = [d for d in
                   master.servicer.runtime_optimizer.decisions()
                   if d["outcome"] == "chosen"]
+        # the measured node's derived attribution gauges (its registry
+        # is still live — run_node resets at ENTRY, not exit)
+        from dlrover_tpu.telemetry import names as tmn
+
+        reg = process_registry()
+        g_mfu = reg.get(tmn.ATTR_MFU)
+        g_frac = reg.get(tmn.ATTR_EXPOSED_COMM_FRAC)
         return {
             "rate": (measure_to - measure_from) / max(dt, 1e-9),
             "finished_steps": int(ex.state.step),
             "steps_per_call": trainer.steps_per_call,
             "chosen": chosen,
+            "mfu": (round(g_mfu.value, 12)
+                    if g_mfu is not None else None),
+            "exposed_comm_frac": (round(g_frac.value, 6)
+                                  if g_frac is not None else None),
         }
     finally:
         master.stop()
@@ -1732,6 +1785,19 @@ def replan_result() -> dict:
                 for p in plans],
             "apply_recompiles": apply_recompiles,
             "applied_without_restart": no_restart,
+            # per-leg attribution: the closed loop's K-amortization
+            # shows up as a HIGHER mfu / LOWER exposed-comm fraction
+            # on the same injected straggler
+            "mfu_per_leg": {
+                "degraded": [d.get("mfu") for d in degraded],
+                "optimized": [o.get("mfu") for o in optimized],
+            },
+            "exposed_comm_frac_per_leg": {
+                "degraded": [d.get("exposed_comm_frac")
+                             for d in degraded],
+                "optimized": [o.get("exposed_comm_frac")
+                              for o in optimized],
+            },
             "n_devices": len(jax.devices()),
         },
     }

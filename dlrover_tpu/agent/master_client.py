@@ -234,6 +234,19 @@ class MasterClient:
         except ValueError:
             return {}
 
+    def get_attribution(self, node_id: int = -1, limit: int = 0) -> dict:
+        """The master's performance-attribution view: per-node derived
+        MFU / exposed-comm / HBM gauges + the optimizer's memory-gate
+        rejections (``tpurun attribution --addr``)."""
+        import json
+
+        resp = self._channel.get(comm.AttributionRequest(
+            node_id=node_id, limit=limit))
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
+
     def report_heartbeat(self) -> comm.Response:
         return self._channel.report(comm.NodeHeartbeat(
             node_id=self.node_id, timestamp=time.time()

@@ -21,6 +21,7 @@ Rule ids:
   G104 dtype drift: f32 matmuls on a bf16 compute path
   G105 donation not applied to the train state
   G106 actual HLO collective bytes vs ``planner.predicted_collective_bytes``
+  G107 compiled peak HBM above the configured per-device budget
 
 Every check is a pure function over lowered/compiled text so the AOT CLI
 (``parallel.aot --lint``) and golden-fixture tests reuse them without
@@ -39,7 +40,8 @@ from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("analysis.graph")
 
-ALL_GRAPH_RULES = ("G101", "G102", "G103", "G104", "G105", "G106")
+ALL_GRAPH_RULES = ("G101", "G102", "G103", "G104", "G105", "G106",
+                   "G107")
 
 GRAPH_RULE_DOCS: Dict[str, str] = {
     "G101": "params the strategy shards are replicated in the compiled "
@@ -53,6 +55,8 @@ GRAPH_RULE_DOCS: Dict[str, str] = {
     "G105": "buffer donation not applied to the train state",
     "G106": "compiled HLO collective bytes diverge from the planner's "
             "predicted collective bytes beyond tolerance",
+    "G107": "compiled peak HBM residency exceeds the configured "
+            "per-device budget",
 }
 
 # Default G106 tolerance (ratio, symmetric in log space). Chosen as one
@@ -426,6 +430,32 @@ def collective_audit(measured_total: float, predicted_total: float,
     )]
 
 
+def check_memory_budget(peak_hbm_bytes: float, hbm_budget_bytes: float,
+                        path: str = "<train_step>") -> List[Finding]:
+    """G107: the compiled program's peak HBM (``memory_analysis``:
+    args + temps + outputs - donated aliases, per device) must fit the
+    configured budget — the static-analysis face of the runtime
+    optimizer's memory-feasibility gate, so an over-budget program
+    fails ``aot.py --lint`` BEFORE a chip is allocated. Skipped when
+    either side is unknown (<= 0)."""
+    if peak_hbm_bytes <= 0 or hbm_budget_bytes <= 0:
+        return []
+    if peak_hbm_bytes <= hbm_budget_bytes:
+        return []
+    return [Finding(
+        rule_id="G107", path=path, line=0,
+        message=f"compiled peak HBM {peak_hbm_bytes / 1e9:.2f} GB "
+                f"exceeds the per-device budget "
+                f"{hbm_budget_bytes / 1e9:.2f} GB "
+                f"({peak_hbm_bytes / hbm_budget_bytes:.2f}x): this "
+                f"program OOMs the devices it claims to target",
+        fixit="shard more (fsdp/tensor), raise remat, shrink the "
+              "per-chip batch, or raise "
+              "DLROVER_TPU_DEVICE_HBM_BUDGET_BYTES if the budget is "
+              "deliberately conservative",
+    )]
+
+
 # -- drivers ----------------------------------------------------------------
 
 
@@ -463,6 +493,8 @@ def lint_artifacts(
     audit_tol: float = DEFAULT_AUDIT_TOL,
     pipe_virtual: int = 1,
     steps_per_call: int = 1,
+    peak_hbm_bytes: float = 0.0,
+    hbm_budget_bytes: float = 0.0,
     label: str = "<train_step>",
 ) -> GraphLintReport:
     """Run every enabled graph rule over already-built artifacts (the
@@ -473,7 +505,9 @@ def lint_artifacts(
     program — the outer ``lax.scan`` carries ``known_trip_count=K``, so
     the measured collective bytes come out K-weighted by
     ``_loop_multipliers`` and the per-step planner prediction must be
-    scaled by K to stay comparable (G106)."""
+    scaled by K to stay comparable (G106).
+    ``peak_hbm_bytes``/``hbm_budget_bytes``: the compiled per-device
+    residency and its budget for G107 (0 = skip the check)."""
     from dlrover_tpu.parallel import planner
 
     on = set(rules) if rules is not None else set(ALL_GRAPH_RULES)
@@ -516,6 +550,9 @@ def lint_artifacts(
             report.measured_total, report.predicted_total,
             tol=audit_tol, path=label, detail=detail,
         ))
+    if "G107" in on:
+        f.extend(check_memory_budget(peak_hbm_bytes, hbm_budget_bytes,
+                                     path=label))
     return report
 
 
@@ -529,6 +566,7 @@ def lint_train_step(
     devices=None,
     tpu_gen: str = "v5e",
     steps_per_call: int = 1,
+    hbm_budget_bytes: float = 0.0,
     label: str = "",
 ) -> GraphLintReport:
     """Build (model, strategy) through ``accelerate``, lower + compile on
@@ -619,6 +657,16 @@ def lint_train_step(
     )
     if steps_per_call > 1 and not label:
         name += f"[K={steps_per_call}]"
+    # G107 inputs: compiled residency via the shared memory shim, the
+    # budget from the caller > Context knob > the device spec capacity
+    from dlrover_tpu.common.config import get_context
+    from dlrover_tpu.utils.prof import compiled_peak_bytes
+
+    budget = (
+        hbm_budget_bytes
+        or float(getattr(get_context(), "device_hbm_budget_bytes", 0.0))
+        or float(planner.TPU_SPECS[tpu_gen].hbm_bytes)
+    )
     report = lint_artifacts(
         stablehlo=lowered.as_text(),
         optimized_hlo=compiled.as_text(),
@@ -636,6 +684,8 @@ def lint_train_step(
         rules=rules,
         audit_tol=audit_tol,
         steps_per_call=steps_per_call,
+        peak_hbm_bytes=float(compiled_peak_bytes(compiled)),
+        hbm_budget_bytes=budget,
         label=name,
     )
     report.build_seconds = time.time() - t0

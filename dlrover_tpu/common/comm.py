@@ -302,7 +302,28 @@ class NodeRuntimeReport:
     window_occupancy: float = 0.0
     lagged_age: float = 0.0
     rss_mb: float = 0.0
-    device_mem_mb: float = 0.0
+    # None = the backend exposes no memory stats (CPU): the master must
+    # report the gauge ABSENT, never a fake 0
+    device_mem_mb: Optional[float] = None
+    hbm_headroom_mb: Optional[float] = None
+    # performance-attribution derived gauges (None until the worker
+    # captured a per-program attribution record)
+    mfu: Optional[float] = None
+    exposed_comm_frac: Optional[float] = None
+    flops_per_step: Optional[float] = None
+    peak_hbm_mb: Optional[float] = None
+
+
+@message
+class AttributionRequest:
+    """Query the master's performance-attribution view: per-node
+    derived MFU / exposed-comm / HBM gauges from the node series plus
+    the optimizer's memory-feasibility rejections (the ``tpurun
+    attribution --addr`` view). Answered with a DiagnosisReport-style
+    JSON blob."""
+
+    node_id: int = -1
+    limit: int = 0  # 0 = every retained memory rejection
 
 
 @message

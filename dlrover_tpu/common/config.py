@@ -139,6 +139,23 @@ class Context:
         # before the realized speedup is measured and OPTIMIZER_APPLIED
         # is emitted (the post-convergence window)
         self.plan_measure_steps = 16
+        # performance-attribution plane (telemetry.attribution,
+        # docs/observability.md): capture a per-compiled-program
+        # attribution record (exact FLOPs, bytes-accessed, per-
+        # collective bytes, compiled peak HBM) once per program and
+        # derive live MFU / exposed-comm-fraction gauges from it.
+        # Requires telemetry_enabled; off = no capture, gauges absent.
+        self.attribution_enabled = True
+        # hardware peak FLOPs/s per device for the MFU denominator
+        # (0 = sniff the device kind against the planner's TPU_SPECS;
+        # CPU meshes fall back to the v5e datasheet so the gauge stays
+        # defined — set this explicitly for meaningful CPU numbers)
+        self.device_peak_flops = 0.0
+        # per-device HBM budget in BYTES for the G107 graph lint and
+        # the optimizer's memory-feasibility gate (0 = the device
+        # spec's capacity, with the planner's 0.8 fit headroom where it
+        # applies)
+        self.device_hbm_budget_bytes = 0.0
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -47,7 +47,15 @@ class NodeSample:
     window_occupancy: float = 0.0
     lagged_age: float = 0.0
     rss_mb: float = 0.0
-    device_mem_mb: float = 0.0
+    # None = not measured (CPU backends expose no memory stats; MFU /
+    # exposed-comm arrive only once the worker captured an attribution
+    # record) — the labeled gauges below export ONLY present values
+    device_mem_mb: Optional[float] = None
+    hbm_headroom_mb: Optional[float] = None
+    mfu: Optional[float] = None
+    exposed_comm_frac: Optional[float] = None
+    flops_per_step: Optional[float] = None
+    peak_hbm_mb: Optional[float] = None
     overflow: bool = False
 
 
@@ -128,6 +136,9 @@ class NodeRuntimeStore:
             p95, of95 = pct("step_time", 0.95)
             d50, _ = pct("dispatch", 0.50)
             s50, _ = pct("host_sync", 0.50)
+            def opt(value):
+                return float(value) if value is not None else None
+
             sample = NodeSample(
                 ts=ts,
                 step=int(report.step),
@@ -140,7 +151,17 @@ class NodeRuntimeStore:
                 window_occupancy=float(report.window_occupancy),
                 lagged_age=float(report.lagged_age),
                 rss_mb=float(report.rss_mb),
-                device_mem_mb=float(report.device_mem_mb),
+                device_mem_mb=opt(getattr(report, "device_mem_mb",
+                                          None)),
+                hbm_headroom_mb=opt(getattr(report, "hbm_headroom_mb",
+                                            None)),
+                mfu=opt(getattr(report, "mfu", None)),
+                exposed_comm_frac=opt(getattr(report,
+                                              "exposed_comm_frac",
+                                              None)),
+                flops_per_step=opt(getattr(report, "flops_per_step",
+                                           None)),
+                peak_hbm_mb=opt(getattr(report, "peak_hbm_mb", None)),
                 overflow=bool(of50 or of95),
             )
             state.samples.append(sample)
@@ -171,9 +192,29 @@ class NodeRuntimeStore:
                       s.window_occupancy)
         reg.gauge(tm.NODE_RSS_MB, labels=labels,
                   help="per-node worker process RSS (MB)").set(s.rss_mb)
-        reg.gauge(tm.NODE_DEVICE_MEM_MB, labels=labels,
-                  help="per-node accelerator bytes_in_use (MB)").set(
-                      s.device_mem_mb)
+        # absent-valued stats (CPU backend, attribution not captured)
+        # export NO series — a scraper must never read a fake 0, and a
+        # stat that BECOMES absent (program swap, failed re-capture)
+        # retracts its series rather than freezing the last value
+        optional = (
+            (tm.NODE_DEVICE_MEM_MB, s.device_mem_mb,
+             "per-node accelerator bytes_in_use (MB)"),
+            (tm.NODE_HBM_HEADROOM_MB, s.hbm_headroom_mb,
+             "per-node HBM bytes_limit - bytes_in_use (MB)"),
+            (tm.NODE_MFU, s.mfu,
+             "per-node live model-FLOPs utilization"),
+            (tm.NODE_EXPOSED_COMM_FRAC, s.exposed_comm_frac,
+             "per-node exposed-communication fraction (upper bound)"),
+            (tm.NODE_FLOPS_PER_STEP, s.flops_per_step,
+             "per-node compiled FLOPs per step"),
+            (tm.NODE_PEAK_HBM_MB, s.peak_hbm_mb,
+             "per-node compiled peak HBM (MB)"),
+        )
+        for name, value, help_text in optional:
+            if value is not None:
+                reg.gauge(name, labels=labels, help=help_text).set(value)
+            else:
+                reg.remove(name, labels=labels)
         reg.gauge(tm.NODE_STEPS_TOTAL, labels=labels,
                   help="per-node optimizer steps materialized").set(
                       s.steps_total)

@@ -206,6 +206,7 @@ class StragglerDetector:
         if mine is None or mine.step_p50 is None or mine.window_steps <= 0:
             return
         peers = []
+        peer_fracs = []
         for nid in self._store.node_ids():
             if nid == node_id:
                 continue
@@ -214,6 +215,8 @@ class StragglerDetector:
                     or now - s.ts > self._freshness):
                 continue
             peers.append(s.step_p50)
+            if getattr(s, "exposed_comm_frac", None) is not None:
+                peer_fracs.append(s.exposed_comm_frac)
         if not peers:
             # no fresh peer anchors a median: there is no evidence
             # basis, so an existing straggler verdict must not outlive
@@ -236,20 +239,39 @@ class StragglerDetector:
         already = cur is not None and cur.verdict == VERDICT_STRAGGLER
         if over < self._confirm or already:
             return
-        self._flag(
-            node_id, VERDICT_STRAGGLER, now,
-            evidence={
-                "step_p50_s": round(mine.step_p50, 6),
-                "step_p95_s": (round(mine.step_p95, 6)
-                               if mine.step_p95 is not None else None),
-                "peer_median_p50_s": round(peer_median, 6),
-                "ratio": round(ratio, 3),
-                "threshold": self._ratio,
-                "confirm_windows": over,
-                "window_steps": mine.window_steps,
-                "overflow": mine.overflow,
-            },
-        )
+        evidence = {
+            "step_p50_s": round(mine.step_p50, 6),
+            "step_p95_s": (round(mine.step_p95, 6)
+                           if mine.step_p95 is not None else None),
+            "peer_median_p50_s": round(peer_median, 6),
+            "ratio": round(ratio, 3),
+            "threshold": self._ratio,
+            "confirm_windows": over,
+            "window_steps": mine.window_steps,
+            "overflow": mine.overflow,
+        }
+        # performance-attribution labeling: when the node reports the
+        # derived exposed-comm fraction, the verdict says WHY it is
+        # slow — a comm-bound straggler (link contention, bad route)
+        # wants a different remedy than a compute-bound one (thermal
+        # throttle, noisy neighbor). The fraction is an UPPER bound
+        # that rises with ANY slowdown, so the label is RELATIVE: only
+        # a fraction clearly above the healthy peers' median means the
+        # extra time is un-overlapped communication; a straggler whose
+        # fraction tracks its peers is slow at the compute itself.
+        frac = getattr(mine, "exposed_comm_frac", None)
+        if frac is not None:
+            evidence["exposed_comm_frac"] = round(frac, 4)
+            if peer_fracs:
+                peer_frac = statistics.median(peer_fracs)
+                evidence["peer_median_comm_frac"] = round(peer_frac, 4)
+                evidence["bound"] = (
+                    "comm-bound" if frac - peer_frac >= 0.1
+                    else "compute-bound"
+                )
+        if getattr(mine, "mfu", None) is not None:
+            evidence["mfu"] = round(mine.mfu, 6)
+        self._flag(node_id, VERDICT_STRAGGLER, now, evidence=evidence)
 
     # -- verdict bookkeeping (lock held) -------------------------------------
 

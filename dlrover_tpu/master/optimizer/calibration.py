@@ -59,6 +59,27 @@ _FACTOR_MAX = 1e4
 # before the residual is trusted as a DEVICE time observation
 _DEVICE_VISIBLE_MARGIN = 1.25
 
+# analytic-memory fit headroom (the planner's own 0.8: allocator
+# fragmentation, collective buffers, hoisted gathers)
+_FIT_HEADROOM = 0.8
+
+
+class MemoryInfeasibleError(ValueError):
+    """A candidate plan's predicted peak HBM exceeds the device budget:
+    it must be REJECTED BEFORE PRICING (a cheap-looking plan the
+    devices cannot hold would win the ranking and then OOM the apply).
+    Carries the evidence the decision trail records."""
+
+    def __init__(self, mesh, memory_bytes: float, budget_bytes: float):
+        super().__init__(
+            f"plan {mesh} memory-infeasible: predicted peak "
+            f"{memory_bytes / 1e9:.2f} GB > budget "
+            f"{budget_bytes / 1e9:.2f} GB"
+        )
+        self.mesh = mesh
+        self.memory_bytes = float(memory_bytes)
+        self.budget_bytes = float(budget_bytes)
+
 
 @dataclass
 class TermCorrections:
@@ -114,6 +135,9 @@ class CostCalibrator:
     model: ModelSpec
     device: DeviceSpec = field(default_factory=DeviceSpec)
     remat_policy: str = ""
+    # per-device HBM budget (bytes) for the memory-feasibility gate;
+    # 0 = the device spec's capacity under the planner's fit headroom
+    hbm_budget_bytes: float = 0.0
     ema: float = 0.5  # weight of the NEWEST observation
     corrections: TermCorrections = field(default_factory=TermCorrections)
     # factor families that have absorbed at least one real observation:
@@ -210,12 +234,16 @@ class CostCalibrator:
               require_fit: bool = True) -> float:
         """Calibrated predicted per-step seconds for one candidate.
 
-        ``require_fit`` (the candidate-enumeration default) raises
-        ``ValueError`` when ``estimate`` judges the plan infeasible
-        (HBM overflow, unbuildable sharding — the ``fits=False`` /
-        ``step_s=inf`` sentinels): the corrections rescale the
-        breakdown TERMS, which stay finite even for plans the planner
-        refused, and a cheap-looking infeasible mesh must never win the
+        ``require_fit`` (the candidate-enumeration default) rejects
+        plans ``estimate`` judges infeasible BEFORE pricing: an
+        unbuildable sharding (``step_s=inf``) raises ``ValueError``; a
+        memory overflow — predicted peak HBM above ``hbm_budget_bytes``
+        (or the device capacity under the planner's 0.8 fit headroom)
+        — raises ``MemoryInfeasibleError`` carrying the evidence, so
+        the optimizer can record a ``PLAN_REJECTED`` memory reason in
+        the decision trail. The corrections rescale the breakdown
+        TERMS, which stay finite even for plans the planner refused,
+        and a cheap-looking infeasible mesh must never win the
         candidate ranking. Pass ``require_fit=False`` only for the
         CURRENT config, which is observably running regardless of what
         the analytic memory model thinks of it."""
@@ -229,13 +257,24 @@ class CostCalibrator:
             mesh, model, self.device, remat_policy=self.remat_policy,
             steps_per_call=k,
         )
-        if require_fit and (
-            not base.fits or base.step_time_s == float("inf")
-        ):
-            raise ValueError(
-                f"plan {mesh} infeasible (fits={base.fits}, "
-                f"step_s={base.step_time_s})"
-            )
+        if require_fit:
+            if base.step_time_s == float("inf"):
+                raise ValueError(
+                    f"plan {mesh} unbuildable (fits={base.fits}, "
+                    f"step_s={base.step_time_s})"
+                )
+            # an explicit operator budget GOVERNS (it already encodes
+            # whatever headroom the operator wants); otherwise the
+            # planner's own fit judgement (capacity x 0.8) applies
+            if self.hbm_budget_bytes:
+                budget = self.hbm_budget_bytes
+                over = base.memory_bytes > budget
+            else:
+                budget = self.device.hbm_bytes * _FIT_HEADROOM
+                over = not base.fits
+            if over:
+                raise MemoryInfeasibleError(
+                    mesh, base.memory_bytes, budget)
         return calibrated_step_time(
             base, self.corrections, steps_per_call=k,
             overlapped=train_window > 0,

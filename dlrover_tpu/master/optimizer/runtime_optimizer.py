@@ -33,12 +33,15 @@ import collections
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.log import get_logger
-from dlrover_tpu.master.optimizer.calibration import CostCalibrator
+from dlrover_tpu.master.optimizer.calibration import (
+    CostCalibrator,
+    MemoryInfeasibleError,
+)
 from dlrover_tpu.parallel.mesh import (
     MeshPlan,
     candidate_plans,
@@ -168,6 +171,10 @@ class Decision:
     applied: bool = False
     apply_failed: bool = False
     realized_speedup: Optional[float] = None
+    # candidate meshes the MEMORY-FEASIBILITY gate rejected BEFORE
+    # pricing (predicted peak HBM above the device budget) — the
+    # evidence `tpurun plan` / `tpurun attribution` surface
+    memory_rejected: List[Dict] = field(default_factory=list)
     # the chosen candidate's knob-tuple key (blacklist identity on a
     # failed apply); not part of the reported dict
     chosen_key: str = ""
@@ -189,6 +196,7 @@ class Decision:
             "applied": self.applied,
             "apply_failed": self.apply_failed,
             "realized_speedup": self.realized_speedup,
+            "memory_rejected": list(self.memory_rejected),
         }
 
 
@@ -250,6 +258,10 @@ class RuntimeOptimizer:
         self._c_calibrations = reg.counter(
             tm.OPTIMIZER_CALIBRATIONS,
             help="cost-model calibration passes")
+        self._c_memory_rejected = reg.counter(
+            tm.OPTIMIZER_PLANS_MEMORY_REJECTED,
+            help="candidate plans rejected by the memory-feasibility "
+                 "gate before pricing")
 
     # -- inputs --------------------------------------------------------------
 
@@ -364,7 +376,14 @@ class RuntimeOptimizer:
                 param_count=1_000_000, num_layers=2, hidden_size=256,
                 seq_len=128, global_batch=batch,
             )
-        self._calibrator = CostCalibrator(model=spec, device=self._device)
+        ctx = get_context()
+        self._calibrator = CostCalibrator(
+            model=spec, device=self._device,
+            # operator HBM budget for the memory-feasibility gate
+            # (0 = the device spec's capacity under the fit headroom)
+            hbm_budget_bytes=float(
+                getattr(ctx, "device_hbm_budget_bytes", 0.0)),
+        )
         return self._calibrator
 
     def _measured_anchor(self) -> Dict[str, Optional[float]]:
@@ -442,12 +461,20 @@ class RuntimeOptimizer:
         return meshes, ks, windows, moes
 
     def _price_candidates(self, run: RunningConfig
-                          ) -> List[CandidateScore]:
+                          ) -> Tuple[List[CandidateScore], List[Dict]]:
+        """Price every knob combination; returns (priced candidates,
+        memory-rejected evidence). The memory-feasibility gate fires
+        BEFORE pricing: a plan whose predicted peak HBM exceeds the
+        device budget is recorded (once per mesh — the memory estimate
+        is knob-invariant) instead of silently skipped, so the
+        decision trail shows WHY a cheap-looking mesh never competed."""
         cal = self._ensure_calibrator()
         if cal is None:
-            return []
+            return [], []
         meshes, ks, windows, moes = self._knob_options(run)
         out: List[CandidateScore] = []
+        memory_rejected: List[Dict] = []
+        mem_seen: set = set()
         for mesh in meshes:
             for k in ks:
                 for w in windows:
@@ -456,6 +483,19 @@ class RuntimeOptimizer:
                             s = cal.price(
                                 mesh, steps_per_call=k, train_window=w,
                                 moe_dispatch=moe)
+                        except MemoryInfeasibleError as e:
+                            mkey = mesh_axes_key(mesh)
+                            if mkey not in mem_seen:
+                                mem_seen.add(mkey)
+                                self._c_memory_rejected.inc()
+                                memory_rejected.append({
+                                    "mesh": _mesh_dict(mesh),
+                                    "predicted_hbm_bytes": round(
+                                        e.memory_bytes),
+                                    "budget_bytes": round(
+                                        e.budget_bytes),
+                                })
+                            continue
                         except (ValueError, KeyError) as e:
                             logger.debug("candidate %s unpriceable: %s",
                                          mesh, e)
@@ -464,7 +504,11 @@ class RuntimeOptimizer:
                             mesh=mesh, steps_per_call=k, train_window=w,
                             moe_dispatch=moe, predicted_step_s=s,
                         ))
-        return out
+        # worst offender first: the trimmed decision evidence and the
+        # PLAN_REJECTED event must name the true worst, not whichever
+        # mesh enumeration happened to visit early
+        memory_rejected.sort(key=lambda m: -m["predicted_hbm_bytes"])
+        return out, memory_rejected
 
     @staticmethod
     def _churn(c: CandidateScore, run: RunningConfig) -> int:
@@ -518,9 +562,49 @@ class RuntimeOptimizer:
             train_window=run.train_window,
             moe_dispatch=run.moe_dispatch, require_fit=False,
         )
-        candidates = [c for c in self._price_candidates(run)
+        priced, memory_rejected = self._price_candidates(run)
+        candidates = [c for c in priced
                       if c.key not in self._failed_keys]
+        if memory_rejected:
+            # the memory-feasibility gate fired: one PLAN_REJECTED
+            # record per pass carrying the evidence (which meshes, how
+            # far over budget) — visible in `tpurun plan` and
+            # `tpurun attribution`. Decision evidence keeps the 8
+            # worst; the event carries the full count.
+            worst = memory_rejected[0]
+            total_rejected = len(memory_rejected)
+            memory_rejected = memory_rejected[:8]
+            emit_event(
+                EventKind.OPTIMIZER_PLAN_REJECTED,
+                trigger=trigger,
+                reason="memory_infeasible",
+                rejected_meshes=total_rejected,
+                mesh=worst["mesh"],
+                predicted_hbm_mb=round(
+                    worst["predicted_hbm_bytes"] / 1e6, 1),
+                budget_mb=round(worst["budget_bytes"] / 1e6, 1),
+            )
+            logger.info(
+                "replan(%s): %d candidate mesh(es) memory-infeasible "
+                "(worst %s needs %.1f MB > %.1f MB budget)",
+                trigger, total_rejected, worst["mesh"],
+                worst["predicted_hbm_bytes"] / 1e6,
+                worst["budget_bytes"] / 1e6,
+            )
         if not candidates:
+            if memory_rejected:
+                # every candidate died at the gate: the pass itself is
+                # a recorded rejection, not a silent no-op
+                decision = Decision(
+                    trigger=trigger, trace_id=tid, ts=time.time(),
+                    current=run.to_dict(),
+                    current_predicted_s=current_s,
+                    corrections=corrections,
+                    memory_rejected=memory_rejected,
+                )
+                self._reject(decision, "memory_infeasible:all")
+                self._decisions.append(decision)
+                return decision
             return None
         for c in candidates:
             c.speedup = current_s / max(c.predicted_step_s, 1e-12)
@@ -531,6 +615,7 @@ class RuntimeOptimizer:
             trigger=trigger, trace_id=tid, ts=time.time(),
             current=run.to_dict(), current_predicted_s=current_s,
             candidates=table, corrections=corrections,
+            memory_rejected=memory_rejected,
         )
         best = candidates[0]
         decision.predicted_speedup = best.speedup
@@ -629,6 +714,18 @@ class RuntimeOptimizer:
     def decisions(self, limit: int = 0) -> List[Dict]:
         with self._lock:
             out = [d.to_dict() for d in self._decisions]
+        return out[-limit:] if limit else out
+
+    def memory_rejections(self, limit: int = 0) -> List[Dict]:
+        """Every memory-feasibility rejection in the retained decision
+        trail, newest last — the ``tpurun attribution`` evidence of
+        which candidate plans the devices could not hold."""
+        with self._lock:
+            out = [
+                {"ts": d.ts, "trigger": d.trigger,
+                 "trace_id": d.trace_id, **m}
+                for d in self._decisions for m in d.memory_rejected
+            ]
         return out[-limit:] if limit else out
 
     def to_report(self, limit: int = 0) -> Dict:

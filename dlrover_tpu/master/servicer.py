@@ -111,6 +111,7 @@ class MasterServicer:
             comm.ParallelConfigRequest: self._get_parallel_config,
             comm.DiagnosisRequest: self._get_diagnosis,
             comm.PlanRequest: self._get_plan,
+            comm.AttributionRequest: self._get_attribution,
         }
         self._report_handlers = {
             comm.DatasetShardParams: self._new_dataset,
@@ -410,6 +411,30 @@ class MasterServicer:
             },
             "stragglers": self.straggler_detector.stragglers(),
             "hung": self.straggler_detector.hung_nodes(),
+        }
+        return comm.DiagnosisReport(report_json=_json.dumps(report))
+
+    def _get_attribution(self, req: comm.AttributionRequest):
+        """The performance-attribution view: per-node derived MFU /
+        exposed-comm / HBM gauges (from the node series) plus the
+        optimizer's memory-feasibility rejections — the ``tpurun
+        attribution --addr`` payload."""
+        import json as _json
+
+        summary = self.node_runtime_store.summary()
+        if req.node_id >= 0:
+            summary = {req.node_id: summary.get(req.node_id)}
+        keys = ("step", "steps_total", "step_p50", "mfu",
+                "exposed_comm_frac", "flops_per_step", "peak_hbm_mb",
+                "device_mem_mb", "hbm_headroom_mb", "report_age_s")
+        report = {
+            "nodes": {
+                str(node_id): {k: sample.get(k) for k in keys}
+                for node_id, sample in summary.items()
+                if sample is not None
+            },
+            "memory_rejected": self.runtime_optimizer.memory_rejections(
+                limit=req.limit or 0),
         }
         return comm.DiagnosisReport(report_json=_json.dumps(report))
 

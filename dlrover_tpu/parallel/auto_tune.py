@@ -97,21 +97,16 @@ def dryrun(result: AccelerateResult, example_batch, rng=None,
         compiled = lowered.compile()
         report.compile_time_s = time.time() - t0
 
-        try:
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
-            report.flops_per_step = float(cost.get("flops", 0.0))
-        except Exception:
-            pass
-        try:
-            mem = compiled.memory_analysis()
-            report.peak_memory_bytes = int(
-                getattr(mem, "temp_size_in_bytes", 0)
-                + getattr(mem, "argument_size_in_bytes", 0)
-            )
-        except Exception:
-            pass
+        # the shared legacy-jax shims (utils/prof): list-vs-dict cost
+        # analysis and the one peak-residency accounting
+        from dlrover_tpu.utils.prof import (
+            compiled_peak_bytes,
+            cost_analysis_dict,
+        )
+
+        report.flops_per_step = float(
+            cost_analysis_dict(compiled).get("flops", 0.0))
+        report.peak_memory_bytes = compiled_peak_bytes(compiled)
 
         for _ in range(warmup_steps):
             state, _metrics = compiled(state, batch, rng)

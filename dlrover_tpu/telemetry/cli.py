@@ -12,6 +12,12 @@
            calibration factors, candidate table, chosen/rejected
            plans, predicted-vs-realized speedups — live (--addr) or
            forensically from a timeline (--events)
+  attribution
+           the performance-attribution plane: per-node derived MFU /
+           exposed-comm-fraction / HBM gauges and the optimizer's
+           memory-gate rejections — live (--addr), forensically from
+           a timeline (--events), or measured device-time buckets
+           from a jax.profiler trace (--trace)
   events   pretty-print a timeline (newest last)
   metrics  dump Prometheus exposition: a live endpoint via --addr, or
            this process's registry (useful under ``tpurun metrics``)
@@ -79,6 +85,23 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--limit", type=int, default=0,
                     help="only the last N decisions")
     pl.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
+    at = sub.add_parser(
+        "attribution", help="performance attribution: derived MFU / "
+                            "exposed-comm / HBM accounting")
+    at.add_argument("--addr", default="",
+                    help="query a live master at host:port")
+    at.add_argument("--events", default="",
+                    help="derive forensically from a timeline JSONL "
+                         "(default: the configured events sink)")
+    at.add_argument("--trace", default="",
+                    help="parse a jax.profiler Chrome trace "
+                         "(*.trace.json[.gz] file or a profile dump "
+                         "dir) into device-time buckets instead")
+    at.add_argument("--limit", type=int, default=0,
+                    help="only the last N memory-gate rejections")
+    at.add_argument("--json", action="store_true",
                     help="machine-readable output")
 
     ev = sub.add_parser("events", help="print a timeline")
@@ -262,6 +285,10 @@ def _cmd_plan(args) -> int:
                   f"window={c.get('train_window')} mesh={c.get('mesh')}"
                   f" -> {c.get('predicted_step_s')}s/step "
                   f"({c.get('speedup')}x)")
+        for m in d.get("memory_rejected") or []:
+            print(f"    MEMORY-REJECTED mesh={m.get('mesh')}: "
+                  f"predicted {m.get('predicted_hbm_bytes')} B > "
+                  f"budget {m.get('budget_bytes')} B")
     for p in report.get("plans") or []:
         line = (f"plan {p.get('plan_id')} [{p.get('trigger', '')}]: "
                 f"K={p.get('steps_per_call')} "
@@ -280,11 +307,115 @@ def _cmd_plan(args) -> int:
     return 0
 
 
+def _cmd_attribution(args) -> int:
+    """Live (master RPC), forensic (timeline), or measured (trace
+    parse) performance attribution."""
+    if args.trace:
+        from dlrover_tpu.telemetry.attribution import parse_trace_path
+
+        try:
+            buckets = parse_trace_path(args.trace)
+        except (OSError, ValueError) as e:
+            print(f"attribution: trace parse of {args.trace} failed: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(buckets))
+            return 0
+        print(f"device-time buckets over {buckets['events']} trace "
+              f"event(s) ({args.trace}):")
+        for key in ("wall_s", "busy_s", "idle_s", "collective_s",
+                    "compute_s", "infeed_s", "other_s"):
+            print(f"  {key:14s} {buckets[key]}")
+        print(f"measured comm fraction (collective over categorized "
+              f"device-op time): {buckets['measured_comm_frac']}")
+        return 0
+    if args.addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(args.addr)
+        try:
+            report = client.get_attribution(limit=args.limit)
+        finally:
+            client.close()
+        report["source"] = args.addr
+    else:
+        from dlrover_tpu.telemetry import events as events_mod
+        from dlrover_tpu.telemetry.names import EventKind
+
+        path = _resolve_events_path(args.events)
+        if not path:
+            print("attribution: no master --addr and no timeline "
+                  "(pass --events or set DLROVER_TPU_EVENTS_FILE)",
+                  file=sys.stderr)
+            return 2
+        records = events_mod.read_events(path)
+        # newest ATTRIBUTION_CAPTURED per worker (node, pid)
+        captured = {}
+        for rec in records:
+            if rec.get("kind") == EventKind.ATTRIBUTION_CAPTURED:
+                captured[(rec.get("node"), rec.get("pid"))] = {
+                    k: v for k, v in rec.items()
+                    if k not in ("kind", "mono", "seq")
+                }
+        rejections = [
+            {k: v for k, v in rec.items() if k not in ("mono", "seq")}
+            for rec in records
+            if rec.get("kind") == EventKind.OPTIMIZER_PLAN_REJECTED
+            and str(rec.get("reason", "")).startswith("memory")
+        ]
+        if args.limit:
+            rejections = rejections[-args.limit:]
+        report = {
+            "source": path,
+            "events": len(records),
+            "records": list(captured.values()),
+            "memory_rejected": rejections,
+        }
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    for node_id, sample in sorted((report.get("nodes") or {}).items()):
+        if not sample:
+            continue
+        mfu = sample.get("mfu")
+        frac = sample.get("exposed_comm_frac")
+        print(
+            f"node {node_id}: step={sample.get('step')} "
+            f"mfu={round(mfu, 4) if mfu is not None else '-'} "
+            f"exposed_comm="
+            f"{round(frac, 4) if frac is not None else '-'} "
+            f"flops/step={sample.get('flops_per_step') or '-'} "
+            f"peak_hbm={sample.get('peak_hbm_mb') or '-'}MB "
+            f"headroom={sample.get('hbm_headroom_mb') or '-'}MB"
+        )
+    for rec in report.get("records") or []:
+        print(f"record node={rec.get('node')} pid={rec.get('pid')}: "
+              f"flops/step={rec.get('flops_per_step')} "
+              f"intensity={rec.get('arithmetic_intensity')} "
+              f"peak_hbm={rec.get('peak_hbm_mb')}MB "
+              f"comm_s={rec.get('predicted_comm_total_s')} "
+              f"source={rec.get('source')}")
+    for rej in report.get("memory_rejected") or []:
+        print(f"MEMORY-REJECTED mesh={rej.get('mesh')} "
+              f"needs {rej.get('predicted_hbm_mb', rej.get('predicted_hbm_bytes'))}"
+              f" > budget {rej.get('budget_mb', rej.get('budget_bytes'))}"
+              f" [{rej.get('trigger', rej.get('reason', ''))}]")
+    if not (report.get("nodes") or report.get("records")
+            or report.get("memory_rejected")):
+        print("attribution: no records (telemetry off, or no "
+              "attribution capture has run)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.cmd == "plan":
         return _cmd_plan(args)
+
+    if args.cmd == "attribution":
+        return _cmd_attribution(args)
 
     if args.cmd == "mttr":
         from dlrover_tpu.telemetry import events as events_mod

@@ -109,6 +109,67 @@ def _train_spans(ordered: List[Dict], t_end: float) -> List[
     return spans
 
 
+def _model_flops_column(ordered: List[Dict],
+                        productive_s: float) -> Optional[Dict]:
+    """The model-FLOPs goodput column (the 100k-GPU HSDP position:
+    production health is model FLOPs delivered, not steps survived).
+
+    Integrated PER ATTRIBUTION RECORD: an elastic job re-captures after
+    every program/world change, so each record's whole-mesh FLOPs/step
+    is charged only for the steps executed while THAT record was
+    current (step progress read from the max-step envelope of the
+    surrounding events — rollback rewinds never subtract). Steps before
+    the first record are charged at the first record's rate. None when
+    no record was ever captured."""
+    captures: List[Tuple[float, float]] = []  # (ts, whole-mesh f/step)
+    for rec in ordered:
+        if rec.get("kind") != EventKind.ATTRIBUTION_CAPTURED:
+            continue
+        try:
+            per_step = float(rec.get("flops_per_step", 0.0)) * max(
+                1, int(rec.get("n_devices", 1)))
+        except (TypeError, ValueError):
+            continue
+        captures.append((rec.get("ts", 0.0), per_step))
+    if not captures:
+        return None
+
+    def max_step_before(t: float) -> int:
+        best = 0
+        for r in ordered:
+            if r.get("ts", 0.0) >= t:
+                break
+            s = r.get("step")
+            if s is not None:
+                try:
+                    best = max(best, int(s))
+                except (TypeError, ValueError):
+                    pass
+        return best
+
+    end_ts = float("inf")
+    total = 0.0
+    steps_total = 0
+    # the first record also covers the steps before its capture ts
+    # (the record describes the program those steps ran)
+    marks = [0.0] + [ts for ts, _ in captures[1:]] + [end_ts]
+    for (ts, per_step), lo, hi in zip(captures, marks, marks[1:]):
+        start = max_step_before(lo) if lo else 0
+        end = max(max_step_before(hi), start)
+        total += per_step * (end - start)
+        steps_total += end - start
+    return {
+        # the newest record's rate, for reference
+        "flops_per_step": captures[-1][1],
+        "steps": steps_total,
+        "total": total,
+        "records": len(captures),
+        "per_productive_second": (
+            round(total / productive_s, 3) if productive_s > 0 else 0.0
+        ),
+    }
+
+
 def derive_goodput(events: List[Dict]) -> Dict:
     """The ledger: bucket seconds + fractions over the timeline's wall
     clock (empty report when fewer than two timestamped events)."""
@@ -191,18 +252,24 @@ def derive_goodput(events: List[Dict]) -> Dict:
     }
     covered = sum(s for s in seconds.values())
     productive = seconds["productive_step"]
+    detail = {
+        "wall_s": round(wall, 3),
+        "buckets": buckets,
+        # buckets partition the wall by construction; quoted so the
+        # acceptance gate (≥0.99) is checkable from the artifact
+        "coverage": round(covered / wall, 4),
+        "badput_s": round(wall - productive - seconds[IDLE], 3),
+        "events": len(ordered),
+        "source": "event_timeline",
+    }
+    # model-FLOPs column: only when an attribution record exists —
+    # a ledger must never invent a zero-FLOPs job
+    model_flops = _model_flops_column(ordered, productive)
+    if model_flops is not None:
+        detail["model_flops"] = model_flops
     return {
         "metric": "goodput_fraction",
         "value": round(productive / wall, 4),
         "unit": "fraction",
-        "detail": {
-            "wall_s": round(wall, 3),
-            "buckets": buckets,
-            # buckets partition the wall by construction; quoted so the
-            # acceptance gate (≥0.99) is checkable from the artifact
-            "coverage": round(covered / wall, 4),
-            "badput_s": round(wall - productive - seconds[IDLE], 3),
-            "events": len(ordered),
-            "source": "event_timeline",
-        },
+        "detail": detail,
     }

@@ -254,6 +254,15 @@ class MetricsRegistry:
     def get(self, name: str, labels: Optional[Dict[str, str]] = None):
         return self._metrics.get(name + _label_suffix(labels))
 
+    def remove(self, name: str,
+               labels: Optional[Dict[str, str]] = None) -> None:
+        """Retract one series from the exposition (the per-node
+        attribution gauges use this when a stat becomes ABSENT — a
+        stale last value must not keep exporting as if it were live).
+        The family's kind registration survives for later re-creation."""
+        with self._lock:
+            self._metrics.pop(name + _label_suffix(labels), None)
+
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             return dict(self._metrics)
@@ -326,6 +335,10 @@ class NullRegistry:
 
     def get(self, name: str, labels: Optional[Dict[str, str]] = None):
         return None
+
+    def remove(self, name: str,
+               labels: Optional[Dict[str, str]] = None) -> None:
+        pass
 
     def snapshot(self) -> Dict[str, object]:
         return {}

@@ -128,6 +128,42 @@ OPTIMIZER_CALIBRATIONS = "dlrover_optimizer_calibrations_total"
 OPTIMIZER_PLANS_APPLIED = "dlrover_optimizer_plans_applied_total"
 # wall seconds of one live plan application on the worker
 OPTIMIZER_APPLY_TIME = "dlrover_optimizer_apply_seconds"
+# candidate plans the memory-feasibility gate rejected BEFORE pricing
+# (compiled/predicted peak HBM above the device budget)
+OPTIMIZER_PLANS_MEMORY_REJECTED = (
+    "dlrover_optimizer_plans_memory_rejected_total"
+)
+
+# -- performance attribution (device-time & HBM accounting) -------------------
+# Derived from the per-compiled-program attribution record
+# (telemetry.attribution: exact FLOPs / bytes-accessed / peak HBM read
+# at compile time) fused with measured step times at materialization.
+# Gauges are created ONLY once a record was captured — absent means
+# "not measured", never 0.
+
+# live model-FLOPs utilization: compiled per-device FLOPs/step over
+# (measured step seconds x device peak) — utils/prof.derived_mfu
+ATTR_MFU = "dlrover_attribution_mfu"
+# compiled FLOPs / bytes-accessed: low values = HBM-bound on TPU
+ATTR_ARITH_INTENSITY = "dlrover_attribution_arithmetic_intensity"
+# clamped (1 - ideal compute seconds / measured step seconds): an
+# UPPER bound on the un-overlapped communication share of the step
+ATTR_EXPOSED_COMM_FRAC = "dlrover_attribution_exposed_comm_fraction"
+# the static record, exported for scrape-side math
+ATTR_FLOPS_PER_STEP = "dlrover_attribution_flops_per_step"
+ATTR_PEAK_HBM_MB = "dlrover_attribution_compiled_peak_hbm_mb"
+ATTR_COMM_PREDICTED_S = "dlrover_attribution_predicted_comm_seconds"
+# device HBM headroom: bytes_limit - bytes_in_use where the backend
+# exposes memory stats (absent on CPU — never a fake 0)
+ATTR_HBM_HEADROOM_MB = "dlrover_attribution_hbm_headroom_mb"
+
+# master-side per-node mirrors (labeled {node="<id>"}), fed by the
+# NodeRuntimeReport push — the cluster view of the same quantities
+NODE_MFU = "dlrover_node_mfu"
+NODE_EXPOSED_COMM_FRAC = "dlrover_node_exposed_comm_fraction"
+NODE_FLOPS_PER_STEP = "dlrover_node_flops_per_step"
+NODE_PEAK_HBM_MB = "dlrover_node_compiled_peak_hbm_mb"
+NODE_HBM_HEADROOM_MB = "dlrover_node_hbm_headroom_mb"
 
 
 class EventKind:
@@ -196,6 +232,11 @@ class EventKind:
     OPTIMIZER_APPLY_BEGIN = "optimizer_apply_begin"
     OPTIMIZER_APPLY_DONE = "optimizer_apply_done"
     OPTIMIZER_APPLIED = "optimizer_applied"
+    # performance attribution: one record per compiled program (exact
+    # FLOPs, bytes-accessed, per-collective bytes, compiled peak HBM)
+    # captured through the AOT path and keyed by the program cache —
+    # the forensic source of `tpurun attribution --events`
+    ATTRIBUTION_CAPTURED = "attribution_captured"
 
 
 class SpanName:

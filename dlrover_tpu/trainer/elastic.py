@@ -72,11 +72,17 @@ class ElasticTrainer:
         report_every_steps: int = 10,
         devices=None,
         steps_per_call: Optional[int] = None,
+        model_spec=None,
     ):
         self._init_fn = init_fn
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._example_batch = example_batch
+        # optional planner ModelSpec: when known, the attribution
+        # record's per-collective comm seconds come from the planner's
+        # predicted_collective_bytes formula instead of the compiled
+        # HLO's own byte parse (telemetry.attribution)
+        self._model_spec = model_spec
         self._base_strategy = strategy or Strategy()
         self._master_client = master_client
         self._report_every = max(report_every_steps, 1)
@@ -114,6 +120,12 @@ class ElasticTrainer:
             collections.OrderedDict()
         )
         self._program_cache_cap = 4
+        # per-compiled-program attribution records, keyed by the SAME
+        # program-cache key (captured lazily on first request, evicted
+        # with the program). A failed capture caches False so a broken
+        # backend is probed once per program, not once per step.
+        self._attr_records: Dict[str, Any] = {}
+        self._current_program_key: Optional[str] = None
         # accelerate() invocations that actually compiled (cache misses)
         self.compile_count = 0
         # Device count the base strategy was written for; grad-accum scales
@@ -196,6 +208,7 @@ class ElasticTrainer:
             self._initial_devices = num_devices
         strategy = self._resolved_strategy(num_devices)
         key = self._program_key(actual, strategy)
+        self._current_program_key = key
         reg = get_registry()
         cached = self._programs.get(key)
         if cached is not None:
@@ -226,8 +239,40 @@ class ElasticTrainer:
         self._programs[key] = result
         while len(self._programs) > self._program_cache_cap:
             evicted, _ = self._programs.popitem(last=False)
+            self._attr_records.pop(evicted, None)
             logger.info("program cache evicted topology %.40s...", evicted)
         return result
+
+    def attribution(self):
+        """The performance-attribution record for the CURRENT compiled
+        program (``telemetry.attribution.AttributionRecord``), captured
+        lazily through the AOT path and cached by the program-cache key
+        — a retune back to a seen knob set reuses the record like it
+        reuses the program. None when attribution/telemetry is off, no
+        program is built yet, or the capture failed (probed once)."""
+        from dlrover_tpu.telemetry import attribution as attr_mod
+
+        if self._result is None or not attr_mod.attribution_enabled():
+            return None
+        key = self._current_program_key or ""
+        cached = self._attr_records.get(key)
+        if cached is not None:
+            return cached or None  # False = a probed, failed capture
+        try:
+            record = attr_mod.capture_attribution(
+                self._result,
+                steps_per_call=self.steps_per_call,
+                example_batch=self._example_batch,
+                model_spec=self._model_spec,
+                mesh_plan=getattr(self._result.strategy, "mesh", None),
+            )
+        except Exception:  # noqa: BLE001 — attribution is observation-
+            # only: a backend without AOT analysis must not kill the job
+            logger.warning("attribution capture failed for this "
+                           "program", exc_info=True)
+            record = None
+        self._attr_records[key] = record if record is not None else False
+        return record
 
     def prepare(self, state: Any = None) -> Any:
         """Compile for the current world; restore or init state."""
@@ -377,6 +422,7 @@ class ElasticTrainer:
         large to double-book (the swap then pays the compile, but
         still skips the strategy/mesh rebuild)."""
         prev_k, prev_mesh = self.steps_per_call, self._mesh_override
+        prev_key = self._current_program_key
         if steps_per_call is not None:
             self.steps_per_call = max(1, int(steps_per_call))
         if mesh is not None:
@@ -391,6 +437,9 @@ class ElasticTrainer:
         finally:
             self.steps_per_call = prev_k
             self._mesh_override = prev_mesh
+            # the ACTIVE program is unchanged: its attribution identity
+            # must not be re-pointed at the standby key
+            self._current_program_key = prev_key
         return compiled
 
     def _execute_dummy_step(self, result: AccelerateResult) -> None:

@@ -175,6 +175,7 @@ class NodeRuntimeReportHook(TrainHook):
         # executor observes into); a test may pass a private registry
         # to simulate several nodes in one process
         reg = registry if registry is not None else get_registry()
+        self._reg = reg
         self._h_step = reg.histogram(tm.STEP_TIME)
         self._h_dispatch = reg.histogram(tm.STEP_DISPATCH_TIME)
         self._h_sync = reg.histogram(tm.STEP_HOST_SYNC_TIME)
@@ -203,23 +204,45 @@ class NodeRuntimeReportHook(TrainHook):
             return resource.getrusage(
                 resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
-    def _device_mem_mb(self) -> float:
+    def _device_memory_mb(self):
+        """(bytes_in_use MB, headroom MB) summed over local devices —
+        each ``None`` when NO backend device exposes the stat: a CPU
+        mesh must report the gauge ABSENT, not a fake 0 an operator
+        would read as an empty accelerator."""
         try:
             import jax
 
             if self._devices is None:
                 self._devices = jax.local_devices()
-            total = 0
+            in_use = limit = None
             for d in self._devices:
                 stats_fn = getattr(d, "memory_stats", None)
                 stats = stats_fn() if stats_fn is not None else None
-                if stats:
-                    total += int(stats.get("bytes_in_use", 0))
-            return total / (1024 * 1024)
+                if not stats:
+                    continue
+                if "bytes_in_use" in stats:
+                    in_use = (in_use or 0) + int(stats["bytes_in_use"])
+                if stats.get("bytes_limit"):
+                    limit = (limit or 0) + int(stats["bytes_limit"])
+            mb = 1024 * 1024
+            headroom = (
+                (limit - (in_use or 0)) / mb
+                if limit is not None else None
+            )
+            return (in_use / mb if in_use is not None else None,
+                    headroom)
         except Exception:  # noqa: BLE001 — CPU backends return nothing
             logger.debug("device memory_stats unavailable",
                          exc_info=True)
-            return 0.0
+            return None, None
+
+    def _gauge_value(self, name: str):
+        """A gauge's value if it EXISTS in this hook's registry, else
+        None — attribution gauges are created only once a record was
+        captured, so absence genuinely means 'not measured'."""
+        getter = getattr(self._reg, "get", None)
+        metric = getter(name) if getter is not None else None
+        return float(metric.value) if metric is not None else None
 
     def after_step(self, step: int, metrics: Dict[str, Any]):
         if self._every <= 0 or step % self._every:
@@ -243,6 +266,14 @@ class NodeRuntimeReportHook(TrainHook):
                 list(self._h_sync.snapshot_counts() or []) or None),
             window_occupancy=float(self._g_window.value),
             lagged_age=float(self._g_lag.value),
+            # performance-attribution gauges (None until the executor
+            # captured a record — the master exports them per node only
+            # when they exist)
+            mfu=self._gauge_value(tm.ATTR_MFU),
+            exposed_comm_frac=self._gauge_value(
+                tm.ATTR_EXPOSED_COMM_FRAC),
+            flops_per_step=self._gauge_value(tm.ATTR_FLOPS_PER_STEP),
+            peak_hbm_mb=self._gauge_value(tm.ATTR_PEAK_HBM_MB),
         )
         if self._sender is None or not self._sender.is_alive():
             self._sender = threading.Thread(
@@ -264,8 +295,20 @@ class NodeRuntimeReportHook(TrainHook):
                 return
             try:
                 payload["rss_mb"] = round(self._rss_mb(), 1)
-                payload["device_mem_mb"] = round(
-                    self._device_mem_mb(), 1)
+                in_use_mb, headroom_mb = self._device_memory_mb()
+                payload["device_mem_mb"] = (
+                    round(in_use_mb, 1) if in_use_mb is not None
+                    else None)
+                payload["hbm_headroom_mb"] = (
+                    round(headroom_mb, 1) if headroom_mb is not None
+                    else None)
+                if headroom_mb is not None:
+                    # worker-local mirror (created only when the stat
+                    # exists — absent on CPU, never 0)
+                    self._reg.gauge(
+                        tm.ATTR_HBM_HEADROOM_MB,
+                        help="device HBM bytes_limit - bytes_in_use",
+                    ).set(headroom_mb)
                 self._client.report_node_runtime(**payload)
                 self._c_sent.inc()
             except Exception:  # noqa: BLE001 — a dead master must not
@@ -560,6 +603,23 @@ class TrainExecutor:
         self._plan_measure_steps = max(1, int(conf.get(
             "plan_measure_steps",
             getattr(ctx, "plan_measure_steps", 16))))
+        # performance attribution: the per-compiled-program record
+        # (telemetry.attribution) fetched lazily at the first
+        # materialization — its derived MFU / exposed-comm gauges are
+        # created only once a record exists, so absence means
+        # "not measured". A program change (retune/reshard) re-arms
+        # the fetch.
+        self._attr_enabled = bool(conf.get(
+            "attribution_enabled",
+            getattr(ctx, "attribution_enabled", True)))
+        self._attr_record: Optional[Any] = None
+        self._attr_pending = self._attr_enabled
+        self._g_attr_mfu: Optional[Any] = None
+        self._g_attr_exposed: Optional[Any] = None
+        # precomputed per-step scalars (set at fetch): the hot-loop
+        # derivation is two divisions and two gauge stores, nothing else
+        self._attr_compute_s = 0.0
+        self._attr_mfu_scale = 0.0
         # time-to-first-materialized-step after TRAIN_START: the
         # trace+compile(+restore) cost, the goodput compile bucket
         self._train_started_mono: Optional[float] = None
@@ -785,6 +845,9 @@ class TrainExecutor:
             self.state = self._trainer.live_reshard(
                 self.state, devices=devices, reason="executor"
             )
+            # a reshard may have swapped the compiled program: the old
+            # attribution record no longer describes it
+            self._refresh_attribution()
             # the resumed step may be behind the max() the master saw
             # (the snapshot covers the last DRAINED step): reset the
             # speed monitor so its gauge/series track the truth
@@ -803,6 +866,7 @@ class TrainExecutor:
         self._restart_requested = False
         logger.info("rebuilding training session (membership change)")
         self.state = self._trainer.on_world_change(self.state)
+        self._refresh_attribution()
 
     # -- optimizer plan application ------------------------------------------
 
@@ -891,6 +955,7 @@ class TrainExecutor:
                 recompiled = (
                     self._trainer.compile_count - compiles_before
                 )
+                self._refresh_attribution()
                 self._report_step_reset()
             if w is not None:
                 self._train_window = max(0, int(w))
@@ -1021,6 +1086,97 @@ class TrainExecutor:
         except Exception:  # noqa: BLE001 — a dead master must not block
             # training; the optimizer just runs on a staler config view
             logger.debug("trainer config report failed", exc_info=True)
+
+    # -- performance attribution ---------------------------------------------
+
+    def _refresh_attribution(self):
+        """The compiled program changed (retune / live reshard /
+        restart rebuild): drop the record and re-arm the lazy fetch."""
+        self._attr_record = None
+        self._attr_pending = self._attr_enabled
+
+    def _fetch_attribution(self):
+        """Fetch the trainer's per-program attribution record (once
+        per program — the trainer caches it by the program-cache key)
+        and export the static gauges. Gauges are CREATED here, not in
+        __init__, so a job that never captured a record never exports
+        a misleading 0."""
+        attribution = getattr(self._trainer, "attribution", None)
+        if attribution is None:
+            return
+        try:
+            record = attribution()
+        except Exception:  # noqa: BLE001 — observation-only: a capture
+            # failure must never take the step loop down
+            logger.warning("attribution fetch failed", exc_info=True)
+            record = None
+        if record is None:
+            return
+        self._attr_record = record
+        # mfu = flops / (step_s * peak) = (flops / peak) / step_s — the
+        # same derived_mfu formula, folded to one multiply per step
+        self._attr_mfu_scale = (
+            record.flops_per_step / record.peak_flops_per_s
+            if record.peak_flops_per_s > 0 else 0.0
+        )
+        self._attr_compute_s = record.predicted_compute_s
+        # NB: the DERIVED gauges (mfu, exposed-comm) are created in
+        # _observe_attribution at the first MEASURED step — creating
+        # them here would export a fake 0.0 for the whole first
+        # trace+compile window (minutes at scale), exactly the
+        # absent-never-0 invariant the node series depends on
+        reg = get_registry()
+        reg.gauge(
+            tm.ATTR_FLOPS_PER_STEP,
+            help="compiled per-device FLOPs per optimizer step",
+        ).set(record.flops_per_step)
+        reg.gauge(
+            tm.ATTR_ARITH_INTENSITY,
+            help="compiled FLOPs / bytes-accessed (HBM-bound when low)",
+        ).set(record.arithmetic_intensity)
+        reg.gauge(
+            tm.ATTR_PEAK_HBM_MB,
+            help="compiled per-device peak HBM residency (MB)",
+        ).set(record.peak_hbm_bytes / (1024 * 1024))
+        reg.gauge(
+            tm.ATTR_COMM_PREDICTED_S,
+            help="predicted per-step collective seconds (all families)",
+        ).set(record.predicted_comm_total_s)
+        # the capture's AOT compile is a one-off stall: it must not
+        # bleed into the NEXT step's measured wall time (same guard as
+        # the optimizer-plan apply)
+        self._last_materialize = time.monotonic()
+
+    def _observe_attribution(self, per_step: float):
+        """Fuse one measured per-step time with the record into the
+        derived gauges — two divisions and two gauge stores, the only
+        per-step cost the attribution plane carries (the ≤5% paired
+        overhead gate in tests/test_attribution.py pins it)."""
+        if self._attr_pending:
+            self._attr_pending = False
+            self._fetch_attribution()
+        if self._attr_record is None or per_step <= 0:
+            return
+        if self._g_attr_mfu is None:
+            reg = get_registry()
+            self._g_attr_mfu = reg.gauge(
+                tm.ATTR_MFU,
+                help="live model-FLOPs utilization (compiled FLOPs/"
+                     "step over measured step time x device peak)")
+            self._g_attr_exposed = reg.gauge(
+                tm.ATTR_EXPOSED_COMM_FRAC,
+                help="upper bound on the un-overlapped comm share of "
+                     "the step (1 - ideal compute s / measured step s)")
+        # .set(), not raw attribute stores: if telemetry was toggled
+        # off between fetch and here, the lazy creation above handed
+        # back the SHARED null-metric singleton — set() is a no-op on
+        # it, a direct .value write would poison every null consumer
+        inv = 1.0 / per_step
+        self._g_attr_mfu.set(self._attr_mfu_scale * inv)
+        frac = 1.0 - self._attr_compute_s * inv
+        self._g_attr_exposed.set(
+            0.0 if frac < 0.0 else (1.0 if frac > 1.0 else frac)
+        )
 
     def _report_step_reset(self):
         """Tell the master the true global step REWOUND (rollback / live
@@ -1194,6 +1350,7 @@ class TrainExecutor:
         per_step = (now - self._last_materialize) / max(entry.count, 1)
         self._last_materialize = now
         self._g_lag.set(self._dispatched_step - entry.last_step)
+        self._observe_attribution(per_step)
         touch_heartbeat()
         stacked = entry.count > 1
         for i in range(entry.count):
@@ -1281,6 +1438,9 @@ class TrainExecutor:
             self.install_preemption_handler()
         self._install_profile_signal_handler()
         self.state = self._trainer.prepare(self.state)
+        # re-arm per run: prepare() may have (re)built the program, and
+        # a second run must re-read the trainer's cached record
+        self._refresh_attribution()
         for hook in self._hooks:
             hook.begin(self)
         if self._failover is not None:
@@ -1302,6 +1462,15 @@ class TrainExecutor:
                    steps_per_call=max(1, int(getattr(
                        self._trainer, "steps_per_call", 1))))
         self._report_trainer_config()
+        # capture the attribution record NOW, before the first dispatch:
+        # its AOT compile is compile-side cost (the persistent cache
+        # then serves the first step's compile warm) and it lands inside
+        # the COMPILE_FIRST_STEP window — never in a steady-state timed
+        # region (deep windows materialize their first step long after
+        # warmup, where a 0.2s capture would poison throughput gates)
+        if self._attr_pending:
+            self._attr_pending = False
+            self._fetch_attribution()
         try:
             while True:
                 # re-read per iterator epoch: a live retune (optimizer

@@ -33,6 +33,57 @@ def param_bytes(tree: Any) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
 
 
+def cost_analysis_dict(compiled) -> Dict[str, float]:
+    """``compiled.cost_analysis()`` as ONE dict, shimming the legacy-jax
+    shape (old jax returns a list with one dict per program) — the one
+    place the list-vs-dict compatibility lives; every reader
+    (``analyze_cost``, ``parallel.aot``, ``parallel.auto_tune``, the
+    attribution capture) routes through here instead of re-spelling the
+    shim. Returns ``{}`` when the backend exposes nothing."""
+    try:
+        cost = compiled.cost_analysis() or {}
+    except Exception:  # noqa: BLE001 - backend-dependent API
+        logger.debug("cost_analysis unavailable", exc_info=True)
+        return {}
+    if isinstance(cost, (list, tuple)):  # old jax: one dict per program
+        cost = cost[0] if cost else {}
+    return dict(cost)
+
+
+def compiled_peak_bytes(compiled) -> int:
+    """Per-device HBM residency of a compiled program from
+    ``memory_analysis()``: arguments (the sharded state + batch) plus
+    transient temps plus outputs, minus donated (aliased) bytes so
+    donation isn't double-counted — the same accounting the AOT
+    fit-proof applies. 0 when the backend has no memory analysis."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - backend-dependent API
+        logger.debug("memory_analysis unavailable", exc_info=True)
+        return 0
+    if mem is None:
+        return 0
+    return int(
+        getattr(mem, "argument_size_in_bytes", 0)
+        + getattr(mem, "temp_size_in_bytes", 0)
+        + getattr(mem, "output_size_in_bytes", 0)
+        - getattr(mem, "alias_size_in_bytes", 0)
+    )
+
+
+def derived_mfu(flops_per_step: float, step_time_s: float,
+                peak_flops_per_s: float) -> float:
+    """THE model-FLOPs-utilization formula: (FLOPs per step / step
+    seconds) over hardware peak. ``ProfileResult.mfu``, the runtime
+    attribution gauges (``telemetry.attribution``) and the bench all
+    price MFU through this one function, so the one-shot profile and
+    the live gauge can never drift apart. FLOPs and peak must share a
+    basis (both per device, or both whole-mesh)."""
+    if peak_flops_per_s <= 0 or step_time_s <= 0:
+        return 0.0
+    return flops_per_step / (step_time_s * peak_flops_per_s)
+
+
 @dataclass
 class CostReport:
     flops: float = 0.0
@@ -47,23 +98,12 @@ class CostReport:
 def analyze_cost(fn: Callable, *args, **kwargs) -> CostReport:
     """Compile ``fn`` for the given args and read XLA's cost model."""
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-    cost = compiled.cost_analysis() or {}
-    if isinstance(cost, (list, tuple)):  # old jax: one dict per program
-        cost = cost[0] if cost else {}
+    cost = cost_analysis_dict(compiled)
     report = CostReport(
         flops=float(cost.get("flops", 0.0)),
         bytes_accessed=float(cost.get("bytes accessed", 0.0)),
     )
-    try:
-        mem = compiled.memory_analysis()
-        if mem is not None:
-            report.peak_memory_bytes = int(
-                getattr(mem, "temp_size_in_bytes", 0)
-                + getattr(mem, "argument_size_in_bytes", 0)
-                + getattr(mem, "output_size_in_bytes", 0)
-            )
-    except Exception:  # noqa: BLE001 - backend-dependent API
-        pass
+    report.peak_memory_bytes = compiled_peak_bytes(compiled)
     return report
 
 
@@ -77,10 +117,12 @@ class ProfileResult:
     peak_memory_bytes: int
 
     def mfu(self, peak_flops_per_sec: float) -> float:
-        """Model FLOPs utilization against a hardware peak."""
-        if peak_flops_per_sec <= 0:
-            return 0.0
-        return self.achieved_flops_per_sec / peak_flops_per_sec
+        """Model FLOPs utilization against a hardware peak (the shared
+        ``derived_mfu`` formula — same one the live attribution gauges
+        use)."""
+        return derived_mfu(self.flops_per_step,
+                           1.0 / max(self.steps_per_sec, 1e-12),
+                           peak_flops_per_sec)
 
 
 class DryRunner:

@@ -1,0 +1,785 @@
+"""Performance-attribution plane (ISSUE 8): per-compiled-program
+device-time & HBM accounting.
+
+Covers: the shared legacy-jax cost/memory shims and the ONE MFU formula
+(utils/prof), the attribution capture + program-cache keyed reuse
+(telemetry.attribution / ElasticTrainer.attribution), the derived
+MFU / exposed-comm gauges through the real executor (CPU-mesh e2e
+smoke, pinned against the fixture-free utils/prof path), the
+jax.profiler trace parser against a committed fixture, the runtime
+optimizer's memory-feasibility gate (PLAN_REJECTED memory evidence),
+G107, the device-memory absent-not-zero guard, the goodput model-FLOPs
+column, the `tpurun attribution` CLI, and the ≤5% attribution-overhead
+paired gate.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.telemetry import names as tm
+from dlrover_tpu.telemetry import attribution as attr_mod
+from dlrover_tpu.telemetry.events import clear_ring, recent_events
+from dlrover_tpu.telemetry.metrics import process_registry
+from dlrover_tpu.trainer.conf import Configuration
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.executor import (
+    NodeRuntimeReportHook,
+    TrainExecutor,
+    TrainHook,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "testdata",
+                       "attribution_trace.json")
+
+PEAK = 1e9  # deterministic MFU denominator for the CPU mesh
+
+
+@pytest.fixture(autouse=True)
+def _attribution_context():
+    """Pin the attribution knobs per test and restore after."""
+    ctx = get_context()
+    saved = (ctx.telemetry_enabled, ctx.attribution_enabled,
+             ctx.device_peak_flops, ctx.device_hbm_budget_bytes)
+    ctx.telemetry_enabled = True
+    ctx.attribution_enabled = True
+    ctx.device_peak_flops = PEAK
+    ctx.device_hbm_budget_bytes = 0.0
+    yield ctx
+    (ctx.telemetry_enabled, ctx.attribution_enabled,
+     ctx.device_peak_flops, ctx.device_hbm_budget_bytes) = saved
+
+
+def _make_trainer(**kwargs):
+    def init_fn(rng):
+        return {"w": jax.random.normal(rng, (16, 8))}
+
+    def loss_fn(params, batch, rng):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2), {}
+
+    rngs = jax.random.split(jax.random.PRNGKey(0), 2)
+    x = jax.random.normal(rngs[0], (32, 16))
+    batch = {"x": x, "y": x @ jax.random.normal(rngs[1], (16, 8))}
+    trainer = ElasticTrainer(
+        init_fn, loss_fn, optax.sgd(0.05), batch,
+        strategy=Strategy(mesh=MeshPlan(data=-1)), **kwargs,
+    )
+    return trainer, batch
+
+
+# -- shared shims (satellite: one cost_analysis compatibility helper) --------
+
+
+class _FakeMem:
+    argument_size_in_bytes = 100
+    temp_size_in_bytes = 50
+    output_size_in_bytes = 30
+    alias_size_in_bytes = 20
+
+
+class _FakeCompiled:
+    def __init__(self, cost, mem=_FakeMem()):
+        self._cost = cost
+        self._mem = mem
+
+    def cost_analysis(self):
+        return self._cost
+
+    def memory_analysis(self):
+        return self._mem
+
+
+class TestSharedShims:
+    def test_cost_analysis_dict_handles_dict_and_legacy_list(self):
+        from dlrover_tpu.utils.prof import cost_analysis_dict
+
+        d = {"flops": 7.0, "bytes accessed": 3.0}
+        assert cost_analysis_dict(_FakeCompiled(d)) == d
+        assert cost_analysis_dict(_FakeCompiled([d])) == d  # old jax
+        assert cost_analysis_dict(_FakeCompiled([])) == {}
+        assert cost_analysis_dict(_FakeCompiled(None)) == {}
+
+    def test_cost_analysis_dict_swallows_backend_errors(self):
+        from dlrover_tpu.utils.prof import cost_analysis_dict
+
+        class Broken:
+            def cost_analysis(self):
+                raise NotImplementedError("no backend support")
+
+        assert cost_analysis_dict(Broken()) == {}
+
+    def test_compiled_peak_bytes_accounting(self):
+        from dlrover_tpu.utils.prof import compiled_peak_bytes
+
+        # args + temps + outputs - donated aliases
+        assert compiled_peak_bytes(_FakeCompiled({})) == 160
+
+        class NoMem:
+            def memory_analysis(self):
+                return None
+
+        assert compiled_peak_bytes(NoMem()) == 0
+
+    def test_derived_mfu_is_the_one_formula(self):
+        from dlrover_tpu.utils.prof import ProfileResult, derived_mfu
+
+        assert derived_mfu(100.0, 0.001, 1e6) == pytest.approx(0.1)
+        assert derived_mfu(100.0, 0.0, 1e6) == 0.0
+        assert derived_mfu(100.0, 0.001, 0.0) == 0.0
+        pr = ProfileResult(
+            steps_per_sec=1000.0, step_time_ms=1.0,
+            flops_per_step=100.0, achieved_flops_per_sec=100_000.0,
+            param_count=1, peak_memory_bytes=0,
+        )
+        assert pr.mfu(1e6) == pytest.approx(
+            derived_mfu(100.0, 0.001, 1e6))
+
+
+# -- trace parser (satellite: committed fixture, known totals) ---------------
+
+
+class TestTraceParser:
+    def test_fixture_category_totals(self):
+        buckets = attr_mod.parse_trace_path(FIXTURE)
+        assert buckets["events"] == 6
+        assert buckets["compute_s"] == pytest.approx(0.030)
+        assert buckets["collective_s"] == pytest.approx(0.015)
+        assert buckets["infeed_s"] == pytest.approx(0.002)
+        assert buckets["other_s"] == pytest.approx(0.003)
+        # busy = the busiest single lane (tid 1: 45 ms; tid 2: 5 ms)
+        assert buckets["busy_s"] == pytest.approx(0.045)
+        assert buckets["wall_s"] == pytest.approx(0.058)
+        assert buckets["idle_s"] == pytest.approx(0.013)
+        # comm share of CATEGORIZED device-op time: 15 / (15+30+2)
+        assert buckets["measured_comm_frac"] == pytest.approx(
+            15 / 47, abs=1e-4)
+
+    def test_host_lanes_cannot_dilute_comm_frac(self):
+        # a fully-overlapping host TraceMe lane must not double-count
+        # busy time or shrink the measured communication share
+        records = [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 1_000_000,
+             "name": "all-reduce.1"},
+            {"ph": "X", "pid": 1, "tid": 99, "ts": 0, "dur": 1_000_000,
+             "name": "TraceMe host step"},
+        ]
+        buckets = attr_mod.parse_trace_events(records)
+        assert buckets["busy_s"] == pytest.approx(1.0)
+        assert buckets["idle_s"] == pytest.approx(0.0)
+        assert buckets["measured_comm_frac"] == pytest.approx(1.0)
+
+    def test_gzip_and_directory_discovery(self, tmp_path):
+        profile = tmp_path / "plugins" / "profile" / "run1"
+        profile.mkdir(parents=True)
+        gz = profile / "host.trace.json.gz"
+        with gzip.open(gz, "wt") as fh:
+            fh.write(open(FIXTURE).read())
+        assert attr_mod.find_trace_files(str(tmp_path)) == [str(gz)]
+        buckets = attr_mod.parse_trace_path(str(tmp_path))
+        assert buckets["collective_s"] == pytest.approx(0.015)
+        assert buckets["source_files"] == 1
+
+    def test_categorize_op_collective_wins_over_fusion(self):
+        # a fused collective is traffic, not compute
+        assert attr_mod.categorize_op("fusion.all-reduce.3") == \
+            "collective"
+        assert attr_mod.categorize_op("fusion.99") == "compute"
+        assert attr_mod.categorize_op("mystery") == "other"
+
+    def test_empty_trace(self):
+        buckets = attr_mod.parse_trace_events([])
+        assert buckets["busy_s"] == 0.0
+        assert buckets["measured_comm_frac"] == 0.0
+
+
+# -- capture ----------------------------------------------------------------
+
+
+class TestCapture:
+    def test_capture_reads_exact_cost_and_collectives(self):
+        trainer, _ = _make_trainer()
+        trainer.prepare()
+        record = trainer.attribution()
+        assert record is not None
+        assert record.flops_per_step > 0
+        assert record.bytes_accessed_per_step > 0
+        assert record.n_devices == len(jax.devices())
+        assert record.steps_per_call == 1
+        assert record.source == "hlo"
+        # a data-parallel mesh must show the gradient all-reduce
+        assert record.collective_bytes.get("all-reduce", 0) > 0
+        assert record.predicted_comm_total_s == pytest.approx(
+            sum(record.predicted_comm_s.values()))
+        assert record.peak_flops_per_s == PEAK
+        assert record.predicted_compute_s == pytest.approx(
+            record.flops_per_step / PEAK)
+
+    def test_record_cached_by_program_key(self):
+        trainer, _ = _make_trainer()
+        trainer.prepare()
+        first = trainer.attribution()
+        assert trainer.attribution() is first  # no re-capture
+
+    def test_disabled_returns_none(self, _attribution_context):
+        trainer, _ = _make_trainer()
+        trainer.prepare()
+        _attribution_context.attribution_enabled = False
+        assert trainer.attribution() is None
+
+    def test_multi_step_program_normalizes_per_step(self):
+        trainer1, _ = _make_trainer()
+        trainer1.prepare()
+        r1 = trainer1.attribution()
+        trainer4, _ = _make_trainer(steps_per_call=4)
+        trainer4.prepare()
+        r4 = trainer4.attribution()
+        assert r4.steps_per_call == 4
+        # XLA counts the K-scan body once, and the K-weighted HLO
+        # collective bytes are divided back by K: both quantities read
+        # PER STEP, so K=4 stays comparable to K=1
+        assert r4.flops_per_step == pytest.approx(
+            r1.flops_per_step, rel=0.25)
+        assert r4.collective_bytes.get("all-reduce", 0) == \
+            pytest.approx(r1.collective_bytes.get("all-reduce", 1),
+                          rel=0.25)
+
+    def test_planner_source_with_model_spec(self):
+        from dlrover_tpu.parallel.planner import ModelSpec
+
+        spec = ModelSpec(param_count=1000, num_layers=2,
+                         hidden_size=16, seq_len=8, global_batch=32)
+        trainer, batch = _make_trainer()
+        trainer.prepare()
+        record = attr_mod.capture_attribution(
+            trainer.accelerated, example_batch=batch,
+            model_spec=spec, emit=False)
+        assert record.source == "planner"
+        # planner families, not HLO kinds
+        assert set(record.predicted_comm_s) <= {
+            "tp", "fsdp", "dp", "seq", "pipe", "moe_dispatch"}
+
+    def test_derived_quantities_clamp(self):
+        trainer, _ = _make_trainer()
+        trainer.prepare()
+        record = trainer.attribution()
+        assert record.mfu(0.0) == 0.0
+        assert 0.0 <= record.exposed_comm_fraction(1e-12) <= 1.0
+        assert record.exposed_comm_fraction(1e9) == pytest.approx(
+            1.0, abs=1e-6)
+        assert record.arithmetic_intensity > 0
+
+
+# -- executor e2e smoke (satellite: gauges in /metrics, MFU agreement) -------
+
+
+class TestExecutorSmoke:
+    def _run(self, trainer, batch, steps=24, **conf):
+        executor = TrainExecutor(
+            trainer, train_iter_fn=lambda: [batch] * steps,
+            conf=Configuration({
+                "train_steps": steps, "log_every_steps": 0,
+                "train_window": 2, "preemption_grace": False,
+                **conf,
+            }),
+        )
+        executor.train_and_evaluate()
+        return executor
+
+    def test_gauges_exported_and_agree_with_prof(self):
+        process_registry().reset()
+        clear_ring()
+        trainer, batch = _make_trainer()
+        self._run(trainer, batch)
+        reg = process_registry()
+        mfu_g = reg.get(tm.ATTR_MFU)
+        assert mfu_g is not None and mfu_g.value > 0
+        assert reg.get(tm.ATTR_EXPOSED_COMM_FRAC) is not None
+        assert 0.0 <= reg.get(tm.ATTR_EXPOSED_COMM_FRAC).value <= 1.0
+        prom = reg.render_prometheus()
+        for name in (tm.ATTR_MFU, tm.ATTR_EXPOSED_COMM_FRAC,
+                     tm.ATTR_FLOPS_PER_STEP, tm.ATTR_ARITH_INTENSITY,
+                     tm.ATTR_PEAK_HBM_MB, tm.ATTR_COMM_PREDICTED_S):
+            assert name in prom
+        # the capture event landed with the record attached
+        captured = [e for e in recent_events()
+                    if e["kind"] == tm.EventKind.ATTRIBUTION_CAPTURED]
+        assert captured and captured[-1]["flops_per_step"] > 0
+
+        # MFU agreement with the fixture-free utils/prof path: the
+        # FLOPs side is EXACT (same compiled cost analysis through the
+        # same shim), and for one shared step time the record's MFU and
+        # the profiler's MFU are the SAME number — the one-formula pin
+        from dlrover_tpu.utils.prof import DryRunner, analyze_cost
+
+        result = trainer.accelerated
+        sharded = result.shard_batch(batch)
+        cost = analyze_cost(result.train_step, trainer.prepare(),
+                            sharded, jax.random.PRNGKey(0))
+        assert reg.get(tm.ATTR_FLOPS_PER_STEP).value == pytest.approx(
+            cost.flops)
+        profile = DryRunner(warmup=1, steps=3).profile(
+            result.train_step, trainer.prepare(), sharded)
+        record = trainer.attribution()
+        assert record.flops_per_step == pytest.approx(
+            profile.flops_per_step)
+        assert record.mfu(1.0 / profile.steps_per_sec) == \
+            pytest.approx(profile.mfu(PEAK))
+
+    def test_no_fake_zero_before_first_measured_step(self):
+        # between capture (train start) and the first materialized
+        # step, the STATIC gauges exist but the DERIVED ones must be
+        # absent — a scrape during a minutes-long first compile must
+        # not read mfu=0 as if the job were measured dead
+        process_registry().reset()
+        trainer, batch = _make_trainer()
+        executor = TrainExecutor(
+            trainer, train_iter_fn=lambda: [batch],
+            conf=Configuration({"train_steps": 1,
+                                "preemption_grace": False}),
+        )
+        executor.state = trainer.prepare()
+        executor._fetch_attribution()
+        reg = process_registry()
+        assert reg.get(tm.ATTR_FLOPS_PER_STEP) is not None
+        assert reg.get(tm.ATTR_MFU) is None
+        assert reg.get(tm.ATTR_EXPOSED_COMM_FRAC) is None
+
+    def test_attribution_off_means_absent_not_zero(
+            self, _attribution_context):
+        process_registry().reset()
+        _attribution_context.attribution_enabled = False
+        trainer, batch = _make_trainer()
+        self._run(trainer, batch, steps=8)
+        assert process_registry().get(tm.ATTR_MFU) is None
+        assert process_registry().get(tm.ATTR_FLOPS_PER_STEP) is None
+
+
+# -- memory-feasibility gate --------------------------------------------------
+
+
+def _big_model_optimizer(hbm_bytes=2e9, budget=0.0):
+    from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
+    from dlrover_tpu.master.optimizer import RuntimeOptimizer
+    from dlrover_tpu.parallel.planner import DeviceSpec
+
+    get_context().device_hbm_budget_bytes = budget
+    opt = RuntimeOptimizer(NodeRuntimeStore(), cooldown_secs=0,
+                           device=DeviceSpec(hbm_bytes=hbm_bytes))
+    opt.update_model_info(comm.ModelInfo(
+        num_params=300_000_000, hidden_size=2048, num_layers=16,
+        seq_len=2048))
+    opt.update_running_config(comm.TrainerConfigReport(
+        node_id=0, world=8, mesh_shape={"fsdp": 8}, train_window=4,
+        steps_per_call=1, global_batch=8))
+    return opt
+
+
+class TestMemoryFeasibilityGate:
+    def test_oversized_candidates_rejected_with_memory_reason(self):
+        clear_ring()
+        opt = _big_model_optimizer(hbm_bytes=1e9)  # nothing fits
+        decision = opt.replan("straggler:2")
+        assert decision is not None
+        assert decision.outcome == "rejected"
+        assert decision.reason == "memory_infeasible:all"
+        assert decision.memory_rejected
+        entry = decision.memory_rejected[0]
+        assert entry["predicted_hbm_bytes"] > entry["budget_bytes"]
+        # the PLAN_REJECTED memory evidence is in the event timeline
+        # (what `tpurun plan --events` / `tpurun attribution` read)
+        rejected = [e for e in recent_events()
+                    if e["kind"] == tm.EventKind.OPTIMIZER_PLAN_REJECTED
+                    and str(e.get("reason", "")).startswith("memory")]
+        assert rejected
+        # the per-pass evidence record carries the worst offender
+        evidence = [e for e in rejected if "predicted_hbm_mb" in e]
+        assert evidence
+        assert evidence[-1]["predicted_hbm_mb"] > \
+            evidence[-1]["budget_mb"]
+        # and in the queryable trail (tpurun plan / attribution --addr)
+        assert opt.memory_rejections()
+        trail = opt.to_report()["decisions"][-1]
+        assert trail["memory_rejected"]
+        # evidence is worst-first: the event's named offender is the
+        # true maximum even when the retained list is trimmed
+        sizes = [m["predicted_hbm_bytes"]
+                 for m in decision.memory_rejected]
+        assert sizes == sorted(sizes, reverse=True)
+        assert evidence[-1]["predicted_hbm_mb"] == pytest.approx(
+            sizes[0] / 1e6, rel=0.01)
+
+    def test_partial_gate_still_prices_feasible_meshes(self):
+        # budget between the sharded (fsdp) and replicated (data) cost:
+        # the data-heavy meshes die at the gate, the fsdp ones price
+        opt = _big_model_optimizer(hbm_bytes=95e9, budget=4.0e9)
+        decision = opt.replan("recovered:2")
+        assert decision is not None
+        assert decision.candidates  # something still priced
+        assert decision.memory_rejected  # and something was gated
+        gated = {json.dumps(m["mesh"], sort_keys=True)
+                 for m in decision.memory_rejected}
+        priced = {json.dumps(c["mesh"], sort_keys=True)
+                  for c in decision.candidates}
+        assert gated.isdisjoint(priced)
+
+    def test_memory_infeasible_error_carries_evidence(self):
+        from dlrover_tpu.master.optimizer.calibration import (
+            CostCalibrator,
+            MemoryInfeasibleError,
+        )
+        from dlrover_tpu.parallel.planner import DeviceSpec, ModelSpec
+
+        cal = CostCalibrator(
+            model=ModelSpec(param_count=300_000_000, num_layers=16,
+                            hidden_size=2048, seq_len=2048,
+                            global_batch=8),
+            device=DeviceSpec(hbm_bytes=1e9),
+        )
+        with pytest.raises(MemoryInfeasibleError) as err:
+            cal.price(MeshPlan(data=8))
+        assert err.value.memory_bytes > err.value.budget_bytes
+        # the CURRENT config is observably running: never gated
+        assert cal.price(MeshPlan(data=8), require_fit=False) > 0
+
+
+# -- G107 ---------------------------------------------------------------------
+
+
+class TestG107:
+    def test_check_memory_budget_pure(self):
+        from dlrover_tpu.analysis.graph_lint import check_memory_budget
+
+        assert check_memory_budget(0, 1e9) == []  # unknown peak
+        assert check_memory_budget(1e9, 0) == []  # unknown budget
+        assert check_memory_budget(1e9, 2e9) == []  # fits
+        findings = check_memory_budget(3e9, 2e9)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "G107"
+        assert "3.00 GB" in findings[0].message
+
+    def test_lint_train_step_fires_on_tiny_budget(self):
+        from dlrover_tpu.analysis.graph_lint import lint_train_step
+
+        report = lint_train_step(rules={"G107"}, hbm_budget_bytes=16.0)
+        assert [f.rule_id for f in report.findings] == ["G107"]
+
+    def test_g107_in_rule_registry(self):
+        from dlrover_tpu.analysis.graph_lint import (
+            ALL_GRAPH_RULES,
+            GRAPH_RULE_DOCS,
+        )
+
+        assert "G107" in ALL_GRAPH_RULES
+        assert "G107" in GRAPH_RULE_DOCS
+
+
+# -- device-memory guard (satellite: absent, never 0) ------------------------
+
+
+class _NoStatsDevice:
+    device_kind = "cpu"
+
+
+class _StatsDevice:
+    device_kind = "TPU v5e"
+
+    @staticmethod
+    def memory_stats():
+        return {"bytes_in_use": 100 * 1024 * 1024,
+                "bytes_limit": 16 * 1024 * 1024 * 1024}
+
+
+class TestDeviceMemoryGuard:
+    def test_no_stats_backend_reports_none(self, monkeypatch):
+        hook = NodeRuntimeReportHook(master_client=None, every_steps=1,
+                                     min_interval_s=0)
+        hook._devices = [_NoStatsDevice()]
+        assert hook._device_memory_mb() == (None, None)
+
+    def test_stats_backend_reports_usage_and_headroom(self):
+        hook = NodeRuntimeReportHook(master_client=None, every_steps=1,
+                                     min_interval_s=0)
+        hook._devices = [_StatsDevice(), _StatsDevice()]
+        in_use, headroom = hook._device_memory_mb()
+        assert in_use == pytest.approx(200.0)
+        assert headroom == pytest.approx(2 * 16 * 1024 - 200.0)
+
+    def test_node_series_exports_absent_as_no_gauge(self):
+        from dlrover_tpu.master.monitor.node_series import (
+            NodeRuntimeStore,
+        )
+
+        process_registry().reset()
+        store = NodeRuntimeStore()
+        report = comm.NodeRuntimeReport(
+            node_id=7, step=10, steps_total=10.0,
+            bounds=[0.001, 0.01], step_time_counts=[5, 5, 0],
+            rss_mb=10.0, device_mem_mb=None, mfu=None,
+        )
+        sample = store.ingest(report)
+        assert sample.device_mem_mb is None and sample.mfu is None
+        reg = process_registry()
+        assert reg.get(tm.NODE_DEVICE_MEM_MB,
+                       labels={"node": "7"}) is None
+        assert reg.get(tm.NODE_MFU, labels={"node": "7"}) is None
+        # present values DO export
+        store.ingest(comm.NodeRuntimeReport(
+            node_id=7, step=20, steps_total=20.0,
+            bounds=[0.001, 0.01], step_time_counts=[9, 11, 0],
+            rss_mb=10.0, device_mem_mb=123.0, mfu=0.5,
+            exposed_comm_frac=0.25, hbm_headroom_mb=1000.0))
+        assert reg.get(tm.NODE_DEVICE_MEM_MB,
+                       labels={"node": "7"}).value == 123.0
+        assert reg.get(tm.NODE_MFU, labels={"node": "7"}).value == 0.5
+        assert reg.get(tm.NODE_EXPOSED_COMM_FRAC,
+                       labels={"node": "7"}).value == 0.25
+        # a stat that BECOMES absent (program swap, failed re-capture)
+        # RETRACTS its series — the stale 0.5 must not export forever
+        store.ingest(comm.NodeRuntimeReport(
+            node_id=7, step=30, steps_total=30.0,
+            bounds=[0.001, 0.01], step_time_counts=[15, 15, 0],
+            rss_mb=10.0, device_mem_mb=None, mfu=None))
+        assert reg.get(tm.NODE_MFU, labels={"node": "7"}) is None
+        assert reg.get(tm.NODE_DEVICE_MEM_MB,
+                       labels={"node": "7"}) is None
+
+
+# -- straggler verdict gains the comm-vs-compute label -----------------------
+
+
+class TestStragglerBoundEvidence:
+    def test_verdict_labeled_comm_bound(self):
+        from dlrover_tpu.master.monitor.node_series import (
+            NodeRuntimeStore,
+        )
+        from dlrover_tpu.master.monitor.straggler import (
+            StragglerDetector,
+        )
+
+        store = NodeRuntimeStore()
+        detector = StragglerDetector(store, ratio=2.0,
+                                     confirm_windows=1, hang_secs=0)
+        bounds = [0.001, 0.01, 0.1]
+
+        def report(node, counts, **extra):
+            store.ingest(comm.NodeRuntimeReport(
+                node_id=node, step=10, steps_total=10.0,
+                bounds=bounds, step_time_counts=counts, **extra))
+            detector.observe(node)
+
+        report(0, [10, 0, 0, 0], exposed_comm_frac=0.2)
+        report(1, [10, 0, 0, 0], exposed_comm_frac=0.25)
+        report(2, [0, 0, 10, 0], mfu=0.01, exposed_comm_frac=0.8)
+        verdicts = detector.verdicts()
+        assert verdicts[2]["verdict"] == "straggler"
+        evidence = verdicts[2]["evidence"]
+        # RELATIVE judgement: 0.8 vs the peers' 0.225 median
+        assert evidence["bound"] == "comm-bound"
+        assert evidence["exposed_comm_frac"] == pytest.approx(0.8)
+        assert evidence["peer_median_comm_frac"] == pytest.approx(
+            0.225)
+        assert evidence["mfu"] == pytest.approx(0.01)
+
+    def test_verdict_labeled_compute_bound_when_frac_tracks_peers(self):
+        from dlrover_tpu.master.monitor.node_series import (
+            NodeRuntimeStore,
+        )
+        from dlrover_tpu.master.monitor.straggler import (
+            StragglerDetector,
+        )
+
+        store = NodeRuntimeStore()
+        detector = StragglerDetector(store, ratio=2.0,
+                                     confirm_windows=1, hang_secs=0)
+        bounds = [0.001, 0.01, 0.1]
+
+        def report(node, counts, **extra):
+            store.ingest(comm.NodeRuntimeReport(
+                node_id=node, step=10, steps_total=10.0,
+                bounds=bounds, step_time_counts=counts, **extra))
+            detector.observe(node)
+
+        # every node (straggler included) shows the same high upper
+        # bound — the extra step time is NOT extra communication
+        report(0, [10, 0, 0, 0], exposed_comm_frac=0.6)
+        report(1, [10, 0, 0, 0], exposed_comm_frac=0.6)
+        report(2, [0, 0, 10, 0], exposed_comm_frac=0.65)
+        evidence = detector.verdicts()[2]["evidence"]
+        assert evidence["bound"] == "compute-bound"
+
+
+# -- goodput model-FLOPs column ----------------------------------------------
+
+
+class TestGoodputModelFlops:
+    def test_column_derived_from_attribution_record(self):
+        from dlrover_tpu.telemetry.goodput import derive_goodput
+
+        events = [
+            {"kind": "train_start", "ts": 0.0, "node": "0", "pid": 1,
+             "step": 0},
+            {"kind": tm.EventKind.ATTRIBUTION_CAPTURED, "ts": 1.0,
+             "node": "0", "pid": 1, "flops_per_step": 100.0,
+             "n_devices": 4},
+            {"kind": "train_end", "ts": 11.0, "node": "0", "pid": 1,
+             "step": 50},
+        ]
+        report = derive_goodput(events)
+        col = report["detail"]["model_flops"]
+        assert col["flops_per_step"] == pytest.approx(400.0)
+        assert col["steps"] == 50
+        assert col["total"] == pytest.approx(20000.0)
+        assert col["per_productive_second"] > 0
+
+    def test_column_integrates_across_elastic_resizes(self):
+        # steps 0-100 on 8 devices, then a resize re-captures at 4
+        # devices and the job runs to step 150: each phase is charged
+        # at ITS OWN record's rate, not the newest record's
+        from dlrover_tpu.telemetry.goodput import derive_goodput
+
+        events = [
+            {"kind": "train_start", "ts": 0.0, "node": "0", "pid": 1,
+             "step": 0},
+            {"kind": tm.EventKind.ATTRIBUTION_CAPTURED, "ts": 1.0,
+             "node": "0", "pid": 1, "flops_per_step": 100.0,
+             "n_devices": 8},
+            {"kind": "train_end", "ts": 50.0, "node": "0", "pid": 1,
+             "step": 100},
+            {"kind": "train_start", "ts": 60.0, "node": "0", "pid": 1,
+             "step": 100},
+            {"kind": tm.EventKind.ATTRIBUTION_CAPTURED, "ts": 61.0,
+             "node": "0", "pid": 1, "flops_per_step": 100.0,
+             "n_devices": 4},
+            {"kind": "train_end", "ts": 90.0, "node": "0", "pid": 1,
+             "step": 150},
+        ]
+        col = derive_goodput(events)["detail"]["model_flops"]
+        assert col["records"] == 2
+        assert col["steps"] == 150
+        # 100 steps @ 800 flops + 50 steps @ 400 flops
+        assert col["total"] == pytest.approx(100 * 800 + 50 * 400)
+
+    def test_no_record_no_column(self):
+        from dlrover_tpu.telemetry.goodput import derive_goodput
+
+        events = [
+            {"kind": "train_start", "ts": 0.0, "node": "0", "pid": 1},
+            {"kind": "train_end", "ts": 5.0, "node": "0", "pid": 1,
+             "step": 9},
+        ]
+        assert "model_flops" not in derive_goodput(events)["detail"]
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+class TestAttributionCli:
+    def test_forensic_events_view(self, tmp_path, capsys):
+        from dlrover_tpu.telemetry.cli import main as cli_main
+
+        path = tmp_path / "events.jsonl"
+        records = [
+            {"kind": tm.EventKind.ATTRIBUTION_CAPTURED, "ts": 1.0,
+             "node": "0", "pid": 42, "flops_per_step": 123.0,
+             "arithmetic_intensity": 0.5, "peak_hbm_mb": 1.5,
+             "predicted_comm_total_s": 0.001, "source": "hlo"},
+            {"kind": tm.EventKind.OPTIMIZER_PLAN_REJECTED, "ts": 2.0,
+             "node": "0", "pid": 1, "reason": "memory_infeasible",
+             "mesh": {"data": 8}, "predicted_hbm_mb": 7000.0,
+             "budget_mb": 1600.0},
+        ]
+        path.write_text(
+            "".join(json.dumps(r) + "\n" for r in records))
+        rc = cli_main(["attribution", "--events", str(path), "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["records"][0]["flops_per_step"] == 123.0
+        assert out["memory_rejected"][0]["reason"] == \
+            "memory_infeasible"
+
+    def test_trace_view(self, capsys):
+        from dlrover_tpu.telemetry.cli import main as cli_main
+
+        rc = cli_main(["attribution", "--trace", FIXTURE, "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["measured_comm_frac"] == pytest.approx(15 / 47,
+                                                          abs=1e-4)
+
+    def test_tpurun_routes_attribution(self, capsys):
+        from dlrover_tpu.trainer.run import main as tpurun_main
+
+        rc = tpurun_main(["attribution", "--trace", FIXTURE, "--json"])
+        assert rc == 0
+        assert json.loads(
+            capsys.readouterr().out)["busy_s"] == pytest.approx(0.045)
+
+
+# -- overhead gate (satellite: attribution collection stays cheap) -----------
+
+
+class _TimedRegion(TrainHook):
+    def __init__(self, warmup):
+        self.warmup = warmup
+        self.t0 = None
+
+    def before_step(self, step):
+        if step == self.warmup + 1 and self.t0 is None:
+            self.t0 = time.perf_counter()
+
+
+class TestAttributionOverheadGate:
+    def test_overhead_within_budget(self, _attribution_context):
+        """Attribution must stay observation-only: ≤5% step-loop
+        overhead with derivation ON vs OFF, as the median of
+        back-to-back paired ratios (run drift on a shared 1-core box
+        dwarfs the real cost — two gauge stores per materialization).
+        The one-off capture compile lands at TRAIN START (inside the
+        COMPILE_FIRST_STEP window), so the timed region sees only the
+        per-step cost."""
+        steps, warmup = 280, 8
+        trainer, batch = _make_trainer()
+
+        def run(enabled):
+            _attribution_context.attribution_enabled = enabled
+            timer = _TimedRegion(warmup)
+            executor = TrainExecutor(
+                trainer,
+                train_iter_fn=lambda: [batch] * (warmup + steps),
+                hooks=[timer],
+                conf=Configuration({
+                    "train_steps": warmup + steps,
+                    "log_every_steps": 0, "train_window": 4,
+                    "preemption_grace": False,
+                }),
+            )
+            executor.train_and_evaluate()
+            return time.perf_counter() - timer.t0
+
+        run(True)  # prime: capture + program compile out of the pairs
+        ratios = []
+        for i in range(5):
+            if i % 2 == 0:
+                dt_off = run(False)
+                dt_on = run(True)
+            else:
+                dt_on = run(True)
+                dt_off = run(False)
+            ratios.append(dt_on / dt_off)
+        overhead = sorted(ratios)[len(ratios) // 2] - 1.0
+        assert overhead <= 0.05, (
+            f"attribution overhead {overhead:.1%} above the 5% budget "
+            f"(ratios {[round(r, 3) for r in ratios]})"
+        )
