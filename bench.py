@@ -696,23 +696,31 @@ def dispatch_result() -> dict:
         return n_steps / dt, recompiles, params
 
     def attr_gauges(telemetry=True):
-        """The leg's derived attribution gauges (MFU / exposed-comm
-        fraction), read right after its executor finished; None when
-        telemetry was off (no capture ran — absent, not 0)."""
+        """The leg's derived attribution + data-plane gauges (MFU /
+        exposed-comm fraction / input-wait fraction), read right after
+        its executor finished; None when telemetry was off (no capture
+        ran — absent, not 0)."""
         if not telemetry:
-            return {"mfu": None, "exposed_comm_frac": None}
+            return {"mfu": None, "exposed_comm_frac": None,
+                    "input_wait_frac": None}
         from dlrover_tpu.telemetry import names as tmn
         from dlrover_tpu.telemetry.metrics import process_registry
 
         reg = process_registry()
         mfu = reg.get(tmn.ATTR_MFU)
         frac = reg.get(tmn.ATTR_EXPOSED_COMM_FRAC)
+        wait = reg.get(tmn.INPUT_WAIT_FRAC)
         return {
             # 12 digits: a tiny CPU-mesh model against a datasheet TPU
             # peak is ~1e-9 MFU — 6 digits would floor it to a fake 0
             "mfu": round(mfu.value, 12) if mfu is not None else None,
             "exposed_comm_frac": (round(frac.value, 6)
                                   if frac is not None else None),
+            # the input-wait share of the leg's last window: an
+            # in-memory list iterator should read ~0 — a meaningful
+            # value here flags the BENCH itself as input-bound
+            "input_wait_frac": (round(wait.value, 6)
+                                if wait is not None else None),
         }
 
     from dlrover_tpu.common.config import get_context as _get_ctx

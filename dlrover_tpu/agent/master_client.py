@@ -63,6 +63,18 @@ class MasterClient:
             dataset_name=dataset_name, content=content
         ))
 
+    def get_data_report(self, dataset_name: str = "") -> dict:
+        """The master's shard-dispatch ledger: per-dataset queue/epoch
+        accounting + per-node consumption (``tpurun data --addr``)."""
+        import json
+
+        resp = self._channel.get(comm.DataShardRequest(
+            dataset_name=dataset_name))
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
+
     # -- rendezvous ---------------------------------------------------------
 
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,

@@ -9,12 +9,14 @@ checkpoints for mid-epoch resume.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Deque, Iterator, List, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import get_registry, names as tm
 
 logger = get_logger("agent.sharding")
 
@@ -41,6 +43,22 @@ class ShardingClient:
         self._lock = threading.Lock()
         self._pending_batch_count = 0
         self._current_task: Optional[comm.Task] = None
+        # data-plane instruments (null handles when telemetry is off):
+        # fetch latency is the worker's view of the master's dispatch
+        # queue — a starved pipeline shows up here before anywhere else
+        reg = get_registry()
+        self._h_fetch = reg.histogram(
+            tm.DATA_SHARD_FETCH_TIME,
+            help="get_task RPC latency fetching the next shard")
+        self._c_fetched = reg.counter(
+            tm.DATA_SHARDS_FETCHED, help="shards fetched from the master")
+        self._c_completed = reg.counter(
+            tm.DATA_SHARDS_COMPLETED,
+            help="shards this worker reported complete")
+        self._c_report_retries = reg.counter(
+            tm.DATA_BATCH_REPORT_RETRIES,
+            help="batch-done credits re-queued after a failed report "
+                 "RPC (restored, not dropped)")
         self._client.report_dataset_shard_params(
             dataset_name=dataset_name,
             dataset_size=dataset_size,
@@ -54,27 +72,46 @@ class ShardingClient:
 
     def fetch_shard(self) -> Optional[comm.Shard]:
         """Next shard, or None when the dataset is exhausted."""
+        t0 = time.monotonic()
         task = self._client.get_task(self.dataset_name)
+        self._h_fetch.observe(time.monotonic() - t0)
         if task is None or task.task_id < 0:
             return None
+        self._c_fetched.inc()
         self._current_task = task
         return task.shard
 
     def report_batch_done(self, batch_count: int = 1):
         """Credit consumed batches; flushed to the master per batch group
-        (cheap: one rpc per batch, still shard-granular on the master)."""
+        (cheap: one rpc per batch, still shard-granular on the master).
+
+        A failed report RPC restores the pending count instead of
+        dropping it: a silently lost credit would leave the shard to
+        complete only via the master's timeout re-dispatch — re-reading
+        data the job already consumed. The retry is counted and the
+        next report carries the accumulated credit."""
         with self._lock:
             self._pending_batch_count += batch_count
-            records = self._pending_batch_count * self.batch_size
+            pending = self._pending_batch_count
             self._pending_batch_count = 0
-        if records:
+        records = pending * self.batch_size
+        if not records:
+            return
+        try:
             self._client.report_batch_done(self.dataset_name, records)
+        except Exception:
+            with self._lock:
+                self._pending_batch_count += pending
+            self._c_report_retries.inc()
+            raise
 
     def report_task_done(self, err_message: str = ""):
         if self._current_task is not None:
             self._client.report_task_result(
                 self.dataset_name, self._current_task.task_id, err_message
             )
+            if not err_message:
+                self._c_completed.inc()
             self._current_task = None
 
     @property
@@ -89,6 +126,8 @@ class ShardingClient:
         self._client.report_task_result(
             self.dataset_name, task_id, err_message
         )
+        if not err_message:
+            self._c_completed.inc()
         if self._current_task is not None and \
                 self._current_task.task_id == task_id:
             self._current_task = None

@@ -312,6 +312,10 @@ class NodeRuntimeReport:
     exposed_comm_frac: Optional[float] = None
     flops_per_step: Optional[float] = None
     peak_hbm_mb: Optional[float] = None
+    # data plane: fraction of the worker's last materialization window
+    # spent blocked waiting for the next host batch (None until the
+    # executor measured a window — absent, never a fake 0)
+    input_wait_frac: Optional[float] = None
 
 
 @message
@@ -324,6 +328,16 @@ class AttributionRequest:
 
     node_id: int = -1
     limit: int = 0  # 0 = every retained memory rejection
+
+
+@message
+class DataShardRequest:
+    """Query the master's shard-dispatch ledger: per-dataset
+    todo/doing/done queues, epoch progress + ETA, timeout recoveries
+    and per-node consumption rates (the ``tpurun data --addr`` view).
+    Answered with a DiagnosisReport-style JSON blob."""
+
+    dataset_name: str = ""  # "" = every registered dataset
 
 
 @message

@@ -132,6 +132,13 @@ class Context:
         # within this many seconds is suppressed (flapping triggers
         # cannot thrash the job through the same plan)
         self.replan_cooldown_secs = 60.0
+        # input-bound replan gate (docs/operations.md "Self-tuning"):
+        # when a node's input_wait_fraction sits >= 0.1 above the peer
+        # median, the job is data-starved and a mesh/steps_per_call
+        # replan cannot help — the optimizer rejects program plans with
+        # reason=input_bound instead of paying a futile drain. Host
+        # knobs (train_window) still apply.
+        self.replan_input_bound_gate = True
         # worker-side: wall seconds between get_parallel_config polls
         # for a master-published plan (0 = the OptimizerPlanHook is off)
         self.plan_poll_secs = 30.0

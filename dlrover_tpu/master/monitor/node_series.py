@@ -56,6 +56,9 @@ class NodeSample:
     exposed_comm_frac: Optional[float] = None
     flops_per_step: Optional[float] = None
     peak_hbm_mb: Optional[float] = None
+    # data plane: the worker's input-wait fraction over its last
+    # materialization window (None until the executor measured one)
+    input_wait_frac: Optional[float] = None
     overflow: bool = False
 
 
@@ -162,6 +165,8 @@ class NodeRuntimeStore:
                 flops_per_step=opt(getattr(report, "flops_per_step",
                                            None)),
                 peak_hbm_mb=opt(getattr(report, "peak_hbm_mb", None)),
+                input_wait_frac=opt(getattr(report, "input_wait_frac",
+                                            None)),
                 overflow=bool(of50 or of95),
             )
             state.samples.append(sample)
@@ -209,6 +214,8 @@ class NodeRuntimeStore:
              "per-node compiled FLOPs per step"),
             (tm.NODE_PEAK_HBM_MB, s.peak_hbm_mb,
              "per-node compiled peak HBM (MB)"),
+            (tm.NODE_INPUT_WAIT_FRAC, s.input_wait_frac,
+             "per-node input-pipeline wait fraction of the step window"),
         )
         for name, value, help_text in optional:
             if value is not None:

@@ -50,6 +50,13 @@ VERDICT_HEALTHY = "healthy"
 VERDICT_STRAGGLER = "straggler"
 VERDICT_HUNG = "hung"
 
+# the bound-triad peer-delta: a node's input-wait / exposed-comm
+# fraction must exceed the healthy peers' median by this much before
+# the leg names it. ONE constant shared with the runtime optimizer's
+# input-bound replan gate — the verdict's label and the gate's
+# judgement must never desynchronize.
+BOUND_PEER_DELTA = 0.1
+
 
 @dataclass
 class NodeVerdict:
@@ -207,6 +214,7 @@ class StragglerDetector:
             return
         peers = []
         peer_fracs = []
+        peer_input_fracs = []
         for nid in self._store.node_ids():
             if nid == node_id:
                 continue
@@ -217,6 +225,8 @@ class StragglerDetector:
             peers.append(s.step_p50)
             if getattr(s, "exposed_comm_frac", None) is not None:
                 peer_fracs.append(s.exposed_comm_frac)
+            if getattr(s, "input_wait_frac", None) is not None:
+                peer_input_fracs.append(s.input_wait_frac)
         if not peers:
             # no fresh peer anchors a median: there is no evidence
             # basis, so an existing straggler verdict must not outlive
@@ -250,25 +260,39 @@ class StragglerDetector:
             "window_steps": mine.window_steps,
             "overflow": mine.overflow,
         }
-        # performance-attribution labeling: when the node reports the
-        # derived exposed-comm fraction, the verdict says WHY it is
-        # slow — a comm-bound straggler (link contention, bad route)
-        # wants a different remedy than a compute-bound one (thermal
-        # throttle, noisy neighbor). The fraction is an UPPER bound
-        # that rises with ANY slowdown, so the label is RELATIVE: only
-        # a fraction clearly above the healthy peers' median means the
-        # extra time is un-overlapped communication; a straggler whose
-        # fraction tracks its peers is slow at the compute itself.
+        # bound labeling — the WHY behind a slow node, judged in triad
+        # order: input-bound, then comm-bound, then compute-bound. A
+        # starved input pipeline inflates BOTH the step time and the
+        # exposed-comm fraction (the residual 1 - compute/step rises
+        # with any non-compute time), so without the input leg a
+        # data-starved node reads as comm/compute-bound and the
+        # optimizer burns a drain on a mesh replan that cannot help.
+        # Every leg is judged RELATIVE to the healthy peers' median
+        # (delta >= 0.1), never an absolute threshold: input wait and
+        # exposed comm both rise cluster-wide with shared causes, and
+        # only the node's EXCESS over its peers names the culprit.
+        bound = None
+        input_frac = getattr(mine, "input_wait_frac", None)
+        if input_frac is not None:
+            evidence["input_wait_frac"] = round(input_frac, 4)
+            if peer_input_fracs:
+                peer_input = statistics.median(peer_input_fracs)
+                evidence["peer_median_input_wait_frac"] = round(
+                    peer_input, 4)
+                if input_frac - peer_input >= BOUND_PEER_DELTA:
+                    bound = "input-bound"
         frac = getattr(mine, "exposed_comm_frac", None)
         if frac is not None:
             evidence["exposed_comm_frac"] = round(frac, 4)
             if peer_fracs:
                 peer_frac = statistics.median(peer_fracs)
                 evidence["peer_median_comm_frac"] = round(peer_frac, 4)
-                evidence["bound"] = (
-                    "comm-bound" if frac - peer_frac >= 0.1
-                    else "compute-bound"
-                )
+                if bound is None:
+                    bound = ("comm-bound"
+                             if frac - peer_frac >= BOUND_PEER_DELTA
+                             else "compute-bound")
+        if bound is not None:
+            evidence["bound"] = bound
         if getattr(mine, "mfu", None) is not None:
             evidence["mfu"] = round(mine.mfu, 6)
         self._flag(node_id, VERDICT_STRAGGLER, now, evidence=evidence)

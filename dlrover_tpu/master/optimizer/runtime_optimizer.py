@@ -30,6 +30,7 @@ renders the live table (``PlanRequest`` RPC) and the forensic trail
 from __future__ import annotations
 
 import collections
+import statistics
 import threading
 import time
 from dataclasses import dataclass, field
@@ -38,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.monitor.straggler import BOUND_PEER_DELTA
 from dlrover_tpu.master.optimizer.calibration import (
     CostCalibrator,
     MemoryInfeasibleError,
@@ -68,6 +70,13 @@ _TABLE_ROWS = 8
 _MAX_DECISIONS = 64
 # a node's latest sample older than this does not anchor calibration
 _CALIBRATION_FRESHNESS_S = 600.0
+# the input-bound replan gate's absolute backstop: uniform cluster-wide
+# starvation (a shared slow filesystem — the most common input-bound
+# mode) has no peer excess to show, so a MEDIAN input-wait fraction at
+# an absolute majority of the window also marks the job data-starved.
+# The peer-relative leg shares BOUND_PEER_DELTA with the straggler
+# verdict's bound label (one constant, never desynchronized).
+_INPUT_BOUND_ABS = 0.5
 
 STEPS_PER_CALL_OPTIONS = (1, 2, 4, 8)
 # priced by the cost model, but NOT yet live-appliable: a dispatch-mode
@@ -175,6 +184,9 @@ class Decision:
     # pricing (predicted peak HBM above the device budget) — the
     # evidence `tpurun plan` / `tpurun attribution` surface
     memory_rejected: List[Dict] = field(default_factory=list)
+    # the INPUT-BOUND gate's evidence when it rejected this pass's
+    # program plan (which node is starved, by how much over peers)
+    input_bound: Optional[Dict] = None
     # the chosen candidate's knob-tuple key (blacklist identity on a
     # failed apply); not part of the reported dict
     chosen_key: str = ""
@@ -197,6 +209,8 @@ class Decision:
             "apply_failed": self.apply_failed,
             "realized_speedup": self.realized_speedup,
             "memory_rejected": list(self.memory_rejected),
+            "input_bound": (dict(self.input_bound)
+                            if self.input_bound else None),
         }
 
 
@@ -229,6 +243,11 @@ class RuntimeOptimizer:
         self._enabled = bool(
             enabled if enabled is not None
             else getattr(ctx, "runtime_optimizer_enabled", True))
+        # the input-bound gate (mirror of PR 8's memory gate): a
+        # data-starved job must not pay a drain for a program replan
+        # that cannot feed it — docs/operations.md names the knob
+        self._input_bound_gate = bool(
+            getattr(ctx, "replan_input_bound_gate", True))
         self._mesh_candidates = mesh_candidates
         self._lock = threading.RLock()
         self._running: Optional[RunningConfig] = None
@@ -510,6 +529,65 @@ class RuntimeOptimizer:
         memory_rejected.sort(key=lambda m: -m["predicted_hbm_bytes"])
         return out, memory_rejected
 
+    def _input_bound_evidence(self) -> Optional[Dict]:
+        """The input-bound judgement over the fresh node samples, on
+        two legs: (a) peer-relative — the worst node's
+        ``input_wait_frac`` at least ``BOUND_PEER_DELTA`` above the
+        peer median (the straggler verdict's bound-label pattern,
+        catching ONE starved node); (b) absolute — the cluster MEDIAN
+        fraction at ``_INPUT_BOUND_ABS`` or above (uniform starvation
+        from a shared slow source shows no peer excess at all).
+        Returns the evidence dict when the job is input-bound, else
+        None. A mesh/steps_per_call replan reshapes device work; it
+        cannot make the host produce batches faster, so a program plan
+        chosen while this holds is rejected as ``input_bound``."""
+        if not self._input_bound_gate:
+            return None
+        now = time.time()
+        fracs: Dict[int, float] = {}
+        for nid in self._store.node_ids():
+            s = self._store.latest(nid)
+            if s is None or now - getattr(s, "ts", now) > \
+                    _CALIBRATION_FRESHNESS_S:
+                continue
+            frac = getattr(s, "input_wait_frac", None)
+            if frac is not None:
+                fracs[int(nid)] = float(frac)
+        if not fracs:
+            return None
+        worst = max(fracs, key=fracs.get)
+        peers = [f for n, f in fracs.items() if n != worst]
+        # "input_bound_node", not "node": the evidence rides emit_event
+        # kwargs, where a "node" field would clobber the record's own
+        # node-identity stamp
+        if peers:
+            peer_median = statistics.median(peers)
+            if fracs[worst] - peer_median >= BOUND_PEER_DELTA:
+                return {
+                    "input_bound_node": worst,
+                    "input_wait_frac": round(fracs[worst], 4),
+                    "peer_median_input_wait_frac": round(peer_median, 4),
+                }
+        median = statistics.median(fracs.values())
+        if median >= _INPUT_BOUND_ABS:
+            return {
+                "input_bound_node": worst,
+                "input_wait_frac": round(fracs[worst], 4),
+                "median_input_wait_frac": round(median, 4),
+            }
+        return None
+
+    @staticmethod
+    def _wants_program(c: CandidateScore, run: RunningConfig) -> bool:
+        """Whether the candidate changes the COMPILED program (mesh or
+        fused-step degree) — the knobs whose apply pays a drain. A
+        host-knob-only plan (train_window) stays appliable even on a
+        data-starved job."""
+        return (
+            _mesh_dict(c.mesh) != _mesh_dict(run.mesh)
+            or c.steps_per_call != run.steps_per_call
+        )
+
     @staticmethod
     def _churn(c: CandidateScore, run: RunningConfig) -> int:
         """Tie-break distance from the current knobs: equal-price plans
@@ -626,7 +704,25 @@ class RuntimeOptimizer:
             best_predicted_s=round(best.predicted_step_s, 6),
             best_speedup=round(best.speedup, 3),
         )
-        if self._churn(best, run) == 0:
+        input_ev = self._input_bound_evidence()
+        if input_ev is not None and (
+            self._churn(best, run) == 0
+            or self._wants_program(best, run)
+        ):
+            # the INPUT-BOUND gate, checked before every other verdict
+            # on the pass: a starved input pipeline poisons the
+            # calibration in BOTH directions (the anchor p50 includes
+            # host wait the cost model books as device work), so
+            # "already optimal" and "8x from K=8" are equally fictional
+            # — and a mesh/steps_per_call drain cannot make the host
+            # produce batches faster. The pass is rejected with the
+            # starvation evidence instead; only a host-knob-only plan
+            # (train_window) passes through. The gate does not consume
+            # the cooldown, so the same plan is immediately proposable
+            # once the starvation clears.
+            decision.input_bound = dict(input_ev)
+            self._reject(decision, "input_bound", **input_ev)
+        elif self._churn(best, run) == 0:
             self._reject(decision, "already_optimal")
         elif best.speedup < self._min_speedup:
             self._reject(
@@ -644,7 +740,8 @@ class RuntimeOptimizer:
         self._decisions.append(decision)
         return decision
 
-    def _reject(self, decision: Decision, reason: str) -> None:
+    def _reject(self, decision: Decision, reason: str,
+                **evidence) -> None:
         decision.outcome = "rejected"
         decision.reason = reason
         self._c_rejected.inc()
@@ -652,6 +749,7 @@ class RuntimeOptimizer:
             EventKind.OPTIMIZER_PLAN_REJECTED,
             trigger=decision.trigger, reason=reason,
             predicted_speedup=round(decision.predicted_speedup, 3),
+            **evidence,
         )
         logger.info("replan(%s): no plan published (%s)",
                     decision.trigger, reason)

@@ -112,6 +112,7 @@ class MasterServicer:
             comm.DiagnosisRequest: self._get_diagnosis,
             comm.PlanRequest: self._get_plan,
             comm.AttributionRequest: self._get_attribution,
+            comm.DataShardRequest: self._get_data_report,
         }
         self._report_handlers = {
             comm.DatasetShardParams: self._new_dataset,
@@ -219,6 +220,19 @@ class MasterServicer:
             req.dataset_name, req.content
         )
         return comm.Response(success=True)
+
+    def _get_data_report(self, req: comm.DataShardRequest):
+        """The shard-dispatch ledger: per-dataset todo/doing/done
+        queues, epoch progress + ETA, timeout recoveries and per-node
+        consumption rates — the ``tpurun data --addr`` payload."""
+        import json as _json
+
+        if self._task_manager is None:
+            report = {"datasets": {}, "nodes": {}}
+        else:
+            report = self._task_manager.data_report(
+                dataset_name=req.dataset_name or "")
+        return comm.DiagnosisReport(report_json=_json.dumps(report))
 
     # -- rendezvous ---------------------------------------------------------
 

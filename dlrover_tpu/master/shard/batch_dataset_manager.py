@@ -17,8 +17,26 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter, Shard
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
 
 logger = get_logger("master.shard")
+
+# the {dataset=}-labeled lifecycle gauges this manager owns (created at
+# the first dispatched shard, retracted when the dataset completes —
+# the absent-not-zero rule: a scrape must never read todo=0 for a
+# dataset that never dispatched, or a frozen queue for one that ended)
+_LIFECYCLE_GAUGES = (
+    tm.DATA_SHARDS_TODO,
+    tm.DATA_SHARDS_DOING,
+    tm.DATA_SHARDS_DONE,
+    tm.DATA_EPOCH,
+    tm.DATA_EPOCH_PROGRESS,
+)
 
 
 @dataclass
@@ -50,6 +68,34 @@ class BatchDatasetManager:
         self._completed_step = 0
         self._reported_records: Dict[int, int] = {}
         self._epoch_checkpoint_restored = False
+        # -- shard-lifecycle accounting (the tpurun data ledger) ------
+        self._shards_done = 0
+        self._records_done = 0
+        # PER-EPOCH records done + tasks outstanding (created, not yet
+        # completed), keyed by the task's own epoch: epochs OVERLAP by
+        # design — get_task refills lazily while the previous epoch's
+        # last shards are still doing on other workers — so a single
+        # "current epoch" counter would credit a late epoch-N
+        # completion to epoch N+1 and never see epoch N drain
+        self._epoch_records: Dict[int, int] = {}
+        self._epoch_outstanding: Dict[int, int] = {}
+        self._timeout_recovered = 0
+        # per-node consumption: shards/records completed + first/last
+        # completion stamps (the rate denominators)
+        self._node_shards: Dict[int, int] = {}
+        self._node_records: Dict[int, int] = {}
+        self._node_first_ts: Dict[int, float] = {}
+        self._node_last_ts: Dict[int, float] = {}
+        self._dispatch_started = False
+        self._gauges_live = False
+        # cached handles (created lazily at first dispatch so the
+        # absent-not-zero rule holds; the registry is resolved once)
+        self._reg = get_registry()
+        self._h_latency = self._reg.histogram(
+            tm.DATA_SHARD_LATENCY,
+            help="shard dispatch -> completion wall seconds")
+        self._gauges: Dict[str, object] = {}
+        self._node_counters: Dict[int, tuple] = {}
 
     @property
     def dataset_name(self) -> str:
@@ -63,6 +109,8 @@ class BatchDatasetManager:
             return Task.create_invalid()
         task = self.todo.popleft()
         self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+        self._dispatch_started = True
+        self._refresh_gauges()
         return task
 
     def _create_epoch_tasks(self):
@@ -73,6 +121,10 @@ class BatchDatasetManager:
                      epoch=self._splitter.epoch)
             )
             self._task_id_seq += 1
+        if shards:
+            epoch = self._splitter.epoch
+            self._epoch_outstanding[epoch] = (
+                self._epoch_outstanding.get(epoch, 0) + len(shards))
 
     def report_task_status(self, task_id: int, success: bool) -> Tuple[bool, Task]:
         """Worker finished (or failed) a task; failure requeues the shard."""
@@ -86,6 +138,9 @@ class BatchDatasetManager:
                 doing.task.shard.end,
             )
             self.todo.appendleft(doing.task)
+        else:
+            self._account_completion(doing)
+        self._refresh_gauges()
         return success, doing.task
 
     def report_batch_done(self, node_id: int, record_count: int,
@@ -107,12 +162,174 @@ class BatchDatasetManager:
                 self._reported_records.pop(tid, None)
                 self.doing.pop(tid)
                 completed.append(tid)
+                self._account_completion(doing)
             else:
                 self._reported_records[tid] = credited
                 remaining = 0
             if remaining <= 0:
                 break
+        if completed:
+            self._refresh_gauges()
         return completed
+
+    # -- shard-lifecycle accounting (lock held by the TaskManager) -----------
+
+    def _account_completion(self, doing: DoingTask):
+        """One shard left doing as COMPLETED (either path: an explicit
+        task result, or record credits covering the shard). Counted at
+        the pop site so the two completion paths can never double
+        count. Credited to the TASK's epoch — a late epoch-N
+        completion arriving after epoch N+1 started dispatching must
+        close epoch N, not inflate N+1."""
+        now = time.time()
+        size = doing.task.shard.size
+        epoch = doing.task.epoch
+        self._shards_done += 1
+        self._records_done += size
+        self._epoch_records[epoch] = (
+            self._epoch_records.get(epoch, 0) + size)
+        outstanding = self._epoch_outstanding.get(epoch, 1) - 1
+        self._epoch_outstanding[epoch] = outstanding
+        nid = int(doing.node_id)
+        self._node_shards[nid] = self._node_shards.get(nid, 0) + 1
+        self._node_records[nid] = self._node_records.get(nid, 0) + size
+        self._node_first_ts.setdefault(nid, doing.start_time)
+        self._node_last_ts[nid] = now
+        self._h_latency.observe(now - doing.start_time)
+        counters = self._node_counters.get(nid)
+        if counters is None:
+            labels = {"node": str(nid)}
+            counters = (
+                self._reg.counter(
+                    tm.DATA_NODE_SHARDS_COMPLETED, labels=labels,
+                    help="shards completed per consuming node"),
+                self._reg.counter(
+                    tm.DATA_NODE_RECORDS_DONE, labels=labels,
+                    help="records completed per consuming node"),
+            )
+            self._node_counters[nid] = counters
+        counters[0].inc()
+        counters[1].inc(size)
+        if outstanding <= 0:
+            # the epoch's every created task completed — the forensic
+            # anchor `tpurun data --events` reconstructs from (fires
+            # even when the NEXT epoch is already dispatching)
+            self._epoch_outstanding.pop(epoch, None)
+            emit_event(
+                EventKind.DATA_EPOCH_END,
+                dataset=self.dataset_name,
+                epoch=epoch,
+                shards_done=self._shards_done,
+                records_done=self._records_done,
+                timeout_recovered=self._timeout_recovered,
+                final=self.completed(),
+            )
+
+    def epoch_progress(self) -> float:
+        """Fraction of the NEWEST dispatch epoch's records completed."""
+        total = max(1, int(self._splitter.dataset_size))
+        done = self._epoch_records.get(self._splitter.epoch, 0)
+        return min(1.0, done / total)
+
+    def _refresh_gauges(self):
+        """Mirror the queue state into {dataset=}-labeled gauges.
+        Created only once a shard was dispatched; RETRACTED when the
+        dataset completes (absent-not-zero — see _LIFECYCLE_GAUGES)."""
+        if not self._dispatch_started:
+            return
+        if self.completed():
+            self.retract_gauges()
+            return
+        labels = {"dataset": self.dataset_name}
+        self._gauges_live = True
+        g = self._gauges
+        if not g:
+            g[tm.DATA_SHARDS_TODO] = self._reg.gauge(
+                tm.DATA_SHARDS_TODO, labels=labels,
+                help="shards waiting for dispatch")
+            g[tm.DATA_SHARDS_DOING] = self._reg.gauge(
+                tm.DATA_SHARDS_DOING, labels=labels,
+                help="shards dispatched and in flight")
+            g[tm.DATA_SHARDS_DONE] = self._reg.gauge(
+                tm.DATA_SHARDS_DONE, labels=labels,
+                help="shards completed so far")
+            g[tm.DATA_EPOCH] = self._reg.gauge(
+                tm.DATA_EPOCH, labels=labels,
+                help="current dispatch epoch")
+            g[tm.DATA_EPOCH_PROGRESS] = self._reg.gauge(
+                tm.DATA_EPOCH_PROGRESS, labels=labels,
+                help="fraction of the newest epoch's records completed")
+        g[tm.DATA_SHARDS_TODO].set(len(self.todo))
+        g[tm.DATA_SHARDS_DOING].set(len(self.doing))
+        g[tm.DATA_SHARDS_DONE].set(self._shards_done)
+        g[tm.DATA_EPOCH].set(self._splitter.epoch)
+        g[tm.DATA_EPOCH_PROGRESS].set(self.epoch_progress())
+
+    def retract_gauges(self):
+        """Drop this dataset's lifecycle gauges from the exposition
+        (dataset reset/unregistration — a gone dataset must not keep
+        exporting a frozen queue)."""
+        if not self._gauges_live:
+            return
+        labels = {"dataset": self.dataset_name}
+        for name in _LIFECYCLE_GAUGES:
+            self._reg.remove(name, labels=labels)
+        self._gauges.clear()
+        self._gauges_live = False
+
+    def node_stats(self) -> Dict[int, Dict]:
+        """Per-node consumption: shard/record counts, the observed
+        records/second, and the completion-window bounds the caller
+        needs to aggregate rates ACROSS datasets (rates over disjoint
+        windows are not additive — records over the union span are)."""
+        out: Dict[int, Dict] = {}
+        for nid, shards in self._node_shards.items():
+            records = self._node_records.get(nid, 0)
+            first = self._node_first_ts.get(nid, 0.0)
+            last = self._node_last_ts.get(nid, 0.0)
+            out[nid] = {
+                "shards_completed": shards,
+                "records_done": records,
+                "records_per_s": (
+                    round(records / (last - first), 1)
+                    if last > first else None),
+                "first_ts": first,
+                "last_ts": last,
+            }
+        return out
+
+    def snapshot(self) -> Dict:
+        """The per-dataset row of the ``tpurun data`` ledger."""
+        total = max(1, int(self._splitter.dataset_size))
+        done = self._epoch_records.get(self._splitter.epoch, 0)
+        remaining = total - done
+        # aggregate rate over the UNION of the nodes' completion
+        # windows (min first -> max last), the same rule data_report
+        # applies per node: per-node spans are not interchangeable —
+        # a late-joining node's short span would overstate the rate
+        # and quote an ETA several times too short
+        span = (
+            max(self._node_last_ts.values())
+            - min(self._node_first_ts.values())
+        ) if self._node_first_ts else 0.0
+        rate = self._records_done / span if span > 0 else None
+        return {
+            "todo": len(self.todo),
+            "doing": len(self.doing),
+            "shards_done": self._shards_done,
+            "records_done": self._records_done,
+            "dataset_size": int(self._splitter.dataset_size),
+            "epoch": self._splitter.epoch,
+            "num_epochs": int(getattr(self._splitter, "num_epochs", 1)),
+            "epoch_progress": round(self.epoch_progress(), 4),
+            "timeout_recovered": self._timeout_recovered,
+            "completed": self.completed(),
+            # remaining records of the newest epoch over the observed
+            # aggregate consumption rate (None before any completion)
+            "eta_s": (round(remaining / rate, 1)
+                      if rate and remaining > 0 else
+                      (0.0 if remaining <= 0 else None)),
+        }
 
     def recover_tasks(self, node_id: int):
         """Requeue every doing task of a dead worker."""
@@ -128,6 +345,7 @@ class BatchDatasetManager:
                 "dataset %s: recovered tasks %s of node %d",
                 self.dataset_name, requeued, node_id,
             )
+            self._refresh_gauges()
 
     def recover_timeout_tasks(self, timeout_secs: float) -> List[int]:
         now = time.time()
@@ -137,6 +355,9 @@ class BatchDatasetManager:
                 self.doing.pop(tid)
                 self.todo.appendleft(doing.task)
                 recovered.append(tid)
+        if recovered:
+            self._timeout_recovered += len(recovered)
+            self._refresh_gauges()
         return recovered
 
     def completed(self) -> bool:
@@ -149,7 +370,10 @@ class BatchDatasetManager:
     # -- checkpoint ---------------------------------------------------------
 
     def checkpoint(self) -> str:
-        """Serialize undone work: doing shards go back in front of todo."""
+        """Serialize undone work: doing shards go back in front of todo.
+        The shard-lifecycle accounting rides along so a restored master
+        resumes the ledger (gauges, epoch progress, ``tpurun data``)
+        instead of re-deriving it as zero."""
         shards = [
             [d.task.shard.start, d.task.shard.end]
             for d in self.doing.values()
@@ -158,6 +382,11 @@ class BatchDatasetManager:
             "dataset_name": self.dataset_name,
             "todo": shards,
             "epoch": self._splitter.epoch,
+            "shards_done": self._shards_done,
+            "records_done": self._records_done,
+            "epoch_records_done": self._epoch_records.get(
+                self._splitter.epoch, 0),
+            "timeout_recovered": self._timeout_recovered,
         })
 
     def restore_checkpoint(self, content: str):
@@ -170,6 +399,7 @@ class BatchDatasetManager:
         self._splitter.epoch = state.get("epoch", 0)
         self.todo.clear()
         self.doing.clear()
+        restored_records = 0
         for start, end in state.get("todo", []):
             self.todo.append(
                 Task(
@@ -179,8 +409,28 @@ class BatchDatasetManager:
                     epoch=self._splitter.epoch,
                 )
             )
+            restored_records += end - start
             self._task_id_seq += 1
+        self._shards_done = int(state.get("shards_done", 0))
+        self._records_done = int(state.get("records_done", 0))
+        # pre-accounting checkpoints lack the field: derive the epoch
+        # cursor from what is NOT pending (remaining records are the
+        # ground truth the restored gauges must agree with)
+        epoch_done = int(state.get(
+            "epoch_records_done",
+            max(0, int(self._splitter.dataset_size) - restored_records),
+        ))
+        self._epoch_records = {self._splitter.epoch: epoch_done}
+        self._epoch_outstanding = {self._splitter.epoch: len(self.todo)}
+        self._timeout_recovered = int(state.get("timeout_recovered", 0))
+        if self._shards_done or epoch_done:
+            # mid-epoch resume: dispatch already happened in the
+            # previous life, so the lifecycle gauges come back live
+            self._dispatch_started = True
+        self._refresh_gauges()
         logger.info(
-            "dataset %s: restored %d pending shards at epoch %d",
+            "dataset %s: restored %d pending shards at epoch %d "
+            "(%d records already done)",
             self.dataset_name, len(self.todo), self._splitter.epoch,
+            epoch_done,
         )

@@ -19,6 +19,12 @@ from dlrover_tpu.master.shard.batch_dataset_manager import (
     Task,
 )
 from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
 
 logger = get_logger("master.task")
 
@@ -67,7 +73,11 @@ class TaskManager:
 
     def reset_dataset(self, name: str):
         with self._lock:
-            self._datasets.pop(name, None)
+            dataset = self._datasets.pop(name, None)
+            if dataset is not None:
+                # a dropped dataset's lifecycle gauges must not keep
+                # exporting a frozen queue forever
+                dataset.retract_gauges()
 
     # -- dispatch -----------------------------------------------------------
 
@@ -132,18 +142,87 @@ class TaskManager:
         self._stop.set()
 
     def _monitor_timeout_tasks(self):
-        ctx = get_context()
-        while not self._stop.wait(30):
-            with self._lock:
-                for dataset in self._datasets.values():
-                    recovered = dataset.recover_timeout_tasks(
-                        ctx.seconds_to_timeout_task
-                    )
-                    if recovered:
-                        logger.warning(
-                            "dataset %s: tasks %s timed out and were "
-                            "requeued", dataset.dataset_name, recovered,
-                        )
+        while True:
+            # the scan cadence FOLLOWS the configured timeout (re-read
+            # each cycle): a test — or an operator chasing a straggler
+            # — that shrinks seconds_to_timeout_task to sub-second must
+            # not wait out a hardcoded 30 s sleep before the first scan
+            timeout_s = float(get_context().seconds_to_timeout_task)
+            cadence = max(0.5, min(30.0, timeout_s / 4.0))
+            if self._stop.wait(cadence):
+                return
+            self.scan_timeout_tasks_once(timeout_s)
+
+    def scan_timeout_tasks_once(self,
+                                timeout_secs: Optional[float] = None):
+        """One timeout sweep (the monitor thread's body, callable
+        directly from tests): requeue overdue doing shards, count them,
+        and put the recovery on the event timeline — re-dispatch means
+        the shard's records will be read twice, which operators must
+        be able to see, not infer from a log grep."""
+        if timeout_secs is None:
+            timeout_secs = float(get_context().seconds_to_timeout_task)
+        with self._lock:
+            for dataset in self._datasets.values():
+                recovered = dataset.recover_timeout_tasks(timeout_secs)
+                if not recovered:
+                    continue
+                get_registry().counter(
+                    tm.DATA_SHARDS_TIMEOUT_RECOVERED,
+                    help="doing shards requeued by the timeout monitor "
+                         "(each recovery re-reads the shard's records)",
+                ).inc(len(recovered))
+                emit_event(
+                    EventKind.DATA_SHARD_TIMEOUT,
+                    error_code="DATA_SHARD_TIMEOUT",
+                    dataset=dataset.dataset_name,
+                    count=len(recovered),
+                    task_ids=recovered[:8],
+                    timeout_secs=timeout_secs,
+                )
+                logger.warning(
+                    "dataset %s: tasks %s timed out and were "
+                    "requeued", dataset.dataset_name, recovered,
+                )
+
+    # -- the shard-dispatch ledger (tpurun data / DataShardRequest) ----------
+
+    def data_report(self, dataset_name: str = "") -> Dict:
+        """Per-dataset queue/epoch accounting plus per-node consumption
+        — the live ``tpurun data --addr`` payload."""
+        with self._lock:
+            names = ([dataset_name] if dataset_name
+                     else sorted(self._datasets))
+            datasets: Dict[str, Dict] = {}
+            nodes: Dict[int, Dict] = {}
+            for name in names:
+                dataset = self._datasets.get(name)
+                if dataset is None:
+                    continue
+                datasets[name] = dataset.snapshot()
+                for nid, stats in dataset.node_stats().items():
+                    agg = nodes.setdefault(nid, {
+                        "shards_completed": 0, "records_done": 0,
+                        "first_ts": stats["first_ts"],
+                        "last_ts": stats["last_ts"],
+                    })
+                    agg["shards_completed"] += stats["shards_completed"]
+                    agg["records_done"] += stats["records_done"]
+                    agg["first_ts"] = min(agg["first_ts"],
+                                          stats["first_ts"])
+                    agg["last_ts"] = max(agg["last_ts"],
+                                         stats["last_ts"])
+        for agg in nodes.values():
+            # rate over the UNION of the node's completion windows:
+            # per-dataset rates over disjoint windows are not additive
+            # (a node doing 100 rec/s on A then 100 rec/s on B never
+            # ran at 200/s)
+            span = agg.pop("last_ts") - agg.pop("first_ts")
+            agg["records_per_s"] = (
+                round(agg["records_done"] / span, 1)
+                if span > 0 else None)
+        return {"datasets": datasets,
+                "nodes": {str(n): v for n, v in sorted(nodes.items())}}
 
     # -- shard checkpoint ---------------------------------------------------
 

@@ -18,6 +18,11 @@
            memory-gate rejections — live (--addr), forensically from
            a timeline (--events), or measured device-time buckets
            from a jax.profiler trace (--trace)
+  data     the shard-dispatch & input-pipeline ledger: per-dataset
+           todo/doing/done queues, epoch progress + ETA, timeout
+           recoveries, per-node consumption rates — live (--addr,
+           DataShardRequest RPC) or forensically from a timeline's
+           DATA_* events (--events)
   events   pretty-print a timeline (newest last)
   metrics  dump Prometheus exposition: a live endpoint via --addr, or
            this process's registry (useful under ``tpurun metrics``)
@@ -102,6 +107,20 @@ def build_parser() -> argparse.ArgumentParser:
     at.add_argument("--limit", type=int, default=0,
                     help="only the last N memory-gate rejections")
     at.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
+    dt = sub.add_parser(
+        "data", help="shard-dispatch & input-pipeline ledger: "
+                     "todo/doing/done queues, epoch progress, "
+                     "per-node consumption, timeout recoveries")
+    dt.add_argument("--addr", default="",
+                    help="query a live master at host:port")
+    dt.add_argument("--events", default="",
+                    help="derive forensically from a timeline JSONL "
+                         "(default: the configured events sink)")
+    dt.add_argument("--dataset", default="",
+                    help="only this dataset ('' = all)")
+    dt.add_argument("--json", action="store_true",
                     help="machine-readable output")
 
     ev = sub.add_parser("events", help="print a timeline")
@@ -223,6 +242,7 @@ def _cmd_diagnose(args) -> int:
 
 def _cmd_plan(args) -> int:
     """Live (master RPC) or forensic (timeline) optimizer trail."""
+    from dlrover_tpu.telemetry.names import EventKind
     if args.addr:
         from dlrover_tpu.agent.master_client import MasterClient
 
@@ -302,7 +322,26 @@ def _cmd_plan(args) -> int:
         if p.get("realized_speedup") is not None:
             line += f", realized {p.get('realized_speedup')}x"
         print(line)
-    if not (report.get("decisions") or report.get("plans")):
+    # forensic view: rejected passes carry no plan id, so they never
+    # join the per-plan rows — but a rejection IS a decision (the
+    # input-bound/memory gates exist to be read), so render the trail's
+    # rejection records too
+    rejected = [
+        r for r in (report.get("trail") or [])
+        if r.get("kind") == EventKind.OPTIMIZER_PLAN_REJECTED
+    ]
+    for r in rejected:
+        line = (f"[{r.get('trace_id', '')}] {r.get('trigger', '')}: "
+                f"rejected ({r.get('reason')})")
+        if r.get("input_bound_node") is not None:
+            line += (f" node={r.get('input_bound_node')} "
+                     f"input_wait={r.get('input_wait_frac')}")
+            if r.get("peer_median_input_wait_frac") is not None:
+                line += (" vs peer median "
+                         f"{r.get('peer_median_input_wait_frac')}")
+        print(line)
+    if not (report.get("decisions") or report.get("plans")
+            or rejected):
         print("plan: no optimizer decisions recorded")
     return 0
 
@@ -408,11 +447,113 @@ def _cmd_attribution(args) -> int:
     return 0
 
 
+def _cmd_data(args) -> int:
+    """Live (master RPC) or forensic (timeline DATA_* events) shard
+    ledger. Both views quote the same shard counts — the tier-1 CLI
+    gate pins their agreement on a completed dataset."""
+    if args.addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(args.addr)
+        try:
+            report = client.get_data_report(dataset_name=args.dataset)
+        finally:
+            client.close()
+        report["source"] = args.addr
+    else:
+        from dlrover_tpu.telemetry import events as events_mod
+        from dlrover_tpu.telemetry.names import EventKind
+
+        path = _resolve_events_path(args.events)
+        if not path:
+            print("data: no master --addr and no timeline (pass "
+                  "--events or set DLROVER_TPU_EVENTS_FILE)",
+                  file=sys.stderr)
+            return 2
+        records = events_mod.read_events(path)
+        # the newest DATA_EPOCH_END per dataset carries the cumulative
+        # accounting; timeout events accumulate per dataset
+        datasets = {}
+        timeouts = []
+        for rec in records:
+            kind = rec.get("kind", "")
+            name = rec.get("dataset", "")
+            if args.dataset and name != args.dataset:
+                continue
+            if kind == EventKind.DATA_EPOCH_END:
+                datasets[name] = {
+                    "shards_done": rec.get("shards_done"),
+                    "records_done": rec.get("records_done"),
+                    "epoch": rec.get("epoch"),
+                    "timeout_recovered": rec.get(
+                        "timeout_recovered", 0),
+                    "completed": bool(rec.get("final")),
+                    "ts": rec.get("ts"),
+                }
+            elif kind == EventKind.DATA_SHARD_TIMEOUT:
+                timeouts.append({
+                    "dataset": name, "ts": rec.get("ts"),
+                    "count": rec.get("count"),
+                    "task_ids": rec.get("task_ids"),
+                    "trace_id": rec.get("trace_id", ""),
+                })
+        report = {
+            "source": path,
+            "events": len(records),
+            "datasets": datasets,
+            "timeouts": timeouts,
+        }
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    for name, d in sorted((report.get("datasets") or {}).items()):
+        line = (f"dataset {name}: todo={d.get('todo', '-')} "
+                f"doing={d.get('doing', '-')} "
+                f"done={d.get('shards_done')} shards "
+                f"({d.get('records_done')} records) "
+                f"epoch={d.get('epoch')}")
+        if d.get("epoch_progress") is not None:
+            line += f" progress={round(d['epoch_progress'] * 100, 1)}%"
+        if d.get("eta_s") is not None:
+            line += f" eta={d['eta_s']}s"
+        if d.get("timeout_recovered"):
+            line += f" timeout_recovered={d['timeout_recovered']}"
+        if d.get("completed"):
+            line += " COMPLETED"
+        print(line)
+    def _node_order(item):
+        # node ids arrive as strings over JSON: sort numerically so a
+        # 10+-node cluster doesn't print 0, 1, 10, 11, 2, ...
+        try:
+            return (0, int(item[0]))
+        except (TypeError, ValueError):
+            return (1, item[0])
+
+    for node_id, stats in sorted((report.get("nodes") or {}).items(),
+                                 key=_node_order):
+        rate = stats.get("records_per_s")
+        print(f"node {node_id}: shards={stats.get('shards_completed')} "
+              f"records={stats.get('records_done')} "
+              f"rate={rate if rate is not None else '-'}/s")
+    for t in report.get("timeouts") or []:
+        print(f"TIMEOUT dataset={t.get('dataset')}: "
+              f"{t.get('count')} shard(s) requeued "
+              f"(tasks {t.get('task_ids')}) [{t.get('trace_id', '')}]")
+    if not (report.get("datasets") or report.get("nodes")
+            or report.get("timeouts")):
+        print("data: no shard-dispatch records (no dataset registered, "
+              "or no DATA_* events in the timeline)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.cmd == "plan":
         return _cmd_plan(args)
+
+    if args.cmd == "data":
+        return _cmd_data(args)
 
     if args.cmd == "attribution":
         return _cmd_attribution(args)

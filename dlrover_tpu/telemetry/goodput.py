@@ -170,6 +170,41 @@ def _model_flops_column(ordered: List[Dict],
     }
 
 
+def _input_wait_column(ordered: List[Dict],
+                       productive_s: float) -> Optional[Dict]:
+    """The input-wait goodput column: host seconds the workers spent
+    blocked waiting for the next batch, summed from the ``input_wait_s``
+    field executors stamp on TRAIN_END. A COLUMN, not a wall bucket —
+    the wait overlaps the productive train span (the device sits idle
+    inside a step window), so it reports how much of the productive
+    time was hollow rather than re-partitioning the wall clock. None
+    when no record carries the field (old timelines, telemetry off)."""
+    total = 0.0
+    workers = set()
+    seen = False
+    for rec in ordered:
+        if rec.get("kind") != EventKind.TRAIN_END:
+            continue
+        wait = rec.get("input_wait_s")
+        if wait is None:
+            continue
+        try:
+            total += float(wait)
+        except (TypeError, ValueError):
+            continue
+        seen = True
+        workers.add((str(rec.get("node", "")), rec.get("pid", 0)))
+    if not seen:
+        return None
+    return {
+        "seconds": round(total, 3),
+        "workers": len(workers),
+        "fraction_of_productive": (
+            round(total / productive_s, 4) if productive_s > 0 else 0.0
+        ),
+    }
+
+
 def derive_goodput(events: List[Dict]) -> Dict:
     """The ledger: bucket seconds + fractions over the timeline's wall
     clock (empty report when fewer than two timestamped events)."""
@@ -267,6 +302,11 @@ def derive_goodput(events: List[Dict]) -> Dict:
     model_flops = _model_flops_column(ordered, productive)
     if model_flops is not None:
         detail["model_flops"] = model_flops
+    # input-wait column: only when a TRAIN_END carried the measurement
+    # (absent-not-zero, like the columns above)
+    input_wait = _input_wait_column(ordered, productive)
+    if input_wait is not None:
+        detail["input_wait"] = input_wait
     return {
         "metric": "goodput_fraction",
         "value": round(productive / wall, 4),

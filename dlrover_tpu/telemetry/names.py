@@ -165,6 +165,57 @@ NODE_FLOPS_PER_STEP = "dlrover_node_flops_per_step"
 NODE_PEAK_HBM_MB = "dlrover_node_compiled_peak_hbm_mb"
 NODE_HBM_HEADROOM_MB = "dlrover_node_hbm_headroom_mb"
 
+# -- data plane (shard dispatch & input pipeline) -----------------------------
+# Worker side instruments the path batch data takes to the device
+# (sharding client RPCs, the H2D prefetcher, the executor's wait for
+# the next host batch); master side accounts the shard queues. The
+# derived INPUT_WAIT_FRAC / NODE_INPUT_WAIT_FRAC gauges follow the
+# absent-not-zero discipline of ATTR_MFU: no gauge exists before the
+# first measured window, and per-dataset shard gauges exist only
+# between the first dispatched shard and dataset completion.
+
+# worker-side: ShardingClient (the master's todo/doing window)
+DATA_SHARD_FETCH_TIME = "dlrover_data_shard_fetch_seconds"
+DATA_SHARDS_FETCHED = "dlrover_data_shards_fetched_total"
+DATA_SHARDS_COMPLETED = "dlrover_data_shards_completed_total"
+# batch-done credits whose RPC failed and were re-queued for the next
+# report (the credit is restored, never silently dropped)
+DATA_BATCH_REPORT_RETRIES = "dlrover_data_batch_report_retries_total"
+# worker-side: the H2D prefetcher (DevicePreloader / DevicePrefetcher)
+DATA_PREFETCH_QUEUE_DEPTH = "dlrover_data_prefetch_queue_depth"
+# producer wait: the pump blocked handing a ready batch to a full
+# queue (consumer-slow — the healthy direction)
+DATA_PRODUCER_WAIT_TIME = "dlrover_data_producer_wait_seconds"
+# consumer wait: the train loop blocked on an empty prefetch queue
+# (producer-slow — the input-bound direction)
+DATA_CONSUMER_WAIT_TIME = "dlrover_data_consumer_wait_seconds"
+# worker-side: executor host time blocked fetching the next batch
+INPUT_WAIT_TIME = "dlrover_input_wait_seconds"
+# fraction of the last materialization window spent waiting on input
+# (absent until the first measured window — never a fake 0)
+INPUT_WAIT_FRAC = "dlrover_input_wait_fraction"
+
+# master-side shard lifecycle, labeled {dataset="<name>"} — created at
+# the first dispatched shard, retracted when the dataset completes
+DATA_SHARDS_TODO = "dlrover_data_shards_todo"
+DATA_SHARDS_DOING = "dlrover_data_shards_doing"
+DATA_SHARDS_DONE = "dlrover_data_shards_done"
+DATA_EPOCH = "dlrover_data_epoch"
+DATA_EPOCH_PROGRESS = "dlrover_data_epoch_progress"
+# dispatch -> completion wall seconds of one shard
+DATA_SHARD_LATENCY = "dlrover_data_shard_latency_seconds"
+# shards requeued by the timeout monitor (straggler mitigation — each
+# recovery risks duplicate data, so it is counted and evented)
+DATA_SHARDS_TIMEOUT_RECOVERED = (
+    "dlrover_data_shards_timeout_recovered_total"
+)
+# master-side per-node consumption, labeled {node="<id>"}
+DATA_NODE_SHARDS_COMPLETED = "dlrover_data_node_shards_completed_total"
+DATA_NODE_RECORDS_DONE = "dlrover_data_node_records_done_total"
+# master-side per-node mirror of the worker's input-wait fraction
+# (rides NodeRuntimeReport like NODE_MFU; absent until measured)
+NODE_INPUT_WAIT_FRAC = "dlrover_node_input_wait_fraction"
+
 
 class EventKind:
     """Event-timeline record kinds (``telemetry.events``). Failure-edge
@@ -237,6 +288,14 @@ class EventKind:
     # captured through the AOT path and keyed by the program cache —
     # the forensic source of `tpurun attribution --events`
     ATTRIBUTION_CAPTURED = "attribution_captured"
+    # data plane: the master's timeout monitor requeued doing shards
+    # of a slow/dead worker (failure-class: the shard will be re-read
+    # — duplicate data risk — so the edge carries an error code), and
+    # a dataset's epoch drained (todo and doing both empty; carries
+    # the cumulative shard/record accounting — the forensic source of
+    # `tpurun data --events`)
+    DATA_SHARD_TIMEOUT = "data_shard_timeout"
+    DATA_EPOCH_END = "data_epoch_end"
 
 
 class SpanName:

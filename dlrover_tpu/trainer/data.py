@@ -16,11 +16,13 @@ static partitioning entirely — faster hosts pull more shards.
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import get_registry, names as tm
 
 logger = get_logger("trainer.data")
 
@@ -243,6 +245,24 @@ class DevicePreloader:
         self._steps_per_call = int(steps_per_call)
         self._put_fn = put_fn
         self._background = background
+        # data-plane instruments (null handles when telemetry is off).
+        # Queue depth is the prefetcher's health gauge: pinned at 0 the
+        # producer can't keep up (input-bound); pinned at `prefetch`
+        # the consumer is the bottleneck (healthy). The wait histograms
+        # split the same story by direction.
+        reg = get_registry()
+        self._g_depth = reg.gauge(
+            tm.DATA_PREFETCH_QUEUE_DEPTH,
+            help="ready batches in the H2D prefetch queue")
+        self._h_producer_wait = reg.histogram(
+            tm.DATA_PRODUCER_WAIT_TIME,
+            help="producer-side wait per batch (foreground: host time "
+                 "producing + issuing the next transfer; background: "
+                 "time blocked handing a ready batch to a full queue)")
+        self._h_consumer_wait = reg.histogram(
+            tm.DATA_CONSUMER_WAIT_TIME,
+            help="consumer time blocked on an empty prefetch queue "
+                 "(the input-bound direction)")
         # background-mode pump state, created ONCE on first iteration:
         # re-entering __iter__ (the executor's restart path) must resume
         # draining the same queue — a second pump racing the first over
@@ -301,10 +321,16 @@ class DevicePreloader:
             pass
         while queue:
             out = queue.popleft()
+            t0 = time.monotonic()
             try:
                 queue.append(self._put(next(it)))
             except StopIteration:
                 pass
+            # foreground mode serializes production with the consumer:
+            # this IS the consumer's per-batch input cost (device_put
+            # itself is async — the wait is host-side batch assembly)
+            self._h_producer_wait.observe(time.monotonic() - t0)
+            self._g_depth.set(len(queue))
             yield out
 
     def _background_iter(self):
@@ -322,7 +348,13 @@ class DevicePreloader:
             def pump():
                 try:
                     for b in self._host_items():
-                        self._bg_queue.put(self._put(b))
+                        item = self._put(b)
+                        t0 = time.monotonic()
+                        self._bg_queue.put(item)
+                        # time blocked on a FULL queue: the consumer is
+                        # slower than the pipeline — the healthy shape
+                        self._h_producer_wait.observe(
+                            time.monotonic() - t0)
                 except BaseException as e:  # surface in the consumer
                     logger.warning(
                         "prefetch pump failed (%s); re-raising in the "
@@ -334,7 +366,12 @@ class DevicePreloader:
 
             threading.Thread(target=pump, daemon=True).start()
         while not self._bg_exhausted:
+            t0 = time.monotonic()
             item = self._bg_queue.get()
+            # time blocked on an EMPTY queue: the producer is the
+            # bottleneck — the input-bound direction
+            self._h_consumer_wait.observe(time.monotonic() - t0)
+            self._g_depth.set(self._bg_queue.qsize())
             if item is self._bg_done:
                 self._bg_exhausted = True
                 break

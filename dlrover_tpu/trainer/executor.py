@@ -77,15 +77,23 @@ class TrainHook:
 
 class ElasticDataShardReportHook(TrainHook):
     """Report consumed batches so the master completes shards
-    (reference hooks.py:97 ``ElasticDataShardReportHook``)."""
+    (reference hooks.py:97 ``ElasticDataShardReportHook``).
 
-    def __init__(self, sharding_client, batch_size: int):
+    One BATCH credit per materialized step: ``report_batch_done``
+    takes a batch COUNT and multiplies by the client's own batch size
+    — passing ``batch_size`` as the count (the old behavior) credited
+    ``batch_size²`` records per step, completing shards the worker had
+    not actually read and desyncing the master's ledger from reality.
+    ``batch_size`` stays accepted for call-site compatibility but the
+    client owns the records conversion."""
+
+    def __init__(self, sharding_client, batch_size: int = 0):
         self._client = sharding_client
-        self._batch_size = batch_size
+        self._batch_size = batch_size  # informational only
 
     def after_step(self, step: int, metrics: Dict[str, Any]):
         try:
-            self._client.report_batch_done(self._batch_size)
+            self._client.report_batch_done(1)
         except Exception:  # noqa: BLE001 — reporting must not kill training
             logger.exception("shard report failed")
 
@@ -274,6 +282,11 @@ class NodeRuntimeReportHook(TrainHook):
                 tm.ATTR_EXPOSED_COMM_FRAC),
             flops_per_step=self._gauge_value(tm.ATTR_FLOPS_PER_STEP),
             peak_hbm_mb=self._gauge_value(tm.ATTR_PEAK_HBM_MB),
+            # data plane: the executor's derived input-wait fraction
+            # (absent until the first measured window, like the
+            # attribution gauges — the master exports it per node only
+            # when it exists)
+            input_wait_frac=self._gauge_value(tm.INPUT_WAIT_FRAC),
         )
         if self._sender is None or not self._sender.is_alive():
             self._sender = threading.Thread(
@@ -529,6 +542,21 @@ class TrainExecutor:
             tm.PREEMPT_NOTICES, help="preemption notices received")
         self._h_eval = reg.histogram(
             tm.EVAL_TIME, help="eval_fn wall time")
+        # data plane: host time blocked in next(data_iter) fetching the
+        # batch for a dispatch. The derived INPUT_WAIT_FRAC gauge is
+        # created lazily at the first MEASURED materialization window
+        # (absent-not-zero, same discipline as ATTR_MFU) and rides
+        # NodeRuntimeReport into the master's per-node series — the
+        # third leg of the bound triad (input/comm/compute).
+        self._h_input_wait = reg.histogram(
+            tm.INPUT_WAIT_TIME,
+            help="host time blocked waiting for the next host batch")
+        self._g_input_wait: Optional[Any] = None
+        self._input_wait_total = 0.0
+        self._input_wait_count = 0
+        self._input_wait_mark = 0.0
+        self._input_wait_count_mark = 0
+        self._input_wait_run_start = 0.0
         # newest dispatched (not yet necessarily materialized) step —
         # the minuend of the lagged-metric age
         self._dispatched_step = 0
@@ -1178,6 +1206,43 @@ class TrainExecutor:
             0.0 if frac < 0.0 else (1.0 if frac > 1.0 else frac)
         )
 
+    def _observe_input_wait(self, window_s: float):
+        """Derive the input-wait fraction of the just-closed
+        materialization window: batch-fetch seconds accumulated since
+        the previous materialization over the window's wall time. With
+        a deep dispatch window the fetches belong to NEWER steps than
+        the one materializing — the fraction is a windowed average that
+        converges over a report window, which is exactly the
+        granularity the node series diffs at. Cost: one subtraction,
+        one division, one gauge store."""
+        waited = self._input_wait_total - self._input_wait_mark
+        fetches = self._input_wait_count - self._input_wait_count_mark
+        self._input_wait_mark = self._input_wait_total
+        self._input_wait_count_mark = self._input_wait_count
+        if window_s <= 0 or self._input_wait_count == 0:
+            # nothing measured yet: the gauge must stay ABSENT — a
+            # scrape must never read a fake 0 for an unmeasured window
+            return
+        if fetches == 0:
+            # a window with NO batch fetch (the drain's tail: queued
+            # dispatches materialize back-to-back) says nothing about
+            # the input pipeline — overwriting the gauge with its 0/0
+            # would erase the measurement the last real window made
+            return
+        if self._g_input_wait is None:
+            self._g_input_wait = get_registry().gauge(
+                tm.INPUT_WAIT_FRAC,
+                help="fraction of the last materialization window the "
+                     "host spent blocked waiting for the next batch")
+        frac = waited / window_s
+        # .set(), never a raw .value store: a telemetry toggle between
+        # construction and here lands the lazy creation on the shared
+        # null-metric singleton (same invariant as the attribution
+        # gauges)
+        self._g_input_wait.set(
+            0.0 if frac < 0.0 else (1.0 if frac > 1.0 else frac)
+        )
+
     def _report_step_reset(self):
         """Tell the master the true global step REWOUND (rollback / live
         reshard) so ``SpeedMonitor.reset_step`` unpins the monotone
@@ -1306,10 +1371,19 @@ class TrainExecutor:
     def _take_batches(self, data_iter: Iterator, n: int) -> List[Any]:
         out: List[Any] = []
         for _ in range(n):
+            t0 = time.monotonic()
             try:
-                out.append(next(data_iter))
+                batch = next(data_iter)
             except StopIteration:
                 break
+            # the input-wait clock: with the dispatch window keeping
+            # the device busy, host time spent here is the data
+            # pipeline failing to stay ahead of the accelerator
+            waited = time.monotonic() - t0
+            self._input_wait_total += waited
+            self._input_wait_count += 1
+            self._h_input_wait.observe(waited)
+            out.append(batch)
         return out
 
     def _materialize_oldest(self, handle_nonfinite: bool = True) -> bool:
@@ -1347,10 +1421,12 @@ class TrainExecutor:
         # per-step wall time: the interval since the previous
         # materialization, amortized over the steps this call carried
         # (exact for K=1; the group average for a fused K-step call)
-        per_step = (now - self._last_materialize) / max(entry.count, 1)
+        window_s = now - self._last_materialize
+        per_step = window_s / max(entry.count, 1)
         self._last_materialize = now
         self._g_lag.set(self._dispatched_step - entry.last_step)
         self._observe_attribution(per_step)
+        self._observe_input_wait(window_s)
         touch_heartbeat()
         stacked = entry.count > 1
         for i in range(entry.count):
@@ -1456,6 +1532,9 @@ class TrainExecutor:
         self._last_eval_step = -1
         self._dispatched_step = step
         self._window.clear()
+        self._input_wait_mark = self._input_wait_total
+        self._input_wait_count_mark = self._input_wait_count
+        self._input_wait_run_start = self._input_wait_total
         self._train_started_mono = time.monotonic()
         emit_event(EventKind.TRAIN_START, step=step,
                    train_window=self._train_window,
@@ -1705,7 +1784,13 @@ class TrainExecutor:
             if self._on_nonfinite == "halt":
                 raise NonFiniteLossError(f"final step non-finite: {detail}")
         self._trainer.finalize()
-        emit_event(EventKind.TRAIN_END, step=step)
+        # the run's total input-wait seconds ride the TRAIN_END record:
+        # the goodput ledger's input-wait column sums these per worker
+        # (a column, not a wall bucket — the wait overlaps train spans)
+        emit_event(EventKind.TRAIN_END, step=step,
+                   input_wait_s=round(
+                       self._input_wait_total
+                       - self._input_wait_run_start, 3))
         for hook in self._hooks:
             hook.end(self)
         return {"step": step, **self.eval_metrics}

@@ -158,12 +158,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return lint_main(argv[1:])
     if argv and argv[0] in ("metrics", "mttr", "goodput", "diagnose",
-                            "plan", "attribution", "events", "trace",
-                            "cache"):
+                            "plan", "attribution", "data", "events",
+                            "trace", "cache"):
         # `tpurun metrics [--addr host:port]` / `tpurun mttr ...` /
         # `tpurun goodput` / `tpurun diagnose` / `tpurun plan` /
-        # `tpurun attribution` / `tpurun cache` — the observability
-        # CLI (docs/observability.md)
+        # `tpurun attribution` / `tpurun data` / `tpurun cache` — the
+        # observability CLI (docs/observability.md)
         from dlrover_tpu.telemetry.cli import main as telemetry_main
 
         return telemetry_main(argv)

@@ -130,7 +130,14 @@ class DevicePrefetcher(DevicePreloader):
     """Overlap host->device transfer with compute: keeps ``depth`` batches
     in flight via ``put_fn`` (async ``jax.device_put``) on a background
     thread — the shm-path face of the ONE sharding-aware prefetcher
-    (``trainer.data.DevicePreloader`` in background mode)."""
+    (``trainer.data.DevicePreloader`` in background mode).
+
+    Inherits the base's data-plane instruments: the
+    ``dlrover_data_prefetch_queue_depth`` gauge plus the
+    producer/consumer wait histograms (docs/data_pipeline.md), so a
+    coworker ring that stops keeping up shows as consumer-wait time
+    and a depth pinned at 0 — the input-bound signature — without any
+    shm-specific hooks."""
 
     def __init__(self, batches: Iterator[Any], put_fn: Callable[[Any], Any],
                  depth: int = 2):

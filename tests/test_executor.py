@@ -397,7 +397,10 @@ class TestTrainExecutor:
             conf=Configuration({"train_steps": 4, "log_every_steps": 0}),
         )
         executor.train_and_evaluate()
-        assert shard_client.batches == 4 * 16
+        # one BATCH credit per materialized step (the client converts
+        # to records itself — crediting batch_size per step would
+        # over-complete shards batch_size-fold on the master)
+        assert shard_client.batches == 4
         assert master.global_steps == [2, 4]
         assert len(master.model_infos) == 1
 
@@ -614,4 +617,4 @@ class TestDispatchWindow:
             results[window] = (shard_client.batches,
                                master.global_steps,
                                len(master.model_infos))
-        assert results[0] == results[4] == (4 * 16, [2, 4], 1)
+        assert results[0] == results[4] == (4, [2, 4], 1)
