@@ -148,6 +148,7 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
     # the kernel): real timing on TPU; on a multi-device CPU mesh it
     # runs in interpret mode — wiring proof, interpreter milliseconds
     t_ep, ep_interpret, ep_degree = None, on_cpu, 0
+    t_ep_chunked, chunks = None, 4
     mesh = _ep_mesh()
     if mesh is not None and e % mesh.devices.size == 0 \
             and (b * s) % mesh.devices.size == 0:
@@ -157,6 +158,19 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
                      kernel_interpret=True if on_cpu else None),
             params, x,
         )
+        # the paired OVERLAP leg (ISSUE 10): same exchange split into
+        # dispatch_chunks ppermute-ring chunks, double-buffered under
+        # the grouped GEMMs. Same rows on the wire, same outputs —
+        # on TPU the ratio vs the one-shot row above is the overlap
+        # win; on the CPU mesh it is interpreter milliseconds (labeled)
+        n_rows = (b * s) // mesh.devices.size * k
+        if n_rows % chunks == 0:
+            t_ep_chunked = _time_step(
+                moe_loss("grouped_ep", ep_axes=("expert",), mesh=mesh,
+                         kernel_interpret=True if on_cpu else None,
+                         dispatch_chunks=chunks),
+                params, x,
+            )
     return {
         "config": {"batch": b, "seq": s, "d_model": d, "d_ff": f,
                    "experts": e, "top_k": k},
@@ -178,6 +192,15 @@ def bench_config(b, s, d, f, e, k, dtype=jnp.bfloat16):
             # True = Pallas interpreter on the CPU mesh: wiring proof
             # only, NOT comparable to hardware rows
             "grouped_ep_interpret": bool(ep_interpret),
+        }),
+        **({} if t_ep_chunked is None else {
+            # the paired overlap-on leg (dispatch_chunks ppermute
+            # ring); the overlap RATIO is a hardware number — on the
+            # CPU mesh both legs measure the interpreter (labeled via
+            # grouped_ep_interpret above)
+            "moe_grouped_ep_chunked_ms": round(t_ep_chunked * 1e3, 3),
+            "grouped_ep_dispatch_chunks": chunks,
+            "grouped_ep_overlap_ratio": round(t_ep / t_ep_chunked, 3),
         }),
     }
 

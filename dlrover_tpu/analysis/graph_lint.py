@@ -22,6 +22,8 @@ Rule ids:
   G105 donation not applied to the train state
   G106 actual HLO collective bytes vs ``planner.predicted_collective_bytes``
   G107 compiled peak HBM above the configured per-device budget
+  G108 serialized large collective: result consumed with no independent
+       compute scheduled between issue and use (an overlap opportunity)
 
 Every check is a pure function over lowered/compiled text so the AOT CLI
 (``parallel.aot --lint``) and golden-fixture tests reuse them without
@@ -41,7 +43,7 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger("analysis.graph")
 
 ALL_GRAPH_RULES = ("G101", "G102", "G103", "G104", "G105", "G106",
-                   "G107")
+                   "G107", "G108")
 
 GRAPH_RULE_DOCS: Dict[str, str] = {
     "G101": "params the strategy shards are replicated in the compiled "
@@ -57,7 +59,22 @@ GRAPH_RULE_DOCS: Dict[str, str] = {
             "predicted collective bytes beyond tolerance",
     "G107": "compiled peak HBM residency exceeds the configured "
             "per-device budget",
+    "G108": "a large collective's result is consumed with no "
+            "independent compute between issue and use — the network "
+            "sits on the critical path (overlap opportunity)",
 }
+
+# G108: collectives below this output size are not worth overlapping
+# (latency-bound, not bandwidth-bound) — and the CPU-mesh test fixtures
+# all sit far below it, so the rule stays clean on HEAD while firing on
+# real serial exchanges (the committed fixture is sized above it).
+G108_MIN_BYTES = 1 << 20
+
+# ops that count as INDEPENDENT work the scheduler could have run under
+# an in-flight collective: fused compute, bare dots/convs, kernels
+# (custom-call), and counted loops (which contain compute)
+_G108_COMPUTE_OPS = ("fusion", "dot", "convolution", "custom-call",
+                     "while")
 
 # Default G106 tolerance (ratio, symmetric in log space). Chosen as one
 # power of two above the worst measured-vs-predicted ratio observed on
@@ -114,6 +131,24 @@ def _shapes_bytes(fragment: str) -> int:
                 n *= int(d)
         total += n * dt
     return total
+
+
+def _max_shape_bytes(fragment: str) -> int:
+    """Bytes of the LARGEST single ``dtype[dims]`` shape in an HLO
+    fragment — the payload estimate for async ``-start`` ops, whose
+    tuple shape carries BOTH the operand and result buffers (summing
+    the members would double-count the traffic)."""
+    best = 0
+    for m in re.finditer(r"\b(\w+)\[([\d,]*)\]", fragment):
+        dt = _DTYPE_BYTES.get(m.group(1))
+        if dt is None:
+            continue
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n * dt)
+    return best
 
 
 def _computations(optimized_hlo: str) -> Dict[str, str]:
@@ -430,6 +465,72 @@ def collective_audit(measured_total: float, predicted_total: float,
     )]
 
 
+def check_serialized_collectives(
+    optimized_hlo: str,
+    path: str = "<train_step>",
+    min_bytes: int = G108_MIN_BYTES,
+    max_findings: int = 4,
+) -> List[Finding]:
+    """G108: a large collective whose result is consumed with NO
+    independent compute between issue and first use — the op-order
+    rendering of "the network sits on the critical path". The compiled
+    HLO's textual op order follows the schedule (def before use), so
+    zero compute ops between a collective (or its ``-start``) and the
+    first line referencing its result means the scheduler had nothing
+    to hide the exchange under: a chunked/double-buffered formulation
+    (``ops.moe`` dispatch_chunks, the FSDP layer prefetch) is the fix
+    this tree ships. Collectives under ``min_bytes`` are skipped —
+    latency-bound traffic isn't worth restructuring, and the tolerance
+    keeps the rule clean on the CPU-mesh fixtures."""
+    findings: List[Finding] = []
+    op_re = re.compile(r"^\s*(%?[\w.\-]+) = (.+?) ([\w\-]+)\(")
+    for comp_name, body in _computations(optimized_hlo).items():
+        lines = body.splitlines()
+        parsed = [op_re.match(ln) for ln in lines]
+        for i, m in enumerate(parsed):
+            if m is None:
+                continue
+            name, shape, opcode = m.group(1), m.group(2), m.group(3)
+            is_start = opcode.endswith("-start")
+            base = opcode[:-len("-start")] if is_start else opcode
+            if base not in _COLLECTIVE_KINDS or opcode.endswith("-done"):
+                continue
+            # a -start op's tuple shape holds operand AND result
+            # buffers: size by the largest member, not the sum
+            nbytes = (_max_shape_bytes(shape) if is_start
+                      else _shapes_bytes(shape))
+            if nbytes < min_bytes:
+                continue
+            token = re.compile(re.escape(name) + r"\b")
+            independent = 0
+            use_line = None
+            for j in range(i + 1, len(lines)):
+                if token.search(lines[j]):
+                    use_line = j
+                    break
+                pj = parsed[j]
+                if pj is not None and pj.group(3) in _G108_COMPUTE_OPS:
+                    independent += 1
+            if use_line is None or independent > 0:
+                continue
+            findings.append(Finding(
+                rule_id="G108", path=path, line=0,
+                message=f"{base} ({nbytes / 1e6:.1f} MB, {name} in "
+                        f"{comp_name}) is consumed immediately — no "
+                        f"independent compute between issue and use, "
+                        f"so the exchange sits fully exposed on the "
+                        f"critical path",
+                fixit="restructure for overlap: chunk the exchange and "
+                      "double-buffer it under compute (ops/moe.py "
+                      "dispatch_chunks, the ops/ring.py ppermute ring) "
+                      "or prefetch the gather a layer ahead "
+                      "(fsdp_prefetch)",
+            ))
+            if len(findings) >= max_findings:
+                return findings
+    return findings
+
+
 def check_memory_budget(peak_hbm_bytes: float, hbm_budget_bytes: float,
                         path: str = "<train_step>") -> List[Finding]:
     """G107: the compiled program's peak HBM (``memory_analysis``:
@@ -553,6 +654,8 @@ def lint_artifacts(
     if "G107" in on:
         f.extend(check_memory_budget(peak_hbm_bytes, hbm_budget_bytes,
                                      path=label))
+    if "G108" in on and optimized_hlo:
+        f.extend(check_serialized_collectives(optimized_hlo, path=label))
     return report
 
 
@@ -707,7 +810,15 @@ def moe_dispatch_audit(
     """The acceptance audit: compile tiny MoE models for every dispatch
     and check each compiled program's collective bytes against the
     planner terms (``moe_disp_*`` et al.) — cost-model drift on ANY
-    dispatch fails the lint."""
+    dispatch fails the lint.
+
+    The "einsum" REFERENCE ORACLE is exempt from G108: its one-hot
+    [T,E,C] capacity movement is serialized by construction (GSPMD
+    all-gathers consumed straight into the dispatch einsums) and the
+    planner already prices it as quadratic COMPUTE, not comm — it
+    exists to test against, never to run. G108's job is keeping the
+    production paths (grouped_ep's chunked exchange, the fsdp
+    gathers) overlapped; those stay fully covered."""
     from dlrover_tpu.models import llama
 
     reports = []
@@ -715,9 +826,15 @@ def moe_dispatch_audit(
         config = llama.llama_tiny(
             num_experts=num_experts, moe_dispatch=dispatch
         )
+        dispatch_rules = rules
+        if dispatch == "einsum":
+            dispatch_rules = (
+                set(rules) if rules is not None else set(
+                    ALL_GRAPH_RULES)
+            ) - {"G108"}
         reports.append(lint_train_step(
             config,
-            rules=rules,
+            rules=dispatch_rules,
             audit_tol=audit_tol,
             label=f"llama_tiny_moe[{dispatch}]",
         ))

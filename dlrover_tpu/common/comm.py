@@ -382,6 +382,12 @@ class ModelInfo:
     hidden_size: int = 0
     num_layers: int = 0
     seq_len: int = 0
+    # MoE shape: lets the runtime optimizer's calibrated ModelSpec
+    # price the dispatch-comm terms (and enumerate dispatch_chunks)
+    # instead of seeing a dense model
+    num_experts: int = 0
+    moe_top_k: int = 1
+    ffn_mult: float = 0.0  # intermediate/hidden (0 = spec default)
 
 
 @message
@@ -404,6 +410,10 @@ class ParallelConfig:
     train_window: int = -1
     steps_per_call: int = 0
     moe_dispatch: str = ""
+    # grouped_ep chunked dispatch degree (0 = leave unchanged): a
+    # COMPILED-program knob, applied through the same prewarmed
+    # program-cache swap as steps_per_call / mesh overrides
+    dispatch_chunks: int = 0
     # optimizer decision identity: the worker echoes plan_id back in its
     # TrainerConfigReport ack, and every OPTIMIZER_* event on both sides
     # carries trace_id so the decision trail merges per incident
@@ -434,6 +444,9 @@ class TrainerConfigReport:
     train_window: int = 0
     steps_per_call: int = 1
     moe_dispatch: str = ""
+    # the grouped_ep chunk degree this worker actually runs (0 = not
+    # reported / not applicable)
+    dispatch_chunks: int = 0
     global_batch: int = 0
     plan_id: str = ""
     predicted_speedup: float = 0.0

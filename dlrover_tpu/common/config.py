@@ -163,6 +163,21 @@ class Context:
         # spec's capacity, with the planner's 0.8 fit headroom where it
         # applies)
         self.device_hbm_budget_bytes = 0.0
+        # comm/compute overlap (docs/parallelism.md "Hiding the
+        # network"): chunked expert dispatch — how many static chunks
+        # the grouped_ep MoE row exchange splits into (1 = the serial
+        # one-shot all_to_all). Resolved at TRACE time by ops.moe, so
+        # ElasticTrainer.retune can re-chunk a running job; the runtime
+        # optimizer enumerates {1, 2, 4, 8} as a knob family.
+        self.dispatch_chunks = 1
+        # FSDP layer prefetch: gather layer l+1's params while layer l
+        # computes (a double-buffered carry through the scan-over-
+        # layers; same math, float-roundoff-level schedule differences
+        # vs the plain scan). Resolved at trace time by models that
+        # support it (llama). Off by default: with heavy tensor
+        # sharding the replicate-gather it issues can cost more than
+        # it hides.
+        self.fsdp_prefetch = False
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -231,6 +231,7 @@ class CostCalibrator:
     def price(self, mesh, steps_per_call: int = 1,
               train_window: int = 1,
               moe_dispatch: str = "",
+              dispatch_chunks: int = 0,
               require_fit: bool = True) -> float:
         """Calibrated predicted per-step seconds for one candidate.
 
@@ -252,6 +253,12 @@ class CostCalibrator:
         model = self.model
         if moe_dispatch and moe_dispatch != model.moe_dispatch:
             model = _dc.replace(model, moe_dispatch=moe_dispatch)
+        if (dispatch_chunks
+                and dispatch_chunks != model.moe_dispatch_chunks):
+            # the chunk knob reshapes only the EXPOSED share of the
+            # dispatch comm (overlap_exposed_comm); bytes are invariant
+            model = _dc.replace(model,
+                                moe_dispatch_chunks=int(dispatch_chunks))
         k = max(1, int(steps_per_call))
         base = estimate(
             mesh, model, self.device, remat_policy=self.remat_policy,

@@ -79,6 +79,13 @@ _CALIBRATION_FRESHNESS_S = 600.0
 _INPUT_BOUND_ABS = 0.5
 
 STEPS_PER_CALL_OPTIONS = (1, 2, 4, 8)
+# grouped_ep chunked-dispatch degrees the optimizer prices (the
+# comm/compute-overlap knob, ops.moe dispatch_chunks). Enumerated only
+# when the worker REPORTS it runs moe_dispatch="grouped_ep" — on any
+# other dispatch the knob is inert and would only widen the candidate
+# product. Applied live through the same prewarmed program-cache swap
+# as steps_per_call (ElasticTrainer.retune(dispatch_chunks=...)).
+DISPATCH_CHUNKS_OPTIONS = (1, 2, 4, 8)
 # priced by the cost model, but NOT yet live-appliable: a dispatch-mode
 # change rebuilds the model, and enumeration is gated on the calibrator
 # seeing num_experts > 0 — which comm.ModelInfo does not carry yet, so
@@ -100,6 +107,7 @@ class RunningConfig:
     train_window: int = 4
     steps_per_call: int = 1
     moe_dispatch: str = ""
+    dispatch_chunks: int = 1
     global_batch: int = 0
 
     @classmethod
@@ -116,6 +124,8 @@ class RunningConfig:
             train_window=int(report.train_window),
             steps_per_call=max(1, int(report.steps_per_call)),
             moe_dispatch=report.moe_dispatch or "",
+            dispatch_chunks=max(
+                1, int(getattr(report, "dispatch_chunks", 0) or 1)),
             global_batch=int(report.global_batch or 0),
         )
 
@@ -126,6 +136,7 @@ class RunningConfig:
             "train_window": self.train_window,
             "steps_per_call": self.steps_per_call,
             "moe_dispatch": self.moe_dispatch,
+            "dispatch_chunks": self.dispatch_chunks,
             "global_batch": self.global_batch,
         }
 
@@ -138,6 +149,7 @@ class CandidateScore:
     steps_per_call: int
     train_window: int
     moe_dispatch: str
+    dispatch_chunks: int = 1
     predicted_step_s: float = 0.0
     speedup: float = 0.0  # current predicted / this predicted
 
@@ -146,7 +158,7 @@ class CandidateScore:
         return (
             f"mesh={mesh_axes_key(self.mesh)}"
             f"|k={self.steps_per_call}|w={self.train_window}"
-            f"|moe={self.moe_dispatch}"
+            f"|moe={self.moe_dispatch}|c={self.dispatch_chunks}"
         )
 
     def to_dict(self) -> Dict:
@@ -155,6 +167,7 @@ class CandidateScore:
             "steps_per_call": self.steps_per_call,
             "train_window": self.train_window,
             "moe_dispatch": self.moe_dispatch,
+            "dispatch_chunks": self.dispatch_chunks,
             "predicted_step_s": round(self.predicted_step_s, 6),
             "speedup": round(self.speedup, 3),
         }
@@ -380,12 +393,30 @@ class RuntimeOptimizer:
         info = self._model_info
         batch = self._running.global_batch or 8
         if info is not None and info.num_params > 0:
+            moe_kwargs = {}
+            if int(getattr(info, "num_experts", 0) or 0) > 0:
+                # the worker runs an MoE model: the spec must carry the
+                # expert shape (and the RUNNING dispatch mode) or the
+                # dispatch-comm terms price as zero and the
+                # dispatch_chunks family collapses into ties
+                moe_kwargs = dict(
+                    num_experts=int(info.num_experts),
+                    moe_top_k=max(1, int(
+                        getattr(info, "moe_top_k", 1) or 1)),
+                    moe_dispatch=(self._running.moe_dispatch
+                                  or "grouped_ep"),
+                    moe_dispatch_chunks=max(
+                        1, self._running.dispatch_chunks),
+                )
+                if float(getattr(info, "ffn_mult", 0.0) or 0.0) > 0:
+                    moe_kwargs["ffn_mult"] = float(info.ffn_mult)
             spec = ModelSpec(
                 param_count=int(info.num_params),
                 num_layers=max(1, int(info.num_layers or 2)),
                 hidden_size=max(8, int(info.hidden_size or 256)),
                 seq_len=max(1, int(info.seq_len or 128)),
                 global_batch=batch,
+                **moe_kwargs,
             )
         else:
             # no ModelInfo reported: a minimal placeholder spec — the
@@ -474,10 +505,23 @@ class RuntimeOptimizer:
         if run.train_window == 0:
             windows.append(4)  # enable dispatch/compute overlap
         cal = self._ensure_calibrator()
+        # the moe-dispatch family stays PARKED at the running mode even
+        # now that ModelInfo carries num_experts: a dispatch-mode
+        # change rebuilds the MODEL, and the worker's plan hook ignores
+        # the knob while acking the rest of the plan — enumerating it
+        # would let a fiction win the ranking and mark itself applied.
+        # (MOE_DISPATCH_OPTIONS waits on a model-rebuild apply path.)
         moes = [run.moe_dispatch]
-        if cal is not None and cal.model.num_experts > 0:
-            moes = sorted({run.moe_dispatch, *MOE_DISPATCH_OPTIONS})
-        return meshes, ks, windows, moes
+        # the chunked-dispatch family: only live-appliable on the
+        # dispatch the worker reports running (grouped_ep) — on every
+        # other mode the knob is a no-op the worker would ack but the
+        # program would ignore
+        chunk_opts = [max(1, run.dispatch_chunks)]
+        if (cal is not None and cal.model.num_experts > 0
+                and run.moe_dispatch == "grouped_ep"):
+            chunk_opts = sorted(
+                {max(1, run.dispatch_chunks), *DISPATCH_CHUNKS_OPTIONS})
+        return meshes, ks, windows, moes, chunk_opts
 
     def _price_candidates(self, run: RunningConfig
                           ) -> Tuple[List[CandidateScore], List[Dict]]:
@@ -490,7 +534,7 @@ class RuntimeOptimizer:
         cal = self._ensure_calibrator()
         if cal is None:
             return [], []
-        meshes, ks, windows, moes = self._knob_options(run)
+        meshes, ks, windows, moes, chunk_opts = self._knob_options(run)
         out: List[CandidateScore] = []
         memory_rejected: List[Dict] = []
         mem_seen: set = set()
@@ -498,31 +542,44 @@ class RuntimeOptimizer:
             for k in ks:
                 for w in windows:
                     for moe in moes:
-                        try:
-                            s = cal.price(
-                                mesh, steps_per_call=k, train_window=w,
-                                moe_dispatch=moe)
-                        except MemoryInfeasibleError as e:
-                            mkey = mesh_axes_key(mesh)
-                            if mkey not in mem_seen:
-                                mem_seen.add(mkey)
-                                self._c_memory_rejected.inc()
-                                memory_rejected.append({
-                                    "mesh": _mesh_dict(mesh),
-                                    "predicted_hbm_bytes": round(
-                                        e.memory_bytes),
-                                    "budget_bytes": round(
-                                        e.budget_bytes),
-                                })
-                            continue
-                        except (ValueError, KeyError) as e:
-                            logger.debug("candidate %s unpriceable: %s",
-                                         mesh, e)
-                            continue
-                        out.append(CandidateScore(
-                            mesh=mesh, steps_per_call=k, train_window=w,
-                            moe_dispatch=moe, predicted_step_s=s,
-                        ))
+                        # the chunk family only differentiates the
+                        # grouped_ep dispatch; pricing other modes at
+                        # every C would add identical-priced rows
+                        chunks_for_moe = (
+                            chunk_opts if moe == "grouped_ep"
+                            else [max(1, run.dispatch_chunks)]
+                        )
+                        for ch in chunks_for_moe:
+                            try:
+                                s = cal.price(
+                                    mesh, steps_per_call=k,
+                                    train_window=w,
+                                    moe_dispatch=moe,
+                                    dispatch_chunks=ch)
+                            except MemoryInfeasibleError as e:
+                                mkey = mesh_axes_key(mesh)
+                                if mkey not in mem_seen:
+                                    mem_seen.add(mkey)
+                                    self._c_memory_rejected.inc()
+                                    memory_rejected.append({
+                                        "mesh": _mesh_dict(mesh),
+                                        "predicted_hbm_bytes": round(
+                                            e.memory_bytes),
+                                        "budget_bytes": round(
+                                            e.budget_bytes),
+                                    })
+                                break
+                            except (ValueError, KeyError) as e:
+                                logger.debug(
+                                    "candidate %s unpriceable: %s",
+                                    mesh, e)
+                                break
+                            out.append(CandidateScore(
+                                mesh=mesh, steps_per_call=k,
+                                train_window=w, moe_dispatch=moe,
+                                dispatch_chunks=ch,
+                                predicted_step_s=s,
+                            ))
         # worst offender first: the trimmed decision evidence and the
         # PLAN_REJECTED event must name the true worst, not whichever
         # mesh enumeration happened to visit early
@@ -579,13 +636,14 @@ class RuntimeOptimizer:
 
     @staticmethod
     def _wants_program(c: CandidateScore, run: RunningConfig) -> bool:
-        """Whether the candidate changes the COMPILED program (mesh or
-        fused-step degree) — the knobs whose apply pays a drain. A
-        host-knob-only plan (train_window) stays appliable even on a
-        data-starved job."""
+        """Whether the candidate changes the COMPILED program (mesh,
+        fused-step degree, or dispatch chunking) — the knobs whose
+        apply pays a drain. A host-knob-only plan (train_window) stays
+        appliable even on a data-starved job."""
         return (
             _mesh_dict(c.mesh) != _mesh_dict(run.mesh)
             or c.steps_per_call != run.steps_per_call
+            or max(1, c.dispatch_chunks) != max(1, run.dispatch_chunks)
         )
 
     @staticmethod
@@ -599,6 +657,8 @@ class RuntimeOptimizer:
             + int(c.steps_per_call != run.steps_per_call)
             + int(c.train_window != run.train_window)
             + int((c.moe_dispatch or "") != (run.moe_dispatch or ""))
+            + int(max(1, c.dispatch_chunks)
+                  != max(1, run.dispatch_chunks))
         )
 
     # -- the re-plan pass ----------------------------------------------------
@@ -638,7 +698,8 @@ class RuntimeOptimizer:
         current_s = cal.price(
             run.mesh, steps_per_call=run.steps_per_call,
             train_window=run.train_window,
-            moe_dispatch=run.moe_dispatch, require_fit=False,
+            moe_dispatch=run.moe_dispatch,
+            dispatch_chunks=run.dispatch_chunks, require_fit=False,
         )
         priced, memory_rejected = self._price_candidates(run)
         candidates = [c for c in priced
@@ -780,6 +841,10 @@ class RuntimeOptimizer:
             moe_dispatch=(best.moe_dispatch
                           if (best.moe_dispatch or "")
                           != (cur.get("moe_dispatch") or "") else ""),
+            dispatch_chunks=(
+                best.dispatch_chunks
+                if max(1, best.dispatch_chunks)
+                != max(1, cur.get("dispatch_chunks") or 1) else 0),
             plan_id=plan_id,
             trace_id=decision.trace_id,
             predicted_speedup=round(best.speedup, 3),
@@ -793,7 +858,7 @@ class RuntimeOptimizer:
             predicted_step_s=round(best.predicted_step_s, 6),
             **{f"knob_{k}": v for k, v in best.to_dict().items()
                if k in ("steps_per_call", "train_window",
-                        "moe_dispatch")},
+                        "moe_dispatch", "dispatch_chunks")},
             mesh=_mesh_dict(best.mesh),
         )
         logger.info(
@@ -804,6 +869,54 @@ class RuntimeOptimizer:
             self._publish(cfg)
 
     # -- queries -------------------------------------------------------------
+
+    def exposed_comm_view(self) -> Optional[Dict]:
+        """Predicted vs measured exposed-comm fraction for the RUNNING
+        config, side by side — the operator's check that the overlap
+        the planner paid for actually materialized. Predicted comes
+        from the overlap-aware ``estimate`` breakdown at the running
+        knobs; measured is the median of the fresh nodes'
+        ``exposed_comm_frac`` gauges (PR 8's attribution plane — an
+        UPPER bound, so measured modestly above predicted is healthy;
+        measured near the C=1 serial prediction means the overlap never
+        happened). None when nothing is running yet."""
+        import dataclasses as _dc
+
+        from dlrover_tpu.parallel.planner import estimate
+
+        with self._lock:
+            cal = self._ensure_calibrator()
+            run = self._running
+            if cal is None or run is None:
+                return None
+            model = cal.model
+            if run.moe_dispatch and run.moe_dispatch != model.moe_dispatch:
+                model = _dc.replace(model, moe_dispatch=run.moe_dispatch)
+            if max(1, run.dispatch_chunks) != model.moe_dispatch_chunks:
+                model = _dc.replace(
+                    model,
+                    moe_dispatch_chunks=max(1, run.dispatch_chunks))
+            score = estimate(run.mesh, model, self._device,
+                             steps_per_call=run.steps_per_call)
+            predicted = score.breakdown.get("exposed_comm_frac")
+            now = time.time()
+            fracs: List[float] = []
+            for nid in self._store.node_ids():
+                s = self._store.latest(nid)
+                if s is None or now - getattr(s, "ts", now) > \
+                        _CALIBRATION_FRESHNESS_S:
+                    continue
+                f = getattr(s, "exposed_comm_frac", None)
+                if f is not None:
+                    fracs.append(float(f))
+        return {
+            "predicted": (round(float(predicted), 4)
+                          if predicted is not None else None),
+            "measured": (round(statistics.median(fracs), 4)
+                         if fracs else None),
+            "nodes_measured": len(fracs),
+            "dispatch_chunks": max(1, run.dispatch_chunks),
+        }
 
     def pending_plan(self) -> Optional[comm.ParallelConfig]:
         with self._lock:
@@ -839,12 +952,15 @@ class RuntimeOptimizer:
             "corrections": corr,
             "min_speedup": self._min_speedup,
             "cooldown_secs": self._cooldown.cooldown_secs,
+            "exposed_comm": self.exposed_comm_view(),
             "pending_plan": {
                 "plan_id": pending.plan_id,
                 "mesh": dict(pending.mesh_shape or {}),
                 "train_window": pending.train_window,
                 "steps_per_call": pending.steps_per_call,
                 "moe_dispatch": pending.moe_dispatch,
+                "dispatch_chunks": getattr(
+                    pending, "dispatch_chunks", 0),
                 "predicted_speedup": pending.predicted_speedup,
                 "trace_id": pending.trace_id,
             } if pending is not None else None,

@@ -449,6 +449,10 @@ class MasterServicer:
             },
             "memory_rejected": self.runtime_optimizer.memory_rejections(
                 limit=req.limit or 0),
+            # predicted (overlap-aware planner) vs measured (PR 8
+            # gauge) exposed-comm fraction for the running config —
+            # did the overlap the planner paid for materialize?
+            "exposed_comm": self.runtime_optimizer.exposed_comm_view(),
         }
         return comm.DiagnosisReport(report_json=_json.dumps(report))
 

@@ -97,6 +97,18 @@ class LlamaConfig:
     # canonical (data x fsdp) expert submesh; the mesh itself resolves
     # ambiently per accelerate (elastic-safe), or from ``mesh`` above.
     moe_ep_axes: Tuple[str, ...] = ("data", "fsdp")
+    # "grouped_ep" only: chunked double-buffered dispatch — split the
+    # row exchange into this many ppermute-ring chunks so the grouped
+    # GEMM overlaps the in-flight exchange (ops.moe). 0 = resolve the
+    # Context knob (``dispatch_chunks``) at trace time, which is how
+    # the runtime optimizer's chosen chunking reaches a retuned program.
+    moe_dispatch_chunks: int = 0
+    # FSDP layer prefetch: gather layer l+1's params while layer l
+    # computes (double-buffered carry through the scan-over-layers).
+    # None = the Context knob (``fsdp_prefetch``). Same math, but the
+    # scan(L-1)+epilogue restructure changes fusion/reduction order, so
+    # results match the plain scan to float roundoff, not bitwise.
+    fsdp_prefetch: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -338,6 +350,7 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
             # ring-attention mesh convention above
             ep_axes=tuple(config.moe_ep_axes),
             mesh=config.mesh,
+            dispatch_chunks=config.moe_dispatch_chunks,
         )
         out, aux, metrics = moe_ops.moe_ffn(
             moe_params, x, cfg, activation=jax.nn.silu, rng=rng
@@ -350,6 +363,42 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
             jnp.zeros((1,), jnp.float32))
 
 
+
+
+def _prefetch_enabled(c: LlamaConfig) -> bool:
+    """The FSDP layer-prefetch toggle: the config wins when set, else
+    the Context knob (``fsdp_prefetch``) — resolved at TRACE time so a
+    re-accelerate picks up a changed knob."""
+    if c.fsdp_prefetch is not None:
+        return bool(c.fsdp_prefetch)
+    from dlrover_tpu.common.config import get_context
+
+    return bool(getattr(get_context(), "fsdp_prefetch", False))
+
+
+def _prefetch_gather(tree):
+    """Issue the gather of ONE layer's params now: a sharding
+    constraint to replicated over the ambient mesh — exactly the
+    all-gather FSDP pays per layer anyway, but as an op with NO data
+    dependency on the current layer's compute, so XLA's latency-hiding
+    scheduler can run it underneath (the HSDP-paper prefetch,
+    PAPERS.md 2602.00277). Values are untouched (a sharding constraint
+    never changes numerics); without an ambient mesh this is the
+    identity."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from dlrover_tpu.ops.shard_compat import ambient_mesh
+
+    mesh = ambient_mesh()
+    if mesh is None:
+        return tree
+    try:
+        rep = NamedSharding(mesh, PartitionSpec())
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, rep), tree
+        )
+    except (ValueError, TypeError):  # mesh flavor unsupported here
+        return tree
 
 
 def _decoder_block(c: LlamaConfig, segment_ids=None, positions=None):
@@ -399,9 +448,40 @@ def apply_hidden(
                  if segment_ids is not None else None)
     block = apply_remat(_decoder_block(c, segment_ids, positions),
                         c.remat_policy)
-    (x, _), (aux_losses, dropped, load) = lax.scan(
-        block, (x, rng), params["layers"]
-    )
+    if _prefetch_enabled(c) and c.num_layers >= 2:
+        # FSDP layer prefetch: the scan carries layer l's ALREADY
+        # GATHERED params and issues layer l+1's gather before the
+        # block compute — a double-buffered carry, so the per-layer
+        # param all-gather runs under the previous layer's compute
+        # instead of on the critical path. The last layer runs as an
+        # epilogue (its params were gathered during layer L-2). The
+        # gather stays OUTSIDE the remat'd block: the backward re-plays
+        # compute, not the exchange schedule. Same blocks, same order,
+        # same rng chain — but the restructure changes XLA's fusion /
+        # reduction order, so outputs match the plain scan to float
+        # roundoff, NOT bitwise (pinned with allclose).
+        layers = params["layers"]
+        first = jax.tree.map(lambda a: a[0], layers)
+        rest = jax.tree.map(lambda a: a[1:], layers)
+
+        def pf_block(carry, next_sharded):
+            inner, cur = carry
+            gathered = _prefetch_gather(next_sharded)  # prefetch l+1
+            inner, ys = block(inner, cur)  # compute layer l
+            return (inner, gathered), ys
+
+        (inner, last), (aux_losses, dropped, load) = lax.scan(
+            pf_block, ((x, rng), _prefetch_gather(first)), rest
+        )
+        inner, (aux_l, drop_l, load_l) = block(inner, last)
+        x, _ = inner
+        aux_losses = jnp.concatenate([aux_losses, aux_l[None]])
+        dropped = jnp.concatenate([dropped, drop_l[None]])
+        load = jnp.concatenate([load, load_l[None]], axis=0)
+    else:
+        (x, _), (aux_losses, dropped, load) = lax.scan(
+            block, (x, rng), params["layers"]
+        )
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
     if with_moe_metrics:
         metrics = {

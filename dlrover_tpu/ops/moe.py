@@ -89,6 +89,15 @@ class MoEConfig:
     # (``jax.sharding.set_mesh``, what accelerate establishes while
     # tracing) — rebuilt by every accelerate, so elastic-safe.
     mesh: Any = None
+    # "grouped_ep" only: split the [P, n, D] row exchange into this many
+    # static chunks driven by a ppermute ring (``ops.ring``), with the
+    # grouped GEMM on already-arrived chunks overlapping the in-flight
+    # exchange (double-buffered). 1 = the one-shot ``all_to_all``
+    # (serial exchange -> GEMM -> exchange). 0 = resolve the global
+    # Context knob (``dispatch_chunks``) at TRACE time, which is what
+    # lets ``ElasticTrainer.retune`` re-chunk a running job through the
+    # program cache with zero recompiles on a prewarmed value.
+    dispatch_chunks: int = 0
 
 
 def _capacity(num_tokens: int, num_experts: int, factor: float,
@@ -382,10 +391,25 @@ def _resolve_ep_mesh(config: "MoEConfig"):
     return (mesh, axes, ep) if ep > 1 else (None, axes, 1)
 
 
+def resolve_dispatch_chunks(config: "MoEConfig") -> int:
+    """The effective ``dispatch_chunks`` for a config: an explicit
+    positive value wins; 0 resolves the global Context knob at TRACE
+    time (``Context.dispatch_chunks``), which is how the runtime
+    optimizer's chosen chunking reaches a re-traced program without
+    rebuilding the model config."""
+    c = int(getattr(config, "dispatch_chunks", 0) or 0)
+    if c > 0:
+        return c
+    from dlrover_tpu.common.config import get_context
+
+    return max(1, int(getattr(get_context(), "dispatch_chunks", 1)))
+
+
 def _moe_compute_grouped_ep(params, xt, config: "MoEConfig", activation,
                             mesh, axes: Tuple[str, ...], ep: int,
                             rng, jitter: float,
-                            block_t: int = 128):
+                            block_t: int = 128,
+                            chunks: int = 1):
     """DROPLESS dispatch with experts SHARDED over the ``axes`` submesh:
     shard_map + two ``lax.all_to_all`` exchanges around the grouped
     Pallas kernel — megablocks-style droplessness with MoE FLOPs linear
@@ -414,9 +438,25 @@ def _moe_compute_grouped_ep(params, xt, config: "MoEConfig", activation,
       5. reverse all_to_all and combine locally (unsort + gate, summing
          each token's top_k rounds).
 
+    ``chunks`` > 1 (the comm/compute-overlap mode): the [P, n, D] row
+    exchange of steps 3/5 is split into C static chunks of n/C rows
+    per block, each exchanged by a ppermute ring (``ops.ring``) instead
+    of the opaque one-shot ``all_to_all``, DOUBLE-BUFFERED — chunk
+    c+1's exchange is issued before chunk c's grouped GEMMs, and chunk
+    c's reverse exchange before chunk c+1's GEMMs, so XLA's
+    latency-hiding scheduler can run the in-flight exchange under the
+    compute on already-arrived rows. Per-row math is unchanged (each
+    row's output is x_row @ W of its expert, independent of chunking),
+    so C is a pure schedule knob: outputs are exactly the C=1 path's,
+    total wire bytes stay the all_to_all's (minus the diagonal block
+    that never needed the wire — the G106 audit's parity contract),
+    shapes stay static per C, and droplessness is untouched. n % C != 0
+    degrades to C=1 at trace time (logged).
+
     Differentiable end to end: the collectives transpose to their
     reverses and the kernel brings its custom VJP, so the backward runs
-    the same two all-to-alls in the opposite direction.
+    the same exchanges (all-to-alls, or the mirrored ppermute ring) in
+    the opposite direction.
 
     Returns (out [T, D], aux_loss, metrics) — metrics are the pmean'd
     global load-balance signals, ``dropped_frac`` identically 0.
@@ -446,6 +486,20 @@ def _moe_compute_grouped_ep(params, xt, config: "MoEConfig", activation,
     el = e // ep
     interpret = config.kernel_interpret
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # chunk validation happens at TRACE time (shapes are static): an
+    # indivisible row count degrades to the one-shot exchange rather
+    # than changing the row layout
+    chunks = max(1, int(chunks))
+    n_static = (t // ep) * config.top_k
+    if chunks > 1 and (n_static % chunks or chunks > n_static):
+        from dlrover_tpu.common.log import get_logger
+
+        get_logger("ops.moe").warning(
+            "grouped_ep: dispatch_chunks=%d does not divide the %d "
+            "local assignment rows; running unchunked (C=1)",
+            chunks, n_static,
+        )
+        chunks = 1
 
     def body(xt_l, router_k, up_l, down_l, rng_l):
         tl = xt_l.shape[0]
@@ -484,65 +538,108 @@ def _moe_compute_grouped_ep(params, xt, config: "MoEConfig", activation,
         # all-to-all #1 (tiny): counts — recv[s, le] = rows shard s is
         # sending for my local expert le
         recv = lax.all_to_all(counts, axes, 0, 0)  # [P, el]
-        # all-to-all #2: the token rows themselves
-        x_recv = lax.all_to_all(
-            x_send.reshape(ep, n, d), axes, 0, 0
-        )  # [P, n, D]; block s = rows from shard s
-
-        # regroup incoming rows by local expert, tile-aligned — all
-        # index math from the exchanged counts, shapes all static
         csum = jnp.cumsum(recv, axis=1)  # [P, el]
         tot = csum[:, -1]  # [P] real rows per source block
-        r_idx = jnp.arange(n, dtype=jnp.int32)
-        le_r = jax.vmap(
-            lambda c, r: jnp.searchsorted(c, r, side="right")
-        )(csum, jnp.broadcast_to(r_idx, (ep, n)))  # [P, n]
-        valid = r_idx[None, :] < tot[:, None]  # [P, n]
-        le_r = jnp.clip(le_r, 0, el - 1).astype(jnp.int32)
-        src_rows = jnp.arange(ep, dtype=jnp.int32)[:, None]
-        within = r_idx[None, :] - (csum - recv)[src_rows, le_r]
-        pre = jnp.cumsum(recv, axis=0) - recv  # rows from earlier shards
-        rank_r = pre[src_rows, le_r] + within  # [P, n] arrival rank
-        m_le = recv.sum(axis=0)  # [el] rows per local expert
-        padded = jnp.maximum(
-            (m_le + block_t - 1) // block_t, 1
-        ) * block_t
-        ends = jnp.cumsum(padded).astype(jnp.int32)
-        offs = (ends - padded).astype(jnp.int32)
-        # static bound: every group full + its tile padding (and every
-        # zero-row expert still owns one sentinel tile — dw init)
-        tp = ((ep * n + block_t - 1) // block_t) * block_t + el * block_t
-        dest_row = jnp.where(valid, offs[le_r] + rank_r, tp)  # [P, n]
-        q_flat = jnp.arange(ep * n, dtype=jnp.int32)
-        row_src = jnp.full((tp + 1,), ep * n, jnp.int32).at[
-            dest_row.reshape(-1)
-        ].set(q_flat)[:tp]
-        x_recv_pad = jnp.concatenate(
-            [x_recv.reshape(ep * n, d),
-             jnp.zeros((1, d), x_recv.dtype)], axis=0
-        )
-        x_sorted = x_recv_pad[row_src]  # [tp, D] expert-sorted
-        tile_start = jnp.arange(tp // block_t, dtype=jnp.int32) * block_t
-        tile_expert = jnp.clip(
-            jnp.searchsorted(ends, tile_start, side="right"), 0, el - 1
-        ).astype(jnp.int32)
+        group_start = csum - recv  # [P, el] within-block group starts
 
         from dlrover_tpu.ops.grouped_matmul import grouped_matmul
 
-        h = activation(grouped_matmul(
-            x_sorted, up_l, tile_expert, block_t, 512, interpret,
-        ))
-        y_sorted = grouped_matmul(
-            h, down_l, tile_expert, block_t, 512, interpret,
-        )
+        def regroup_gemm(x_chunk, lo, nc):
+            """Received block rows [lo, lo+nc) from every source
+            ([P, nc, D]) -> expert outputs in the same layout (invalid
+            slots zero). All index math comes from the exchanged
+            counts, so every shape is static; at lo=0, nc=n this IS
+            the unchunked regroup (chunk-window clips are no-ops)."""
+            r_idx = lo + jnp.arange(nc, dtype=jnp.int32)
+            le_r = jax.vmap(
+                lambda c, r: jnp.searchsorted(c, r, side="right")
+            )(csum, jnp.broadcast_to(r_idx, (ep, nc)))  # [P, nc]
+            valid = r_idx[None, :] < tot[:, None]  # [P, nc]
+            le_r = jnp.clip(le_r, 0, el - 1).astype(jnp.int32)
+            src_rows = jnp.arange(ep, dtype=jnp.int32)[:, None]
+            # rows of each (source, local-expert) group that fall in
+            # this chunk's window, and the group's start within it
+            cnt = jnp.clip(
+                jnp.minimum(csum, lo + nc)
+                - jnp.maximum(group_start, lo), 0, nc
+            )  # [P, el]
+            start = jnp.maximum(group_start[src_rows, le_r], lo)
+            pre = jnp.cumsum(cnt, axis=0) - cnt  # earlier sources
+            rank_r = pre[src_rows, le_r] + (r_idx[None, :] - start)
+            m_le = cnt.sum(axis=0)  # [el] chunk rows per local expert
+            padded = jnp.maximum(
+                (m_le + block_t - 1) // block_t, 1
+            ) * block_t
+            ends = jnp.cumsum(padded).astype(jnp.int32)
+            offs = (ends - padded).astype(jnp.int32)
+            # static bound: every group full + its tile padding (and
+            # every zero-row expert still owns one sentinel tile — dw
+            # init, see grouped_matmul)
+            tp = (
+                ((ep * nc + block_t - 1) // block_t) * block_t
+                + el * block_t
+            )
+            dest_row = jnp.where(valid, offs[le_r] + rank_r, tp)
+            q_flat = jnp.arange(ep * nc, dtype=jnp.int32)
+            row_src = jnp.full((tp + 1,), ep * nc, jnp.int32).at[
+                dest_row.reshape(-1)
+            ].set(q_flat)[:tp]
+            x_pad_c = jnp.concatenate(
+                [x_chunk.reshape(ep * nc, d),
+                 jnp.zeros((1, d), x_chunk.dtype)], axis=0
+            )
+            x_sorted = x_pad_c[row_src]  # [tp, D] expert-sorted
+            tile_start = jnp.arange(
+                tp // block_t, dtype=jnp.int32
+            ) * block_t
+            tile_expert = jnp.clip(
+                jnp.searchsorted(ends, tile_start, side="right"),
+                0, el - 1,
+            ).astype(jnp.int32)
+            h = activation(grouped_matmul(
+                x_sorted, up_l, tile_expert, block_t, 512, interpret,
+            ))
+            y_sorted = grouped_matmul(
+                h, down_l, tile_expert, block_t, 512, interpret,
+            )
+            # back to the chunk's recv layout (invalid slots zero)
+            y_flat = y_sorted[
+                jnp.clip(dest_row, 0, tp - 1).reshape(-1)
+            ]
+            y_flat = jnp.where(
+                valid.reshape(-1)[:, None], y_flat, 0
+            ).astype(xt_l.dtype)
+            return y_flat.reshape(ep, nc, d)
 
-        # back to the recv layout (invalid slots zero), reverse
-        # all-to-all returns each block to its source shard
-        y_flat = y_sorted[jnp.clip(dest_row, 0, tp - 1).reshape(-1)]
-        y_flat = jnp.where(
-            valid.reshape(-1)[:, None], y_flat, 0
-        ).astype(xt_l.dtype)
-        y_ret = lax.all_to_all(y_flat.reshape(ep, n, d), axes, 0, 0)
+        x_send3 = x_send.reshape(ep, n, d)
+        if chunks <= 1:
+            # all-to-all #2: the token rows, one shot (serial)
+            x_recv = lax.all_to_all(x_send3, axes, 0, 0)
+            y_ret = lax.all_to_all(
+                regroup_gemm(x_recv, 0, n), axes, 0, 0
+            )  # [P, n, D]
+        else:
+            # chunked double-buffered exchange: chunk c+1's ring
+            # permutes (and chunk c's reverse ring) carry no data
+            # dependency on chunk c's GEMMs, so the scheduler can run
+            # them under the compute — the overlap the one-shot
+            # all_to_all structurally forbids
+            from dlrover_tpu.ops.ring import ring_all_to_all
+
+            nc = n // chunks
+            cur = ring_all_to_all(x_send3[:, :nc], axes, ep)
+            parts = []
+            for c in range(chunks):
+                nxt = (
+                    ring_all_to_all(
+                        x_send3[:, (c + 1) * nc:(c + 2) * nc],
+                        axes, ep,
+                    ) if c + 1 < chunks else None
+                )
+                y_c = regroup_gemm(cur, c * nc, nc)
+                parts.append(ring_all_to_all(y_c, axes, ep))
+                cur = nxt
+            y_ret = jnp.concatenate(parts, axis=1)  # [P, n, D]
         # combine: each assignment's result sits at its own send_pos
         y_a = y_ret.reshape(ep * n, d)[send_pos]  # [n, D]
         out_l = jnp.zeros((tl, d), xt_l.dtype).at[token_a].add(
@@ -617,6 +714,7 @@ def moe_ffn(
             out, aux, metrics = _moe_compute_grouped_ep(
                 params, xt, config, activation, mesh, axes, ep,
                 rng, jitter,
+                chunks=resolve_dispatch_chunks(config),
             )
             return out.reshape(b, s, d), aux, metrics
         # no usable expert submesh (single shard, elastic shrink, or no

@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.ops.flash_attention import flash_attention_lse
+from dlrover_tpu.ops.ring import ring_axis_size, ring_shift
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
@@ -273,8 +274,7 @@ def ring_attention_local(
     attended, not skipped. Requires ``causal=True`` and no
     ``segment_ids``.
     """
-    n = (lax.axis_size(axis_name) if hasattr(lax, "axis_size")
-         else lax.psum(1, axis_name))  # old jax: constant-folded psum
+    n = ring_axis_size(axis_name)  # legacy-jax fallback in ops.ring
     my = lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if impl is None:
@@ -301,8 +301,6 @@ def ring_attention_local(
     # causal mask, which the flash kernel applies at tile granularity
     o, lse = attend(q, k, v, causal=causal, seg_q=seg, seg_k=seg)
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
     def attend_merge(o, lse, ck, cv, cs):
         o_i, lse_i = attend(
             q, ck, cv, causal=False, seg_q=seg,
@@ -312,13 +310,14 @@ def ring_attention_local(
 
     def step(carry, _):
         o, lse, cur_k, cur_v, cur_s, owner = carry
-        # rotate kv to the next neighbor (single ICI hop), then attend;
-        # n-1 rotations total — the last visiting shard is not re-sent.
-        # Only the H_kv heads travel: GQA pays kv/h of the MHA bytes.
-        cur_k = lax.ppermute(cur_k, axis_name, perm)
-        cur_v = lax.ppermute(cur_v, axis_name, perm)
+        # rotate kv to the next neighbor (single ICI hop, the shared
+        # ops.ring step), then attend; n-1 rotations total — the last
+        # visiting shard is not re-sent. Only the H_kv heads travel:
+        # GQA pays kv/h of the MHA bytes.
+        cur_k = ring_shift(cur_k, axis_name, n)
+        cur_v = ring_shift(cur_v, axis_name, n)
         if seg is not None:
-            cur_s = lax.ppermute(cur_s, axis_name, perm)
+            cur_s = ring_shift(cur_s, axis_name, n)
         owner = jnp.asarray((owner - 1) % n, jnp.int32)
         if causal:
             # visiting shard is wholly past (attend, unmasked) or wholly
@@ -365,12 +364,10 @@ def _ring_prefix(q, k, v, attend, prefix_len, axis_name, n, my):
     p_loc = jnp.clip(p - my * s_local, 0, s_local)
     o, lse = attend(q, k, v, causal=True, prefix=p_loc)
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
     def step(carry, _):
         o, lse, cur_k, cur_v, owner = carry
-        cur_k = lax.ppermute(cur_k, axis_name, perm)
-        cur_v = lax.ppermute(cur_v, axis_name, perm)
+        cur_k = ring_shift(cur_k, axis_name, n)
+        cur_v = ring_shift(cur_v, axis_name, n)
         owner = jnp.asarray((owner - 1) % n, jnp.int32)
         # p_vis: how many of the visiting shard's columns are prompt
         p_vis = jnp.clip(p - owner * s_local, 0, s_local)

@@ -72,6 +72,17 @@ class ModelSpec:
     moe_capacity_factor: float = 1.25
     # "gather" | "einsum" | "grouped" | "grouped_ep" (ops.moe dispatches)
     moe_dispatch: str = "gather"
+    # grouped_ep chunked double-buffered dispatch (ops.moe
+    # dispatch_chunks): C > 1 splits the row exchange into C
+    # ppermute-ring chunks so the grouped GEMM overlaps the in-flight
+    # exchange. The BYTES on the wire are invariant in C (the audit
+    # contract); what changes is how much of them is EXPOSED — see
+    # ``estimate``'s overlap-aware moe_disp_comm_s.
+    moe_dispatch_chunks: int = 1
+    # FSDP layer prefetch (models/llama.py fsdp_prefetch): gather layer
+    # l+1's params under layer l's compute, exposing only the
+    # non-overlappable remainder of the fsdp gather bytes.
+    fsdp_prefetch: bool = False
 
 
 # Recompute multiplier on executed FLOPs per remat policy: "full" re-runs
@@ -202,6 +213,33 @@ COMM_BREAKDOWN_KEYS = (
     "tp_comm_s", "fsdp_comm_s", "dp_comm_s", "seq_comm_s",
     "pipe_comm_s", "moe_disp_comm_s",
 )
+
+
+def overlap_exposed_comm(comm_s: float, overlappable_compute_s: float,
+                         chunks: int) -> float:
+    """EXPOSED seconds of a chunked, double-buffered exchange — the
+    overlap-aware pricing both overlapped paths share (chunked expert
+    dispatch, FSDP layer prefetch), so the planner stops summing comm
+    and compute serially where the program actually interleaves them.
+
+    The C-chunk schedule is: exchange chunk 0; then for each next chunk
+    its exchange runs UNDER the previous chunk's compute; the last
+    chunk's compute runs alone. With per-chunk exchange e = comm/C and
+    per-chunk compute g = overlappable/C the exposed comm is
+    e + (C-1)*max(e - g, 0), which simplifies to
+
+        max(comm_s / C,  comm_s - (C-1)/C * overlappable_compute_s)
+
+    — at C=1 this is the serial comm_s; it is non-increasing in C for
+    fixed bytes (both tests pin both directions), and it can never go
+    below comm_s/C (the un-overlappable head of the pipeline)."""
+    c = max(1, int(chunks))
+    if comm_s <= 0:
+        return 0.0
+    if c <= 1:
+        return comm_s
+    return max(comm_s / c,
+               comm_s - overlappable_compute_s * (c - 1) / c)
 
 
 def combine_step_time(compute_s: float, comm_s: float,
@@ -422,7 +460,11 @@ def estimate(
                  quadratic one-hot einsums for the capacity paths under
                  EP, linear all-to-all bytes for "grouped_ep"
                  (``_moe_dispatch_terms``; ep degree = data x fsdp, the
-                 expert submesh of the canonical rule sets).
+                 expert submesh of the canonical rule sets). With
+                 ``moe_dispatch_chunks`` > 1 (and with
+                 ``fsdp_prefetch`` for the fsdp gathers) only the
+                 EXPOSED remainder enters the step time
+                 (``overlap_exposed_comm``); bytes stay invariant.
       memory   : params+optimizer sharded over (fsdp x tensor x pipe),
                  activations for one microbatch per layer (remat floor).
 
@@ -520,6 +562,37 @@ def estimate(
     moe_disp_comm_s = comm_bytes["moe_dispatch"] / device.ici_bw
     compute_s += moe_disp_comp_s
 
+    # ---- overlap-aware exposure: on the overlapped paths the planner
+    # must not sum comm and compute serially. The BYTES stay invariant
+    # (predicted_collective_bytes — the G106 audit side); what the
+    # chunk schedule changes is how many of their seconds are EXPOSED.
+    moe_disp_comm_serial_s = moe_disp_comm_s
+    chunks = max(1, int(getattr(model, "moe_dispatch_chunks", 1)))
+    if (model.num_experts > 0 and model.moe_dispatch == "grouped_ep"
+            and moe_disp_comm_s > 0):
+        # what the row exchange hides under: the expert FFN's own
+        # grouped GEMMs (up+down, fwd+bwd) on this chip's rows —
+        # per-chunk exchange c+1 runs beneath chunk c's GEMMs
+        f_dim = model.ffn_mult * model.hidden_size
+        gemm_flops = (
+            12.0 * tokens_per_chip * max(1, model.moe_top_k)
+            * model.hidden_size * f_dim * model.num_layers
+        )
+        moe_gemm_s = gemm_flops / (device.flops_per_s * eff)
+        moe_disp_comm_s = overlap_exposed_comm(
+            moe_disp_comm_serial_s, moe_gemm_s, chunks)
+
+    fsdp_comm_serial_s = fsdp_comm_s
+    if model.fsdp_prefetch and fsdp > 1 and fsdp_comm_s > 0:
+        # layer prefetch hides the gathers (2 of the 3 shard-bytes
+        # traversals: the forward all-gather and the backward
+        # re-gather) under the neighboring layers' compute — a chunk
+        # schedule with one chunk per layer; the grad reduce-scatter
+        # (the third traversal) has nothing later to hide under
+        gather_s = fsdp_comm_s * 2.0 / 3.0
+        fsdp_comm_s = (fsdp_comm_s - gather_s) + overlap_exposed_comm(
+            gather_s, compute_s, max(1, model.num_layers))
+
     # comm + dispatch fold into the step time through the shared
     # combiner (overlap max + dispatch floor; see combine_step_time)
     comm_s = (tp_comm_s + fsdp_comm_s + dp_comm_s + seq_comm_s
@@ -595,12 +668,26 @@ def estimate(
             "compute_s": compute_s,
             "dispatch_s": dispatch_s,
             "tp_comm_s": tp_comm_s,
+            # the EXPOSED seconds (post-overlap) — what enters the
+            # step time; the *_serial_s twins keep the pre-overlap
+            # figure visible so `tpurun plan` can show what the chunk
+            # schedule bought
             "fsdp_comm_s": fsdp_comm_s,
+            "fsdp_comm_serial_s": fsdp_comm_serial_s,
             "dp_comm_s": dp_comm_s,
             "seq_comm_s": seq_comm_s,
             "pipe_comm_s": pipe_comm_s,
             "moe_disp_comp_s": moe_disp_comp_s,
             "moe_disp_comm_s": moe_disp_comm_s,
+            "moe_disp_comm_serial_s": moe_disp_comm_serial_s,
+            "moe_dispatch_chunks": float(chunks),
+            # predicted analog of the attribution plane's measured
+            # exposed-comm bound (1 - compute/step): what `tpurun
+            # plan`/`attribution` print beside the measured gauge
+            "exposed_comm_frac": (
+                min(max(1.0 - compute_s / step_s, 0.0), 1.0)
+                if step_s not in (0.0, float("inf")) else 0.0
+            ),
             "param_shard_bytes": param_shard,
             "grad_temp_bytes": grad_temp,
             "gather_buf_bytes": gather_buf,
@@ -698,6 +785,7 @@ def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
     """Convenience: derive a ModelSpec from a LlamaConfig."""
     import numpy as np
 
+    from dlrover_tpu.common.config import get_context
     from dlrover_tpu.models import llama
 
     return ModelSpec(
@@ -715,4 +803,15 @@ def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
         moe_top_k=config.moe_top_k,
         moe_capacity_factor=config.moe_capacity_factor,
         moe_dispatch=config.moe_dispatch,
+        # 0 = the Context knob, exactly how ops.moe resolves it at
+        # trace time — the spec must price the program that will build
+        moe_dispatch_chunks=(
+            config.moe_dispatch_chunks
+            or int(getattr(get_context(), "dispatch_chunks", 1))
+        ),
+        fsdp_prefetch=(
+            bool(config.fsdp_prefetch)
+            if config.fsdp_prefetch is not None
+            else bool(getattr(get_context(), "fsdp_prefetch", False))
+        ),
     )

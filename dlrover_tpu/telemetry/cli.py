@@ -240,6 +240,23 @@ def _cmd_diagnose(args) -> int:
     return 0
 
 
+def _print_exposed_comm(ec) -> None:
+    """The predicted-vs-measured exposed-comm line shared by ``tpurun
+    plan`` and ``tpurun attribution``: side by side, so an operator can
+    see whether the overlap the planner paid for actually materialized
+    (measured is an upper bound — far above predicted means the
+    exchange is still serial)."""
+    if not ec:
+        return
+    pred = ec.get("predicted")
+    meas = ec.get("measured")
+    print(f"exposed comm: predicted="
+          f"{pred if pred is not None else '-'} "
+          f"measured={meas if meas is not None else '-'} "
+          f"(C={ec.get('dispatch_chunks')}, "
+          f"{ec.get('nodes_measured', 0)} node(s) measured)")
+
+
 def _cmd_plan(args) -> int:
     """Live (master RPC) or forensic (timeline) optimizer trail."""
     from dlrover_tpu.telemetry.names import EventKind
@@ -273,10 +290,14 @@ def _cmd_plan(args) -> int:
         return 0
     running = report.get("running")
     if running:
-        print(f"running: mesh={running.get('mesh')} "
-              f"window={running.get('train_window')} "
-              f"K={running.get('steps_per_call')} "
-              f"world={running.get('world')}")
+        line = (f"running: mesh={running.get('mesh')} "
+                f"window={running.get('train_window')} "
+                f"K={running.get('steps_per_call')} "
+                f"world={running.get('world')}")
+        if running.get("dispatch_chunks"):
+            line += f" C={running.get('dispatch_chunks')}"
+        print(line)
+    _print_exposed_comm(report.get("exposed_comm"))
     corr = report.get("corrections")
     if corr:
         print(f"calibration: compute x{corr.get('compute')} "
@@ -292,8 +313,10 @@ def _cmd_plan(args) -> int:
             line += (f" plan={d.get('plan_id')} -> "
                      f"K={c.get('steps_per_call')} "
                      f"window={c.get('train_window')} "
-                     f"mesh={c.get('mesh')} "
-                     f"predicted {d.get('predicted_speedup')}x")
+                     f"mesh={c.get('mesh')} ")
+            if c.get("dispatch_chunks"):
+                line += f"C={c.get('dispatch_chunks')} "
+            line += f"predicted {d.get('predicted_speedup')}x"
             if d.get("applied"):
                 line += (f" (applied, realized "
                          f"{d.get('realized_speedup')}x)")
@@ -301,8 +324,11 @@ def _cmd_plan(args) -> int:
             line += f" ({d.get('reason')})"
         print(line)
         for c in (d.get("candidates") or [])[:4]:
+            chunk = (f" C={c.get('dispatch_chunks')}"
+                     if c.get("dispatch_chunks") else "")
             print(f"    candidate K={c.get('steps_per_call')} "
                   f"window={c.get('train_window')} mesh={c.get('mesh')}"
+                  f"{chunk}"
                   f" -> {c.get('predicted_step_s')}s/step "
                   f"({c.get('speedup')}x)")
         for m in d.get("memory_rejected") or []:
@@ -414,6 +440,7 @@ def _cmd_attribution(args) -> int:
     if args.json:
         print(json.dumps(report))
         return 0
+    _print_exposed_comm(report.get("exposed_comm"))
     for node_id, sample in sorted((report.get("nodes") or {}).items()):
         if not sample:
             continue

@@ -73,6 +73,7 @@ class ElasticTrainer:
         devices=None,
         steps_per_call: Optional[int] = None,
         model_spec=None,
+        dispatch_chunks: Optional[int] = None,
     ):
         self._init_fn = init_fn
         self._loss_fn = loss_fn
@@ -97,6 +98,18 @@ class ElasticTrainer:
                 get_context(), "steps_per_call", 1
             ))
         self.steps_per_call = max(1, int(steps_per_call))
+        # grouped_ep chunked-dispatch degree: a COMPILED-program knob
+        # like steps_per_call (the program-cache key carries it, and
+        # retune/prewarm swap it live). The model reads it from the
+        # Context at trace time (ops.moe.resolve_dispatch_chunks), so
+        # _build pins the Context knob to this trainer's value before
+        # any build — and the lazy jit trace that follows — runs.
+        if dispatch_chunks is None:
+            from dlrover_tpu.common.config import get_context
+
+            dispatch_chunks = int(getattr(
+                get_context(), "dispatch_chunks", 1))
+        self.dispatch_chunks = max(1, int(dispatch_chunks))
         # explicit device set (default: the whole jax.devices() world);
         # the agent hands the post-change survivor subset to
         # on_world_change, and dryruns carve sub-worlds out of one host
@@ -197,6 +210,7 @@ class ElasticTrainer:
             topology_key(devices)
             + f"|k={self.steps_per_call}"
             + f"|mesh={mesh_axes_key(strategy.mesh)}"
+            + f"|c={self.dispatch_chunks}"
         )
 
     def _build(self, devices: Optional[list]) -> AccelerateResult:
@@ -206,6 +220,13 @@ class ElasticTrainer:
         num_devices = len(actual)
         if self._initial_devices is None:
             self._initial_devices = num_devices
+        # pin the trace-time knob BEFORE anything compiles (jit is
+        # lazy: the trace may land on the first post-build step, so the
+        # Context value must persist — on a prewarm/failed retune the
+        # caller restores it alongside self.dispatch_chunks)
+        from dlrover_tpu.common.config import get_context
+
+        get_context().dispatch_chunks = self.dispatch_chunks
         strategy = self._resolved_strategy(num_devices)
         key = self._program_key(actual, strategy)
         self._current_program_key = key
@@ -404,7 +425,7 @@ class ElasticTrainer:
 
     def prewarm(self, devices=None, execute: bool = True,
                 steps_per_call: Optional[int] = None,
-                mesh=None) -> bool:
+                mesh=None, dispatch_chunks: Optional[int] = None) -> bool:
         """Standby-compile the program for a topology OR knob set we may
         swap to — the (N - node_unit)-device survivor world before a
         failure, or an optimizer-chosen (``steps_per_call``, mesh
@@ -421,24 +442,33 @@ class ElasticTrainer:
         on the standby submesh; pass ``execute=False`` on models too
         large to double-book (the swap then pays the compile, but
         still skips the strategy/mesh rebuild)."""
+        from dlrover_tpu.common.config import get_context
+
         prev_k, prev_mesh = self.steps_per_call, self._mesh_override
+        prev_c = self.dispatch_chunks
         prev_key = self._current_program_key
         if steps_per_call is not None:
             self.steps_per_call = max(1, int(steps_per_call))
         if mesh is not None:
             self._mesh_override = mesh
+        if dispatch_chunks is not None:
+            self.dispatch_chunks = max(1, int(dispatch_chunks))
         try:
             before = self.compile_count
             result = self._build(
                 list(devices) if devices is not None else None)
             compiled = self.compile_count > before
             if execute and compiled:
+                # the dummy step also forces the standby TRACE, which
+                # is when ops.moe reads the chunk knob off the Context
                 self._execute_dummy_step(result)
         finally:
             self.steps_per_call = prev_k
             self._mesh_override = prev_mesh
-            # the ACTIVE program is unchanged: its attribution identity
-            # must not be re-pointed at the standby key
+            self.dispatch_chunks = prev_c
+            # the ACTIVE program keeps its trace-time knob (and its
+            # attribution identity — not re-pointed at the standby key)
+            get_context().dispatch_chunks = prev_c
             self._current_program_key = prev_key
         return compiled
 
@@ -474,22 +504,28 @@ class ElasticTrainer:
         )
 
     def retune(self, state: Any, steps_per_call: Optional[int] = None,
-               mesh=None, reason: str = "optimizer") -> Any:
+               mesh=None, dispatch_chunks: Optional[int] = None,
+               reason: str = "optimizer") -> Any:
         """Apply optimizer-chosen PROGRAM knobs on the current world
         without a restart: ``steps_per_call`` (the lax.scan multi-step
-        degree) and/or a mesh override (a different factorization of
-        the same devices). Same mechanics as ``live_reshard`` — the
-        caller drains its window first; snapshot → rebuild → reshard —
-        but against the unchanged device set, and through the program
+        degree), ``dispatch_chunks`` (the grouped_ep chunked-dispatch
+        degree — a trace-time knob the program-cache key carries)
+        and/or a mesh override (a different factorization of the same
+        devices). Same mechanics as ``live_reshard`` — the caller
+        drains its window first; snapshot → rebuild → reshard — but
+        against the unchanged device set, and through the program
         cache keyed on these very knobs, so a prewarmed knob set swaps
         with ZERO recompiles. On failure the previous knobs (and the
         previously compiled program) are restored and the error
         propagates — the job keeps running the old config."""
         prev_k, prev_mesh = self.steps_per_call, self._mesh_override
+        prev_c = self.dispatch_chunks
         if steps_per_call is not None:
             self.steps_per_call = max(1, int(steps_per_call))
         if mesh is not None:
             self._mesh_override = mesh
+        if dispatch_chunks is not None:
+            self.dispatch_chunks = max(1, int(dispatch_chunks))
         try:
             return self.live_reshard(
                 state, devices=self._devices, reason=reason,
@@ -498,8 +534,10 @@ class ElasticTrainer:
         except Exception:
             self.steps_per_call = prev_k
             self._mesh_override = prev_mesh
-            # re-point at the old program (cache hit) so the trainer
-            # stays runnable with the pre-retune config
+            self.dispatch_chunks = prev_c
+            # re-point at the old program (cache hit, and the Context
+            # chunk knob re-pinned by _build) so the trainer stays
+            # runnable with the pre-retune config
             self._result = self._build(self._devices)
             raise
 

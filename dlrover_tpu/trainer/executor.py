@@ -103,10 +103,16 @@ class ReportModelInfoHook(TrainHook):
     hooks.py:59 ``ReportModelMetricHook``)."""
 
     def __init__(self, master_client, param_count: int = 0,
-                 flops_per_step: float = 0.0, every_steps: int = 20):
+                 flops_per_step: float = 0.0, every_steps: int = 20,
+                 model_spec=None):
         self._client = master_client
         self._param_count = param_count
         self._flops = flops_per_step
+        # optional planner ModelSpec: carries the shape facts (layers,
+        # hidden, experts) the master's runtime optimizer needs to
+        # price knob families — without them the calibrated spec is a
+        # dense placeholder and e.g. dispatch_chunks never competes
+        self._model_spec = model_spec
         self._every = max(every_steps, 1)
         reg = get_registry()
         self._c_reports = reg.counter(
@@ -121,9 +127,21 @@ class ReportModelInfoHook(TrainHook):
         try:
             from dlrover_tpu.common import comm
 
+            spec = self._model_spec
+            extra = {}
+            if spec is not None:
+                extra = dict(
+                    hidden_size=int(getattr(spec, "hidden_size", 0)),
+                    num_layers=int(getattr(spec, "num_layers", 0)),
+                    seq_len=int(getattr(spec, "seq_len", 0)),
+                    num_experts=int(getattr(spec, "num_experts", 0)),
+                    moe_top_k=int(getattr(spec, "moe_top_k", 1)),
+                    ffn_mult=float(getattr(spec, "ffn_mult", 0.0)),
+                )
             self._client.report_model_info(comm.ModelInfo(
                 num_params=self._param_count,
                 flops_per_step=self._flops,
+                **extra,
             ))
             self._c_reports.inc()
         except Exception:  # noqa: BLE001
@@ -402,7 +420,8 @@ class OptimizerPlanHook(TrainHook):
             return
         import jax
 
-        wants_program = bool(cfg.steps_per_call) or bool(cfg.mesh_shape)
+        wants_program = (bool(cfg.steps_per_call) or bool(cfg.mesh_shape)
+                         or bool(getattr(cfg, "dispatch_chunks", 0)))
         if wants_program and jax.process_count() > 1:
             # each process polls on its own clock: an in-place program
             # swap applied at different wall times would diverge the
@@ -443,6 +462,8 @@ class OptimizerPlanHook(TrainHook):
                           if cfg.train_window >= 0 else None),
             mesh_shape=(dict(cfg.mesh_shape) if cfg.mesh_shape
                         else None),
+            dispatch_chunks=(
+                getattr(cfg, "dispatch_chunks", 0) or None),
             plan_id=plan_id,
             trace_id=getattr(cfg, "trace_id", "") or "",
             predicted_speedup=float(
@@ -827,18 +848,20 @@ class TrainExecutor:
     def request_retune(self, steps_per_call: Optional[int] = None,
                        train_window: Optional[int] = None,
                        mesh_shape: Optional[Dict[str, int]] = None,
+                       dispatch_chunks: Optional[int] = None,
                        plan_id: str = "", trace_id: str = "",
                        predicted_speedup: float = 0.0,
                        prewarm: bool = True):
         """A runtime-optimizer plan arrived (``OptimizerPlanHook``):
         apply it at the next loop boundary — drain the window, then
         retune the host knob (``train_window``) in place and swap the
-        compiled program (``steps_per_call`` / mesh override) through
-        the program cache. No process restart."""
+        compiled program (``steps_per_call`` / ``dispatch_chunks`` /
+        mesh override) through the program cache. No process restart."""
         self._retune_request = {
             "steps_per_call": steps_per_call,
             "train_window": train_window,
             "mesh_shape": dict(mesh_shape) if mesh_shape else None,
+            "dispatch_chunks": dispatch_chunks,
             "plan_id": plan_id,
             "trace_id": trace_id,
             "predicted_speedup": float(predicted_speedup or 0.0),
@@ -946,14 +969,20 @@ class TrainExecutor:
     def _apply_plan_scoped(self, req: Dict[str, Any], plan_id: str):
         k = req.get("steps_per_call")
         w = req.get("train_window")
+        ch = req.get("dispatch_chunks")
         mesh = self._mesh_override_from(req.get("mesh_shape"))
         cur_k = max(1, int(getattr(self._trainer, "steps_per_call", 1)))
         if k is not None and int(k) == cur_k:
             k = None
-        needs_program = k is not None or mesh is not None
+        cur_c = max(1, int(getattr(
+            self._trainer, "dispatch_chunks", 1)))
+        if ch is not None and int(ch) == cur_c:
+            ch = None
+        needs_program = (k is not None or mesh is not None
+                         or ch is not None)
         emit_event(
             EventKind.OPTIMIZER_APPLY_BEGIN, plan_id=plan_id,
-            steps_per_call=k, train_window=w,
+            steps_per_call=k, train_window=w, dispatch_chunks=ch,
             mesh=req.get("mesh_shape") if mesh is not None else None,
             step=int(getattr(self.state, "step", 0)),
         )
@@ -975,10 +1004,12 @@ class TrainExecutor:
                     prewarmed = self._trainer.prewarm(
                         devices=getattr(self._trainer, "devices", None),
                         steps_per_call=k, mesh=mesh,
+                        dispatch_chunks=ch,
                     )
                 compiles_before = self._trainer.compile_count
                 self.state = self._trainer.retune(
                     self.state, steps_per_call=k, mesh=mesh,
+                    dispatch_chunks=ch,
                 )
                 recompiled = (
                     self._trainer.compile_count - compiles_before
@@ -1023,6 +1054,8 @@ class TrainExecutor:
             prewarmed=prewarmed, train_window=self._train_window,
             steps_per_call=int(getattr(
                 self._trainer, "steps_per_call", 1)),
+            dispatch_chunks=int(getattr(
+                self._trainer, "dispatch_chunks", 1)),
         )
         logger.info(
             "optimizer plan %s applied in %.2fs (recompiled=%d, "
@@ -1098,12 +1131,22 @@ class TrainExecutor:
                 a: int(v)
                 for a, v in result.strategy.mesh.axis_sizes().items()
             }
+            # the MoE dispatch mode lives in the MODEL config; the
+            # trainer sees it only through its planner ModelSpec —
+            # report it when known so the optimizer's dispatch_chunks
+            # family unlocks (it gates on moe_dispatch=="grouped_ep")
+            spec = getattr(self._trainer, "_model_spec", None)
             self._master_client.report_trainer_config(
                 world=int(result.mesh.devices.size),
                 mesh_shape=mesh_shape,
                 train_window=int(self._train_window),
                 steps_per_call=int(getattr(
                     self._trainer, "steps_per_call", 1)),
+                dispatch_chunks=int(getattr(
+                    self._trainer, "dispatch_chunks", 1)),
+                moe_dispatch=(
+                    getattr(spec, "moe_dispatch", "")
+                    if getattr(spec, "num_experts", 0) else ""),
                 global_batch=int(
                     result.strategy.global_batch_size or 0),
                 plan_id=plan_id,

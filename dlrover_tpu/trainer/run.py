@@ -84,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optimizer steps fused per compiled call "
                         "(lax.scan multi-step; workers see it as "
                         "DLROVER_TPU_STEPS_PER_CALL)")
+    p.add_argument("--dispatch_chunks", type=int, default=None,
+                   help="chunked grouped_ep MoE dispatch: split the "
+                        "row exchange into this many double-buffered "
+                        "ppermute-ring chunks (1 = serial one-shot "
+                        "all_to_all; workers see it as "
+                        "DLROVER_TPU_DISPATCH_CHUNKS; the runtime "
+                        "optimizer retunes it live)")
     p.add_argument("--live_recovery", "--live-recovery",
                    dest="live_recovery", action="store_true",
                    help="absorb survivable membership changes with an "
@@ -179,6 +186,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.environ["DLROVER_TPU_TRAIN_WINDOW"] = str(args.train_window)
     if args.steps_per_call is not None:
         os.environ["DLROVER_TPU_STEPS_PER_CALL"] = str(args.steps_per_call)
+    if args.dispatch_chunks is not None:
+        os.environ["DLROVER_TPU_DISPATCH_CHUNKS"] = str(
+            args.dispatch_chunks)
     if args.live_recovery:
         # workers' executors route survivable changes to the in-process
         # reshard path (Context.live_recovery reads this at import)
