@@ -594,6 +594,41 @@ def main() -> int:
 DISPATCH_SPEEDUP_TARGET = 1.5
 
 
+def _params_bitwise_equal(a, b) -> bool:
+    """Bit-for-bit pytree equality — the parity comparator every
+    paired-leg wedge (dispatch / overlap / precision) shares, so the
+    contract cannot drift between them."""
+    import jax
+    import numpy as np
+
+    leaves_a = jax.tree.leaves(a)
+    leaves_b = jax.tree.leaves(b)
+    return len(leaves_a) == len(leaves_b) and all(
+        np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        for x, y in zip(leaves_a, leaves_b)
+    )
+
+
+def _warmup_timer(trainer, warmup: int):
+    """The shared timed-region hook: t0 at the dispatch of the first
+    post-warmup step; the compiled-cache snapshot there is the
+    zero-recompile reference every wedge gates on."""
+    from dlrover_tpu.trainer.executor import TrainHook
+
+    class _Timer(TrainHook):
+        def __init__(self):
+            self.t0 = None
+            self.cache_at_t0 = None
+
+        def before_step(self, step):
+            if step == warmup + 1 and self.t0 is None:
+                self.cache_at_t0 = (
+                    trainer.accelerated.compiled_cache_size())
+                self.t0 = time.perf_counter()
+
+    return _Timer()
+
+
 def dispatch_result() -> dict:
     """Measure the async dispatch pipeline on the tiny CPU-mesh model:
     steps/sec for {sync, window=W, window=W + steps_per_call=K} through
@@ -645,20 +680,6 @@ def dispatch_result() -> dict:
     def cache_sizes(trainer):
         return trainer.accelerated.compiled_cache_size()
 
-    class TimedRegion(TrainHook):
-        """t0 at the dispatch of the first post-warmup step; the cache
-        snapshot there is the zero-recompile reference."""
-
-        def __init__(self, trainer):
-            self.trainer = trainer
-            self.t0 = None
-            self.cache_at_t0 = None
-
-        def before_step(self, step):
-            if step == warmup + 1 and self.t0 is None:
-                self.cache_at_t0 = cache_sizes(self.trainer)
-                self.t0 = time.perf_counter()
-
     def run_mode(mode_window, mode_spc, telemetry=True,
                  mode_steps=None, attribution=True):
         from dlrover_tpu.common.config import get_context
@@ -676,7 +697,7 @@ def dispatch_result() -> dict:
             strategy=Strategy(mesh=MeshPlan(data=-1)),
             steps_per_call=mode_spc,
         )
-        timer = TimedRegion(trainer)
+        timer = _warmup_timer(trainer, warmup)
         executor = TrainExecutor(
             trainer,
             train_iter_fn=lambda: itertools.repeat(batch),
@@ -778,22 +799,12 @@ def dispatch_result() -> dict:
         max(0.0, median_ratio - 1.0) * 100.0, 2
     )
 
-    def bitwise_equal(a, b):
-        import jax
-
-        leaves_a = jax.tree.leaves(a)
-        leaves_b = jax.tree.leaves(b)
-        return len(leaves_a) == len(leaves_b) and all(
-            np.asarray(x).tobytes() == np.asarray(y).tobytes()
-            for x, y in zip(leaves_a, leaves_b)
-        )
-
     parity = (
-        bitwise_equal(sync_params, win_params)
-        and bitwise_equal(sync_params, scan_params)
+        _params_bitwise_equal(sync_params, win_params)
+        and _params_bitwise_equal(sync_params, scan_params)
         # telemetry must be observation-only: the bare and instrumented
         # A/B arms (same step count as each other) stay bit-identical
-        and bitwise_equal(bare_params, inst_params)
+        and _params_bitwise_equal(bare_params, inst_params)
     )
     speedup = scan_rate / max(sync_rate, 1e-9)
     result_line = {
@@ -906,18 +917,6 @@ def overlap_result() -> dict:
     mesh = (MeshPlan(data=2, fsdp=2, tensor=2) if n_dev >= 8
             else MeshPlan(data=1, fsdp=max(1, n_dev)))
 
-    class TimedRegion(TrainHook):
-        def __init__(self, trainer):
-            self.trainer = trainer
-            self.t0 = None
-            self.cache_at_t0 = None
-
-        def before_step(self, step):
-            if step == warmup + 1 and self.t0 is None:
-                self.cache_at_t0 = (
-                    self.trainer.accelerated.compiled_cache_size())
-                self.t0 = time.perf_counter()
-
     def run_leg(c):
         trainer = ElasticTrainer(
             llama.make_init_fn(cfg),
@@ -926,6 +925,11 @@ def overlap_result() -> dict:
             batch,
             strategy=Strategy(mesh=mesh, rule_set="moe_ep"),
             dispatch_chunks=c,
+            # wire precision pinned too: a live precision retune earlier
+            # in the process (the replan wedge) leaves the Context knob
+            # at its chosen value, and an implicit resolve here would
+            # silently run the overlap legs on the fp8 wire
+            moe_precision="bf16",
             # chunk degree pinned EXPLICITLY into the spec: a 0 here
             # would resolve the Context knob at spec-build time — the
             # PREVIOUS leg's value, since the trainer pins Context only
@@ -937,7 +941,7 @@ def overlap_result() -> dict:
                                  moe_dispatch_chunks=c),
                 ids.shape[0]),
         )
-        timer = TimedRegion(trainer)
+        timer = _warmup_timer(trainer, warmup)
         executor = TrainExecutor(
             trainer,
             train_iter_fn=lambda: itertools.repeat(batch),
@@ -982,13 +986,6 @@ def overlap_result() -> dict:
     finally:
         get_context().telemetry_enabled = prev_telemetry
 
-    def bitwise_equal(a, b):
-        la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
-        return len(la) == len(lb) and all(
-            np.asarray(x).tobytes() == np.asarray(y).tobytes()
-            for x, y in zip(la, lb)
-        )
-
     def close(a, b):
         return all(
             np.allclose(np.asarray(x), np.asarray(y),
@@ -997,9 +994,9 @@ def overlap_result() -> dict:
         )
 
     parity = (
-        all(bitwise_equal(legs_off[0]["params"], leg["params"])
+        all(_params_bitwise_equal(legs_off[0]["params"], leg["params"])
             for leg in legs_off[1:])
-        and all(bitwise_equal(legs_on[0]["params"], leg["params"])
+        and all(_params_bitwise_equal(legs_on[0]["params"], leg["params"])
                 for leg in legs_on[1:])
         and close(legs_off[0]["params"], legs_on[0]["params"])
     )
@@ -1057,6 +1054,220 @@ def overlap_result() -> dict:
     return result_line
 
 
+def precision_result() -> dict:
+    """Paired bf16-vs-fp8 legs of the grouped_ep MoE wire (ISSUE 11):
+    the same tiny MoE llama trained through the real ``ElasticTrainer``
+    / ``TrainExecutor`` loop with ``moe_precision="bf16"`` vs ``"fp8"``
+    (block-scaled e4m3 values + f32 per-block scales on every row
+    exchange, forward and backward), back-to-back pairs in alternating
+    order with the MEDIAN of per-pair ratios, zero recompiles after
+    warmup — plus ONE ``fp8_qdq`` reference leg whose final params
+    must be BIT-identical to the fp8 leg's (the dequant-exact parity
+    contract: quantization commutes with the row exchange, so the
+    quantized wire changes transport, never numbers).
+
+    The accounting the artifact carries: each leg's measured
+    all-to-all row bytes from the attribution record (the same
+    ``collective_bytes_by_kind`` counter the G106 audit reads) beside
+    the planner's dtype-aware prediction
+    (``predicted_collective_bytes`` moe_dispatch, fp8/bf16 = 0.5625
+    with the 32-channel scale side-band included) — the wire-bytes
+    halving is verified on the COMPILED program, not asserted from the
+    formula.
+
+    On the CPU mesh the exchanges are memcpys, so the steps/sec RATIO
+    is recorded, not gated — the fp8 win is a hardware row, labeled
+    pending the tunnel (ROADMAP item 5). Env: BENCH_PRECISION_STEPS
+    (timed steps/leg, default 48), BENCH_PRECISION_PAIRS (default 3).
+    """
+    import itertools
+
+    import jax
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.common.config import get_context
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import MeshPlan
+    from dlrover_tpu.parallel.planner import (
+        model_spec_from_llama,
+        predicted_collective_bytes,
+    )
+    from dlrover_tpu.parallel.strategy import Strategy
+    from dlrover_tpu.trainer.conf import Configuration
+    from dlrover_tpu.trainer.elastic import ElasticTrainer
+    from dlrover_tpu.trainer.executor import TrainExecutor, TrainHook
+
+    steps = int(os.environ.get("BENCH_PRECISION_STEPS", "48"))
+    pairs = int(os.environ.get("BENCH_PRECISION_PAIRS", "3"))
+    warmup = 4
+    n_dev = len(jax.devices())
+
+    cfg = llama.llama_tiny(num_experts=8, moe_dispatch="grouped_ep")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(8, 17))
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    mesh = (MeshPlan(data=2, fsdp=2, tensor=2) if n_dev >= 8
+            else MeshPlan(data=1, fsdp=max(1, n_dev)))
+
+    def spec_at(precision):
+        return model_spec_from_llama(
+            llama.llama_tiny(num_experts=8, moe_dispatch="grouped_ep",
+                             moe_precision=precision),
+            ids.shape[0])
+
+    def run_leg(precision):
+        trainer = ElasticTrainer(
+            llama.make_init_fn(cfg),
+            llama.make_loss_fn(cfg),
+            optax.adafactor(1e-3),
+            batch,
+            strategy=Strategy(mesh=mesh, rule_set="moe_ep"),
+            moe_precision=precision,
+            # chunks pinned to the serial exchange: this wedge isolates
+            # the WIRE PRECISION; a leaked Context chunk knob would
+            # reroute the rows onto the ppermute ring mid-comparison
+            dispatch_chunks=1,
+            # precision pinned EXPLICITLY into the spec (the
+            # overlap_result Context-staleness lesson applies
+            # unchanged)
+            model_spec=spec_at(precision),
+        )
+        timer = _warmup_timer(trainer, warmup)
+        executor = TrainExecutor(
+            trainer,
+            train_iter_fn=lambda: itertools.repeat(batch),
+            hooks=[timer],
+            conf=Configuration({
+                "train_steps": warmup + steps,
+                "log_every_steps": 0,
+                "train_window": 2,
+                "preemption_grace": False,
+            }),
+        )
+        # the wedge must not masquerade: if the fp8 probe failed, the
+        # trainer degraded this leg to the bf16 wire (logged) and an
+        # artifact labeled fp8 would be fiction — record the EFFECTIVE
+        # precision and let the caller error the run
+        effective = trainer.moe_precision
+        executor.train_and_evaluate()
+        dt = time.perf_counter() - timer.t0
+        recompiles = (trainer.accelerated.compiled_cache_size()
+                      - timer.cache_at_t0)
+        record = trainer.attribution()
+        row_bytes = None
+        if record is not None:
+            # the G106 counter: exchange traffic of the compiled
+            # program (all_to_all at C=1; the ring would show up as
+            # collective-permute), per device per step
+            cb = record.collective_bytes or {}
+            row_bytes = (cb.get("all-to-all", 0.0)
+                         + cb.get("collective-permute", 0.0))
+        params = jax.device_get(executor.state.params)
+        return {
+            "rate": steps / dt,
+            "recompiles": recompiles,
+            "params": params,
+            "measured_row_bytes": row_bytes,
+            "degraded": effective != precision,
+        }
+
+    prev_telemetry = get_context().telemetry_enabled
+    get_context().telemetry_enabled = True
+    legs_q, legs_b, ratios, recompiles = [], [], [], 0
+    try:
+        for i in range(pairs):
+            order = (("bf16", "fp8") if i % 2 == 0
+                     else ("fp8", "bf16"))
+            res = {p: run_leg(p) for p in order}
+            legs_b.append(res["bf16"])
+            legs_q.append(res["fp8"])
+            ratios.append(res["fp8"]["rate"]
+                          / max(res["bf16"]["rate"], 1e-9))
+            recompiles += (res["bf16"]["recompiles"]
+                           + res["fp8"]["recompiles"])
+        # the dequant-exact parity leg: the qdq reference (full-
+        # precision wire, identical quantize->dequantize math) must
+        # land on BIT-identical final params
+        ref_leg = run_leg("fp8_qdq")
+    finally:
+        get_context().telemetry_enabled = prev_telemetry
+
+    parity = (
+        all(_params_bitwise_equal(legs_b[0]["params"], leg["params"])
+            for leg in legs_b[1:])
+        and all(_params_bitwise_equal(legs_q[0]["params"], leg["params"])
+                for leg in legs_q[1:])
+        and _params_bitwise_equal(legs_q[0]["params"], ref_leg["params"])
+    )
+    median_ratio = sorted(ratios)[len(ratios) // 2]
+    resolved = mesh.resolve(n_dev)
+    pred_b = predicted_collective_bytes(
+        resolved, spec_at("bf16"))["moe_dispatch"]
+    pred_q = predicted_collective_bytes(
+        resolved, spec_at("fp8"))["moe_dispatch"]
+    mb = legs_b[-1]["measured_row_bytes"]
+    mq = legs_q[-1]["measured_row_bytes"]
+    measured_ratio = (mq / mb) if (mb and mq) else None
+    result_line = {
+        "metric": "moe_wire_precision_ratio",
+        "value": round(median_ratio, 3),
+        "unit": "x",
+        # CPU mesh: exchanges are local memcpys, so halving their
+        # bytes buys ~nothing here — the speed ratio is recorded, NOT
+        # gated; the fp8 win is a hardware row pending the tunnel
+        "vs_baseline": None,
+        "platform": "cpu",
+        "pending_hardware": True,
+        "detail": {
+            "moe_precision": "fp8",
+            "timed_steps_per_leg": steps,
+            "pairs": pairs,
+            "pair_ratios": [round(r, 3) for r in ratios],
+            "bf16_steps_per_s": round(
+                max(leg["rate"] for leg in legs_b), 2),
+            "fp8_steps_per_s": round(
+                max(leg["rate"] for leg in legs_q), 2),
+            "recompiles_after_warmup": recompiles,
+            # bitwise within same-precision legs AND fp8 == fp8_qdq
+            # (the dequant-exact contract); fp8-vs-bf16 params are NOT
+            # compared — quantization legitimately changes the numbers
+            # (G109 bounds that drift)
+            "params_parity": parity,
+            "n_devices": n_dev,
+            "wire_bytes": {
+                # the G106 counter's view of each compiled program
+                # (per device per step) beside the planner's
+                # dtype-aware prediction — both ratios should sit near
+                # 0.5625 (1-byte values + f32/32 scale side-band over
+                # a 2-byte wire... here f32 tokens, so lower still)
+                "bf16_measured": mb,
+                "fp8_measured": mq,
+                "measured_ratio": (round(measured_ratio, 4)
+                                   if measured_ratio else None),
+                "bf16_predicted": round(pred_b, 1),
+                "fp8_predicted": round(pred_q, 1),
+                "predicted_ratio": round(pred_q / pred_b, 4),
+            },
+        },
+    }
+    degraded = (ref_leg["degraded"]
+                or any(leg["degraded"] for leg in legs_q + legs_b))
+    if degraded:
+        result_line["error"] = (
+            "fp8 probe failed on this backend: legs degraded to the "
+            "bf16 wire — no fp8 measurement exists to publish"
+        )
+    elif not parity:
+        result_line["error"] = (
+            "final params diverged across same-precision legs or "
+            "between fp8 and the qdq reference"
+        )
+    elif recompiles:
+        result_line["error"] = "recompile inside the timed region"
+    return result_line
+
+
 def dispatch_main() -> int:
     result_line = dispatch_result()
     print(json.dumps(result_line))
@@ -1083,8 +1294,21 @@ def dispatch_main() -> int:
     if overlap_artifact:
         with open(overlap_artifact, "w") as f:
             f.write(json.dumps(overlap_line) + "\n")
+    # the low-precision wire wedge (fp8 grouped_ep, ISSUE 11) rides the
+    # dispatch mode too and writes its own artifact
+    precision_line = precision_result()
+    print(json.dumps(precision_line))
+    precision_artifact = os.environ.get(
+        "BENCH_PRECISION_ARTIFACT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_r10.json"),
+    )
+    if precision_artifact:
+        with open(precision_artifact, "w") as f:
+            f.write(json.dumps(precision_line) + "\n")
     return 1 if (result_line.get("error")
-                 or overlap_line.get("error")) else 0
+                 or overlap_line.get("error")
+                 or precision_line.get("error")) else 0
 
 
 # -- recovery (MTTR) mode ----------------------------------------------------

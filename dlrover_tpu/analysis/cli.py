@@ -135,6 +135,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             reports.extend(graph_lint.moe_dispatch_audit(
                 rules=rules, audit_tol=tol
             ))
+        # the quantization-drift probe rides the same gate: it is the
+        # numerics face of the moe audit (G109 — the quantized program
+        # vs its bf16 twin on a fixed probe batch, judged against the
+        # ratcheted quant_baseline.json)
+        if not args.no_moe_audit and (rules is None or "G109" in rules):
+            # the only graph pass that EXECUTES a program: a host that
+            # cannot run it (too few devices, broken backend) skips the
+            # probe with a warning instead of killing the whole lint
+            # run and the findings already computed
+            try:
+                reports.append(graph_lint.quantization_drift_audit())
+            except Exception as e:  # noqa: BLE001
+                import logging
+
+                logging.getLogger("dlrover_tpu.analysis").warning(
+                    "quantization drift probe skipped", exc_info=True)
+                print(f"quantization drift probe skipped: "
+                      f"{type(e).__name__}: {e}")
         for rep in reports:
             all_findings.extend(rep.findings)
 

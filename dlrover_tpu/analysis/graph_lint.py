@@ -43,7 +43,7 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger("analysis.graph")
 
 ALL_GRAPH_RULES = ("G101", "G102", "G103", "G104", "G105", "G106",
-                   "G107", "G108")
+                   "G107", "G108", "G109")
 
 GRAPH_RULE_DOCS: Dict[str, str] = {
     "G101": "params the strategy shards are replicated in the compiled "
@@ -62,6 +62,9 @@ GRAPH_RULE_DOCS: Dict[str, str] = {
     "G108": "a large collective's result is consumed with no "
             "independent compute between issue and use — the network "
             "sits on the critical path (overlap opportunity)",
+    "G109": "a quantized program's output drifts from its bf16 twin "
+            "beyond the ratcheted per-model baseline (numerics "
+            "regression)",
 }
 
 # G108: collectives below this output size are not worth overlapping
@@ -529,6 +532,178 @@ def check_serialized_collectives(
             if len(findings) >= max_findings:
                 return findings
     return findings
+
+
+# G109: how far above its committed baseline a model's quantization
+# drift may grow before the lint fires. The baseline is the drift
+# MEASURED at commit time (quant_baseline.json, per model label) — the
+# ratchet mirrors the AST baseline's discipline: today's numerics are
+# the contract, and a change that doubles the drift is a regression to
+# explain, not to absorb silently. 4x leaves room for routing jitter
+# across probe batches; an fp8 path gone wrong (scale bug, double
+# quantization, a dequant in the wrong dtype) moves drift by orders of
+# magnitude, not fractions.
+G109_DRIFT_RATIO = 4.0
+# the absolute floor under which drift differences are noise (f32
+# accumulation order), and the default tolerance when a model has no
+# committed baseline entry yet
+G109_DRIFT_FLOOR = 1e-5
+G109_DEFAULT_TOL = 0.02
+
+
+def check_quantization_drift(measured_drift: float,
+                             baseline_drift: Optional[float],
+                             ratio: float = G109_DRIFT_RATIO,
+                             path: str = "<train_step>",
+                             detail: str = "") -> List[Finding]:
+    """G109: the relative output drift of a quantized program against
+    its bf16 twin (same params, same probe batch) must stay within the
+    ratcheted per-model baseline — ``baseline * ratio``, floored so a
+    near-zero committed baseline cannot make reassociation noise fire.
+    ``baseline_drift=None`` (no committed entry) falls back to the
+    absolute default tolerance. The G104 extension the low-precision
+    paths needed: G104 catches dtype drift in the PROGRAM (f32 dots on
+    a bf16 path); G109 catches drift in the NUMBERS (a quantization
+    regression the graph text cannot show)."""
+    if baseline_drift is None:
+        tol = G109_DEFAULT_TOL
+        basis = f"default tolerance {G109_DEFAULT_TOL:g} (no baseline)"
+    else:
+        tol = max(float(baseline_drift) * ratio, G109_DRIFT_FLOOR)
+        basis = (f"baseline {baseline_drift:.3g} x {ratio:g} "
+                 f"(floor {G109_DRIFT_FLOOR:g})")
+    if measured_drift <= tol:
+        return []
+    return [Finding(
+        rule_id="G109", path=path, line=0,
+        message=f"quantized program drifts {measured_drift:.3g} "
+                f"(relative) from its bf16 twin on the fixed probe "
+                f"batch, above {basis}: the low-precision path's "
+                f"numerics regressed"
+                + (f" [{detail}]" if detail else ""),
+        fixit="bisect the quantization path (ops/quantize.py encode, "
+              "ops/grouped_matmul.py dequant-in-kernel, ops/moe.py "
+              "wire boundary); if the drift increase is understood and "
+              "acceptable, re-ratchet the model's entry in "
+              "dlrover_tpu/analysis/quant_baseline.json",
+    )]
+
+
+def quantization_drift_baseline_path() -> str:
+    import os
+
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "quant_baseline.json")
+
+
+def measure_quantization_drift(config=None, precision: str = "fp8",
+                               global_batch: int = 4):
+    """(drift, label): the relative loss difference between the
+    quantized program and its bf16-wire twin on a FIXED probe batch —
+    same params, same routing seed, only the wire precision differs.
+    Deterministic per backend (the probe is seeded and single-process),
+    which is what lets the baseline ratchet instead of tolerance-guess.
+
+    Default model: the tiny grouped_ep MoE llama over an explicit
+    4-way (data x fsdp) expert submesh — every quantized boundary
+    (row quantize, exchange, dequant-in-kernel, return wire) executes.
+    Runs on the HOST backend's devices (the probe needs to EXECUTE,
+    unlike the deviceless byte audits)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+
+    if config is None:
+        # chunks pinned to 1: the probe must not resolve an ambient
+        # Context chunk knob (drift is C-invariant — per-row outputs
+        # are exact at any C — but the baseline label should name ONE
+        # program shape)
+        config = llama.llama_tiny(
+            num_experts=8, moe_dispatch="grouped_ep", moe_top_k=2,
+            moe_dispatch_chunks=1,
+        )
+    # 4-way when the host has it, else 2-way — never an odd count the
+    # (n//2, 2) mesh reshape cannot tile (a 3-device host must probe
+    # on 2, not crash)
+    n = 4 if len(jax.devices()) >= 4 else 2
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "quantization drift probe needs >= 2 devices for the "
+            "expert submesh"
+        )
+    from jax.sharding import Mesh
+
+    mesh = Mesh(
+        np.array(jax.devices()[:n]).reshape(n // 2, 2),
+        ("data", "fsdp"),
+    )
+    rng = np.random.RandomState(0)
+    seq = config.max_seq_len
+    ids = rng.randint(0, config.vocab_size, size=(global_batch, seq + 1))
+    batch = {
+        "input_ids": jnp.asarray(ids[:, :-1]),
+        "labels": jnp.asarray(ids[:, 1:]),
+    }
+    params = llama.init(jax.random.PRNGKey(0), config)
+
+    def loss_at(prec: str) -> float:
+        cfg = dataclasses.replace(config, mesh=mesh, moe_precision=prec)
+        loss_fn = llama.make_loss_fn(cfg)
+        out = jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(1))
+        loss = out[0] if isinstance(out, tuple) else out
+        return float(jax.device_get(loss))
+
+    loss_q = loss_at(precision)
+    loss_b = loss_at("bf16")
+    drift = abs(loss_q - loss_b) / max(abs(loss_b), 1e-12)
+    # the label carries the EXECUTING backend: drift is a property of
+    # the kernels that ran (interpret-mode on cpu, Mosaic on tpu —
+    # different accumulation/fusion orders), so a baseline ratcheted
+    # on one backend must not judge another; a backend without an
+    # entry falls back to the absolute default tolerance
+    label = (f"llama_tiny_moe[grouped_ep,{precision}]"
+             f"@{jax.default_backend()}")
+    return drift, label
+
+
+def quantization_drift_audit(config=None, precision: str = "fp8",
+                             baseline_path: str = "",
+                             ratio: float = G109_DRIFT_RATIO,
+                             ) -> GraphLintReport:
+    """The G109 acceptance audit: run the quantized-vs-bf16 probe and
+    judge the drift against the committed per-model baseline
+    (``dlrover_tpu/analysis/quant_baseline.json``) — numerics
+    regressions fail ``tpulint`` / ``aot --lint`` the way byte
+    regressions (G106) already do."""
+    import json
+    import os
+
+    t0 = time.time()
+    drift, label = measure_quantization_drift(config, precision)
+    path = baseline_path or quantization_drift_baseline_path()
+    baseline_drift = None
+    if os.path.exists(path):
+        with open(path) as fh:
+            entries = json.load(fh).get("entries", {})
+        entry = entries.get(label)
+        if entry is not None:
+            baseline_drift = float(entry.get("drift", 0.0))
+    report = GraphLintReport(label=label)
+    report.findings = check_quantization_drift(
+        drift, baseline_drift, ratio=ratio, path=label,
+        detail=f"measured drift {drift:.3g}",
+    )
+    report.build_seconds = time.time() - t0
+    logger.info(
+        "quantization drift audit %s: drift %.3g vs baseline %s, "
+        "%d findings, %.1fs", label, drift, baseline_drift,
+        len(report.findings), report.build_seconds,
+    )
+    return report
 
 
 def check_memory_budget(peak_hbm_bytes: float, hbm_budget_bytes: float,

@@ -414,6 +414,9 @@ class ParallelConfig:
     # COMPILED-program knob, applied through the same prewarmed
     # program-cache swap as steps_per_call / mesh overrides
     dispatch_chunks: int = 0
+    # grouped_ep wire precision ("" = leave unchanged; "bf16"/"fp8"):
+    # the same prewarmed program-cache swap contract as dispatch_chunks
+    moe_precision: str = ""
     # optimizer decision identity: the worker echoes plan_id back in its
     # TrainerConfigReport ack, and every OPTIMIZER_* event on both sides
     # carries trace_id so the decision trail merges per incident
@@ -447,6 +450,9 @@ class TrainerConfigReport:
     # the grouped_ep chunk degree this worker actually runs (0 = not
     # reported / not applicable)
     dispatch_chunks: int = 0
+    # the grouped_ep wire precision this worker actually runs ("" =
+    # not reported / not applicable)
+    moe_precision: str = ""
     global_batch: int = 0
     plan_id: str = ""
     predicted_speedup: float = 0.0

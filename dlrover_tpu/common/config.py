@@ -178,6 +178,16 @@ class Context:
         # sharding the replicate-gather it issues can cost more than
         # it hides.
         self.fsdp_prefetch = False
+        # low-precision MoE wire (docs/parallelism.md "Low-precision"):
+        # the grouped_ep row exchanges' wire format — "bf16" (the
+        # compute dtype, no quantization), "fp8" (block-scaled e4m3
+        # values + f32 per-block scales, ~0.56x the bytes; G109 lints
+        # the numerics drift, G106 audits the bytes), or "fp8_qdq"
+        # (the bitwise reference oracle / debug mode). Resolved at
+        # TRACE time by ops.moe, so ElasticTrainer.retune can swap a
+        # running job's wire precision through the program cache; the
+        # runtime optimizer enumerates {bf16, fp8} as a knob family.
+        self.moe_precision = "bf16"
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -232,6 +232,7 @@ class CostCalibrator:
               train_window: int = 1,
               moe_dispatch: str = "",
               dispatch_chunks: int = 0,
+              moe_precision: str = "",
               require_fit: bool = True) -> float:
         """Calibrated predicted per-step seconds for one candidate.
 
@@ -259,6 +260,12 @@ class CostCalibrator:
             # dispatch comm (overlap_exposed_comm); bytes are invariant
             model = _dc.replace(model,
                                 moe_dispatch_chunks=int(dispatch_chunks))
+        if moe_precision and moe_precision != model.moe_precision:
+            # the precision knob reshapes the BYTES (the fp8 wire's
+            # values + scale side-band, ModelSpec.moe_wire_bytes_per_elem)
+            # — the dual of the chunk knob, priced through the same
+            # estimate
+            model = _dc.replace(model, moe_precision=moe_precision)
         k = max(1, int(steps_per_call))
         base = estimate(
             mesh, model, self.device, remat_policy=self.remat_policy,

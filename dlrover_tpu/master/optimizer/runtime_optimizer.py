@@ -86,6 +86,17 @@ STEPS_PER_CALL_OPTIONS = (1, 2, 4, 8)
 # product. Applied live through the same prewarmed program-cache swap
 # as steps_per_call (ElasticTrainer.retune(dispatch_chunks=...)).
 DISPATCH_CHUNKS_OPTIONS = (1, 2, 4, 8)
+# grouped_ep wire precisions the optimizer prices (ops.moe precision /
+# ops.quantize): the fp8 wire halves the dispatch-comm bytes the
+# planner prices, so on a comm-bound MoE job the family wins honestly.
+# Enumerated under the same parked-knob discipline as dispatch_chunks
+# (only when the worker REPORTS moe_dispatch="grouped_ep" — on any
+# other dispatch the knob is inert and would only widen the candidate
+# product), and applied live through the same prewarmed program-cache
+# swap (ElasticTrainer.retune(moe_precision=...)). "fp8_qdq" (the
+# reference oracle) is deliberately absent: it prices as bf16 and
+# exists to test against, never to run.
+MOE_PRECISION_OPTIONS = ("bf16", "fp8")
 # priced by the cost model, but NOT yet live-appliable: a dispatch-mode
 # change rebuilds the model, and enumeration is gated on the calibrator
 # seeing num_experts > 0 — which comm.ModelInfo does not carry yet, so
@@ -108,6 +119,7 @@ class RunningConfig:
     steps_per_call: int = 1
     moe_dispatch: str = ""
     dispatch_chunks: int = 1
+    moe_precision: str = "bf16"
     global_batch: int = 0
 
     @classmethod
@@ -126,6 +138,8 @@ class RunningConfig:
             moe_dispatch=report.moe_dispatch or "",
             dispatch_chunks=max(
                 1, int(getattr(report, "dispatch_chunks", 0) or 1)),
+            moe_precision=str(
+                getattr(report, "moe_precision", "") or "bf16"),
             global_batch=int(report.global_batch or 0),
         )
 
@@ -137,6 +151,7 @@ class RunningConfig:
             "steps_per_call": self.steps_per_call,
             "moe_dispatch": self.moe_dispatch,
             "dispatch_chunks": self.dispatch_chunks,
+            "moe_precision": self.moe_precision,
             "global_batch": self.global_batch,
         }
 
@@ -150,6 +165,7 @@ class CandidateScore:
     train_window: int
     moe_dispatch: str
     dispatch_chunks: int = 1
+    moe_precision: str = "bf16"
     predicted_step_s: float = 0.0
     speedup: float = 0.0  # current predicted / this predicted
 
@@ -159,6 +175,7 @@ class CandidateScore:
             f"mesh={mesh_axes_key(self.mesh)}"
             f"|k={self.steps_per_call}|w={self.train_window}"
             f"|moe={self.moe_dispatch}|c={self.dispatch_chunks}"
+            f"|p={self.moe_precision}"
         )
 
     def to_dict(self) -> Dict:
@@ -168,6 +185,7 @@ class CandidateScore:
             "train_window": self.train_window,
             "moe_dispatch": self.moe_dispatch,
             "dispatch_chunks": self.dispatch_chunks,
+            "moe_precision": self.moe_precision,
             "predicted_step_s": round(self.predicted_step_s, 6),
             "speedup": round(self.speedup, 3),
         }
@@ -407,6 +425,8 @@ class RuntimeOptimizer:
                                   or "grouped_ep"),
                     moe_dispatch_chunks=max(
                         1, self._running.dispatch_chunks),
+                    moe_precision=(self._running.moe_precision
+                                   or "bf16"),
                 )
                 if float(getattr(info, "ffn_mult", 0.0) or 0.0) > 0:
                     moe_kwargs["ffn_mult"] = float(info.ffn_mult)
@@ -517,11 +537,16 @@ class RuntimeOptimizer:
         # other mode the knob is a no-op the worker would ack but the
         # program would ignore
         chunk_opts = [max(1, run.dispatch_chunks)]
+        # the wire-precision family rides the same gate: a precision
+        # the running dispatch would silently ignore must not compete
+        precision_opts = [run.moe_precision or "bf16"]
         if (cal is not None and cal.model.num_experts > 0
                 and run.moe_dispatch == "grouped_ep"):
             chunk_opts = sorted(
                 {max(1, run.dispatch_chunks), *DISPATCH_CHUNKS_OPTIONS})
-        return meshes, ks, windows, moes, chunk_opts
+            precision_opts = sorted(
+                {run.moe_precision or "bf16", *MOE_PRECISION_OPTIONS})
+        return meshes, ks, windows, moes, chunk_opts, precision_opts
 
     def _price_candidates(self, run: RunningConfig
                           ) -> Tuple[List[CandidateScore], List[Dict]]:
@@ -534,7 +559,8 @@ class RuntimeOptimizer:
         cal = self._ensure_calibrator()
         if cal is None:
             return [], []
-        meshes, ks, windows, moes, chunk_opts = self._knob_options(run)
+        (meshes, ks, windows, moes, chunk_opts,
+         precision_opts) = self._knob_options(run)
         out: List[CandidateScore] = []
         memory_rejected: List[Dict] = []
         mem_seen: set = set()
@@ -549,37 +575,47 @@ class RuntimeOptimizer:
                             chunk_opts if moe == "grouped_ep"
                             else [max(1, run.dispatch_chunks)]
                         )
+                        precisions_for_moe = (
+                            precision_opts if moe == "grouped_ep"
+                            else [run.moe_precision or "bf16"]
+                        )
                         for ch in chunks_for_moe:
-                            try:
-                                s = cal.price(
-                                    mesh, steps_per_call=k,
-                                    train_window=w,
-                                    moe_dispatch=moe,
-                                    dispatch_chunks=ch)
-                            except MemoryInfeasibleError as e:
-                                mkey = mesh_axes_key(mesh)
-                                if mkey not in mem_seen:
-                                    mem_seen.add(mkey)
-                                    self._c_memory_rejected.inc()
-                                    memory_rejected.append({
-                                        "mesh": _mesh_dict(mesh),
-                                        "predicted_hbm_bytes": round(
-                                            e.memory_bytes),
-                                        "budget_bytes": round(
-                                            e.budget_bytes),
-                                    })
-                                break
-                            except (ValueError, KeyError) as e:
-                                logger.debug(
-                                    "candidate %s unpriceable: %s",
-                                    mesh, e)
-                                break
-                            out.append(CandidateScore(
-                                mesh=mesh, steps_per_call=k,
-                                train_window=w, moe_dispatch=moe,
-                                dispatch_chunks=ch,
-                                predicted_step_s=s,
-                            ))
+                            for prec in precisions_for_moe:
+                                try:
+                                    s = cal.price(
+                                        mesh, steps_per_call=k,
+                                        train_window=w,
+                                        moe_dispatch=moe,
+                                        dispatch_chunks=ch,
+                                        moe_precision=prec)
+                                except MemoryInfeasibleError as e:
+                                    mkey = mesh_axes_key(mesh)
+                                    if mkey not in mem_seen:
+                                        mem_seen.add(mkey)
+                                        self._c_memory_rejected.inc()
+                                        memory_rejected.append({
+                                            "mesh": _mesh_dict(mesh),
+                                            "predicted_hbm_bytes":
+                                                round(e.memory_bytes),
+                                            "budget_bytes": round(
+                                                e.budget_bytes),
+                                        })
+                                    break
+                                except (ValueError, KeyError) as e:
+                                    logger.debug(
+                                        "candidate %s unpriceable: %s",
+                                        mesh, e)
+                                    break
+                                out.append(CandidateScore(
+                                    mesh=mesh, steps_per_call=k,
+                                    train_window=w, moe_dispatch=moe,
+                                    dispatch_chunks=ch,
+                                    moe_precision=prec,
+                                    predicted_step_s=s,
+                                ))
+                            else:
+                                continue
+                            break
         # worst offender first: the trimmed decision evidence and the
         # PLAN_REJECTED event must name the true worst, not whichever
         # mesh enumeration happened to visit early
@@ -644,6 +680,8 @@ class RuntimeOptimizer:
             _mesh_dict(c.mesh) != _mesh_dict(run.mesh)
             or c.steps_per_call != run.steps_per_call
             or max(1, c.dispatch_chunks) != max(1, run.dispatch_chunks)
+            or (c.moe_precision or "bf16")
+            != (run.moe_precision or "bf16")
         )
 
     @staticmethod
@@ -659,6 +697,8 @@ class RuntimeOptimizer:
             + int((c.moe_dispatch or "") != (run.moe_dispatch or ""))
             + int(max(1, c.dispatch_chunks)
                   != max(1, run.dispatch_chunks))
+            + int((c.moe_precision or "bf16")
+                  != (run.moe_precision or "bf16"))
         )
 
     # -- the re-plan pass ----------------------------------------------------
@@ -699,7 +739,8 @@ class RuntimeOptimizer:
             run.mesh, steps_per_call=run.steps_per_call,
             train_window=run.train_window,
             moe_dispatch=run.moe_dispatch,
-            dispatch_chunks=run.dispatch_chunks, require_fit=False,
+            dispatch_chunks=run.dispatch_chunks,
+            moe_precision=run.moe_precision, require_fit=False,
         )
         priced, memory_rejected = self._price_candidates(run)
         candidates = [c for c in priced
@@ -845,6 +886,10 @@ class RuntimeOptimizer:
                 best.dispatch_chunks
                 if max(1, best.dispatch_chunks)
                 != max(1, cur.get("dispatch_chunks") or 1) else 0),
+            moe_precision=(
+                best.moe_precision
+                if (best.moe_precision or "bf16")
+                != (cur.get("moe_precision") or "bf16") else ""),
             plan_id=plan_id,
             trace_id=decision.trace_id,
             predicted_speedup=round(best.speedup, 3),
@@ -858,7 +903,8 @@ class RuntimeOptimizer:
             predicted_step_s=round(best.predicted_step_s, 6),
             **{f"knob_{k}": v for k, v in best.to_dict().items()
                if k in ("steps_per_call", "train_window",
-                        "moe_dispatch", "dispatch_chunks")},
+                        "moe_dispatch", "dispatch_chunks",
+                        "moe_precision")},
             mesh=_mesh_dict(best.mesh),
         )
         logger.info(
@@ -896,6 +942,9 @@ class RuntimeOptimizer:
                 model = _dc.replace(
                     model,
                     moe_dispatch_chunks=max(1, run.dispatch_chunks))
+            if (run.moe_precision or "bf16") != model.moe_precision:
+                model = _dc.replace(
+                    model, moe_precision=run.moe_precision or "bf16")
             score = estimate(run.mesh, model, self._device,
                              steps_per_call=run.steps_per_call)
             predicted = score.breakdown.get("exposed_comm_frac")
@@ -961,6 +1010,8 @@ class RuntimeOptimizer:
                 "moe_dispatch": pending.moe_dispatch,
                 "dispatch_chunks": getattr(
                     pending, "dispatch_chunks", 0),
+                "moe_precision": getattr(
+                    pending, "moe_precision", ""),
                 "predicted_speedup": pending.predicted_speedup,
                 "trace_id": pending.trace_id,
             } if pending is not None else None,

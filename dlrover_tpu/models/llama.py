@@ -103,6 +103,12 @@ class LlamaConfig:
     # Context knob (``dispatch_chunks``) at trace time, which is how
     # the runtime optimizer's chosen chunking reaches a retuned program.
     moe_dispatch_chunks: int = 0
+    # "grouped_ep" only: the wire precision of the row exchanges —
+    # "bf16" | "fp8" (block-scaled e4m3 + f32 scales, ~half the wire
+    # bytes) | "fp8_qdq" (the bitwise reference oracle). "" = resolve
+    # the Context knob (``moe_precision``) at trace time, the same
+    # retune-without-rebuild contract as the chunk knob (ops.moe).
+    moe_precision: str = ""
     # FSDP layer prefetch: gather layer l+1's params while layer l
     # computes (double-buffered carry through the scan-over-layers).
     # None = the Context knob (``fsdp_prefetch``). Same math, but the
@@ -351,6 +357,7 @@ def _ffn_block(x, layer, config: LlamaConfig, rng):
             ep_axes=tuple(config.moe_ep_axes),
             mesh=config.mesh,
             dispatch_chunks=config.moe_dispatch_chunks,
+            precision=config.moe_precision,
         )
         out, aux, metrics = moe_ops.moe_ffn(
             moe_params, x, cfg, activation=jax.nn.silu, rng=rng

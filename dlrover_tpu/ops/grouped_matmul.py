@@ -87,6 +87,56 @@ def _dw_kernel(tile_expert_ref, x_ref, dy_ref, dw_ref):
         dw_ref[0] = (dw_ref[0] + contrib).astype(dw_ref.dtype)
 
 
+def _fwd_kernel_quant(tile_expert_ref, x_ref, s_ref, w_ref, y_ref):
+    """The quantized-LHS forward kernel: dequantize the fp8 row tile
+    IN KERNEL (one f32 multiply per element against the per-block
+    scales riding their own tile) and run the same f32-accumulating
+    dot. The multiply happens in f32 exactly like
+    ``ops.quantize.dequantize_block_scaled``, so this kernel is bitwise
+    equal to dequant-then-``_fwd_kernel`` — the oracle contract the
+    tests pin."""
+    del tile_expert_ref  # consumed by the index maps
+    x = x_ref[...].astype(jnp.float32)
+    s = s_ref[...].astype(jnp.float32)
+    bt, d = x.shape
+    nb = s.shape[1]
+    x = (x.reshape(bt, nb, d // nb) * s[:, :, None]).reshape(bt, d)
+    y_ref[...] = jax.lax.dot_general(
+        x, w_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(y_ref.dtype)
+
+
+def _grouped_matmul_fwd_quant(values, scales, w, tile_expert, block_t,
+                              block_f, interpret, out_dtype):
+    tp, d = values.shape
+    e, dw_, f = w.shape
+    assert d == dw_, (values.shape, w.shape)
+    assert tp % block_t == 0, (tp, block_t)
+    nb = scales.shape[1]
+    num_t = tp // block_t
+    bf = _pick_block(f, block_f)
+    num_f = f // bf
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_t, num_f),
+        in_specs=[
+            pl.BlockSpec((block_t, d), lambda i, j, te: (i, 0)),
+            pl.BlockSpec((block_t, nb), lambda i, j, te: (i, 0)),
+            pl.BlockSpec((1, d, bf), lambda i, j, te: (te[i], 0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_t, bf), lambda i, j, te: (i, j)),
+    )
+    return pl.pallas_call(
+        _fwd_kernel_quant,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tp, f), out_dtype),
+        interpret=interpret,
+    )(tile_expert, values, scales, w)
+
+
 def _grouped_matmul_fwd(x, w, tile_expert, block_t, block_f, interpret):
     tp, d = x.shape
     e, dw_, f = w.shape
@@ -236,3 +286,57 @@ def _gm_bwd(block_t, block_f, interpret, res, dy):
 
 
 grouped_matmul.defvjp(_gm_fwd, _gm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def grouped_matmul_quantized(values, scales, w, tile_expert,
+                             block_t=128, block_f=512, interpret=None,
+                             out_dtype=jnp.float32):
+    """``grouped_matmul`` over a BLOCK-SCALED fp8 LHS, dequantized IN
+    KERNEL: ``y[i] = (values[i] * scales[i])  @ w[tile_expert[i //
+    block_t]]`` where ``values`` is [Tp, D] e4m3 and ``scales`` is
+    [Tp, D/block] f32 (``ops.quantize.quantize_block_scaled`` layout;
+    pad rows carry zero values, so any scale decodes them to zero).
+
+    The contract the tests pin: bitwise equal to
+    ``grouped_matmul(dequantize_block_scaled(values, scales), w, ...)``
+    — the dequant multiply runs in f32 inside the kernel exactly as the
+    standalone decode does, so fusing it costs nothing numerically
+    while the rows enter the kernel at wire precision (the point: the
+    [Tp, D] buffer the exchange produced is never re-materialized at
+    4x/2x the bytes just to feed the GEMM).
+
+    Differentiable in ``w`` ONLY: ``dw[e] = dequant(values, scales)^T @
+    dy`` through the same accumulation kernel as the unquantized path.
+    ``values``/``scales`` get zero cotangents — they arrived over the
+    wire already quantized; the activation gradient flows through the
+    caller's wire boundary (``ops.moe``'s quantized exchange defines
+    the straight-through chain), not through the encode.
+    """
+    interp = _auto_interpret(interpret)
+    return _grouped_matmul_fwd_quant(values, scales, w, tile_expert,
+                                     block_t, block_f, interp,
+                                     out_dtype)
+
+
+def _gmq_fwd(values, scales, w, tile_expert, block_t, block_f,
+             interpret, out_dtype):
+    y = grouped_matmul_quantized(values, scales, w, tile_expert,
+                                 block_t, block_f, interpret, out_dtype)
+    return y, (values, scales, w, tile_expert)
+
+
+def _gmq_bwd(block_t, block_f, interpret, out_dtype, res, dy):
+    from dlrover_tpu.ops.quantize import dequantize_block_scaled
+
+    values, scales, w, tile_expert = res
+    interp = _auto_interpret(interpret)
+    x_deq = dequantize_block_scaled(values, scales, jnp.float32)
+    dw = _grouped_matmul_dw(
+        x_deq, dy.astype(x_deq.dtype), tile_expert, w.shape[0],
+        block_t, block_f, interp,
+    ).astype(w.dtype)
+    return jnp.zeros_like(values), jnp.zeros_like(scales), dw, None
+
+
+grouped_matmul_quantized.defvjp(_gmq_fwd, _gmq_bwd)

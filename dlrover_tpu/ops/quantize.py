@@ -1,0 +1,130 @@
+"""Block-scaled fp8 quantization — the wire format of the low-precision
+MoE dispatch.
+
+The grouped_ep row exchange moves [P, n, D] token rows over ICI every
+step (``ops.moe``); at the scales the fault-tolerant-HSDP line of work
+targets (PAPERS.md 2602.00277) those wire bytes are the binding
+resource. Block-scaled fp8 halves them: each row's channels split into
+blocks of ``QUANT_BLOCK`` and every block ships as e4m3 values plus ONE
+f32 scale — 1 byte/element of values and ``4 / block`` bytes/element of
+scale side-band, ~0.56x of bf16 (the planner prices exactly this, see
+``parallel.planner._moe_dispatch_terms``; the G106 audit verifies it on
+the compiled HLO).
+
+Why per-block rather than per-tensor scales: a single scale for the
+whole exchange buffer is set by the largest outlier row, pushing every
+other row into the bottom of e4m3's ~2-decimal-digit range; per-block
+scales bound the quantization error by each 32-channel neighborhood
+instead (the microscaling/MX convention). Why f32 scales: they ride a
+side-band that is 1/32 of the payload — making them cheaper (e8m0)
+saves ~1% of wire for a real accuracy cost.
+
+Everything here is elementwise-per-row, which is the property the
+exact-oracle tests lean on: quantization COMMUTES with the row
+exchanges (an all_to_all/ppermute ring is a pure permutation of rows),
+so quantize -> exchange -> dequantize is bitwise equal to the local
+quantize -> dequantize reference with a full-precision wire
+(``tests/test_quantize.py`` pins it fwd+bwd).
+
+Zero blocks: an all-zero block would produce scale 0 and 0/0 values;
+the scale clamps to 1.0 and the values quantize to exact zeros — pad
+rows (the dispatch's zero sentinel) survive quantization untouched.
+Denormals: a block whose max|x| sits below e4m3's smallest normal
+up-scales into range (scale = amax / FP8_MAX < 1), so tiny-but-nonzero
+blocks keep ~2 digits instead of flushing to zero.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# channels per scale block (the MX convention's 32); ``resolve_quant_block``
+# shrinks it to the largest divisor of the channel dim
+QUANT_BLOCK = 32
+
+# e4m3fn: the widest-range fp8 (no inf, max 448) — activations/rows want
+# range; e5m2 is the gradient format and the wire here carries rows and
+# row-shaped cotangents, both activation-scaled
+WIRE_DTYPE = jnp.float8_e4m3fn
+
+FP8_MAX = float(jnp.finfo(WIRE_DTYPE).max)  # 448.0
+
+# wire precisions the MoE dispatch understands (ops.moe resolves the
+# knob): "bf16" = no quantization (the exchange carries the compute
+# dtype); "fp8" = block-scaled e4m3 values + f32 scales on the wire;
+# "fp8_qdq" = the REFERENCE ORACLE — quantize->dequantize applied
+# locally at every wire crossing with the exchange itself left in full
+# precision. Identical numbers to "fp8" by construction (quantization
+# commutes with the row permutation), so it is what the exact fwd+bwd
+# tests compare against, and a debug mode for isolating wire-transport
+# issues from quantization numerics.
+PRECISIONS = ("bf16", "fp8", "fp8_qdq")
+
+
+def resolve_quant_block(channels: int, want: int = QUANT_BLOCK) -> int:
+    """The largest divisor of ``channels`` that is <= ``want`` — scale
+    blocks must tile the channel dim exactly (static shapes; a ragged
+    tail block would need its own masked path for one block's worth of
+    savings)."""
+    want = max(1, min(int(want), int(channels)))
+    for cand in range(want, 0, -1):
+        if channels % cand == 0:
+            return cand
+    return 1
+
+
+def quantize_block_scaled(x: jax.Array, block: int = 0):
+    """``x [..., D]`` -> ``(values [..., D] e4m3, scales [..., D/block]
+    f32)`` with ``dequantize_block_scaled(values, scales)`` the decode.
+
+    Per block: ``scale = max|x| / FP8_MAX`` (so the block max lands on
+    +-448, the top of e4m3's range), zero blocks clamp to scale 1.0
+    (values quantize to exact zeros). The division happens in f32
+    regardless of input dtype — the encode must not round twice.
+    """
+    d = x.shape[-1]
+    b = block or resolve_quant_block(d)
+    if d % b:
+        raise ValueError(
+            f"quantize_block_scaled: block {b} does not divide the "
+            f"channel dim {d} (use resolve_quant_block)"
+        )
+    xb = x.astype(jnp.float32).reshape(x.shape[:-1] + (d // b, b))
+    amax = jnp.max(jnp.abs(xb), axis=-1)  # [..., D/b]
+    # the scale floors at the smallest NORMAL f32: on a
+    # flush-to-zero backend (TPU) ``amax / FP8_MAX`` for a
+    # deep-denormal block would flush to 0.0 and the division below
+    # would mint inf -> NaN-in-e4m3; flooring keeps the encode finite
+    # (such a block quantizes to zeros — below fp8's resolution
+    # anyway) without touching any normal-range block
+    scales = jnp.where(
+        amax > 0,
+        jnp.maximum(amax / FP8_MAX, jnp.finfo(jnp.float32).tiny),
+        1.0,
+    )
+    values = (xb / scales[..., None]).astype(WIRE_DTYPE)
+    return values.reshape(x.shape), scales
+
+
+def dequantize_block_scaled(values: jax.Array, scales: jax.Array,
+                            dtype=jnp.float32) -> jax.Array:
+    """Decode: ``values * scales`` broadcast per block, in f32 (one
+    exact multiply — e4m3 -> f32 is lossless and the scales are f32),
+    cast to ``dtype`` last. The in-kernel dequant of
+    ``ops.grouped_matmul.grouped_matmul_quantized`` computes exactly
+    this product, which is what makes dequant-in-kernel bitwise equal
+    to dequant-then-matmul (the oracle contract)."""
+    d = values.shape[-1]
+    nb = scales.shape[-1]
+    vb = values.astype(jnp.float32).reshape(
+        values.shape[:-1] + (nb, d // nb)
+    )
+    return (vb * scales[..., None]).reshape(values.shape).astype(dtype)
+
+
+def qdq(x: jax.Array, block: int = 0) -> jax.Array:
+    """quantize -> dequantize in place (f32 out): the local reference
+    transform of the "fp8_qdq" oracle mode."""
+    v, s = quantize_block_scaled(x, block)
+    return dequantize_block_scaled(v, s)

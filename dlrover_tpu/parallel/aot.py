@@ -469,7 +469,36 @@ def aot_compile_train_step(
             label=f"{model_name}@{topology}",
         )
         report.lint_findings = lint.findings
-        for f in lint.findings:
+        # G109: the quantization-drift probe must EXECUTE the program,
+        # which a deviceless topology cannot — it runs the same model
+        # family on the HOST backend's devices instead (the numerics
+        # of the quantized wire do not depend on which backend carries
+        # it; the bitwise wire tests pin that)
+        if (getattr(config, "num_experts", 0) > 0
+                and getattr(config, "moe_dispatch", "") == "grouped_ep"):
+            try:
+                # resolve INSIDE the guard: a malformed precision
+                # string (a typo'd env override) must also skip the
+                # probe, not kill the fit-proof
+                from dlrover_tpu.ops.moe import resolve_moe_precision
+                from dlrover_tpu.ops.moe import MoEConfig as _MC
+
+                resolved = resolve_moe_precision(_MC(
+                    num_experts=config.num_experts,
+                    precision=getattr(config, "moe_precision", ""),
+                ))
+                if resolved != "bf16":
+                    drift_rep = gl.quantization_drift_audit(
+                        precision=resolved)
+                    report.lint_findings = (
+                        list(report.lint_findings)
+                        + list(drift_rep.findings))
+            except Exception:  # noqa: BLE001 — a host backend without
+                # enough devices (or an unresolvable precision knob)
+                # skips the probe, it does not kill the fit-proof
+                logger.warning(
+                    "quantization drift probe skipped", exc_info=True)
+        for f in report.lint_findings:
             logger.warning("graph lint: %s", f.render())
     logger.info("AOT report: %s", report.to_json())
     return report

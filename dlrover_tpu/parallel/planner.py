@@ -83,6 +83,32 @@ class ModelSpec:
     # l+1's params under layer l's compute, exposing only the
     # non-overlappable remainder of the fsdp gather bytes.
     fsdp_prefetch: bool = False
+    # grouped_ep wire precision (ops.moe precision / ops.quantize):
+    # "fp8" ships the row exchanges as block-scaled e4m3 values plus
+    # f32 per-block scales — the BYTES change (wire_bytes_per_elem
+    # below), which is exactly what the G106 audit must see; the
+    # schedule does not. "fp8_qdq" (the reference oracle) prices as
+    # bf16: its wire IS full precision.
+    moe_precision: str = "bf16"
+
+    def moe_wire_bytes_per_elem(self) -> float:
+        """Wire bytes per exchanged row element, scale side-band
+        INCLUDED: the quantized wire ships 1-byte e4m3 values plus one
+        f32 scale per quantization block (ops.quantize layout), so a
+        bf16 exchange drops to 1 + 4/32 = 1.125 bytes/elem (~0.56x).
+        The ONE formula the pricing, the audit, and the bench's
+        wire-bytes ratio all read. The "fp8_qdq" reference oracle
+        prices at the f32 wire its implementation actually ships
+        (``dequantize_block_scaled`` decodes to f32 before the
+        exchange) — never at the bytes it does not save."""
+        if self.moe_precision == "fp8":
+            from dlrover_tpu.ops.quantize import resolve_quant_block
+
+            block = resolve_quant_block(max(1, int(self.hidden_size)))
+            return 1.0 + 4.0 / block
+        if self.moe_precision == "fp8_qdq":
+            return 4.0
+        return float(self.dtype_bytes)
 
 
 # Recompute multiplier on executed FLOPs per remat policy: "full" re-runs
@@ -316,10 +342,14 @@ def _moe_dispatch_terms(
       gather / grouped per-shard (P==1): slot-map gathers, O(t*D) HBM
         bytes — linear and tiny.
       grouped_ep: two all_to_alls fwd + their transposes bwd moving the
-        static dropless row buffer [P, t*k, D] => 4*P*t*k*D bytes on
-        ICI — LINEAR in tokens. (The buffer is the static-shape worst
-        case the implementation actually exchanges; see
-        ``ops.moe._moe_compute_grouped_ep``.)
+        static dropless row buffer [P, t*k, D] => 4*P*t*k*D *
+        wire_bytes_per_elem bytes on ICI — LINEAR in tokens, and
+        DTYPE-AWARE: the fp8 wire ships 1-byte values + the f32
+        per-block scale side-band (``ModelSpec.moe_wire_bytes_per_elem``
+        — ~0.56x of bf16), which is what the G106 audit of a quantized
+        program must be compared against. (The buffer is the
+        static-shape worst case the implementation actually exchanges;
+        see ``ops.moe._moe_compute_grouped_ep``.)
 
     The quadratic-vs-linear structure crosses over: below ~12k
     tokens/chip (v5e numbers) the capacity fallback wins, above it
@@ -337,7 +367,8 @@ def _moe_dispatch_terms(
         flops = 12.0 * cf * k * t * t * d * layers
         return flops / (device.flops_per_s * eff), 0.0
     if dispatch == "grouped_ep" and ep > 1:
-        ici_bytes = 4.0 * ep * t * k * d * model.dtype_bytes * layers
+        ici_bytes = (4.0 * ep * t * k * d * layers
+                     * model.moe_wire_bytes_per_elem())
         return 0.0, ici_bytes
     if dispatch == "grouped" and ep > 1:
         # the kernel is opaque to GSPMD: EP-sharded expert weights get
@@ -567,6 +598,22 @@ def estimate(
     # (predicted_collective_bytes — the G106 audit side); what the
     # chunk schedule changes is how many of their seconds are EXPOSED.
     moe_disp_comm_serial_s = moe_disp_comm_s
+    # the bf16 TWIN: what the same exchange would cost at the compute
+    # dtype's wire — held beside the (possibly quantized) actual
+    # pricing so `tpurun plan` shows what the precision knob buys, and
+    # so the monotonicity pin (quantized <= bf16, both directions) has
+    # an in-breakdown anchor. At precision "bf16" the twins are equal.
+    moe_disp_comm_bf16_serial_s = moe_disp_comm_serial_s
+    if (model.num_experts > 0 and model.moe_dispatch == "grouped_ep"
+            and model.moe_precision != "bf16"):
+        import dataclasses as _dc
+
+        _, bf16_bytes = _moe_dispatch_terms(
+            _dc.replace(model, moe_precision="bf16"), device, eff,
+            tokens_per_chip, data * fsdp,
+        )
+        moe_disp_comm_bf16_serial_s = bf16_bytes / device.ici_bw
+    moe_disp_comm_bf16_s = moe_disp_comm_bf16_serial_s
     chunks = max(1, int(getattr(model, "moe_dispatch_chunks", 1)))
     if (model.num_experts > 0 and model.moe_dispatch == "grouped_ep"
             and moe_disp_comm_s > 0):
@@ -581,6 +628,8 @@ def estimate(
         moe_gemm_s = gemm_flops / (device.flops_per_s * eff)
         moe_disp_comm_s = overlap_exposed_comm(
             moe_disp_comm_serial_s, moe_gemm_s, chunks)
+        moe_disp_comm_bf16_s = overlap_exposed_comm(
+            moe_disp_comm_bf16_serial_s, moe_gemm_s, chunks)
 
     fsdp_comm_serial_s = fsdp_comm_s
     if model.fsdp_prefetch and fsdp > 1 and fsdp_comm_s > 0:
@@ -680,6 +729,11 @@ def estimate(
             "moe_disp_comp_s": moe_disp_comp_s,
             "moe_disp_comm_s": moe_disp_comm_s,
             "moe_disp_comm_serial_s": moe_disp_comm_serial_s,
+            # the bf16 twins (what the wire would cost unquantized;
+            # equal to the pair above at precision "bf16") — the
+            # quantized-vs-bf16 delta `tpurun plan` surfaces
+            "moe_disp_comm_bf16_s": moe_disp_comm_bf16_s,
+            "moe_disp_comm_bf16_serial_s": moe_disp_comm_bf16_serial_s,
             "moe_dispatch_chunks": float(chunks),
             # predicted analog of the attribution plane's measured
             # exposed-comm bound (1 - compute/step): what `tpurun
@@ -813,5 +867,12 @@ def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
             bool(config.fsdp_prefetch)
             if config.fsdp_prefetch is not None
             else bool(getattr(get_context(), "fsdp_prefetch", False))
+        ),
+        # "" = the Context knob, exactly how ops.moe resolves it at
+        # trace time — the spec must price the wire the program ships
+        moe_precision=(
+            config.moe_precision
+            or str(getattr(get_context(), "moe_precision", "bf16")
+                   or "bf16")
         ),
     )

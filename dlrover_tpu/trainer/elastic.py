@@ -74,6 +74,7 @@ class ElasticTrainer:
         steps_per_call: Optional[int] = None,
         model_spec=None,
         dispatch_chunks: Optional[int] = None,
+        moe_precision: Optional[str] = None,
     ):
         self._init_fn = init_fn
         self._loss_fn = loss_fn
@@ -110,6 +111,16 @@ class ElasticTrainer:
             dispatch_chunks = int(getattr(
                 get_context(), "dispatch_chunks", 1))
         self.dispatch_chunks = max(1, int(dispatch_chunks))
+        # MoE wire precision: the same COMPILED-program trace-time knob
+        # contract as dispatch_chunks (the program-cache key carries
+        # it, _build pins the Context knob, retune/prewarm swap it
+        # live through the cache)
+        if moe_precision is None:
+            from dlrover_tpu.common.config import get_context
+
+            moe_precision = str(getattr(
+                get_context(), "moe_precision", "bf16") or "bf16")
+        self.moe_precision = self._effective_precision(moe_precision)
         # explicit device set (default: the whole jax.devices() world);
         # the agent hands the post-change survivor subset to
         # on_world_change, and dryruns carve sub-worlds out of one host
@@ -159,6 +170,28 @@ class ElasticTrainer:
             self._ckpt = ElasticCheckpointManager(
                 ckpt_dir, save_interval=ckpt_interval or CheckpointInterval()
             )
+
+    @staticmethod
+    def _effective_precision(precision: Optional[str]) -> str:
+        """The wire precision the traced program will ACTUALLY run:
+        the probe fallback applied HERE, not just inside ops.moe — so
+        the program-cache key, the Context pin, the worker's
+        TrainerConfigReport and the planner spec all agree with the
+        compiled program. Without this, a backend that fails the fp8
+        probe would run the bf16 wire while the trainer reports (and
+        the optimizer prices, applies and 'realizes') a phantom fp8."""
+        p = (precision or "bf16").strip() or "bf16"
+        if p != "bf16":
+            from dlrover_tpu.ops.shard_compat import fp8_wire_supported
+
+            if not fp8_wire_supported():
+                logger.warning(
+                    "moe precision %r requested but the backend fails "
+                    "the fp8 probe; the trainer runs (and reports) "
+                    "the bf16 wire", p,
+                )
+                return "bf16"
+        return p
 
     # -- build / rebuild -----------------------------------------------------
 
@@ -211,6 +244,7 @@ class ElasticTrainer:
             + f"|k={self.steps_per_call}"
             + f"|mesh={mesh_axes_key(strategy.mesh)}"
             + f"|c={self.dispatch_chunks}"
+            + f"|p={self.moe_precision}"
         )
 
     def _build(self, devices: Optional[list]) -> AccelerateResult:
@@ -227,6 +261,7 @@ class ElasticTrainer:
         from dlrover_tpu.common.config import get_context
 
         get_context().dispatch_chunks = self.dispatch_chunks
+        get_context().moe_precision = self.moe_precision
         strategy = self._resolved_strategy(num_devices)
         key = self._program_key(actual, strategy)
         self._current_program_key = key
@@ -425,7 +460,8 @@ class ElasticTrainer:
 
     def prewarm(self, devices=None, execute: bool = True,
                 steps_per_call: Optional[int] = None,
-                mesh=None, dispatch_chunks: Optional[int] = None) -> bool:
+                mesh=None, dispatch_chunks: Optional[int] = None,
+                moe_precision: Optional[str] = None) -> bool:
         """Standby-compile the program for a topology OR knob set we may
         swap to — the (N - node_unit)-device survivor world before a
         failure, or an optimizer-chosen (``steps_per_call``, mesh
@@ -446,6 +482,7 @@ class ElasticTrainer:
 
         prev_k, prev_mesh = self.steps_per_call, self._mesh_override
         prev_c = self.dispatch_chunks
+        prev_p = self.moe_precision
         prev_key = self._current_program_key
         if steps_per_call is not None:
             self.steps_per_call = max(1, int(steps_per_call))
@@ -453,6 +490,8 @@ class ElasticTrainer:
             self._mesh_override = mesh
         if dispatch_chunks is not None:
             self.dispatch_chunks = max(1, int(dispatch_chunks))
+        if moe_precision is not None:
+            self.moe_precision = self._effective_precision(moe_precision)
         try:
             before = self.compile_count
             result = self._build(
@@ -460,15 +499,18 @@ class ElasticTrainer:
             compiled = self.compile_count > before
             if execute and compiled:
                 # the dummy step also forces the standby TRACE, which
-                # is when ops.moe reads the chunk knob off the Context
+                # is when ops.moe reads the chunk/precision knobs off
+                # the Context
                 self._execute_dummy_step(result)
         finally:
             self.steps_per_call = prev_k
             self._mesh_override = prev_mesh
             self.dispatch_chunks = prev_c
-            # the ACTIVE program keeps its trace-time knob (and its
+            self.moe_precision = prev_p
+            # the ACTIVE program keeps its trace-time knobs (and its
             # attribution identity — not re-pointed at the standby key)
             get_context().dispatch_chunks = prev_c
+            get_context().moe_precision = prev_p
             self._current_program_key = prev_key
         return compiled
 
@@ -505,27 +547,32 @@ class ElasticTrainer:
 
     def retune(self, state: Any, steps_per_call: Optional[int] = None,
                mesh=None, dispatch_chunks: Optional[int] = None,
+               moe_precision: Optional[str] = None,
                reason: str = "optimizer") -> Any:
         """Apply optimizer-chosen PROGRAM knobs on the current world
         without a restart: ``steps_per_call`` (the lax.scan multi-step
-        degree), ``dispatch_chunks`` (the grouped_ep chunked-dispatch
-        degree — a trace-time knob the program-cache key carries)
-        and/or a mesh override (a different factorization of the same
-        devices). Same mechanics as ``live_reshard`` — the caller
-        drains its window first; snapshot → rebuild → reshard — but
-        against the unchanged device set, and through the program
-        cache keyed on these very knobs, so a prewarmed knob set swaps
-        with ZERO recompiles. On failure the previous knobs (and the
-        previously compiled program) are restored and the error
-        propagates — the job keeps running the old config."""
+        degree), ``dispatch_chunks`` / ``moe_precision`` (the
+        grouped_ep chunked-dispatch degree and wire precision —
+        trace-time knobs the program-cache key carries) and/or a mesh
+        override (a different factorization of the same devices). Same
+        mechanics as ``live_reshard`` — the caller drains its window
+        first; snapshot → rebuild → reshard — but against the
+        unchanged device set, and through the program cache keyed on
+        these very knobs, so a prewarmed knob set swaps with ZERO
+        recompiles. On failure the previous knobs (and the previously
+        compiled program) are restored and the error propagates — the
+        job keeps running the old config."""
         prev_k, prev_mesh = self.steps_per_call, self._mesh_override
         prev_c = self.dispatch_chunks
+        prev_p = self.moe_precision
         if steps_per_call is not None:
             self.steps_per_call = max(1, int(steps_per_call))
         if mesh is not None:
             self._mesh_override = mesh
         if dispatch_chunks is not None:
             self.dispatch_chunks = max(1, int(dispatch_chunks))
+        if moe_precision is not None:
+            self.moe_precision = self._effective_precision(moe_precision)
         try:
             return self.live_reshard(
                 state, devices=self._devices, reason=reason,
@@ -535,6 +582,7 @@ class ElasticTrainer:
             self.steps_per_call = prev_k
             self._mesh_override = prev_mesh
             self.dispatch_chunks = prev_c
+            self.moe_precision = prev_p
             # re-point at the old program (cache hit, and the Context
             # chunk knob re-pinned by _build) so the trainer stays
             # runnable with the pre-retune config

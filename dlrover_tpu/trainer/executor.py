@@ -421,7 +421,8 @@ class OptimizerPlanHook(TrainHook):
         import jax
 
         wants_program = (bool(cfg.steps_per_call) or bool(cfg.mesh_shape)
-                         or bool(getattr(cfg, "dispatch_chunks", 0)))
+                         or bool(getattr(cfg, "dispatch_chunks", 0))
+                         or bool(getattr(cfg, "moe_precision", "")))
         if wants_program and jax.process_count() > 1:
             # each process polls on its own clock: an in-place program
             # swap applied at different wall times would diverge the
@@ -464,6 +465,8 @@ class OptimizerPlanHook(TrainHook):
                         else None),
             dispatch_chunks=(
                 getattr(cfg, "dispatch_chunks", 0) or None),
+            moe_precision=(
+                getattr(cfg, "moe_precision", "") or None),
             plan_id=plan_id,
             trace_id=getattr(cfg, "trace_id", "") or "",
             predicted_speedup=float(
@@ -849,6 +852,7 @@ class TrainExecutor:
                        train_window: Optional[int] = None,
                        mesh_shape: Optional[Dict[str, int]] = None,
                        dispatch_chunks: Optional[int] = None,
+                       moe_precision: Optional[str] = None,
                        plan_id: str = "", trace_id: str = "",
                        predicted_speedup: float = 0.0,
                        prewarm: bool = True):
@@ -856,12 +860,14 @@ class TrainExecutor:
         apply it at the next loop boundary — drain the window, then
         retune the host knob (``train_window``) in place and swap the
         compiled program (``steps_per_call`` / ``dispatch_chunks`` /
-        mesh override) through the program cache. No process restart."""
+        ``moe_precision`` / mesh override) through the program cache.
+        No process restart."""
         self._retune_request = {
             "steps_per_call": steps_per_call,
             "train_window": train_window,
             "mesh_shape": dict(mesh_shape) if mesh_shape else None,
             "dispatch_chunks": dispatch_chunks,
+            "moe_precision": moe_precision,
             "plan_id": plan_id,
             "trace_id": trace_id,
             "predicted_speedup": float(predicted_speedup or 0.0),
@@ -970,6 +976,7 @@ class TrainExecutor:
         k = req.get("steps_per_call")
         w = req.get("train_window")
         ch = req.get("dispatch_chunks")
+        mp = req.get("moe_precision")
         mesh = self._mesh_override_from(req.get("mesh_shape"))
         cur_k = max(1, int(getattr(self._trainer, "steps_per_call", 1)))
         if k is not None and int(k) == cur_k:
@@ -978,11 +985,39 @@ class TrainExecutor:
             self._trainer, "dispatch_chunks", 1)))
         if ch is not None and int(ch) == cur_c:
             ch = None
+        cur_p = str(getattr(
+            self._trainer, "moe_precision", "bf16") or "bf16")
+        if mp is not None:
+            eff = mp
+            normalize = getattr(self._trainer, "_effective_precision",
+                                None)
+            if normalize is not None:
+                eff = normalize(mp)
+            if eff != mp:
+                # the backend cannot honor the requested wire (fp8
+                # probe failed): applying would silently run bf16
+                # while acking fp8 — the master would mark the plan
+                # applied and re-choose it after every trigger, each
+                # cycle paying a futile drain. Negative-ack instead so
+                # the knob tuple is blacklisted (the multi-host
+                # program-plan precedent).
+                logger.warning(
+                    "optimizer plan %s wants moe_precision=%s but the "
+                    "backend runs %s (fp8 probe failed); negative-"
+                    "acking so the master blacklists it", plan_id, mp,
+                    eff,
+                )
+                self._report_trainer_config(plan_id=plan_id,
+                                            apply_failed=True)
+                return
+            if mp == cur_p:
+                mp = None
         needs_program = (k is not None or mesh is not None
-                         or ch is not None)
+                         or ch is not None or mp is not None)
         emit_event(
             EventKind.OPTIMIZER_APPLY_BEGIN, plan_id=plan_id,
             steps_per_call=k, train_window=w, dispatch_chunks=ch,
+            moe_precision=mp,
             mesh=req.get("mesh_shape") if mesh is not None else None,
             step=int(getattr(self.state, "step", 0)),
         )
@@ -1004,12 +1039,12 @@ class TrainExecutor:
                     prewarmed = self._trainer.prewarm(
                         devices=getattr(self._trainer, "devices", None),
                         steps_per_call=k, mesh=mesh,
-                        dispatch_chunks=ch,
+                        dispatch_chunks=ch, moe_precision=mp,
                     )
                 compiles_before = self._trainer.compile_count
                 self.state = self._trainer.retune(
                     self.state, steps_per_call=k, mesh=mesh,
-                    dispatch_chunks=ch,
+                    dispatch_chunks=ch, moe_precision=mp,
                 )
                 recompiled = (
                     self._trainer.compile_count - compiles_before
@@ -1056,6 +1091,8 @@ class TrainExecutor:
                 self._trainer, "steps_per_call", 1)),
             dispatch_chunks=int(getattr(
                 self._trainer, "dispatch_chunks", 1)),
+            moe_precision=str(getattr(
+                self._trainer, "moe_precision", "bf16")),
         )
         logger.info(
             "optimizer plan %s applied in %.2fs (recompiled=%d, "
@@ -1144,6 +1181,10 @@ class TrainExecutor:
                     self._trainer, "steps_per_call", 1)),
                 dispatch_chunks=int(getattr(
                     self._trainer, "dispatch_chunks", 1)),
+                moe_precision=(
+                    str(getattr(self._trainer, "moe_precision",
+                                "bf16"))
+                    if getattr(spec, "num_experts", 0) else ""),
                 moe_dispatch=(
                     getattr(spec, "moe_dispatch", "")
                     if getattr(spec, "num_experts", 0) else ""),

@@ -91,6 +91,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "all_to_all; workers see it as "
                         "DLROVER_TPU_DISPATCH_CHUNKS; the runtime "
                         "optimizer retunes it live)")
+    p.add_argument("--moe_precision", default=None,
+                   choices=["bf16", "fp8", "fp8_qdq"],
+                   help="grouped_ep MoE wire precision: fp8 quantizes "
+                        "the row exchanges to block-scaled e4m3 "
+                        "(values + f32 scales, ~half the wire bytes; "
+                        "bf16 fallback when the backend fails the fp8 "
+                        "probe); workers see it as "
+                        "DLROVER_TPU_MOE_PRECISION and the runtime "
+                        "optimizer retunes it live")
     p.add_argument("--live_recovery", "--live-recovery",
                    dest="live_recovery", action="store_true",
                    help="absorb survivable membership changes with an "
@@ -189,6 +198,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.dispatch_chunks is not None:
         os.environ["DLROVER_TPU_DISPATCH_CHUNKS"] = str(
             args.dispatch_chunks)
+    if args.moe_precision is not None:
+        os.environ["DLROVER_TPU_MOE_PRECISION"] = args.moe_precision
     if args.live_recovery:
         # workers' executors route survivable changes to the in-process
         # reshard path (Context.live_recovery reads this at import)
