@@ -144,15 +144,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             # cannot run it (too few devices, broken backend) skips the
             # probe with a warning instead of killing the whole lint
             # run and the findings already computed
-            try:
-                reports.append(graph_lint.quantization_drift_audit())
-            except Exception as e:  # noqa: BLE001
-                import logging
+            for family in ("moe", "fsdp", "grad"):
+                try:
+                    reports.append(graph_lint.quantization_drift_audit(
+                        family=family))
+                except Exception as e:  # noqa: BLE001
+                    import logging
 
-                logging.getLogger("dlrover_tpu.analysis").warning(
-                    "quantization drift probe skipped", exc_info=True)
-                print(f"quantization drift probe skipped: "
-                      f"{type(e).__name__}: {e}")
+                    logging.getLogger("dlrover_tpu.analysis").warning(
+                        "quantization drift probe (%s) skipped",
+                        family, exc_info=True)
+                    print(f"quantization drift probe ({family}) "
+                          f"skipped: {type(e).__name__}: {e}")
         for rep in reports:
             all_findings.extend(rep.findings)
 

@@ -596,15 +596,117 @@ def quantization_drift_baseline_path() -> str:
                         "quant_baseline.json")
 
 
+def _probe_batch(config, global_batch: int, seed: int = 0):
+    """The fixed, seeded probe batch every drift family shares."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    seq = config.max_seq_len
+    ids = rng.randint(0, config.vocab_size, size=(global_batch, seq + 1))
+    return {
+        "input_ids": jnp.asarray(ids[:, :-1]),
+        "labels": jnp.asarray(ids[:, 1:]),
+    }
+
+
+def _measure_fsdp_drift(config, precision: str, global_batch: int):
+    """The "fsdp" family probe: one forward loss of the dense llama
+    with the quantized per-layer gather wire vs its bf16 twin. The
+    wire transform is elementwise over the stacked params (quantize
+    commutes with the per-layer slice), so the drift is pure weight-
+    qdq rounding and mesh-independent — the probe runs unsharded."""
+    import dataclasses
+
+    import jax
+
+    from dlrover_tpu.models import llama
+
+    if config is None:
+        config = llama.llama_tiny(num_layers=4)
+    batch = _probe_batch(config, global_batch)
+    params = llama.init(jax.random.PRNGKey(0), config)
+
+    def loss_at(prec: str) -> float:
+        cfg = dataclasses.replace(config, fsdp_precision=prec)
+        out = jax.jit(llama.make_loss_fn(cfg))(
+            params, batch, jax.random.PRNGKey(1))
+        loss = out[0] if isinstance(out, tuple) else out
+        return float(jax.device_get(loss))
+
+    loss_q = loss_at(precision)
+    loss_b = loss_at("bf16")
+    drift = abs(loss_q - loss_b) / max(abs(loss_b), 1e-12)
+    label = f"llama_tiny[fsdp,{precision}]@{jax.default_backend()}"
+    return drift, label
+
+
+def _measure_grad_drift(config, precision: str, global_batch: int,
+                        steps: int = 4, lr: float = 1e-2):
+    """The "grad" family probe: a few deterministic SGD steps with the
+    error-feedback quantized gradient path vs the exact bf16 twin,
+    judged on the final loss. Single-program (no mesh): the transform
+    is elementwise over the gradient tree, so the drift does not
+    depend on how the grads were sharded."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.accelerate import _apply_grad_wire
+
+    if config is None:
+        config = llama.llama_tiny(num_layers=2)
+    batch = _probe_batch(config, global_batch)
+    loss_fn = llama.make_loss_fn(_dc.replace(config))
+    grad_fn = jax.value_and_grad(
+        lambda p, b, r: loss_fn(p, b, r)[0])
+
+    def step(params, residual, quantized):
+        loss, grads = grad_fn(params, batch, jax.random.PRNGKey(1))
+        new_residual = residual
+        if quantized:
+            # the probed mode must be the LABELED mode — "fp8_nofb"
+            # measures the no-feedback control, not the EF path
+            grads, new_residual = _apply_grad_wire(
+                grads, residual, precision)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, new_residual, loss
+
+    def run(quantized: bool) -> float:
+        params = llama.init(jax.random.PRNGKey(0), config)
+        residual = jax.tree.map(jnp.zeros_like, params)
+        loss = None
+        fn = jax.jit(lambda p, r: step(p, r, quantized))
+        for _ in range(steps):
+            params, residual, loss = fn(params, residual)
+        return float(jax.device_get(loss))
+
+    loss_q = run(True)
+    loss_b = run(False)
+    drift = abs(loss_q - loss_b) / max(abs(loss_b), 1e-12)
+    label = f"llama_tiny[grad,{precision}]@{jax.default_backend()}"
+    return drift, label
+
+
 def measure_quantization_drift(config=None, precision: str = "fp8",
-                               global_batch: int = 4):
+                               global_batch: int = 4,
+                               family: str = "moe"):
     """(drift, label): the relative loss difference between the
     quantized program and its bf16-wire twin on a FIXED probe batch —
     same params, same routing seed, only the wire precision differs.
     Deterministic per backend (the probe is seeded and single-process),
     which is what lets the baseline ratchet instead of tolerance-guess.
 
-    Default model: the tiny grouped_ep MoE llama over an explicit
+    ``family`` selects which quantized boundary is probed; each knob
+    family ratchets its OWN ``quant_baseline.json`` entry (fire/clean
+    per family): "moe" (the grouped_ep row-exchange wire — the default
+    and the PR 11 behavior), "fsdp" (the dense per-layer param-gather
+    wire, ``_measure_fsdp_drift``) and "grad" (the error-feedback
+    gradient path, ``_measure_grad_drift``).
+
+    The "moe" model: the tiny grouped_ep MoE llama over an explicit
     4-way (data x fsdp) expert submesh — every quantized boundary
     (row quantize, exchange, dequant-in-kernel, return wire) executes.
     Runs on the HOST backend's devices (the probe needs to EXECUTE,
@@ -617,6 +719,12 @@ def measure_quantization_drift(config=None, precision: str = "fp8",
 
     from dlrover_tpu.models import llama
 
+    if family == "fsdp":
+        return _measure_fsdp_drift(config, precision, global_batch)
+    if family == "grad":
+        return _measure_grad_drift(config, precision, global_batch)
+    if family != "moe":
+        raise ValueError(f"unknown drift family {family!r}")
     if config is None:
         # chunks pinned to 1: the probe must not resolve an ambient
         # Context chunk knob (drift is C-invariant — per-row outputs
@@ -673,9 +781,11 @@ def measure_quantization_drift(config=None, precision: str = "fp8",
 def quantization_drift_audit(config=None, precision: str = "fp8",
                              baseline_path: str = "",
                              ratio: float = G109_DRIFT_RATIO,
+                             family: str = "moe",
                              ) -> GraphLintReport:
-    """The G109 acceptance audit: run the quantized-vs-bf16 probe and
-    judge the drift against the committed per-model baseline
+    """The G109 acceptance audit: run the quantized-vs-bf16 probe for
+    one knob ``family`` ("moe" | "fsdp" | "grad") and judge the drift
+    against the committed per-model, per-family baseline
     (``dlrover_tpu/analysis/quant_baseline.json``) — numerics
     regressions fail ``tpulint`` / ``aot --lint`` the way byte
     regressions (G106) already do."""
@@ -683,7 +793,8 @@ def quantization_drift_audit(config=None, precision: str = "fp8",
     import os
 
     t0 = time.time()
-    drift, label = measure_quantization_drift(config, precision)
+    drift, label = measure_quantization_drift(config, precision,
+                                              family=family)
     path = baseline_path or quantization_drift_baseline_path()
     baseline_drift = None
     if os.path.exists(path):

@@ -417,6 +417,10 @@ class ParallelConfig:
     # grouped_ep wire precision ("" = leave unchanged; "bf16"/"fp8"):
     # the same prewarmed program-cache swap contract as dispatch_chunks
     moe_precision: str = ""
+    # dense FSDP gather wire precision ("" = leave unchanged;
+    # "bf16"/"fp8"): the same prewarmed program-cache swap contract —
+    # a backend whose fp8 probe fails negative-acks the plan
+    fsdp_precision: str = ""
     # optimizer decision identity: the worker echoes plan_id back in its
     # TrainerConfigReport ack, and every OPTIMIZER_* event on both sides
     # carries trace_id so the decision trail merges per incident
@@ -453,6 +457,14 @@ class TrainerConfigReport:
     # the grouped_ep wire precision this worker actually runs ("" =
     # not reported / not applicable)
     moe_precision: str = ""
+    # the dense FSDP gather wire precision this worker actually runs
+    # ("" = not reported): what unlocks the optimizer's fsdp_precision
+    # knob family — always known for a trainer-managed job
+    fsdp_precision: str = ""
+    # the gradient-path precision (error-feedback residual) this worker
+    # was BUILT with — reported for observability; never enumerated by
+    # the optimizer (the residual is TrainState structure)
+    grad_precision: str = ""
     global_batch: int = 0
     plan_id: str = ""
     predicted_speedup: float = 0.0

@@ -188,6 +188,30 @@ class Context:
         # running job's wire precision through the program cache; the
         # runtime optimizer enumerates {bf16, fp8} as a knob family.
         self.moe_precision = "bf16"
+        # low-precision DENSE wire (docs/parallelism.md "Low-precision
+        # / The dense wire"): what the per-layer FSDP param gathers of
+        # the scan-over-layers ship — "bf16" (the param dtype, no
+        # quantization), "fp8" (block-scaled e4m3 + f32 scales, ~1/4
+        # of an f32 gather; dequant-exact at consumption, gradients
+        # straight-through) or "fp8_qdq" (the bitwise reference
+        # oracle). Resolved at TRACE time by models that support it
+        # (llama), so ElasticTrainer.retune can swap a running job's
+        # dense wire through the program cache; the runtime optimizer
+        # enumerates {bf16, fp8} as a knob family.
+        self.fsdp_precision = "bf16"
+        # low-precision GRADIENT path: "bf16" (exact, today's math) or
+        # "fp8" — the per-shard gradient tree is quantized with an
+        # ERROR-FEEDBACK residual (decompression error carried in
+        # TrainState alongside optimizer state, added back before the
+        # next quantize so the error telescopes instead of
+        # accumulating). Unlike the dense gathers this changes
+        # training numerics (bounded; G109 ratchets the drift) and the
+        # residual is part of the training state, so it is a BUILD-time
+        # knob of accelerate/ElasticTrainer, not a live-retune family.
+        # ("fp8_nofb" quantizes WITHOUT feedback — the degradation
+        # control the telescoping tests compare against; never use it
+        # to train.)
+        self.grad_precision = "bf16"
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -233,6 +233,7 @@ class CostCalibrator:
               moe_dispatch: str = "",
               dispatch_chunks: int = 0,
               moe_precision: str = "",
+              fsdp_precision: str = "",
               require_fit: bool = True) -> float:
         """Calibrated predicted per-step seconds for one candidate.
 
@@ -266,6 +267,11 @@ class CostCalibrator:
             # — the dual of the chunk knob, priced through the same
             # estimate
             model = _dc.replace(model, moe_precision=moe_precision)
+        if fsdp_precision and fsdp_precision != model.fsdp_precision:
+            # the dense-wire knob reshapes the fsdp GATHER bytes
+            # (ModelSpec.fsdp_wire_bytes_per_elem; the grad
+            # reduce-scatter leg stays at the param dtype)
+            model = _dc.replace(model, fsdp_precision=fsdp_precision)
         k = max(1, int(steps_per_call))
         base = estimate(
             mesh, model, self.device, remat_policy=self.remat_policy,

@@ -97,6 +97,23 @@ DISPATCH_CHUNKS_OPTIONS = (1, 2, 4, 8)
 # reference oracle) is deliberately absent: it prices as bf16 and
 # exists to test against, never to run.
 MOE_PRECISION_OPTIONS = ("bf16", "fp8")
+# dense FSDP gather wire precisions the optimizer prices (models/llama
+# fsdp_precision / ops.quantize): the fp8 wire cuts the per-layer param
+# gather bytes the planner's fsdp_gather term prices (~0.28x of an f32
+# gather), so on a gather-bound dense job the family wins honestly.
+# Enumerated under the parked-knob discipline: only when the worker
+# REPORTS a dense-wire precision (TrainerConfigReport.fsdp_precision —
+# a trainer-managed llama job always does) AND the running mesh
+# actually has an fsdp axis > 1; otherwise the knob is inert and would
+# only widen the candidate product. Applied live through the same
+# prewarmed program-cache swap (ElasticTrainer.retune(fsdp_precision=))
+# with the probe-failure negative-ack contract. "fp8_qdq" (the
+# dequant-exact oracle) is deliberately absent: it prices at the full-
+# precision wire and exists to test against, never to run. The
+# GRADIENT-path precision (grad_precision) is NOT a family at all: its
+# error-feedback residual is TrainState structure, which no live
+# retune can swap.
+FSDP_PRECISION_OPTIONS = ("bf16", "fp8")
 # priced by the cost model, but NOT yet live-appliable: a dispatch-mode
 # change rebuilds the model, and enumeration is gated on the calibrator
 # seeing num_experts > 0 — which comm.ModelInfo does not carry yet, so
@@ -120,6 +137,9 @@ class RunningConfig:
     moe_dispatch: str = ""
     dispatch_chunks: int = 1
     moe_precision: str = "bf16"
+    # "" = the worker did not report a dense-wire precision (the
+    # family stays parked); a trainer-managed job reports "bf16"/"fp8"
+    fsdp_precision: str = ""
     global_batch: int = 0
 
     @classmethod
@@ -140,6 +160,8 @@ class RunningConfig:
                 1, int(getattr(report, "dispatch_chunks", 0) or 1)),
             moe_precision=str(
                 getattr(report, "moe_precision", "") or "bf16"),
+            fsdp_precision=str(
+                getattr(report, "fsdp_precision", "") or ""),
             global_batch=int(report.global_batch or 0),
         )
 
@@ -152,6 +174,7 @@ class RunningConfig:
             "moe_dispatch": self.moe_dispatch,
             "dispatch_chunks": self.dispatch_chunks,
             "moe_precision": self.moe_precision,
+            "fsdp_precision": self.fsdp_precision,
             "global_batch": self.global_batch,
         }
 
@@ -166,6 +189,7 @@ class CandidateScore:
     moe_dispatch: str
     dispatch_chunks: int = 1
     moe_precision: str = "bf16"
+    fsdp_precision: str = "bf16"
     predicted_step_s: float = 0.0
     speedup: float = 0.0  # current predicted / this predicted
 
@@ -175,7 +199,7 @@ class CandidateScore:
             f"mesh={mesh_axes_key(self.mesh)}"
             f"|k={self.steps_per_call}|w={self.train_window}"
             f"|moe={self.moe_dispatch}|c={self.dispatch_chunks}"
-            f"|p={self.moe_precision}"
+            f"|p={self.moe_precision}|fp={self.fsdp_precision}"
         )
 
     def to_dict(self) -> Dict:
@@ -186,6 +210,7 @@ class CandidateScore:
             "moe_dispatch": self.moe_dispatch,
             "dispatch_chunks": self.dispatch_chunks,
             "moe_precision": self.moe_precision,
+            "fsdp_precision": self.fsdp_precision,
             "predicted_step_s": round(self.predicted_step_s, 6),
             "speedup": round(self.speedup, 3),
         }
@@ -436,6 +461,7 @@ class RuntimeOptimizer:
                 hidden_size=max(8, int(info.hidden_size or 256)),
                 seq_len=max(1, int(info.seq_len or 128)),
                 global_batch=batch,
+                fsdp_precision=(self._running.fsdp_precision or "bf16"),
                 **moe_kwargs,
             )
         else:
@@ -546,7 +572,17 @@ class RuntimeOptimizer:
                 {max(1, run.dispatch_chunks), *DISPATCH_CHUNKS_OPTIONS})
             precision_opts = sorted(
                 {run.moe_precision or "bf16", *MOE_PRECISION_OPTIONS})
-        return meshes, ks, windows, moes, chunk_opts, precision_opts
+        # the dense-wire family: parked unless the worker REPORTS a
+        # dense-wire precision (i.e. the trainer manages the knob and a
+        # live apply exists); per-MESH gating — only factorizations
+        # that actually pay fsdp gathers differentiate the options —
+        # happens in _price_candidates, the chunks_for_moe pattern
+        fsdp_opts = [run.fsdp_precision or "bf16"]
+        if run.fsdp_precision:
+            fsdp_opts = sorted(
+                {run.fsdp_precision or "bf16", *FSDP_PRECISION_OPTIONS})
+        return (meshes, ks, windows, moes, chunk_opts, precision_opts,
+                fsdp_opts)
 
     def _price_candidates(self, run: RunningConfig
                           ) -> Tuple[List[CandidateScore], List[Dict]]:
@@ -560,11 +596,18 @@ class RuntimeOptimizer:
         if cal is None:
             return [], []
         (meshes, ks, windows, moes, chunk_opts,
-         precision_opts) = self._knob_options(run)
+         precision_opts, fsdp_opts) = self._knob_options(run)
         out: List[CandidateScore] = []
         memory_rejected: List[Dict] = []
         mem_seen: set = set()
         for mesh in meshes:
+            # the dense-wire family only differentiates meshes that pay
+            # fsdp gathers; elsewhere it would add identical-priced rows
+            fsdp_for_mesh = (
+                fsdp_opts
+                if max(1, mesh.axis_sizes().get("fsdp", 1)) > 1
+                else [run.fsdp_precision or "bf16"]
+            )
             for k in ks:
                 for w in windows:
                     for moe in moes:
@@ -579,43 +622,47 @@ class RuntimeOptimizer:
                             precision_opts if moe == "grouped_ep"
                             else [run.moe_precision or "bf16"]
                         )
-                        for ch in chunks_for_moe:
-                            for prec in precisions_for_moe:
-                                try:
-                                    s = cal.price(
-                                        mesh, steps_per_call=k,
-                                        train_window=w,
-                                        moe_dispatch=moe,
-                                        dispatch_chunks=ch,
-                                        moe_precision=prec)
-                                except MemoryInfeasibleError as e:
-                                    mkey = mesh_axes_key(mesh)
-                                    if mkey not in mem_seen:
-                                        mem_seen.add(mkey)
-                                        self._c_memory_rejected.inc()
-                                        memory_rejected.append({
-                                            "mesh": _mesh_dict(mesh),
-                                            "predicted_hbm_bytes":
-                                                round(e.memory_bytes),
-                                            "budget_bytes": round(
-                                                e.budget_bytes),
-                                        })
-                                    break
-                                except (ValueError, KeyError) as e:
-                                    logger.debug(
-                                        "candidate %s unpriceable: %s",
-                                        mesh, e)
-                                    break
-                                out.append(CandidateScore(
-                                    mesh=mesh, steps_per_call=k,
-                                    train_window=w, moe_dispatch=moe,
+                        combos = [
+                            (ch, prec, fp)
+                            for ch in chunks_for_moe
+                            for prec in precisions_for_moe
+                            for fp in fsdp_for_mesh
+                        ]
+                        for ch, prec, fp in combos:
+                            try:
+                                s = cal.price(
+                                    mesh, steps_per_call=k,
+                                    train_window=w,
+                                    moe_dispatch=moe,
                                     dispatch_chunks=ch,
                                     moe_precision=prec,
-                                    predicted_step_s=s,
-                                ))
-                            else:
-                                continue
-                            break
+                                    fsdp_precision=fp)
+                            except MemoryInfeasibleError as e:
+                                mkey = mesh_axes_key(mesh)
+                                if mkey not in mem_seen:
+                                    mem_seen.add(mkey)
+                                    self._c_memory_rejected.inc()
+                                    memory_rejected.append({
+                                        "mesh": _mesh_dict(mesh),
+                                        "predicted_hbm_bytes":
+                                            round(e.memory_bytes),
+                                        "budget_bytes": round(
+                                            e.budget_bytes),
+                                    })
+                                break
+                            except (ValueError, KeyError) as e:
+                                logger.debug(
+                                    "candidate %s unpriceable: %s",
+                                    mesh, e)
+                                break
+                            out.append(CandidateScore(
+                                mesh=mesh, steps_per_call=k,
+                                train_window=w, moe_dispatch=moe,
+                                dispatch_chunks=ch,
+                                moe_precision=prec,
+                                fsdp_precision=fp,
+                                predicted_step_s=s,
+                            ))
         # worst offender first: the trimmed decision evidence and the
         # PLAN_REJECTED event must name the true worst, not whichever
         # mesh enumeration happened to visit early
@@ -682,6 +729,8 @@ class RuntimeOptimizer:
             or max(1, c.dispatch_chunks) != max(1, run.dispatch_chunks)
             or (c.moe_precision or "bf16")
             != (run.moe_precision or "bf16")
+            or (c.fsdp_precision or "bf16")
+            != (run.fsdp_precision or "bf16")
         )
 
     @staticmethod
@@ -699,6 +748,8 @@ class RuntimeOptimizer:
                   != max(1, run.dispatch_chunks))
             + int((c.moe_precision or "bf16")
                   != (run.moe_precision or "bf16"))
+            + int((c.fsdp_precision or "bf16")
+                  != (run.fsdp_precision or "bf16"))
         )
 
     # -- the re-plan pass ----------------------------------------------------
@@ -740,7 +791,8 @@ class RuntimeOptimizer:
             train_window=run.train_window,
             moe_dispatch=run.moe_dispatch,
             dispatch_chunks=run.dispatch_chunks,
-            moe_precision=run.moe_precision, require_fit=False,
+            moe_precision=run.moe_precision,
+            fsdp_precision=run.fsdp_precision, require_fit=False,
         )
         priced, memory_rejected = self._price_candidates(run)
         candidates = [c for c in priced
@@ -890,6 +942,10 @@ class RuntimeOptimizer:
                 best.moe_precision
                 if (best.moe_precision or "bf16")
                 != (cur.get("moe_precision") or "bf16") else ""),
+            fsdp_precision=(
+                best.fsdp_precision
+                if (best.fsdp_precision or "bf16")
+                != (cur.get("fsdp_precision") or "bf16") else ""),
             plan_id=plan_id,
             trace_id=decision.trace_id,
             predicted_speedup=round(best.speedup, 3),
@@ -904,7 +960,7 @@ class RuntimeOptimizer:
             **{f"knob_{k}": v for k, v in best.to_dict().items()
                if k in ("steps_per_call", "train_window",
                         "moe_dispatch", "dispatch_chunks",
-                        "moe_precision")},
+                        "moe_precision", "fsdp_precision")},
             mesh=_mesh_dict(best.mesh),
         )
         logger.info(
@@ -945,6 +1001,9 @@ class RuntimeOptimizer:
             if (run.moe_precision or "bf16") != model.moe_precision:
                 model = _dc.replace(
                     model, moe_precision=run.moe_precision or "bf16")
+            if (run.fsdp_precision or "bf16") != model.fsdp_precision:
+                model = _dc.replace(
+                    model, fsdp_precision=run.fsdp_precision or "bf16")
             score = estimate(run.mesh, model, self._device,
                              steps_per_call=run.steps_per_call)
             predicted = score.breakdown.get("exposed_comm_frac")

@@ -115,6 +115,21 @@ class LlamaConfig:
     # scan(L-1)+epilogue restructure changes fusion/reduction order, so
     # results match the plain scan to float roundoff, not bitwise.
     fsdp_prefetch: Any = None
+    # dense FSDP wire precision: what the per-layer param gathers of
+    # the scan-over-layers ship — "bf16" (the param dtype, today's
+    # wire), "fp8" (the stacked per-layer weight matrices quantize to
+    # block-scaled e4m3 + f32 scales BEFORE the scan, the layer slice
+    # moves quantized, and dequant happens at consumption inside the
+    # block — ~1/4 of the f32 wire) or "fp8_qdq" (the bitwise
+    # reference oracle: the identical quantize->dequantize applied to
+    # the stack, with the wire itself left at full precision). A pure-
+    # forward transform: gradients pass straight through to the
+    # original params (the gather is dequant-exact, so no error
+    # feedback is needed — unlike the gradient direction, see
+    # ``parallel.accelerate``). "" = resolve the Context knob
+    # (``fsdp_precision``) at TRACE time, the retune-without-rebuild
+    # contract shared with moe_precision/dispatch_chunks.
+    fsdp_precision: str = ""
 
     @property
     def head_dim(self) -> int:
@@ -383,6 +398,192 @@ def _prefetch_enabled(c: LlamaConfig) -> bool:
     return bool(getattr(get_context(), "fsdp_prefetch", False))
 
 
+# -- dense FSDP wire (low-precision param gathers) --------------------------
+
+
+def resolve_fsdp_precision(config: LlamaConfig) -> str:
+    """The effective dense-wire precision at TRACE time: an explicit
+    ``config.fsdp_precision`` wins; "" resolves the global Context knob
+    (``fsdp_precision``) — how the runtime optimizer's chosen precision
+    reaches a re-traced program without rebuilding the model config
+    (the ``moe_precision`` pattern, ops.moe.resolve_moe_precision). A
+    quantized choice degrades to "bf16" (logged, never raised) when the
+    backend fails the fp8 capability probe."""
+    p = (getattr(config, "fsdp_precision", "") or "").strip()
+    if not p:
+        from dlrover_tpu.common.config import get_context
+
+        p = str(getattr(get_context(), "fsdp_precision", "bf16")
+                or "bf16").strip() or "bf16"
+    from dlrover_tpu.ops.quantize import PRECISIONS
+
+    if p not in PRECISIONS:
+        raise ValueError(
+            f"unknown FSDP wire precision {p!r}; choose one of "
+            f"{PRECISIONS}"
+        )
+    if p != "bf16":
+        from dlrover_tpu.ops.shard_compat import fp8_wire_supported
+
+        if not fp8_wire_supported():
+            import logging
+
+            logging.getLogger("dlrover_tpu.models.llama").warning(
+                "fsdp precision %r requested but the backend fails the "
+                "fp8 probe; falling back to the bf16 wire", p,
+            )
+            return "bf16"
+    return p
+
+
+def _wire_leaf(a) -> bool:
+    """Which stacked layer params ride the quantized wire: the rank-3
+    per-layer weight matrices ([L, in, out] — the bytes that dominate
+    the per-layer gather). Vector params (norm scales, [L, D]) are a
+    rounding error of the traffic and stay exact; rank-4 expert
+    tensors are consumed shard-local inside the grouped_ep shard_map
+    (never gathered), so quantizing them would add drift for zero wire
+    win."""
+    return (getattr(a, "ndim", 0) == 3
+            and jnp.issubdtype(a.dtype, jnp.floating))
+
+
+def _quantize_layer_stack(layers: Dict, mode: str) -> Dict[str, Dict]:
+    """path -> wire form of every wired leaf of the STACKED layer tree.
+
+    Quantization runs on the stacked, still-sharded params (elementwise
+    per 32-channel block along the last dim, so it computes shardwise
+    and commutes with the per-layer slice the scan takes): the scan's
+    xs then carry e4m3 values + f32 scales and the per-layer gather
+    moves the quantized bytes. "fp8_qdq" dequantizes here instead —
+    identical numbers (slice commutes with the elementwise decode), but
+    the wire ships full precision: the dequant-exact oracle the bitwise
+    tests pin fp8 against."""
+    from dlrover_tpu.ops.quantize import (
+        dequantize_block_scaled,
+        quantize_block_scaled,
+    )
+
+    wire: Dict[str, Dict] = {}
+    for path, leaf in _flatten_layers(layers):
+        if not _wire_leaf(leaf):
+            continue
+        v, s = quantize_block_scaled(leaf)
+        if mode == "fp8":
+            wire[path] = {"v": v, "s": s}
+        else:  # fp8_qdq: decode locally, wire at full precision
+            wire[path] = {"dq": dequantize_block_scaled(v, s, leaf.dtype)}
+    return wire
+
+
+def _flatten_layers(layers: Dict):
+    """(path, leaf) pairs of a nested-dict layer tree, "/"-joined —
+    the addressing `_consume_wire` uses to splice dequantized leaves
+    back into the per-layer param tree."""
+    out = []
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], prefix + (k,))
+        else:
+            out.append(("/".join(prefix), node))
+
+    walk(layers, ())
+    return out
+
+
+def _set_path(tree: Dict, path: str, value):
+    keys = path.split("/")
+    node = tree
+    for k in keys[:-1]:
+        node = node[k]
+    node[keys[-1]] = value
+
+
+def _get_path(tree: Dict, path: str):
+    node = tree
+    for k in path.split("/"):
+        node = node[k]
+    return node
+
+
+@jax.custom_vjp
+def _consume_fp8(v, s, w):
+    """Dequantize one wired leaf at consumption. ``w`` (the original
+    full-precision slice) contributes NO forward value — it exists so
+    the backward has a full-precision cotangent path back to the
+    stacked params: the transform is pure-forward (straight-through),
+    and without this route the scan's xs cotangent would be e4m3,
+    which cannot carry a gradient. The forward never reads ``w``, so
+    its f32 slice is dead code the compiler drops — the layer gather
+    moves only the quantized bytes."""
+    from dlrover_tpu.ops.quantize import dequantize_block_scaled
+
+    return dequantize_block_scaled(v, s, w.dtype)
+
+
+def _consume_fp8_fwd(v, s, w):
+    return _consume_fp8(v, s, w), (v, s)
+
+
+def _consume_fp8_bwd(res, g):
+    v, s = res
+    return jnp.zeros(v.shape, v.dtype), jnp.zeros(s.shape, s.dtype), g
+
+
+_consume_fp8.defvjp(_consume_fp8_fwd, _consume_fp8_bwd)
+
+
+@jax.custom_vjp
+def _consume_qdq(dq, w):
+    """The fsdp_qdq oracle's consumption: the pre-decoded value, with
+    the identical straight-through backward as ``_consume_fp8`` — so
+    fp8 and fp8_qdq are bitwise equal fwd AND bwd."""
+    return dq
+
+
+def _consume_qdq_fwd(dq, w):
+    return dq, (dq,)
+
+
+def _consume_qdq_bwd(res, g):
+    (dq,) = res
+    return jnp.zeros(dq.shape, dq.dtype), g
+
+
+_consume_qdq.defvjp(_consume_qdq_fwd, _consume_qdq_bwd)
+
+
+def _consume_wire(wire_slice: Dict[str, Dict], orig_slice: Dict) -> Dict:
+    """Per-layer param tree with every wired leaf replaced by its
+    dequantized wire form (non-wired leaves come from ``orig_slice``
+    untouched)."""
+    out = jax.tree.map(lambda x: x, orig_slice)  # fresh containers
+    for path, form in wire_slice.items():
+        w = _get_path(orig_slice, path)
+        if "dq" in form:
+            _set_path(out, path, _consume_qdq(form["dq"], w))
+        else:
+            _set_path(out, path, _consume_fp8(form["v"], form["s"], w))
+    return out
+
+
+def _wire_block(block, wired: bool):
+    """Adapter running ``block`` over (wire, orig) xs pairs when the
+    quantized wire is active — INSIDE the remat wrapper, so a remat'd
+    backward re-derives the dequantized params from the quantized xs
+    (the re-gather leg of the backward also moves fp8)."""
+    if not wired:
+        return block
+
+    def wired_block(carry, xs):
+        wire_slice, orig_slice = xs
+        return block(carry, _consume_wire(wire_slice, orig_slice))
+
+    return wired_block
+
+
 def _prefetch_gather(tree):
     """Issue the gather of ONE layer's params now: a sharding
     constraint to replicated over the ambient mesh — exactly the
@@ -453,8 +654,19 @@ def apply_hidden(
 
     positions = (segment_positions(segment_ids)
                  if segment_ids is not None else None)
-    block = apply_remat(_decoder_block(c, segment_ids, positions),
-                        c.remat_policy)
+    wire_mode = resolve_fsdp_precision(c)
+    layers = params["layers"]
+    wire_stack = (_quantize_layer_stack(layers, wire_mode)
+                  if wire_mode != "bf16" else {})
+    wired = bool(wire_stack)
+    block = apply_remat(
+        _wire_block(_decoder_block(c, segment_ids, positions), wired),
+        c.remat_policy,
+    )
+    # wired xs: (quantized wire forms, original tree) — the original
+    # rides along as the straight-through gradient route; its wired
+    # leaves are never read forward, so only quantized bytes move
+    scan_xs = (wire_stack, layers) if wired else layers
     if _prefetch_enabled(c) and c.num_layers >= 2:
         # FSDP layer prefetch: the scan carries layer l's ALREADY
         # GATHERED params and issues layer l+1's gather before the
@@ -466,28 +678,52 @@ def apply_hidden(
         # compute, not the exchange schedule. Same blocks, same order,
         # same rng chain — but the restructure changes XLA's fusion /
         # reduction order, so outputs match the plain scan to float
-        # roundoff, NOT bitwise (pinned with allclose).
-        layers = params["layers"]
-        first = jax.tree.map(lambda a: a[0], layers)
-        rest = jax.tree.map(lambda a: a[1:], layers)
+        # roundoff, NOT bitwise (pinned with allclose). On the
+        # quantized wire only the WIRE forms ride the prefetched
+        # (constraint-issued) gather and the double-buffered carry —
+        # dequant still happens at consumption inside the block, and
+        # the gradient-route originals stay out of the carry.
+        if wired:
+            wire_first = jax.tree.map(lambda a: a[0], wire_stack)
+            wire_rest = jax.tree.map(lambda a: a[1:], wire_stack)
+            orig_head = jax.tree.map(lambda a: a[:-1], layers)
+            orig_last = jax.tree.map(lambda a: a[-1], layers)
 
-        def pf_block(carry, next_sharded):
-            inner, cur = carry
-            gathered = _prefetch_gather(next_sharded)  # prefetch l+1
-            inner, ys = block(inner, cur)  # compute layer l
-            return (inner, gathered), ys
+            def pf_block(carry, xs_i):
+                inner, cur_wire = carry
+                wire_next, orig_cur = xs_i
+                gathered = _prefetch_gather(wire_next)  # prefetch l+1
+                inner, ys = block(inner, (cur_wire, orig_cur))
+                return (inner, gathered), ys
 
-        (inner, last), (aux_losses, dropped, load) = lax.scan(
-            pf_block, ((x, rng), _prefetch_gather(first)), rest
-        )
-        inner, (aux_l, drop_l, load_l) = block(inner, last)
+            (inner, last_wire), (aux_losses, dropped, load) = lax.scan(
+                pf_block,
+                ((x, rng), _prefetch_gather(wire_first)),
+                (wire_rest, orig_head),
+            )
+            inner, (aux_l, drop_l, load_l) = block(
+                inner, (last_wire, orig_last))
+        else:
+            first = jax.tree.map(lambda a: a[0], layers)
+            rest = jax.tree.map(lambda a: a[1:], layers)
+
+            def pf_block(carry, next_sharded):
+                inner, cur = carry
+                gathered = _prefetch_gather(next_sharded)  # prefetch l+1
+                inner, ys = block(inner, cur)  # compute layer l
+                return (inner, gathered), ys
+
+            (inner, last), (aux_losses, dropped, load) = lax.scan(
+                pf_block, ((x, rng), _prefetch_gather(first)), rest
+            )
+            inner, (aux_l, drop_l, load_l) = block(inner, last)
         x, _ = inner
         aux_losses = jnp.concatenate([aux_losses, aux_l[None]])
         dropped = jnp.concatenate([dropped, drop_l[None]])
         load = jnp.concatenate([load, load_l[None]], axis=0)
     else:
         (x, _), (aux_losses, dropped, load) = lax.scan(
-            block, (x, rng), params["layers"]
+            block, (x, rng), scan_xs
         )
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
     if with_moe_metrics:

@@ -128,3 +128,37 @@ def qdq(x: jax.Array, block: int = 0) -> jax.Array:
     transform of the "fp8_qdq" oracle mode."""
     v, s = quantize_block_scaled(x, block)
     return dequantize_block_scaled(v, s)
+
+
+# gradient-path wire precisions (``parallel.accelerate``): unlike the
+# dense gathers a quantized gradient is NOT dequant-exact training —
+# the compression error must be carried forward ("fp8", error
+# feedback) or it accumulates ("fp8_nofb", the degradation control the
+# telescoping tests compare against; never train with it)
+GRAD_PRECISIONS = ("bf16", "fp8", "fp8_nofb")
+
+
+def error_feedback_qdq(g: jax.Array, residual: jax.Array,
+                       feedback: bool = True):
+    """One error-feedback quantization step on one gradient leaf:
+    ``(g_quantized, new_residual)``.
+
+    The residual (last step's decompression error, zeros at init) is
+    added BACK into the gradient before quantizing, and the new
+    residual is the error of THIS quantization — so across steps the
+    errors telescope: sum(applied) = sum(raw grads) - final_residual,
+    i.e. the optimizer eventually sees every gradient bit, just a step
+    or two late (the classic EF-SGD/1-bit-Adam argument, and why the
+    residual must ride TrainState through checkpoint and reshard).
+    With ``feedback=False`` the raw gradient is quantized and the
+    error is DROPPED — the control mode whose drift the tests pin as
+    strictly worse."""
+    if feedback:
+        eff = g + residual.astype(g.dtype)
+    else:
+        eff = g
+    v, s = quantize_block_scaled(eff)
+    gq = dequantize_block_scaled(v, s, g.dtype)
+    new_residual = (eff - gq if feedback
+                    else jnp.zeros_like(residual))
+    return gq, new_residual.astype(residual.dtype)

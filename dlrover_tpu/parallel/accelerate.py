@@ -38,6 +38,16 @@ class TrainState:
     step: jnp.ndarray
     params: Any
     opt_state: Any
+    # error-feedback residual of the low-precision gradient path
+    # (``grad_precision`` != "bf16"): the decompression error of the
+    # last step's quantized gradients, param-shaped and param-sharded,
+    # added back before the next quantize so the error telescopes
+    # instead of accumulating. Part of the TRAINING STATE proper — it
+    # rides HostSnapshot, checkpoint save/restore and live reshard
+    # exactly like optimizer moments. None when the gradient wire is
+    # exact (the default), so existing checkpoints and states are
+    # structurally unchanged.
+    wire_residual: Any = None
 
 
 @dataclass
@@ -139,6 +149,64 @@ def _remat_wrap(loss_fn: LossFn, policy_name: str) -> LossFn:
     return apply_remat(loss_fn, policy_name or "none")
 
 
+def resolve_grad_precision(requested: Optional[str] = None) -> str:
+    """The effective gradient-path precision at BUILD time: an explicit
+    request wins, else the Context knob (``grad_precision``). A
+    quantized choice degrades to "bf16" (logged, never raised) when
+    the backend fails the fp8 probe. Build-time — not trace-time like
+    the dense gathers — because a quantized gradient path changes the
+    STRUCTURE of TrainState (the error-feedback residual), which a
+    live retune cannot swap under a running state."""
+    from dlrover_tpu.common.config import get_context
+    from dlrover_tpu.ops.quantize import GRAD_PRECISIONS
+
+    p = (requested or "").strip()
+    if not p:
+        p = str(getattr(get_context(), "grad_precision", "bf16")
+                or "bf16").strip() or "bf16"
+    if p not in GRAD_PRECISIONS:
+        raise ValueError(
+            f"unknown grad precision {p!r}; choose one of "
+            f"{GRAD_PRECISIONS}"
+        )
+    if p != "bf16":
+        from dlrover_tpu.ops.shard_compat import fp8_wire_supported
+
+        if not fp8_wire_supported():
+            logger.warning(
+                "grad precision %r requested but the backend fails the "
+                "fp8 probe; gradients stay exact (bf16 path)", p,
+            )
+            return "bf16"
+    return p
+
+
+def _apply_grad_wire(grads, residual, grad_precision: str):
+    """(effective grads, new residual): the error-feedback quantized
+    gradient path, per float leaf (blocks along each leaf's last dim,
+    computed SHARDWISE — the transform is elementwise over the
+    param-sharded gradient tree, so it adds zero collective traffic).
+    Non-float leaves pass through untouched."""
+    from dlrover_tpu.ops.quantize import error_feedback_qdq
+
+    feedback = grad_precision != "fp8_nofb"
+
+    def one(g, r):
+        if (r is None or getattr(g, "ndim", 0) == 0
+                or not jnp.issubdtype(jnp.asarray(g).dtype,
+                                      jnp.floating)):
+            return g, r
+        gq, nr = error_feedback_qdq(g, r, feedback=feedback)
+        return gq, nr
+
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_r = treedef.flatten_up_to(residual)
+    pairs = [one(g, r) for g, r in zip(flat_g, flat_r)]
+    new_g = jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs])
+    new_r = jax.tree_util.tree_unflatten(treedef, [p[1] for p in pairs])
+    return new_g, new_r
+
+
 def accelerate(
     init_fn: Callable[[Any], Any],
     loss_fn: LossFn,
@@ -149,6 +217,7 @@ def accelerate(
     devices: Optional[Sequence] = None,
     extra_metrics_fn: Optional[Callable] = None,
     steps_per_call: int = 1,
+    grad_precision: Optional[str] = None,
 ) -> AccelerateResult:
     """Build the sharded training program.
 
@@ -165,6 +234,13 @@ def accelerate(
         optimizer steps — the dispatch-overhead amortization lever of
         the async pipelined executor). Donation and per-step semantics
         are preserved; metrics come back stacked along a leading K axis.
+      grad_precision: "bf16" (exact, default) | "fp8" — quantize the
+        per-shard gradient tree with an ERROR-FEEDBACK residual
+        carried in ``TrainState.wire_residual`` (zeros at init,
+        param-shaped/-sharded). None resolves the Context knob
+        (``grad_precision``). Resolved at BUILD time: the residual
+        changes the TrainState structure, so it cannot flip under a
+        live retune the way the dense-gather wire can.
     """
     from dlrover_tpu.common.config import get_context
     from dlrover_tpu.utils.compile_cache import enable_compile_cache
@@ -203,12 +279,21 @@ def accelerate(
     replicated = NamedSharding(mesh, PartitionSpec())
     batch_spec = batch_sharding(mesh)
 
+    grad_precision = resolve_grad_precision(grad_precision)
+
     def make_state(r) -> TrainState:
         params = init_fn(r)
+        residual = None
+        if grad_precision != "bf16":
+            # error-feedback residual: zeros, param-shaped — sharded
+            # like the params (the rules match the mirrored
+            # wire_residual/... paths), so it reshards with them
+            residual = jax.tree.map(jnp.zeros_like, params)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=optimizer.init(params),
+            wire_residual=residual,
         )
 
     abstract_state = jax.eval_shape(make_state, rng)
@@ -253,6 +338,16 @@ def accelerate(
             grads, loss, aux = _accumulate_grads(
                 state.params, batch, step_rng
             )
+        new_residual = state.wire_residual
+        if state.wire_residual is not None and grad_precision != "bf16":
+            # low-precision gradient path with error feedback: the
+            # optimizer (and everything downstream — norm, finite
+            # gate) consumes the decompressed gradients the quantized
+            # wire delivers; the decompression error rides forward in
+            # the state so it telescopes instead of compounding
+            grads, new_residual = _apply_grad_wire(
+                grads, state.wire_residual, grad_precision
+            )
         if hasattr(optimizer, "update_with_grad_fn"):
             # two-gradient optimizers (WSAM/SAM family): hand them a full
             # forward/backward at arbitrary params on this same batch
@@ -289,7 +384,8 @@ def accelerate(
         if extra_metrics_fn is not None:
             metrics.update(extra_metrics_fn(state.params, grads))
         new_state = TrainState(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
+            step=state.step + 1, params=new_params,
+            opt_state=new_opt_state, wire_residual=new_residual,
         )
         return new_state, metrics
 
@@ -371,10 +467,11 @@ def accelerate(
         ))
 
     logger.info(
-        "accelerate: mesh=%s accum=%d rules=%s remat=%s steps_per_call=%d",
+        "accelerate: mesh=%s accum=%d rules=%s remat=%s steps_per_call=%d"
+        " grad_precision=%s",
         dict(zip(mesh.axis_names, mesh.devices.shape)),
         accum, strategy.rule_set, strategy.remat_policy or "none",
-        steps_per_call,
+        steps_per_call, grad_precision,
     )
     return AccelerateResult(
         train_step=jit_train_step,

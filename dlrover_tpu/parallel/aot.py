@@ -498,6 +498,33 @@ def aot_compile_train_step(
                 # skips the probe, it does not kill the fit-proof
                 logger.warning(
                     "quantization drift probe skipped", exc_info=True)
+        # dense-wire families: the fsdp gather wire (trace-time knob,
+        # models/llama.resolve_fsdp_precision) and the error-feedback
+        # gradient path (build-time knob, accelerate) each ratchet
+        # their own G109 entry when resolved quantized
+        try:
+            from dlrover_tpu.models.llama import resolve_fsdp_precision
+
+            if resolve_fsdp_precision(config) != "bf16":
+                drift_rep = gl.quantization_drift_audit(family="fsdp")
+                report.lint_findings = (list(report.lint_findings)
+                                        + list(drift_rep.findings))
+        except Exception:  # noqa: BLE001 — same contract as the moe
+            # probe: skip, never kill the fit-proof
+            logger.warning(
+                "fsdp drift probe skipped", exc_info=True)
+        try:
+            from dlrover_tpu.parallel.accelerate import (
+                resolve_grad_precision,
+            )
+
+            if resolve_grad_precision() != "bf16":
+                drift_rep = gl.quantization_drift_audit(family="grad")
+                report.lint_findings = (list(report.lint_findings)
+                                        + list(drift_rep.findings))
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "grad drift probe skipped", exc_info=True)
         for f in report.lint_findings:
             logger.warning("graph lint: %s", f.render())
     logger.info("AOT report: %s", report.to_json())

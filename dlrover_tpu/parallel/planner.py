@@ -90,6 +90,17 @@ class ModelSpec:
     # schedule does not. "fp8_qdq" (the reference oracle) prices as
     # bf16: its wire IS full precision.
     moe_precision: str = "bf16"
+    # dense FSDP wire precision (models/llama.py fsdp_precision):
+    # "fp8" ships the per-layer param GATHERS of the scan-over-layers
+    # as block-scaled e4m3 + f32 scales (``fsdp_wire_bytes_per_elem``
+    # — ~1/4 of an f32 gather); the gradient reduce-scatter direction
+    # stays at the param dtype — under GSPMD the cotangent reduction
+    # ships the compute dtype regardless of the gradient-path
+    # quantization (``grad_precision``), whose error-feedback qdq is a
+    # numerics contract, not a transport change. "fp8_qdq" (the
+    # dequant-exact oracle) prices at the full-precision wire it
+    # actually ships.
+    fsdp_precision: str = "bf16"
 
     def moe_wire_bytes_per_elem(self) -> float:
         """Wire bytes per exchanged row element, scale side-band
@@ -109,6 +120,48 @@ class ModelSpec:
         if self.moe_precision == "fp8_qdq":
             return 4.0
         return float(self.dtype_bytes)
+
+    def fsdp_wire_bytes_per_elem(self) -> float:
+        """Wire bytes per gathered PARAM element on the dense FSDP
+        gather legs, scale side-band included — the fsdp analog of
+        ``moe_wire_bytes_per_elem`` and likewise the ONE formula the
+        pricing, the G106 audit comparison and the bench wire-bytes
+        ratio read. "fp8" ships e4m3 values + one f32 scale per
+        quantization block (blocks along each kernel's last dim;
+        hidden_size is the representative channel count). "fp8_qdq"
+        decodes BEFORE the wire, so it prices at the param bytes it
+        actually ships (never winning on bytes it does not save)."""
+        if self.fsdp_precision == "fp8":
+            from dlrover_tpu.ops.quantize import resolve_quant_block
+
+            block = resolve_quant_block(max(1, int(self.hidden_size)))
+            return 1.0 + 4.0 / block
+        return float(self.param_bytes)
+
+    def fsdp_byte_split(self, fsdp: int, tensor: int = 1,
+                        pipe: int = 1) -> Tuple[float, float]:
+        """(gather_bytes, scatter_bytes) of the per-step dense FSDP
+        traffic for one chip — the two DIRECTIONS of the wire, split
+        so each can be priced at the dtype it actually ships:
+
+          gather  : 2 traversals of the sharded params (the forward
+                    per-layer all-gather + the backward re-gather the
+                    remat replay pays) at ``fsdp_wire_bytes_per_elem``
+                    — the legs the fsdp_precision knob compresses;
+          scatter : 1 traversal (the gradient reduce-scatter) at the
+                    param dtype — under GSPMD the cotangent reduction
+                    ships the compute dtype regardless of
+                    ``grad_precision`` (see docs/parallelism.md).
+
+        At precision "bf16" the sum reproduces the historical
+        ``3 * shard_bytes * (fsdp-1)/fsdp`` exactly."""
+        if fsdp <= 1:
+            return 0.0, 0.0
+        shard_elems = self.param_count / (tensor * pipe)
+        frac = (fsdp - 1) / fsdp
+        gather = 2.0 * shard_elems * self.fsdp_wire_bytes_per_elem() * frac
+        scatter = shard_elems * self.param_bytes * frac
+        return gather, scatter
 
 
 # Recompute multiplier on executed FLOPs per remat policy: "full" re-runs
@@ -424,10 +477,12 @@ def predicted_collective_bytes(
         )
         out["tp"] = 4 * model.num_layers * bytes_per_ar
     if fsdp > 1:
-        shard_bytes = model.param_count * model.param_bytes / (
-            tensor * pipe
-        )
-        out["fsdp"] = 3 * shard_bytes * (fsdp - 1) / fsdp
+        # dtype-aware split (ModelSpec.fsdp_byte_split): the 2 gather
+        # traversals at the wire precision + the reduce-scatter at the
+        # param dtype — at "bf16" this IS the historical
+        # 3 * shard_bytes * (fsdp-1)/fsdp
+        gather_b, scatter_b = model.fsdp_byte_split(fsdp, tensor, pipe)
+        out["fsdp"] = gather_b + scatter_b
     if data > 1:
         grad_bytes = model.param_count * model.param_bytes / (
             tensor * pipe * fsdp
@@ -631,16 +686,38 @@ def estimate(
         moe_disp_comm_bf16_s = overlap_exposed_comm(
             moe_disp_comm_bf16_serial_s, moe_gemm_s, chunks)
 
-    fsdp_comm_serial_s = fsdp_comm_s
+    # dense-wire split twins: gather legs (dtype-aware — what the
+    # fsdp_precision knob compresses) vs the grad reduce-scatter (the
+    # param dtype GSPMD actually ships); the bf16 twins hold the
+    # unquantized pricing beside them so `tpurun plan` shows what the
+    # precision knob buys and the monotonicity pin (quantized <= bf16,
+    # both directions) has an in-breakdown anchor
+    gather_b, scatter_b = model.fsdp_byte_split(fsdp, tensor, pipe)
+    fsdp_gather_serial_s = gather_b / device.ici_bw
+    fsdp_scatter_s = scatter_b / device.ici_bw
+    fsdp_gather_s = fsdp_gather_serial_s
+    fsdp_comm_serial_s = fsdp_gather_serial_s + fsdp_scatter_s
+    bf16_gather_serial_s = fsdp_gather_serial_s
+    if fsdp > 1 and model.fsdp_precision != "bf16":
+        import dataclasses as _dc
+
+        bf16_gather_b, _ = _dc.replace(
+            model, fsdp_precision="bf16"
+        ).fsdp_byte_split(fsdp, tensor, pipe)
+        bf16_gather_serial_s = bf16_gather_b / device.ici_bw
+    fsdp_comm_bf16_serial_s = bf16_gather_serial_s + fsdp_scatter_s
+    bf16_gather_s = bf16_gather_serial_s
     if model.fsdp_prefetch and fsdp > 1 and fsdp_comm_s > 0:
-        # layer prefetch hides the gathers (2 of the 3 shard-bytes
-        # traversals: the forward all-gather and the backward
-        # re-gather) under the neighboring layers' compute — a chunk
-        # schedule with one chunk per layer; the grad reduce-scatter
-        # (the third traversal) has nothing later to hide under
-        gather_s = fsdp_comm_s * 2.0 / 3.0
-        fsdp_comm_s = (fsdp_comm_s - gather_s) + overlap_exposed_comm(
-            gather_s, compute_s, max(1, model.num_layers))
+        # layer prefetch hides the GATHER legs (forward all-gather +
+        # the backward re-gather) under the neighboring layers'
+        # compute — a chunk schedule with one chunk per layer; the
+        # grad reduce-scatter has nothing later to hide under
+        fsdp_gather_s = overlap_exposed_comm(
+            fsdp_gather_serial_s, compute_s, max(1, model.num_layers))
+        bf16_gather_s = overlap_exposed_comm(
+            bf16_gather_serial_s, compute_s, max(1, model.num_layers))
+    fsdp_comm_s = fsdp_gather_s + fsdp_scatter_s
+    fsdp_comm_bf16_s = bf16_gather_s + fsdp_scatter_s
 
     # comm + dispatch fold into the step time through the shared
     # combiner (overlap max + dispatch floor; see combine_step_time)
@@ -723,6 +800,17 @@ def estimate(
             # schedule bought
             "fsdp_comm_s": fsdp_comm_s,
             "fsdp_comm_serial_s": fsdp_comm_serial_s,
+            # the dense-wire split: gather legs (dtype-aware, the
+            # fsdp_precision knob's lever, overlappable by
+            # fsdp_prefetch) vs the grad reduce-scatter (param-dtype,
+            # never hidden) — plus the bf16 twins (equal to the pair
+            # above at precision "bf16"), the quantized-vs-bf16 delta
+            # `tpurun plan` surfaces
+            "fsdp_gather_s": fsdp_gather_s,
+            "fsdp_gather_serial_s": fsdp_gather_serial_s,
+            "fsdp_scatter_s": fsdp_scatter_s,
+            "fsdp_comm_bf16_s": fsdp_comm_bf16_s,
+            "fsdp_comm_bf16_serial_s": fsdp_comm_bf16_serial_s,
             "dp_comm_s": dp_comm_s,
             "seq_comm_s": seq_comm_s,
             "pipe_comm_s": pipe_comm_s,
@@ -873,6 +961,13 @@ def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
         moe_precision=(
             config.moe_precision
             or str(getattr(get_context(), "moe_precision", "bf16")
+                   or "bf16")
+        ),
+        # "" = the Context knob, exactly how models/llama resolves the
+        # dense wire at trace time (resolve_fsdp_precision)
+        fsdp_precision=(
+            getattr(config, "fsdp_precision", "")
+            or str(getattr(get_context(), "fsdp_precision", "bf16")
                    or "bf16")
         ),
     )

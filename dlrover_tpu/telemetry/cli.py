@@ -296,6 +296,12 @@ def _cmd_plan(args) -> int:
                 f"world={running.get('world')}")
         if running.get("dispatch_chunks"):
             line += f" C={running.get('dispatch_chunks')}"
+        # the wire precisions, shown only when they deviate from the
+        # bf16 default (the interesting case)
+        if (running.get("moe_precision") or "bf16") != "bf16":
+            line += f" p={running.get('moe_precision')}"
+        if (running.get("fsdp_precision") or "bf16") != "bf16":
+            line += f" fp={running.get('fsdp_precision')}"
         print(line)
     _print_exposed_comm(report.get("exposed_comm"))
     corr = report.get("corrections")
@@ -316,6 +322,10 @@ def _cmd_plan(args) -> int:
                      f"mesh={c.get('mesh')} ")
             if c.get("dispatch_chunks"):
                 line += f"C={c.get('dispatch_chunks')} "
+            if (c.get("moe_precision") or "bf16") != "bf16":
+                line += f"p={c.get('moe_precision')} "
+            if (c.get("fsdp_precision") or "bf16") != "bf16":
+                line += f"fp={c.get('fsdp_precision')} "
             line += f"predicted {d.get('predicted_speedup')}x"
             if d.get("applied"):
                 line += (f" (applied, realized "

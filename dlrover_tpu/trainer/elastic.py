@@ -75,6 +75,8 @@ class ElasticTrainer:
         model_spec=None,
         dispatch_chunks: Optional[int] = None,
         moe_precision: Optional[str] = None,
+        fsdp_precision: Optional[str] = None,
+        grad_precision: Optional[str] = None,
     ):
         self._init_fn = init_fn
         self._loss_fn = loss_fn
@@ -121,6 +123,26 @@ class ElasticTrainer:
             moe_precision = str(getattr(
                 get_context(), "moe_precision", "bf16") or "bf16")
         self.moe_precision = self._effective_precision(moe_precision)
+        # dense FSDP wire precision: the same trace-time program-cache
+        # contract as moe_precision (the key carries |fp=, _build pins
+        # the Context knob, prewarm/retune swap it live) — normalized
+        # through the SAME capability probe so key/report/pricing agree
+        # with the traced program
+        if fsdp_precision is None:
+            from dlrover_tpu.common.config import get_context
+
+            fsdp_precision = str(getattr(
+                get_context(), "fsdp_precision", "bf16") or "bf16")
+        self.fsdp_precision = self._effective_precision(fsdp_precision)
+        # gradient-path precision (error-feedback residual): a BUILD-
+        # time knob — it changes the TrainState STRUCTURE, so it is
+        # pinned at construction and never enumerated for live retunes
+        # (a plan carrying a different value is negative-acked by the
+        # executor). The program-cache key still carries |gp= so
+        # distinct builds never collide.
+        from dlrover_tpu.parallel.accelerate import resolve_grad_precision
+
+        self.grad_precision = resolve_grad_precision(grad_precision)
         # explicit device set (default: the whole jax.devices() world);
         # the agent hands the post-change survivor subset to
         # on_world_change, and dryruns carve sub-worlds out of one host
@@ -245,6 +267,8 @@ class ElasticTrainer:
             + f"|mesh={mesh_axes_key(strategy.mesh)}"
             + f"|c={self.dispatch_chunks}"
             + f"|p={self.moe_precision}"
+            + f"|fp={self.fsdp_precision}"
+            + f"|gp={self.grad_precision}"
         )
 
     def _build(self, devices: Optional[list]) -> AccelerateResult:
@@ -262,6 +286,7 @@ class ElasticTrainer:
 
         get_context().dispatch_chunks = self.dispatch_chunks
         get_context().moe_precision = self.moe_precision
+        get_context().fsdp_precision = self.fsdp_precision
         strategy = self._resolved_strategy(num_devices)
         key = self._program_key(actual, strategy)
         self._current_program_key = key
@@ -290,6 +315,7 @@ class ElasticTrainer:
             rng=self._rng,
             devices=devices,
             steps_per_call=self.steps_per_call,
+            grad_precision=self.grad_precision,
         )
         self.compile_count += 1
         self._programs[key] = result
@@ -461,7 +487,8 @@ class ElasticTrainer:
     def prewarm(self, devices=None, execute: bool = True,
                 steps_per_call: Optional[int] = None,
                 mesh=None, dispatch_chunks: Optional[int] = None,
-                moe_precision: Optional[str] = None) -> bool:
+                moe_precision: Optional[str] = None,
+                fsdp_precision: Optional[str] = None) -> bool:
         """Standby-compile the program for a topology OR knob set we may
         swap to — the (N - node_unit)-device survivor world before a
         failure, or an optimizer-chosen (``steps_per_call``, mesh
@@ -483,6 +510,7 @@ class ElasticTrainer:
         prev_k, prev_mesh = self.steps_per_call, self._mesh_override
         prev_c = self.dispatch_chunks
         prev_p = self.moe_precision
+        prev_fp = self.fsdp_precision
         prev_key = self._current_program_key
         if steps_per_call is not None:
             self.steps_per_call = max(1, int(steps_per_call))
@@ -492,6 +520,8 @@ class ElasticTrainer:
             self.dispatch_chunks = max(1, int(dispatch_chunks))
         if moe_precision is not None:
             self.moe_precision = self._effective_precision(moe_precision)
+        if fsdp_precision is not None:
+            self.fsdp_precision = self._effective_precision(fsdp_precision)
         try:
             before = self.compile_count
             result = self._build(
@@ -499,18 +529,20 @@ class ElasticTrainer:
             compiled = self.compile_count > before
             if execute and compiled:
                 # the dummy step also forces the standby TRACE, which
-                # is when ops.moe reads the chunk/precision knobs off
-                # the Context
+                # is when ops.moe / models.llama read the chunk and
+                # precision knobs off the Context
                 self._execute_dummy_step(result)
         finally:
             self.steps_per_call = prev_k
             self._mesh_override = prev_mesh
             self.dispatch_chunks = prev_c
             self.moe_precision = prev_p
+            self.fsdp_precision = prev_fp
             # the ACTIVE program keeps its trace-time knobs (and its
             # attribution identity — not re-pointed at the standby key)
             get_context().dispatch_chunks = prev_c
             get_context().moe_precision = prev_p
+            get_context().fsdp_precision = prev_fp
             self._current_program_key = prev_key
         return compiled
 
@@ -548,23 +580,28 @@ class ElasticTrainer:
     def retune(self, state: Any, steps_per_call: Optional[int] = None,
                mesh=None, dispatch_chunks: Optional[int] = None,
                moe_precision: Optional[str] = None,
+               fsdp_precision: Optional[str] = None,
                reason: str = "optimizer") -> Any:
         """Apply optimizer-chosen PROGRAM knobs on the current world
         without a restart: ``steps_per_call`` (the lax.scan multi-step
-        degree), ``dispatch_chunks`` / ``moe_precision`` (the
-        grouped_ep chunked-dispatch degree and wire precision —
-        trace-time knobs the program-cache key carries) and/or a mesh
-        override (a different factorization of the same devices). Same
-        mechanics as ``live_reshard`` — the caller drains its window
-        first; snapshot → rebuild → reshard — but against the
-        unchanged device set, and through the program cache keyed on
-        these very knobs, so a prewarmed knob set swaps with ZERO
-        recompiles. On failure the previous knobs (and the previously
-        compiled program) are restored and the error propagates — the
-        job keeps running the old config."""
+        degree), ``dispatch_chunks`` / ``moe_precision`` /
+        ``fsdp_precision`` (the grouped_ep chunked-dispatch degree and
+        the MoE / dense-FSDP wire precisions — trace-time knobs the
+        program-cache key carries) and/or a mesh override (a different
+        factorization of the same devices). Same mechanics as
+        ``live_reshard`` — the caller drains its window first;
+        snapshot → rebuild → reshard — but against the unchanged
+        device set, and through the program cache keyed on these very
+        knobs, so a prewarmed knob set swaps with ZERO recompiles.
+        (``grad_precision`` is deliberately absent: the error-feedback
+        residual is part of TrainState, so that knob cannot flip under
+        a live state.) On failure the previous knobs (and the
+        previously compiled program) are restored and the error
+        propagates — the job keeps running the old config."""
         prev_k, prev_mesh = self.steps_per_call, self._mesh_override
         prev_c = self.dispatch_chunks
         prev_p = self.moe_precision
+        prev_fp = self.fsdp_precision
         if steps_per_call is not None:
             self.steps_per_call = max(1, int(steps_per_call))
         if mesh is not None:
@@ -573,6 +610,8 @@ class ElasticTrainer:
             self.dispatch_chunks = max(1, int(dispatch_chunks))
         if moe_precision is not None:
             self.moe_precision = self._effective_precision(moe_precision)
+        if fsdp_precision is not None:
+            self.fsdp_precision = self._effective_precision(fsdp_precision)
         try:
             return self.live_reshard(
                 state, devices=self._devices, reason=reason,
@@ -583,6 +622,7 @@ class ElasticTrainer:
             self._mesh_override = prev_mesh
             self.dispatch_chunks = prev_c
             self.moe_precision = prev_p
+            self.fsdp_precision = prev_fp
             # re-point at the old program (cache hit, and the Context
             # chunk knob re-pinned by _build) so the trainer stays
             # runnable with the pre-retune config

@@ -422,7 +422,8 @@ class OptimizerPlanHook(TrainHook):
 
         wants_program = (bool(cfg.steps_per_call) or bool(cfg.mesh_shape)
                          or bool(getattr(cfg, "dispatch_chunks", 0))
-                         or bool(getattr(cfg, "moe_precision", "")))
+                         or bool(getattr(cfg, "moe_precision", ""))
+                         or bool(getattr(cfg, "fsdp_precision", "")))
         if wants_program and jax.process_count() > 1:
             # each process polls on its own clock: an in-place program
             # swap applied at different wall times would diverge the
@@ -467,6 +468,8 @@ class OptimizerPlanHook(TrainHook):
                 getattr(cfg, "dispatch_chunks", 0) or None),
             moe_precision=(
                 getattr(cfg, "moe_precision", "") or None),
+            fsdp_precision=(
+                getattr(cfg, "fsdp_precision", "") or None),
             plan_id=plan_id,
             trace_id=getattr(cfg, "trace_id", "") or "",
             predicted_speedup=float(
@@ -853,6 +856,7 @@ class TrainExecutor:
                        mesh_shape: Optional[Dict[str, int]] = None,
                        dispatch_chunks: Optional[int] = None,
                        moe_precision: Optional[str] = None,
+                       fsdp_precision: Optional[str] = None,
                        plan_id: str = "", trace_id: str = "",
                        predicted_speedup: float = 0.0,
                        prewarm: bool = True):
@@ -860,14 +864,15 @@ class TrainExecutor:
         apply it at the next loop boundary — drain the window, then
         retune the host knob (``train_window``) in place and swap the
         compiled program (``steps_per_call`` / ``dispatch_chunks`` /
-        ``moe_precision`` / mesh override) through the program cache.
-        No process restart."""
+        ``moe_precision`` / ``fsdp_precision`` / mesh override) through
+        the program cache. No process restart."""
         self._retune_request = {
             "steps_per_call": steps_per_call,
             "train_window": train_window,
             "mesh_shape": dict(mesh_shape) if mesh_shape else None,
             "dispatch_chunks": dispatch_chunks,
             "moe_precision": moe_precision,
+            "fsdp_precision": fsdp_precision,
             "plan_id": plan_id,
             "trace_id": trace_id,
             "predicted_speedup": float(predicted_speedup or 0.0),
@@ -1012,12 +1017,38 @@ class TrainExecutor:
                 return
             if mp == cur_p:
                 mp = None
+        fp = req.get("fsdp_precision")
+        cur_fp = str(getattr(
+            self._trainer, "fsdp_precision", "bf16") or "bf16")
+        if fp is not None:
+            eff_fp = fp
+            normalize = getattr(self._trainer, "_effective_precision",
+                                None)
+            if normalize is not None:
+                eff_fp = normalize(fp)
+            if eff_fp != fp:
+                # same phantom-apply hazard as the MoE wire: a backend
+                # failing the fp8 probe would run (and the trainer
+                # report) bf16 while the master marks fp8 applied —
+                # negative-ack so the knob tuple is blacklisted
+                logger.warning(
+                    "optimizer plan %s wants fsdp_precision=%s but the "
+                    "backend runs %s (fp8 probe failed); negative-"
+                    "acking so the master blacklists it", plan_id, fp,
+                    eff_fp,
+                )
+                self._report_trainer_config(plan_id=plan_id,
+                                            apply_failed=True)
+                return
+            if fp == cur_fp:
+                fp = None
         needs_program = (k is not None or mesh is not None
-                         or ch is not None or mp is not None)
+                         or ch is not None or mp is not None
+                         or fp is not None)
         emit_event(
             EventKind.OPTIMIZER_APPLY_BEGIN, plan_id=plan_id,
             steps_per_call=k, train_window=w, dispatch_chunks=ch,
-            moe_precision=mp,
+            moe_precision=mp, fsdp_precision=fp,
             mesh=req.get("mesh_shape") if mesh is not None else None,
             step=int(getattr(self.state, "step", 0)),
         )
@@ -1040,11 +1071,13 @@ class TrainExecutor:
                         devices=getattr(self._trainer, "devices", None),
                         steps_per_call=k, mesh=mesh,
                         dispatch_chunks=ch, moe_precision=mp,
+                        fsdp_precision=fp,
                     )
                 compiles_before = self._trainer.compile_count
                 self.state = self._trainer.retune(
                     self.state, steps_per_call=k, mesh=mesh,
                     dispatch_chunks=ch, moe_precision=mp,
+                    fsdp_precision=fp,
                 )
                 recompiled = (
                     self._trainer.compile_count - compiles_before
@@ -1093,6 +1126,8 @@ class TrainExecutor:
                 self._trainer, "dispatch_chunks", 1)),
             moe_precision=str(getattr(
                 self._trainer, "moe_precision", "bf16")),
+            fsdp_precision=str(getattr(
+                self._trainer, "fsdp_precision", "bf16")),
         )
         logger.info(
             "optimizer plan %s applied in %.2fs (recompiled=%d, "
@@ -1188,6 +1223,21 @@ class TrainExecutor:
                 moe_dispatch=(
                     getattr(spec, "moe_dispatch", "")
                     if getattr(spec, "num_experts", 0) else ""),
+                # the dense-wire knobs are reported only when the
+                # trainer carries a planner ModelSpec (the llama-family
+                # path that actually implements the wire): an
+                # unconditional "bf16" would unpark the optimizer's
+                # fsdp_precision family for models whose loss_fn never
+                # resolves the knob — a plan the worker acks but the
+                # program ignores (the moe_dispatch precedent above)
+                fsdp_precision=(
+                    str(getattr(self._trainer, "fsdp_precision",
+                                "bf16") or "bf16")
+                    if spec is not None else ""),
+                grad_precision=(
+                    str(getattr(self._trainer, "grad_precision",
+                                "bf16") or "bf16")
+                    if spec is not None else ""),
                 global_batch=int(
                     result.strategy.global_batch_size or 0),
                 plan_id=plan_id,

@@ -100,6 +100,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "probe); workers see it as "
                         "DLROVER_TPU_MOE_PRECISION and the runtime "
                         "optimizer retunes it live")
+    p.add_argument("--fsdp_precision", default=None,
+                   choices=["bf16", "fp8", "fp8_qdq"],
+                   help="dense FSDP wire precision: fp8 quantizes the "
+                        "per-layer param gathers of the scan-over-"
+                        "layers to block-scaled e4m3 (values + f32 "
+                        "scales, ~1/4 of an f32 gather; dequant-exact, "
+                        "gradients untouched; bf16 fallback when the "
+                        "backend fails the fp8 probe); workers see it "
+                        "as DLROVER_TPU_FSDP_PRECISION and the runtime "
+                        "optimizer retunes it live")
+    p.add_argument("--grad_precision", default=None,
+                   choices=["bf16", "fp8"],
+                   help="gradient-path precision: fp8 quantizes the "
+                        "per-shard gradient tree with an error-"
+                        "feedback residual carried in TrainState "
+                        "(bounded drift, G109-ratcheted); a BUILD-time "
+                        "knob — workers see it as "
+                        "DLROVER_TPU_GRAD_PRECISION; never retuned "
+                        "live")
     p.add_argument("--live_recovery", "--live-recovery",
                    dest="live_recovery", action="store_true",
                    help="absorb survivable membership changes with an "
@@ -200,6 +219,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.dispatch_chunks)
     if args.moe_precision is not None:
         os.environ["DLROVER_TPU_MOE_PRECISION"] = args.moe_precision
+    if args.fsdp_precision is not None:
+        os.environ["DLROVER_TPU_FSDP_PRECISION"] = args.fsdp_precision
+    if args.grad_precision is not None:
+        os.environ["DLROVER_TPU_GRAD_PRECISION"] = args.grad_precision
     if args.live_recovery:
         # workers' executors route survivable changes to the in-process
         # reshard path (Context.live_recovery reads this at import)

@@ -31,6 +31,25 @@ from dlrover_tpu.utils.compile_cache import cap_cpu_isa_for_cache  # noqa: E402
 cap_cpu_isa_for_cache()
 os.environ.setdefault("DLROVER_TPU_LOG_LEVEL", "WARNING")
 
+# A SIGKILLed tier-1 run (timeout, OOM-killer) leaves a stale
+# /tmp/libtpu_lockfile behind; libtpu's init in LATER runs then waits
+# on it silently — the suite looks hung at 0% CPU before a single test
+# collects. Remove a leftover at session import — but only after an
+# flock probe proves no LIVE process holds it (os.remove succeeds on a
+# held flock, so an unconditional unlink would strip a concurrent
+# run's lock — the very conflict the file serializes). See
+# docs/operations.md "Troubleshooting".
+_lock = os.environ.get("LIBTPU_LOCKFILE", "/tmp/libtpu_lockfile")
+try:
+    if os.path.exists(_lock):
+        import fcntl
+
+        with open(_lock) as _fh:
+            fcntl.flock(_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)  # probe
+            os.remove(_lock)  # stale: nothing holds it
+except OSError:
+    pass  # held by a live process (or not ours to remove): leave it
+
 # The environment's sitecustomize force-registers an experimental TPU
 # platform ('axon') that overrides JAX_PLATFORMS; an explicit config update
 # after import is the only reliable way to pin the CPU backend.

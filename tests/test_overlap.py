@@ -363,10 +363,10 @@ class TestOptimizerChunkKnob:
         opt.update_model_info(_moe_model_info())
         opt.update_running_config(_running_report("gather"))
         run = opt._running
-        _, _, _, _, chunk_opts, _ = opt._knob_options(run)
+        _, _, _, _, chunk_opts, _, _ = opt._knob_options(run)
         assert chunk_opts == [1]  # parked off grouped_ep
         opt.update_running_config(_running_report("grouped_ep"))
-        _, _, _, _, chunk_opts, _ = opt._knob_options(opt._running)
+        _, _, _, _, chunk_opts, _, _ = opt._knob_options(opt._running)
         assert chunk_opts == [1, 2, 4, 8]
 
     def test_replan_chooses_and_publishes_a_chunk_plan(self):
@@ -452,6 +452,14 @@ def _moe_trainer(tmpdir="", chunks=1, **kwargs):
 
 
 class TestRetuneChunksZeroRecompile:
+    # the ~20 s retune e2e is slow-marked per the ISSUE 12 tier-1
+    # triage: the prewarm→retune→program-cache mechanics are
+    # knob-agnostic and stay tier-1 via PR 7's test_optimizer e2e
+    # wedges plus the newest family's gate (test_fsdp_wire
+    # TestRetuneFsdpPrecisionZeroRecompile — same cache path, same
+    # Context-pin contract); the chunk knob's OWN identity keeps its
+    # cheap tier-1 pins (program key, plan-hook routing) below
+    @pytest.mark.slow
     def test_prewarmed_chunk_retune_swaps_with_zero_recompiles(self):
         """The acceptance gate: retune() across C values through the
         program cache — a prewarmed chunk degree applies with ZERO
@@ -738,6 +746,12 @@ class TestG108SerializedCollective:
 
 
 class TestChunkedGraphLint:
+    # slow-marked per the ISSUE 12 tier-1 triage (~12 s, a full
+    # accelerate+compile): the G106 audit machinery stays tier-1 via
+    # test_lint_clean + test_fsdp_wire's quantized-program audit, and
+    # the chunk bytes-invariance via the planner unit pins; the
+    # chunked compile re-proof rides tpulint / the slow lane
+    @pytest.mark.slow
     def test_chunked_program_passes_the_audit_and_stays_clean(self):
         """G106 on the CHUNKED schedule: the ppermute ring's measured
         collective bytes stay within tolerance of the same planner

@@ -440,10 +440,10 @@ class TestOptimizerPrecisionKnob:
         opt = self._opt(store, [])
         opt.update_model_info(_moe_model_info())
         opt.update_running_config(_running_report("gather"))
-        *_, precision_opts = opt._knob_options(opt._running)
+        *_, precision_opts, _fsdp_opts = opt._knob_options(opt._running)
         assert precision_opts == ["bf16"]  # parked off grouped_ep
         opt.update_running_config(_running_report("grouped_ep"))
-        *_, precision_opts = opt._knob_options(opt._running)
+        *_, precision_opts, _fsdp_opts = opt._knob_options(opt._running)
         assert precision_opts == ["bf16", "fp8"]
 
     def test_replan_chooses_and_publishes_a_precision_plan(self):
@@ -533,6 +533,14 @@ def _moe_trainer(precision="bf16", **kwargs):
 
 
 class TestRetunePrecisionZeroRecompile:
+    # the ~16 s retune e2e is slow-marked per the ISSUE 12 tier-1
+    # triage: the prewarm→retune→program-cache mechanics are
+    # knob-agnostic and stay tier-1 via PR 7's test_optimizer e2e
+    # wedges plus the newest family's gate (test_fsdp_wire
+    # TestRetuneFsdpPrecisionZeroRecompile); the precision knob's OWN
+    # identity keeps its cheap tier-1 pins (program key, plan-hook
+    # routing) below
+    @pytest.mark.slow
     def test_prewarmed_precision_retune_swaps_with_zero_recompiles(self):
         """The acceptance gate: retune() across precisions through the
         program cache — a prewarmed fp8 wire applies with ZERO
@@ -708,6 +716,13 @@ class TestPrecisionReplanWedge:
 
 
 class TestFp8GraphLint:
+    # slow-marked per the ISSUE 12 tier-1 triage (~13 s, two full
+    # accelerate+compiles): the G106-on-a-quantized-program coverage
+    # stays tier-1 via test_fsdp_wire's dense-wire audit (same audit
+    # machinery, same dtype-aware prediction path), the moe wire ratio
+    # via the planner formula pins; the moe compile re-proof rides
+    # tpulint / the slow lane
+    @pytest.mark.slow
     def test_quantized_program_passes_the_audit_with_halved_row_bytes(
             self):
         """The acceptance pin: G106 audits the fp8 program's
