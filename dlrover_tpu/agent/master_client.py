@@ -75,6 +75,54 @@ class MasterClient:
         except ValueError:
             return {}
 
+    # -- serving request plane ----------------------------------------------
+
+    def submit_serve_request(self, prompt, max_new_tokens: int = 16,
+                             request_id: str = "",
+                             eos_id: int = -1) -> str:
+        """Enqueue one inference request; returns the router-assigned
+        request id."""
+        resp = self._channel.report(comm.ServeSubmit(
+            request_id=request_id, prompt=[int(t) for t in prompt],
+            max_new_tokens=max_new_tokens, eos_id=eos_id,
+        ))
+        return str(resp.data or request_id)
+
+    def serve_lease(self, max_requests: int = 1) -> list:
+        """Lease up to ``max_requests`` queued requests (wire dicts)."""
+        resp = self._channel.get(comm.ServeLeaseRequest(
+            node_id=self.node_id, max_requests=max_requests))
+        return list(resp.requests or [])
+
+    def serve_complete(self, request_id: str, tokens,
+                       ttft_s=None, e2e_s=None,
+                       error_code: str = "") -> comm.Response:
+        return self._channel.report(comm.ServeResult(
+            node_id=self.node_id, request_id=request_id,
+            tokens=[int(t) for t in tokens or []],
+            ttft_s=ttft_s, e2e_s=e2e_s, error_code=error_code,
+        ))
+
+    def serve_touch(self) -> comm.Response:
+        return self._channel.report(comm.ServeTouch(
+            node_id=self.node_id))
+
+    def report_serve_config(self, **kwargs) -> comm.Response:
+        """Report the serving config this worker actually runs (the
+        optimizer's serve-knob input; a non-empty plan_id acks)."""
+        kwargs.setdefault("node_id", self.node_id)
+        return self._channel.report(comm.ServeConfigReport(**kwargs))
+
+    def get_serve_report(self) -> dict:
+        """The router ledger (``tpurun requests --addr``)."""
+        import json
+
+        resp = self._channel.get(comm.ServeReportRequest())
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
+
     # -- rendezvous ---------------------------------------------------------
 
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,

@@ -68,13 +68,15 @@ FAILURE_EVENT_ATTRS = {
     "NONFINITE_STEP", "WORKER_FAILED", "HANG_DETECTED",
     "PREEMPT_NOTICE", "RDZV_TIMEOUT", "CKPT_MIRROR_TIMEOUT",
     "ERROR_REPORT", "DIAG_STRAGGLER", "DIAG_NODE_HANG",
-    "DATA_SHARD_TIMEOUT",
+    "DATA_SHARD_TIMEOUT", "SERVE_REQUEST_EVICTED",
+    "SERVE_LEASE_EXPIRED",
 }
 FAILURE_EVENT_VALUES = {
     "nonfinite_step", "worker_failed", "hang_detected",
     "preempt_notice", "rdzv_timeout", "ckpt_mirror_timeout",
     "error_report", "diag_straggler", "diag_node_hang",
-    "data_shard_timeout",
+    "data_shard_timeout", "serve_request_evicted",
+    "serve_lease_expired",
 }
 
 

@@ -144,10 +144,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             # cannot run it (too few devices, broken backend) skips the
             # probe with a warning instead of killing the whole lint
             # run and the findings already computed
-            for family in ("moe", "fsdp", "grad"):
+            # "kv" probes the serving tier's int8 page storage — the
+            # ONLY family whose quantized format is int8, not fp8
+            for family in ("moe", "fsdp", "grad", "kv"):
                 try:
                     reports.append(graph_lint.quantization_drift_audit(
-                        family=family))
+                        family=family,
+                        precision=("int8" if family == "kv"
+                                   else "fp8")))
                 except Exception as e:  # noqa: BLE001
                     import logging
 

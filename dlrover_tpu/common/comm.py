@@ -421,6 +421,11 @@ class ParallelConfig:
     # "bf16"/"fp8"): the same prewarmed program-cache swap contract —
     # a backend whose fp8 probe fails negative-acks the plan
     fsdp_precision: str = ""
+    # serving-tier knobs (0 = leave unchanged): the continuous-batching
+    # slot width and the prefill chunk, applied by serve workers through
+    # the SAME prewarmed program-cache swap as the training knobs
+    serve_slots: int = 0
+    serve_prefill_chunk: int = 0
     # optimizer decision identity: the worker echoes plan_id back in its
     # TrainerConfigReport ack, and every OPTIMIZER_* event on both sides
     # carries trace_id so the decision trail merges per incident
@@ -472,6 +477,91 @@ class TrainerConfigReport:
     # negative ack: the plan could not be applied (rebuild failed, or
     # the knobs are unsupported on this deployment) — the optimizer
     # blacklists the knob tuple instead of re-proposing it forever
+    apply_failed: bool = False
+
+
+# --------------------------------------------------------------------------
+# serving (request router + serve workers)
+# --------------------------------------------------------------------------
+
+
+@message
+class ServeSubmit:
+    """Enqueue one inference request on the master's request router."""
+
+    request_id: str = ""  # "" = router-assigned
+    prompt: Optional[List[int]] = None
+    max_new_tokens: int = 16
+    eos_id: int = -1
+
+
+@message
+class ServeLeaseRequest:
+    """Worker -> master: lease up to ``max_requests`` queued requests
+    (the serving twin of TaskRequest)."""
+
+    node_id: int = -1
+    max_requests: int = 1
+
+
+@message
+class ServeLeases:
+    # list of ServeRequest wire dicts (request_id/prompt/
+    # max_new_tokens/eos_id) — the router owns the schema
+    requests: Optional[List[Dict]] = None
+
+
+@message
+class ServeResult:
+    """Worker -> master: one request finished (tokens + the latency
+    facts the router's histograms account)."""
+
+    node_id: int = -1
+    request_id: str = ""
+    tokens: Optional[List[int]] = None
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    error_code: str = ""
+
+
+@message
+class ServeTouch:
+    """Worker liveness for the lease-expiry scan (rate-limited by the
+    worker; absence past ``serve_lease_timeout_secs`` re-leases its
+    requests)."""
+
+    node_id: int = -1
+
+
+@message
+class ServeReportRequest:
+    """Query the router ledger (``tpurun requests --addr``): queue /
+    lease / completion counts, latency percentiles, per-node rows.
+    Answered with a DiagnosisReport-style JSON blob."""
+
+    pass
+
+
+@message
+class ServeConfigReport:
+    """Serve worker -> master: the serving config actually running —
+    the runtime optimizer's serve-knob input and plan-apply ack (the
+    TrainerConfigReport pattern for the serving workload)."""
+
+    node_id: int = -1
+    world: int = 0
+    serve_slots: int = 0
+    prefill_chunk: int = 0
+    kv_precision: str = ""
+    max_seq: int = 0
+    # the REAL pool geometry (the worker's KVCacheSpec): without it
+    # the optimizer's HBM gate would price a GQA model's pool at the
+    # full query-head count — up to heads/kv_heads too large — and
+    # memory-reject slot widths that actually fit
+    num_layers: int = 0
+    kv_heads: int = 0
+    head_dim: int = 0
+    plan_id: str = ""
     apply_failed: bool = False
 
 

@@ -212,6 +212,27 @@ class Context:
         # control the telescoping tests compare against; never use it
         # to train.)
         self.grad_precision = "bf16"
+        # -- serving tier (dlrover_tpu.serving, docs/serving.md) -----
+        # fixed slot-batch width of the continuous-batching decode
+        # loop (the compiled batch dimension; the runtime optimizer
+        # retunes it live through the serve program cache)
+        self.serve_slots = 8
+        # prompt tokens prefilled per chunk, interleaved into the
+        # decode stream so long prompts cannot stall the batch (also
+        # optimizer-retunable)
+        self.serve_prefill_chunk = 32
+        # KV-page storage precision: "f32" | "bf16" | "int8" (int8 =
+        # values + f32 per-block scales, ~1/4 of f32 residency; probe
+        # fallback to f32; the G109 "kv" family ratchets the drift)
+        self.serve_kv_precision = "f32"
+        # in-flight decode dispatches before the oldest one's tokens
+        # materialize on host (the PR 3 async window, re-aimed at
+        # decode; 0 = synchronous)
+        self.serve_window = 2
+        # master-side: a leased request whose worker has not touched
+        # the router for this long is re-leased to a live worker
+        # (the shard-timeout machinery re-pointed at requests)
+        self.serve_lease_timeout_secs = 120.0
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -96,6 +96,9 @@ class LocalJobMaster:
                 # a hung node stops reporting, so the hang judgement
                 # must run on a clock, not only on report ingest
                 self.servicer.straggler_detector.scan_hangs()
+                # a stranded serve lease likewise only expires on a
+                # clock — a dead worker sends nothing
+                self.servicer.request_router.scan_expired_once()
             except Exception:  # noqa: BLE001 — stats must not kill serving
                 logger.exception("runtime stats collection failed")
 

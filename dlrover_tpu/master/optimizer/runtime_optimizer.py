@@ -316,6 +316,12 @@ class RuntimeOptimizer:
         # would stall the job with a failed rebuild each cycle
         self._failed_keys: set = set()
         self._model_info: Optional[comm.ModelInfo] = None
+        # the serving workload's running view (ServeConfigReport) —
+        # the serve-knob family's input, None until a serve worker
+        # reports; worlds tracked PER NODE so a laggard's stale report
+        # cannot rewind the view (the _node_worlds discipline)
+        self._serving: Optional[Dict] = None
+        self._serve_node_worlds: Dict[int, int] = {}
         self._calibrator: Optional[CostCalibrator] = None
         self._decisions: "collections.deque[Decision]" = (
             collections.deque(maxlen=_MAX_DECISIONS)
@@ -395,9 +401,9 @@ class RuntimeOptimizer:
                         )
                 else:
                     d.applied = True
-                    if report.realized_speedup:
-                        d.realized_speedup = round(
-                            float(report.realized_speedup), 3)
+                    realized = getattr(report, "realized_speedup", 0.0)
+                    if realized:
+                        d.realized_speedup = round(float(realized), 3)
                 break
         # a consumed plan is RETRACTED from the broadcast slot: a worker
         # restarted later (fresh _seen_plan) must not replay a plan the
@@ -414,6 +420,286 @@ class RuntimeOptimizer:
                     self._retract(report.plan_id)
                 except Exception:  # noqa: BLE001 — ack path must not die
                     logger.exception("failed to retract consumed plan")
+
+    def update_serving_config(self, report: comm.ServeConfigReport
+                              ) -> None:
+        """A SERVE worker reported its running config (serve start,
+        post-resize, or a serve-plan ack) — the serving twin of
+        ``update_running_config``. A config change (fresh worker,
+        resized world) triggers a serve-knob re-plan."""
+        with self._lock:
+            cfg = {
+                "node_id": int(report.node_id),
+                "world": int(report.world),
+                "serve_slots": int(report.serve_slots),
+                "prefill_chunk": int(report.prefill_chunk),
+                "kv_precision": report.kv_precision or "f32",
+                "max_seq": int(report.max_seq),
+                "num_layers": int(getattr(report, "num_layers", 0)),
+                "kv_heads": int(getattr(report, "kv_heads", 0)),
+                "head_dim": int(getattr(report, "head_dim", 0)),
+            }
+            if report.plan_id:
+                self._record_applied(report)
+            # per-node world tracking + stale-minority rejection, the
+            # update_running_config discipline: around a resize, a
+            # laggard peer's queued pre-resize report must neither
+            # rewind the serving view to a dead world nor fire a
+            # replan priced for it
+            nid = int(report.node_id)
+            prev_world = self._serve_node_worlds.get(nid)
+            self._serve_node_worlds[nid] = cfg["world"]
+            world_changed = (prev_world is not None
+                             and prev_world != cfg["world"]
+                             and cfg["world"] > 0)
+            prev = self._serving
+            adopted = (prev is None or world_changed
+                       or cfg["world"] == prev.get("world"))
+            if adopted:
+                self._serving = cfg
+            changed = adopted and (prev is None or any(
+                prev.get(k) != cfg[k]
+                for k in ("world", "serve_slots", "prefill_chunk",
+                          "kv_precision")))
+        if changed and not report.plan_id:
+            # an ack's config echo is the plan we just published —
+            # re-planning on it would chase our own tail
+            self.replan_serving("serve_config")
+
+    # -- the serving knob family ---------------------------------------------
+
+    def serving_config(self) -> Optional[Dict]:
+        with self._lock:
+            cfg = getattr(self, "_serving", None)
+            return dict(cfg) if cfg else None
+
+    def _serve_candidates(self, cfg: Dict) -> List[Dict]:
+        slots = max(1, cfg["serve_slots"])
+        chunk = max(1, cfg["prefill_chunk"])
+        max_seq = max(1, cfg["max_seq"])
+        slot_opts = sorted({
+            s for s in (slots // 2, slots, slots * 2, slots * 4)
+            if 1 <= s <= 256})
+        # only chunks the worker can honor EXACTLY: the reported
+        # max_seq is the page-aligned pool depth, and the engine fits
+        # chunks to its divisors (a non-divisor plan would be
+        # negative-acked — don't enumerate guaranteed nacks)
+        chunk_opts = sorted({
+            c for c in (chunk // 2, chunk, chunk * 2)
+            if 1 <= c <= max_seq and max_seq % c == 0})
+        if not chunk_opts:
+            chunk_opts = [chunk]
+        return [{"serve_slots": s, "prefill_chunk": c}
+                for s in slot_opts for c in chunk_opts]
+
+    def _serve_spec(self, cfg: Optional[Dict] = None):
+        """A ModelSpec for the decode pricing. The KV-pool geometry
+        (layers, kv heads, head_dim) comes from the SERVE WORKER's
+        report when it carries it — the worker knows its KVCacheSpec
+        exactly, and guessing heads from hidden_size would price a
+        GQA model's pool up to heads/kv_heads too large and memory-
+        reject slot widths that actually fit. ModelInfo fills the
+        param count (the weight-read term); a placeholder otherwise
+        (the RANKING is shape-driven either way)."""
+        from dlrover_tpu.parallel.planner import ModelSpec
+
+        cfg = cfg or getattr(self, "_serving", None) or {}
+        info = self._model_info
+        kv_heads = int(cfg.get("kv_heads") or 0)
+        head_dim = int(cfg.get("head_dim") or 0)
+        layers = int(cfg.get("num_layers") or 0)
+        if kv_heads and head_dim:
+            # encode the reported geometry exactly: hidden/heads is
+            # how the planner re-derives head_dim, so set heads such
+            # that hidden_size // heads == head_dim
+            hidden = (int(info.hidden_size) if info is not None
+                      and info.hidden_size else kv_heads * head_dim)
+            heads = max(1, hidden // head_dim)
+            return ModelSpec(
+                param_count=int(info.num_params) if info is not None
+                and info.num_params > 0 else 1e6,
+                num_layers=max(1, layers or (
+                    int(info.num_layers) if info is not None else 1)),
+                hidden_size=hidden,
+                seq_len=max(1, int(getattr(info, "seq_len", 0) or 128)
+                            if info is not None else 128),
+                global_batch=1,
+                num_heads=heads, kv_heads=kv_heads,
+            )
+        if info is not None and info.num_params > 0:
+            heads = max(1, (info.hidden_size or 64) // 64)
+            return ModelSpec(
+                param_count=int(info.num_params),
+                num_layers=max(1, int(info.num_layers or 1)),
+                hidden_size=max(1, int(info.hidden_size or 64)),
+                seq_len=max(1, int(info.seq_len or 128)),
+                global_batch=1,
+                num_heads=heads, kv_heads=heads,
+            )
+        return ModelSpec(param_count=1e6, num_layers=2, hidden_size=64,
+                         seq_len=128, global_batch=1, num_heads=4,
+                         kv_heads=2)
+
+    def _serve_budget_bytes(self) -> float:
+        budget = float(getattr(
+            get_context(), "device_hbm_budget_bytes", 0.0) or 0.0)
+        if budget > 0:
+            return budget
+        return float(self._device.hbm_bytes) * 0.8
+
+    def replan_serving(self, trigger: str) -> Optional[Decision]:
+        """Enumerate and price ``serve_slots`` / ``prefill_chunk``
+        under live traffic — the serving mirror of ``replan``: the
+        planner's decode term (KV-read bytes, the memory-bound regime)
+        prices candidates, the HBM feasibility gate (PR 8) refuses
+        pools that cannot fit, hysteresis/cooldown/blacklist guard the
+        churn, and winners publish through the SAME ParallelConfig
+        broadcast the training knobs ride."""
+        if not self._enabled:
+            return None
+        from dlrover_tpu.parallel.planner import (
+            estimate_decode,
+            serve_cache_bytes,
+        )
+
+        with self._lock:
+            cfg = getattr(self, "_serving", None)
+            if cfg is None:
+                return None
+            with trace_scope(current_trace_id() or None) as tid:
+                self._c_replans.inc()
+                spec = self._serve_spec(cfg)
+                world = max(1, cfg["world"])
+                kvp = cfg["kv_precision"]
+                max_seq = max(1, cfg["max_seq"])
+                budget = self._serve_budget_bytes()
+                current = estimate_decode(
+                    spec, world, cfg["serve_slots"],
+                    cfg["prefill_chunk"], max_seq, kvp,
+                    device=self._device)
+                priced, memory_rejected = [], []
+                for cand in self._serve_candidates(cfg):
+                    pool = serve_cache_bytes(
+                        spec, cand["serve_slots"], max_seq, kvp)
+                    if pool / world > budget:
+                        memory_rejected.append({
+                            "serve_slots": cand["serve_slots"],
+                            "predicted_hbm_bytes": pool / world,
+                            "budget_bytes": budget,
+                        })
+                        self._c_memory_rejected.inc()
+                        continue
+                    est = estimate_decode(
+                        spec, world, cand["serve_slots"],
+                        cand["prefill_chunk"], max_seq, kvp,
+                        device=self._device)
+                    key = (f"serve|slots={cand['serve_slots']}"
+                           f"|pc={cand['prefill_chunk']}")
+                    if key in self._failed_keys:
+                        continue
+                    priced.append({
+                        **cand, "key": key,
+                        "tokens_per_s": est["tokens_per_s"],
+                        "step_s": est["step_s"],
+                        "speedup": (est["tokens_per_s"]
+                                    / max(current["tokens_per_s"],
+                                          1e-12)),
+                    })
+                memory_rejected.sort(
+                    key=lambda r: -r["predicted_hbm_bytes"])
+                decision = Decision(
+                    trigger=f"serve:{trigger}", trace_id=tid,
+                    ts=time.time(), current=dict(cfg),
+                    current_predicted_s=current["step_s"],
+                    memory_rejected=memory_rejected[:8],
+                )
+                if not priced:
+                    self._reject(decision, "serve:no_feasible_candidate")
+                    self._decisions.append(decision)
+                    return decision
+                def churn(c):
+                    # equal throughput prefers the fewest knob flips
+                    # (the training ranking's churn tie-break): a tied
+                    # prefill_chunk change must not ride along free
+                    return ((c["serve_slots"] != cfg["serve_slots"])
+                            + (c["prefill_chunk"]
+                               != cfg["prefill_chunk"]))
+
+                priced.sort(key=lambda c: (-c["tokens_per_s"],
+                                           churn(c), c["serve_slots"]))
+                decision.candidates = [
+                    {k: (round(v, 6) if isinstance(v, float) else v)
+                     for k, v in c.items()} for c in priced[:8]]
+                best = priced[0]
+                decision.predicted_speedup = round(best["speedup"], 3)
+                unchanged = (
+                    best["serve_slots"] == cfg["serve_slots"]
+                    and best["prefill_chunk"] == cfg["prefill_chunk"])
+                pending_training = (
+                    self._pending is not None
+                    and not getattr(self._pending, "serve_slots", 0)
+                    and not getattr(self._pending,
+                                    "serve_prefill_chunk", 0))
+                if unchanged:
+                    self._reject(decision, "already_optimal")
+                elif pending_training:
+                    # ONE broadcast slot serves both planes today (the
+                    # colocation split is ROADMAP item 3): publishing
+                    # now would silently clobber an unconsumed TRAINING
+                    # plan. Defer — the next serve-config report
+                    # re-triggers this pass. (The trainer's plan hook
+                    # symmetrically ignores serve-only plans, so the
+                    # reverse clobber is an overwrite, not a bad ack.)
+                    self._reject(decision, "pending_training_plan")
+                elif best["speedup"] < self._min_speedup:
+                    self._reject(
+                        decision,
+                        f"hysteresis:{best['speedup']:.2f}"
+                        f"<{self._min_speedup:.2f}")
+                elif not self._cooldown.check(best["key"]):
+                    self._reject(
+                        decision, "cooldown:%.0fs"
+                        % self._cooldown.seconds_remaining(best["key"]))
+                else:
+                    self._choose_serving(decision, best, cfg)
+                self._decisions.append(decision)
+                return decision
+
+    def _choose_serving(self, decision: Decision, best: Dict,
+                        cfg: Dict) -> None:
+        self._plan_seq += 1
+        plan_id = f"plan-{self._plan_seq}"
+        decision.outcome = "chosen"
+        decision.plan_id = plan_id
+        decision.chosen = dict(best)
+        decision.chosen_key = best["key"]
+        self._c_chosen.inc()
+        published = comm.ParallelConfig(
+            serve_slots=(best["serve_slots"]
+                         if best["serve_slots"] != cfg["serve_slots"]
+                         else 0),
+            serve_prefill_chunk=(
+                best["prefill_chunk"]
+                if best["prefill_chunk"] != cfg["prefill_chunk"]
+                else 0),
+            plan_id=plan_id,
+            trace_id=decision.trace_id,
+            predicted_speedup=round(best["speedup"], 3),
+            prewarm=True,
+        )
+        self._pending = published
+        emit_event(
+            EventKind.OPTIMIZER_PLAN_CHOSEN,
+            plan_id=plan_id, trigger=decision.trigger,
+            predicted_speedup=round(best["speedup"], 3),
+            knob_serve_slots=best["serve_slots"],
+            knob_serve_prefill_chunk=best["prefill_chunk"],
+        )
+        logger.info("replan(%s): chose %s (predicted %.2fx tokens/s, "
+                    "plan %s)", decision.trigger, best["key"],
+                    best["speedup"], plan_id)
+        if self._publish is not None:
+            self._publish(published)
 
     def on_verdict(self, node_id: int, verdict: str) -> None:
         """Straggler-detector listener: a flagged verdict (and its
@@ -1051,12 +1337,14 @@ class RuntimeOptimizer:
         """The ``tpurun plan --addr`` payload."""
         with self._lock:
             running = self._running.to_dict() if self._running else None
+            serving = dict(self._serving) if self._serving else None
             corr = (self._calibrator.corrections.to_dict()
                     if self._calibrator is not None else None)
             pending = self._pending
         return {
             "enabled": self._enabled,
             "running": running,
+            "serving": serving,
             "corrections": corr,
             "min_speedup": self._min_speedup,
             "cooldown_secs": self._cooldown.cooldown_secs,

@@ -39,6 +39,7 @@ class MasterServicer:
         node_runtime_store=None,
         straggler_detector=None,
         runtime_optimizer=None,
+        request_router=None,
     ):
         from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
         from dlrover_tpu.master.monitor.straggler import StragglerDetector
@@ -78,6 +79,12 @@ class MasterServicer:
         )
         self.straggler_detector.add_verdict_listener(
             self.runtime_optimizer.on_verdict)
+        # the serving request plane: the PR 9 dispatch ledger
+        # generalized into a request router (enqueue/lease/complete,
+        # dead-worker re-lease, per-request latency accounting)
+        from dlrover_tpu.serving.router import RequestRouter
+
+        self.request_router = request_router or RequestRouter()
         # one failure record store: the job manager's when present (its
         # handle_training_failure records there), else our own so the
         # local master can still answer failed-node queries
@@ -113,6 +120,8 @@ class MasterServicer:
             comm.PlanRequest: self._get_plan,
             comm.AttributionRequest: self._get_attribution,
             comm.DataShardRequest: self._get_data_report,
+            comm.ServeLeaseRequest: self._serve_lease,
+            comm.ServeReportRequest: self._get_serve_report,
         }
         self._report_handlers = {
             comm.DatasetShardParams: self._new_dataset,
@@ -138,6 +147,10 @@ class MasterServicer:
             comm.JobExitRequest: self._request_job_exit,
             comm.ParallelConfig: self._set_parallel_config,
             comm.TrainerConfigReport: self._report_trainer_config,
+            comm.ServeSubmit: self._serve_submit,
+            comm.ServeResult: self._serve_complete,
+            comm.ServeTouch: self._serve_touch,
+            comm.ServeConfigReport: self._report_serve_config,
         }
 
     # -- entry points (bound to the two-method gRPC service) ----------------
@@ -233,6 +246,45 @@ class MasterServicer:
             report = self._task_manager.data_report(
                 dataset_name=req.dataset_name or "")
         return comm.DiagnosisReport(report_json=_json.dumps(report))
+
+    # -- serving request plane ----------------------------------------------
+
+    def _serve_submit(self, req: comm.ServeSubmit):
+        rid = self.request_router.submit(
+            prompt=list(req.prompt or []),
+            max_new_tokens=req.max_new_tokens,
+            request_id=req.request_id, eos_id=req.eos_id,
+        )
+        return comm.Response(success=True, data=rid)
+
+    def _serve_lease(self, req: comm.ServeLeaseRequest):
+        return comm.ServeLeases(requests=self.request_router.lease(
+            req.node_id, req.max_requests))
+
+    def _serve_complete(self, req: comm.ServeResult):
+        ok = self.request_router.complete(
+            req.node_id, req.request_id, list(req.tokens or []),
+            ttft_s=req.ttft_s, e2e_s=req.e2e_s,
+            error_code=req.error_code,
+        )
+        return comm.Response(success=ok)
+
+    def _serve_touch(self, req: comm.ServeTouch):
+        self.request_router.touch(req.node_id)
+        return comm.Response(success=True)
+
+    def _report_serve_config(self, req: comm.ServeConfigReport):
+        """A serve worker reported its actual running serving config —
+        the runtime optimizer's serve-knob family input and plan ack."""
+        self.runtime_optimizer.update_serving_config(req)
+        return comm.Response(success=True)
+
+    def _get_serve_report(self, req: comm.ServeReportRequest):
+        import json as _json
+
+        self.request_router.scan_expired_once()
+        return comm.DiagnosisReport(
+            report_json=_json.dumps(self.request_router.report()))
 
     # -- rendezvous ---------------------------------------------------------
 

@@ -257,7 +257,7 @@ def _ring_impl(c: LlamaConfig):
 
 
 def _attention_block(x, layer, config: LlamaConfig, positions,
-                     segment_ids=None):
+                     segment_ids=None, return_kv: bool = False):
     c = config
     b, s, d = x.shape
     h, kv, hd = c.num_heads, c.num_kv_heads, c.head_dim
@@ -266,6 +266,9 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
     v = (x @ layer["v_proj"]["kernel"]).reshape(b, s, kv, hd)
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
+    # serving prefill captures the post-RoPE K/V — exactly what the
+    # decode steps will read back from the KV pages
+    kv_out = (k, v) if return_kv else None
     # GQA kv heads are NOT repeated: the flash/ring kernels index the
     # shared KV head per query group, so HBM holds (and the ring
     # rotates) only the kv heads — h/kv less traffic than the repeat
@@ -343,7 +346,10 @@ def _attention_block(x, layer, config: LlamaConfig, positions,
         out = mha_reference(q, k, v, causal=True)
     out = checkpoint_name(out, "attn_out")
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
-    return out @ layer["o_proj"]["kernel"]
+    out = out @ layer["o_proj"]["kernel"]
+    if return_kv:
+        return out, kv_out
+    return out
 
 
 def _ffn_block(x, layer, config: LlamaConfig, rng):
@@ -828,6 +834,308 @@ def apply_pipelined(
     x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
     logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
     return logits.astype(jnp.float32), aux
+
+
+# -- serving: single-token decode over the paged KV cache --------------------
+#
+# The decode-step apply of the serving tier (``dlrover_tpu.serving``):
+# the same stacked-layer params, the same scan-over-layers, but the
+# sequence dimension is replaced by a KV-page READ — attention for slot
+# ``s`` is a plain slice of its own contiguous pages (gather-free; see
+# ``serving.kv_cache`` for the slot-major pool layout). Numerics follow
+# the training forward (f32 attention logits, ``finfo.min`` masking,
+# f32 softmax — the ``mha_reference`` conventions), so prefill+decode
+# matches the one-shot forward to float roundoff; ``prefill_sequence``
+# goes further and routes the whole prompt through ``_attention_block``
+# itself — ring attention included for long-context ``seq_axis``
+# configs — so its hidden states (and the first generated token) are
+# BITWISE the training forward's.
+
+
+def _kv_write_token(k_l, scale_l, new_kv, pos, active, spec):
+    """Write one token's K (or V) into its slot page at ``pos``,
+    masked by ``active`` (an admitted-and-decoding slot). The write
+    touches exactly one page row per slot — a scatter at
+    ``(slot, pos)`` — and inactive slots keep their old row, so a slot
+    mid-prefill (or parked) is never corrupted by the batch-wide
+    decode step."""
+    from dlrover_tpu.serving.kv_cache import encode_kv
+
+    s = k_l.shape[0]
+    idx = jnp.arange(s)
+    pos_c = jnp.clip(pos, 0, k_l.shape[1] - 1)
+    vals, scales = encode_kv(new_kv, spec)
+    gate = active[:, None, None]
+    old = k_l[idx, pos_c]
+    k_l = k_l.at[idx, pos_c].set(jnp.where(gate, vals, old))
+    if scales is not None and scale_l is not None:
+        old_s = scale_l[idx, pos_c]
+        scale_l = scale_l.at[idx, pos_c].set(
+            jnp.where(gate, scales, old_s))
+    return k_l, scale_l
+
+
+def _paged_attention(q, k_l, ks_l, v_l, vs_l, pos, spec, config):
+    """Decode attention: ``q [S, H, HD]`` against each slot's own pages
+    ``[S, T, KV, HD]`` with the causal mask ``t <= pos[s]``. GQA via a
+    grouped einsum (KV heads are never repeated — the pages hold, and
+    the read moves, only the KV heads). Mirrors ``mha_reference``:
+    f32 logits, ``finfo.min`` mask, f32 softmax."""
+    from dlrover_tpu.serving.kv_cache import decode_kv
+
+    s, h, hd = q.shape
+    kvh = k_l.shape[2]
+    t = k_l.shape[1]
+    group = h // kvh
+    k = decode_kv(k_l, ks_l, spec)      # [S, T, KV, HD] f32
+    v = decode_kv(v_l, vs_l, spec)
+    qg = q.reshape(s, kvh, group, hd)
+    logits = jnp.einsum(
+        "skgd,stkd->skgt", qg, k, preferred_element_type=jnp.float32
+    ) * (1.0 / (hd ** 0.5))
+    mask = jnp.arange(t)[None, :] <= pos[:, None]  # [S, T]
+    logits = jnp.where(mask[:, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("skgt,stkd->skgd", probs.astype(v.dtype), v)
+    return out.reshape(s, h * hd).astype(config.compute_dtype)
+
+
+def _chunk_attention(q, k_slot, ks_slot, v_slot, vs_slot, start, spec,
+                     config):
+    """Prefill-chunk attention: chunk queries ``[C, H, HD]`` against
+    ONE slot's pages (which already contain the chunk's own K/V at
+    ``start..start+C``), causal mask ``t <= start + i``."""
+    from dlrover_tpu.serving.kv_cache import decode_kv
+
+    cq, h, hd = q.shape
+    kvh = k_slot.shape[1]
+    t = k_slot.shape[0]
+    group = h // kvh
+    k = decode_kv(k_slot, ks_slot, spec)    # [T, KV, HD] f32
+    v = decode_kv(v_slot, vs_slot, spec)
+    qg = q.reshape(cq, kvh, group, hd)
+    logits = jnp.einsum(
+        "ckgd,tkd->ckgt", qg, k, preferred_element_type=jnp.float32
+    ) * (1.0 / (hd ** 0.5))
+    mask = (jnp.arange(t)[None, :]
+            <= start + jnp.arange(cq)[:, None])  # [C, T]
+    logits = jnp.where(mask[:, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("ckgt,tkd->ckgd", probs.astype(v.dtype), v)
+    return out.reshape(cq, h * hd).astype(config.compute_dtype)
+
+
+def _cache_xs(cache):
+    """(k, k_scale-or-None, v, v_scale-or-None) in scan-xs order; the
+    scale leaves exist only for int8 pools."""
+    return (cache["k"], cache.get("k_scale"), cache["v"],
+            cache.get("v_scale"))
+
+
+def _rebuild_cache(cache, k, ks, v, vs, length):
+    out = dict(cache, k=k, v=v, length=length)
+    if ks is not None:
+        out["k_scale"] = ks
+    if vs is not None:
+        out["v_scale"] = vs
+    return out
+
+
+def decode_step(params, cache, tokens, active, config: LlamaConfig,
+                spec):
+    """One continuous-batching decode step for EVERY slot at once.
+
+    ``tokens [S] int32``: each slot's current token (the one whose
+    successor is being predicted). ``active [S] bool``: slots that are
+    admitted and decoding — inactive (free / mid-prefill) slots compute
+    harmlessly but neither write pages nor advance ``length``. Returns
+    ``(next_tokens [S], logits [S, V] f32, cache)`` with greedy
+    next-token selection done ON DEVICE, so the engine's dispatch
+    window never needs a host sync to feed step k+1.
+
+    Dense FFN only: MoE expert dispatch for single-token batches is a
+    different kernel regime (ROADMAP item 3 names it) — a config with
+    experts must serve through ``prefill_sequence`` + a dense head or
+    wait for the MoE decode path.
+    """
+    c = config
+    if c.num_experts > 0:
+        raise NotImplementedError(
+            "decode_step serves dense llama configs; MoE decode "
+            "dispatch is not built yet (ROADMAP item 3)")
+    s = tokens.shape[0]
+    pos = cache["length"]  # the position this step writes
+    x = params["embed_tokens"]["embedding"][tokens].astype(c.compute_dtype)
+
+    def block(x_in, xs):
+        layer, k_l, ks_l, v_l, vs_l = xs
+        layer = cast_floats(layer, c.compute_dtype)
+        h, kvh, hd = c.num_heads, c.num_kv_heads, c.head_dim
+        attn_in = _rms_norm(x_in, layer["input_norm"]["scale"], c.rms_eps)
+        q = (attn_in @ layer["q_proj"]["kernel"]).reshape(s, h, hd)
+        k_new = (attn_in @ layer["k_proj"]["kernel"]).reshape(s, kvh, hd)
+        v_new = (attn_in @ layer["v_proj"]["kernel"]).reshape(s, kvh, hd)
+        # RoPE at each slot's own position (slots are a batch of
+        # length-1 sequences)
+        q = _rope(q[:, None], pos[:, None], c.rope_theta)[:, 0]
+        k_new = _rope(k_new[:, None], pos[:, None], c.rope_theta)[:, 0]
+        k_l, ks_l = _kv_write_token(k_l, ks_l, k_new, pos, active, spec)
+        v_l, vs_l = _kv_write_token(v_l, vs_l, v_new, pos, active, spec)
+        attn = _paged_attention(q, k_l, ks_l, v_l, vs_l, pos, spec, c)
+        x_mid = x_in + attn @ layer["o_proj"]["kernel"]
+        ffn_in = _rms_norm(x_mid, layer["post_norm"]["scale"], c.rms_eps)
+        gate = jax.nn.silu(ffn_in @ layer["gate_proj"]["kernel"])
+        up = ffn_in @ layer["up_proj"]["kernel"]
+        ffn = (gate * up) @ layer["down_proj"]["kernel"]
+        return x_mid + ffn, (k_l, ks_l, v_l, vs_l)
+
+    k, ks, v, vs = _cache_xs(cache)
+    xs = (params["layers"], k, ks, v, vs)
+    x, (k, ks, v, vs) = lax.scan(block, x, xs)
+    x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
+    logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
+    logits = logits.astype(jnp.float32)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    length = jnp.where(active, pos + 1, pos).astype(jnp.int32)
+    return next_tokens, logits, _rebuild_cache(cache, k, ks, v, vs,
+                                               length)
+
+
+def prefill_chunk(params, cache, tokens, slot, start, n_valid,
+                  config: LlamaConfig, spec):
+    """Prefill ONE chunk of one slot's prompt: write the chunk's K/V
+    pages and return ``(cache, last_logits [V])`` — the logits of token
+    ``n_valid - 1``, which seed the first decode step when this is the
+    prompt's final chunk.
+
+    ``tokens [C] int32`` (fixed chunk shape — the ``prefill_chunk``
+    knob), ``slot`` / ``start`` / ``n_valid`` traced scalars, so
+    admission at any slot with any prompt length is the SAME compiled
+    program: chunked prefill interleaves with the decode stream and a
+    long prompt can never stall the batch behind a monolithic prefill.
+    Chunks past the first attend to the slot's earlier pages through
+    the cache, exactly like decode. Trailing padding (``n_valid < C``)
+    is written but never read: decode's next write lands at
+    ``start + n_valid``, and every mask is position-bounded."""
+    c = config
+    if c.num_experts > 0:
+        raise NotImplementedError(
+            "prefill_chunk serves dense llama configs; use "
+            "prefill_sequence for MoE prompts")
+    cq = tokens.shape[0]
+    positions = start + jnp.arange(cq)
+    x = params["embed_tokens"]["embedding"][tokens].astype(c.compute_dtype)
+
+    def block(x_in, xs):
+        from dlrover_tpu.serving.kv_cache import encode_kv
+
+        layer, k_l, ks_l, v_l, vs_l = xs
+        layer = cast_floats(layer, c.compute_dtype)
+        h, kvh, hd = c.num_heads, c.num_kv_heads, c.head_dim
+        attn_in = _rms_norm(x_in, layer["input_norm"]["scale"], c.rms_eps)
+        q = (attn_in @ layer["q_proj"]["kernel"]).reshape(cq, h, hd)
+        k_new = (attn_in @ layer["k_proj"]["kernel"]).reshape(cq, kvh, hd)
+        v_new = (attn_in @ layer["v_proj"]["kernel"]).reshape(cq, kvh, hd)
+        q = _rope(q[None], positions[None], c.rope_theta)[0]
+        k_new = _rope(k_new[None], positions[None], c.rope_theta)[0]
+        kv_vals, kv_scales = encode_kv(k_new, spec)
+        vv_vals, vv_scales = encode_kv(v_new, spec)
+        k_l = lax.dynamic_update_slice(
+            k_l, kv_vals[None], (slot, start, 0, 0))
+        v_l = lax.dynamic_update_slice(
+            v_l, vv_vals[None], (slot, start, 0, 0))
+        if ks_l is not None:
+            ks_l = lax.dynamic_update_slice(
+                ks_l, kv_scales[None], (slot, start, 0, 0))
+            vs_l = lax.dynamic_update_slice(
+                vs_l, vv_scales[None], (slot, start, 0, 0))
+        k_slot = lax.dynamic_index_in_dim(k_l, slot, 0, keepdims=False)
+        v_slot = lax.dynamic_index_in_dim(v_l, slot, 0, keepdims=False)
+        ks_slot = (lax.dynamic_index_in_dim(ks_l, slot, 0, False)
+                   if ks_l is not None else None)
+        vs_slot = (lax.dynamic_index_in_dim(vs_l, slot, 0, False)
+                   if vs_l is not None else None)
+        attn = _chunk_attention(q, k_slot, ks_slot, v_slot, vs_slot,
+                                start, spec, c)
+        x_mid = x_in + attn @ layer["o_proj"]["kernel"]
+        ffn_in = _rms_norm(x_mid, layer["post_norm"]["scale"], c.rms_eps)
+        gate = jax.nn.silu(ffn_in @ layer["gate_proj"]["kernel"])
+        up = ffn_in @ layer["up_proj"]["kernel"]
+        ffn = (gate * up) @ layer["down_proj"]["kernel"]
+        return x_mid + ffn, (k_l, ks_l, v_l, vs_l)
+
+    k, ks, v, vs = _cache_xs(cache)
+    xs = (params["layers"], k, ks, v, vs)
+    x, (k, ks, v, vs) = lax.scan(block, x, xs)
+    x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
+    last = lax.dynamic_index_in_dim(
+        x, jnp.clip(n_valid - 1, 0, cq - 1), 0, keepdims=False)
+    logits = (last @ params["lm_head"]["kernel"].astype(c.compute_dtype))
+    length = cache["length"]
+    length = length.at[slot].set((start + n_valid).astype(jnp.int32))
+    return _rebuild_cache(cache, k, ks, v, vs, length), \
+        logits.astype(jnp.float32)
+
+
+def prefill_sequence(params, cache, tokens, slot, config: LlamaConfig,
+                     spec):
+    """One-shot prefill of a whole prompt into slot ``slot`` (start
+    must be 0: a freshly admitted slot), returning ``(cache,
+    last_logits [V])``.
+
+    Unlike ``prefill_chunk`` this routes the prompt through the
+    TRAINING forward itself — ``_attention_block`` with ``return_kv``,
+    so flash kernels, packed-segment masking and the ``seq_axis`` RING
+    attention path (``ops.ring_attention``) all apply for long-context
+    configs, and the hidden states (hence the first generated token)
+    are bitwise the training ``apply``'s. The long-prompt path of the
+    promotion scenario; continuous batching admits through
+    ``prefill_chunk`` so the batch never stalls."""
+    c = config
+    p = tokens.shape[0]
+    x = params["embed_tokens"]["embedding"][tokens][None].astype(
+        c.compute_dtype)
+    positions = jnp.broadcast_to(jnp.arange(p), (1, p))
+
+    def block(carry, xs):
+        from dlrover_tpu.serving.kv_cache import encode_kv
+
+        x_in, block_rng = carry
+        layer, k_l, ks_l, v_l, vs_l = xs
+        layer = cast_floats(layer, c.compute_dtype)
+        block_rng, ffn_rng = jax.random.split(block_rng)
+        attn_in = _rms_norm(x_in, layer["input_norm"]["scale"], c.rms_eps)
+        attn, (k_new, v_new) = _attention_block(
+            attn_in, layer, c, positions, return_kv=True)
+        x_mid = x_in + attn
+        kv_vals, kv_scales = encode_kv(k_new[0], spec)
+        vv_vals, vv_scales = encode_kv(v_new[0], spec)
+        k_l = lax.dynamic_update_slice(
+            k_l, kv_vals[None], (slot, 0, 0, 0))
+        v_l = lax.dynamic_update_slice(
+            v_l, vv_vals[None], (slot, 0, 0, 0))
+        if ks_l is not None:
+            ks_l = lax.dynamic_update_slice(
+                ks_l, kv_scales[None], (slot, 0, 0, 0))
+            vs_l = lax.dynamic_update_slice(
+                vs_l, vv_scales[None], (slot, 0, 0, 0))
+        ffn_in = _rms_norm(x_mid, layer["post_norm"]["scale"], c.rms_eps)
+        ffn_out, _aux, _dropped, _load = _ffn_block(
+            ffn_in, layer, c, ffn_rng)
+        return (x_mid + ffn_out, block_rng), (k_l, ks_l, v_l, vs_l)
+
+    k, ks, v, vs = _cache_xs(cache)
+    xs = (params["layers"], k, ks, v, vs)
+    (x, _), (k, ks, v, vs) = lax.scan(
+        block, (x, jax.random.PRNGKey(0)), xs)
+    x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
+    logits = (x[0, -1] @ params["lm_head"]["kernel"].astype(
+        c.compute_dtype))
+    length = cache["length"].at[slot].set(jnp.int32(p))
+    return _rebuild_cache(cache, k, ks, v, vs, length), \
+        logits.astype(jnp.float32)
 
 
 # -- training glue ----------------------------------------------------------

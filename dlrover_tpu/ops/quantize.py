@@ -130,6 +130,61 @@ def qdq(x: jax.Array, block: int = 0) -> jax.Array:
     return dequantize_block_scaled(v, s)
 
 
+# -- int8 storage (the serving tier's KV-cache format) -----------------------
+
+# KV-cache storage precisions (``serving.kv_cache`` resolves the knob):
+# "bf16"/"f32" = pages stored in the compute dtype; "int8" = pages
+# stored as int8 values + f32 per-block scales (~1/4 of f32 residency —
+# the decode regime is KV-READ memory-bound, so smaller pages are both
+# capacity AND bandwidth). Like the wire formats above, int8 storage is
+# judged by the G109 "kv" drift family, not trusted blindly.
+KV_PRECISIONS = ("f32", "bf16", "int8")
+
+INT8_MAX = 127.0
+
+
+def quantize_block_scaled_int8(x: "jax.Array", block: int = 0):
+    """``x [..., D]`` -> ``(values [..., D] int8, scales [..., D/block]
+    f32)``; symmetric per-block scaling (``scale = max|x| / 127``), the
+    same block geometry (and zero-block clamp) as the fp8 encode above.
+    int8 rather than e4m3 for STORAGE: a KV page is written once and
+    read every later decode step, so the format wants mantissa (int8's
+    ~2.4 digits within a block) over dynamic range — the block scale
+    already carries the range."""
+    d = x.shape[-1]
+    b = block or resolve_quant_block(d)
+    if d % b:
+        raise ValueError(
+            f"quantize_block_scaled_int8: block {b} does not divide the "
+            f"channel dim {d} (use resolve_quant_block)"
+        )
+    xb = x.astype(jnp.float32).reshape(x.shape[:-1] + (d // b, b))
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    scales = jnp.where(
+        amax > 0,
+        jnp.maximum(amax / INT8_MAX, jnp.finfo(jnp.float32).tiny),
+        1.0,
+    )
+    # round-to-nearest, clamped: the encode must be deterministic and
+    # saturating (an outlier exactly at amax lands on +-127)
+    values = jnp.clip(
+        jnp.round(xb / scales[..., None]), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+    return values.reshape(x.shape), scales
+
+
+def dequantize_block_scaled_int8(values: "jax.Array", scales: "jax.Array",
+                                 dtype=jnp.float32) -> "jax.Array":
+    """Decode: ``values * scales`` per block in f32 (int8 -> f32 is
+    exact, scales are f32), cast last — the mirror of the fp8 decode."""
+    d = values.shape[-1]
+    nb = scales.shape[-1]
+    vb = values.astype(jnp.float32).reshape(
+        values.shape[:-1] + (nb, d // nb)
+    )
+    return (vb * scales[..., None]).reshape(values.shape).astype(dtype)
+
+
 # gradient-path wire precisions (``parallel.accelerate``): unlike the
 # dense gathers a quantized gradient is NOT dequant-exact training —
 # the compression error must be carried forward ("fp8", error

@@ -923,6 +923,134 @@ def plan_stage_depths(
     return tuple(j - i for i, j in spans)
 
 
+# -- the serving decode term --------------------------------------------------
+#
+# Decode is the MEMORY-BOUND regime: each step reads every live KV page
+# plus (its share of) the weights once, per generated token — so the
+# bytes term is KV reads + weight reads over HBM bandwidth, and the
+# FLOPs term almost never binds. The slot width multiplies tokens/step
+# for nearly-flat step time (the weight read amortizes across slots;
+# the KV read scales with slots), which is exactly why continuous
+# batching wins and why ``serve_slots`` is an optimizer knob — until
+# the pool no longer fits, which is the HBM feasibility gate's job.
+
+
+def kv_bytes_per_elem(kv_precision: str, channels: int = 0) -> float:
+    """Stored bytes per KV element: int8 = values + the f32 per-block
+    scale side-band (the ``ops.quantize`` block geometry, resolved
+    against the channel/head dim when known); ONE formula for pricing,
+    the feasibility gate, ``KVCacheSpec.bytes_per_slot`` and the bench
+    wedge — they cannot drift."""
+    if kv_precision == "int8":
+        from dlrover_tpu.ops.quantize import (
+            QUANT_BLOCK,
+            resolve_quant_block,
+        )
+
+        block = (resolve_quant_block(channels) if channels
+                 else QUANT_BLOCK)
+        return 1.0 + 4.0 / block
+    if kv_precision == "bf16":
+        return 2.0
+    return 4.0
+
+
+def serve_cache_bytes(m: ModelSpec, serve_slots: int, max_seq: int,
+                      kv_precision: str = "f32") -> float:
+    """Whole-pool KV residency (K and V, every slot at full depth —
+    preallocated, so this is what must FIT, not an average)."""
+    kv_heads = m.kv_heads or m.num_heads or 1
+    heads = max(1, m.num_heads or 1)
+    head_dim = m.hidden_size // heads
+    elems = (m.num_layers * serve_slots * max_seq
+             * max(1, kv_heads) * head_dim)
+    return 2.0 * elems * kv_bytes_per_elem(kv_precision, head_dim)
+
+
+def decode_kv_read_bytes(m: ModelSpec, serve_slots: int, seq_fill: int,
+                         kv_precision: str = "f32") -> float:
+    """Bytes of KV pages one decode step reads: every live token's K
+    and V, every layer, every slot (``seq_fill`` = the depth actually
+    filled — callers price at max_seq/2 as the steady-state average)."""
+    kv_heads = m.kv_heads or m.num_heads or 1
+    heads = max(1, m.num_heads or 1)
+    head_dim = m.hidden_size // heads
+    elems = (m.num_layers * serve_slots * seq_fill
+             * max(1, kv_heads) * head_dim)
+    return 2.0 * elems * kv_bytes_per_elem(kv_precision, head_dim)
+
+
+def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
+                    prefill_chunk: int, max_seq: int,
+                    kv_precision: str = "f32",
+                    device: Optional[DeviceSpec] = None) -> Dict:
+    """Price one serving config: predicted decode-step seconds and
+    tokens/second, with the breakdown the decision trail shows.
+
+    Terms (per device, ``num_devices`` shards the batch and weights):
+      kv_read_s      KV pages at half fill over HBM bandwidth
+      weight_read_s  2 bytes/param/step over HBM bandwidth (decode
+                     re-reads the weights once per step; batch-
+                     amortized across slots by construction)
+      flops_s        2*params*slots/peak — the check that the regime
+                     really is memory-bound
+      dispatch_s     the PR 3 host floor, one dispatch per step
+      prefill amortization: a bigger chunk admits a prompt in fewer
+                     interleaved steps but each chunk stalls one
+                     decode step longer — priced as chunk_steps
+                     spread over the chunk's tokens
+
+    Returns {"step_s", "tokens_per_s", "cache_bytes",
+    "cache_bytes_per_device", "breakdown"}. ``tokens_per_s`` is
+    monotone-increasing in ``serve_slots`` until the HBM gate refuses
+    the pool — which is the caller's check (``serve_cache_bytes``
+    against the device budget), not this function's.
+    """
+    dev = device or DeviceSpec()
+    n = max(1, int(num_devices))
+    slots = max(1, int(serve_slots))
+    chunk = max(1, int(prefill_chunk))
+    cache_bytes = serve_cache_bytes(m, slots, max_seq, kv_precision)
+    kv_read = decode_kv_read_bytes(
+        m, slots, max(1, max_seq // 2), kv_precision) / n
+    kv_read_s = kv_read / dev.hbm_bw
+    weight_read_s = (m.param_count * 2.0 / n) / dev.hbm_bw
+    flops_s = (2.0 * m.param_count * slots / n) / (
+        dev.flops_per_s * MAX_EFFICIENCY)
+    dispatch_s = HOST_DISPATCH_OVERHEAD_S
+    # a prompt of L tokens takes ceil(L/chunk) interleaved prefill
+    # calls; each call costs ~one dispatch + the chunk's weight read.
+    # Amortized per generated token (assuming ~one admission per slot
+    # drain), this prefers bigger chunks until the chunk itself
+    # dominates a decode step — the trade the optimizer enumerates.
+    avg_prompt = max(1.0, max_seq / 4.0)
+    prefill_calls = math.ceil(avg_prompt / chunk)
+    prefill_s_per_req = prefill_calls * (
+        dispatch_s + weight_read_s + chunk * kv_read_s / max(1, max_seq // 2) / slots)
+    avg_new = max(1.0, max_seq / 4.0)
+    prefill_amort_s = prefill_s_per_req / avg_new / slots
+    step_s = max(kv_read_s + weight_read_s + prefill_amort_s,
+                 flops_s, dispatch_s)
+    return {
+        "step_s": step_s,
+        "tokens_per_s": slots / step_s,
+        "cache_bytes": cache_bytes,
+        "cache_bytes_per_device": cache_bytes / n,
+        "breakdown": {
+            "kv_read_s": kv_read_s,
+            "weight_read_s": weight_read_s,
+            "flops_s": flops_s,
+            "dispatch_s": dispatch_s,
+            "prefill_amort_s": prefill_amort_s,
+            # channel-resolved, exactly as the terms above priced it —
+            # the decision trail must show the number that was USED
+            "kv_bytes_per_elem": kv_bytes_per_elem(
+                kv_precision,
+                m.hidden_size // max(1, m.num_heads or 1)),
+        },
+    }
+
+
 def model_spec_from_llama(config, global_batch: int) -> ModelSpec:
     """Convenience: derive a ModelSpec from a LlamaConfig."""
     import numpy as np

@@ -1,0 +1,44 @@
+"""The elastic serving tier — continuous-batching decode on the same
+runtime that trains (ROADMAP item 3).
+
+The hard parts were already built for training and are REUSED, not
+reimplemented: host-DRAM snapshot (``checkpoint.HostSnapshot``), GSPMD
+resharding (``device_put`` against new shardings), the topology+knob
+program cache with ``prewarm`` (``serving.engine`` mirrors
+``trainer.elastic``), the master-side dispatch ledger generalized into
+a request router (``serving.router`` <- PR 9's shard accounting), and
+the runtime optimizer's live retune loop (serve knobs ride the same
+``ParallelConfig`` broadcast).
+
+Modules:
+  kv_cache  paged, preallocated KV-cache pytree + its sharding rules
+            (+ int8 page storage via ``ops.quantize``)
+  engine    ServeEngine (compiled decode/prefill programs, program
+            cache, prewarm, live resize, checkpoint->serving promotion)
+            and ServeExecutor (continuous batching over fixed slots)
+  router    RequestRouter on the master: enqueue/lease/complete over
+            the existing ``comm`` surface, per-request latency
+            accounting, re-lease of requests stranded on dead workers
+  cli       ``tpurun serve`` / ``tpurun requests``
+"""
+
+from dlrover_tpu.serving.kv_cache import (  # noqa: F401
+    KVCacheSpec,
+    init_kv_cache,
+    kv_cache_rules,
+    resolve_kv_precision,
+)
+
+
+def __getattr__(name):
+    # engine/router import jax-heavy modules; keep ``import
+    # dlrover_tpu.serving`` light for CLI-only consumers
+    if name in ("ServeEngine", "ServeExecutor", "ServeRequestState"):
+        from dlrover_tpu.serving import engine
+
+        return getattr(engine, name)
+    if name == "RequestRouter":
+        from dlrover_tpu.serving.router import RequestRouter
+
+        return RequestRouter
+    raise AttributeError(name)

@@ -1,0 +1,153 @@
+"""``tpurun serve`` / ``tpurun requests`` — the serving CLIs.
+
+``tpurun serve --addr <master>`` runs one continuous-batching serve
+worker (the demo tiny-llama model unless a driver script builds its
+own ``ServeEngine``) against the master's request router, leasing
+until the queue drains. ``tpurun requests`` renders the router ledger
+— live (``--addr``) or forensically from the event timeline
+(``--events``), the same two-view contract as ``tpurun data``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("serving.cli")
+
+
+def _serve_main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpurun serve",
+        description="run one continuous-batching serve worker")
+    p.add_argument("--addr", required=True,
+                   help="master address (host:port)")
+    p.add_argument("--node_id", type=int, default=0)
+    p.add_argument("--slots", type=int, default=None,
+                   help="slot batch width (default: serve_slots knob)")
+    p.add_argument("--prefill_chunk", type=int, default=None)
+    p.add_argument("--kv_precision", default=None,
+                   choices=["f32", "bf16", "int8"])
+    p.add_argument("--max_seq", type=int, default=64,
+                   help="KV pool depth per slot (tokens)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="weight init seed of the demo model")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import MeshPlan
+    from dlrover_tpu.parallel.strategy import Strategy
+    from dlrover_tpu.serving.engine import ServeEngine, ServeExecutor
+
+    cfg = llama.llama_tiny()
+    params = llama.init(jax.random.PRNGKey(args.seed), cfg)
+    engine = ServeEngine(
+        cfg, strategy=Strategy(mesh=MeshPlan(data=-1),
+                               rule_set="llama"),
+        serve_slots=args.slots, prefill_chunk=args.prefill_chunk,
+        kv_precision=args.kv_precision, max_seq=args.max_seq,
+    )
+    engine.prepare(params)
+    client = MasterClient(args.addr, node_id=args.node_id)
+    executor = ServeExecutor(engine, router_client=client)
+    done = executor.serve()
+    print(f"served {len(done)} requests "
+          f"({executor.decode_steps} decode steps)")
+    client.close()
+    return 0
+
+
+def _forensic_report(events_path: str) -> dict:
+    from dlrover_tpu.telemetry.events import read_events
+
+    records = read_events(events_path)
+    resizes = [r for r in records if r.get("kind") == "serve_resize_done"]
+    return {
+        "runs": sum(1 for r in records if r.get("kind") == "serve_start"),
+        "completed_runs": [
+            {"decode_steps": r.get("decode_steps"),
+             "completed": r.get("completed")}
+            for r in records if r.get("kind") == "serve_end"
+        ],
+        "resizes": [
+            {"world_from": r.get("world_from"),
+             "world_to": r.get("world_to"),
+             "seconds": r.get("reshard_seconds"),
+             "recompiled": r.get("recompiled")}
+            for r in resizes
+        ],
+        "evicted": sum(1 for r in records
+                       if r.get("kind") == "serve_request_evicted"),
+        "leases_expired": sum(1 for r in records
+                              if r.get("kind") == "serve_lease_expired"),
+    }
+
+
+def _requests_main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpurun requests",
+        description="the request-router ledger (live or forensic)")
+    p.add_argument("--addr", default="",
+                   help="live view: master address")
+    p.add_argument("--events", default="",
+                   help="forensic view: event-timeline JSONL path")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    if not args.addr and not args.events:
+        print("tpurun requests: need --addr or --events",
+              file=sys.stderr)
+        return 2
+    if args.addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(args.addr)
+        report = client.get_serve_report()
+        client.close()
+    else:
+        report = _forensic_report(args.events)
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+        return 0
+    if args.addr:
+        r = report.get("requests", {})
+        print("requests: submitted=%s completed=%s queued=%s "
+              "leased=%s dropped=%s leases_expired=%s" % (
+                  r.get("submitted"), r.get("completed"),
+                  r.get("queued"), r.get("leased"), r.get("dropped"),
+                  r.get("leases_expired")))
+        lat = report.get("latency", {})
+        print("latency: ttft p50=%s p95=%s  e2e p50=%s p95=%s (s)" % (
+            lat.get("ttft_p50_s"), lat.get("ttft_p95_s"),
+            lat.get("e2e_p50_s"), lat.get("e2e_p95_s")))
+        for node, row in sorted(report.get("nodes", {}).items(),
+                                key=lambda kv: int(kv[0])):
+            print(f"  node {node}: leased={row.get('leased')} "
+                  f"done={row.get('done')} tokens={row.get('tokens')}")
+    else:
+        print(json.dumps(report, indent=2))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: tpurun serve|requests ...", file=sys.stderr)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "serve":
+        return _serve_main(rest)
+    if cmd == "requests":
+        return _requests_main(rest)
+    print(f"unknown serving command {cmd!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

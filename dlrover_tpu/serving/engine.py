@@ -1,0 +1,1054 @@
+"""ServeEngine + ServeExecutor — continuous-batching decode on the
+training runtime.
+
+``ServeEngine`` mirrors ``trainer.elastic.ElasticTrainer`` knob for
+knob: compiled serve programs (decode step + prefill chunk) live in a
+topology+knob program cache, ``prewarm`` standby-compiles a survivor
+world or a candidate knob set (executing one dummy step — jit is lazy),
+and ``live_resize`` is the PR 5 drain → host-DRAM snapshot → rebuild →
+``device_put``-reshard path applied to ``{"params", "cache"}`` instead
+of a TrainState. A previously-seen serving topology is ZERO recompiles.
+
+``ServeExecutor`` is the PR 3 async-window skeleton re-aimed at decode:
+a fixed-shape slot batch (``serve_slots``), per-step admit/evict slot
+swaps through index ops (no recompiles as the active set churns),
+prefill chunked INTO the decode stream so a long prompt cannot stall
+the batch, and a bounded in-flight window of decode dispatches whose
+token materialization lags — greedy sampling happens ON DEVICE, so
+step k+1 never waits on step k's host sync.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.serving.kv_cache import (
+    KVCacheSpec,
+    init_kv_cache,
+    migrate_slots_host,
+    serve_shardings,
+)
+from dlrover_tpu.telemetry import (
+    EventKind,
+    SpanName,
+    emit_event,
+    get_registry,
+    names as tm,
+    span,
+)
+
+logger = get_logger("serving.engine")
+
+
+@dataclass
+class ServeProgram:
+    """One compiled serving world: the jitted decode/prefill programs
+    plus everything needed to lay state out on its mesh."""
+
+    decode: Callable
+    prefill: Callable
+    mesh: Any
+    shardings: Dict[str, Any]  # {"params": ..., "cache": ...}
+    spec: KVCacheSpec
+    config: Any
+    strategy: Any
+    prefill_chunk: int
+
+    def compiled_cache_size(self) -> int:
+        total = 0
+        for fn in (self.decode, self.prefill):
+            inner = getattr(fn, "__wrapped__", fn)
+            size = getattr(inner, "_cache_size", None)
+            if callable(size):
+                total += int(size())
+        return total
+
+
+def _resolve_knob(value, name: str, default):
+    if value is not None:
+        return value
+    from dlrover_tpu.common.config import get_context
+
+    return getattr(get_context(), name, default)
+
+
+def _fit_prefill_chunk(requested: int, pool_depth: int) -> int:
+    """The largest divisor of the pool depth <= the requested chunk.
+
+    Chunk cursors advance in whole chunks (the last chunk is the only
+    partial one), so start positions are multiples of C — with C | T
+    every padded write window [start, start+C) fits the pool. Without
+    this, a window crossing the pool end would be CLAMPED by
+    ``dynamic_update_slice`` (e.g. T=48, C=32, a 40-token prompt:
+    chunk 2's start=32 clamps to 16), silently shifting the chunk onto
+    — and destroying — earlier pages while the attention mask still
+    uses the unclamped positions."""
+    want = max(1, min(int(requested), int(pool_depth)))
+    for cand in range(want, 0, -1):
+        if pool_depth % cand == 0:
+            return cand
+    return 1
+
+
+class ServeEngine:
+    """Owns (config, compiled serve programs, params, cache) across
+    world changes and knob retunes — the serving twin of
+    ``ElasticTrainer``."""
+
+    def __init__(self, config, strategy=None, serve_slots: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 kv_precision: Optional[str] = None,
+                 max_seq: int = 0, page_size: int = 16,
+                 devices=None):
+        from dlrover_tpu.parallel.strategy import Strategy
+        from dlrover_tpu.serving.kv_cache import resolve_kv_precision
+
+        self._config = config
+        self._base_strategy = strategy or Strategy(rule_set="llama")
+        self.serve_slots = max(1, int(_resolve_knob(
+            serve_slots, "serve_slots", 8)))
+        self.kv_precision = resolve_kv_precision(kv_precision)
+        self._max_seq = int(max_seq or config.max_seq_len)
+        self._page_size = int(page_size)
+        import math as _math
+
+        self._pool_depth = self._page_size * max(
+            1, _math.ceil(self._max_seq / self._page_size))
+        self.prefill_chunk = _fit_prefill_chunk(
+            int(_resolve_knob(prefill_chunk, "serve_prefill_chunk",
+                              32)), self._pool_depth)
+        self._devices = list(devices) if devices is not None else None
+        self._initial_devices: Optional[int] = None
+        self._programs: "collections.OrderedDict[str, ServeProgram]" = (
+            collections.OrderedDict()
+        )
+        self._program_cache_cap = 4
+        self.compile_count = 0
+        self.program: Optional[ServeProgram] = None
+        self.params = None
+        self.cache = None
+
+    # -- program cache -------------------------------------------------------
+
+    def _spec(self) -> KVCacheSpec:
+        return KVCacheSpec.from_model(
+            self._config, num_slots=self.serve_slots,
+            max_seq=self._max_seq, page_size=self._page_size,
+            precision=self.kv_precision,
+        )
+
+    def _resolved_strategy(self, num_devices: int):
+        return self._base_strategy.adjust_to_world(
+            num_devices, prev_num_devices=self._initial_devices)
+
+    def _program_key(self, devices: list, strategy) -> str:
+        from dlrover_tpu.parallel.mesh import mesh_axes_key, topology_key
+
+        return (
+            topology_key(devices)
+            + f"|slots={self.serve_slots}"
+            + f"|pc={self.prefill_chunk}"
+            + f"|mesh={mesh_axes_key(strategy.mesh)}"
+            + f"|kvp={self.kv_precision}"
+        )
+
+    def _build(self, devices: Optional[list]) -> ServeProgram:
+        import jax
+
+        actual = list(devices) if devices else jax.devices()
+        num = len(actual)
+        if self._initial_devices is None:
+            self._initial_devices = num
+        strategy = self._resolved_strategy(num)
+        key = self._program_key(actual, strategy)
+        reg = get_registry()
+        cached = self._programs.get(key)
+        if cached is not None:
+            self._programs.move_to_end(key)
+            reg.counter(
+                tm.PROGRAM_CACHE_HITS,
+                help="rebuilds served from the compiled-program cache "
+                     "(zero recompiles)").inc()
+            logger.info("serve program cache hit for %d devices", num)
+            return cached
+        reg.counter(tm.PROGRAM_CACHE_MISSES,
+                    help="rebuilds that had to compile").inc()
+        program = self._compile(actual, strategy)
+        self.compile_count += 1
+        self._programs[key] = program
+        while len(self._programs) > self._program_cache_cap:
+            self._programs.popitem(last=False)
+        return program
+
+    def _compile(self, devices: list, strategy) -> ServeProgram:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dlrover_tpu.models import llama
+
+        config = self._config
+        spec = self._spec()
+        mesh = strategy.mesh.build(devices)
+        params_abstract = jax.eval_shape(
+            lambda r: llama.init(r, config), jax.random.PRNGKey(0))
+        shardings = serve_shardings(
+            mesh, spec, params_abstract,
+            base_rule_set=strategy.rule_set)
+        replicated = NamedSharding(mesh, PartitionSpec())
+
+        def decode_fn(params, cache, tokens, active):
+            return llama.decode_step(params, cache, tokens, active,
+                                     config, spec)
+
+        def prefill_fn(params, cache, tokens, slot, start, n_valid):
+            return llama.prefill_chunk(params, cache, tokens, slot,
+                                       start, n_valid, config, spec)
+
+        decode = jax.jit(
+            decode_fn,
+            in_shardings=(shardings["params"], shardings["cache"],
+                          replicated, replicated),
+            out_shardings=(replicated, replicated, shardings["cache"]),
+            donate_argnums=(1,),
+        )
+        prefill = jax.jit(
+            prefill_fn,
+            in_shardings=(shardings["params"], shardings["cache"],
+                          replicated, replicated, replicated,
+                          replicated),
+            out_shardings=(shardings["cache"], replicated),
+            donate_argnums=(1,),
+        )
+        logger.info(
+            "serve program compiled: %d devices, slots=%d chunk=%d "
+            "kv=%s mesh=%s", len(devices), spec.num_slots,
+            self.prefill_chunk, spec.precision,
+            dict(zip(mesh.axis_names, mesh.devices.shape)),
+        )
+        return ServeProgram(
+            decode=decode, prefill=prefill, mesh=mesh,
+            shardings=shardings, spec=spec, config=config,
+            strategy=strategy, prefill_chunk=self.prefill_chunk,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def prepare(self, params) -> None:
+        """Compile for the current world and lay ``params`` + a fresh
+        pool out on its mesh. ``params`` may be host numpy, a live
+        training tree, or a promoted checkpoint's params."""
+        import jax
+
+        self.program = self._build(self._devices)
+        self.params = jax.device_put(
+            params, self.program.shardings["params"])
+        self.cache = jax.device_put(
+            _host_zero_cache(self.program.spec),
+            self.program.shardings["cache"])
+        jax.block_until_ready(self.params)
+
+    def fresh_cache(self):
+        import jax
+
+        return jax.device_put(
+            _host_zero_cache(self.program.spec),
+            self.program.shardings["cache"])
+
+    # -- promotion (checkpoint -> serving, no cold start) --------------------
+
+    def load_from_snapshot(self, snapshot) -> None:
+        """Promote a live trainer's ``HostSnapshot`` (or any TrainState-
+        shaped host tree) into the serving shardings: the train+serve
+        colocation path — one ``device_put``, no storage round-trip, no
+        cold start."""
+        import jax
+
+        tree = getattr(snapshot, "tree", snapshot)
+        params = getattr(tree, "params", tree)
+        if self.program is None:
+            self.prepare(params)
+            return
+        self.params = jax.device_put(
+            params, self.program.shardings["params"])
+        jax.block_until_ready(self.params)
+
+    def load_from_checkpoint(self, ckpt_dir: str, init_fn, optimizer,
+                             grad_precision: str = "bf16"):
+        """Promote a TRAINING checkpoint into the serving tier: the
+        TrainState restores against the SERVING param shardings
+        directly (Orbax reshard-on-load — the Universal-Checkpointing
+        move), so a differently-sharded serving world starts warm.
+        Returns the restored step (None when no checkpoint exists)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dlrover_tpu.checkpoint import (
+            ElasticCheckpointManager,
+            abstract_like,
+        )
+        from dlrover_tpu.parallel.accelerate import TrainState
+
+        if self.program is None:
+            self.program = self._build(self._devices)
+
+        def make_state(r):
+            params = init_fn(r)
+            residual = (jax.tree.map(jnp.zeros_like, params)
+                        if grad_precision != "bf16" else None)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=optimizer.init(params),
+                wire_residual=residual,
+            )
+
+        abstract = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+        repl = NamedSharding(self.program.mesh, PartitionSpec())
+        sharding_tree = TrainState(
+            step=repl,
+            params=self.program.shardings["params"],
+            opt_state=jax.tree.map(lambda _: repl, abstract.opt_state),
+            wire_residual=(
+                jax.tree.map(lambda _: repl, abstract.wire_residual)
+                if abstract.wire_residual is not None else None),
+        )
+        target = abstract_like(abstract, sharding_tree)
+        mgr = ElasticCheckpointManager(ckpt_dir)
+        try:
+            out = mgr.restore(target)
+        finally:
+            mgr.close()
+        if out is None:
+            return None
+        self.params = out["state"].params
+        if self.cache is None:
+            self.cache = self.fresh_cache()
+        logger.info("promoted training checkpoint step %d into the "
+                    "serving tier", out["step"])
+        return out["step"]
+
+    # -- elasticity ----------------------------------------------------------
+
+    def prewarm(self, devices=None, serve_slots: Optional[int] = None,
+                prefill_chunk: Optional[int] = None,
+                execute: bool = True) -> bool:
+        """Standby-compile the program for a topology or knob set we
+        may swap to, executing one dummy decode step AND one dummy
+        prefill chunk (jit is lazy) — so the live resize / retune that
+        follows pays ZERO recompiles. Does not switch the active
+        program. Returns True when a compile happened."""
+        import jax
+        import jax.numpy as jnp
+
+        prev_slots, prev_chunk = self.serve_slots, self.prefill_chunk
+        if serve_slots is not None:
+            self.serve_slots = max(1, int(serve_slots))
+        if prefill_chunk is not None:
+            self.prefill_chunk = _fit_prefill_chunk(
+                int(prefill_chunk), self._pool_depth)
+        try:
+            before = self.compile_count
+            program = self._build(
+                list(devices) if devices is not None else self._devices)
+            compiled = self.compile_count > before
+            if execute and compiled and self.params is not None:
+                params = jax.device_put(
+                    self.params, program.shardings["params"])
+                cache = jax.device_put(
+                    _host_zero_cache(program.spec),
+                    program.shardings["cache"])
+                s = program.spec.num_slots
+                tokens = jnp.zeros((s,), jnp.int32)
+                active = jnp.zeros((s,), bool)
+                _nt, _lg, cache = program.decode(
+                    params, cache, tokens, active)
+                chunk = jnp.zeros((program.prefill_chunk,), jnp.int32)
+                cache, _ll = program.prefill(
+                    params, cache, chunk, jnp.int32(0), jnp.int32(0),
+                    jnp.int32(1))
+                jax.block_until_ready(cache)
+                logger.info("prewarmed standby serve program (%d "
+                            "devices, slots=%d)", len(
+                                program.mesh.devices.flatten()), s)
+        finally:
+            self.serve_slots = prev_slots
+            self.prefill_chunk = prev_chunk
+        return compiled
+
+    def snapshot(self):
+        """Host-DRAM copy of ``{"params", "cache"}`` — the resize
+        source. In-flight slots' KV pages ride it to the survivor
+        world, which is what lets leased requests continue instead of
+        restarting from their prompts."""
+        from dlrover_tpu.checkpoint import HostSnapshot
+
+        return HostSnapshot.take(
+            {"params": self.params, "cache": self.cache},
+            kind="serving")
+
+    def live_resize(self, devices=None, snapshot=None,
+                    reason: str = "") -> int:
+        """Drain (caller) → snapshot → rebuild (program cache; zero
+        recompiles when prewarmed) → reshard params AND live KV pages
+        onto the survivor world. Returns the number of programs
+        compiled (0 = the prewarmed fast path)."""
+        import jax
+
+        old_n = (self.program.mesh.devices.size
+                 if self.program is not None else 0)
+        t0 = time.monotonic()
+        emit_event(EventKind.SERVE_RESIZE_BEGIN, world_from=old_n,
+                   reason=reason)
+        with span(SpanName.LIVE_RESHARD, world_from=old_n):
+            if snapshot is None:
+                snapshot = self.snapshot()
+            self._devices = list(devices) if devices is not None else None
+            compiles_before = self.compile_count
+            self.program = self._build(self._devices)
+            state = snapshot.restore({
+                "params": self.program.shardings["params"],
+                "cache": self.program.shardings["cache"],
+            })
+            self.params, self.cache = state["params"], state["cache"]
+            jax.block_until_ready(self.cache)
+        n = self.program.mesh.devices.size
+        recompiled = self.compile_count - compiles_before
+        seconds = time.monotonic() - t0
+        reg = get_registry()
+        reg.counter(
+            tm.SERVE_RESIZES,
+            help="serving worlds resized live (no dropped requests)"
+        ).inc()
+        reg.histogram(
+            tm.SERVE_RESIZE_TIME,
+            help="drain -> snapshot -> reshard wall seconds (serving)",
+        ).observe(seconds)
+        emit_event(EventKind.SERVE_RESIZE_DONE, world_from=old_n,
+                   world_to=int(n), reshard_seconds=round(seconds, 3),
+                   recompiled=recompiled)
+        logger.info("serve resize %d -> %d devices in %.2fs (%s)",
+                    old_n, n, seconds,
+                    "cache hit" if not recompiled else "recompiled")
+        return recompiled
+
+    def retune(self, serve_slots: Optional[int] = None,
+               prefill_chunk: Optional[int] = None,
+               slot_map: Optional[Dict[int, int]] = None) -> int:
+        """Apply optimizer-chosen serve knobs on the current world
+        through the program cache (drain first — the caller owns the
+        window). A slot-count change repacks live slots host-side via
+        ``slot_map`` (old -> new); prefill_chunk swaps are pure program
+        swaps. Failure restores the previous knobs and re-raises."""
+        import jax
+
+        prev_slots, prev_chunk = self.serve_slots, self.prefill_chunk
+        prev_program = self.program
+        old_spec = self.program.spec if self.program else None
+        try:
+            if serve_slots is not None:
+                self.serve_slots = max(1, int(serve_slots))
+            if prefill_chunk is not None:
+                self.prefill_chunk = _fit_prefill_chunk(
+                    int(prefill_chunk), self._pool_depth)
+            compiles_before = self.compile_count
+            new_program = self._build(self._devices)
+            if old_spec is not None and new_program.spec == old_spec:
+                # a pure PROGRAM swap (chunk-only retune): the pool
+                # spec, shardings and devices are unchanged, so the
+                # live params and KV pages are already laid out for
+                # the new program — no host round-trip of the whole
+                # state inside the serving drain
+                self.program = new_program
+                return self.compile_count - compiles_before
+            host = jax.device_get(
+                {"params": self.params, "cache": self.cache})
+            self.program = new_program
+            cache_host = host["cache"]
+            if old_spec is not None and \
+                    old_spec.num_slots != self.program.spec.num_slots:
+                cache_host = migrate_slots_host(
+                    cache_host, old_spec, self.program.spec,
+                    slot_map or {})
+            self.params = jax.device_put(
+                host["params"], self.program.shardings["params"])
+            self.cache = jax.device_put(
+                cache_host, self.program.shardings["cache"])
+            jax.block_until_ready(self.cache)
+            return self.compile_count - compiles_before
+        except Exception:
+            self.serve_slots = prev_slots
+            self.prefill_chunk = prev_chunk
+            # the ACTIVE program too, not just the knobs: _build may
+            # have swapped it before the device_put failed (OOM on a
+            # wider pool) — leaving the new-spec program over the
+            # old-shape cache would shape-mismatch every later call
+            # and wipe the executor's slot bookkeeping at the next
+            # _ensure_prepared
+            self.program = prev_program
+            raise
+
+
+def _host_zero_cache(spec: KVCacheSpec):
+    """Zero-filled host cache (numpy — no device allocation until the
+    device_put lays it out shard by shard)."""
+    import jax
+
+    return jax.tree.map(
+        lambda a: np.zeros(a.shape, a.dtype),
+        jax.eval_shape(lambda: init_kv_cache(spec)),
+    )
+
+
+# -- the continuous-batching executor ----------------------------------------
+
+
+@dataclass
+class ServeRequestState:
+    """Host-side bookkeeping for one leased request in a slot."""
+
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: int = -1
+    cursor: int = 0            # prompt tokens prefilled so far
+    generated: List[int] = field(default_factory=list)
+    t_admit: float = 0.0
+    t_first_token: Optional[float] = None
+
+
+@dataclass
+class _InflightDecode:
+    tokens: Any                       # device [S] next-token array
+    owners: Dict[int, str]            # slot -> request_id at dispatch
+
+
+class ServeExecutor:
+    """Continuous batching over a fixed slot batch.
+
+    One loop iteration: (boundary work: plans/resizes/admission) → at
+    most one prefill chunk per admitting slot → ONE decode step for the
+    whole batch → lagged materialization of the oldest in-flight decode
+    (the PR 3 window, ``serve_window``). Greedy tokens feed back on
+    device; the host only ever reads tokens that are already
+    ``serve_window`` steps old, so Python/RPC overhead never drains the
+    device queue.
+
+    ``admission="static"`` is the comparison mode ``bench --mode
+    serve`` pairs against: a full batch admits together and the next
+    batch waits for the LAST request of the current one — the classic
+    static-batching tail every mixed-length workload pays.
+    """
+
+    def __init__(self, engine: ServeEngine, router_client=None,
+                 admission: str = "continuous",
+                 serve_window: Optional[int] = None,
+                 eos_id: int = -1, max_new_default: int = 16,
+                 plan_poll_secs: Optional[float] = None):
+        from dlrover_tpu.common.config import get_context
+
+        ctx = get_context()
+        self._engine = engine
+        self._client = router_client
+        self._admission = admission
+        self._window_cap = max(0, int(_resolve_knob(
+            serve_window, "serve_window", 2)))
+        self._eos_default = int(eos_id)
+        self._max_new_default = int(max_new_default)
+        self._plan_poll = float(
+            plan_poll_secs if plan_poll_secs is not None
+            else getattr(ctx, "plan_poll_secs", 30.0))
+        self._last_plan_poll = 0.0
+        self._seen_plan = ""
+        self._last_touch = 0.0
+        self._local_queue: "collections.deque" = collections.deque()
+        self._window: "collections.deque[_InflightDecode]" = (
+            collections.deque())
+        self._slots: List[Optional[ServeRequestState]] = []
+        self._active_host: List[bool] = []
+        self._tokens = None
+        self._active = None
+        self._resize_devices = None
+        self._resize_requested = False
+        self._retune_request: Optional[Dict[str, Any]] = None
+        self.completed: List[Dict[str, Any]] = []
+        self.decode_steps = 0
+        self._local_id_seq = 0
+        reg = get_registry()
+        self._c_tokens = reg.counter(
+            tm.SERVE_TOKENS, help="tokens generated by this worker")
+        self._c_decode = reg.counter(
+            tm.SERVE_DECODE_STEPS, help="batched decode steps dispatched")
+        self._c_prefill = reg.counter(
+            tm.SERVE_PREFILL_CHUNKS, help="prefill chunks dispatched")
+        self._c_admitted = reg.counter(
+            tm.SERVE_ADMISSIONS, help="requests admitted into slots")
+        self._g_occupancy = reg.gauge(
+            tm.SERVE_SLOT_OCCUPANCY,
+            help="slots holding a live request, after admission")
+        self._h_step = reg.histogram(
+            tm.SERVE_STEP_TIME, help="per-decode-step wall seconds")
+
+    # -- local submission (router-less mode / tests) -------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 0,
+               request_id: str = "", eos_id: Optional[int] = None):
+        """Enqueue a request on the worker-local queue (no router)."""
+        # a monotonic sequence, never derived from queue/completed
+        # lengths: those regress when a request is admitted-but-
+        # unfinished, and a colliding id breaks the window's owner
+        # guard (two live slots claiming one identity)
+        self._local_id_seq += 1
+        rid = request_id or f"local-{self._local_id_seq}"
+        self._local_queue.append({
+            "request_id": rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens
+                                  or self._max_new_default),
+            "eos_id": (self._eos_default if eos_id is None
+                       else int(eos_id)),
+        })
+        return rid
+
+    # -- elasticity hooks ----------------------------------------------------
+
+    def request_resize(self, devices=None):
+        self._resize_devices = (list(devices)
+                                if devices is not None else None)
+        self._resize_requested = True
+
+    def request_retune(self, serve_slots: Optional[int] = None,
+                       prefill_chunk: Optional[int] = None,
+                       plan_id: str = "", prewarm: bool = False):
+        self._retune_request = {
+            "serve_slots": serve_slots,
+            "prefill_chunk": prefill_chunk,
+            "plan_id": plan_id,
+            "prewarm": bool(prewarm),
+        }
+
+    # -- loop ----------------------------------------------------------------
+
+    def _ensure_prepared(self):
+        import jax.numpy as jnp
+
+        if self._engine.program is None:
+            raise RuntimeError("engine.prepare(params) first")
+        s = self._engine.program.spec.num_slots
+        if len(self._slots) != s:
+            if any(r is not None for r in self._slots):
+                # the slot width changed UNDER live requests — a
+                # direct engine.retune() between serve() calls.
+                # Silently rebuilding would drop those requests (and
+                # dangle their router leases); the supported path is
+                # request_retune, which repacks them.
+                raise RuntimeError(
+                    "engine slot width changed with live requests; "
+                    "use ServeExecutor.request_retune")
+            self._slots = [None] * s
+            self._active_host = [False] * s
+        if self._tokens is None or int(self._tokens.shape[0]) != s:
+            self._tokens = jnp.zeros((s,), jnp.int32)
+            self._active = jnp.asarray(self._active_host)
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slots) if r is None]
+
+    def _lease(self, n: int) -> List[Dict[str, Any]]:
+        out = []
+        while n > 0 and self._local_queue:
+            out.append(self._local_queue.popleft())
+            n -= 1
+        if n > 0 and self._client is not None:
+            try:
+                out.extend(self._client.serve_lease(max_requests=n))
+            except Exception:  # noqa: BLE001 — a dead master must not
+                # kill serving; the worker drains its admitted slots
+                logger.debug("serve lease failed", exc_info=True)
+        return out
+
+    def _admit(self):
+        free = self._free_slots()
+        if not free:
+            return
+        if self._admission == "static" and len(free) != len(self._slots):
+            # static batching: the next batch waits for the WHOLE
+            # current batch — the tail continuous batching removes
+            return
+        leases = self._lease(len(free))
+        max_seq = self._engine.program.spec.max_seq
+        for req in leases:
+            slot = free.pop(0)
+            state = ServeRequestState(
+                request_id=str(req["request_id"]),
+                prompt=[int(t) for t in req["prompt"]],
+                max_new_tokens=int(req.get("max_new_tokens")
+                                   or self._max_new_default),
+                eos_id=int(req.get("eos_id", self._eos_default)),
+                t_admit=time.monotonic(),
+            )
+            if len(state.prompt) + state.max_new_tokens > max_seq:
+                # the pool cannot hold this request: evict loudly (a
+                # failure-class edge — carries its error code) and
+                # complete it as errored so the router never counts it
+                # dropped-on-the-floor
+                emit_event(
+                    EventKind.SERVE_REQUEST_EVICTED,
+                    error_code="SERVE_REQUEST_EVICTED",
+                    request_id=state.request_id,
+                    prompt_tokens=len(state.prompt),
+                    max_seq=max_seq,
+                )
+                self._complete(state, error_code="SERVE_REQUEST_EVICTED")
+                continue
+            self._slots[slot] = state
+            self._c_admitted.inc()
+            if not free:
+                break
+        self._g_occupancy.set(
+            sum(1 for r in self._slots if r is not None))
+
+    def _prefill_tick(self):
+        """Dispatch at most ONE chunk per admitting slot, so prefill
+        interleaves with the decode stream instead of stalling it."""
+        import jax
+        import jax.numpy as jnp
+
+        program = self._engine.program
+        c = program.prefill_chunk
+        for slot, state in enumerate(self._slots):
+            if state is None or state.cursor >= len(state.prompt) \
+                    or self._active_host[slot]:
+                continue
+            chunk = state.prompt[state.cursor:state.cursor + c]
+            n_valid = len(chunk)
+            padded = np.zeros((c,), np.int32)
+            padded[:n_valid] = chunk
+            self._engine.cache, last_logits = program.prefill(
+                self._engine.params, self._engine.cache,
+                jnp.asarray(padded), jnp.int32(slot),
+                jnp.int32(state.cursor), jnp.int32(n_valid))
+            self._c_prefill.inc()
+            state.cursor += n_valid
+            if state.cursor >= len(state.prompt):
+                # final chunk: its last logits seed the first token —
+                # the one host sync admission pays (TTFT is measured
+                # here, which is exactly what it means)
+                first = int(np.argmax(jax.device_get(last_logits)))
+                state.t_first_token = time.monotonic()
+                state.generated.append(first)
+                self._tokens = self._tokens.at[slot].set(first)
+                if self._finished(state):
+                    self._retire(slot)
+                    continue
+                self._active_host[slot] = True
+                self._active = jnp.asarray(self._active_host)
+
+    def _finished(self, state: ServeRequestState) -> bool:
+        if len(state.generated) >= state.max_new_tokens:
+            return True
+        return (state.eos_id >= 0 and state.generated
+                and state.generated[-1] == state.eos_id)
+
+    def _complete(self, state: ServeRequestState, error_code: str = ""):
+        now = time.monotonic()
+        record = {
+            "request_id": state.request_id,
+            "tokens": list(state.generated),
+            "ttft_s": (round(state.t_first_token - state.t_admit, 6)
+                       if state.t_first_token else None),
+            "e2e_s": round(now - state.t_admit, 6),
+            "error_code": error_code,
+        }
+        self.completed.append(record)
+        self._c_tokens.inc(len(state.generated))
+        if self._client is not None:
+            try:
+                self._client.serve_complete(**record)
+            except Exception:  # noqa: BLE001 — the router re-leases on
+                # lease timeout; a lost completion is re-served, never
+                # silently dropped
+                logger.warning("serve completion report failed",
+                               exc_info=True)
+
+    def _retire(self, slot: int):
+        import jax.numpy as jnp
+
+        state = self._slots[slot]
+        self._slots[slot] = None
+        self._active_host[slot] = False
+        self._active = jnp.asarray(self._active_host)
+        self._complete(state)
+
+    def _materialize_oldest(self):
+        import jax
+
+        entry = self._window.popleft()
+        host = np.asarray(jax.device_get(entry.tokens))
+        for slot, rid in entry.owners.items():
+            state = self._slots[slot]
+            if state is None or state.request_id != rid:
+                continue  # completed/reassigned meanwhile: stale token
+            state.generated.append(int(host[slot]))
+            if state.t_first_token is None:
+                state.t_first_token = time.monotonic()
+            if self._finished(state):
+                self._retire(slot)
+
+    def _drain_window(self):
+        while self._window:
+            self._materialize_oldest()
+
+    def _apply_resize(self):
+        self._resize_requested = False
+        devices = self._resize_devices
+        self._resize_devices = None
+        import jax
+
+        tokens_host = np.asarray(jax.device_get(self._tokens))
+        active_host = list(self._active_host)
+        self._engine.live_resize(devices, reason="executor")
+        import jax.numpy as jnp
+
+        self._tokens = jnp.asarray(tokens_host)
+        self._active_host = active_host
+        self._active = jnp.asarray(active_host)
+
+    def _apply_retune(self):
+        import jax
+        import jax.numpy as jnp
+
+        req = self._retune_request
+        self._retune_request = None
+        new_slots = req.get("serve_slots")
+        new_chunk = req.get("prefill_chunk")
+        plan_id = req.get("plan_id", "")
+        if new_chunk is not None:
+            fitted = _fit_prefill_chunk(int(new_chunk),
+                                        self._engine._pool_depth)
+            if fitted != int(new_chunk):
+                # the plan's chunk cannot be honored exactly (it does
+                # not divide the pool depth): applying the fitted
+                # variant while acking the plan would be the PR 11
+                # phantom-apply loop — the master re-chooses the
+                # unachievable tuple every cooldown window, each cycle
+                # a futile drain. Negative-ack so it blacklists.
+                logger.warning(
+                    "serve plan %s wants prefill_chunk=%s but the "
+                    "pool depth %d fits %d; negative-acking", plan_id,
+                    new_chunk, self._engine._pool_depth, fitted)
+                self._ack_plan(plan_id, apply_failed=True)
+                return
+            if int(new_chunk) != self._engine.prefill_chunk:
+                # a chunk change invalidates IN-FLIGHT prefill
+                # cursors: their start positions are multiples of the
+                # OLD chunk, and a grown chunk's padded window could
+                # cross the pool end (the dynamic_update_slice clamp
+                # hazard _fit_prefill_chunk documents). Restart those
+                # prompts from 0 — prefill rewrites its pages, so a
+                # restart is always safe and bounded by one prompt.
+                for slot, state in enumerate(self._slots):
+                    if (state is not None
+                            and not self._active_host[slot]
+                            and state.cursor > 0):
+                        state.cursor = 0
+        live = [i for i, r in enumerate(self._slots) if r is not None]
+        cur_slots = self._engine.program.spec.num_slots
+        # host-side slot compaction happens ONLY when the slot width
+        # actually changes (the engine migrates the KV pages under the
+        # same condition — a chunk-only retune must leave both the
+        # pages AND this bookkeeping exactly where they are, or they
+        # diverge and every in-flight continuation is garbage)
+        slots_changing = (new_slots is not None
+                          and int(new_slots) != cur_slots)
+        if slots_changing and len(live) > int(new_slots):
+            logger.warning(
+                "serve retune to %s slots declined: %d live requests",
+                new_slots, len(live))
+            self._ack_plan(plan_id, apply_failed=True)
+            return
+        slot_map = ({old: new for new, old in enumerate(live)}
+                    if slots_changing else {i: i for i in live})
+        tokens_host = np.asarray(jax.device_get(self._tokens))
+        if req.get("prewarm"):
+            # standby-compile the candidate program BEFORE the swap
+            # (the training plan-apply discipline): the retune below
+            # then hits the cache and the drained pause pays zero
+            # compiles
+            try:
+                self._engine.prewarm(serve_slots=new_slots,
+                                     prefill_chunk=new_chunk)
+            except Exception:  # noqa: BLE001 — prewarm is an
+                # optimization; the retune still decides the outcome
+                logger.warning("serve prewarm failed", exc_info=True)
+        try:
+            self._engine.retune(
+                serve_slots=new_slots,
+                prefill_chunk=req.get("prefill_chunk"),
+                slot_map=slot_map)
+        except Exception:  # noqa: BLE001 — a bad plan must not kill
+            # serving; the engine restored the previous knobs
+            logger.exception("serve retune failed; continuing with the "
+                             "previous config")
+            self._ack_plan(plan_id, apply_failed=True)
+            return
+        if slots_changing:
+            s = self._engine.program.spec.num_slots
+            slots: List[Optional[ServeRequestState]] = [None] * s
+            active = [False] * s
+            tokens = np.zeros((s,), np.int32)
+            for old, new in slot_map.items():
+                slots[new] = self._slots[old]
+                active[new] = self._active_host[old]
+                tokens[new] = tokens_host[old]
+            self._slots, self._active_host = slots, active
+            self._tokens = jnp.asarray(tokens)
+            self._active = jnp.asarray(active)
+        self._ack_plan(plan_id)
+
+    def _ack_plan(self, plan_id: str, apply_failed: bool = False):
+        if not plan_id or self._client is None or not hasattr(
+                self._client, "report_serve_config"):
+            return
+        try:
+            self._report_config(plan_id=plan_id,
+                                apply_failed=apply_failed)
+        except Exception:  # noqa: BLE001
+            logger.debug("serve plan ack failed", exc_info=True)
+
+    def _report_config(self, plan_id: str = "",
+                       apply_failed: bool = False):
+        if self._client is None or not hasattr(
+                self._client, "report_serve_config"):
+            return
+        program = self._engine.program
+        try:
+            self._client.report_serve_config(
+                world=int(program.mesh.devices.size),
+                serve_slots=int(program.spec.num_slots),
+                prefill_chunk=int(program.prefill_chunk),
+                kv_precision=str(program.spec.precision),
+                max_seq=int(program.spec.max_seq),
+                num_layers=int(program.spec.num_layers),
+                kv_heads=int(program.spec.num_kv_heads),
+                head_dim=int(program.spec.head_dim),
+                plan_id=plan_id, apply_failed=bool(apply_failed),
+            )
+        except Exception:  # noqa: BLE001 — a dead master must not
+            # block serving
+            logger.debug("serve config report failed", exc_info=True)
+
+    def _poll_plan(self):
+        if self._client is None or self._plan_poll <= 0 or not hasattr(
+                self._client, "get_parallel_config"):
+            return
+        now = time.monotonic()
+        if now - self._last_plan_poll < self._plan_poll:
+            return
+        self._last_plan_poll = now
+        try:
+            cfg = self._client.get_parallel_config()
+        except Exception:  # noqa: BLE001 — master briefly away: retry
+            # at the next poll cadence
+            logger.debug("serve plan poll failed", exc_info=True)
+            return
+        plan_id = getattr(cfg, "plan_id", "") or ""
+        slots = int(getattr(cfg, "serve_slots", 0) or 0)
+        chunk = int(getattr(cfg, "serve_prefill_chunk", 0) or 0)
+        if not plan_id or plan_id == self._seen_plan \
+                or not (slots or chunk):
+            return
+        self._seen_plan = plan_id
+        self.request_retune(serve_slots=slots or None,
+                            prefill_chunk=chunk or None,
+                            plan_id=plan_id,
+                            prewarm=bool(getattr(cfg, "prewarm", True)))
+
+    def _touch(self):
+        if self._client is None or not hasattr(self._client,
+                                               "serve_touch"):
+            return
+        now = time.monotonic()
+        if now - self._last_touch < 5.0:
+            return
+        self._last_touch = now
+        try:
+            self._client.serve_touch()
+        except Exception:  # noqa: BLE001 — liveness is best-effort;
+            # the lease-expiry scan is the backstop
+            logger.debug("serve touch failed", exc_info=True)
+
+    def serve(self, max_steps: int = 0, until_idle: bool = True):
+        """Run the loop: admit → prefill tick → decode → lagged
+        materialization, until the queue AND slots drain (or
+        ``max_steps`` decode steps elapsed). Returns the completion
+        records accumulated so far."""
+        self._ensure_prepared()
+        self._report_config()
+        emit_event(EventKind.SERVE_START,
+                   slots=self._engine.program.spec.num_slots,
+                   prefill_chunk=self._engine.program.prefill_chunk,
+                   kv_precision=self._engine.program.spec.precision)
+        steps = 0
+        idle_polls = 0
+        while True:
+            if self._resize_requested or self._retune_request is not None:
+                self._drain_window()
+                if self._resize_requested:
+                    self._apply_resize()
+                    self._report_config()
+                if self._retune_request is not None:
+                    self._apply_retune()
+            self._poll_plan()
+            self._admit()
+            self._prefill_tick()
+            self._touch()
+            if not any(self._active_host):
+                # nothing decoding: drain stragglers, then either a
+                # fresh admission pass finds queued work or we are idle
+                self._drain_window()
+                if any(r is not None for r in self._slots):
+                    continue  # admitted slots still prefilling
+                if self._local_queue:
+                    continue
+                leased = self._lease(1)
+                if leased:
+                    self._local_queue.extend(leased)
+                    continue
+                idle_polls += 1
+                if until_idle or (max_steps and steps >= max_steps) \
+                        or idle_polls > 2:
+                    break
+                time.sleep(0.01)
+                continue
+            idle_polls = 0
+            t0 = time.monotonic()
+            owners = {
+                i: r.request_id for i, r in enumerate(self._slots)
+                if r is not None and self._active_host[i]
+            }
+            next_tokens, _logits, self._engine.cache = (
+                self._engine.program.decode(
+                    self._engine.params, self._engine.cache,
+                    self._tokens, self._active))
+            self._tokens = next_tokens
+            self._c_decode.inc()
+            self.decode_steps += 1
+            steps += 1
+            self._window.append(
+                _InflightDecode(tokens=next_tokens, owners=owners))
+            while len(self._window) > self._window_cap:
+                self._materialize_oldest()
+            self._h_step.observe(time.monotonic() - t0)
+            if max_steps and steps >= max_steps:
+                self._drain_window()
+                break
+        self._drain_window()
+        emit_event(EventKind.SERVE_END, decode_steps=self.decode_steps,
+                   completed=len(self.completed))
+        return list(self.completed)

@@ -1,0 +1,291 @@
+"""Paged, preallocated KV cache for the serving tier.
+
+Geometry: one pool of ``num_slots * pages_per_slot`` pages per layer,
+``page_size`` tokens each, laid out SLOT-MAJOR — slot ``s`` owns the
+contiguous pages ``[s * pages_per_slot, (s+1) * pages_per_slot)``, so a
+leaf is shaped ``[L, S, T, KV, HD]`` with ``T = pages_per_slot *
+page_size``. Slot-major contiguity is what makes the decode read
+GATHER-FREE: attention for slot ``s`` is a plain slice of its own rows
+(no page-table indirection on the hot path), while admission/eviction
+still swap page *ranges* with ``lax.dynamic_update_slice``-style index
+ops — fixed shapes, zero recompiles as the active set churns.
+
+Sharding: KV heads shard on the "tensor" axis (the same axis the
+attention projections are Megatron-split on, so the per-head pages live
+where the heads compute) and the slot dimension shards on the
+``(data, fsdp)`` axes (each data shard serves its own slots) — or
+replicates when the slot count does not divide them, the same graceful
+degradation every rule in ``parallel.sharding_rules`` has. The rules
+regex-COMPOSE with the existing training rule sets (the
+``wire_residual`` precedent from PR 12): one ``ShardingRules`` object
+shards ``{"params": ..., "cache": ...}`` with the params falling
+through to the unchanged training rules, which is what makes
+checkpoint->serving promotion a pure ``device_put``.
+
+Storage precision (``serve_kv_precision`` knob): "f32"/"bf16" pages
+store the compute dtype; "int8" stores int8 values + f32 per-block
+scales (``ops.quantize.quantize_block_scaled_int8``), ~1/4 of an f32
+page — decode is KV-READ memory-bound, so smaller pages are capacity
+AND step-time. The G109 "kv" drift family ratchets the numerics.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.ops.quantize import (
+    KV_PRECISIONS,
+    dequantize_block_scaled_int8,
+    quantize_block_scaled_int8,
+    resolve_quant_block,
+)
+from dlrover_tpu.parallel.sharding_rules import ShardingRules
+
+logger = get_logger("serving.kv_cache")
+
+_INT8_KV_SUPPORTED: Optional[bool] = None
+
+
+def int8_kv_supported() -> bool:
+    """Capability probe for int8 KV storage (the ``fp8_wire_supported``
+    pattern): a tiny round-trip must execute on the default backend.
+    Probed once per process; a failing backend degrades the knob to
+    "f32" — logged, never raised."""
+    global _INT8_KV_SUPPORTED
+    if _INT8_KV_SUPPORTED is not None:
+        return _INT8_KV_SUPPORTED
+    try:
+        import numpy as np
+
+        with jax.ensure_compile_time_eval():
+            x = jnp.asarray(np.asarray([[1.0, -2.0, 0.5, 0.25]],
+                                       np.float32))
+            v, s = quantize_block_scaled_int8(x, block=4)
+            back = dequantize_block_scaled_int8(v, s)
+            jax.block_until_ready(back)
+            _INT8_KV_SUPPORTED = bool(
+                np.allclose(np.asarray(back), np.asarray(x), atol=0.02))
+    except Exception:  # noqa: BLE001 — a probe failure means "no"
+        logger.warning("int8 KV probe failed", exc_info=True)
+        _INT8_KV_SUPPORTED = False
+    return _INT8_KV_SUPPORTED
+
+
+def resolve_kv_precision(requested: Optional[str] = None) -> str:
+    """The effective KV-page storage precision: an explicit request
+    wins, else the Context knob (``serve_kv_precision``). "int8"
+    degrades to "f32" when the backend fails the probe."""
+    from dlrover_tpu.common.config import get_context
+
+    p = (requested or "").strip()
+    if not p:
+        p = str(getattr(get_context(), "serve_kv_precision", "f32")
+                or "f32").strip() or "f32"
+    if p not in KV_PRECISIONS:
+        raise ValueError(
+            f"unknown KV-cache precision {p!r}; choose one of "
+            f"{KV_PRECISIONS}"
+        )
+    if p == "int8" and not int8_kv_supported():
+        logger.warning(
+            "serve_kv_precision=int8 requested but the backend fails "
+            "the int8 probe; KV pages stay f32")
+        return "f32"
+    return p
+
+
+@dataclass(frozen=True)
+class KVCacheSpec:
+    """Static geometry of one serving world's KV pool."""
+
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    num_slots: int
+    page_size: int = 16
+    pages_per_slot: int = 8
+    # "f32" | "bf16" | "int8" (see resolve_kv_precision)
+    precision: str = "f32"
+
+    @property
+    def max_seq(self) -> int:
+        return self.page_size * self.pages_per_slot
+
+    @property
+    def quant_block(self) -> int:
+        return resolve_quant_block(self.head_dim)
+
+    @property
+    def scale_blocks(self) -> int:
+        return self.head_dim // self.quant_block
+
+    def bytes_per_slot(self) -> int:
+        """Residency of ONE slot's K+V pages, priced by the planner's
+        ``kv_bytes_per_elem`` — the ONE formula the decode term, the
+        HBM feasibility gate and this spec share."""
+        from dlrover_tpu.parallel.planner import kv_bytes_per_elem
+
+        elems = (self.num_layers * self.max_seq
+                 * self.num_kv_heads * self.head_dim)
+        return int(2 * elems  # K and V
+                   * kv_bytes_per_elem(self.precision, self.head_dim))
+
+    def total_bytes(self) -> int:
+        return self.bytes_per_slot() * self.num_slots
+
+    @classmethod
+    def from_model(cls, config, num_slots: int, max_seq: int = 0,
+                   page_size: int = 16,
+                   precision: Optional[str] = None) -> "KVCacheSpec":
+        """Derive the pool geometry from a model config (LlamaConfig-
+        shaped). ``max_seq`` rounds UP to a whole number of pages."""
+        want = int(max_seq or config.max_seq_len)
+        pages = max(1, math.ceil(want / page_size))
+        return cls(
+            num_layers=int(config.num_layers),
+            num_kv_heads=int(config.num_kv_heads),
+            head_dim=int(config.head_dim),
+            num_slots=int(num_slots),
+            page_size=int(page_size),
+            pages_per_slot=pages,
+            precision=resolve_kv_precision(precision),
+        )
+
+    def with_slots(self, num_slots: int) -> "KVCacheSpec":
+        return replace(self, num_slots=int(num_slots))
+
+
+def store_dtype(spec: KVCacheSpec):
+    if spec.precision == "int8":
+        return jnp.int8
+    if spec.precision == "bf16":
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def init_kv_cache(spec: KVCacheSpec) -> Dict[str, Any]:
+    """The preallocated pool pytree. Leaves:
+
+      k, v           [L, S, T, KV, HD]   page payload (store dtype)
+      k_scale, v_scale [L, S, T, KV, NB] f32 per-block scales (int8 only)
+      length         [S] int32           tokens written per slot
+
+    Zero-filled: position ``t`` is never READ before it is written
+    (decode masks ``t <= pos`` and writes position ``pos`` first), so
+    stale pages need no invalidation pass on slot reuse.
+    """
+    l, s = spec.num_layers, spec.num_slots
+    t, kv, hd = spec.max_seq, spec.num_kv_heads, spec.head_dim
+    cache: Dict[str, Any] = {
+        "k": jnp.zeros((l, s, t, kv, hd), store_dtype(spec)),
+        "v": jnp.zeros((l, s, t, kv, hd), store_dtype(spec)),
+        "length": jnp.zeros((s,), jnp.int32),
+    }
+    if spec.precision == "int8":
+        nb = spec.scale_blocks
+        cache["k_scale"] = jnp.ones((l, s, t, kv, nb), jnp.float32)
+        cache["v_scale"] = jnp.ones((l, s, t, kv, nb), jnp.float32)
+    return cache
+
+
+# -- encode/decode at the page boundary --------------------------------------
+
+
+def encode_kv(x: jax.Array, spec: KVCacheSpec):
+    """Token K/V (``[..., KV, HD]`` compute dtype) -> (payload, scales-
+    or-None) in the page storage format."""
+    if spec.precision == "int8":
+        v, s = quantize_block_scaled_int8(
+            x.astype(jnp.float32), block=spec.quant_block)
+        return v, s
+    return x.astype(store_dtype(spec)), None
+
+
+def decode_kv(values: jax.Array, scales: Optional[jax.Array],
+              spec: KVCacheSpec, dtype=jnp.float32) -> jax.Array:
+    """Page storage -> compute dtype (the read side of the pool)."""
+    if spec.precision == "int8":
+        return dequantize_block_scaled_int8(values, scales, dtype)
+    return values.astype(dtype)
+
+
+# -- sharding ----------------------------------------------------------------
+
+
+def kv_cache_rules(base_rule_set: str = "llama") -> ShardingRules:
+    """The serving rule set: KV-pool rules prepended to the UNCHANGED
+    training rules of ``base_rule_set`` (regex-compose, first match
+    wins — the ``moe_ep_rules`` / ``wire_residual`` pattern), so one
+    rule object shards ``{"params": ..., "cache": ...}`` and the params
+    land exactly where training would put them."""
+    from dlrover_tpu.parallel.strategy import RULE_SETS
+
+    factory = RULE_SETS.get(base_rule_set)
+    if factory is None:
+        raise ValueError(
+            f"unknown base rule set {base_rule_set!r}; "
+            f"have {sorted(RULE_SETS)}"
+        )
+    base = factory()
+    return ShardingRules(rules=[
+        # pool payload [L, S, T, KV, HD]: heads on the model axis,
+        # slots data-sharded (each data shard serves its own slots)
+        (r"cache/(k|v)$", (None, ("data", "fsdp"), None, "tensor", None)),
+        # int8 scale side-band [L, S, T, KV, NB] rides with its payload
+        (r"cache/(k|v)_scale$",
+         (None, ("data", "fsdp"), None, "tensor", None)),
+        (r"cache/length$", (("data", "fsdp"),)),
+        *base.rules,
+    ], default=base.default)
+
+
+def serve_shardings(mesh, spec: KVCacheSpec, params_abstract,
+                    base_rule_set: str = "llama"):
+    """NamedShardings for the joint ``{"params", "cache"}`` tree a
+    serve program runs over."""
+    rules = kv_cache_rules(base_rule_set)
+    abstract = {
+        "params": params_abstract,
+        "cache": jax.eval_shape(lambda: init_kv_cache(spec)),
+    }
+    return rules.tree_shardings(mesh, abstract)
+
+
+# -- host-side slot surgery (retune across a slot-count change) --------------
+
+
+def migrate_slots_host(host_cache: Dict[str, Any], old_spec: KVCacheSpec,
+                       new_spec: KVCacheSpec,
+                       slot_map: Dict[int, int]) -> Dict[str, Any]:
+    """Repack a HOST (numpy) cache snapshot into a new slot count:
+    ``slot_map`` maps old slot -> new slot for every live request; the
+    rest of the new pool is zeros. Page geometry (T, KV, HD, precision)
+    must match — a retune changes the SLOT dimension only."""
+    import numpy as np
+
+    if (old_spec.max_seq, old_spec.precision) != (
+            new_spec.max_seq, new_spec.precision):
+        raise ValueError("migrate_slots_host only remaps the slot dim")
+    out: Dict[str, Any] = {}
+    for name, leaf in host_cache.items():
+        arr = np.asarray(leaf)
+        if name == "length":
+            fresh = np.zeros((new_spec.num_slots,), arr.dtype)
+            for old, new in slot_map.items():
+                fresh[new] = arr[old]
+        else:
+            fresh = np.zeros(
+                (arr.shape[0], new_spec.num_slots) + arr.shape[2:],
+                arr.dtype)
+            if name.endswith("_scale"):
+                fresh[:] = 1.0
+            for old, new in slot_map.items():
+                fresh[:, new] = arr[:, old]
+        out[name] = fresh
+    return out

@@ -1,0 +1,336 @@
+"""The request router — the master's serving control plane.
+
+The PR 9 ``BatchDatasetManager`` dispatch ledger, generalized from
+shards to requests: enqueue (todo) → lease (doing) → complete (done),
+with the same invariants re-pointed at serving:
+
+  * a leased request belongs to exactly one worker until it completes
+    or its lease EXPIRES (the shard-timeout machinery: a request
+    stranded on a dead/wedged worker re-queues to a live one — counted
+    and evented, because the re-lease re-decodes the prompt);
+  * accounting is conservation-checked: every submitted request is
+    queued, leased, or done at all times — ``dropped_total`` counts
+    conservation violations and the resize wedge pins it at ZERO;
+  * per-request latency lands in master-side histograms (TTFT,
+    per-token, end-to-end), the serving twin of the shard
+    dispatch→complete latency histogram.
+
+Leases survive a live resize by construction: the worker process never
+dies (PR 5 in-process reshard), so its leases simply keep ticking —
+the router HOLDS them, and only the expiry scan (a genuinely dead
+worker) ever takes a request back.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
+
+logger = get_logger("serving.router")
+
+_id_seq = itertools.count()
+
+
+@dataclass
+class ServeRequest:
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: int = -1
+    state: str = "queued"  # queued | leased | done
+    node_id: int = -1
+    enqueue_ts: float = 0.0
+    lease_ts: float = 0.0
+    first_lease_ts: float = 0.0
+    done_ts: float = 0.0
+    releases: int = 0
+    tokens: List[int] = field(default_factory=list)
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    error_code: str = ""
+
+    def wire(self) -> Dict[str, Any]:
+        return {
+            "request_id": self.request_id,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "eos_id": self.eos_id,
+        }
+
+
+class RequestRouter:
+    def __init__(self, lease_timeout_secs: Optional[float] = None):
+        from dlrover_tpu.common.config import get_context
+
+        self._lock = threading.Lock()
+        self._timeout = float(
+            lease_timeout_secs if lease_timeout_secs is not None
+            else getattr(get_context(), "serve_lease_timeout_secs", 120.0))
+        self._queue: "deque[ServeRequest]" = deque()
+        self._requests: Dict[str, ServeRequest] = {}
+        self._node_touch: Dict[int, float] = {}
+        # instance-local totals: the registry counters below are
+        # process-wide (shared across router instances in tests); the
+        # ledger must report THIS router's ledger
+        self._n_submitted = 0
+        self._n_completed = 0
+        self._n_dropped = 0
+        self._n_expired = 0
+        # bounded done-ledger: a long-lived serving master must not
+        # retain every completed request's prompt+tokens forever (the
+        # decision-trail deque precedent) — completion order, oldest
+        # pruned past the cap. Totals above keep counting; only the
+        # per-request records age out.
+        self._done_order: "deque[str]" = deque()
+        self._done_retention_cap = 4096
+        # incremental state counts, updated at every transition: the
+        # gauges/ledger must not rescan every tracked request under
+        # the lock on the serving hot path
+        self._live_counts = {"queued": 0, "leased": 0, "done": 0}
+        reg = get_registry()
+        self._c_submitted = reg.counter(
+            tm.SERVE_REQUESTS_SUBMITTED,
+            help="requests enqueued on the router")
+        self._c_completed = reg.counter(
+            tm.SERVE_REQUESTS_COMPLETED,
+            help="requests completed by workers")
+        self._c_dropped = reg.counter(
+            tm.SERVE_REQUESTS_DROPPED,
+            help="requests lost without completion or re-lease "
+                 "(conservation violations — must stay 0)")
+        self._c_expired = reg.counter(
+            tm.SERVE_LEASES_EXPIRED,
+            help="leases expired on a silent worker and re-queued")
+        self._g_queued = reg.gauge(
+            tm.SERVE_REQUESTS_QUEUED, help="requests waiting for a lease")
+        self._g_leased = reg.gauge(
+            tm.SERVE_REQUESTS_LEASED, help="requests leased to workers")
+        self._h_ttft = reg.histogram(
+            tm.SERVE_TTFT_TIME, help="admit -> first token wall seconds")
+        self._h_e2e = reg.histogram(
+            tm.SERVE_E2E_TIME, help="admit -> completion wall seconds")
+        self._h_tokens = reg.histogram(
+            tm.SERVE_TOKENS_PER_REQUEST,
+            help="tokens generated per completed request")
+
+    # -- the three verbs -----------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               request_id: str = "", eos_id: int = -1) -> str:
+        with self._lock:
+            rid = request_id or f"req-{next(_id_seq)}"
+            if rid in self._requests:
+                # idempotent re-submit (a retried RPC): keep the first
+                return rid
+            req = ServeRequest(
+                request_id=rid, prompt=[int(t) for t in prompt],
+                max_new_tokens=int(max_new_tokens), eos_id=int(eos_id),
+                enqueue_ts=time.time(),
+            )
+            self._requests[rid] = req
+            self._queue.append(req)
+            self._live_counts["queued"] += 1
+            self._n_submitted += 1
+            self._c_submitted.inc()
+            self._refresh_gauges()
+            return rid
+
+    def lease(self, node_id: int, max_requests: int) -> List[Dict]:
+        self.scan_expired_once()
+        out = []
+        with self._lock:
+            now = time.time()
+            self._node_touch[int(node_id)] = now
+            while self._queue and len(out) < max(0, int(max_requests)):
+                req = self._queue.popleft()
+                req.state = "leased"
+                self._live_counts["queued"] -= 1
+                self._live_counts["leased"] += 1
+                req.node_id = int(node_id)
+                req.lease_ts = now
+                if not req.first_lease_ts:
+                    req.first_lease_ts = now
+                out.append(req.wire())
+            if out:
+                self._refresh_gauges()
+        return out
+
+    def complete(self, node_id: int, request_id: str,
+                 tokens: List[int], ttft_s: Optional[float] = None,
+                 e2e_s: Optional[float] = None,
+                 error_code: str = "") -> bool:
+        with self._lock:
+            self._node_touch[int(node_id)] = time.time()
+            req = self._requests.get(request_id)
+            if req is None or req.state == "done":
+                return False  # a re-leased twin already completed it
+            if req.state == "queued":
+                # completed by the ORIGINAL worker after an expiry
+                # re-queued it: accept the result and pull it back out
+                # of the queue (no duplicate decode)
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass
+                self._live_counts["queued"] -= 1
+            else:
+                self._live_counts["leased"] -= 1
+            req.state = "done"
+            self._live_counts["done"] += 1
+            req.done_ts = time.time()
+            req.tokens = [int(t) for t in tokens or []]
+            req.ttft_s, req.e2e_s = ttft_s, e2e_s
+            req.error_code = error_code or ""
+            self._n_completed += 1
+            self._done_order.append(req.request_id)
+            while len(self._done_order) > self._done_retention_cap:
+                if self._requests.pop(self._done_order.popleft(),
+                                      None) is not None:
+                    self._live_counts["done"] -= 1
+            self._c_completed.inc()
+            if ttft_s is not None:
+                self._h_ttft.observe(float(ttft_s))
+            if e2e_s is not None:
+                self._h_e2e.observe(float(e2e_s))
+            self._h_tokens.observe(float(len(req.tokens)))
+            self._refresh_gauges()
+            return True
+
+    def touch(self, node_id: int):
+        with self._lock:
+            self._node_touch[int(node_id)] = time.time()
+
+    # -- expiry (the shard-timeout machinery, re-pointed) --------------------
+
+    def scan_expired_once(self, timeout_secs: Optional[float] = None
+                          ) -> List[str]:
+        """Re-queue leased requests whose worker has been silent past
+        the lease timeout — the dead-worker re-lease path. The request
+        re-decodes from its prompt on the next worker (counted and
+        evented: duplicate work, never a drop)."""
+        timeout = float(timeout_secs if timeout_secs is not None
+                        else self._timeout)
+        if timeout <= 0:
+            return []
+        requeued: List[str] = []
+        with self._lock:
+            now = time.time()
+            for req in self._requests.values():
+                if req.state != "leased":
+                    continue
+                last = max(req.lease_ts,
+                           self._node_touch.get(req.node_id, 0.0))
+                if now - last <= timeout:
+                    continue
+                req.state = "queued"
+                self._live_counts["leased"] -= 1
+                self._live_counts["queued"] += 1
+                req.releases += 1
+                stranded_node = req.node_id
+                req.node_id = -1
+                self._queue.append(req)
+                requeued.append(req.request_id)
+                self._n_expired += 1
+                self._c_expired.inc()
+                emit_event(
+                    EventKind.SERVE_LEASE_EXPIRED,
+                    error_code="SERVE_LEASE_EXPIRED",
+                    request_id=req.request_id,
+                    stranded_node=stranded_node,
+                    lease_age_s=round(now - last, 1),
+                )
+            if requeued:
+                self._refresh_gauges()
+                logger.warning("re-leased %d stranded requests: %s",
+                               len(requeued), requeued[:8])
+        return requeued
+
+    # -- accounting ----------------------------------------------------------
+
+    def _counts(self) -> Dict[str, int]:
+        return dict(self._live_counts)
+
+    def _refresh_gauges(self):
+        c = self._counts()
+        self._g_queued.set(c["queued"])
+        self._g_leased.set(c["leased"])
+        # conservation: every submitted request is in exactly one
+        # state. TODAY this cannot fire (the three states are
+        # exhaustive by construction) — it guards FUTURE code paths
+        # that remove entries; the PRIMARY zero-drop check is the
+        # completed-equals-submitted arithmetic the resize wedge and
+        # the bench resize leg pin, plus `oldest_lease_age_s` in the
+        # report for leases a live-but-stuck worker never completes.
+        lost = len(self._requests) - sum(c.values())
+        if lost > 0:
+            self._n_dropped += lost
+            self._c_dropped.inc(lost)
+            logger.error("request conservation violated: %d lost", lost)
+
+    def dropped(self) -> int:
+        return self._n_dropped
+
+    def report(self) -> Dict[str, Any]:
+        """The ``tpurun requests`` ledger."""
+        from dlrover_tpu.telemetry.metrics import percentile_from_counts
+
+        with self._lock:
+            counts = self._counts()
+            per_node: Dict[int, Dict[str, int]] = {}
+            for r in self._requests.values():
+                if r.node_id < 0:
+                    continue
+                row = per_node.setdefault(
+                    r.node_id, {"leased": 0, "done": 0, "tokens": 0})
+                if r.state == "leased":
+                    row["leased"] += 1
+                elif r.state == "done":
+                    row["done"] += 1
+                    row["tokens"] += len(r.tokens)
+
+            def pct(h, q):
+                b = getattr(h, "bounds", None)
+                cts = h.snapshot_counts()
+                if not b or cts is None:
+                    return None
+                return percentile_from_counts(b, cts, q)
+
+            now = time.time()
+            oldest_lease = max(
+                (now - r.first_lease_ts
+                 for r in self._requests.values()
+                 if r.state == "leased" and r.first_lease_ts), default=0.0)
+            return {
+                "requests": {
+                    **counts,
+                    "submitted": self._n_submitted,
+                    "completed": self._n_completed,
+                    "dropped": self._n_dropped,
+                    "leases_expired": self._n_expired,
+                    # a live-but-stuck worker keeps touching, so its
+                    # lease never expires: the age of the OLDEST open
+                    # lease is the operator's visibility into that
+                    # failure mode (expiry only catches SILENT workers)
+                    "oldest_lease_age_s": round(oldest_lease, 1),
+                },
+                "latency": {
+                    "ttft_p50_s": pct(self._h_ttft, 0.50),
+                    "ttft_p95_s": pct(self._h_ttft, 0.95),
+                    "e2e_p50_s": pct(self._h_e2e, 0.50),
+                    "e2e_p95_s": pct(self._h_e2e, 0.95),
+                },
+                "nodes": {str(n): v
+                          for n, v in sorted(per_node.items())},
+            }

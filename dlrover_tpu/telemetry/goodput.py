@@ -59,6 +59,9 @@ _SCENARIO_BUCKET = {
     "worker_failure": "restart",
     "hang": "restart",
     "live_reshard": "reshard",
+    # the serving world's live resize is reshard-class downtime: the
+    # decode stream pauses while params+KV pages move meshes
+    "serving_resize": "reshard",
     # a runtime-optimizer plan applying live (drain -> retune -> resume)
     "replan": "replan",
     "nonfinite_rollback": "rollback",

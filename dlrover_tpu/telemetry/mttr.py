@@ -45,6 +45,12 @@ _PAIRINGS = {
     # ledger and the recovery report must both see it
     EventKind.OPTIMIZER_APPLY_BEGIN: (
         {EventKind.OPTIMIZER_APPLY_DONE}, "replan"),
+    # the serving world resizing live (drain decode window -> snapshot
+    # params+KV pages -> reshard): requests are HELD across it, so
+    # this interval is exactly the per-request latency bump a resize
+    # costs — the serving tier's recovery scenario
+    EventKind.SERVE_RESIZE_BEGIN: (
+        {EventKind.SERVE_RESIZE_DONE}, "serving_resize"),
 }
 
 

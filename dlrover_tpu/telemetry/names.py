@@ -216,6 +216,38 @@ DATA_NODE_RECORDS_DONE = "dlrover_data_node_records_done_total"
 # (rides NodeRuntimeReport like NODE_MFU; absent until measured)
 NODE_INPUT_WAIT_FRAC = "dlrover_node_input_wait_fraction"
 
+# -- serving tier (dlrover_tpu.serving) ---------------------------------------
+# Worker side: the continuous-batching decode loop; master side: the
+# request router's ledger (the PR 9 shard ledger generalized).
+
+# worker-side decode loop
+SERVE_DECODE_STEPS = "dlrover_serve_decode_steps_total"
+SERVE_TOKENS = "dlrover_serve_tokens_total"
+SERVE_PREFILL_CHUNKS = "dlrover_serve_prefill_chunks_total"
+SERVE_ADMISSIONS = "dlrover_serve_admissions_total"
+SERVE_SLOT_OCCUPANCY = "dlrover_serve_slot_occupancy"
+SERVE_STEP_TIME = "dlrover_serve_decode_step_seconds"
+# worker-side elasticity: live serving-world resizes (requests held,
+# never dropped)
+SERVE_RESIZES = "dlrover_serve_resizes_total"
+SERVE_RESIZE_TIME = "dlrover_serve_resize_seconds"
+# master-side router ledger (requests, not shards)
+SERVE_REQUESTS_SUBMITTED = "dlrover_serve_requests_submitted_total"
+SERVE_REQUESTS_COMPLETED = "dlrover_serve_requests_completed_total"
+SERVE_REQUESTS_QUEUED = "dlrover_serve_requests_queued"
+SERVE_REQUESTS_LEASED = "dlrover_serve_requests_leased"
+# requests DROPPED (lost without completion or re-lease): the resize
+# wedge pins this at exactly zero
+SERVE_REQUESTS_DROPPED = "dlrover_serve_requests_dropped_total"
+# leases that expired and were re-queued to a live worker (the shard
+# re-dispatch machinery re-pointed at requests — duplicate decode
+# work, so counted and evented like DATA_SHARDS_TIMEOUT_RECOVERED)
+SERVE_LEASES_EXPIRED = "dlrover_serve_leases_expired_total"
+# per-request latency accounting on the master
+SERVE_TTFT_TIME = "dlrover_serve_ttft_seconds"
+SERVE_E2E_TIME = "dlrover_serve_e2e_seconds"
+SERVE_TOKENS_PER_REQUEST = "dlrover_serve_tokens_per_request"
+
 
 class EventKind:
     """Event-timeline record kinds (``telemetry.events``). Failure-edge
@@ -296,6 +328,17 @@ class EventKind:
     # `tpurun data --events`)
     DATA_SHARD_TIMEOUT = "data_shard_timeout"
     DATA_EPOCH_END = "data_epoch_end"
+    # serving tier: run lifecycle, the live serving-world resize
+    # (failure edge -> recovery edge for the serving_resize MTTR
+    # scenario), and the failure-class request edges (eviction when a
+    # request cannot fit the pool; a lease expiring on a dead worker
+    # and re-queueing — both carry error codes, DLR008)
+    SERVE_START = "serve_start"
+    SERVE_END = "serve_end"
+    SERVE_RESIZE_BEGIN = "serve_resize_begin"
+    SERVE_RESIZE_DONE = "serve_resize_done"
+    SERVE_REQUEST_EVICTED = "serve_request_evicted"
+    SERVE_LEASE_EXPIRED = "serve_lease_expired"
 
 
 class SpanName:

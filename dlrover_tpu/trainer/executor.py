@@ -413,6 +413,24 @@ class OptimizerPlanHook(TrainHook):
         plan_id = getattr(cfg, "plan_id", "") or ""
         if not plan_id or plan_id == self._seen_plan:
             return
+        if (
+            (getattr(cfg, "serve_slots", 0)
+             or getattr(cfg, "serve_prefill_chunk", 0))
+            and not cfg.steps_per_call and not cfg.mesh_shape
+            and cfg.train_window < 0
+            and not getattr(cfg, "dispatch_chunks", 0)
+            and not getattr(cfg, "moe_precision", "")
+            and not getattr(cfg, "fsdp_precision", "")
+            and not getattr(cfg, "restart", False)
+        ):
+            # a SERVE-ONLY plan (every training knob at its sentinel):
+            # addressed to a serve worker sharing this master's
+            # broadcast slot. Applying it here would be a no-op apply
+            # that ACKS the plan — the master would mark it applied
+            # and retract it before the serve worker ever polls it.
+            # Mark seen and leave it alone.
+            self._seen_plan = plan_id
+            return
         self._seen_plan = plan_id
         if getattr(cfg, "restart", False):
             logger.info("optimizer plan %s requests a restart", plan_id)

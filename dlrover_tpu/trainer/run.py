@@ -192,6 +192,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from dlrover_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] in ("serve", "requests"):
+        # `tpurun serve --addr ...` runs one continuous-batching serve
+        # worker; `tpurun requests` renders the router ledger (live
+        # --addr / forensic --events) — see docs/serving.md
+        from dlrover_tpu.serving.cli import main as serving_main
+
+        return serving_main(argv)
     if argv and argv[0] in ("metrics", "mttr", "goodput", "diagnose",
                             "plan", "attribution", "data", "events",
                             "trace", "cache"):

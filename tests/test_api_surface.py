@@ -12,7 +12,8 @@ SURFACE = {
     "dlrover_tpu.parallel.mesh": ["MeshPlan"],
     "dlrover_tpu.parallel.planner": ["plan_mesh", "estimate",
                                      "plan_stages", "plan_stage_depths",
-                                     "ModelSpec"],
+                                     "ModelSpec", "estimate_decode",
+                                     "serve_cache_bytes"],
     "dlrover_tpu.parallel.aot": ["aot_compile_train_step"],
     "dlrover_tpu.parallel.auto_tune": ["dryrun", "search_strategy"],
     "dlrover_tpu.trainer.run": ["main"],
@@ -34,6 +35,12 @@ SURFACE = {
     "dlrover_tpu.agent.training_agent": ["ElasticTrainingAgent",
                                          "AgentConfig"],
     "dlrover_tpu.master.local_master": ["start_local_master"],
+    "dlrover_tpu.serving.kv_cache": ["KVCacheSpec", "init_kv_cache",
+                                     "kv_cache_rules",
+                                     "resolve_kv_precision"],
+    "dlrover_tpu.serving.engine": ["ServeEngine", "ServeExecutor"],
+    "dlrover_tpu.serving.router": ["RequestRouter"],
+    "dlrover_tpu.serving.cli": ["main"],
     "dlrover_tpu.master.main": ["main"],
     "dlrover_tpu.ops.flash_attention": [
         "flash_attention", "flash_attention_auto",

@@ -309,6 +309,10 @@ class TestPlannerFsdpSplit:
 
 
 class TestFsdpWireBytesAndLint:
+    @pytest.mark.slow  # PR 13 triage: a second copy of a lint-compile
+    # test — the G106 audit machinery stays tier-1 via test_lint_clean
+    # + test_analysis, and the dtype-aware fsdp byte formula stays
+    # tier-1 via the planner perturbation/ratio pins in this file
     def test_quantized_program_audits_clean_with_shrunk_gathers(self):
         """The acceptance pin: G106 audits the fp8 dense program's
         collective bytes against the dtype-aware prediction within the
@@ -527,6 +531,11 @@ class TestResidualRidesStateMachinery:
 
 
 class TestRetuneFsdpPrecisionZeroRecompile:
+    @pytest.mark.slow  # PR 13 triage: the per-knob retune gate — the
+    # prewarm/retune/program-cache mechanics stay tier-1 via PR 7's
+    # test_optimizer e2e wedges and the serving retune/resize gates
+    # (tests/test_serving.py); the fsdp-specific key identity stays
+    # tier-1 below (test_program_key_carries_both_precisions)
     def test_prewarmed_fsdp_retune_swaps_with_zero_recompiles(self):
         """The tier-1 live-apply gate (the PR 11 pattern): retune()
         across dense-wire precisions through the program cache — a

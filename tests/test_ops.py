@@ -1132,7 +1132,12 @@ class TestMoEGroupedEP:
                        mesh=mesh)
         return oracle, ep
 
-    @pytest.mark.parametrize("top_k", [1, 2])
+    # PR 13 triage: the top_k=1 parametrization is a strict subset of
+    # the top_k=2 regime (fewer routing paths) and rides slow; the
+    # exact-oracle contract stays tier-1 at top_k=2 here and fwd+bwd
+    # in test_grads_match_oracle
+    @pytest.mark.parametrize("top_k", [
+        pytest.param(1, marks=pytest.mark.slow), 2])
     def test_matches_no_drop_einsum_oracle(self, top_k):
         params, x = self._params_x()
         cfg_o, cfg_ep = self._cfgs(top_k)

@@ -54,6 +54,9 @@ class TestWsam:
         expected = -lr * ((1 - alpha) * g + alpha * g_sam)
         np.testing.assert_allclose(updates, expected, rtol=1e-5)
 
+    @pytest.mark.slow  # PR 13 triage: a 17 s convergence loop — the
+    # wsam step CONTRACT stays tier-1 via the exact manual-match tests
+    # above and the accelerate integration below
     def test_converges_on_quadratic(self):
         def loss(w):
             return 5.0 * w[0] ** 2 + 0.5 * w[1] ** 2

@@ -772,6 +772,11 @@ class TestChunkedGraphLint:
 
 
 class TestPrefetchLint:
+    @pytest.mark.slow  # PR 13 triage: a second lint-compile of the
+    # prefetch program — prefetch numerics stay tier-1 via the
+    # fsdp-wire prefetch oracle (test_fsdp_wire TestFsdpWireOracle::
+    # test_prefetch_path_holds_the_oracle_too) and G105 machinery via
+    # test_lint_clean
     def test_prefetch_keeps_donation_and_numerics(self):
         """G105 (donation) must survive the prefetch-restructured scan,
         and the prefetched forward matches the plain one to fp32
