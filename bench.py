@@ -2599,6 +2599,15 @@ def _serve_leg(engine, admission: str, workload,
     ttfts = sorted(r["ttft_s"] for r in done
                    if r["ttft_s"] is not None)
     e2es = sorted(r["e2e_s"] for r in done)
+    # the SLO decomposition beside TTFT/e2e: queue-wait (submit ->
+    # admit, worker-local mode measures it on the records) and TPOT
+    # (decode-phase inter-token: (e2e - ttft) / (tokens - 1))
+    waits = sorted(r["queue_wait_s"] for r in done
+                   if r.get("queue_wait_s") is not None)
+    tpots = sorted(
+        (r["e2e_s"] - r["ttft_s"]) / (len(r["tokens"]) - 1)
+        for r in done
+        if r.get("ttft_s") is not None and len(r["tokens"]) > 1)
 
     def pct(xs, q):
         return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
@@ -2614,6 +2623,14 @@ def _serve_leg(engine, admission: str, workload,
         "ttft_p95_s": pct(ttfts, 0.95),
         "e2e_p50_s": pct(e2es, 0.50),
         "e2e_p95_s": pct(e2es, 0.95),
+        "queue_wait_p50_s": pct(waits, 0.50),
+        "queue_wait_p95_s": pct(waits, 0.95),
+        "tpot_p50_s": pct(tpots, 0.50),
+        "tpot_p95_s": pct(tpots, 0.95),
+        # the slot-seconds partition of this leg's serve loop (sums to
+        # slots x serve_wall_s by construction — the per-leg view of
+        # `tpurun serve slo --events`)
+        "slot_ledger": executor.slot_ledger(),
         "records": done,
     }
 
@@ -2757,7 +2774,7 @@ def serve_main() -> int:
     artifact = os.environ.get(
         "BENCH_SERVE_ARTIFACT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_r12.json"),
+                     "BENCH_r13.json"),
     )
     if artifact:
         with open(artifact, "w") as f:

@@ -123,6 +123,18 @@ class MasterClient:
         except ValueError:
             return {}
 
+    def get_serve_slo(self) -> dict:
+        """The serving SLO plane: targets, burn rates, active
+        violation verdicts, scale proposals (``tpurun serve slo
+        --addr``)."""
+        import json
+
+        resp = self._channel.get(comm.ServeSLORequest())
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
+
     # -- rendezvous ---------------------------------------------------------
 
     def report_rdzv_params(self, min_nodes: int, max_nodes: int,

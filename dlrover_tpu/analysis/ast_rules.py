@@ -69,14 +69,14 @@ FAILURE_EVENT_ATTRS = {
     "PREEMPT_NOTICE", "RDZV_TIMEOUT", "CKPT_MIRROR_TIMEOUT",
     "ERROR_REPORT", "DIAG_STRAGGLER", "DIAG_NODE_HANG",
     "DATA_SHARD_TIMEOUT", "SERVE_REQUEST_EVICTED",
-    "SERVE_LEASE_EXPIRED",
+    "SERVE_LEASE_EXPIRED", "SERVE_SLO_VIOLATION",
 }
 FAILURE_EVENT_VALUES = {
     "nonfinite_step", "worker_failed", "hang_detected",
     "preempt_notice", "rdzv_timeout", "ckpt_mirror_timeout",
     "error_report", "diag_straggler", "diag_node_hang",
     "data_shard_timeout", "serve_request_evicted",
-    "serve_lease_expired",
+    "serve_lease_expired", "serve_slo_violation",
 }
 
 

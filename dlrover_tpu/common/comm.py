@@ -316,6 +316,16 @@ class NodeRuntimeReport:
     # spent blocked waiting for the next host batch (None until the
     # executor measured a window — absent, never a fake 0)
     input_wait_frac: Optional[float] = None
+    # serving tier (reports with node_type="serve", pushed by
+    # ServeRuntimeReportHook): ``step_time_counts`` carries the
+    # cumulative DECODE-step histogram and ``steps_total`` the decode
+    # steps; these fields carry the serving-only facts. None on
+    # training reports — the master exports the serve gauges only for
+    # serve nodes.
+    serve_tokens_total: Optional[float] = None
+    serve_queue_len: Optional[float] = None
+    serve_slot_occupancy: Optional[float] = None
+    serve_slots: Optional[float] = None
 
 
 @message
@@ -563,6 +573,16 @@ class ServeConfigReport:
     head_dim: int = 0
     plan_id: str = ""
     apply_failed: bool = False
+
+
+@message
+class ServeSLORequest:
+    """Query the master's serving SLO plane (``tpurun serve slo
+    --addr``): declared targets, current burn rates, active violation
+    verdicts and the scale proposals the policy loop issued. Answered
+    with a DiagnosisReport-style JSON blob."""
+
+    pass
 
 
 @message

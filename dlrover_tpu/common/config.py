@@ -233,6 +233,25 @@ class Context:
         # the router for this long is re-leased to a live worker
         # (the shard-timeout machinery re-pointed at requests)
         self.serve_lease_timeout_secs = 120.0
+        # -- serving SLO plane (master/monitor/serve_slo.py;
+        # docs/operations.md "Reading an SLO violation") --------------
+        # declared SLO targets, evaluated over rolling windows with
+        # multi-window burn-rate confirmation. 0 = target OFF (both
+        # off = the SLO engine never evaluates — the default: SLOs are
+        # a deployment declaration, not a framework guess)
+        self.serve_slo_ttft_p95_secs = 0.0
+        self.serve_slo_queue_depth = 0.0
+        # rolling evaluation window, and how many consecutive
+        # over-budget (or, for recovery, under-budget) windows confirm
+        # (0 = follow diagnosis_confirm_windows)
+        self.serve_slo_window_secs = 30.0
+        self.serve_slo_confirm_windows = 0
+        # SLO-driven serving scale policy: per-direction proposal
+        # cooldown (a flapping SLO cannot thrash the serving world),
+        # and how many consecutive all-idle ticks propose a scale-in
+        # (0 = scale-in off)
+        self.serve_scale_cooldown_secs = 120.0
+        self.serve_scale_idle_windows = 0
         self._apply_env_overrides()
 
     def _apply_env_overrides(self):

@@ -117,6 +117,11 @@ class DistributedJobMaster:
         # would add up to a full scaler period of recovery latency
         self.servicer.straggler_detector.add_verdict_listener(
             self._on_diag_verdict)
+        # the serving SLO policy loop feeds the auto-scaler (scale-out
+        # on sustained violation, scale-in on sustained idle); the
+        # resize itself rides the serving live-resize path
+        self.servicer.serving_scale_policy.attach_auto_scaler(
+            self.job_auto_scaler)
         self._stopped = threading.Event()
         self._exit_reason = ""
         self._ctx = get_context()
@@ -225,6 +230,15 @@ class DistributedJobMaster:
                 self.metric_collector.collect_runtime_stats(
                     self.speed_monitor, self.job_manager.get_job_nodes()
                 )
+                # the serving SLO plane ticks on the same clock the
+                # local master uses (the engine self-paces its window);
+                # guarded like the local master's stats loop — an SLO
+                # evaluation failure must not tear down the job master
+                try:
+                    self.servicer.serve_slo.evaluate()
+                    self.servicer.serving_scale_policy.tick()
+                except Exception:  # noqa: BLE001
+                    logger.exception("serving SLO tick failed")
                 self._stopped.wait(self._ctx.seconds_interval_to_report)
             return 0
         finally:

@@ -99,6 +99,11 @@ class LocalJobMaster:
                 # a stranded serve lease likewise only expires on a
                 # clock — a dead worker sends nothing
                 self.servicer.request_router.scan_expired_once()
+                # the serving SLO plane: one rolling-window tick per
+                # pass (the engine self-paces to serve_slo_window_secs)
+                # plus the scale policy's idle watch
+                self.servicer.serve_slo.evaluate()
+                self.servicer.serving_scale_policy.tick()
             except Exception:  # noqa: BLE001 — stats must not kill serving
                 logger.exception("runtime stats collection failed")
 

@@ -59,6 +59,16 @@ class NodeSample:
     # data plane: the worker's input-wait fraction over its last
     # materialization window (None until the executor measured one)
     input_wait_frac: Optional[float] = None
+    # serving tier (reports with node_type="serve"): step_p50/p95 hold
+    # the windowed DECODE-step percentiles, steps_total the decode
+    # steps; tokens_per_s is the windowed token rate (None on a node's
+    # first report — no window to rate over)
+    node_type: str = "worker"
+    serve_tokens_total: Optional[float] = None
+    serve_tokens_per_s: Optional[float] = None
+    serve_queue_len: Optional[float] = None
+    serve_slot_occupancy: Optional[float] = None
+    serve_slots: Optional[float] = None
     overflow: bool = False
 
 
@@ -142,6 +152,18 @@ class NodeRuntimeStore:
             def opt(value):
                 return float(value) if value is not None else None
 
+            # serving: windowed token rate from the cumulative token
+            # total and the previous sample's receive clock
+            tokens_total = opt(getattr(report, "serve_tokens_total",
+                                       None))
+            tokens_per_s = None
+            if tokens_total is not None and state.samples:
+                prev = state.samples[-1]
+                prev_tokens = prev.serve_tokens_total
+                dt = ts - prev.ts
+                if prev_tokens is not None and dt > 0 \
+                        and tokens_total >= prev_tokens:
+                    tokens_per_s = (tokens_total - prev_tokens) / dt
             sample = NodeSample(
                 ts=ts,
                 step=int(report.step),
@@ -167,6 +189,14 @@ class NodeRuntimeStore:
                 peak_hbm_mb=opt(getattr(report, "peak_hbm_mb", None)),
                 input_wait_frac=opt(getattr(report, "input_wait_frac",
                                             None)),
+                node_type=state.node_type,
+                serve_tokens_total=tokens_total,
+                serve_tokens_per_s=tokens_per_s,
+                serve_queue_len=opt(getattr(report, "serve_queue_len",
+                                            None)),
+                serve_slot_occupancy=opt(getattr(
+                    report, "serve_slot_occupancy", None)),
+                serve_slots=opt(getattr(report, "serve_slots", None)),
                 overflow=bool(of50 or of95),
             )
             state.samples.append(sample)
@@ -178,6 +208,12 @@ class NodeRuntimeStore:
     def _export_gauges(self, node_id: int, s: NodeSample) -> None:
         reg = get_registry()
         labels = {"node": str(node_id)}
+        if s.node_type == "serve":
+            # a serve worker's report: its step histogram holds DECODE
+            # steps — export the serving names, never the training ones
+            # (a scraper must not read a decode p50 as a train step)
+            self._export_serve_gauges(reg, labels, s)
+            return
         if s.step_p50 is not None:
             reg.gauge(tm.NODE_STEP_P50, labels=labels,
                       help="per-node windowed step-time p50").set(s.step_p50)
@@ -225,6 +261,38 @@ class NodeRuntimeStore:
         reg.gauge(tm.NODE_STEPS_TOTAL, labels=labels,
                   help="per-node optimizer steps materialized").set(
                       s.steps_total)
+
+    def _export_serve_gauges(self, reg, labels, s: NodeSample) -> None:
+        if s.step_p50 is not None:
+            reg.gauge(tm.NODE_SERVE_DECODE_P50, labels=labels,
+                      help="per-serve-node windowed decode-step p50"
+                      ).set(s.step_p50)
+        if s.step_p95 is not None:
+            reg.gauge(tm.NODE_SERVE_DECODE_P95, labels=labels,
+                      help="per-serve-node windowed decode-step p95"
+                      ).set(s.step_p95)
+        reg.gauge(tm.NODE_SERVE_STEPS_TOTAL, labels=labels,
+                  help="per-serve-node decode steps dispatched").set(
+                      s.steps_total)
+        reg.gauge(tm.NODE_RSS_MB, labels=labels,
+                  help="per-node worker process RSS (MB)").set(s.rss_mb)
+        # absent-not-zero, the attribution-gauge discipline: a rate
+        # needs two samples; queue/occupancy only when reported
+        optional = (
+            (tm.NODE_SERVE_TOKENS_PER_S, s.serve_tokens_per_s,
+             "per-serve-node windowed tokens per second"),
+            (tm.NODE_SERVE_QUEUE_LEN, s.serve_queue_len,
+             "per-serve-node worker-local queued requests"),
+            (tm.NODE_SERVE_SLOT_OCCUPANCY, s.serve_slot_occupancy,
+             "per-serve-node slots holding a live request"),
+            (tm.NODE_SERVE_SLOTS, s.serve_slots,
+             "per-serve-node compiled slot-batch width"),
+        )
+        for name, value, help_text in optional:
+            if value is not None:
+                reg.gauge(name, labels=labels, help=help_text).set(value)
+            else:
+                reg.remove(name, labels=labels)
 
     # -- queries -------------------------------------------------------------
 

@@ -1,0 +1,410 @@
+"""Master-side serving SLO verdict engine + the scale-policy loop.
+
+The serving tier's counterpart of the straggler detector: declared SLO
+targets (``serve_slo_ttft_p95_secs``, ``serve_slo_queue_depth`` —
+knob-table defaults OFF) are evaluated over rolling windows against
+the request router's live state, with MULTI-WINDOW burn-rate
+confirmation mirroring ``diagnosis_confirm_windows``: one queue spike
+cannot flag a violation, and one quiet window cannot clear it. A
+confirmed violation emits ``SERVE_SLO_VIOLATION`` (failure-class: it
+carries an error code and the burn-rate evidence) under a freshly
+minted incident trace id; the recovery emits ``SERVE_SLO_RECOVERED``
+under the SAME id, and the pair derives the ``serving_scale`` MTTR /
+goodput scenario.
+
+``ServingScalePolicy`` closes ROADMAP item 3's open loop: it listens
+to the engine's verdicts (the PR 6/7 verdict-listener pattern) and
+turns them into serving scale PROPOSALS — scale-out on a sustained
+violation, scale-in on sustained idle slots — guarded by a
+``ProposalCooldown`` (hysteresis: flapping SLOs cannot thrash the
+serving world), handed to ``JobAutoScaler`` for immediate evaluation
+and applied through the existing lease-holding live-resize path (the
+worker's ``request_resize`` / a ScalePlan on scheduled deployments).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
+from dlrover_tpu.telemetry.metrics import percentile_from_counts
+from dlrover_tpu.telemetry.trace_context import new_trace_id, trace_scope
+
+logger = get_logger("master.serve_slo")
+
+SLO_TTFT_P95 = "ttft_p95"
+SLO_QUEUE_DEPTH = "queue_depth"
+
+
+class ServeSLOEngine:
+    """Rolling-window SLO evaluation over the router's live state.
+
+    One ``evaluate()`` tick per window (the master's stats loop drives
+    it; tests inject ``now``): each enabled target observes its
+    current value, computes the burn rate (observed / target; > 1 =
+    out of SLO), and advances per-target over/under counters. A target
+    over budget for ``confirm`` CONSECUTIVE windows flags a violation;
+    an active violation under budget for ``confirm`` windows recovers.
+    TTFT percentiles are windowed by diffing the router histogram's
+    cumulative bucket counts between ticks (the node-series
+    discipline) — a p95 poisoned by yesterday's incident must not flag
+    today."""
+
+    def __init__(self, router, store=None,
+                 ttft_p95_secs: Optional[float] = None,
+                 queue_depth: Optional[float] = None,
+                 window_secs: Optional[float] = None,
+                 confirm_windows: Optional[int] = None):
+        ctx = get_context()
+        self.router = router
+        self._store = store
+        self._ttft_target = float(
+            ttft_p95_secs if ttft_p95_secs is not None
+            else getattr(ctx, "serve_slo_ttft_p95_secs", 0.0))
+        self._queue_target = float(
+            queue_depth if queue_depth is not None
+            else getattr(ctx, "serve_slo_queue_depth", 0.0))
+        self._window = float(
+            window_secs if window_secs is not None
+            else getattr(ctx, "serve_slo_window_secs", 30.0))
+        confirm = int(
+            confirm_windows if confirm_windows is not None
+            else getattr(ctx, "serve_slo_confirm_windows", 0))
+        if confirm <= 0:
+            confirm = int(getattr(ctx, "diagnosis_confirm_windows", 3))
+        self._confirm = max(1, confirm)
+        self._lock = threading.Lock()
+        self._last_eval = 0.0
+        self._prev_ttft_counts: Optional[List[int]] = None
+        # per-target: consecutive over/under window counts + the
+        # active violation record ({trace_id, since, evidence})
+        self._over: Dict[str, int] = {}
+        self._under: Dict[str, int] = {}
+        self._burns: Dict[str, collections.deque] = {}
+        self._active: Dict[str, Dict] = {}
+        self._listeners: List[Callable] = []
+        self._pending: List = []
+        reg = get_registry()
+        self._c_violations = reg.counter(
+            tm.SERVE_SLO_VIOLATIONS,
+            help="serving SLO violations confirmed")
+        self._c_recoveries = reg.counter(
+            tm.SERVE_SLO_RECOVERIES,
+            help="serving SLO violations recovered")
+
+    def enabled(self) -> bool:
+        return self._ttft_target > 0 or self._queue_target > 0
+
+    def add_verdict_listener(self, fn: Callable) -> None:
+        """``fn(slo_name, verdict, info)`` with verdict in
+        {"violation", "recovered"}; fired OUTSIDE the engine lock
+        under the incident's trace scope (the straggler-detector
+        listener discipline); failures are logged, never raised into
+        the evaluation tick."""
+        self._listeners.append(fn)
+
+    def _drain_notices(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for slo, verdict, info in pending:
+            with trace_scope(info.get("trace_id") or None):
+                for fn in self._listeners:
+                    try:
+                        fn(slo, verdict, dict(info))
+                    except Exception:  # noqa: BLE001 — a broken
+                        # listener must not kill SLO evaluation
+                        logger.exception(
+                            "SLO verdict listener failed for %s (%s)",
+                            slo, verdict)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _observations(self) -> Dict[str, tuple]:
+        """Per-target ``(observed, overflow)``; overflow marks a
+        +Inf-bucket clamped percentile — a LOWER bound, not a
+        measurement (the diagnosis-verdict discipline)."""
+        obs = self.router.slo_observations()
+        out: Dict[str, tuple] = {
+            SLO_QUEUE_DEPTH: (float(obs.get("queue_depth", 0)), False),
+            SLO_TTFT_P95: (None, False),
+        }
+        counts = obs.get("ttft_counts")
+        bounds = obs.get("ttft_bounds")
+        if counts and bounds:
+            prev = self._prev_ttft_counts
+            self._prev_ttft_counts = list(counts)
+            if prev is not None and len(prev) == len(counts):
+                window = [c - p for c, p in zip(counts, prev)]
+                if any(w > 0 for w in window):
+                    out[SLO_TTFT_P95] = percentile_from_counts(
+                        bounds, window, 0.95, with_overflow=True)
+            elif sum(counts) > 0:
+                # a node's first window is its own window (the
+                # node-series rule)
+                out[SLO_TTFT_P95] = percentile_from_counts(
+                    bounds, counts, 0.95, with_overflow=True)
+        return out
+
+    def evaluate(self, now: Optional[float] = None,
+                 force: bool = False) -> Dict[str, Dict]:
+        """One window tick (no-op inside the window unless forced);
+        returns the active violation verdicts."""
+        if not self.enabled():
+            return {}
+        now = float(now if now is not None else time.monotonic())
+        with self._lock:
+            if not force and now - self._last_eval < self._window:
+                return {k: dict(v) for k, v in self._active.items()}
+            self._last_eval = now
+            observations = self._observations()
+            targets = {}
+            if self._ttft_target > 0:
+                targets[SLO_TTFT_P95] = self._ttft_target
+            if self._queue_target > 0:
+                targets[SLO_QUEUE_DEPTH] = self._queue_target
+            for slo, target in targets.items():
+                observed, overflow = observations.get(slo,
+                                                      (None, False))
+                if observed is None:
+                    # no observations this window (e.g. no completions
+                    # landed a TTFT): neither over nor under — hold the
+                    # counters, the queue-depth target still watches a
+                    # stalled system
+                    continue
+                burn = observed / target
+                if overflow and burn <= 1.0:
+                    # the percentile was CLAMPED at the last finite
+                    # bucket bound: the true value is only known to be
+                    # >= observed, so "under budget" is not concluded
+                    # — an active violation must not count a censored
+                    # window toward recovery (over IS conclusive: a
+                    # lower bound above target is above target)
+                    continue
+                burns = self._burns.setdefault(
+                    slo, collections.deque(maxlen=self._confirm))
+                burns.append(round(burn, 4))
+                if burn > 1.0:
+                    self._over[slo] = self._over.get(slo, 0) + 1
+                    self._under[slo] = 0
+                    if (self._over[slo] >= self._confirm
+                            and slo not in self._active):
+                        self._flag(slo, observed, target, now,
+                                   overflow=overflow)
+                else:
+                    self._under[slo] = self._under.get(slo, 0) + 1
+                    self._over[slo] = 0
+                    if (slo in self._active
+                            and self._under[slo] >= self._confirm):
+                        self._recover(slo, observed, target, now)
+            verdicts = {k: dict(v) for k, v in self._active.items()}
+        self._drain_notices()
+        return verdicts
+
+    def _flag(self, slo: str, observed: float, target: float,
+              now: float, overflow: bool = False) -> None:
+        tid = new_trace_id()
+        evidence = {
+            "slo": slo,
+            "observed": round(observed, 6),
+            "target": target,
+            "burn_rate": round(observed / target, 4),
+            "burn_rates": list(self._burns.get(slo, ())),
+            "confirm_windows": self._over.get(slo, 0),
+            "window_secs": self._window,
+        }
+        if overflow:
+            # histogram-clamped: observed/burn are LOWER bounds
+            evidence["overflow"] = True
+        self._active[slo] = {
+            "trace_id": tid, "since": now, "evidence": evidence,
+        }
+        self._c_violations.inc()
+        get_registry().gauge(
+            tm.SERVE_SLO_BURN_RATE, labels={"slo": slo},
+            help="observed/target per declared serving SLO (>1 = out "
+                 "of SLO)").set(evidence["burn_rate"])
+        emit_event(
+            EventKind.SERVE_SLO_VIOLATION,
+            error_code="SERVE_SLO_VIOLATION",
+            trace_id=tid, **evidence,
+        )
+        logger.warning("serving SLO %s violated [%s]: %s", slo, tid,
+                       evidence)
+        self._pending.append((slo, "violation",
+                              {"trace_id": tid, **evidence}))
+
+    def _recover(self, slo: str, observed: float, target: float,
+                 now: float) -> None:
+        active = self._active.pop(slo)
+        self._c_recoveries.inc()
+        get_registry().gauge(
+            tm.SERVE_SLO_BURN_RATE, labels={"slo": slo}).set(
+                round(observed / target, 4))
+        emit_event(
+            EventKind.SERVE_SLO_RECOVERED,
+            trace_id=active["trace_id"], slo=slo,
+            observed=round(observed, 6), target=target,
+            violated_seconds=round(now - active["since"], 3),
+            confirm_windows=self._under.get(slo, 0),
+        )
+        logger.info("serving SLO %s recovered after %.1fs", slo,
+                    now - active["since"])
+        self._pending.append((
+            slo, "recovered",
+            {"trace_id": active["trace_id"], "slo": slo,
+             "observed": round(observed, 6), "target": target}))
+
+    # -- queries -------------------------------------------------------------
+
+    def verdicts(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._active.items()}
+
+    def report(self) -> Dict:
+        """The ``tpurun serve slo --addr`` payload."""
+        with self._lock:
+            return {
+                "enabled": self.enabled(),
+                "targets": {
+                    SLO_TTFT_P95: self._ttft_target or None,
+                    SLO_QUEUE_DEPTH: self._queue_target or None,
+                },
+                "window_secs": self._window,
+                "confirm_windows": self._confirm,
+                "burn_rates": {k: list(v)
+                               for k, v in self._burns.items()},
+                "verdicts": {k: dict(v)
+                             for k, v in self._active.items()},
+            }
+
+
+class ServingScalePolicy:
+    """Verdict -> proposal: the first queue-depth/SLO-driven serving
+    scale policy. Registered as a listener on the SLO engine; also
+    ``tick()``-ed by the master's stats loop to watch for sustained
+    IDLE slots (the scale-in direction — an SLO can only ask for
+    more)."""
+
+    def __init__(self, slo_engine: ServeSLOEngine, store=None,
+                 auto_scaler=None, apply: Optional[Callable] = None,
+                 cooldown_secs: Optional[float] = None,
+                 idle_windows: Optional[int] = None):
+        from dlrover_tpu.parallel.search import ProposalCooldown
+
+        ctx = get_context()
+        self._engine = slo_engine
+        self._store = store
+        self._auto_scaler = auto_scaler
+        self._apply = apply
+        self._cooldown = ProposalCooldown(float(
+            cooldown_secs if cooldown_secs is not None
+            else getattr(ctx, "serve_scale_cooldown_secs", 120.0)))
+        # consecutive idle ticks before a scale-in proposal (0 = the
+        # scale-in direction is off; knob-table default off)
+        self._idle_windows = int(
+            idle_windows if idle_windows is not None
+            else getattr(ctx, "serve_scale_idle_windows", 0))
+        self._idle_count = 0
+        self.proposals: collections.deque = collections.deque(maxlen=64)
+        self._c_proposals = get_registry().counter(
+            tm.SERVE_SCALE_PROPOSALS,
+            help="SLO/idle-driven serving scale proposals issued")
+        slo_engine.add_verdict_listener(self._on_verdict)
+
+    def attach_auto_scaler(self, auto_scaler) -> None:
+        self._auto_scaler = auto_scaler
+
+    def attach_apply(self, fn: Callable) -> None:
+        """The resize actuator (deployment-specific): called with the
+        proposal dict. Standalone wedges wire it to a serve worker's
+        ``request_resize`` — the existing lease-holding live-resize
+        path; scheduled deployments translate it into a ScalePlan."""
+        self._apply = fn
+
+    def _on_verdict(self, slo: str, verdict: str, info: Dict) -> None:
+        if verdict == "violation":
+            self._propose("scale_out", reason=f"slo:{slo}",
+                          trace_id=info.get("trace_id", ""),
+                          evidence=info)
+        # a recovery needs no proposal: the violated state asked for
+        # capacity, its clearing just stops asking
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Idle watch (the scale-in direction): every serve node's
+        occupancy at 0 and the router queue empty for
+        ``serve_scale_idle_windows`` consecutive ticks proposes a
+        scale-in."""
+        if self._idle_windows <= 0 or self._store is None:
+            return
+        serve_nodes = [
+            s for s in (self._store.latest(nid)
+                        for nid in self._store.node_ids())
+            if s is not None and getattr(s, "node_type", "") == "serve"
+        ]
+        if not serve_nodes:
+            self._idle_count = 0
+            return
+        occupied = any((s.serve_slot_occupancy or 0) > 0
+                       for s in serve_nodes)
+        queued = self._engine.router.queue_depth() > 0
+        if occupied or queued:
+            self._idle_count = 0
+            return
+        self._idle_count += 1
+        if self._idle_count >= self._idle_windows:
+            self._idle_count = 0
+            self._propose("scale_in", reason="idle_slots",
+                          trace_id="",
+                          evidence={"idle_windows": self._idle_windows})
+
+    def _propose(self, direction: str, reason: str, trace_id: str,
+                 evidence: Dict) -> None:
+        key = f"serve_scale|{direction}"
+        if not self._cooldown.check(key):
+            logger.info("serving scale proposal (%s) suppressed by "
+                        "cooldown", direction)
+            return
+        proposal = {
+            "direction": direction,
+            "reason": reason,
+            "trace_id": trace_id,
+            "ts": time.time(),
+            "evidence": {k: v for k, v in (evidence or {}).items()
+                         if k != "trace_id"},
+        }
+        self.proposals.append(proposal)
+        self._c_proposals.inc()
+        emit_event(
+            EventKind.SERVE_SCALE_PROPOSED,
+            trace_id=trace_id or None, direction=direction,
+            reason=reason,
+        )
+        logger.warning("serving scale proposal: %s (%s)", direction,
+                       reason)
+        if self._auto_scaler is not None:
+            try:
+                self._auto_scaler.submit_serving_proposal(proposal)
+            except Exception:  # noqa: BLE001 — the proposal is
+                # recorded either way; the scaler loop must not be
+                # able to kill SLO evaluation
+                logger.exception("auto-scaler rejected serving "
+                                 "proposal")
+        if self._apply is not None:
+            try:
+                self._apply(dict(proposal))
+            except Exception:  # noqa: BLE001 — actuator failures are
+                # the next evaluation window's problem, not this one's
+                logger.exception("serving scale apply failed")
+
+    def to_report(self) -> Dict:
+        return {"proposals": [dict(p) for p in self.proposals]}

@@ -212,6 +212,7 @@ class StragglerDetector:
         mine = self._store.latest(node_id)
         if mine is None or mine.step_p50 is None or mine.window_steps <= 0:
             return
+        workload = getattr(mine, "node_type", "worker")
         peers = []
         peer_fracs = []
         peer_input_fracs = []
@@ -221,6 +222,11 @@ class StragglerDetector:
             s = self._store.latest(nid)
             if (s is None or s.step_p50 is None
                     or now - s.ts > self._freshness):
+                continue
+            if getattr(s, "node_type", "worker") != workload:
+                # a decode worker's step is a different animal from a
+                # train step: peers anchor the median ONLY within the
+                # same workload (serve vs serve, train vs train)
                 continue
             peers.append(s.step_p50)
             if getattr(s, "exposed_comm_frac", None) is not None:
@@ -260,6 +266,16 @@ class StragglerDetector:
             "window_steps": mine.window_steps,
             "overflow": mine.overflow,
         }
+        if workload == "serve":
+            # the serve evidence flavor: the p50s above are DECODE-step
+            # percentiles, and the serving facts say what the slow
+            # decode is starving (tokens/sec, held slots)
+            evidence["workload"] = "serve"
+            if getattr(mine, "serve_tokens_per_s", None) is not None:
+                evidence["tokens_per_s"] = round(
+                    mine.serve_tokens_per_s, 3)
+            if getattr(mine, "serve_slot_occupancy", None) is not None:
+                evidence["slot_occupancy"] = mine.serve_slot_occupancy
         # bound labeling — the WHY behind a slow node, judged in triad
         # order: input-bound, then comm-bound, then compute-bound. A
         # starved input pipeline inflates BOTH the step time and the
@@ -369,6 +385,13 @@ class StragglerDetector:
 
     def _push_verdict(self, node_id: int) -> None:
         if self._speed_monitor is None:
+            return
+        latest = self._store.latest(node_id)
+        if latest is not None and getattr(
+                latest, "node_type", "worker") == "serve":
+            # serve verdicts must not freeze the TRAINING auto-scaler
+            # (it defers while any verdict is active); the SLO policy
+            # loop is the serving actuator
             return
         v = self._verdicts[node_id]
         try:

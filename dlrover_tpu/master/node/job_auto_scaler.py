@@ -46,6 +46,14 @@ class JobAutoScaler:
         self._thread: Optional[threading.Thread] = None
         self._last_plan_time = 0.0
         self.started = False
+        # serving scale proposals from the SLO policy loop (bounded
+        # trail — the operator's `tpurun serve slo` view reads the
+        # policy's copy; this one drives execution)
+        import collections
+
+        self._serving_proposals: "collections.deque" = (
+            collections.deque(maxlen=32))
+        self._serving_apply = None
 
     def start_auto_scaling(self):
         if self.started:
@@ -65,6 +73,35 @@ class JobAutoScaler:
         next optimize_once runs as soon as the loop services the event
         instead of after the remaining scaler period."""
         self._wake.set()
+
+    # -- serving scale (the SLO policy loop's actuator) ----------------------
+
+    def attach_serving_apply(self, fn):
+        """The serving resize actuator: called with each proposal
+        dict. Deployment-specific — a standalone job routes it to the
+        serve worker's ``request_resize`` (the lease-holding live-
+        resize path); a scheduled deployment builds a ScalePlan for
+        the serving replica group."""
+        self._serving_apply = fn
+
+    def submit_serving_proposal(self, proposal: dict):
+        """SLO-policy feed (``ServingScalePolicy``): record the
+        proposal, wake the control loop, and execute through the
+        attached serving actuator. The training optimize path is
+        untouched — serving scale rides the serving live-resize
+        mechanics, not a worker-count plan."""
+        self._serving_proposals.append(dict(proposal))
+        self.request_immediate_evaluation()
+        if self._serving_apply is not None:
+            try:
+                self._serving_apply(dict(proposal))
+            except Exception:  # noqa: BLE001 — a failed actuator is
+                # the next SLO window's problem; the scaler loop and
+                # the proposal trail must survive it
+                logger.exception("serving scale apply failed")
+
+    def serving_proposals(self) -> list:
+        return [dict(p) for p in self._serving_proposals]
 
     def _periodic_optimize(self):
         while not self._stopped.is_set():

@@ -40,6 +40,8 @@ class MasterServicer:
         straggler_detector=None,
         runtime_optimizer=None,
         request_router=None,
+        serve_slo=None,
+        serving_scale_policy=None,
     ):
         from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
         from dlrover_tpu.master.monitor.straggler import StragglerDetector
@@ -85,6 +87,21 @@ class MasterServicer:
         from dlrover_tpu.serving.router import RequestRouter
 
         self.request_router = request_router or RequestRouter()
+        # the serving SLO plane: declared targets evaluated over
+        # rolling windows on the router's live state (the master's
+        # stats loop ticks it), with the scale-policy loop turning
+        # confirmed violations / sustained idle into proposals for the
+        # auto-scaler (attached by the dist master when one exists)
+        from dlrover_tpu.master.monitor.serve_slo import (
+            ServeSLOEngine,
+            ServingScalePolicy,
+        )
+
+        self.serve_slo = serve_slo or ServeSLOEngine(
+            self.request_router, store=self.node_runtime_store)
+        self.serving_scale_policy = (
+            serving_scale_policy or ServingScalePolicy(
+                self.serve_slo, store=self.node_runtime_store))
         # one failure record store: the job manager's when present (its
         # handle_training_failure records there), else our own so the
         # local master can still answer failed-node queries
@@ -122,6 +139,7 @@ class MasterServicer:
             comm.DataShardRequest: self._get_data_report,
             comm.ServeLeaseRequest: self._serve_lease,
             comm.ServeReportRequest: self._get_serve_report,
+            comm.ServeSLORequest: self._get_serve_slo,
         }
         self._report_handlers = {
             comm.DatasetShardParams: self._new_dataset,
@@ -285,6 +303,16 @@ class MasterServicer:
         self.request_router.scan_expired_once()
         return comm.DiagnosisReport(
             report_json=_json.dumps(self.request_router.report()))
+
+    def _get_serve_slo(self, req: comm.ServeSLORequest):
+        """The serving SLO plane (``tpurun serve slo --addr``):
+        declared targets, burn rates, active violation verdicts and
+        the scale proposals the policy loop issued."""
+        import json as _json
+
+        report = self.serve_slo.report()
+        report.update(self.serving_scale_policy.to_report())
+        return comm.DiagnosisReport(report_json=_json.dumps(report))
 
     # -- rendezvous ---------------------------------------------------------
 

@@ -66,15 +66,32 @@ def _serve_main(argv: List[str]) -> int:
 
 def _forensic_report(events_path: str) -> dict:
     from dlrover_tpu.telemetry.events import read_events
+    from dlrover_tpu.telemetry.names import EventKind
 
     records = read_events(events_path)
-    resizes = [r for r in records if r.get("kind") == "serve_resize_done"]
+    resizes = [r for r in records
+               if r.get("kind") == EventKind.SERVE_RESIZE_DONE]
+
+    def count(kind):
+        return sum(1 for r in records if r.get("kind") == kind)
+
     return {
-        "runs": sum(1 for r in records if r.get("kind") == "serve_start"),
+        # the live-vs-forensic agreement contract (the `tpurun data`
+        # gate pattern): these counts must match get_serve_report()'s
+        # ledger after any run whose full timeline is on file
+        "requests": {
+            "submitted": count(EventKind.SERVE_REQUEST_SUBMITTED),
+            # the ROUTER's accepted completions (worker-side DONE
+            # events double on a re-leased twin; the router dedups)
+            "completed": count(EventKind.SERVE_REQUEST_COMPLETED),
+            "evicted": count(EventKind.SERVE_REQUEST_EVICTED),
+            "leases_expired": count(EventKind.SERVE_LEASE_EXPIRED),
+        },
+        "runs": count(EventKind.SERVE_START),
         "completed_runs": [
             {"decode_steps": r.get("decode_steps"),
              "completed": r.get("completed")}
-            for r in records if r.get("kind") == "serve_end"
+            for r in records if r.get("kind") == EventKind.SERVE_END
         ],
         "resizes": [
             {"world_from": r.get("world_from"),
@@ -83,10 +100,8 @@ def _forensic_report(events_path: str) -> dict:
              "recompiled": r.get("recompiled")}
             for r in resizes
         ],
-        "evicted": sum(1 for r in records
-                       if r.get("kind") == "serve_request_evicted"),
-        "leases_expired": sum(1 for r in records
-                              if r.get("kind") == "serve_lease_expired"),
+        "evicted": count(EventKind.SERVE_REQUEST_EVICTED),
+        "leases_expired": count(EventKind.SERVE_LEASE_EXPIRED),
     }
 
 
@@ -135,6 +150,98 @@ def _requests_main(argv: List[str]) -> int:
     return 0
 
 
+def _slo_main(argv: List[str]) -> int:
+    """``tpurun serve slo`` — the serving SLO plane: live (``--addr``:
+    declared targets, burn rates, active verdicts, scale proposals)
+    or forensic (``--events``: the slot-seconds ledger derived from
+    SERVE_END records plus the violation/recovery trail)."""
+    p = argparse.ArgumentParser(
+        prog="tpurun serve slo",
+        description="serving SLO verdicts + the slot-time ledger")
+    p.add_argument("--addr", default="",
+                   help="live view: master address")
+    p.add_argument("--events", default="",
+                   help="forensic view: event-timeline JSONL path")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    if not args.addr and not args.events:
+        print("tpurun serve slo: need --addr or --events",
+              file=sys.stderr)
+        return 2
+    if args.addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(args.addr)
+        report = client.get_serve_slo()
+        client.close()
+    else:
+        from dlrover_tpu.telemetry.events import read_events
+        from dlrover_tpu.telemetry.goodput import derive_slot_ledger
+        from dlrover_tpu.telemetry.names import EventKind
+
+        records = read_events(args.events)
+        report = {
+            "ledger": derive_slot_ledger(records),
+            "violations": [
+                {"slo": r.get("slo"), "observed": r.get("observed"),
+                 "target": r.get("target"),
+                 "burn_rate": r.get("burn_rate"),
+                 "trace_id": r.get("trace_id")}
+                for r in records
+                if r.get("kind") == EventKind.SERVE_SLO_VIOLATION
+            ],
+            "recovered": [
+                {"slo": r.get("slo"),
+                 "violated_seconds": r.get("violated_seconds"),
+                 "trace_id": r.get("trace_id")}
+                for r in records
+                if r.get("kind") == EventKind.SERVE_SLO_RECOVERED
+            ],
+            "scale_proposals": [
+                {"direction": r.get("direction"),
+                 "reason": r.get("reason"),
+                 "trace_id": r.get("trace_id")}
+                for r in records
+                if r.get("kind") == EventKind.SERVE_SCALE_PROPOSED
+            ],
+        }
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+        return 0
+    if args.addr:
+        print("targets: %s (window %ss, confirm %s)" % (
+            report.get("targets"), report.get("window_secs"),
+            report.get("confirm_windows")))
+        verdicts = report.get("verdicts", {})
+        if not verdicts:
+            print("verdicts: none active")
+        for slo, v in verdicts.items():
+            print(f"  VIOLATION {slo}: {v.get('evidence')} "
+                  f"[{v.get('trace_id')}]")
+        for prop in report.get("proposals", []):
+            print(f"  proposal: {prop.get('direction')} "
+                  f"({prop.get('reason')}) [{prop.get('trace_id')}]")
+    else:
+        ledger = report.get("ledger", {})
+        print("slot-seconds ledger (%s runs, %.3f slot-s, coverage "
+              "%s):" % (ledger.get("runs"),
+                        ledger.get("slot_seconds") or 0.0,
+                        ledger.get("coverage")))
+        for cls, row in ledger.get("buckets", {}).items():
+            print(f"  {cls:>14}: {row['seconds']:>10.3f}s "
+                  f"({row['fraction'] * 100:.1f}%)")
+        for v in report.get("violations", []):
+            print(f"  VIOLATION {v['slo']}: observed={v['observed']} "
+                  f"target={v['target']} burn={v['burn_rate']} "
+                  f"[{v['trace_id']}]")
+        for r in report.get("recovered", []):
+            print(f"  recovered {r['slo']} after "
+                  f"{r['violated_seconds']}s [{r['trace_id']}]")
+        for prop in report.get("scale_proposals", []):
+            print(f"  proposal: {prop['direction']} ({prop['reason']})")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
@@ -142,6 +249,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "serve":
+        if rest and rest[0] == "slo":
+            return _slo_main(rest[1:])
         return _serve_main(rest)
     if cmd == "requests":
         return _requests_main(rest)

@@ -21,6 +21,7 @@ step k+1 never waits on step k's host sync.
 from __future__ import annotations
 
 import collections
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -42,6 +43,8 @@ from dlrover_tpu.telemetry import (
     names as tm,
     span,
 )
+from dlrover_tpu.telemetry.metrics import LATENCY_BUCKETS
+from dlrover_tpu.telemetry.trace_context import trace_scope
 
 logger = get_logger("serving.engine")
 
@@ -507,6 +510,11 @@ def _host_zero_cache(spec: KVCacheSpec):
 
 # -- the continuous-batching executor ----------------------------------------
 
+# per-process executor sequence: SERVE_START/END events carry it so
+# the forensic slot-ledger derivation can tell "one executor's
+# cumulative ledger reported twice" from "two executors' ledgers"
+_serve_seq = itertools.count(1)
+
 
 @dataclass
 class ServeRequestState:
@@ -520,6 +528,13 @@ class ServeRequestState:
     generated: List[int] = field(default_factory=list)
     t_admit: float = 0.0
     t_first_token: Optional[float] = None
+    # the per-request trace id minted at Router.submit (or locally for
+    # router-less submissions): every lifecycle event this worker
+    # emits for the request carries it
+    trace_id: str = ""
+    # local-queue submissions stamp their enqueue time so the worker
+    # can report queue-wait without a router (bench/local mode)
+    t_submit: Optional[float] = None
 
 
 @dataclass
@@ -549,7 +564,8 @@ class ServeExecutor:
                  admission: str = "continuous",
                  serve_window: Optional[int] = None,
                  eos_id: int = -1, max_new_default: int = 16,
-                 plan_poll_secs: Optional[float] = None):
+                 plan_poll_secs: Optional[float] = None,
+                 registry=None, report_hook=None):
         from dlrover_tpu.common.config import get_context
 
         ctx = get_context()
@@ -575,11 +591,32 @@ class ServeExecutor:
         self._active = None
         self._resize_devices = None
         self._resize_requested = False
+        self._resize_trace_id = ""
         self._retune_request: Optional[Dict[str, Any]] = None
         self.completed: List[Dict[str, Any]] = []
         self.decode_steps = 0
         self._local_id_seq = 0
-        reg = get_registry()
+        self._serve_seq = next(_serve_seq)
+        # slot-time ledger: every slot-second of the serve loop is
+        # charged to exactly ONE class (decode / prefill /
+        # admitted_idle / vacant / resize_frozen), so the classes sum
+        # to slots x wall by construction — the serving analog of the
+        # goodput partition. Accumulated host-side (plain float adds;
+        # no registry on this path) and emitted on SERVE_END.
+        self._ledger: Dict[str, float] = {
+            k: 0.0 for k in ("decode", "prefill", "admitted_idle",
+                             "vacant", "resize_frozen")}
+        self._ledger_mark: Optional[float] = None
+        self._slot_seconds = 0.0
+        self._serve_wall = 0.0
+        # the ledger is observability, so it pays inside the ≤5%
+        # overhead gate: off with the rest of telemetry (resolved at
+        # construction, the get_registry() discipline)
+        self._ledger_enabled = bool(
+            getattr(ctx, "telemetry_enabled", True))
+        # a test may pass a private registry to simulate several serve
+        # nodes in one process (the NodeRuntimeReportHook discipline)
+        reg = registry if registry is not None else get_registry()
         self._c_tokens = reg.counter(
             tm.SERVE_TOKENS, help="tokens generated by this worker")
         self._c_decode = reg.counter(
@@ -592,13 +629,32 @@ class ServeExecutor:
             tm.SERVE_SLOT_OCCUPANCY,
             help="slots holding a live request, after admission")
         self._h_step = reg.histogram(
-            tm.SERVE_STEP_TIME, help="per-decode-step wall seconds")
+            tm.SERVE_STEP_TIME, buckets=LATENCY_BUCKETS,
+            help="per-decode-step wall seconds")
+        self._h_prefill_e2e = reg.histogram(
+            tm.SERVE_PREFILL_TIME, buckets=LATENCY_BUCKETS,
+            help="admit -> prompt fully prefilled wall seconds")
+        # SLO-plane node reporting: serve workers ride the SAME
+        # NodeRuntimeReport path training workers do, so the master's
+        # /metrics carries {node=} serving gauges and the straggler
+        # detector judges slow decode workers. Auto-wired when the
+        # client can carry it (the executor's NodeRuntimeReportHook
+        # discipline); pass an explicit hook to control cadence.
+        if report_hook is None and router_client is not None and \
+                hasattr(router_client, "report_node_runtime"):
+            from dlrover_tpu.serving.slo import ServeRuntimeReportHook
+
+            report_hook = ServeRuntimeReportHook(
+                router_client, registry=reg)
+        self._report_hook = report_hook or None
 
     # -- local submission (router-less mode / tests) -------------------------
 
     def submit(self, prompt: List[int], max_new_tokens: int = 0,
                request_id: str = "", eos_id: Optional[int] = None):
         """Enqueue a request on the worker-local queue (no router)."""
+        from dlrover_tpu.serving.router import new_request_trace_id
+
         # a monotonic sequence, never derived from queue/completed
         # lengths: those regress when a request is admitted-but-
         # unfinished, and a colliding id breaks the window's owner
@@ -612,14 +668,19 @@ class ServeExecutor:
                                   or self._max_new_default),
             "eos_id": (self._eos_default if eos_id is None
                        else int(eos_id)),
+            "trace_id": new_request_trace_id(),
+            "submit_ts": time.monotonic(),
         })
         return rid
 
     # -- elasticity hooks ----------------------------------------------------
 
-    def request_resize(self, devices=None):
+    def request_resize(self, devices=None, trace_id: str = ""):
+        """``trace_id`` threads the incident that caused the resize
+        (an SLO scale proposal) onto the SERVE_RESIZE_* events."""
         self._resize_devices = (list(devices)
                                 if devices is not None else None)
+        self._resize_trace_id = str(trace_id or "")
         self._resize_requested = True
 
     def request_retune(self, serve_slots: Optional[int] = None,
@@ -690,6 +751,8 @@ class ServeExecutor:
                 max_new_tokens=int(req.get("max_new_tokens")
                                    or self._max_new_default),
                 eos_id=int(req.get("eos_id", self._eos_default)),
+                trace_id=str(req.get("trace_id", "") or ""),
+                t_submit=req.get("submit_ts"),
                 t_admit=time.monotonic(),
             )
             if len(state.prompt) + state.max_new_tokens > max_seq:
@@ -700,6 +763,7 @@ class ServeExecutor:
                 emit_event(
                     EventKind.SERVE_REQUEST_EVICTED,
                     error_code="SERVE_REQUEST_EVICTED",
+                    trace_id=state.trace_id,
                     request_id=state.request_id,
                     prompt_tokens=len(state.prompt),
                     max_seq=max_seq,
@@ -729,18 +793,34 @@ class ServeExecutor:
             n_valid = len(chunk)
             padded = np.zeros((c,), np.int32)
             padded[:n_valid] = chunk
-            self._engine.cache, last_logits = program.prefill(
-                self._engine.params, self._engine.cache,
-                jnp.asarray(padded), jnp.int32(slot),
-                jnp.int32(state.cursor), jnp.int32(n_valid))
+            with span(SpanName.SERVE_PREFILL, slot=slot):
+                self._engine.cache, last_logits = program.prefill(
+                    self._engine.params, self._engine.cache,
+                    jnp.asarray(padded), jnp.int32(slot),
+                    jnp.int32(state.cursor), jnp.int32(n_valid))
             self._c_prefill.inc()
             state.cursor += n_valid
+            emit_event(
+                EventKind.SERVE_PREFILL_CHUNK,
+                trace_id=state.trace_id, request_id=state.request_id,
+                slot=slot, cursor=state.cursor,
+                prompt_tokens=len(state.prompt),
+            )
             if state.cursor >= len(state.prompt):
                 # final chunk: its last logits seed the first token —
                 # the one host sync admission pays (TTFT is measured
                 # here, which is exactly what it means)
                 first = int(np.argmax(jax.device_get(last_logits)))
                 state.t_first_token = time.monotonic()
+                self._h_prefill_e2e.observe(
+                    state.t_first_token - state.t_admit)
+                emit_event(
+                    EventKind.SERVE_FIRST_TOKEN,
+                    trace_id=state.trace_id,
+                    request_id=state.request_id, slot=slot,
+                    ttft_s=round(state.t_first_token - state.t_admit,
+                                 6),
+                )
                 state.generated.append(first)
                 self._tokens = self._tokens.at[slot].set(first)
                 if self._finished(state):
@@ -765,11 +845,32 @@ class ServeExecutor:
             "e2e_s": round(now - state.t_admit, 6),
             "error_code": error_code,
         }
+        emit_event(
+            EventKind.SERVE_REQUEST_DONE,
+            trace_id=state.trace_id, request_id=state.request_id,
+            tokens=len(state.generated), ttft_s=record["ttft_s"],
+            e2e_s=record["e2e_s"],
+            done_error_code=error_code or None,
+        )
+        # local-queue submissions see their queue wait here (the
+        # router measures its own at lease time)
+        if state.t_submit is not None:
+            record["queue_wait_s"] = round(
+                state.t_admit - state.t_submit, 6)
         self.completed.append(record)
         self._c_tokens.inc(len(state.generated))
         if self._client is not None:
+            wire = {k: v for k, v in record.items()
+                    if k != "queue_wait_s"}
             try:
-                self._client.serve_complete(**record)
+                # the request's trace id rides the gRPC metadata
+                # channel, so the router's ingress-side events (the
+                # completion record) join the request's lane
+                if state.trace_id:
+                    with trace_scope(state.trace_id):
+                        self._client.serve_complete(**wire)
+                else:
+                    self._client.serve_complete(**wire)
             except Exception:  # noqa: BLE001 — the router re-leases on
                 # lease timeout; a lost completion is re-served, never
                 # silently dropped
@@ -784,6 +885,59 @@ class ServeExecutor:
         self._active_host[slot] = False
         self._active = jnp.asarray(self._active_host)
         self._complete(state)
+
+    # -- slot-time ledger ----------------------------------------------------
+
+    def _classify(self) -> List[str]:
+        """Per-slot ledger class under the CURRENT host state."""
+        out = []
+        for i, state in enumerate(self._slots):
+            if state is None:
+                out.append("vacant")
+            elif self._active_host[i]:
+                out.append("decode")
+            elif state.cursor < len(state.prompt):
+                out.append("prefill")
+            else:
+                # admitted, prompt prefilled, but not decoding — the
+                # finish-detection lag / pre-activation gap
+                out.append("admitted_idle")
+        return out
+
+    def _charge_slots(self, now: float, override: Optional[str] = None,
+                      classes: Optional[List[str]] = None):
+        """Charge the wall time since the previous mark to the ledger:
+        ``dt`` per slot, each slot to exactly one class. ``override``
+        charges every slot (the resize/retune freeze); ``classes`` is
+        a pre-captured per-slot classification (the prefill interval
+        classifies by the state that held DURING it, not the state the
+        tick left behind). Classes sum to ∫slots·dt by construction."""
+        if not self._ledger_enabled:
+            return
+        mark = self._ledger_mark
+        self._ledger_mark = now
+        if mark is None:
+            return
+        dt = now - mark
+        if dt <= 0 or not self._slots:
+            return
+        self._slot_seconds += dt * len(self._slots)
+        if override is not None:
+            self._ledger[override] += dt * len(self._slots)
+            return
+        if classes is None or len(classes) != len(self._slots):
+            classes = self._classify()
+        for cls in classes:
+            self._ledger[cls] += dt
+
+    def slot_ledger(self) -> Dict[str, float]:
+        """The accumulated slot-seconds partition plus its invariant
+        total (``slot_seconds`` = ∫slots·dt charged so far; the sum of
+        the classes, exactly) and the serve-loop wall it partitions."""
+        out = {k: round(v, 6) for k, v in self._ledger.items()}
+        out["slot_seconds"] = round(self._slot_seconds, 6)
+        out["serve_wall_s"] = round(self._serve_wall, 6)
+        return out
 
     def _materialize_oldest(self):
         import jax
@@ -808,11 +962,19 @@ class ServeExecutor:
         self._resize_requested = False
         devices = self._resize_devices
         self._resize_devices = None
+        trace_id = self._resize_trace_id
+        self._resize_trace_id = ""
         import jax
 
         tokens_host = np.asarray(jax.device_get(self._tokens))
         active_host = list(self._active_host)
-        self._engine.live_resize(devices, reason="executor")
+        if trace_id:
+            # the SERVE_RESIZE_* events join the incident (SLO scale
+            # proposal) that asked for the resize
+            with trace_scope(trace_id):
+                self._engine.live_resize(devices, reason="executor")
+        else:
+            self._engine.live_resize(devices, reason="executor")
         import jax.numpy as jnp
 
         self._tokens = jnp.asarray(tokens_host)
@@ -993,10 +1155,17 @@ class ServeExecutor:
         emit_event(EventKind.SERVE_START,
                    slots=self._engine.program.spec.num_slots,
                    prefill_chunk=self._engine.program.prefill_chunk,
-                   kv_precision=self._engine.program.spec.precision)
+                   kv_precision=self._engine.program.spec.precision,
+                   serve_seq=self._serve_seq)
         steps = 0
         idle_polls = 0
+        loop_start = time.monotonic()
+        self._ledger_mark = loop_start
         while True:
+            # charge the elapsed interval to the ledger under the slot
+            # states the PREVIOUS iteration left (the states that held
+            # while its decode dispatch / materialization ran)
+            self._charge_slots(time.monotonic())
             if self._resize_requested or self._retune_request is not None:
                 self._drain_window()
                 if self._resize_requested:
@@ -1004,9 +1173,20 @@ class ServeExecutor:
                     self._report_config()
                 if self._retune_request is not None:
                     self._apply_retune()
+                # the drain + apply froze every slot: no decode or
+                # prefill could run, whatever state the slots hold
+                self._charge_slots(time.monotonic(),
+                                   override="resize_frozen")
             self._poll_plan()
             self._admit()
+            # the admission + prefill interval classifies by the state
+            # that holds DURING it: a slot whose final chunk lands this
+            # tick flips to decoding, and charging by the post-tick
+            # state would fold every prefill second into decode
+            pre_classes = (self._classify() if self._ledger_enabled
+                           else None)
             self._prefill_tick()
+            self._charge_slots(time.monotonic(), classes=pre_classes)
             self._touch()
             if not any(self._active_host):
                 # nothing decoding: drain stragglers, then either a
@@ -1032,10 +1212,11 @@ class ServeExecutor:
                 i: r.request_id for i, r in enumerate(self._slots)
                 if r is not None and self._active_host[i]
             }
-            next_tokens, _logits, self._engine.cache = (
-                self._engine.program.decode(
-                    self._engine.params, self._engine.cache,
-                    self._tokens, self._active))
+            with span(SpanName.SERVE_DECODE, step=self.decode_steps):
+                next_tokens, _logits, self._engine.cache = (
+                    self._engine.program.decode(
+                        self._engine.params, self._engine.cache,
+                        self._tokens, self._active))
             self._tokens = next_tokens
             self._c_decode.inc()
             self.decode_steps += 1
@@ -1045,10 +1226,37 @@ class ServeExecutor:
             while len(self._window) > self._window_cap:
                 self._materialize_oldest()
             self._h_step.observe(time.monotonic() - t0)
+            if self._report_hook is not None:
+                try:
+                    self._report_hook.after_step(
+                        self.decode_steps,
+                        queue_len=len(self._local_queue),
+                        slots=len(self._slots))
+                except Exception:  # noqa: BLE001 — reporting must
+                    # never take the decode loop down
+                    logger.debug("serve runtime report hook failed",
+                                 exc_info=True)
             if max_steps and steps >= max_steps:
                 self._drain_window()
                 break
         self._drain_window()
+        now = time.monotonic()
+        self._charge_slots(now)
+        self._serve_wall += now - loop_start
         emit_event(EventKind.SERVE_END, decode_steps=self.decode_steps,
-                   completed=len(self.completed))
+                   completed=len(self.completed),
+                   slots=len(self._slots),
+                   serve_seq=self._serve_seq,
+                   slot_ledger={k: round(v, 6)
+                                for k, v in self._ledger.items()},
+                   slot_seconds=round(self._slot_seconds, 6),
+                   serve_wall_s=round(self._serve_wall, 6))
+        if self._report_hook is not None:
+            try:
+                self._report_hook.flush(
+                    queue_len=len(self._local_queue),
+                    slots=len(self._slots))
+            except Exception:  # noqa: BLE001 — best-effort final push
+                logger.debug("serve runtime report flush failed",
+                             exc_info=True)
         return list(self.completed)

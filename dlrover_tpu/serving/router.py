@@ -33,14 +33,25 @@ from typing import Any, Dict, List, Optional
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.telemetry import (
     EventKind,
+    SpanName,
     emit_event,
     get_registry,
     names as tm,
+    span,
 )
+from dlrover_tpu.telemetry.metrics import COUNT_BUCKETS, LATENCY_BUCKETS
+from dlrover_tpu.telemetry.trace_context import new_trace_id
 
 logger = get_logger("serving.router")
 
 _id_seq = itertools.count()
+
+
+def new_request_trace_id() -> str:
+    """A per-request trace id, minted at submission: every lifecycle
+    event of the request (router AND worker pids) carries it, so
+    ``tpurun trace --events`` stitches one lane per request."""
+    return "req-" + new_trace_id()[len("inc-"):]
 
 
 @dataclass
@@ -51,8 +62,12 @@ class ServeRequest:
     eos_id: int = -1
     state: str = "queued"  # queued | leased | done
     node_id: int = -1
+    trace_id: str = ""
     enqueue_ts: float = 0.0
     lease_ts: float = 0.0
+    # when an expiry re-queued the request: queue-wait of the NEXT
+    # lease is measured from here, not from the original enqueue
+    requeue_ts: float = 0.0
     first_lease_ts: float = 0.0
     done_ts: float = 0.0
     releases: int = 0
@@ -67,6 +82,7 @@ class ServeRequest:
             "prompt": list(self.prompt),
             "max_new_tokens": self.max_new_tokens,
             "eos_id": self.eos_id,
+            "trace_id": self.trace_id,
         }
 
 
@@ -88,6 +104,11 @@ class RequestRouter:
         self._n_completed = 0
         self._n_dropped = 0
         self._n_expired = 0
+        # completions that carried the eviction error code (the
+        # worker could not fit the request): counted so the live
+        # ledger and the forensic --events view agree on all four of
+        # submitted/completed/evicted/expired
+        self._n_evicted = 0
         # bounded done-ledger: a long-lived serving master must not
         # retain every completed request's prompt+tokens forever (the
         # decision-trail deque precedent) — completion order, oldest
@@ -118,11 +139,19 @@ class RequestRouter:
         self._g_leased = reg.gauge(
             tm.SERVE_REQUESTS_LEASED, help="requests leased to workers")
         self._h_ttft = reg.histogram(
-            tm.SERVE_TTFT_TIME, help="admit -> first token wall seconds")
+            tm.SERVE_TTFT_TIME, buckets=LATENCY_BUCKETS,
+            help="admit -> first token wall seconds")
         self._h_e2e = reg.histogram(
-            tm.SERVE_E2E_TIME, help="admit -> completion wall seconds")
+            tm.SERVE_E2E_TIME, buckets=LATENCY_BUCKETS,
+            help="admit -> completion wall seconds")
+        self._h_queue_wait = reg.histogram(
+            tm.SERVE_QUEUE_WAIT_TIME, buckets=LATENCY_BUCKETS,
+            help="enqueue (or re-queue) -> lease wall seconds")
+        self._h_tpot = reg.histogram(
+            tm.SERVE_TPOT_TIME, buckets=LATENCY_BUCKETS,
+            help="inter-token seconds: (e2e - ttft) / (tokens - 1)")
         self._h_tokens = reg.histogram(
-            tm.SERVE_TOKENS_PER_REQUEST,
+            tm.SERVE_TOKENS_PER_REQUEST, buckets=COUNT_BUCKETS,
             help="tokens generated per completed request")
 
     # -- the three verbs -----------------------------------------------------
@@ -137,6 +166,7 @@ class RequestRouter:
             req = ServeRequest(
                 request_id=rid, prompt=[int(t) for t in prompt],
                 max_new_tokens=int(max_new_tokens), eos_id=int(eos_id),
+                trace_id=new_request_trace_id(),
                 enqueue_ts=time.time(),
             )
             self._requests[rid] = req
@@ -145,12 +175,19 @@ class RequestRouter:
             self._n_submitted += 1
             self._c_submitted.inc()
             self._refresh_gauges()
+            emit_event(
+                EventKind.SERVE_REQUEST_SUBMITTED,
+                trace_id=req.trace_id, request_id=rid,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens,
+            )
             return rid
 
     def lease(self, node_id: int, max_requests: int) -> List[Dict]:
         self.scan_expired_once()
         out = []
-        with self._lock:
+        leased_meta = []
+        with self._lock, span(SpanName.SERVE_LEASE, node=int(node_id)):
             now = time.time()
             self._node_touch[int(node_id)] = now
             while self._queue and len(out) < max(0, int(max_requests)):
@@ -162,16 +199,29 @@ class RequestRouter:
                 req.lease_ts = now
                 if not req.first_lease_ts:
                     req.first_lease_ts = now
+                wait = max(0.0, now - (req.requeue_ts
+                                       or req.enqueue_ts))
+                self._h_queue_wait.observe(wait)
+                leased_meta.append((req.trace_id, req.request_id,
+                                    req.releases, wait))
                 out.append(req.wire())
             if out:
                 self._refresh_gauges()
+        for tid, rid, releases, wait in leased_meta:
+            emit_event(
+                EventKind.SERVE_REQUEST_LEASED,
+                trace_id=tid, request_id=rid, lease_node=int(node_id),
+                queue_wait_s=round(wait, 6),
+                releases=releases,
+            )
         return out
 
     def complete(self, node_id: int, request_id: str,
                  tokens: List[int], ttft_s: Optional[float] = None,
                  e2e_s: Optional[float] = None,
                  error_code: str = "") -> bool:
-        with self._lock:
+        with self._lock, span(SpanName.SERVE_COMPLETE,
+                              node=int(node_id)):
             self._node_touch[int(node_id)] = time.time()
             req = self._requests.get(request_id)
             if req is None or req.state == "done":
@@ -194,18 +244,36 @@ class RequestRouter:
             req.ttft_s, req.e2e_s = ttft_s, e2e_s
             req.error_code = error_code or ""
             self._n_completed += 1
+            if error_code == "SERVE_REQUEST_EVICTED":
+                self._n_evicted += 1
             self._done_order.append(req.request_id)
             while len(self._done_order) > self._done_retention_cap:
                 if self._requests.pop(self._done_order.popleft(),
                                       None) is not None:
                     self._live_counts["done"] -= 1
             self._c_completed.inc()
+            tpot = None
             if ttft_s is not None:
                 self._h_ttft.observe(float(ttft_s))
             if e2e_s is not None:
                 self._h_e2e.observe(float(e2e_s))
+                if ttft_s is not None and len(req.tokens) > 1:
+                    # the decode-phase inter-token latency: the TTFT
+                    # (queue + prefill + first token) is subtracted so
+                    # TPOT judges ONLY the steady decode stream
+                    tpot = max(0.0, (float(e2e_s) - float(ttft_s))
+                               / (len(req.tokens) - 1))
+                    self._h_tpot.observe(tpot)
             self._h_tokens.observe(float(len(req.tokens)))
             self._refresh_gauges()
+            emit_event(
+                EventKind.SERVE_REQUEST_COMPLETED,
+                trace_id=req.trace_id, request_id=request_id,
+                complete_node=int(node_id), tokens=len(req.tokens),
+                ttft_s=ttft_s, e2e_s=e2e_s,
+                tpot_s=round(tpot, 6) if tpot is not None else None,
+                completed_error_code=error_code or None,
+            )
             return True
 
     def touch(self, node_id: int):
@@ -238,6 +306,7 @@ class RequestRouter:
                 self._live_counts["leased"] -= 1
                 self._live_counts["queued"] += 1
                 req.releases += 1
+                req.requeue_ts = now
                 stranded_node = req.node_id
                 req.node_id = -1
                 self._queue.append(req)
@@ -247,6 +316,7 @@ class RequestRouter:
                 emit_event(
                     EventKind.SERVE_LEASE_EXPIRED,
                     error_code="SERVE_LEASE_EXPIRED",
+                    trace_id=req.trace_id,
                     request_id=req.request_id,
                     stranded_node=stranded_node,
                     lease_age_s=round(now - last, 1),
@@ -281,6 +351,26 @@ class RequestRouter:
 
     def dropped(self) -> int:
         return self._n_dropped
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._live_counts["queued"]
+
+    def slo_observations(self) -> Dict[str, Any]:
+        """The SLO engine's per-evaluation snapshot: current queue
+        depth plus the CUMULATIVE TTFT histogram counts (the engine
+        diffs consecutive snapshots into rolling-window percentiles —
+        the node-series discipline)."""
+        with self._lock:
+            counts = self._h_ttft.snapshot_counts()
+            return {
+                "queue_depth": self._live_counts["queued"],
+                "leased": self._live_counts["leased"],
+                "ttft_bounds": list(getattr(self._h_ttft, "bounds",
+                                            ()) or ()),
+                "ttft_counts": (list(counts)
+                                if counts is not None else None),
+            }
 
     def report(self) -> Dict[str, Any]:
         """The ``tpurun requests`` ledger."""
@@ -319,6 +409,7 @@ class RequestRouter:
                     "completed": self._n_completed,
                     "dropped": self._n_dropped,
                     "leases_expired": self._n_expired,
+                    "evicted": self._n_evicted,
                     # a live-but-stuck worker keeps touching, so its
                     # lease never expires: the age of the OLDEST open
                     # lease is the operator's visibility into that
@@ -330,6 +421,10 @@ class RequestRouter:
                     "ttft_p95_s": pct(self._h_ttft, 0.95),
                     "e2e_p50_s": pct(self._h_e2e, 0.50),
                     "e2e_p95_s": pct(self._h_e2e, 0.95),
+                    "queue_wait_p50_s": pct(self._h_queue_wait, 0.50),
+                    "queue_wait_p95_s": pct(self._h_queue_wait, 0.95),
+                    "tpot_p50_s": pct(self._h_tpot, 0.50),
+                    "tpot_p95_s": pct(self._h_tpot, 0.95),
                 },
                 "nodes": {str(n): v
                           for n, v in sorted(per_node.items())},

@@ -1,0 +1,163 @@
+"""Worker-side serving SLO plane: the node-report hook.
+
+``ServeRuntimeReportHook`` is the serving twin of the trainer's
+``NodeRuntimeReportHook`` (PR 6): it pushes node-tagged snapshots of
+the serve worker's instruments — cumulative decode-step histogram
+bucket counts, tokens/decode-step totals, slot occupancy, local queue
+depth — through the SAME ``comm.NodeRuntimeReport`` path, with
+``node_type="serve"``. The master's node-series store diffs them into
+windowed per-node samples, exports ``{node=}``-labeled serving gauges
+on ``/metrics``, and the straggler detector judges slow DECODE workers
+against their serve peers exactly as it judges training stragglers
+(evidence carries ``workload: serve``).
+
+Discipline carried over verbatim from the training hook: the decode
+loop only snapshots and enqueues; the RPC and the ``/proc`` RSS read
+run on a background daemon sender thread; backpressure drops the
+report (the next cadence supersedes it); and the send rate is floored
+by wall time so a fast decode loop cannot flood the master.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import get_registry, names as tm
+from dlrover_tpu.telemetry.metrics import LATENCY_BUCKETS
+
+logger = get_logger("serving.slo")
+
+
+class ServeRuntimeReportHook:
+    """Push serve-worker runtime snapshots to the master every
+    ``every_steps`` decode steps, wall-time-floored by
+    ``min_interval_s`` (default: the master's
+    ``seconds_interval_to_report``)."""
+
+    def __init__(self, master_client, every_steps: Optional[int] = None,
+                 registry=None, min_interval_s: Optional[float] = None):
+        import queue
+
+        ctx = get_context()
+        self._client = master_client
+        self._every = int(
+            every_steps if every_steps is not None
+            else getattr(ctx, "runtime_report_steps", 32))
+        self._min_interval = float(
+            min_interval_s if min_interval_s is not None
+            else getattr(ctx, "seconds_interval_to_report", 15))
+        self._last_send = 0.0
+        # 0.0, not a -1 sentinel: a run with ZERO decode steps must
+        # also skip the flush (an all-zero report is exactly the
+        # empty-window sample the flush guard exists to avoid)
+        self._last_steps_sent = 0.0
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._sender: Optional[threading.Thread] = None
+        reg = registry if registry is not None else get_registry()
+        self._reg = reg
+        self._h_step = reg.histogram(
+            tm.SERVE_STEP_TIME, buckets=LATENCY_BUCKETS)
+        self._c_decode = reg.counter(tm.SERVE_DECODE_STEPS)
+        self._c_tokens = reg.counter(tm.SERVE_TOKENS)
+        self._g_occupancy = reg.gauge(tm.SERVE_SLOT_OCCUPANCY)
+        self._c_sent = get_registry().counter(
+            tm.NODE_RUNTIME_REPORTS,
+            help="node runtime snapshots pushed to the master")
+        self._c_failed = get_registry().counter(
+            tm.NODE_RUNTIME_REPORT_FAILURES,
+            help="runtime snapshots the master never acked")
+
+    def after_step(self, step: int, queue_len: int = 0,
+                   slots: int = 0) -> None:
+        """Called by the executor after each decode step; snapshots
+        and enqueues at the configured cadence."""
+        if self._every <= 0 or step % self._every:
+            return
+        now = time.monotonic()
+        if now - self._last_send < self._min_interval:
+            return
+        self._last_send = now
+        self._enqueue(step, queue_len, slots)
+
+    def flush(self, queue_len: int = 0, slots: int = 0) -> None:
+        """One final snapshot regardless of cadence (SERVE_END) — but
+        ONLY when steps landed since the last send: a zero-window
+        report would become the node's latest sample with p50=None,
+        and a peer whose latest window is empty can no longer anchor
+        the straggler median. Then stop the sender after the queue
+        drains (bounded join — exit must not hang on a dead master)."""
+        if self._every > 0 and \
+                float(self._c_decode.value) != self._last_steps_sent:
+            self._enqueue(int(self._c_decode.value), queue_len, slots)
+        if self._sender is None or not self._sender.is_alive():
+            return
+        try:
+            self._queue.put_nowait(None)
+        except Exception:  # noqa: BLE001 — full queue: sender is wedged
+            logger.debug("serve report queue full at flush",
+                         exc_info=True)
+            return
+        self._sender.join(timeout=5.0)
+        self._sender = None
+
+    def _enqueue(self, step: int, queue_len: int, slots: int) -> None:
+        import queue
+
+        bounds = getattr(self._h_step, "bounds", None)  # null when off
+        counts = self._h_step.snapshot_counts()
+        self._last_steps_sent = float(self._c_decode.value)
+        payload = dict(
+            node_type="serve",
+            step=int(step),
+            steps_total=float(self._c_decode.value),
+            bounds=list(bounds) if bounds else None,
+            step_time_counts=list(counts) if counts else None,
+            serve_tokens_total=float(self._c_tokens.value),
+            serve_queue_len=float(queue_len),
+            serve_slot_occupancy=float(self._g_occupancy.value),
+            serve_slots=float(slots),
+        )
+        if self._sender is None or not self._sender.is_alive():
+            self._sender = threading.Thread(
+                target=self._send_loop, name="serve-runtime-report",
+                daemon=True,
+            )
+            self._sender.start()
+        try:
+            self._queue.put_nowait(payload)
+        except queue.Full:
+            # sender is behind (slow/dead master): drop — the next
+            # cadence's cumulative snapshot supersedes this one
+            self._c_failed.inc()
+
+    def _rss_mb(self) -> float:
+        try:
+            import psutil
+
+            return psutil.Process().memory_info().rss / (1024 * 1024)
+        except Exception:  # noqa: BLE001 — psutil-less hosts
+            logger.debug("psutil rss read failed; using getrusage",
+                         exc_info=True)
+            import resource
+
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+    def _send_loop(self):
+        while True:
+            payload = self._queue.get()
+            if payload is None:
+                return
+            try:
+                payload["rss_mb"] = round(self._rss_mb(), 1)
+                self._client.report_node_runtime(**payload)
+                self._c_sent.inc()
+            except Exception:  # noqa: BLE001 — a dead master must not
+                # kill the decode loop; the gap is counted
+                self._c_failed.inc()
+                logger.debug("serve runtime report failed",
+                             exc_info=True)

@@ -27,10 +27,27 @@ import os
 from typing import Dict, List, Optional
 
 from dlrover_tpu.telemetry.mttr import derive_incidents
+from dlrover_tpu.telemetry.names import EventKind
 
 # Perfetto wants process-scoped ids; the synthetic incident track uses
 # a pid real processes cannot take
 INCIDENT_TRACK_PID = 0
+# synthetic per-request track: one tid ROW per serve request (its
+# lifecycle span from submit to completion), so the serving view reads
+# as one Perfetto lane per request with the flow arrows of its
+# trace_id pointing at the real router/worker pid events
+REQUEST_TRACK_PID = -1
+
+_SERVE_REQUEST_KINDS = {
+    EventKind.SERVE_REQUEST_SUBMITTED,
+    EventKind.SERVE_REQUEST_LEASED,
+    EventKind.SERVE_PREFILL_CHUNK,
+    EventKind.SERVE_FIRST_TOKEN,
+    EventKind.SERVE_REQUEST_DONE,
+    EventKind.SERVE_REQUEST_COMPLETED,
+    EventKind.SERVE_REQUEST_EVICTED,
+    EventKind.SERVE_LEASE_EXPIRED,
+}
 
 
 def merged_trace_events(events: List[Dict]) -> List[Dict]:
@@ -57,6 +74,37 @@ def merged_trace_events(events: List[Dict]) -> List[Dict]:
         tid = rec.get("trace_id")
         if tid:
             flows.setdefault(tid, []).append(ev)
+
+    # per-request lanes: each request trace id whose lifecycle events
+    # appear in the timeline becomes one complete-event span (first ->
+    # last lifecycle event) on its own tid row of the request track
+    request_rows: Dict[str, List[Dict]] = {}
+    for rec in ordered:
+        if rec.get("kind") in _SERVE_REQUEST_KINDS and \
+                rec.get("trace_id"):
+            request_rows.setdefault(rec["trace_id"], []).append(rec)
+    if request_rows:
+        seen_pids[REQUEST_TRACK_PID] = "serve requests"
+    for row, (tid_key, chain) in enumerate(sorted(
+            request_rows.items(),
+            key=lambda kv: kv[1][0].get("ts", 0.0))):
+        t0 = chain[0].get("ts", 0.0)
+        t1 = chain[-1].get("ts", t0)
+        pids = sorted({int(r.get("pid", 0) or 0) for r in chain})
+        out.append({
+            "name": str(chain[0].get("request_id", tid_key)),
+            "cat": "serve_request",
+            "ph": "X",
+            "ts": int(t0 * 1e6),
+            "dur": max(1, int((t1 - t0) * 1e6)),
+            "pid": REQUEST_TRACK_PID,
+            "tid": row,
+            "args": {
+                "trace_id": tid_key,
+                "lifecycle": [r.get("kind") for r in chain],
+                "pids": pids,
+            },
+        })
 
     # incident spans (downtime bars) on the synthetic track
     seen_pids[INCIDENT_TRACK_PID] = "incidents"

@@ -41,7 +41,10 @@ from dlrover_tpu.telemetry.mttr import derive_incidents
 from dlrover_tpu.telemetry.names import EventKind
 
 # highest priority first: an instant of wall time goes to the FIRST
-# bucket that claims it
+# bucket that claims it. serving_scale sits LAST: an SLO violation is
+# degraded-but-alive operation, not downtime — it claims only time no
+# training/recovery bucket owns (on a pure serving timeline, the
+# otherwise-idle window between violation and recovery).
 BUCKET_PRIORITY = (
     "restart",
     "reshard",
@@ -52,6 +55,7 @@ BUCKET_PRIORITY = (
     "checkpoint",
     "compile",
     "productive_step",
+    "serving_scale",
 )
 IDLE = "idle"
 
@@ -66,6 +70,9 @@ _SCENARIO_BUCKET = {
     "replan": "replan",
     "nonfinite_rollback": "rollback",
     "preemption_drain": "preempt_drain",
+    # a serving SLO violation burning until its recovery (degraded-
+    # but-alive; lowest priority — see BUCKET_PRIORITY)
+    "serving_scale": "serving_scale",
 }
 
 _FAILURE_EDGES = {EventKind.WORKER_FAILED, EventKind.HANG_DETECTED}
@@ -205,6 +212,77 @@ def _input_wait_column(ordered: List[Dict],
         "fraction_of_productive": (
             round(total / productive_s, 4) if productive_s > 0 else 0.0
         ),
+    }
+
+
+# slot-ledger classes in display order (the serving analog of
+# BUCKET_PRIORITY — the executor charges every slot-second to exactly
+# one of these, so they sum to slots x wall by construction)
+SLOT_LEDGER_CLASSES = (
+    "decode", "prefill", "admitted_idle", "vacant", "resize_frozen",
+)
+
+
+def derive_slot_ledger(events: List[Dict]) -> Dict:
+    """The serving slot-seconds partition, derived from the
+    cumulative ledger each serve run stamps on its SERVE_END event
+    (the goodput-ledger discipline: the artifact is DERIVED from the
+    production timeline, never hand-assembled). Aggregates across
+    every serve run in the timeline; ``coverage`` quotes
+    sum(classes)/slot_seconds, which is 1.0 up to float rounding by
+    construction."""
+    runs = []
+    for rec in sorted(events, key=lambda r: r.get("ts", 0.0)):
+        if rec.get("kind") != EventKind.SERVE_END:
+            continue
+        ledger = rec.get("slot_ledger")
+        if not isinstance(ledger, dict):
+            continue  # pre-SLO-plane timelines carry no ledger
+        runs.append(rec)
+    if not runs:
+        return {
+            "metric": "serve_slot_seconds",
+            "runs": 0,
+            "slot_seconds": 0.0,
+            "buckets": {},
+            "error": "no SERVE_END ledger records in the timeline",
+        }
+    seconds = {k: 0.0 for k in SLOT_LEDGER_CLASSES}
+    slot_seconds = 0.0
+    # ledgers are CUMULATIVE per executor (serve_seq identifies one
+    # executor's loop within a process): the last SERVE_END of each
+    # executor supersedes its earlier ones; distinct executors sum
+    latest: Dict = {}
+    for rec in runs:
+        latest[(str(rec.get("node", "")), rec.get("pid", 0),
+                rec.get("serve_seq", 0))] = rec
+    for rec in latest.values():
+        for k, v in rec["slot_ledger"].items():
+            if k in seconds:
+                try:
+                    seconds[k] += float(v)
+                except (TypeError, ValueError):
+                    continue
+        try:
+            slot_seconds += float(rec.get("slot_seconds", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            pass
+    covered = sum(seconds.values())
+    return {
+        "metric": "serve_slot_seconds",
+        "runs": len(latest),
+        "slot_seconds": round(slot_seconds, 3),
+        "buckets": {
+            k: {
+                "seconds": round(v, 3),
+                "fraction": (round(v / slot_seconds, 4)
+                             if slot_seconds > 0 else 0.0),
+            }
+            for k, v in seconds.items()
+        },
+        "coverage": (round(covered / slot_seconds, 4)
+                     if slot_seconds > 0 else 0.0),
+        "source": "event_timeline",
     }
 
 

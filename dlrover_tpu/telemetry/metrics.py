@@ -33,6 +33,23 @@ DURATION_BUCKETS: Tuple[float, ...] = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+# Serving-latency buckets (seconds): 50µs .. 30s. Decode steps and
+# TPOT sit at sub-ms to ~10ms — on DURATION_BUCKETS everything below
+# 0.5ms collapses into the first bucket and the interpolated
+# percentiles are fiction at exactly the scale an SLO judges.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+# Count-valued buckets (tokens per request, items per batch): a COUNT
+# observed into seconds-scale buckets lands every real value in the
+# overflow tail — the bucket-resolution trap `histogram()` guards
+# against below.
+COUNT_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384,
+)
+
 
 def _label_suffix(labels: Optional[Dict[str, str]]) -> str:
     """Prometheus-style sorted label block ('' when unlabeled)."""
@@ -248,6 +265,22 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = DURATION_BUCKETS,
                   labels: Optional[Dict[str, str]] = None) -> Histogram:
+        # the bucket-resolution trap: DURATION_BUCKETS (0.5ms..60s,
+        # seconds) under a histogram that does not measure seconds
+        # (its name must say so — Prometheus unit-suffix convention)
+        # puts every real observation in one bucket or the overflow
+        # tail, and the interpolated percentiles become fiction. A
+        # count/size histogram must declare its own scale explicitly
+        # (COUNT_BUCKETS, or a domain-specific list).
+        if tuple(buckets) == DURATION_BUCKETS and not name.endswith(
+                "_seconds"):
+            raise ValueError(
+                f"histogram {name!r} uses the seconds-scale "
+                "DURATION_BUCKETS but is not named *_seconds — a "
+                "non-duration value would land entirely in one "
+                "bucket/overflow; pass explicit buckets "
+                "(e.g. metrics.COUNT_BUCKETS)"
+            )
         return self._get_or_create(Histogram, name, help, labels=labels,
                                    buckets=buckets)
 

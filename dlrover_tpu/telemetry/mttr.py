@@ -51,6 +51,12 @@ _PAIRINGS = {
     # costs — the serving tier's recovery scenario
     EventKind.SERVE_RESIZE_BEGIN: (
         {EventKind.SERVE_RESIZE_DONE}, "serving_resize"),
+    # a confirmed serving SLO violation -> its recovery: the interval
+    # the SLO-driven scale policy is judged on (detection latency +
+    # proposal + resize + burn-down), distinct from the resize pause
+    # itself (serving_resize) which it usually contains
+    EventKind.SERVE_SLO_VIOLATION: (
+        {EventKind.SERVE_SLO_RECOVERED}, "serving_scale"),
 }
 
 

@@ -243,10 +243,43 @@ SERVE_REQUESTS_DROPPED = "dlrover_serve_requests_dropped_total"
 # re-dispatch machinery re-pointed at requests — duplicate decode
 # work, so counted and evented like DATA_SHARDS_TIMEOUT_RECOVERED)
 SERVE_LEASES_EXPIRED = "dlrover_serve_leases_expired_total"
-# per-request latency accounting on the master
+# per-request latency accounting on the master. The full SLO
+# decomposition: queue-wait (enqueue -> lease), TTFT (admit -> first
+# token), TPOT (inter-token: (e2e - ttft) / (tokens - 1)), e2e — all
+# on the serving LATENCY_BUCKETS (sub-ms resolution; the seconds-scale
+# DURATION_BUCKETS would flatten a decode-step-scale latency into its
+# first bucket). SERVE_PREFILL_TIME is worker-side (admit -> prompt
+# fully prefilled).
 SERVE_TTFT_TIME = "dlrover_serve_ttft_seconds"
 SERVE_E2E_TIME = "dlrover_serve_e2e_seconds"
+SERVE_QUEUE_WAIT_TIME = "dlrover_serve_queue_wait_seconds"
+SERVE_TPOT_TIME = "dlrover_serve_tpot_seconds"
+SERVE_PREFILL_TIME = "dlrover_serve_prefill_seconds"
+# tokens generated per completed request: a COUNT, not a duration —
+# it takes explicit count-scale buckets (metrics.COUNT_BUCKETS); the
+# registry refuses duration buckets on a non-``_seconds`` histogram
 SERVE_TOKENS_PER_REQUEST = "dlrover_serve_tokens_per_request"
+
+# -- serving SLO plane (dlrover_tpu/serving/slo.py + master/monitor/
+# serve_slo.py) ---------------------------------------------------------------
+# master-side per-serve-node gauges (labeled {node="<id>"}), fed by
+# the ServeRuntimeReportHook push through the NodeRuntimeReport path —
+# the serving twin of the NODE_* training series
+NODE_SERVE_DECODE_P50 = "dlrover_node_serve_decode_p50_seconds"
+NODE_SERVE_DECODE_P95 = "dlrover_node_serve_decode_p95_seconds"
+NODE_SERVE_TOKENS_PER_S = "dlrover_node_serve_tokens_per_second"
+NODE_SERVE_SLOT_OCCUPANCY = "dlrover_node_serve_slot_occupancy"
+NODE_SERVE_QUEUE_LEN = "dlrover_node_serve_queue_len"
+NODE_SERVE_SLOTS = "dlrover_node_serve_slots"
+NODE_SERVE_STEPS_TOTAL = "dlrover_node_serve_decode_steps_total"
+# master-side SLO verdict engine: violations flagged / recovered after
+# multi-window burn-rate confirmation, plus the current burn rate per
+# declared target (labeled {slo="<target>"}; burn > 1 = out of SLO)
+SERVE_SLO_VIOLATIONS = "dlrover_serve_slo_violations_total"
+SERVE_SLO_RECOVERIES = "dlrover_serve_slo_recoveries_total"
+SERVE_SLO_BURN_RATE = "dlrover_serve_slo_burn_rate"
+# SLO/idle-driven serving scale proposals handed to the auto-scaler
+SERVE_SCALE_PROPOSALS = "dlrover_serve_scale_proposals_total"
 
 
 class EventKind:
@@ -339,6 +372,25 @@ class EventKind:
     SERVE_RESIZE_DONE = "serve_resize_done"
     SERVE_REQUEST_EVICTED = "serve_request_evicted"
     SERVE_LEASE_EXPIRED = "serve_lease_expired"
+    # per-request lifecycle (every record carries the request's trace
+    # id, minted at Router.submit, so `tpurun trace --events` renders
+    # one lane per request with flow arrows across the router and
+    # worker pids): submitted/leased/completed on the router,
+    # prefill-chunk/first-token/done on the worker
+    SERVE_REQUEST_SUBMITTED = "serve_request_submitted"
+    SERVE_REQUEST_LEASED = "serve_request_leased"
+    SERVE_REQUEST_COMPLETED = "serve_request_completed"
+    SERVE_PREFILL_CHUNK = "serve_prefill_chunk"
+    SERVE_FIRST_TOKEN = "serve_first_token"
+    SERVE_REQUEST_DONE = "serve_request_done"
+    # serving SLO plane: a declared SLO target violated for the
+    # confirmation windows (failure-class — carries an error code and
+    # the burn-rate evidence; DLR008), its recovery, and the scale
+    # proposal the policy loop hands the auto-scaler. VIOLATION ->
+    # RECOVERED pairs into the mttr/goodput `serving_scale` scenario.
+    SERVE_SLO_VIOLATION = "serve_slo_violation"
+    SERVE_SLO_RECOVERED = "serve_slo_recovered"
+    SERVE_SCALE_PROPOSED = "serve_scale_proposed"
 
 
 class SpanName:
@@ -355,3 +407,9 @@ class SpanName:
     RENDEZVOUS = "rendezvous"
     EVALUATE = "evaluate"
     RPC = "rpc"  # prefix; full name is "rpc.<MessageType>"
+    # serving: host spans on the worker (decode dispatch, prefill
+    # chunk) and router (lease/complete handling) pids
+    SERVE_DECODE = "serve_decode_step"
+    SERVE_PREFILL = "serve_prefill_chunk"
+    SERVE_LEASE = "serve_lease"
+    SERVE_COMPLETE = "serve_complete"

@@ -93,6 +93,7 @@ class TestRequestRouter:
         assert rep["requests"] == {
             "queued": 1, "leased": 1, "done": 1, "submitted": 3,
             "completed": 1, "dropped": 0, "leases_expired": 0,
+            "evicted": 0,
         }
         assert rep["latency"]["ttft_p50_s"] is not None
         assert rep["nodes"]["0"]["done"] == 1
