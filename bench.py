@@ -2181,6 +2181,157 @@ def recovery_wedge_result() -> dict:
     return result_line
 
 
+def peer_rebuild_result() -> dict:
+    """The checkpoint-free recovery leg (ISSUE 15): train -> replicate
+    the host snapshot to a surviving peer's DRAM over real RPC -> lose
+    the node -> a fresh trainer rebuilds by streaming the regions back
+    and ``device_put``-ing against its mesh. Reports the MTTR breakdown
+    the peer path is judged on — drain (settle + snapshot), fetch (wire
+    stream out of peer DRAM), device_put — plus bytes fetched from
+    peers vs storage (pinned 0: no checkpoint directory exists) and the
+    bitwise param parity of the rebuilt state.
+
+    Env: BENCH_PEER_REPEATS (default 3; repeats >1 re-run the fetch on
+    the already-compiled trainer, isolating transfer cost from the
+    one-time compile)."""
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.checkpoint import replication as crepl
+    from dlrover_tpu.common.config import get_context
+    from dlrover_tpu.master.local_master import start_local_master
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import MeshPlan
+    from dlrover_tpu.parallel.strategy import Strategy
+    from dlrover_tpu.telemetry.events import recent_events
+    from dlrover_tpu.trainer.elastic import ElasticTrainer
+
+    import jax
+
+    repeats = int(os.environ.get("BENCH_PEER_REPEATS", "3"))
+    ctx = get_context()
+    saved = {k: getattr(ctx, k) for k in (
+        "snapshot_replicas", "peer_restore",
+        "replica_min_interval_secs")}
+    ctx.snapshot_replicas = 1
+    ctx.peer_restore = True
+    ctx.replica_min_interval_secs = 0.0
+    master = start_local_master()
+    store = crepl.ReplicaStore()
+    srv, port = crepl.start_replica_server(store, host="127.0.0.1")
+    try:
+        holder = MasterClient(master.addr, node_id=9)
+        holder.report_replica_endpoint(
+            addr=f"127.0.0.1:{port}", budget_mb=256.0,
+            snapshot_mb=0.0, step=-1)
+        holder.close()
+
+        config, batch_rows, seq_len = _pick_config("cpu", "tiny")
+        rng = np.random.RandomState(0)
+        n_dev = len(jax.devices())
+        batch_rows = -(-batch_rows // n_dev) * n_dev
+        ids = rng.randint(0, config.vocab_size,
+                          size=(batch_rows, seq_len + 1))
+        batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+        def build(node_client):
+            return ElasticTrainer(
+                llama.make_init_fn(config),
+                llama.make_loss_fn(config),
+                optax.adafactor(1e-3), batch,
+                strategy=Strategy(mesh=MeshPlan(data=-1),
+                                  rule_set="llama", remat_policy=""),
+                master_client=node_client,
+            )
+
+        client0 = MasterClient(master.addr, node_id=0)
+        trainer = build(client0)
+        state = trainer.prepare()
+        for _ in range(3):
+            state, _ = trainer.step(state, batch)
+        # drain: settle the in-flight chain, then the one device_get
+        t0 = time.monotonic()
+        jax.block_until_ready(state)
+        snap = trainer.snapshot(state)
+        drain_s = time.monotonic() - t0
+        replicator = crepl.SnapshotReplicator(client0, node_id=0)
+        try:
+            t0 = time.monotonic()
+            replicator.submit(snap.tree, snap.meta, snap.step)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and \
+                    not store.inventory().get("0"):
+                time.sleep(0.02)
+            push_s = time.monotonic() - t0
+        finally:
+            replicator.stop()
+        if not store.inventory().get("0"):
+            return {"metric": "peer_rebuild_mttr_s", "value": 0.0,
+                    "unit": "s", "vs_baseline": 0.0,
+                    "error": "replica never committed on the peer"}
+        # the loss: node 0's own store is gone, the master knows
+        reporter = MasterClient(master.addr, node_id=0)
+        reporter.report_failure(node_rank=0, restart_count=0,
+                                error_data="bench kill", level="node")
+        reporter.close()
+
+        clientB = MasterClient(master.addr, node_id=0)
+        trainerB = build(clientB)
+        fetches, puts, wire = [], [], []
+        stateB = trainerB.prepare()  # repeat 0: includes the compile
+        for _ in range(max(0, repeats - 1)):
+            restored = trainerB._try_peer_restore()
+            if restored is not None:
+                stateB = restored
+        done = [r for r in recent_events()
+                if r.get("kind") == "peer_rebuild_done"]
+        for r in done[-repeats:]:
+            fetches.append(float(r["fetch_seconds"]))
+            puts.append(float(r["put_seconds"]))
+            wire.append(int(r["bytes_from_peers"]))
+        if not fetches:
+            return {"metric": "peer_rebuild_mttr_s", "value": 0.0,
+                    "unit": "s", "vs_baseline": 0.0,
+                    "error": "no peer_rebuild_done edge recorded"}
+        params_identical = all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(jax.tree.leaves(snap.tree),
+                            jax.tree.leaves(jax.device_get(stateB)))
+        )
+        med = sorted(
+            f + p for f, p in zip(fetches, puts))[len(fetches) // 2]
+        result_line = {
+            "metric": "peer_rebuild_mttr_s",
+            "value": round(drain_s + med, 3),
+            "unit": "s",
+            "vs_baseline": round((drain_s + med) / MTTR_TARGET_S, 4),
+            "detail": {
+                "drain_s": round(drain_s, 3),
+                "replicate_push_s": round(push_s, 3),
+                "fetch_s": [round(f, 3) for f in fetches],
+                "device_put_s": [round(p, 3) for p in puts],
+                "bytes_from_peers": wire,
+                "bytes_from_storage": 0,
+                "snapshot_mb": round(snap.nbytes() / 1e6, 2),
+                "params_bit_identical": bool(params_identical),
+                "repeats": len(fetches),
+                "resumed_step": int(trainerB._host_step),
+            },
+        }
+        if not params_identical:
+            result_line["error"] = (
+                "peer-rebuilt params diverged from the snapshot")
+        client0.close()
+        clientB.close()
+        return result_line
+    finally:
+        srv.stop(grace=0)
+        master.stop()
+        for k, v in saved.items():
+            setattr(ctx, k, v)
+
+
 def _write_wedge_artifacts(result_line: dict):
     """BENCH_r07.json: the wedge line. MTTR_r02.json: the DERIVED MTTR
     report (telemetry.mttr) over this process's event ring — the
@@ -2228,11 +2379,30 @@ def recovery_main() -> int:
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
         _pin_cpu_isa_for_cache()
-        result_line = recovery_wedge_result()
-        print(json.dumps(result_line))
-        if "error" not in result_line:
-            _write_wedge_artifacts(result_line)
-        return 1 if result_line.get("error") else 0
+        # BENCH_RECOVERY_LEG=peer runs ONLY the checkpoint-free
+        # peer-rebuild leg (cheap; writes BENCH_r14.json); the default
+        # runs the live-vs-restart wedge then the peer leg
+        leg = os.environ.get("BENCH_RECOVERY_LEG", "")
+        rc = 0
+        if leg != "peer":
+            result_line = recovery_wedge_result()
+            print(json.dumps(result_line))
+            if "error" not in result_line:
+                _write_wedge_artifacts(result_line)
+            rc = 1 if result_line.get("error") else rc
+            if leg == "wedge":
+                return rc
+        peer_line = peer_rebuild_result()
+        print(json.dumps(peer_line))
+        if "error" not in peer_line:
+            here = os.path.dirname(os.path.abspath(__file__))
+            artifact = os.environ.get(
+                "BENCH_PEER_ARTIFACT",
+                os.path.join(here, "BENCH_r14.json"))
+            if artifact:
+                with open(artifact, "w") as f:
+                    f.write(json.dumps(peer_line) + "\n")
+        return 1 if peer_line.get("error") else rc
     result_line = recovery_result()
     print(json.dumps(result_line))
     return 1 if result_line.get("error") else 0

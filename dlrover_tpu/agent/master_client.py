@@ -319,6 +319,33 @@ class MasterClient:
         except ValueError:
             return {}
 
+    # -- peer-redundant host snapshots ---------------------------------------
+
+    def report_replica_endpoint(self, **kwargs) -> comm.Response:
+        """Register/refresh this node's replica-store endpoint (the
+        ReplicaDirectory's liveness + budget + freshness input)."""
+        kwargs.setdefault("node_id", self.node_id)
+        kwargs.setdefault("timestamp", time.time())
+        return self._channel.report(comm.ReplicaEndpointReport(**kwargs))
+
+    def get_replica_plan(self) -> comm.ReplicaPlan:
+        """This node's master-assigned replica peers (rendezvous-stable,
+        budget-admitted; ``degraded`` marks a plan priced below k)."""
+        return self._channel.get(comm.ReplicaPlanRequest(
+            node_id=self.node_id))
+
+    def get_recovery_plan(self) -> dict:
+        """Owner -> ordered live replica holders: the peer-rebuild map a
+        recovering worker streams its state from."""
+        import json
+
+        resp = self._channel.get(comm.RecoveryPlanRequest(
+            node_id=self.node_id))
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
+
     def report_heartbeat(self) -> comm.Response:
         return self._channel.report(comm.NodeHeartbeat(
             node_id=self.node_id, timestamp=time.time()

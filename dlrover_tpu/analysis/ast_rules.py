@@ -70,6 +70,8 @@ FAILURE_EVENT_ATTRS = {
     "ERROR_REPORT", "DIAG_STRAGGLER", "DIAG_NODE_HANG",
     "DATA_SHARD_TIMEOUT", "SERVE_REQUEST_EVICTED",
     "SERVE_LEASE_EXPIRED", "SERVE_SLO_VIOLATION",
+    "REPLICA_PUSH_FAILED", "REPLICA_PLAN_DEGRADED",
+    "REPLICA_HOLDER_LOST", "PEER_REBUILD_FALLBACK",
 }
 FAILURE_EVENT_VALUES = {
     "nonfinite_step", "worker_failed", "hang_detected",
@@ -77,6 +79,8 @@ FAILURE_EVENT_VALUES = {
     "error_report", "diag_straggler", "diag_node_hang",
     "data_shard_timeout", "serve_request_evicted",
     "serve_lease_expired", "serve_slo_violation",
+    "replica_push_failed", "replica_plan_degraded",
+    "replica_holder_lost", "peer_rebuild_fallback",
 }
 
 

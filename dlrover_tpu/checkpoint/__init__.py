@@ -11,10 +11,20 @@ from dlrover_tpu.checkpoint.manager import (
     HostSnapshot,
     abstract_like,
 )
+from dlrover_tpu.checkpoint.replication import (
+    ReplicaStore,
+    SnapshotReplicator,
+    fetch_tree,
+    start_replica_server,
+)
 
 __all__ = [
     "CheckpointInterval",
     "ElasticCheckpointManager",
     "HostSnapshot",
+    "ReplicaStore",
+    "SnapshotReplicator",
     "abstract_like",
+    "fetch_tree",
+    "start_replica_server",
 ]

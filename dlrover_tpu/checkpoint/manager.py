@@ -113,11 +113,27 @@ class HostSnapshot:
     @classmethod
     def take(cls, state: Any, **meta) -> "HostSnapshot":
         """One device sync: pull every leaf to host DRAM. Callers drain
-        in-flight work first so this waits only on the last step."""
+        in-flight work first so this waits only on the last step.
+
+        On the CPU backend ``device_get`` can return numpy views that
+        ALIAS the live XLA buffers (host memory IS device memory there
+        — the same zero-copy family as the Orbax adjacency hang): a
+        donated train step dispatched after ``take()`` would then
+        scribble over the "snapshot". One host-side copy per leaf makes
+        the snapshot genuinely immune to later donation; accelerator
+        backends skip it (their device_get is a real D2H copy)."""
         reg = get_registry()
         t0 = time.monotonic()
         with span(SpanName.STATE_SNAPSHOT):
             tree = jax.device_get(state)
+            if _on_cpu_backend(state):
+                import numpy as _np
+
+                tree = jax.tree.map(
+                    lambda x: _np.array(x, copy=True)
+                    if isinstance(x, _np.ndarray) else x,
+                    tree,
+                )
         snap_s = time.monotonic() - t0
         reg.histogram(
             tm.SNAPSHOT_TIME,
@@ -137,9 +153,37 @@ class HostSnapshot:
         return jax.device_put(self.tree, sharding_tree)
 
     def nbytes(self) -> int:
-        return sum(
-            getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(self.tree)
-        )
+        """Host bytes this snapshot holds. Non-numpy leaves (python
+        scalars, 0-d device remnants) are sized through ``np.asarray``
+        instead of silently counting 0 — the replica-budget admission
+        prices plans off this number."""
+        import numpy as np
+
+        total = 0
+        for leaf in jax.tree.leaves(self.tree):
+            n = getattr(leaf, "nbytes", None)
+            if n is None:
+                try:
+                    n = np.asarray(leaf).nbytes
+                except (TypeError, ValueError):
+                    n = 0
+            total += int(n)
+        return total
+
+
+def _on_cpu_backend(state: Any) -> bool:
+    """True when the state's device arrays live on the CPU backend (the
+    zero-copy-aliasing platform the donation-safety copies exist for)."""
+    leaves = [x for x in jax.tree.leaves(state) if isinstance(x, jax.Array)]
+    if not leaves:
+        return False
+    try:
+        return {d.platform for d in leaves[0].devices()} == {"cpu"}
+    except Exception as e:  # noqa: BLE001 — conservative: copy when unsure
+        logger.debug("could not read device platform (%s: %s); assuming "
+                     "cpu for the donation-safety copy",
+                     type(e).__name__, e)
+        return True
 
 
 def _rematerialize(state: Any) -> Any:
@@ -183,14 +227,7 @@ def _decouple_from_donation(state: Any) -> Any:
     leaves = [x for x in jax.tree.leaves(state) if isinstance(x, jax.Array)]
     if not leaves:
         return state
-    try:
-        platforms = {d.platform for d in leaves[0].devices()}
-    except Exception as e:  # noqa: BLE001 — conservative: copy when unsure
-        logger.warning("could not read device platform before save; "
-                       "taking the donation-safety copy (%s: %s)",
-                       type(e).__name__, e)
-        platforms = {"cpu"}
-    if platforms != {"cpu"}:
+    if not _on_cpu_backend(state):
         return state
     return _copy_tree(state)
 

@@ -1,0 +1,991 @@
+"""Peer-redundant host snapshots: the checkpoint-free recovery plane.
+
+The HSDP position (PAPERS.md 2602.00277): at pod scale, the dominant
+recovery cost is the storage round-trip a *lost* node forces — the
+survivors' state is intact (PR 5's live reshard covers them), but the
+dead node's shard exists only on disk. This module removes that
+round-trip by keeping k in-memory replicas of every node's host-shard
+regions in PEER DRAM:
+
+- Each node's :class:`HostSnapshot` is partitioned into deterministic
+  per-owner byte regions (``owner_slice``) — the in-memory analogue of
+  Universal Checkpointing's sharding-agnostic layout (PAPERS.md
+  2406.18820): regions are raw global-array bytes, so the rebuilt host
+  tree can be ``device_put`` against *whatever* shardings the survivor
+  mesh wants.
+- A :class:`SnapshotReplicator` pushes the node's own regions to k
+  master-chosen peers on a cadence, off the training thread (the same
+  async-staging discipline as ``enable_async_checkpointing``): the
+  step path only enqueues; chunking, checksumming and the RPC stream
+  run on a background daemon thread.
+- Each node serves its :class:`ReplicaStore` over the same two-method
+  gRPC surface the master speaks (``rpc.server``), so a rebuilding
+  node streams regions straight out of surviving peers' DRAM —
+  chunked, length-prefixed, checksummed, with per-chunk retry and a
+  mid-transfer-holder-death fallback to the next replica
+  (:func:`fetch_tree`). Terminal failure degrades to the Orbax/mirror
+  path — graceful degradation is part of the contract.
+
+Wire format (one chunk frame)::
+
+    [4-byte BE header length][header JSON][payload bytes]
+
+Header: ``{"v", "kind": "chunk"|"manifest", "owner", "step", "leaf",
+"lo", "hi", "seq", "nbytes", "crc"}`` — ``nbytes`` is the payload
+length (the length-prefix integrity check) and ``crc`` its crc32 (the
+corruption check the fault-injection matrix flips bytes against). A
+snapshot becomes visible to fetchers only once its ``manifest`` frame
+commits (per-leaf chunk counts + tree spec + snapshot meta verified),
+so a pusher dying mid-transfer leaves no torn state behind.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry import (
+    EventKind,
+    emit_event,
+    get_registry,
+    names as tm,
+)
+
+logger = get_logger("checkpoint.replication")
+
+_LEN = struct.Struct(">I")
+_WIRE_VERSION = 1
+
+
+class ChunkCorruptionError(RuntimeError):
+    """A chunk frame failed its length-prefix or crc32 check."""
+
+
+class PeerRestoreError(RuntimeError):
+    """No combination of live holders could produce a complete,
+    consistent snapshot — callers degrade to the storage path."""
+
+
+# ---------------------------------------------------------------------------
+# region partition + tree spec
+# ---------------------------------------------------------------------------
+
+
+def owner_slice(nbytes: int, group_size: int, owner_rank: int
+                ) -> Tuple[int, int]:
+    """The contiguous byte range of one leaf that ``owner_rank`` (its
+    position in the SORTED owner group) owns. Deterministic and
+    boundary-exact: the union over ranks is [0, nbytes) with no overlap
+    — what lets a fetcher verify full coverage before trusting a
+    rebuild."""
+    if group_size <= 0:
+        raise ValueError(f"group_size must be positive, got {group_size}")
+    if not 0 <= owner_rank < group_size:
+        raise ValueError(
+            f"owner_rank {owner_rank} outside group of {group_size}")
+    lo = (nbytes * owner_rank) // group_size
+    hi = (nbytes * (owner_rank + 1)) // group_size
+    return lo, hi
+
+
+def tree_spec(leaves: List[Any]) -> List[Dict[str, Any]]:
+    """Per-leaf (dtype, shape) facts of a snapshot's flattened leaves —
+    the manifest's structural contract with the rebuilder."""
+    spec = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        spec.append({"dtype": arr.dtype.str, "shape": list(arr.shape)})
+    return spec
+
+
+def spec_digest(spec: List[Dict[str, Any]]) -> str:
+    """Stable identity of a tree spec: a snapshot replicated for one
+    model must never rebuild into another's structure."""
+    blob = json.dumps(spec, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# chunk frame codec (length-prefixed + checksummed)
+# ---------------------------------------------------------------------------
+
+
+def _header_blob(fields: Dict[str, Any]) -> bytes:
+    return json.dumps(fields, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def encode_chunk(*, kind: str, owner: int, step: int, leaf: int,
+                 lo: int, hi: int, seq: int, payload: bytes) -> bytes:
+    fields = {
+        "v": _WIRE_VERSION, "kind": kind, "owner": int(owner),
+        "step": int(step), "leaf": int(leaf), "lo": int(lo),
+        "hi": int(hi), "seq": int(seq), "nbytes": len(payload),
+        "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+    }
+    # the payload crc cannot protect the PLACEMENT facts (a flipped
+    # lo/hi would write good bytes to the wrong region): the header
+    # carries its own crc over the canonical field serialization
+    fields["hcrc"] = zlib.crc32(_header_blob(fields)) & 0xFFFFFFFF
+    header = _header_blob(fields)
+    return b"".join([_LEN.pack(len(header)), header, payload])
+
+
+def decode_chunk(frame: bytes) -> Tuple[Dict[str, Any], bytes]:
+    """Verify the length prefix, the header crc (placement facts) and
+    the payload crc32, returning (header, payload). Raises
+    :class:`ChunkCorruptionError` on any mismatch — the checksums are
+    what turn silent bitrot into a retriable fault."""
+    try:
+        (hlen,) = _LEN.unpack_from(frame, 0)
+        header = json.loads(bytes(frame[4:4 + hlen]))
+    except (struct.error, ValueError, UnicodeDecodeError) as e:
+        raise ChunkCorruptionError(f"undecodable chunk header: {e}") from e
+    try:
+        hcrc = int(header.pop("hcrc"))
+    except (KeyError, TypeError, ValueError) as e:
+        raise ChunkCorruptionError(f"missing header crc: {e}") from e
+    if (zlib.crc32(_header_blob(header)) & 0xFFFFFFFF) != hcrc:
+        raise ChunkCorruptionError(
+            "header crc mismatch: placement facts are untrustworthy")
+    payload = bytes(frame[4 + hlen:])
+    if len(payload) != int(header.get("nbytes", -1)):
+        raise ChunkCorruptionError(
+            f"length prefix mismatch: header says {header.get('nbytes')} "
+            f"payload bytes, frame carries {len(payload)}")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != int(header.get("crc", -1)):
+        raise ChunkCorruptionError(
+            f"crc mismatch on owner={header.get('owner')} "
+            f"leaf={header.get('leaf')} seq={header.get('seq')}")
+    return header, payload
+
+
+def frame_to_wire(frame: bytes) -> str:
+    return base64.b64encode(frame).decode("ascii")
+
+
+def frame_from_wire(wire: str) -> bytes:
+    return base64.b64decode(wire.encode("ascii"))
+
+
+def build_region_frames(
+    *, owner: int, step: int, leaves: List[np.ndarray],
+    group: List[int], meta: Dict[str, Any],
+    chunk_bytes: int = 256 * 1024,
+) -> List[bytes]:
+    """Slice ``owner``'s byte regions out of every leaf and frame them:
+    N data chunks followed by ONE manifest frame that seals the step.
+    ``group`` is the sorted owner set the partition is computed over
+    (the snapshot group at push time — recorded in the manifest so a
+    fetcher reassembles against the same split even after a resize)."""
+    group = sorted(group)
+    rank = group.index(owner)
+    spec = tree_spec(leaves)
+    frames: List[bytes] = []
+    manifest_leaves: Dict[str, Dict[str, Any]] = {}
+    for idx, leaf in enumerate(leaves):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        raw = arr.view(np.uint8).reshape(-1) if arr.ndim else \
+            np.frombuffer(arr.tobytes(), dtype=np.uint8)
+        lo, hi = owner_slice(arr.nbytes, len(group), rank)
+        region = raw[lo:hi].tobytes()
+        nchunks = max(1, -(-len(region) // chunk_bytes)) if region else 0
+        for seq in range(nchunks):
+            piece = region[seq * chunk_bytes:(seq + 1) * chunk_bytes]
+            frames.append(encode_chunk(
+                kind="chunk", owner=owner, step=step, leaf=idx,
+                lo=lo + seq * chunk_bytes,
+                hi=lo + seq * chunk_bytes + len(piece),
+                seq=seq, payload=piece,
+            ))
+        manifest_leaves[str(idx)] = {
+            "lo": lo, "hi": hi, "nchunks": nchunks,
+            "leaf_nbytes": int(arr.nbytes),
+        }
+    manifest = {
+        "owner": owner, "step": step, "group": group,
+        "leaves": manifest_leaves, "spec": spec,
+        "spec_digest": spec_digest(spec), "meta": dict(meta),
+        "pushed_at": time.time(),
+    }
+    payload = json.dumps(manifest, separators=(",", ":")).encode()
+    frames.append(encode_chunk(
+        kind="manifest", owner=owner, step=step, leaf=-1, lo=0,
+        hi=len(payload), seq=0, payload=payload,
+    ))
+    return frames
+
+
+# ---------------------------------------------------------------------------
+# the holder side: in-memory store + its RPC servicer
+# ---------------------------------------------------------------------------
+
+
+class ReplicaStore:
+    """Per-node in-memory replica store: committed snapshots keyed by
+    owner (newest step wins), plus the in-flight staged push. Budget-
+    bounded: a chunk that would exceed ``budget_bytes`` is REJECTED
+    (the pusher logs a degraded verdict) — a replica plan can degrade,
+    it can never OOM this worker."""
+
+    def __init__(self, budget_bytes: int = 0,
+                 staged_ttl_secs: float = 600.0,
+                 self_owner: Optional[int] = None):
+        self._lock = threading.Lock()
+        # 0 = uncapped (test/default posture); any positive value is a
+        # hard cap on PEER bytes. ``self_owner``'s own regions are
+        # exempt: a node must always be able to commit its own
+        # snapshot locally (peers rebuild IT from here), whatever DRAM
+        # it lends to others.
+        self.budget_bytes = int(budget_bytes)
+        self._self_owner = self_owner
+        # staged cycles older than this are reclaimed: a pusher that
+        # died mid-transfer (the exact fault this plane recovers from)
+        # must not pin its torn chunks against the budget forever
+        self._staged_ttl = float(staged_ttl_secs)
+        # owner -> newest-first retained commits, each
+        # {"step", "manifest", "chunks": {(leaf, seq): frame}}.
+        # TWO-deep retention: during a multi-owner push wave, one
+        # owner's fresh commit would otherwise discard the only step
+        # every owner still covers — a SIGKILL landing inside that
+        # window (the plane's target fault) would force the storage
+        # path even though a fully-covered older step existed.
+        self._retain_depth = 2
+        self._committed: Dict[int, List[Dict[str, Any]]] = {}
+        self._staged: Dict[Tuple[int, int], Dict[Tuple[int, int], bytes]] = {}
+        # last-touch monotonic time per staged cycle (TTL reclamation)
+        self._staged_ts: Dict[Tuple[int, int], float] = {}
+        # running resident-byte counter: the budget check must be O(1),
+        # not a scan over every frame under the lock per incoming chunk
+        self._resident = 0
+        reg = get_registry()
+        self._g_bytes = reg.gauge(
+            tm.REPLICA_STORE_BYTES,
+            help="peer-replica bytes resident in this worker's DRAM")
+        self._c_corrupt = reg.counter(
+            tm.REPLICA_CHUNK_CORRUPTIONS,
+            help="chunk frames rejected by the length/crc checks")
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident
+
+    def _drop_staged_locked(self, key: Tuple[int, int]):
+        chunks = self._staged.pop(key, None)
+        self._staged_ts.pop(key, None)
+        if chunks:
+            self._resident -= sum(len(f) for f in chunks.values())
+
+    def _reap_stale_staged_locked(self, now: float):
+        """Reclaim staged cycles whose pusher went quiet (died
+        mid-transfer before its manifest): torn chunks must not pin
+        the holder's replica budget forever."""
+        for key in [k for k, ts in self._staged_ts.items()
+                    if now - ts > self._staged_ttl]:
+            logger.warning(
+                "reclaiming staged replica cycle owner=%d step=%d: no "
+                "manifest within %.0fs (pusher died mid-transfer?)",
+                key[0], key[1], self._staged_ttl)
+            self._drop_staged_locked(key)
+
+    def put_frame(self, frame: bytes) -> Tuple[bool, str]:
+        """Ingest one frame. Data chunks stage; the manifest frame
+        verifies coverage and commits (superseding any older committed
+        step for that owner). Returns (ok, reason)."""
+        try:
+            header, payload = decode_chunk(frame)
+        except ChunkCorruptionError as e:
+            self._c_corrupt.inc()
+            logger.warning("[REPLICA_CORRUPT] rejected chunk on put: %s", e)
+            return False, f"corrupt: {e}"
+        owner, step = int(header["owner"]), int(header["step"])
+        now = time.monotonic()
+        with self._lock:
+            self._reap_stale_staged_locked(now)
+            if header["kind"] == "chunk":
+                if (
+                    self.budget_bytes
+                    and owner != self._self_owner
+                    and self._resident + len(frame) > self.budget_bytes
+                ):
+                    return False, "budget"
+                staged = self._staged.setdefault((owner, step), {})
+                key = (int(header["leaf"]), int(header["seq"]))
+                prev = staged.get(key)
+                if prev is not None:
+                    self._resident -= len(prev)  # idempotent re-put
+                staged[key] = bytes(frame)
+                self._resident += len(frame)
+                self._staged_ts[(owner, step)] = now
+                return True, ""
+            # manifest: verify every listed chunk is staged, then commit
+            manifest = json.loads(payload)
+            staged = self._staged.get((owner, step), {})
+            for leaf_key, info in manifest["leaves"].items():
+                leaf = int(leaf_key)
+                for seq in range(int(info["nchunks"])):
+                    if (leaf, seq) not in staged:
+                        return False, (
+                            f"incomplete: leaf {leaf} chunk {seq} missing"
+                        )
+            entries = self._committed.setdefault(owner, [])
+            if entries and int(entries[0]["step"]) > step:
+                # a stale push (slow retry of an old cycle) must not
+                # roll a fresher committed snapshot back
+                self._drop_staged_locked((owner, step))
+                return False, "stale"
+            if entries and int(entries[0]["step"]) == step:
+                # idempotent re-commit of the same step: replace
+                self._resident -= sum(
+                    len(f) for f in entries[0]["chunks"].values())
+                entries.pop(0)
+            entries.insert(0, {
+                "step": step, "manifest": manifest, "chunks": staged,
+            })
+            while len(entries) > self._retain_depth:
+                evicted = entries.pop()
+                self._resident -= sum(
+                    len(f) for f in evicted["chunks"].values())
+            # the staged bytes are now committed bytes: only the
+            # bookkeeping moves, the counter already holds them
+            self._staged.pop((owner, step), None)
+            self._staged_ts.pop((owner, step), None)
+            # drop any older staged cycles of this owner too
+            for key in [k for k in self._staged if k[0] == owner
+                        and k[1] < step]:
+                self._drop_staged_locked(key)
+            self._g_bytes.set(self._resident)
+        return True, ""
+
+    def fetch(self, owner: int, step: int, leaf: int, seq: int
+              ) -> Optional[bytes]:
+        with self._lock:
+            for entry in self._committed.get(owner, []):
+                if int(entry["step"]) == step:
+                    return entry["chunks"].get((leaf, seq))
+            return None
+
+    def inventory(self, owner: int = -1) -> Dict[str, Any]:
+        """Committed holdings: {owner: {"step", "manifest", "steps"}} —
+        "step"/"manifest" are the NEWEST retained commit, "steps" maps
+        every retained step to its manifest (the fetcher's
+        best_common_step sweeps all of them). Chunks are elided — the
+        fetcher pulls them one at a time."""
+        with self._lock:
+            out = {}
+            for o, entries in self._committed.items():
+                if owner >= 0 and o != owner or not entries:
+                    continue
+                out[str(o)] = {
+                    "step": int(entries[0]["step"]),
+                    "manifest": entries[0]["manifest"],
+                    "steps": {
+                        str(e["step"]): e["manifest"] for e in entries
+                    },
+                }
+            return out
+
+    def drop_owner(self, owner: int):
+        with self._lock:
+            for entry in self._committed.pop(owner, []):
+                self._resident -= sum(
+                    len(f) for f in entry["chunks"].values())
+            for key in [k for k in self._staged if k[0] == owner]:
+                self._drop_staged_locked(key)
+            self._g_bytes.set(self._resident)
+
+
+class ReplicaServicer:
+    """The two-method (get/report) servicer fronting a ReplicaStore —
+    served by ``rpc.server.build_server`` exactly like the master, so
+    peers speak the surface that already exists."""
+
+    def __init__(self, store: ReplicaStore):
+        self.store = store
+
+    def report(self, request, context=None):
+        from dlrover_tpu.common import comm
+
+        if isinstance(request, comm.ReplicaPut):
+            ok, reason = self.store.put_frame(
+                frame_from_wire(request.frame))
+            return comm.Response(success=ok, reason=reason)
+        return comm.Response(
+            success=False,
+            reason=f"no replica report handler: {type(request).__name__}",
+        )
+
+    def get(self, request, context=None):
+        from dlrover_tpu.common import comm
+
+        if isinstance(request, comm.ReplicaFetchRequest):
+            frame = self.store.fetch(
+                request.owner, request.step, request.leaf, request.seq)
+            if frame is None:
+                return comm.ReplicaFrame(frame="", found=False)
+            return comm.ReplicaFrame(
+                frame=frame_to_wire(frame), found=True)
+        if isinstance(request, comm.ReplicaInfoRequest):
+            return comm.DiagnosisReport(report_json=json.dumps(
+                self.store.inventory(request.owner)))
+        return comm.Response(
+            success=False,
+            reason=f"no replica get handler: {type(request).__name__}",
+        )
+
+
+def start_replica_server(store: ReplicaStore, port: int = 0,
+                         host: str = "0.0.0.0"):
+    """Serve a ReplicaStore; returns (server, bound_port)."""
+    from dlrover_tpu.rpc.server import build_server
+
+    server, bound = build_server(ReplicaServicer(store), port=port,
+                                 host=host)
+    server.start()
+    return server, bound
+
+
+# ---------------------------------------------------------------------------
+# the pusher side
+# ---------------------------------------------------------------------------
+
+
+def default_replica_budget_bytes() -> int:
+    """The host-DRAM budget this node grants to peer replicas: the
+    configured ``replica_budget_mb`` capped by a quarter of the host's
+    available memory right now — the same host-accounting posture the
+    PR 8 plane reports (``rss_mb`` / headroom gauges), so an admission
+    decision never prices against memory the training process is about
+    to need. A NEGATIVE knob means "lend no DRAM to peers" (the store
+    still commits this node's OWN regions — self regions are budget-
+    exempt); 0 means uncapped."""
+    from dlrover_tpu.common.config import get_context
+
+    mb = float(get_context().replica_budget_mb)
+    if mb < 0:
+        return 1  # effectively nothing: every peer chunk is refused
+    if mb == 0:
+        return 0  # uncapped
+    budget = int(mb * 1024 * 1024)
+    try:
+        import psutil
+
+        avail = int(psutil.virtual_memory().available)
+        budget = min(budget, avail // 4)
+    except Exception as e:  # noqa: BLE001 — psutil-less hosts keep the knob
+        logger.debug("psutil unavailable for budget sizing (%s: %s)",
+                     type(e).__name__, e)
+    return max(budget, 1)
+
+
+class SnapshotReplicator:
+    """Owns this node's replica store + server, registers the endpoint
+    with the master, and pushes the node's own snapshot regions to the
+    master-assigned peers on demand.
+
+    ``submit()`` is the only step-path entry: it enqueues (bounded,
+    drop-on-backpressure — replication must never stall the loop) and
+    the daemon sender thread does the slicing, framing, local commit
+    and per-peer RPC stream. Peer channels are ``RpcChannel``s, so
+    every chunk rides the hardened transient-retry path (jittered
+    exponential backoff); a peer that stays down is dropped for the
+    cycle with a counted, error-coded event — degradation, not a
+    crash."""
+
+    def __init__(self, master_client, node_id: int,
+                 port: int = 0, budget_bytes: Optional[int] = None,
+                 chunk_bytes: Optional[int] = None,
+                 advertise_host: str = "127.0.0.1"):
+        import queue
+
+        from dlrover_tpu.common.config import get_context
+
+        ctx = get_context()
+        self._client = master_client
+        self.node_id = int(node_id)
+        if budget_bytes is None:
+            budget_bytes = default_replica_budget_bytes()
+        self.store = ReplicaStore(budget_bytes=budget_bytes,
+                                  self_owner=self.node_id)
+        self._server, self._port = start_replica_server(
+            self.store, port=port or int(getattr(ctx, "replica_port", 0)))
+        self.addr = f"{advertise_host}:{self._port}"
+        self._chunk_bytes = int(
+            chunk_bytes if chunk_bytes is not None
+            else float(getattr(ctx, "replica_chunk_kb", 256)) * 1024)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._sender: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._channel, self._close_channels = replica_channel_factory()
+        self.last_pushed_step = -1
+        self.last_plan: Optional[Dict[str, Any]] = None
+        # maintenance/chaos pause: submissions are dropped (counted)
+        # while True — the "expired cadence" failure mode on demand
+        self.paused = False
+        reg = get_registry()
+        self._c_pushes = reg.counter(
+            tm.REPLICA_PUSHES,
+            help="snapshot replication cycles completed")
+        self._c_push_failures = reg.counter(
+            tm.REPLICA_PUSH_FAILURES,
+            help="peer pushes dropped (dead peer / budget / backpressure)")
+        self._c_bytes = reg.counter(
+            tm.REPLICA_BYTES_PUSHED,
+            help="region bytes shipped to peer stores")
+        self._h_push = reg.histogram(
+            tm.REPLICA_PUSH_TIME,
+            help="one replication cycle: slice + frame + peer stream")
+        self._register_endpoint(snapshot_mb=0.0)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def plan_cadence_steps(self) -> int:
+        """The MASTER-computed cluster-wide cadence from the last plan
+        (0 = none yet): when present, the replica hook paces by it
+        INSTEAD of the local wall floor, so every node pushes at the
+        same global-step multiples and a rebuild always finds one step
+        with full owner coverage."""
+        return int((self.last_plan or {}).get("cadence_steps", 0) or 0)
+
+    def _register_endpoint(self, snapshot_mb: float):
+        try:
+            self._client.report_replica_endpoint(
+                node_id=self.node_id, addr=self.addr,
+                budget_mb=self.store.budget_bytes / (1024 * 1024),
+                snapshot_mb=float(snapshot_mb),
+                step=int(self.last_pushed_step),
+            )
+        except Exception as e:  # noqa: BLE001 — a briefly-away master
+            # only delays the plan; the next cycle re-registers
+            logger.warning("replica endpoint registration failed "
+                           "(%s: %s)", type(e).__name__, e)
+
+    # -- step-path entry -----------------------------------------------------
+
+    def submit(self, tree: Any, meta: Dict[str, Any], step: int) -> bool:
+        """Enqueue one snapshot tree for replication. Returns False when
+        the previous cycle is still in flight (dropped — the next
+        cadence's fresher snapshot supersedes this one)."""
+        import queue
+
+        if self.paused:
+            self._c_push_failures.inc()
+            return False
+        if self._sender is None or not self._sender.is_alive():
+            self._sender = threading.Thread(
+                target=self._send_loop, name="snapshot-replicator",
+                daemon=True)
+            self._sender.start()
+        try:
+            self._queue.put_nowait((tree, dict(meta), int(step)))
+            return True
+        except queue.Full:
+            self._c_push_failures.inc()
+            return False
+
+    # -- the background cycle ------------------------------------------------
+
+    def _send_loop(self):
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                return
+            try:
+                self._replicate_once(*item)
+            except Exception:  # noqa: BLE001 — replication is redundancy,
+                # never a reason to kill the worker
+                self._c_push_failures.inc()
+                logger.exception("replication cycle failed")
+
+    def _replicate_once(self, tree: Any, meta: Dict[str, Any], step: int):
+        import jax
+
+        t0 = time.monotonic()
+        leaves = [np.asarray(x) for x in jax.tree.leaves(tree)]
+        nbytes = sum(x.nbytes for x in leaves)
+        self._register_endpoint(snapshot_mb=nbytes / (1024 * 1024))
+        plan = self._fetch_plan()
+        group = sorted(set(
+            [self.node_id] + [int(p["node_id"])
+                              for p in (plan or {}).get("peers", [])]
+        )) if plan else [self.node_id]
+        if plan and plan.get("group"):
+            group = sorted(int(g) for g in plan["group"])
+        frames = build_region_frames(
+            owner=self.node_id, step=step, leaves=leaves, group=group,
+            meta=meta, chunk_bytes=self._chunk_bytes,
+        )
+        # local commit first: this node is always holder #0 of its own
+        # regions (peers of a DIFFERENT lost node fetch them from here)
+        for frame in frames:
+            ok, reason = self.store.put_frame(frame)
+            if not ok:
+                logger.warning("local replica commit refused: %s", reason)
+        pushed_peers = []
+        for peer in (plan or {}).get("peers", []):
+            addr = peer.get("addr", "")
+            if not addr:
+                continue
+            if self._push_to_peer(addr, frames):
+                pushed_peers.append(int(peer.get("node_id", -1)))
+        self.last_pushed_step = step
+        self._register_endpoint(snapshot_mb=nbytes / (1024 * 1024))
+        push_s = time.monotonic() - t0
+        self._c_pushes.inc()
+        self._h_push.observe(push_s)
+        # bytes actually SHIPPED: zero peers reached = zero bytes (a
+        # counter that kept rising while nothing left the host would
+        # mask a total redundancy outage on dashboards)
+        region_bytes = sum(
+            len(f) for f in frames) * len(pushed_peers)
+        self._c_bytes.inc(region_bytes)
+        emit_event(EventKind.REPLICA_PUSHED, step=step,
+                   peers=pushed_peers, bytes=region_bytes,
+                   push_seconds=round(push_s, 3),
+                   replicas=len(pushed_peers),
+                   degraded=bool((plan or {}).get("degraded", False)))
+
+    def _fetch_plan(self) -> Optional[Dict[str, Any]]:
+        try:
+            plan = self._client.get_replica_plan()
+        except Exception as e:  # noqa: BLE001 — a master blip skips one
+            # cycle; the local commit still lands
+            logger.warning("replica plan fetch failed (%s: %s)",
+                           type(e).__name__, e)
+            return self.last_plan
+        if plan is None:
+            return self.last_plan
+        out = {
+            "peers": list(plan.peers or []),
+            "replicas": int(plan.replicas),
+            "requested": int(plan.requested),
+            "group": [int(g) for g in (plan.group or [])],
+            "cadence_steps": int(getattr(plan, "cadence_steps", 0) or 0),
+            "degraded": bool(plan.degraded),
+            "reason": plan.reason or "",
+        }
+        if plan.degraded and (
+            self.last_plan is None
+            or not self.last_plan.get("degraded")
+        ):
+            emit_event(EventKind.REPLICA_PLAN_DEGRADED,
+                       error_code="REPLICA_BUDGET",
+                       replicas=out["replicas"],
+                       requested=out["requested"],
+                       reason=out["reason"])
+        self.last_plan = out
+        return out
+
+    def _push_to_peer(self, addr: str, frames: List[bytes]) -> bool:
+        from dlrover_tpu.common import comm
+
+        channel = self._channel(addr)
+        for frame in frames:
+            try:
+                resp = channel.report(comm.ReplicaPut(
+                    node_id=self.node_id, frame=frame_to_wire(frame)))
+            except Exception as e:  # noqa: BLE001 — the channel already
+                # retried transients; a peer that stays down degrades
+                # THIS cycle's redundancy, it does not fail the worker
+                self._c_push_failures.inc()
+                logger.warning(
+                    "[REPLICA_PEER_DOWN] push to peer %s failed; this "
+                    "cycle ships one replica fewer (%s: %s)",
+                    addr, type(e).__name__, e)
+                emit_event(EventKind.REPLICA_PUSH_FAILED,
+                           error_code="REPLICA_PEER_DOWN", peer=addr,
+                           detail=f"{type(e).__name__}: {e}"[:200])
+                return False
+            if not resp.success:
+                self._c_push_failures.inc()
+                code = ("REPLICA_BUDGET" if resp.reason == "budget"
+                        else "REPLICA_PUT_REFUSED")
+                emit_event(EventKind.REPLICA_PUSH_FAILED,
+                           error_code=code, peer=addr,
+                           detail=resp.reason[:200])
+                return False
+        return True
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._queue.put_nowait(None)
+        except Exception:  # noqa: BLE001 — full queue: sender mid-cycle
+            logger.debug("replicator queue full at stop", exc_info=True)
+        if self._sender is not None:
+            self._sender.join(timeout=5.0)
+        self._close_channels()
+        self._server.stop(grace=0.5)
+
+
+# ---------------------------------------------------------------------------
+# the fetch side: peer rebuild
+# ---------------------------------------------------------------------------
+
+
+def _collect_inventories(endpoints: List[Dict[str, Any]],
+                         channel_factory) -> Dict[str, Dict[str, Any]]:
+    """addr -> inventory for every reachable endpoint (dead holders are
+    skipped, not fatal — fallback is the whole point). An address that
+    failed once is never re-dialed: recovery plans list the full HRW
+    ranking per owner, so one unreachable endpoint would otherwise pay
+    its channel timeout once per OWNER, serially — minutes of pure
+    timeout before any chunk moves."""
+    from dlrover_tpu.common import comm
+
+    out: Dict[str, Dict[str, Any]] = {}
+    failed: set = set()
+    for ep in endpoints:
+        addr = ep.get("addr", "")
+        if not addr or addr in out or addr in failed:
+            continue
+        try:
+            resp = channel_factory(addr).get(comm.ReplicaInfoRequest())
+            out[addr] = json.loads(resp.report_json or "{}")
+        except Exception as e:  # noqa: BLE001 — unreachable holder
+            failed.add(addr)
+            logger.warning("replica inventory fetch from %s failed "
+                           "(%s: %s)", addr, type(e).__name__, e)
+    return out
+
+
+def best_common_step(inventories: Dict[str, Dict[str, Any]]
+                     ) -> Optional[Tuple[int, List[int]]]:
+    """The highest step at which every owner of that step's snapshot
+    group has a committed manifest on SOME reachable holder. Returns
+    (step, sorted owner group) or None."""
+    # step -> owner -> manifest (sweeping EVERY retained step per
+    # owner, not just the newest: mid-push-wave the newest steps are
+    # partially covered and the fully-covered step is the older one)
+    by_step: Dict[int, Dict[int, Dict[str, Any]]] = {}
+    for inv in inventories.values():
+        for owner_key, entry in inv.items():
+            steps = entry.get("steps") or {
+                str(entry["step"]): entry["manifest"]}
+            for step_key, manifest in steps.items():
+                by_step.setdefault(int(step_key), {})[
+                    int(owner_key)] = manifest
+    for step in sorted(by_step, reverse=True):
+        owners = by_step[step]
+        groups = {tuple(m.get("group", [])) for m in owners.values()}
+        if len(groups) != 1:
+            continue
+        group = sorted(next(iter(groups)))
+        if set(owners) == set(group):
+            return step, group
+    return None
+
+
+def fetch_tree(
+    abstract_leaves: List[Any],
+    holders_by_owner: Dict[int, List[Dict[str, Any]]],
+    channel_factory,
+    expected_digest: Optional[str] = None,
+    inventories: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> Tuple[List[np.ndarray], Dict[str, Any], int, int]:
+    """Stream every owner's regions out of its live holders and
+    reassemble the full host tree.
+
+    Per owner: holders are tried in plan order; chunks stream one RPC
+    at a time (each already carrying the transient-retry channel), a
+    corrupt chunk is re-fetched once and then the next holder takes
+    over; a holder whose CHANNEL dies is marked dead for the rest of
+    the fetch (resuming mid-transfer on the next replica — chunks are
+    identical across holders by construction, and later chunks must
+    not re-pay the dead holder's timeout). An owner none of whose
+    holders can produce a complete, checksummed region set raises
+    :class:`PeerRestoreError` (the caller's storage fallback).
+
+    ``inventories``: a pre-collected holder-inventory sweep (see
+    :func:`_collect_inventories` / :func:`best_common_step`) — callers
+    that already peeked the candidate step to run a cheap staleness
+    gate pass it in so the sweep is not paid twice.
+
+    Returns (leaves, snapshot meta, step, bytes_fetched_over_wire).
+    """
+    from dlrover_tpu.common import comm
+
+    reg = get_registry()
+    c_retries = reg.counter(
+        tm.REPLICA_FETCH_RETRIES,
+        help="chunk fetches retried or failed over to the next holder")
+    c_corrupt = reg.counter(tm.REPLICA_CHUNK_CORRUPTIONS)
+    if inventories is None:
+        all_endpoints = [
+            ep for eps in holders_by_owner.values() for ep in eps]
+        inventories = _collect_inventories(all_endpoints, channel_factory)
+    found = best_common_step(inventories)
+    if found is None:
+        raise PeerRestoreError(
+            "no step with full owner coverage on any reachable holder")
+    step, group = found
+    dead_holders: set = set()
+    spec = [{"dtype": np.asarray(x).dtype.str
+             if not hasattr(x, "dtype") else np.dtype(x.dtype).str,
+             "shape": list(x.shape)} for x in abstract_leaves]
+    digest = expected_digest or spec_digest(spec)
+    buffers = [np.zeros(int(np.prod(s["shape"] or [1]))
+                        * np.dtype(s["dtype"]).itemsize, dtype=np.uint8)
+               for s in spec]
+    covered = [0 for _ in spec]
+    meta: Dict[str, Any] = {}
+    wire_bytes = 0
+
+    for owner in group:
+        candidates = [ep for ep in holders_by_owner.get(owner, [])
+                      if ep.get("addr") in inventories
+                      and str(owner) in inventories[ep["addr"]]
+                      and int(inventories[ep["addr"]][str(owner)]["step"])
+                      == step]
+        if not candidates:
+            raise PeerRestoreError(
+                f"owner {owner}: no live holder carries step {step}")
+        manifest = inventories[candidates[0]["addr"]][str(owner)][
+            "manifest"]
+        if manifest.get("spec_digest") != digest:
+            raise PeerRestoreError(
+                f"owner {owner}: snapshot structure "
+                f"{manifest.get('spec_digest')} does not match this "
+                f"trainer's {digest}")
+        if int(owner) == min(group) or not meta:
+            meta = dict(manifest.get("meta", {}))
+        for leaf_key, info in manifest["leaves"].items():
+            leaf = int(leaf_key)
+            for seq in range(int(info["nchunks"])):
+                payload = None
+                for ep in candidates:
+                    addr = ep["addr"]
+                    if addr in dead_holders:
+                        continue
+                    attempts = 0
+                    while attempts < 2 and payload is None:
+                        attempts += 1
+                        try:
+                            resp = channel_factory(addr).get(
+                                comm.ReplicaFetchRequest(
+                                    owner=owner, step=step,
+                                    leaf=leaf, seq=seq))
+                        except Exception as e:  # noqa: BLE001 — holder
+                            # died mid-transfer: fall to the next
+                            # replica, and never come back to this one
+                            # (each visit re-pays the channel timeout)
+                            dead_holders.add(addr)
+                            c_retries.inc()
+                            logger.warning(
+                                "[REPLICA_HOLDER_LOST] holder %s died "
+                                "mid-transfer (owner %d leaf %d chunk "
+                                "%d); falling to the next replica "
+                                "(%s: %s)", addr, owner, leaf, seq,
+                                type(e).__name__, e)
+                            emit_event(
+                                EventKind.REPLICA_HOLDER_LOST,
+                                error_code="REPLICA_HOLDER_LOST",
+                                holder=addr, owner=owner, leaf=leaf,
+                                seq=seq,
+                                detail=f"{type(e).__name__}"[:80])
+                            break
+                        if not getattr(resp, "found", False):
+                            c_retries.inc()
+                            break
+                        raw = frame_from_wire(resp.frame)
+                        try:
+                            header, data = decode_chunk(raw)
+                            # the crc covers only the PAYLOAD — a bit
+                            # flip inside the JSON header can still
+                            # parse. Validate the placement facts
+                            # before trusting them with a buffer
+                            # write: identity, bounds, and the
+                            # length/offset consistency.
+                            lo = int(header["lo"])
+                            hi = int(header["hi"])
+                            leaf_nbytes = len(buffers[leaf])
+                            if (int(header["owner"]) != owner
+                                    or int(header["leaf"]) != leaf
+                                    or int(header["seq"]) != seq
+                                    or not 0 <= lo <= hi <= leaf_nbytes
+                                    or hi - lo != len(data)):
+                                raise ChunkCorruptionError(
+                                    f"header placement invalid: "
+                                    f"owner={header.get('owner')} "
+                                    f"leaf={header.get('leaf')} "
+                                    f"seq={header.get('seq')} "
+                                    f"lo={lo} hi={hi} "
+                                    f"payload={len(data)}")
+                        except ChunkCorruptionError as e:
+                            c_corrupt.inc()
+                            c_retries.inc()
+                            logger.warning(
+                                "[REPLICA_CORRUPT] chunk from %s "
+                                "failed validation (attempt %d): %s",
+                                addr, attempts, e)
+                            continue  # retry the same holder once
+                        payload = (lo, hi, data)
+                        # bytes of the DECODED frame: the base64 wire
+                        # inflation must not pollute the MTTR-vs-bytes
+                        # accounting this counter feeds
+                        wire_bytes += len(raw)
+                    if payload is not None:
+                        break
+                if payload is None:
+                    raise PeerRestoreError(
+                        f"owner {owner} leaf {leaf} chunk {seq}: "
+                        f"exhausted every holder")
+                lo, hi, data = payload
+                buffers[leaf][lo:hi] = np.frombuffer(data, dtype=np.uint8)
+                covered[leaf] += hi - lo
+
+    leaves = []
+    for idx, s in enumerate(spec):
+        expected = int(np.prod(s["shape"] or [1])) * np.dtype(
+            s["dtype"]).itemsize
+        if covered[idx] != expected:
+            raise PeerRestoreError(
+                f"leaf {idx}: fetched {covered[idx]} of {expected} "
+                f"bytes — region coverage incomplete")
+        # copy-free: buffers[idx] is a fresh contiguous uint8 array we
+        # own outright — a dtype view avoids transiently doubling host
+        # memory per leaf on an already-pressured recovering node
+        arr = buffers[idx].view(np.dtype(s["dtype"]))
+        leaves.append(arr.reshape(s["shape"]))
+    return leaves, meta, step, wire_bytes
+
+
+def replica_channel_factory():
+    """The ONE fast-fail channel policy for the replica plane (push and
+    fetch sides share it): a dead peer/holder must cost milliseconds,
+    not the patient master-channel backoff ladder. Returns a caching
+    ``factory(addr) -> RpcChannel`` plus a ``close()`` that tears the
+    cache down."""
+    from dlrover_tpu.rpc.client import RpcChannel
+
+    channels: Dict[str, Any] = {}
+
+    def factory(addr: str):
+        ch = channels.get(addr)
+        if ch is None:
+            ch = RpcChannel(addr, timeout=10.0, retries=2, backoff=0.2)
+            channels[addr] = ch
+        return ch
+
+    def close():
+        for ch in channels.values():
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                logger.debug("replica channel close failed",
+                             exc_info=True)
+        channels.clear()
+
+    return factory, close

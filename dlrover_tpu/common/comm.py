@@ -491,6 +491,107 @@ class TrainerConfigReport:
 
 
 # --------------------------------------------------------------------------
+# peer-redundant host snapshots (checkpoint-free pod-scale recovery)
+# --------------------------------------------------------------------------
+
+
+@message
+class ReplicaEndpointReport:
+    """Worker -> master: this node serves a replica store at ``addr``.
+
+    Re-reported on every push cycle so the master's ReplicaDirectory
+    tracks liveness and snapshot freshness without a second heartbeat
+    channel. ``budget_mb`` is the host-DRAM budget this node grants to
+    PEER replicas (the admission input of the replica plan);
+    ``snapshot_mb`` the size of one full snapshot on this node (the
+    numerator of the per-owner share the plan prices)."""
+
+    node_id: int = -1
+    addr: str = ""
+    budget_mb: float = 0.0
+    snapshot_mb: float = 0.0
+    step: int = -1  # newest replicated (committed) step, -1 = none yet
+    timestamp: float = 0.0
+
+
+@message
+class ReplicaPlanRequest:
+    """Worker -> master: which peers should hold my snapshot regions?"""
+
+    node_id: int = -1
+
+
+@message
+class ReplicaPlan:
+    """The master-chosen, rendezvous-stable peer assignment for one
+    owner. ``replicas`` may be below the configured k when the budget
+    pricing degraded the plan (``degraded``/``reason`` say why) — an
+    infeasible plan ships fewer replicas, never an OOM."""
+
+    owner: int = -1
+    peers: Optional[List[Dict]] = None  # [{"node_id": int, "addr": str}]
+    replicas: int = 0
+    requested: int = 0
+    # the FULL live owner group the byte partition is computed over —
+    # every owner must slice against the same group or the per-owner
+    # regions cannot reassemble (k < n-1 means peers ⊂ group)
+    group: Optional[List[int]] = None
+    # MASTER-computed effective cadence in steps (0 = master has no
+    # step-time series yet; workers fall back to their local knob +
+    # wall floor). One value for the whole cluster: per-node wall
+    # floors drift nodes onto disjoint push-step schedules, and a
+    # rebuild needs ONE step with full owner coverage.
+    cadence_steps: int = 0
+    degraded: bool = False
+    reason: str = ""
+
+
+@message
+class RecoveryPlanRequest:
+    """Rebuilding worker -> master: map every owner's snapshot regions
+    to live replica holders (answered with a DiagnosisReport JSON
+    blob: {"owners": {owner: [endpoints...]}, "replicas": k})."""
+
+    node_id: int = -1
+
+
+@message
+class ReplicaPut:
+    """One length-prefixed, checksummed snapshot chunk (or the commit
+    manifest that seals a step) pushed peer-to-peer into a holder's
+    ReplicaStore. ``frame`` is the base64 chunk frame
+    (``checkpoint.replication.encode_chunk``)."""
+
+    node_id: int = -1  # the PUSHING node (the region owner)
+    frame: str = ""
+
+
+@message
+class ReplicaFetchRequest:
+    """Fetch one stored chunk of a committed snapshot from a holder."""
+
+    owner: int = -1
+    step: int = -1
+    leaf: int = -1
+    seq: int = 0
+
+
+@message
+class ReplicaFrame:
+    frame: str = ""  # base64 chunk frame; "" when not held
+    found: bool = False
+
+
+@message
+class ReplicaInfoRequest:
+    """Holder inventory: which (owner, step) snapshots are committed
+    here, with per-leaf coverage. Answered with a DiagnosisReport
+    JSON blob."""
+
+    owner: int = -1  # -1 = every owner this store holds
+
+
+# --------------------------------------------------------------------------
 # serving (request router + serve workers)
 # --------------------------------------------------------------------------
 

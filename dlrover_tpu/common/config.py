@@ -77,6 +77,34 @@ class Context:
         # (docs/operations.md decision tree). Off = every change takes
         # the process-restart path.
         self.live_recovery = True
+        # peer-redundant host snapshots (checkpoint-free pod-scale
+        # recovery, docs/elasticity.md recovery ladder): how many PEER
+        # DRAM replicas of each node's snapshot regions the master
+        # should assign (0 = plane off). The budget admission can
+        # degrade below this — fewer replicas, never a worker OOM.
+        self.snapshot_replicas = 0
+        # replication cadence: materialized steps between snapshot
+        # pushes, floored by a wall-time interval so a fast-stepping
+        # job cannot tax itself with per-step-scale replication
+        self.replica_cadence_steps = 16
+        self.replica_min_interval_secs = 15.0
+        # host-DRAM budget (MB) this node grants to PEER replicas —
+        # the admission input the master prices plans against (capped
+        # at a quarter of the host's available memory at registration).
+        # 0 = uncapped; NEGATIVE = lend nothing (the node is never a
+        # peer-replica holder, while its OWN regions — budget-exempt
+        # on its store — still replicate out to peers)
+        self.replica_budget_mb = 512.0
+        # chunk size of the replica wire stream (KB): each chunk is
+        # length-prefixed + crc32-checksummed and retried individually
+        self.replica_chunk_kb = 256
+        # port the worker's replica store serves on (0 = ephemeral)
+        self.replica_port = 0
+        # recovering workers try the peer-rebuild path before the
+        # Orbax/mirror restore (only meaningful with replicas > 0);
+        # a stale peer snapshot older than the newest checkpoint
+        # falls back to storage
+        self.peer_restore = True
         # what to do on a non-finite step after reporting the failure:
         # "halt" | "rollback" (restore last checkpoint) | "ignore"
         self.on_nonfinite = "halt"

@@ -100,14 +100,88 @@ class FlakyStats:
 
 def make_flaky(channel, drop_rate: float = 0.3, seed: int = 0) -> FlakyStats:
     """Patch an ``RpcChannel`` in place so its raw grpc callables fail a
-    deterministic fraction of the time. Injects BELOW the ``retry_rpc``
-    decorator (which wraps ``channel.get/report``), so the production
-    retry path is what absorbs the faults. Returns the stats counter."""
+    deterministic fraction of the time. Injects BELOW the channel's
+    retry layer (``RpcChannel._invoke`` wraps ``get/report``), so the
+    production retry path is what absorbs the faults. Returns the
+    stats counter."""
     stats = FlakyStats()
     rng = random.Random(seed)
     channel._get = _FlakyCallable(channel._get, rng, drop_rate, stats)
     channel._report = _FlakyCallable(channel._report, rng, drop_rate, stats)
     return stats
+
+
+class _DyingCallable:
+    """A raw grpc callable that dies PERMANENTLY after ``after_calls``
+    successful invocations — the mid-transfer-holder-death model: the
+    holder streams some chunks, then its process is gone and every
+    later call fails with UNAVAILABLE (not a one-off blip a retry
+    absorbs — the fetcher must fall over to the NEXT replica)."""
+
+    def __init__(self, inner, after_calls: int, stats: "FlakyStats"):
+        self._inner = inner
+        self._remaining = int(after_calls)
+        self._stats = stats
+
+    def __call__(self, *args, **kwargs):
+        if self._remaining <= 0:
+            self._stats.injected += 1
+            raise _InjectedUnavailable()
+        self._remaining -= 1
+        return self._inner(*args, **kwargs)
+
+
+def kill_channel_after(channel, after_calls: int) -> FlakyStats:
+    """Patch an ``RpcChannel`` so its raw callables serve exactly
+    ``after_calls`` more requests EACH and then die for good (below
+    the retry layer, like every injector here). Models a replica
+    holder lost MID-TRANSFER — fetch-side (get) or push-side
+    (report). Returns the injection counter."""
+    stats = FlakyStats()
+    channel._get = _DyingCallable(channel._get, after_calls, stats)
+    channel._report = _DyingCallable(channel._report, after_calls, stats)
+    return stats
+
+
+def corrupt_replica_chunk(store, owner: int, index: int = 0,
+                          seed: int = 0) -> Optional[tuple]:
+    """Flip one payload byte of a COMMITTED chunk inside a live
+    ReplicaStore — silent DRAM bitrot on a holder. The frame's crc32
+    must catch it at fetch time (the fetcher retries, then falls to
+    the next holder); returns the (leaf, seq) corrupted, or None."""
+    import random as _random
+
+    with store._lock:
+        entries = store._committed.get(int(owner)) or []
+        entry = entries[0] if entries else None  # newest retained step
+        if not entry or not entry["chunks"]:
+            return None
+        keys = sorted(entry["chunks"])
+        key = keys[index % len(keys)]
+        frame = bytearray(entry["chunks"][key])
+        # flip a byte INSIDE the payload (past the 4-byte length prefix
+        # and the JSON header), so the header still parses and only the
+        # crc check can notice
+        import struct as _struct
+
+        (hlen,) = _struct.unpack_from(">I", frame, 0)
+        payload_start = 4 + hlen
+        if payload_start >= len(frame):
+            return None
+        off = payload_start + _random.Random(seed).randrange(
+            len(frame) - payload_start)
+        frame[off] ^= 0xFF
+        entry["chunks"][key] = bytes(frame)
+    logger.info("chaos: flipped a payload byte of replica chunk "
+                "owner=%d leaf=%d seq=%d", owner, key[0], key[1])
+    return key
+
+
+def freeze_replicator(replicator) -> None:
+    """Pause a SnapshotReplicator's push cycles (the expired-cadence
+    fault: the job keeps training while its replicas go stale)."""
+    replicator.paused = True
+    logger.info("chaos: snapshot replicator frozen (cadence expired)")
 
 
 # ---------------------------------------------------------------------------

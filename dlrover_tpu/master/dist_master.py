@@ -122,6 +122,11 @@ class DistributedJobMaster:
         # resize itself rides the serving live-resize path
         self.servicer.serving_scale_policy.attach_auto_scaler(
             self.job_auto_scaler)
+        # node-lifecycle loss signals (watcher events, failure reports,
+        # heartbeat-loss relaunches) feed the replica directory, so
+        # recovery plans stop pointing fetchers at dead holders
+        self.job_manager.replica_directory = (
+            self.servicer.replica_directory)
         self._stopped = threading.Event()
         self._exit_reason = ""
         self._ctx = get_context()

@@ -91,6 +91,12 @@ class DistributedJobManager(JobManager):
         self._slice_relaunches: Dict[int, int] = {}
         self.max_relaunch_count = self._ctx.max_relaunch_count
         self.error_monitor = ErrorLogMonitor()
+        # the peer-replication plane's view of node liveness: attached
+        # by the master (servicer.replica_directory) so every
+        # lifecycle-level loss signal this manager sees — watcher
+        # FAILED/DELETED events, agent failure reports, heartbeat-loss
+        # relaunches — also excludes the node from replica holder lists
+        self.replica_directory = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -237,8 +243,22 @@ class DistributedJobManager(JobManager):
             node.name, flow.from_status, flow.to_status, node.exit_reason,
         )
         self._fire_callbacks(node, new_status)
+        self._note_replica_liveness(node, new_status)
         if flow.should_relaunch and self._should_relaunch(node):
             self._relaunch_node(node)
+
+    def _note_replica_liveness(self, node: Node, status: str):
+        """Feed node-lifecycle transitions into the replica directory:
+        a dead/failed worker must drop out of recovery-plan holder
+        lists immediately (a fetcher pointed at its DRAM can only burn
+        the fallback ladder), and a node seen RUNNING again is a
+        holder candidate once it re-registers its endpoint."""
+        if self.replica_directory is None or node.type != NodeType.WORKER:
+            return
+        if status in (
+            NodeStatus.FAILED, NodeStatus.DELETED, NodeStatus.BREAKDOWN,
+        ):
+            self.replica_directory.mark_failed(node.id)
 
     def _fire_callbacks(self, node: Node, status: str):
         ctx = ClusterContext(self)
@@ -329,6 +349,7 @@ class DistributedJobManager(JobManager):
         if node is None:
             return
         node.update_reported_status(NodeStatus.FAILED)
+        self._note_replica_liveness(node, NodeStatus.FAILED)
         # Remember the classified reason so the relaunch decision (made
         # when the watcher sees the pod die) applies the right policy
         # (OOM memory bump, fatal no-relaunch, hardware cordon).

@@ -1,0 +1,254 @@
+"""Master-side replica directory: who holds whose snapshot regions.
+
+The worker side (``checkpoint.replication``) pushes and serves bytes;
+this directory owns the two decisions that must be cluster-consistent:
+
+1. **Assignment** — each owner's k replica peers, chosen by rendezvous
+   (HRW) hashing over the registered group: every (owner, peer) pair
+   gets a stable hash rank, so a node joining or leaving only remaps
+   the pairs that involve it. A resize does NOT reshuffle the whole
+   assignment — replicas that survived the change stay valid, which is
+   what makes the plan "rendezvous-stable" across elasticity.
+2. **Admission** — the replica budget is priced against the hosts'
+   declared DRAM budgets (the PR 8 host-accounting posture) BEFORE a
+   plan ships: with k replicas each holder carries k × (snapshot /
+   group) bytes of peer state; if any holder's declared budget cannot
+   fit its share, k degrades until the plan fits (terminally to 0,
+   plane off) with a logged verdict — an infeasible plan ships fewer
+   replicas, it never OOMs a worker.
+
+On a node-loss verdict (the PR 6 diagnosis plane's hang verdicts, or a
+hard failure report through the servicer/job manager), the lost node is
+excluded from holder lists, and ``recovery_plan`` maps every owner's
+regions to the surviving holders a rebuilding worker should stream
+from — owner first when alive (its own store has its freshest regions),
+then its HRW peers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("master.replication")
+
+
+def hrw_peers(owner: int, group: List[int], k: int) -> List[int]:
+    """Highest-random-weight peer ranking: deterministic, stable under
+    membership changes (a departed node drops out of the ranking
+    without permuting the survivors' relative order)."""
+    others = [n for n in sorted(set(group)) if n != owner]
+
+    def weight(peer: int) -> str:
+        return hashlib.md5(f"{owner}|{peer}".encode()).hexdigest()
+
+    return sorted(others, key=weight)[:max(0, k)]
+
+
+class ReplicaDirectory:
+    """Registered replica endpoints + the assignment/admission logic."""
+
+    def __init__(self, liveness_secs: float = 600.0):
+        self._lock = threading.Lock()
+        self._liveness = float(liveness_secs)
+        # node_id -> {"addr", "budget_mb", "snapshot_mb", "step", "ts"}
+        self._nodes: Dict[int, Dict[str, Any]] = {}
+        self._failed: set = set()
+        self._last_degraded: Optional[int] = None
+
+    # -- ingest --------------------------------------------------------------
+
+    def register(self, node_id: int, addr: str, budget_mb: float,
+                 snapshot_mb: float, step: int,
+                 ts: Optional[float] = None):
+        with self._lock:
+            self._nodes[int(node_id)] = {
+                "addr": addr, "budget_mb": float(budget_mb),
+                "snapshot_mb": float(snapshot_mb), "step": int(step),
+                "ts": float(ts if ts is not None else time.time()),
+            }
+            # a re-registering node is alive again, whatever we thought
+            self._failed.discard(int(node_id))
+
+    def mark_failed(self, node_id: int):
+        """Exclude a node from holder lists (hard failure report or a
+        diagnosis hang verdict): its DRAM is gone or unreachable, so a
+        recovery plan must not send fetchers there first."""
+        with self._lock:
+            if int(node_id) in self._nodes:
+                self._failed.add(int(node_id))
+
+    def on_verdict(self, node_id: int, verdict: str):
+        """StragglerDetector verdict listener: a node-hang verdict is
+        the diagnosis plane's node-loss signal; recovery ("healthy")
+        restores the node to the holder pool."""
+        from dlrover_tpu.master.monitor.straggler import (
+            VERDICT_HEALTHY,
+            VERDICT_HUNG,
+        )
+
+        if verdict == VERDICT_HUNG:
+            self.mark_failed(node_id)
+        elif verdict == VERDICT_HEALTHY:
+            with self._lock:
+                self._failed.discard(int(node_id))
+
+    # -- views ---------------------------------------------------------------
+
+    def _live(self) -> List[int]:
+        """Endpoints alive right now."""
+        now = time.time()
+        return sorted(
+            n for n, info in self._nodes.items()
+            if n not in self._failed
+            and now - info["ts"] <= self._liveness
+        )
+
+    def _lends_dram(self, node_id: int) -> bool:
+        """A node with a NEGATIVE declared budget lends no DRAM to
+        peers: never a PEER-replica holder (it still serves its own
+        regions — self commits are budget-exempt on the store)."""
+        return self._nodes[node_id]["budget_mb"] >= 0
+
+    def _owners(self) -> List[int]:
+        """Nodes that own snapshot regions (they declared a snapshot
+        size). A store-only endpoint — a peer lending DRAM without
+        training state of its own — is a holder candidate but never
+        part of the byte partition: a partition that counted it would
+        wait forever for regions it will never push."""
+        return sorted(
+            n for n, info in self._nodes.items()
+            if info["snapshot_mb"] > 0
+        )
+
+    def admitted_replicas(self, requested: int) -> Dict[str, Any]:
+        """Price the replica budget BEFORE admitting a plan: degrade k
+        until every holder's declared DRAM budget fits its share."""
+        with self._lock:
+            live = self._live()
+            lenders = [n for n in live if self._lends_dram(n)]
+            owners = [n for n in self._owners() if n in set(live)]
+            group = owners or live
+            if len(live) < 2 or not lenders:
+                return {"replicas": 0, "requested": requested,
+                        "group": group, "live": lenders,
+                        "degraded": requested > 0,
+                        "reason": "fewer than 2 live replica endpoints"}
+            share_mb = {
+                n: self._nodes[n]["snapshot_mb"] / max(1, len(group))
+                for n in group
+            }
+            k = min(int(requested), max(0, len(lenders) - 1),
+                    len(live) - 1)
+            reason = ""
+            while k > 0:
+                load = {n: 0.0 for n in lenders}
+                for owner in group:
+                    for peer in hrw_peers(owner, lenders, k):
+                        load[peer] += share_mb.get(owner, 0.0)
+                over = [
+                    n for n in lenders
+                    if self._nodes[n]["budget_mb"] > 0
+                    and load[n] > self._nodes[n]["budget_mb"]
+                ]
+                if not over:
+                    break
+                worst = max(over, key=lambda n: load[n])
+                reason = (
+                    f"holder {worst} budget "
+                    f"{self._nodes[worst]['budget_mb']:.0f} MB < "
+                    f"assigned {load[worst]:.0f} MB at k={k}"
+                )
+                k -= 1
+            degraded = k < int(requested)
+            # "live" is the PEER-holder candidate pool: only nodes
+            # that lend DRAM (plan_for draws assignments from it)
+            return {"replicas": k, "requested": int(requested),
+                    "group": group, "live": lenders,
+                    "degraded": degraded,
+                    "reason": reason if degraded else ""}
+
+    def plan_for(self, node_id: int, requested: int) -> Dict[str, Any]:
+        admitted = self.admitted_replicas(requested)
+        k = admitted["replicas"]
+        group = sorted(set(admitted["group"]) | {int(node_id)})
+        with self._lock:
+            peers = [
+                {"node_id": p, "addr": self._nodes[p]["addr"]}
+                for p in hrw_peers(
+                    int(node_id), admitted.get("live", []), k)
+                if p in self._nodes
+            ]
+        if admitted["degraded"] and self._last_degraded != k:
+            self._last_degraded = k
+            logger.warning(
+                "replica plan degraded to k=%d (requested %d): %s",
+                k, requested, admitted["reason"] or "not enough peers",
+            )
+        return {**admitted, "owner": int(node_id), "group": group,
+                "peers": peers}
+
+    def recovery_plan(self, requested: int,
+                      for_node: int = -1) -> Dict[str, Any]:
+        """Owner -> ordered live holder endpoints. Order per owner: the
+        owner itself when alive (its own store holds its freshest
+        regions), then its HRW peers — failed/dead nodes excluded, so a
+        fetcher walks exactly the fallback ladder the assignment
+        promised. Owners include DEAD nodes: the lost node's regions
+        are precisely what a rebuild needs, served by its surviving
+        peers."""
+        with self._lock:
+            now = time.time()
+            live = set(
+                n for n, info in self._nodes.items()
+                if n not in self._failed
+                and now - info["ts"] <= self._liveness
+            )
+            owner_ids = sorted(
+                n for n, info in self._nodes.items()
+                if info["snapshot_mb"] > 0
+            )
+            # peer candidates must LEND DRAM; the owner itself is a
+            # valid holder regardless (its own regions are budget-
+            # exempt on its store)
+            holder_pool = sorted(
+                n for n in self._nodes if self._lends_dram(n))
+            k = min(int(requested), max(0, len(holder_pool) - 1))
+            owners: Dict[str, List[Dict[str, Any]]] = {}
+            for owner in owner_ids:
+                # the FULL HRW ranking, not top-k: pushes were assigned
+                # over the live set AT PUSH TIME, which may differ from
+                # today's pool (a node failed before the push reshapes
+                # the top-k) — truncating here could omit the one peer
+                # that actually holds the data. Listing every live node
+                # costs the fetcher only cheap inventory RPCs; the
+                # inventory sweep picks the holders that really carry
+                # the step.
+                candidates = [owner] + hrw_peers(
+                    owner, holder_pool, len(holder_pool))
+                owners[str(owner)] = [
+                    {"node_id": c, "addr": self._nodes[c]["addr"]}
+                    for c in candidates if c in live
+                ]
+            return {
+                "owners": owners,
+                "replicas": k,
+                "group": owner_ids,
+                "live": sorted(live),
+                "failed": sorted(self._failed),
+                "for_node": int(for_node),
+            }
+
+    def to_report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "nodes": {
+                    str(n): {k: v for k, v in info.items()}
+                    for n, info in self._nodes.items()
+                },
+                "failed": sorted(self._failed),
+            }

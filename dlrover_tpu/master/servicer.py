@@ -13,7 +13,10 @@ import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common import comm
-from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.constants import (
+    RendezvousName,
+    TrainingExceptionLevel,
+)
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
 from dlrover_tpu.master.elastic_training.kv_store import KVStoreService
@@ -81,6 +84,15 @@ class MasterServicer:
         )
         self.straggler_detector.add_verdict_listener(
             self.runtime_optimizer.on_verdict)
+        # the peer-redundancy plane: replica endpoint directory + the
+        # rendezvous-stable assignment / budget admission / recovery
+        # mapping (checkpoint-free pod-scale recovery). Diagnosis hang
+        # verdicts are its node-loss signal.
+        from dlrover_tpu.master.replication import ReplicaDirectory
+
+        self.replica_directory = ReplicaDirectory()
+        self.straggler_detector.add_verdict_listener(
+            self.replica_directory.on_verdict)
         # the serving request plane: the PR 9 dispatch ledger
         # generalized into a request router (enqueue/lease/complete,
         # dead-worker re-lease, per-request latency accounting)
@@ -137,6 +149,8 @@ class MasterServicer:
             comm.PlanRequest: self._get_plan,
             comm.AttributionRequest: self._get_attribution,
             comm.DataShardRequest: self._get_data_report,
+            comm.ReplicaPlanRequest: self._get_replica_plan,
+            comm.RecoveryPlanRequest: self._get_recovery_plan,
             comm.ServeLeaseRequest: self._serve_lease,
             comm.ServeReportRequest: self._get_serve_report,
             comm.ServeSLORequest: self._get_serve_slo,
@@ -165,6 +179,7 @@ class MasterServicer:
             comm.JobExitRequest: self._request_job_exit,
             comm.ParallelConfig: self._set_parallel_config,
             comm.TrainerConfigReport: self._report_trainer_config,
+            comm.ReplicaEndpointReport: self._report_replica_endpoint,
             comm.ServeSubmit: self._serve_submit,
             comm.ServeResult: self._serve_complete,
             comm.ServeTouch: self._serve_touch,
@@ -432,8 +447,89 @@ class MasterServicer:
 
     # -- failures / monitoring ---------------------------------------------
 
+    # -- peer-redundant host snapshots ---------------------------------------
+
+    def _report_replica_endpoint(self, req: comm.ReplicaEndpointReport):
+        self.replica_directory.register(
+            req.node_id, req.addr, req.budget_mb, req.snapshot_mb,
+            req.step, ts=req.timestamp or time.time(),
+        )
+        return comm.Response(success=True)
+
+    @staticmethod
+    def _configured_replicas() -> int:
+        from dlrover_tpu.common.config import get_context
+
+        return int(getattr(get_context(), "snapshot_replicas", 0))
+
+    def _replica_cadence_steps(self) -> int:
+        """The cluster-wide effective replication cadence: the base
+        step cadence, stretched so one cycle spans at least the wall
+        floor at the cluster's MEDIAN step time. Computed HERE — one
+        value for every node — because per-node wall floors drift
+        push schedules apart (a node that barely misses its floor
+        skips to the next multiple) and a rebuild needs ONE step with
+        full owner coverage. The multiplier is quantized to a power of
+        two so small drifts of the measured median cannot hand two
+        nodes different cadences. 0 = no step-time series yet (workers
+        fall back to their local knob + wall floor)."""
+        import math
+
+        from dlrover_tpu.common.config import get_context
+
+        ctx = get_context()
+        base = max(1, int(getattr(ctx, "replica_cadence_steps", 16)))
+        floor_s = float(getattr(
+            ctx, "replica_min_interval_secs", 15.0))
+        if floor_s <= 0:
+            return base
+        p50s = []
+        for sample in self.node_runtime_store.summary().values():
+            if not sample or not sample.get("step_p50"):
+                continue
+            if sample.get("node_type") == "serve":
+                # serving samples carry DECODE-step percentiles (ms
+                # scale): letting them anchor the median would inflate
+                # the cadence multiplier by orders of magnitude on a
+                # colocated train+serve master
+                continue
+            p50s.append(float(sample["step_p50"]))
+        if not p50s:
+            return 0
+        med = sorted(p50s)[len(p50s) // 2]
+        mult = max(1, math.ceil(floor_s / max(1e-9, base * med)))
+        mult = 1 << (mult - 1).bit_length()
+        return base * mult
+
+    def _get_replica_plan(self, req: comm.ReplicaPlanRequest):
+        plan = self.replica_directory.plan_for(
+            req.node_id, self._configured_replicas())
+        return comm.ReplicaPlan(
+            owner=plan["owner"], peers=plan["peers"],
+            replicas=plan["replicas"], requested=plan["requested"],
+            group=list(plan["group"]),
+            cadence_steps=self._replica_cadence_steps(),
+            degraded=plan["degraded"],
+            reason=plan["reason"],
+        )
+
+    def _get_recovery_plan(self, req: comm.RecoveryPlanRequest):
+        import json as _json
+
+        plan = self.replica_directory.recovery_plan(
+            self._configured_replicas(), for_node=req.node_id)
+        return comm.DiagnosisReport(report_json=_json.dumps(plan))
+
     def _report_failure(self, req: comm.NodeFailure):
         self._c_failure_reports.inc()
+        # a hard node/process failure is the replica plane's node-loss
+        # signal too: recovery plans must stop pointing fetchers at the
+        # dead node's store
+        if req.level in (
+            TrainingExceptionLevel.NODE_ERROR,
+            TrainingExceptionLevel.PROCESS_ERROR,
+        ):
+            self.replica_directory.mark_failed(req.node_id)
         logger.warning(
             "node %d (rank %d) failure level=%s restart=%d: %s",
             req.node_id, req.node_rank, req.level, req.restart_count,

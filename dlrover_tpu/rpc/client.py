@@ -6,7 +6,6 @@ Role parity: the stub + ``retry_grpc_request`` decorator of
 
 from __future__ import annotations
 
-import functools
 import time
 from typing import Any, Optional
 
@@ -27,36 +26,45 @@ _TRANSIENT_CODES = {
 }
 
 
-def retry_rpc(retries: int = 5, backoff: float = 1.0):
-    """Retry transient RPC failures with linear backoff; non-transient
-    codes (bad method, serialization errors, ...) raise immediately."""
+def _retry_counter():
+    """The retry-budget counter (lazy: telemetry may be configured after
+    this module imports). Null-object when telemetry is off."""
+    from dlrover_tpu.telemetry import get_registry, names as tm
 
-    def decorator(fn):
-        @functools.wraps(fn)
-        def wrapped(*args, **kwargs):
-            for i in range(retries):
-                try:
-                    return fn(*args, **kwargs)
-                except grpc.RpcError as e:
-                    if e.code() not in _TRANSIENT_CODES or i == retries - 1:
-                        raise
-                    logger.warning(
-                        "rpc %s failed (%s), retry %d/%d",
-                        fn.__name__, e.code(), i + 1, retries,
-                    )
-                    time.sleep(backoff * (i + 1))
+    return get_registry().counter(
+        tm.RPC_RETRIES,
+        help="transient master-RPC retries taken by the client channel")
 
-        return wrapped
 
-    return decorator
+def retry_backoff_s(attempt: int, backoff: float = 1.0,
+                    cap: float = 30.0) -> float:
+    """Jittered exponential backoff for retry ``attempt`` (0-based):
+    ``backoff * 2^attempt`` capped at ``cap``, scaled by a uniform
+    [0.5, 1.0) draw. The jitter is the load-bearing part: a master blip
+    hits EVERY worker at once, and the old fixed-sleep schedule
+    re-synchronized the whole fleet into retry stampedes that landed on
+    the recovering master together — per-worker random spread breaks
+    the thundering herd."""
+    import random
+
+    return min(cap, backoff * (2.0 ** attempt)) * random.uniform(0.5, 1.0)
 
 
 class RpcChannel:
-    """A thin two-method channel: ``get(msg)`` and ``report(msg)``."""
+    """A thin two-method channel: ``get(msg)`` and ``report(msg)``.
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    ``retries``/``backoff`` tune the transient-failure policy per
+    channel: the master channel keeps the patient default, while e.g.
+    the replica fetch path runs a fast-fail channel (a dead holder
+    should fall through to the next replica in milliseconds, not burn
+    the full backoff ladder)."""
+
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 retries: int = 5, backoff: float = 1.0):
         self.addr = addr
         self._timeout = timeout
+        self._retries = max(1, int(retries))
+        self._backoff = float(backoff)
         self._channel = grpc.insecure_channel(
             addr,
             options=[
@@ -89,25 +97,37 @@ class RpcChannel:
         tid = current_trace_id()
         return ((TRACE_ID_METADATA_KEY, tid),) if tid else None
 
-    @retry_rpc()
-    def get(self, msg: Any) -> Any:
+    def _invoke(self, method, verb: str, msg: Any) -> Any:
         # spans cover every master RPC — shard-dispatch get_task, comm
         # world polls, kv ops — at the one choke point (SpanName.RPC)
         from dlrover_tpu.telemetry import SpanName, span
 
-        with span(f"{SpanName.RPC}.get.{type(msg).__name__}",
-                  category="rpc"):
-            return self._get(msg, timeout=self._timeout,
-                             metadata=self._trace_metadata())
+        for i in range(self._retries):
+            try:
+                with span(f"{SpanName.RPC}.{verb}.{type(msg).__name__}",
+                          category="rpc"):
+                    return method(msg, timeout=self._timeout,
+                                  metadata=self._trace_metadata())
+            except grpc.RpcError as e:
+                if (
+                    e.code() not in _TRANSIENT_CODES
+                    or i == self._retries - 1
+                ):
+                    raise
+                _retry_counter().inc()
+                delay = retry_backoff_s(i, backoff=self._backoff)
+                logger.warning(
+                    "rpc %s %s failed (%s), retry %d/%d in %.2fs",
+                    verb, type(msg).__name__, e.code(), i + 1,
+                    self._retries, delay,
+                )
+                time.sleep(delay)
 
-    @retry_rpc()
+    def get(self, msg: Any) -> Any:
+        return self._invoke(self._get, "get", msg)
+
     def report(self, msg: Any) -> Response:
-        from dlrover_tpu.telemetry import SpanName, span
-
-        with span(f"{SpanName.RPC}.report.{type(msg).__name__}",
-                  category="rpc"):
-            return self._report(msg, timeout=self._timeout,
-                                metadata=self._trace_metadata())
+        return self._invoke(self._report, "report", msg)
 
     def close(self):
         self._channel.close()

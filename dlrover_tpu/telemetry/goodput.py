@@ -48,6 +48,7 @@ from dlrover_tpu.telemetry.names import EventKind
 BUCKET_PRIORITY = (
     "restart",
     "reshard",
+    "peer_rebuild",
     "replan",
     "rollback",
     "preempt_drain",
@@ -66,6 +67,10 @@ _SCENARIO_BUCKET = {
     # the serving world's live resize is reshard-class downtime: the
     # decode stream pauses while params+KV pages move meshes
     "serving_resize": "reshard",
+    # checkpoint-free recovery: peer-fetch + device_put time of a
+    # rebuilding worker (its own bucket — it runs AFTER the restart
+    # incident closes at workers_started, so restart never claims it)
+    "peer_rebuild": "peer_rebuild",
     # a runtime-optimizer plan applying live (drain -> retune -> resume)
     "replan": "replan",
     "nonfinite_rollback": "rollback",

@@ -40,6 +40,16 @@ _PAIRINGS = {
         {EventKind.PREEMPT_DRAIN_DONE}, "preemption_drain"),
     EventKind.LIVE_RESHARD_BEGIN: (
         {EventKind.LIVE_RESHARD_DONE}, "live_reshard"),
+    # checkpoint-free recovery: a rebuilding worker streaming its state
+    # out of surviving peers' DRAM instead of an Orbax restore (the
+    # recovery-ladder rung between live reshard and storage restore).
+    # FALLBACK also closes the incident: a mid-transfer terminal
+    # failure degrades to the storage rung — the rebuild attempt is
+    # over either way, and an open incident would wrongly flag a
+    # by-design degradation as unrecovered.
+    EventKind.PEER_REBUILD_BEGIN: (
+        {EventKind.PEER_REBUILD_DONE, EventKind.PEER_REBUILD_FALLBACK},
+        "peer_rebuild"),
     # a runtime-optimizer plan applying live (drain -> retune/reshard ->
     # resume): not a failure, but downtime the loop chose to spend — the
     # ledger and the recovery report must both see it

@@ -51,6 +51,34 @@ SNAPSHOT_TIME = "dlrover_state_snapshot_seconds"
 PROGRAM_CACHE_HITS = "dlrover_program_cache_hits_total"
 PROGRAM_CACHE_MISSES = "dlrover_program_cache_misses_total"
 
+# -- peer-redundant host snapshots (checkpoint-free recovery) -----------------
+# Worker side: the SnapshotReplicator's push cycles and its in-DRAM
+# ReplicaStore; fetch side: the peer-rebuild stream a recovering worker
+# runs instead of an Orbax restore.
+
+REPLICA_PUSHES = "dlrover_replica_pushes_total"
+REPLICA_PUSH_FAILURES = "dlrover_replica_push_failures_total"
+REPLICA_PUSH_TIME = "dlrover_replica_push_seconds"
+REPLICA_BYTES_PUSHED = "dlrover_replica_bytes_pushed_total"
+# peer-replica bytes resident in this worker's DRAM (budget-bounded:
+# admission degrades the plan before this can OOM a worker)
+REPLICA_STORE_BYTES = "dlrover_replica_store_bytes"
+# chunk frames rejected by the length-prefix/crc32 checks (holder-side
+# on put, fetcher-side on read — silent bitrot becomes a counted fault)
+REPLICA_CHUNK_CORRUPTIONS = "dlrover_replica_chunk_corruptions_total"
+# chunk fetches retried or failed over to the next replica holder
+REPLICA_FETCH_RETRIES = "dlrover_replica_fetch_retries_total"
+# the checkpoint-free rebuild itself: peer-fetch + device_put wall
+# seconds, and the bytes streamed out of peer DRAM (vs storage: 0)
+PEER_REBUILD_TIME = "dlrover_peer_rebuild_seconds"
+PEER_REBUILD_BYTES = "dlrover_peer_rebuild_bytes_fetched_total"
+
+# -- rpc client ---------------------------------------------------------------
+
+# transient-RPC retries taken by the client channel (the retry budget
+# spent): a synchronized burst after a master blip shows here first
+RPC_RETRIES = "dlrover_rpc_retries_total"
+
 # -- persistent XLA compile cache ---------------------------------------------
 
 COMPILE_CACHE_HITS = "dlrover_compile_cache_hits_total"
@@ -303,6 +331,22 @@ class EventKind:
     # agent chose to delegate a survivable membership change to the
     # workers' in-process reshard instead of restarting them
     LIVE_RESHARD_DELEGATED = "live_reshard_delegated"
+    # peer-redundant host snapshots. PUSHED records a completed
+    # replication cycle (step, peers, bytes); the failure-class edges
+    # (DLR008: all carry error codes) mark a peer push that could not
+    # land (dead peer / budget refusal), a budget-degraded plan, and a
+    # holder dying mid-fetch (the fallback-to-next-replica edge).
+    # PEER_REBUILD_BEGIN -> PEER_REBUILD_DONE bracket the checkpoint-
+    # free recovery (the mttr "peer_rebuild" scenario);
+    # PEER_REBUILD_FALLBACK is the terminal degradation to the
+    # Orbax/mirror storage path.
+    REPLICA_PUSHED = "replica_pushed"
+    REPLICA_PUSH_FAILED = "replica_push_failed"
+    REPLICA_PLAN_DEGRADED = "replica_plan_degraded"
+    REPLICA_HOLDER_LOST = "replica_holder_lost"
+    PEER_REBUILD_BEGIN = "peer_rebuild_begin"
+    PEER_REBUILD_DONE = "peer_rebuild_done"
+    PEER_REBUILD_FALLBACK = "peer_rebuild_fallback"
     # preemption (failure edge -> recovery edge)
     PREEMPT_NOTICE = "preempt_notice"
     PREEMPT_DRAIN_DONE = "preempt_drain_done"

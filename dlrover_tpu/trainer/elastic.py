@@ -357,11 +357,20 @@ class ElasticTrainer:
         return record
 
     def prepare(self, state: Any = None) -> Any:
-        """Compile for the current world; restore or init state."""
+        """Compile for the current world; restore or init state.
+
+        Restore ladder (docs/elasticity.md): peer rebuild first — the
+        checkpoint-free path that streams state out of surviving peers'
+        DRAM (``checkpoint.replication``), taken when replicas are
+        configured and at least as fresh as the newest checkpoint —
+        then the Orbax/host-mirror restore, then a fresh init."""
         self._result = self._build(self._devices)
         if state is not None:
             self._host_step = int(state.step)
             return state
+        restored = self._try_peer_restore()
+        if restored is not None:
+            return restored
         if self._ckpt is not None:
             restored = self._try_restore()
             if restored is not None:
@@ -392,6 +401,146 @@ class ElasticTrainer:
         self._host_step = int(out["state"].step)
         return out["state"]
 
+    def _try_peer_restore(self) -> Optional[Any]:
+        """The checkpoint-free recovery path: ask the master which live
+        peers hold replicated snapshot regions, stream them (chunked,
+        checksummed, holder-fallback), and ``device_put`` the rebuilt
+        host tree against THIS mesh's shardings — the same
+        sharding-agnostic landing an Orbax reshard-on-load performs,
+        minus the storage round-trip. Returns the rebuilt state, or
+        None to degrade to the storage path (no replicas configured,
+        none reachable, structure mismatch, or the peers' snapshot is
+        STALER than the newest committed checkpoint)."""
+        from dlrover_tpu.common.config import get_context
+
+        ctx = get_context()
+        if (
+            self._master_client is None
+            or not getattr(ctx, "peer_restore", True)
+            or int(getattr(ctx, "snapshot_replicas", 0)) <= 0
+            or not hasattr(self._master_client, "get_recovery_plan")
+        ):
+            return None
+        from dlrover_tpu.checkpoint import replication as repl
+        from dlrover_tpu.diagnosis.hang_detector import announce_long_phase
+
+        try:
+            plan = self._master_client.get_recovery_plan()
+        except Exception as e:  # noqa: BLE001 — no master, no peers:
+            # the storage ladder below still recovers the job
+            logger.warning("recovery plan fetch failed (%s: %s); taking "
+                           "the storage path", type(e).__name__, e)
+            return None
+        owners = {
+            int(k): list(v or [])
+            for k, v in (plan.get("owners") or {}).items()
+        }
+        if not owners or not any(owners.values()):
+            return None
+        announce_long_phase(600.0)  # rebuild window: not a hang
+        abstract = jax.eval_shape(
+            lambda r: self._result.init_fn(r), self._rng
+        )
+        flat, treedef = jax.tree_util.tree_flatten(abstract)
+        # the plane's ONE fast-fail channel policy (a dead holder must
+        # fall through to the next replica quickly, not burn the
+        # patient master backoff ladder)
+        channel_factory, close_channels = repl.replica_channel_factory()
+        t0 = time.monotonic()
+        try:
+            # cheap inventory sweep first: the candidate step is known
+            # BEFORE any chunk moves, so the staleness gate below can
+            # veto the transfer without paying for it
+            all_endpoints = [ep for eps in owners.values() for ep in eps]
+            inventories = repl._collect_inventories(
+                all_endpoints, channel_factory)
+            found = repl.best_common_step(inventories)
+            if found is None:
+                raise repl.PeerRestoreError(
+                    "no step with full owner coverage on any "
+                    "reachable holder")
+            peek_step = found[0]
+            # staleness gate: a frozen replicator (expired cadence)
+            # must not roll the job back past a newer committed
+            # checkpoint — the one storage touch here is a step
+            # LISTING, not a state transfer
+            if self._ckpt is not None:
+                try:
+                    ckpt_step = self._ckpt.latest_step()
+                except Exception:  # noqa: BLE001 — unreachable storage
+                    # cannot veto the in-DRAM copy on offer
+                    logger.warning("checkpoint step listing failed "
+                                   "during peer restore", exc_info=True)
+                    ckpt_step = None
+                if ckpt_step is not None and int(ckpt_step) > peek_step:
+                    emit_event(EventKind.PEER_REBUILD_FALLBACK,
+                               error_code="REPLICA_STALE",
+                               replica_step=int(peek_step),
+                               checkpoint_step=int(ckpt_step))
+                    logger.warning(
+                        "peer snapshot step %d is staler than "
+                        "checkpoint step %d; restoring from storage",
+                        peek_step, ckpt_step)
+                    return None
+            # the failure edge opens only once the gates passed and a
+            # transfer actually begins: a by-design degradation (stale
+            # replica, nothing reachable) must not strand an unpaired
+            # PEER_REBUILD_BEGIN that the MTTR derivation would report
+            # as an unrecovered incident
+            emit_event(EventKind.PEER_REBUILD_BEGIN,
+                       step=int(peek_step), owners=sorted(owners),
+                       holders=sum(len(v) for v in owners.values()))
+            leaves, meta, step, wire_bytes = repl.fetch_tree(
+                flat, owners, channel_factory,
+                inventories=inventories)
+        except repl.PeerRestoreError as e:
+            emit_event(EventKind.PEER_REBUILD_FALLBACK,
+                       error_code="PEER_RESTORE_UNAVAILABLE",
+                       detail=str(e)[:300])
+            logger.warning("peer rebuild unavailable (%s); degrading to "
+                           "the storage restore path", e)
+            return None
+        finally:
+            close_channels()
+        fetch_s = time.monotonic() - t0
+        t1 = time.monotonic()
+        from dlrover_tpu.checkpoint.manager import _rematerialize
+
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        state = jax.device_put(tree, self._result.state_sharding)
+        # donation safety: on CPU, device_put can zero-copy ALIAS the
+        # fetched numpy buffers — the first donated step would scribble
+        # host memory XLA does not own (the Orbax adjacency lesson)
+        state = _rematerialize(state)
+        jax.block_until_ready(state)
+        put_s = time.monotonic() - t1
+        self._host_step = int(meta.get("host_step", step))
+        rng = meta.get("rng")
+        if rng:
+            import numpy as np
+
+            self._rng = jax.numpy.asarray(
+                np.asarray(rng, dtype=np.uint32))
+        reg = get_registry()
+        reg.histogram(
+            tm.PEER_REBUILD_TIME,
+            help="checkpoint-free rebuild: peer fetch + device_put "
+                 "wall seconds").observe(fetch_s + put_s)
+        reg.counter(
+            tm.PEER_REBUILD_BYTES,
+            help="bytes streamed out of peer DRAM during rebuilds",
+        ).inc(wire_bytes)
+        emit_event(EventKind.PEER_REBUILD_DONE, step=int(step),
+                   fetch_seconds=round(fetch_s, 3),
+                   put_seconds=round(put_s, 3),
+                   bytes_from_peers=int(wire_bytes), storage_bytes=0,
+                   owners=sorted(owners))
+        logger.info(
+            "peer rebuild: restored step %d from surviving peers' DRAM "
+            "(%.1f MB over the wire in %.2fs, device_put %.2fs, zero "
+            "storage reads)", step, wire_bytes / 1e6, fetch_s, put_s)
+        return state
+
     def restore_state(self) -> Optional[Any]:
         """Restore the latest checkpoint onto the EXISTING compiled
         program — the rollback path. The world hasn't changed, so the
@@ -408,11 +557,19 @@ class ElasticTrainer:
 
     def snapshot(self, state: Any) -> HostSnapshot:
         """Host-DRAM copy of the live state (one ``device_get``). The
-        reshard source of ``live_reshard`` and a rollback anchor that
-        survives the loss of any peer's devices."""
+        reshard source of ``live_reshard``, a rollback anchor that
+        survives the loss of any peer's devices, and — with the rng
+        stream and host step in its meta — a complete resume point the
+        peer-replication plane can rebuild a DIFFERENT process from
+        bitwise (the replayed trainer must continue the same rng
+        stream the lost one would have)."""
+        import numpy as np
+
         return HostSnapshot.take(
             state, strategy=self._result.strategy.to_json()
             if self._result else "",
+            rng=[int(x) for x in np.asarray(self._rng).reshape(-1)],
+            host_step=int(self._host_step),
         )
 
     def live_reshard(self, state: Any, devices=None,

@@ -361,6 +361,83 @@ class NodeRuntimeReportHook(TrainHook):
         self._sender.join(timeout=5.0)
 
 
+class SnapshotReplicaHook(TrainHook):
+    """Push the node's host-snapshot regions to k master-assigned peers
+    on a cadence — the peer-redundancy plane of checkpoint-free
+    recovery (``checkpoint.replication``).
+
+    The step path pays ONE ``device_get`` per cadence (the same sync a
+    checkpoint save stages, floored by ``replica_min_interval_secs`` so
+    a fast-stepping job cannot tax itself); slicing, checksummed
+    framing and the per-peer RPC stream run on the replicator's
+    background daemon thread, with drop-on-backpressure — replication
+    is redundancy, never a stall."""
+
+    def __init__(self, master_client, every_steps: Optional[int] = None,
+                 min_interval_s: Optional[float] = None,
+                 replicator=None):
+        ctx = get_context()
+        self._client = master_client
+        self._every = int(
+            every_steps if every_steps is not None
+            else getattr(ctx, "replica_cadence_steps", 16))
+        self._min_interval = float(
+            min_interval_s if min_interval_s is not None
+            else getattr(ctx, "replica_min_interval_secs", 15.0))
+        self._last_send = 0.0
+        self._executor: Optional["TrainExecutor"] = None
+        self.replicator = replicator
+        self._owns_replicator = replicator is None
+
+    def begin(self, executor: "TrainExecutor"):
+        self._executor = executor
+        if self.replicator is not None:
+            return
+        from dlrover_tpu.checkpoint.replication import SnapshotReplicator
+
+        try:
+            self.replicator = SnapshotReplicator(
+                self._client,
+                node_id=int(getattr(self._client, "node_id", 0)),
+            )
+        except Exception:  # noqa: BLE001 — a port/bind failure loses
+            # redundancy, not the job; the gap is visible in the logs
+            logger.exception("snapshot replicator startup failed; "
+                             "peer redundancy disabled for this run")
+
+    def after_step(self, step: int, metrics: Dict[str, Any]):
+        if self.replicator is None or self._executor is None:
+            return
+        # prefer the MASTER-computed cluster-wide cadence (one value
+        # for every node): a per-node wall floor can drift nodes onto
+        # disjoint push-step schedules — a jitter event puts node A on
+        # {48, 80, ...} and node B on {64, 96, ...} with no resync —
+        # and a rebuild needs ONE step with full owner coverage. The
+        # local floor only paces the bootstrap cycles before the first
+        # plan (and single-node runs, where alignment is moot).
+        plan_cadence = int(getattr(
+            self.replicator, "plan_cadence_steps", 0) or 0)
+        every = plan_cadence if plan_cadence > 0 else self._every
+        if every <= 0 or step % every:
+            return
+        now = time.monotonic()
+        if plan_cadence <= 0 and now - self._last_send < \
+                self._min_interval:
+            return
+        self._last_send = now
+        try:
+            snap = self._executor._trainer.snapshot(self._executor.state)
+        except Exception:  # noqa: BLE001 — a failed snapshot loses one
+            # cadence of redundancy, never the step loop
+            logger.exception("replica snapshot failed at step %d", step)
+            return
+        self.replicator.submit(snap.tree, snap.meta, snap.step)
+
+    def end(self, executor: "TrainExecutor"):
+        if self.replicator is not None and self._owns_replicator:
+            self.replicator.stop()
+
+
 class OptimizerPlanHook(TrainHook):
     """Poll the master for a runtime-optimizer plan and apply it LIVE.
 
@@ -646,6 +723,20 @@ class TrainExecutor:
         ):
             self._hooks.append(NodeRuntimeReportHook(
                 master_client, every_steps=report_steps))
+        # peer-redundant host snapshots: when the plane is on
+        # (snapshot_replicas > 0) and a master connection exists, the
+        # replica hook rides along automatically (an explicit hook
+        # instance opts out of the auto-wire)
+        replicas = int(conf.get(
+            "snapshot_replicas",
+            getattr(ctx, "snapshot_replicas", 0)))
+        if (
+            master_client is not None and replicas > 0
+            and hasattr(master_client, "report_replica_endpoint")
+            and not any(isinstance(h, SnapshotReplicaHook)
+                        for h in self._hooks)
+        ):
+            self._hooks.append(SnapshotReplicaHook(master_client))
         # runtime-optimizer plan channel: poll the master for published
         # plans and apply them live (plan_poll_secs=0 or an explicit
         # hook instance opts out)

@@ -119,6 +119,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "knob — workers see it as "
                         "DLROVER_TPU_GRAD_PRECISION; never retuned "
                         "live")
+    p.add_argument("--snapshot_replicas", type=int, default=None,
+                   help="peer-redundant host snapshots: keep this many "
+                        "in-DRAM replicas of each node's snapshot "
+                        "regions on master-chosen peers (0 = off; the "
+                        "budget admission can degrade below it), "
+                        "enabling the checkpoint-free peer-rebuild "
+                        "recovery rung (docs/elasticity.md); workers "
+                        "and the master see it as "
+                        "DLROVER_TPU_SNAPSHOT_REPLICAS")
+    p.add_argument("--replica_cadence_steps", type=int, default=None,
+                   help="materialized steps between snapshot "
+                        "replication pushes (wall-time floored by "
+                        "replica_min_interval_secs)")
     p.add_argument("--live_recovery", "--live-recovery",
                    dest="live_recovery", action="store_true",
                    help="absorb survivable membership changes with an "
@@ -230,6 +243,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.environ["DLROVER_TPU_FSDP_PRECISION"] = args.fsdp_precision
     if args.grad_precision is not None:
         os.environ["DLROVER_TPU_GRAD_PRECISION"] = args.grad_precision
+    if args.snapshot_replicas is not None:
+        # the MASTER prices the replica plan off this knob and the
+        # workers gate their replicator/peer-restore on it, so it must
+        # land in the shared environment before either initializes
+        os.environ["DLROVER_TPU_SNAPSHOT_REPLICAS"] = str(
+            args.snapshot_replicas)
+    if args.replica_cadence_steps is not None:
+        os.environ["DLROVER_TPU_REPLICA_CADENCE_STEPS"] = str(
+            args.replica_cadence_steps)
     if args.live_recovery:
         # workers' executors route survivable changes to the in-process
         # reshard path (Context.live_recovery reads this at import)

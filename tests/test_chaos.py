@@ -2,6 +2,7 @@
 real components — the integration layer mocked-fault unit tests miss.
 """
 
+import json
 import os
 import threading
 import time
@@ -271,6 +272,169 @@ def test_flaky_rpc_absorbed_by_retries(master):
     assert done == 4  # 24 records / (3*2) per shard, every shard completed
     assert stats.injected > 0, "no faults were actually injected"
     client.close()
+
+
+def test_peer_rebuild_after_sigkill_is_bitwise_and_storage_free(
+        master, tmp_path, monkeypatch):
+    """The checkpoint-free recovery wedge (ISSUE 15 acceptance):
+    SIGKILL a worker whose snapshot regions are replicated on a
+    surviving peer -> the master's verdict excludes the dead node from
+    holder lists -> the relaunched worker rebuilds its state by
+    STREAMING it out of the peer's DRAM (no checkpoint directory even
+    exists) -> its post-recovery steps are BITWISE an uninterrupted
+    run's, the whole recovery rides ONE incident trace id across >= 2
+    pids, and the MTTR/goodput derivations record the peer_rebuild
+    scenario with zero storage bytes."""
+    import subprocess
+    import sys
+
+    from dlrover_tpu.checkpoint import replication as repl
+
+    events_path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("DLROVER_TPU_EVENTS_FILE", events_path)
+    # the MASTER owns k ("k peer agents chosen by the master"): the
+    # plan request is priced against ITS Context knob, so the master
+    # process — pytest here — must carry it, not just the workers
+    from dlrover_tpu.common.config import get_context
+
+    monkeypatch.setattr(get_context(), "snapshot_replicas", 1)
+    # the surviving peer: an in-test replica store registered as node 9
+    # (its process — pytest — survives the worker's death)
+    store = repl.ReplicaStore()
+    srv, port = repl.start_replica_server(store, host="127.0.0.1")
+    holder_client = MasterClient(master.addr, node_id=9)
+    holder_client.report_replica_endpoint(
+        addr=f"127.0.0.1:{port}", budget_mb=64.0, snapshot_mb=0.0,
+        step=-1)
+
+    status = tmp_path / "status.jsonl"
+    worker_env = {
+        **WORKER_ENV,
+        "PEER_STATUS": str(status),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "DLROVER_TPU_SNAPSHOT_REPLICAS": "1",
+        "DLROVER_TPU_REPLICA_CADENCE_STEPS": "2",
+        "DLROVER_TPU_REPLICA_MIN_INTERVAL_SECS": "0",
+        "DLROVER_TPU_PEER_RESTORE": "true",
+    }
+    config = AgentConfig(
+        node_rank=0, node_id=0, nproc_per_node=1, min_nodes=1,
+        max_nodes=1, max_restarts=2, monitor_interval=0.2,
+        rdzv_waiting_timeout=5.0,
+    )
+    spec = WorkerSpec(
+        entrypoint=os.path.join(TESTDATA, "peer_worker.py"),
+        nproc_per_node=1, env=worker_env,
+    )
+    client = MasterClient(master.addr, node_id=0)
+    agent = ElasticTrainingAgent(config, spec, client,
+                                 host_ip="127.0.0.1")
+    result = {}
+    thread = threading.Thread(
+        target=lambda: result.update(rc=agent.run()), daemon=True
+    )
+    thread.start()
+    try:
+        # wait until a replica has COMMITTED on the surviving peer,
+        # then SIGKILL the worker mid-step
+        deadline = time.monotonic() + 120
+        pids = []
+        while time.monotonic() < deadline:
+            procs = getattr(agent._worker_group, "_procs", [])
+            pids = [p.pid for p in procs if p.poll() is None]
+            if pids and store.inventory().get("0"):
+                break
+            time.sleep(0.1)
+        assert store.inventory().get("0"), \
+            "no replica ever committed on the surviving peer"
+        assert pids and kill_workers(pids)
+
+        thread.join(timeout=180)
+        assert not thread.is_alive(), "agent never finished"
+        assert result["rc"] == 0
+        assert agent._worker_group.restart_round >= 1
+    finally:
+        holder_client.close()
+        client.close()
+        srv.stop(grace=0)
+
+    # -- the recovered run resumed at the replicated step and finished
+    records = [json.loads(ln) for ln in
+               status.read_text().splitlines()]
+    ends = [r for r in records if r.get("event") == "end"]
+    assert ends, records[-3:]
+    end = ends[-1]
+    assert end["round"] >= 1
+    resumed = end["resumed_step"]
+    assert resumed >= 2, "relaunched worker did not peer-restore"
+    assert end["final_step"] == resumed + 3
+    # the relaunched worker keeps replicating: the surviving peer's
+    # freshest commit is at (or past) the recovered run's progress
+    assert store.inventory()["0"]["manifest"]["meta"][
+        "host_step"] >= resumed
+
+    # -- bitwise: an UNINTERRUPTED run to the same step produces the
+    # identical params (same rng stream, same batches — the rebuild
+    # lost nothing and invented nothing)
+    ref_status = tmp_path / "ref_status.jsonl"
+    ref_env = {
+        **os.environ, **WORKER_ENV,
+        "PEER_STATUS": str(ref_status),
+        "PEER_REFERENCE": "1",
+        "PEER_TOTAL_STEPS": str(end["final_step"]),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "DLROVER_TPU_SNAPSHOT_REPLICAS": "0",
+    }
+    ref_env.pop("PALLAS_AXON_POOL_IPS", None)
+    ref = subprocess.run(
+        [sys.executable, os.path.join(TESTDATA, "peer_worker.py")],
+        env=ref_env, timeout=180,
+    )
+    assert ref.returncode == 0
+    ref_end = [json.loads(ln) for ln in
+               ref_status.read_text().splitlines()][-1]
+    assert ref_end["final_step"] == end["final_step"]
+    assert ref_end["digest"] == end["digest"], (
+        "post-recovery params diverged from the uninterrupted run")
+
+    # -- zero storage reads on the recovery path, derived + asserted
+    from dlrover_tpu.telemetry import read_events
+
+    timeline = read_events(events_path)
+    done = [r for r in timeline if r["kind"] == "peer_rebuild_done"]
+    assert done, "no peer_rebuild_done edge in the timeline"
+    assert done[-1]["storage_bytes"] == 0
+    assert done[-1]["bytes_from_peers"] > 0
+    assert not [r for r in timeline if r["kind"] == "ckpt_restore"], (
+        "the recovery path touched storage")
+
+    # -- one incident trace id spans agent-side failure detection and
+    # the relaunched worker's peer rebuild (>= 2 pids)
+    failed = [r for r in timeline if r["kind"] == "worker_failed"]
+    assert failed
+    tid = failed[0].get("trace_id", "")
+    assert tid.startswith("inc-")
+    stamped = {r["kind"] for r in timeline
+               if r.get("trace_id") == tid}
+    assert "peer_rebuild_done" in stamped, stamped
+    assert "workers_started" in stamped, stamped
+    pids_stamped = {r["pid"] for r in timeline
+                    if r.get("trace_id") == tid}
+    assert len(pids_stamped) >= 2
+
+    # -- the MTTR scenario + goodput ledger record the recovery
+    report = _derived_mttr(events_path)
+    pr = report["detail"]["by_scenario"].get("peer_rebuild")
+    assert pr and pr["count"] >= 1, report
+    wf = report["detail"]["by_scenario"].get("worker_failure")
+    assert wf and wf["count"] >= 1, report
+    from dlrover_tpu.telemetry.goodput import derive_goodput
+
+    ledger = derive_goodput(timeline)
+    assert ledger["detail"]["coverage"] >= 0.99, ledger
+    assert ledger["detail"]["buckets"]["peer_rebuild"]["seconds"] > 0
 
 
 @pytest.mark.slow

@@ -637,15 +637,20 @@ class TestReportingOverheadGate:
             executor.train_and_evaluate()
             return time.perf_counter() - timer.t0
 
-        def paired_median(pairs=3):
+        def leg(report, best_of):
+            # best_of > 1: MIN over repeats — floors out the one-off
+            # scheduler stalls that are this box's residual flake
+            return min(run(report) for _ in range(best_of))
+
+        def paired_median(pairs=3, best_of=1):
             ratios = []
             for i in range(pairs):
                 if i % 2 == 0:
-                    dt_b = run(False)
-                    dt_r = run(True)
+                    dt_b = leg(False, best_of)
+                    dt_r = leg(True, best_of)
                 else:
-                    dt_r = run(True)
-                    dt_b = run(False)
+                    dt_r = leg(True, best_of)
+                    dt_b = leg(False, best_of)
                 ratios.append(dt_r / dt_b)
             return sorted(ratios)[len(ratios) // 2]
 
@@ -659,9 +664,13 @@ class TestReportingOverheadGate:
             # (every attempt fails) while a clean tree stops failing
             # one run in three. See test_telemetry.py for the full
             # rationale.
+            # retry attempts escalate to BEST-OF-2 legs (ISSUE 15
+            # satellite; rationale in test_telemetry.py): the common
+            # case stays one attempt of single-run pairs, while a
+            # retry filters single-run stalls on either side
             medians = [paired_median()]
             while medians[-1] - 1.0 > 0.05 and len(medians) < 3:
-                medians.append(paired_median())
+                medians.append(paired_median(best_of=2))
             overhead = min(medians) - 1.0
             assert overhead <= 0.05, (
                 f"node-runtime reporting overhead {overhead:.1%} above "

@@ -31,6 +31,7 @@ def test_recovery_wedge_live_vs_restart(tmp_path):
         BENCH_RECOVERY_TIMEOUT="240",
         BENCH_WEDGE_ARTIFACT=str(tmp_path / "BENCH_r07.json"),
         BENCH_WEDGE_MTTR=str(tmp_path / "MTTR_r02.json"),
+        BENCH_PEER_ARTIFACT=str(tmp_path / "BENCH_r14.json"),
         JAX_PLATFORMS="cpu",
     )
     # the wedge pins its own XLA_FLAGS (8-device live mesh, 1-device
@@ -42,8 +43,9 @@ def test_recovery_wedge_live_vs_restart(tmp_path):
     )
     lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
     assert lines, f"no bench output; stderr tail: {proc.stderr[-2000:]}"
-    rec = json.loads(lines[-1])
-    assert rec["metric"] == "live_reshard_speedup"
+    by_metric = {json.loads(ln)["metric"]: json.loads(ln)
+                 for ln in lines}
+    rec = by_metric["live_reshard_speedup"]
     assert "error" not in rec, rec
 
     detail = rec["detail"]
@@ -66,3 +68,15 @@ def test_recovery_wedge_live_vs_restart(tmp_path):
     assert wedge["metric"] == "live_reshard_speedup"
     mttr = json.loads((tmp_path / "MTTR_r02.json").read_text())
     assert mttr["detail"]["by_scenario"]["live_reshard"]["count"] >= 1
+
+    # the checkpoint-free peer-rebuild leg (ISSUE 15): MTTR breakdown
+    # recorded, every byte came from peer DRAM, params bitwise
+    peer = by_metric["peer_rebuild_mttr_s"]
+    assert "error" not in peer, peer
+    pd = peer["detail"]
+    assert pd["params_bit_identical"] is True, pd
+    assert pd["bytes_from_storage"] == 0, pd
+    assert all(b > 0 for b in pd["bytes_from_peers"]), pd
+    assert pd["drain_s"] >= 0 and pd["fetch_s"] and pd["device_put_s"]
+    artifact = json.loads((tmp_path / "BENCH_r14.json").read_text())
+    assert artifact["metric"] == "peer_rebuild_mttr_s"

@@ -466,18 +466,30 @@ class TestInstrumentedRunPins:
         recompiles = 0
         inst_runs = 0
 
-        def paired_median(pairs=3):
+        def leg(instrumented, best_of):
+            """One timed leg; ``best_of`` > 1 takes the MIN over
+            repeats — the classic floor estimator that filters one-off
+            scheduler stalls, which on this box are the whole residual
+            flake (the true cost is a lower envelope)."""
             nonlocal recompiles, inst_runs
+            best = None
+            for _ in range(best_of):
+                dt, rc = _timed_loop(instrumented, steps)
+                recompiles += rc
+                if instrumented:
+                    inst_runs += 1
+                best = dt if best is None else min(best, dt)
+            return best
+
+        def paired_median(pairs=3, best_of=1):
             ratios = []
             for i in range(pairs):
                 if i % 2 == 0:
-                    dt_b, rc_b = _timed_loop(False, steps)
-                    dt_i, rc_i = _timed_loop(True, steps)
+                    dt_b = leg(False, best_of)
+                    dt_i = leg(True, best_of)
                 else:
-                    dt_i, rc_i = _timed_loop(True, steps)
-                    dt_b, rc_b = _timed_loop(False, steps)
-                inst_runs += 1
-                recompiles += rc_b + rc_i
+                    dt_i = leg(True, best_of)
+                    dt_b = leg(False, best_of)
                 ratios.append(dt_i / dt_b)
             return sorted(ratios)[len(ratios) // 2]
 
@@ -493,9 +505,15 @@ class TestInstrumentedRunPins:
         # suite has actually caught (≥10%, e.g. PR 8's capture
         # placement at 11-15%), where every attempt fails, while a
         # clean tree stops failing tier-1 one run in three.
+        # Retry attempts escalate to BEST-OF-2 legs (ISSUE 15
+        # satellite): min-of-medians alone still left a ~1/27 residual
+        # flake — one scheduler stall landing on a baseline leg of
+        # every attempt. Taking each retry leg as the min of two runs
+        # floors out single-run stalls on either side; the common case
+        # (first attempt passes) costs exactly what it used to.
         medians = [paired_median()]
         while medians[-1] - 1.0 > 0.05 and len(medians) < 3:
-            medians.append(paired_median())
+            medians.append(paired_median(best_of=2))
         assert recompiles == 0, "recompile inside the timed region"
         overhead = min(medians) - 1.0
         assert overhead <= 0.05, (

@@ -1,0 +1,124 @@
+"""Checkpoint-free recovery chaos worker.
+
+Round 0 (under the agent): trains a tiny deterministic model with the
+peer-replication plane on (env-configured Context knobs) and NO
+checkpoint directory — the only recovery source is the surviving
+peer's DRAM. Steps are slowed so the test can SIGKILL it after a
+replica committed. The relaunched round peer-restores through
+``ElasticTrainer.prepare`` and finishes exactly 3 steps past the
+resumed step, writing a bitwise param digest.
+
+PEER_REFERENCE=1: the uninterrupted control — same model, same rng
+stream, same constant batch, trained 0 -> PEER_TOTAL_STEPS in one run,
+writing the digest the recovered run must match bitwise.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.trainer.conf import Configuration
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+from dlrover_tpu.trainer.executor import TrainExecutor, TrainHook
+
+STATUS = os.environ["PEER_STATUS"]
+REFERENCE = os.environ.get("PEER_REFERENCE", "") == "1"
+RESTART_ROUND = int(os.environ.get(NodeEnv.RESTART_ROUND, "0"))
+
+
+def emit(record):
+    with open(STATUS, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def build_trainer():
+    def init_fn(rng):
+        return {"w": jax.random.normal(rng, (4, 2)),
+                "b": jnp.zeros((2,))}
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    rngs = jax.random.split(jax.random.PRNGKey(0), 2)
+    x = jax.random.normal(rngs[0], (16, 4))
+    batch = {"x": x, "y": x @ jax.random.normal(rngs[1], (4, 2))}
+    client = None
+    if not REFERENCE:
+        from dlrover_tpu.agent.master_client import build_master_client
+
+        client = build_master_client()
+    trainer = ElasticTrainer(
+        init_fn, loss_fn, optax.adam(0.1), batch,
+        strategy=Strategy(mesh=MeshPlan(data=-1)),
+        master_client=client,
+    )
+    return trainer, batch, client
+
+
+class _StatusHook(TrainHook):
+    def __init__(self, slow_s=0.0):
+        self.slow_s = slow_s
+
+    def after_step(self, step, metrics):
+        emit({"event": "step", "step": step, "round": RESTART_ROUND})
+        if self.slow_s:
+            time.sleep(self.slow_s)
+
+
+def digest_of(state):
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state)):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    trainer, batch, client = build_trainer()
+    state = trainer.prepare()
+    resumed = int(trainer._host_step)
+    emit({"event": "begin", "round": RESTART_ROUND,
+          "reference": REFERENCE, "resumed_step": resumed})
+    if REFERENCE:
+        total = int(os.environ["PEER_TOTAL_STEPS"])
+        slow = 0.0
+    elif RESTART_ROUND == 0:
+        total = 5000  # killed long before this
+        slow = 0.05
+    else:
+        total = resumed + 3
+        slow = 0.0
+    executor = TrainExecutor(
+        trainer,
+        train_iter_fn=lambda: iter(lambda: batch, None),
+        hooks=[_StatusHook(slow_s=slow)],
+        master_client=client,
+        conf=Configuration({
+            "train_steps": total, "log_every_steps": 0,
+            "train_window": 2, "preemption_grace": False,
+            "plan_poll_secs": 0, "runtime_report_steps": 0,
+        }),
+    )
+    executor.state = state
+    executor.train_and_evaluate()
+    emit({"event": "end", "round": RESTART_ROUND,
+          "final_step": int(executor.state.step),
+          "resumed_step": resumed,
+          "digest": digest_of(executor.state)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
