@@ -2805,6 +2805,247 @@ def _serve_leg(engine, admission: str, workload,
     }
 
 
+# wedge target: prefix pool ON vs OFF on a shared-system-prompt
+# workload (the workload shape the pool exists for: every request
+# repeats the same leading pages, so ON replaces most prefill chunks
+# with page copies; decode is identical, so the tokens/sec ratio is
+# the prefill-work win)
+PREFIX_SPEEDUP_TARGET = 1.3
+
+
+def _prefix_workload(seed: int = 1, requests: int = 12,
+                     shared_len: int = 32, tail_len: int = 8,
+                     max_new: int = 4):
+    """Shared-system-prompt batch: one common prefix, distinct tails."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    shared = [int(t) for t in rng.randint(0, 256, size=(shared_len,))]
+    out = []
+    for _ in range(requests):
+        tail = [int(t) for t in rng.randint(0, 256, size=(tail_len,))]
+        out.append({"prompt": shared + tail, "max_new": max_new})
+    return out
+
+
+def _prefix_leg(engine, workload, tag: str) -> dict:
+    """One prefix-wedge leg: fresh slots and a fresh (empty) pool,
+    one UNTIMED seeding request that publishes the shared prefix when
+    the pool is on (served identically when it is off — the legs run
+    the same procedure), then the timed batch."""
+    from dlrover_tpu.serving.engine import ServeExecutor
+
+    engine.cache = engine.fresh_cache()
+    engine.reset_prefix()
+    executor = ServeExecutor(engine, serve_window=1)
+    executor.submit(workload[0]["prompt"], max_new_tokens=2,
+                    request_id=f"{tag}-seed")
+    executor.serve()
+    for i, req in enumerate(workload):
+        executor.submit(req["prompt"], max_new_tokens=req["max_new"],
+                        request_id=f"{tag}-{i}")
+    t0 = time.monotonic()
+    done = executor.serve()
+    wall = time.monotonic() - t0
+    recs = [r for r in done if not r["request_id"].endswith("-seed")]
+    tokens = sum(len(r["tokens"]) for r in recs)
+    return {
+        "completed": len(recs),
+        "tokens": tokens,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / max(wall, 1e-9), 2),
+        "prefix_hit_tokens": sum(
+            int(r.get("prefix_hit_tokens", 0) or 0) for r in recs),
+        "records": recs,
+    }
+
+
+def _serve_prefix_replan(engine) -> dict:
+    """The replan wedge: an in-process RuntimeOptimizer fed the live
+    engine's geometry and the operator's expected-hit-rate prior must
+    CHOOSE a nonzero pool under the HBM gate, and the engine must
+    apply it through prewarm + retune at zero recompiles — the full
+    knob path, master judgment to worker apply."""
+    import jax
+
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.common.config import get_context
+    from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
+    from dlrover_tpu.master.optimizer import RuntimeOptimizer
+
+    spec = engine.program.spec
+    ctx = get_context()
+    prev_prior = getattr(ctx, "serve_prefix_expected_hit_rate", 0.0)
+    ctx.serve_prefix_expected_hit_rate = 0.8
+    published = []
+    try:
+        opt = RuntimeOptimizer(NodeRuntimeStore(),
+                               publish=published.append,
+                               cooldown_secs=0.0)
+        # price at a realistic model scale: the tiny demo model's
+        # decode step sits on the host-dispatch FLOOR where every
+        # candidate ties (and the churn tie-break rightly keeps every
+        # knob unchanged) — the wedge is about the decision PLUMBING,
+        # so the optimizer judges a weight-read-bound 7B-class model
+        # over the worker's true KV geometry
+        opt.update_model_info(comm.ModelInfo(
+            num_params=7_000_000_000,
+            hidden_size=spec.num_kv_heads * spec.head_dim,
+            num_layers=spec.num_layers, seq_len=128))
+        opt.update_serving_config(comm.ServeConfigReport(
+            node_id=0, world=len(jax.devices()),
+            serve_slots=spec.num_slots,
+            prefill_chunk=engine.prefill_chunk,
+            kv_precision=spec.precision, max_seq=spec.max_seq,
+            num_layers=spec.num_layers, kv_heads=spec.num_kv_heads,
+            head_dim=spec.head_dim, prefix_pool_pages=0,
+            page_size=spec.page_size, prefix_hit_rate=-1.0))
+        dec = [d for d in opt.decisions()
+               if d["trigger"].startswith("serve:")][-1]
+        chosen = dec.get("chosen") or {}
+        plan = published[-1] if published else None
+        plan_ppp = (getattr(plan, "serve_prefix_pool_pages", -1)
+                    if plan is not None else -1)
+        out = {
+            "outcome": dec.get("outcome"),
+            "chosen_key": chosen.get("key"),
+            "predicted_speedup": dec.get("predicted_speedup"),
+            "plan_prefix_pool_pages": plan_ppp,
+            "memory_rejected": len(dec.get("memory_rejected") or []),
+        }
+        if dec.get("outcome") != "chosen" or plan_ppp <= 0:
+            out["error"] = ("optimizer did not choose a nonzero "
+                            "prefix pool")
+            return out
+        # apply on the live engine: prewarm the chosen knob tuple
+        # (standby compile, allowed), then retune must be a cache hit
+        new_slots = int(chosen.get("serve_slots", spec.num_slots))
+        new_chunk = int(chosen.get("prefill_chunk",
+                                   engine.prefill_chunk))
+        engine.prewarm(serve_slots=new_slots, prefill_chunk=new_chunk,
+                       prefix_pool_pages=plan_ppp)
+        recompiled = engine.retune(serve_slots=new_slots,
+                                   prefill_chunk=new_chunk,
+                                   prefix_pool_pages=plan_ppp,
+                                   slot_map={})
+        out["applied_recompiles"] = int(recompiled)
+        # ack: the worker's config echo marks the plan applied and
+        # must NOT trigger a chase-our-own-tail replan
+        opt.update_serving_config(comm.ServeConfigReport(
+            node_id=0, world=len(jax.devices()),
+            serve_slots=new_slots, prefill_chunk=new_chunk,
+            kv_precision=spec.precision, max_seq=spec.max_seq,
+            num_layers=spec.num_layers, kv_heads=spec.num_kv_heads,
+            head_dim=spec.head_dim, prefix_pool_pages=plan_ppp,
+            page_size=spec.page_size, plan_id=plan.plan_id))
+        acked = [d for d in opt.decisions()
+                 if d.get("plan_id") == plan.plan_id][-1]
+        out["applied"] = bool(acked.get("applied"))
+        if recompiled:
+            out["error"] = "retune recompiled on a prewarmed knob set"
+        elif not out["applied"]:
+            out["error"] = "apply ack did not mark the plan applied"
+        return out
+    finally:
+        ctx.serve_prefix_expected_hit_rate = prev_prior
+
+
+def _serve_prefix_wedge(cfg, params) -> dict:
+    """Paired OFF-vs-ON legs (alternating order, median of paired
+    ratios) on the shared-system-prompt workload, a bitwise parity
+    check between the legs, and the replan wedge — two engines so each
+    side keeps its own compiled programs (the OFF engine never even
+    builds the copy programs)."""
+    from dlrover_tpu.parallel.mesh import MeshPlan
+    from dlrover_tpu.parallel.strategy import Strategy
+    from dlrover_tpu.serving.engine import ServeEngine
+
+    def build(pool_pages):
+        e = ServeEngine(
+            cfg, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                   rule_set="llama"),
+            serve_slots=4, prefill_chunk=8, max_seq=48, page_size=8,
+            prefix_pool_pages=pool_pages,
+        )
+        e.prepare(params)
+        return e
+
+    engines = {"off": build(0), "on": build(16)}
+    workload = _prefix_workload()
+    # warmup: absorb every lazy jit (decode, prefill, and the ON
+    # engine's admit/publish copies) outside the timed region
+    for mode, eng in engines.items():
+        _prefix_leg(eng, _prefix_workload(requests=2),
+                    f"warm-{mode}")
+    before = {
+        mode: (eng.compile_count, eng.program.compiled_cache_size())
+        for mode, eng in engines.items()}
+
+    pairs, legs = [], {"off": [], "on": []}
+    for i in range(3):
+        order = ("off", "on") if i % 2 == 0 else ("on", "off")
+        pair = {}
+        for mode in order:
+            pair[mode] = _prefix_leg(engines[mode], workload,
+                                     f"{mode}{i}")
+        for mode in ("off", "on"):
+            legs[mode].append(pair[mode])
+        pairs.append(round(
+            pair["on"]["tokens_per_s"]
+            / max(pair["off"]["tokens_per_s"], 1e-9), 3))
+    ratio = sorted(pairs)[len(pairs) // 2]
+
+    # the parity leg: every completion of the last pair must be
+    # BITWISE identical between OFF and ON (copy-on-admit feeds the
+    # continuation the same bytes full prefill would have written)
+    def by_req(rows):
+        return {r["request_id"].split("-", 1)[1]: r["tokens"]
+                for r in rows}
+
+    off_toks = by_req(legs["off"][-1]["records"])
+    on_toks = by_req(legs["on"][-1]["records"])
+    bitwise = (set(off_toks) == set(on_toks) and all(
+        off_toks[k] == on_toks[k] for k in off_toks))
+    recompiles = {
+        mode: (eng.compile_count - before[mode][0],
+               eng.program.compiled_cache_size() - before[mode][1])
+        for mode, eng in engines.items()}
+    zero_recompiles = all(c == 0 and g == 0
+                          for c, g in recompiles.values())
+    stats = engines["on"].prefix_stats()
+    replan = _serve_prefix_replan(engines["off"])
+
+    def strip(rows):
+        return [{k: v for k, v in r.items() if k != "records"}
+                for r in rows]
+
+    result = {
+        "pool_pages": 16,
+        "requests_per_leg": len(workload),
+        "shared_prefix_tokens": 32,
+        "pair_ratios": pairs,
+        "tokens_per_s_ratio_median": ratio,
+        "target_ratio": PREFIX_SPEEDUP_TARGET,
+        "off_legs": strip(legs["off"]),
+        "on_legs": strip(legs["on"]),
+        "bitwise_parity": bitwise,
+        "zero_recompiles_in_timed_legs": zero_recompiles,
+        "pool_stats": stats or {},
+        "replan": replan,
+    }
+    if not bitwise:
+        result["error"] = "prefix-reused tokens diverged from full " \
+                          "prefill"
+    elif not zero_recompiles:
+        result["error"] = "recompile inside a timed prefix leg"
+    elif ratio < PREFIX_SPEEDUP_TARGET:
+        result["error"] = (f"on/off ratio {ratio} < "
+                           f"{PREFIX_SPEEDUP_TARGET}")
+    elif replan.get("error"):
+        result["error"] = f"replan: {replan['error']}"
+    return result
+
+
 def serve_result() -> dict:
     """The continuous-batching wedge: paired static-vs-continuous legs
     (alternating order, median of paired ratios — the established
@@ -2913,6 +3154,10 @@ def serve_result() -> dict:
         ),
         "elapsed_s": round(time.time() - t_start, 1),
     }
+    # the prefix-cache wedge rides the same artifact (fresh engines —
+    # the continuous-batching numbers above are already closed)
+    result["prefix"] = _serve_prefix_wedge(cfg, params)
+    result["elapsed_s"] = round(time.time() - t_start, 1)
     if result["resize"]["dropped"]:
         result["error"] = (
             f"resize dropped {result['resize']['dropped']} requests")
@@ -2924,6 +3169,8 @@ def serve_result() -> dict:
         result["error"] = (
             f"continuous/static ratio {ratio} < "
             f"{SERVE_SPEEDUP_TARGET}")
+    elif result["prefix"].get("error"):
+        result["error"] = f"prefix: {result['prefix']['error']}"
     return result
 
 
@@ -2944,7 +3191,7 @@ def serve_main() -> int:
     artifact = os.environ.get(
         "BENCH_SERVE_ARTIFACT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_r13.json"),
+                     "BENCH_r15.json"),
     )
     if artifact:
         with open(artifact, "w") as f:

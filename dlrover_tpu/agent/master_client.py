@@ -96,11 +96,13 @@ class MasterClient:
 
     def serve_complete(self, request_id: str, tokens,
                        ttft_s=None, e2e_s=None,
-                       error_code: str = "") -> comm.Response:
+                       error_code: str = "",
+                       prefix_hit_tokens: int = 0) -> comm.Response:
         return self._channel.report(comm.ServeResult(
             node_id=self.node_id, request_id=request_id,
             tokens=[int(t) for t in tokens or []],
             ttft_s=ttft_s, e2e_s=e2e_s, error_code=error_code,
+            prefix_hit_tokens=int(prefix_hit_tokens or 0),
         ))
 
     def serve_touch(self) -> comm.Response:

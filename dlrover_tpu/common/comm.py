@@ -436,6 +436,9 @@ class ParallelConfig:
     # the SAME prewarmed program-cache swap as the training knobs
     serve_slots: int = 0
     serve_prefill_chunk: int = 0
+    # shared prefix pool pages. 0 is a REAL value here (pool off), so
+    # the leave-unchanged sentinel is -1, unlike its 0-sentinel siblings
+    serve_prefix_pool_pages: int = -1
     # optimizer decision identity: the worker echoes plan_id back in its
     # TrainerConfigReport ack, and every OPTIMIZER_* event on both sides
     # carries trace_id so the decision trail merges per incident
@@ -633,6 +636,10 @@ class ServeResult:
     ttft_s: Optional[float] = None
     e2e_s: Optional[float] = None
     error_code: str = ""
+    # prompt tokens whose KV pages were COPIED from the worker's
+    # shared prefix pool instead of prefilled (0 = miss or pool off) —
+    # the router's saved-token ledger input
+    prefix_hit_tokens: int = 0
 
 
 @message
@@ -672,6 +679,13 @@ class ServeConfigReport:
     num_layers: int = 0
     kv_heads: int = 0
     head_dim: int = 0
+    # shared prefix pool actually running (pages; 0 = off), its page
+    # grain, and the hit rate this worker has OBSERVED — the
+    # optimizer's pricing input for the prefill discount (observation
+    # beats the serve_prefix_expected_hit_rate prior)
+    prefix_pool_pages: int = 0
+    page_size: int = 0
+    prefix_hit_rate: float = -1.0
     plan_id: str = ""
     apply_failed: bool = False
 

@@ -257,6 +257,24 @@ class Context:
         # materialize on host (the PR 3 async window, re-aimed at
         # decode; 0 = synchronous)
         self.serve_window = 2
+        # shared prefix pool, in pages (0 = off): device-resident
+        # refcounted KV pages beside the slot pool, radix-indexed
+        # host-side; admission COPIES matched pages into the slot
+        # (copy-on-admit) and prefills only the unmatched tail. Pool
+        # bytes ride the same HBM feasibility gate the slot pool does;
+        # the runtime optimizer retunes this live (docs/serving.md
+        # "Prefix reuse").
+        self.serve_prefix_pool_pages = 0
+        # router-side soft session affinity: lease same-prefix
+        # requests to the worker whose pool already holds the pages
+        # (correctness never depends on it — a worker without the
+        # pages just misses and prefills)
+        self.serve_prefix_affinity = True
+        # planner prior for the expected prefix hit rate before any
+        # worker has observed one (0 = price prefill undiscounted, so
+        # the optimizer only spends pool HBM once traffic proves
+        # prefix sharing — or an operator declares it)
+        self.serve_prefix_expected_hit_rate = 0.0
         # master-side: a leased request whose worker has not touched
         # the router for this long is re-leased to a live worker
         # (the shard-timeout machinery re-pointed at requests)

@@ -438,6 +438,14 @@ class RuntimeOptimizer:
                 "num_layers": int(getattr(report, "num_layers", 0)),
                 "kv_heads": int(getattr(report, "kv_heads", 0)),
                 "head_dim": int(getattr(report, "head_dim", 0)),
+                "prefix_pool_pages": int(getattr(
+                    report, "prefix_pool_pages", 0)),
+                "page_size": int(getattr(report, "page_size", 0)),
+                # observed hit rate rides the report but is NOT a
+                # replan trigger (it drifts every request) — it only
+                # feeds the pricing when >= 0
+                "prefix_hit_rate": float(getattr(
+                    report, "prefix_hit_rate", -1.0)),
             }
             if report.plan_id:
                 self._record_applied(report)
@@ -460,7 +468,7 @@ class RuntimeOptimizer:
             changed = adopted and (prev is None or any(
                 prev.get(k) != cfg[k]
                 for k in ("world", "serve_slots", "prefill_chunk",
-                          "kv_precision")))
+                          "kv_precision", "prefix_pool_pages")))
         if changed and not report.plan_id:
             # an ack's config echo is the plan we just published —
             # re-planning on it would chase our own tail
@@ -489,8 +497,23 @@ class RuntimeOptimizer:
             if 1 <= c <= max_seq and max_seq % c == 0})
         if not chunk_opts:
             chunk_opts = [chunk]
-        return [{"serve_slots": s, "prefill_chunk": c}
-                for s in slot_opts for c in chunk_opts]
+        # prefix-pool widths: 0 (off), current, and pool depths sized
+        # to hold whole prompts (max_seq / page_size pages each). Only
+        # enumerable when the worker reported its page geometry — an
+        # old worker without page_size keeps its pool untouched.
+        ppp = max(0, int(cfg.get("prefix_pool_pages", 0) or 0))
+        pg = int(cfg.get("page_size", 0) or 0)
+        if pg > 0:
+            per_prompt = max(1, max_seq // pg)
+            pool_opts = sorted({
+                p for p in (0, ppp, per_prompt * 4, per_prompt * 8)
+                if 0 <= p <= 4096})
+        else:
+            pool_opts = [ppp]
+        return [{"serve_slots": s, "prefill_chunk": c,
+                 "prefix_pool_pages": p}
+                for s in slot_opts for c in chunk_opts
+                for p in pool_opts]
 
     def _serve_spec(self, cfg: Optional[Dict] = None):
         """A ModelSpec for the decode pricing. The KV-pool geometry
@@ -560,6 +583,7 @@ class RuntimeOptimizer:
         from dlrover_tpu.parallel.planner import (
             estimate_decode,
             serve_cache_bytes,
+            serve_prefix_pool_bytes,
         )
 
         with self._lock:
@@ -573,18 +597,44 @@ class RuntimeOptimizer:
                 kvp = cfg["kv_precision"]
                 max_seq = max(1, cfg["max_seq"])
                 budget = self._serve_budget_bytes()
+                page_size = int(cfg.get("page_size", 0) or 0)
+                # the hit-rate driving the prefill discount: observed
+                # (from the worker's ledger) once traffic has spoken,
+                # else the operator's prior — 0 without either, which
+                # prices every pool width as pure cost and keeps the
+                # knob off until there is evidence it pays
+                observed_hr = float(cfg.get("prefix_hit_rate", -1.0))
+                hit_rate = (observed_hr if observed_hr >= 0.0
+                            else float(getattr(
+                                get_context(),
+                                "serve_prefix_expected_hit_rate",
+                                0.0) or 0.0))
                 current = estimate_decode(
                     spec, world, cfg["serve_slots"],
                     cfg["prefill_chunk"], max_seq, kvp,
-                    device=self._device)
+                    device=self._device,
+                    prefix_pool_pages=max(
+                        0, cfg.get("prefix_pool_pages", 0)),
+                    page_size=page_size or 16,
+                    prefix_hit_rate=hit_rate)
                 priced, memory_rejected = [], []
                 for cand in self._serve_candidates(cfg):
                     pool = serve_cache_bytes(
                         spec, cand["serve_slots"], max_seq, kvp)
-                    if pool / world > budget:
+                    # the prefix pool is sharded only on heads and
+                    # charged UNDIVIDED per device (conservative: the
+                    # page dim is replicated) on top of this node's
+                    # slot-pool share
+                    prefix_bytes = serve_prefix_pool_bytes(
+                        spec, cand["prefix_pool_pages"],
+                        page_size or 16, kvp)
+                    per_device = pool / world + prefix_bytes
+                    if per_device > budget:
                         memory_rejected.append({
                             "serve_slots": cand["serve_slots"],
-                            "predicted_hbm_bytes": pool / world,
+                            "prefix_pool_pages":
+                                cand["prefix_pool_pages"],
+                            "predicted_hbm_bytes": per_device,
                             "budget_bytes": budget,
                         })
                         self._c_memory_rejected.inc()
@@ -592,9 +642,13 @@ class RuntimeOptimizer:
                     est = estimate_decode(
                         spec, world, cand["serve_slots"],
                         cand["prefill_chunk"], max_seq, kvp,
-                        device=self._device)
+                        device=self._device,
+                        prefix_pool_pages=cand["prefix_pool_pages"],
+                        page_size=page_size or 16,
+                        prefix_hit_rate=hit_rate)
                     key = (f"serve|slots={cand['serve_slots']}"
-                           f"|pc={cand['prefill_chunk']}")
+                           f"|pc={cand['prefill_chunk']}"
+                           f"|ppp={cand['prefix_pool_pages']}")
                     if key in self._failed_keys:
                         continue
                     priced.append({
@@ -623,7 +677,9 @@ class RuntimeOptimizer:
                     # prefill_chunk change must not ride along free
                     return ((c["serve_slots"] != cfg["serve_slots"])
                             + (c["prefill_chunk"]
-                               != cfg["prefill_chunk"]))
+                               != cfg["prefill_chunk"])
+                            + (c["prefix_pool_pages"]
+                               != cfg.get("prefix_pool_pages", 0)))
 
                 priced.sort(key=lambda c: (-c["tokens_per_s"],
                                            churn(c), c["serve_slots"]))
@@ -634,12 +690,16 @@ class RuntimeOptimizer:
                 decision.predicted_speedup = round(best["speedup"], 3)
                 unchanged = (
                     best["serve_slots"] == cfg["serve_slots"]
-                    and best["prefill_chunk"] == cfg["prefill_chunk"])
+                    and best["prefill_chunk"] == cfg["prefill_chunk"]
+                    and best["prefix_pool_pages"]
+                    == cfg.get("prefix_pool_pages", 0))
                 pending_training = (
                     self._pending is not None
                     and not getattr(self._pending, "serve_slots", 0)
                     and not getattr(self._pending,
-                                    "serve_prefill_chunk", 0))
+                                    "serve_prefill_chunk", 0)
+                    and getattr(self._pending,
+                                "serve_prefix_pool_pages", -1) < 0)
                 if unchanged:
                     self._reject(decision, "already_optimal")
                 elif pending_training:
@@ -682,6 +742,11 @@ class RuntimeOptimizer:
                 best["prefill_chunk"]
                 if best["prefill_chunk"] != cfg["prefill_chunk"]
                 else 0),
+            serve_prefix_pool_pages=(
+                best["prefix_pool_pages"]
+                if best["prefix_pool_pages"]
+                != cfg.get("prefix_pool_pages", 0)
+                else -1),
             plan_id=plan_id,
             trace_id=decision.trace_id,
             predicted_speedup=round(best["speedup"], 3),
@@ -694,6 +759,7 @@ class RuntimeOptimizer:
             predicted_speedup=round(best["speedup"], 3),
             knob_serve_slots=best["serve_slots"],
             knob_serve_prefill_chunk=best["prefill_chunk"],
+            knob_serve_prefix_pool_pages=best["prefix_pool_pages"],
         )
         logger.info("replan(%s): chose %s (predicted %.2fx tokens/s, "
                     "plan %s)", decision.trigger, best["key"],

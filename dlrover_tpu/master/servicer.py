@@ -299,6 +299,8 @@ class MasterServicer:
             req.node_id, req.request_id, list(req.tokens or []),
             ttft_s=req.ttft_s, e2e_s=req.e2e_s,
             error_code=req.error_code,
+            prefix_hit_tokens=int(getattr(req, "prefix_hit_tokens", 0)
+                                  or 0),
         )
         return comm.Response(success=ok)
 
@@ -327,6 +329,9 @@ class MasterServicer:
 
         report = self.serve_slo.report()
         report.update(self.serving_scale_policy.to_report())
+        # the prefix-hit ledger rides the SLO view: hit rate and saved
+        # prefill tokens are capacity signals the same operators read
+        report["prefix"] = self.request_router.prefix_summary()
         return comm.DiagnosisReport(report_json=_json.dumps(report))
 
     # -- rendezvous ---------------------------------------------------------

@@ -967,6 +967,22 @@ def serve_cache_bytes(m: ModelSpec, serve_slots: int, max_seq: int,
     return 2.0 * elems * kv_bytes_per_elem(kv_precision, head_dim)
 
 
+def serve_prefix_pool_bytes(m: ModelSpec, pool_pages: int,
+                            page_size: int,
+                            kv_precision: str = "f32") -> float:
+    """Device residency of the shared prefix pool (K and V for every
+    layer, ``pool_pages`` pages of ``page_size`` tokens) — the SAME
+    byte formula as ``serve_cache_bytes``/``KVCacheSpec``. The pool
+    REPLICATES across the data axes (any slot may admit any page), so
+    the per-device HBM charge is this number UNDIVIDED."""
+    kv_heads = m.kv_heads or m.num_heads or 1
+    heads = max(1, m.num_heads or 1)
+    head_dim = m.hidden_size // heads
+    elems = (m.num_layers * max(0, int(pool_pages))
+             * max(1, int(page_size)) * max(1, kv_heads) * head_dim)
+    return 2.0 * elems * kv_bytes_per_elem(kv_precision, head_dim)
+
+
 def decode_kv_read_bytes(m: ModelSpec, serve_slots: int, seq_fill: int,
                          kv_precision: str = "f32") -> float:
     """Bytes of KV pages one decode step reads: every live token's K
@@ -983,6 +999,9 @@ def decode_kv_read_bytes(m: ModelSpec, serve_slots: int, seq_fill: int,
 def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
                     prefill_chunk: int, max_seq: int,
                     kv_precision: str = "f32",
+                    prefix_pool_pages: int = 0,
+                    page_size: int = 16,
+                    prefix_hit_rate: float = 0.0,
                     device: Optional[DeviceSpec] = None) -> Dict:
     """Price one serving config: predicted decode-step seconds and
     tokens/second, with the breakdown the decision trail shows.
@@ -998,19 +1017,28 @@ def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
       prefill amortization: a bigger chunk admits a prompt in fewer
                      interleaved steps but each chunk stalls one
                      decode step longer — priced as chunk_steps
-                     spread over the chunk's tokens
+                     spread over the chunk's tokens. A nonzero prefix
+                     pool discounts it by the expected hit rate
+                     (matched tokens are page COPIES, priced as one
+                     dispatch per page instead of a chunk prefill).
 
     Returns {"step_s", "tokens_per_s", "cache_bytes",
     "cache_bytes_per_device", "breakdown"}. ``tokens_per_s`` is
     monotone-increasing in ``serve_slots`` until the HBM gate refuses
-    the pool — which is the caller's check (``serve_cache_bytes``
-    against the device budget), not this function's.
+    the pool — which is the caller's check (``serve_cache_bytes`` plus
+    the UNDIVIDED ``serve_prefix_pool_bytes`` against the device
+    budget), not this function's.
     """
     dev = device or DeviceSpec()
     n = max(1, int(num_devices))
     slots = max(1, int(serve_slots))
     chunk = max(1, int(prefill_chunk))
+    pool_pages = max(0, int(prefix_pool_pages))
+    hit_rate = min(1.0, max(0.0, float(prefix_hit_rate))) \
+        if pool_pages else 0.0
     cache_bytes = serve_cache_bytes(m, slots, max_seq, kv_precision)
+    pool_bytes = serve_prefix_pool_bytes(
+        m, pool_pages, page_size, kv_precision)
     kv_read = decode_kv_read_bytes(
         m, slots, max(1, max_seq // 2), kv_precision) / n
     kv_read_s = kv_read / dev.hbm_bw
@@ -1027,6 +1055,20 @@ def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
     prefill_calls = math.ceil(avg_prompt / chunk)
     prefill_s_per_req = prefill_calls * (
         dispatch_s + weight_read_s + chunk * kv_read_s / max(1, max_seq // 2) / slots)
+    # prefix reuse: an expected-hit admission replaces its matched
+    # prefill with per-page admit copies (one dispatch each; the page
+    # bytes move at HBM bandwidth, negligible beside the dispatch).
+    # The pool can only ever hold hit tokens it has pages for, so the
+    # discount is additionally capped by the pool's token capacity
+    # against the average prompt.
+    if pool_pages:
+        pool_tokens = pool_pages * max(1, int(page_size))
+        coverage = min(1.0, pool_tokens / avg_prompt)
+        discount = hit_rate * coverage
+        copy_pages = avg_prompt / max(1, int(page_size))
+        copy_s_per_req = discount * copy_pages * dispatch_s
+        prefill_s_per_req = ((1.0 - discount) * prefill_s_per_req
+                             + copy_s_per_req)
     avg_new = max(1.0, max_seq / 4.0)
     prefill_amort_s = prefill_s_per_req / avg_new / slots
     step_s = max(kv_read_s + weight_read_s + prefill_amort_s,
@@ -1035,13 +1077,15 @@ def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
         "step_s": step_s,
         "tokens_per_s": slots / step_s,
         "cache_bytes": cache_bytes,
-        "cache_bytes_per_device": cache_bytes / n,
+        "cache_bytes_per_device": cache_bytes / n + pool_bytes,
         "breakdown": {
             "kv_read_s": kv_read_s,
             "weight_read_s": weight_read_s,
             "flops_s": flops_s,
             "dispatch_s": dispatch_s,
             "prefill_amort_s": prefill_amort_s,
+            "prefix_pool_bytes": pool_bytes,
+            "prefix_hit_rate": hit_rate,
             # channel-resolved, exactly as the terms above priced it —
             # the decision trail must show the number that was USED
             "kv_bytes_per_elem": kv_bytes_per_elem(

@@ -34,6 +34,10 @@ def _serve_main(argv: List[str]) -> int:
                    choices=["f32", "bf16", "int8"])
     p.add_argument("--max_seq", type=int, default=64,
                    help="KV pool depth per slot (tokens)")
+    p.add_argument("--prefix_pool_pages", type=int, default=None,
+                   help="shared prefix-cache pool width in pages "
+                        "(0 disables; default: "
+                        "serve_prefix_pool_pages knob)")
     p.add_argument("--seed", type=int, default=0,
                    help="weight init seed of the demo model")
     args = p.parse_args(argv)
@@ -53,6 +57,7 @@ def _serve_main(argv: List[str]) -> int:
                                rule_set="llama"),
         serve_slots=args.slots, prefill_chunk=args.prefill_chunk,
         kv_precision=args.kv_precision, max_seq=args.max_seq,
+        prefix_pool_pages=args.prefix_pool_pages,
     )
     engine.prepare(params)
     client = MasterClient(args.addr, node_id=args.node_id)
@@ -102,6 +107,17 @@ def _forensic_report(events_path: str) -> dict:
         ],
         "evicted": count(EventKind.SERVE_REQUEST_EVICTED),
         "leases_expired": count(EventKind.SERVE_LEASE_EXPIRED),
+        # the prefix-cache columns: worker-side HIT edges carry the
+        # admitted token count; EVICTED edges carry evicted page counts
+        "prefix": {
+            "hits": count(EventKind.SERVE_PREFIX_HIT),
+            "saved_prefill_tokens": sum(
+                int(r.get("hit_tokens", 0) or 0) for r in records
+                if r.get("kind") == EventKind.SERVE_PREFIX_HIT),
+            "evicted_pages": sum(
+                int(r.get("pages", 0) or 0) for r in records
+                if r.get("kind") == EventKind.SERVE_PREFIX_EVICTED),
+        },
     }
 
 
@@ -141,6 +157,14 @@ def _requests_main(argv: List[str]) -> int:
         print("latency: ttft p50=%s p95=%s  e2e p50=%s p95=%s (s)" % (
             lat.get("ttft_p50_s"), lat.get("ttft_p95_s"),
             lat.get("e2e_p50_s"), lat.get("e2e_p95_s")))
+        pref = report.get("prefix") or {}
+        if pref:
+            print("prefix: hits=%s saved_tokens=%s hit_rate=%s "
+                  "affinity_routed=%s" % (
+                      pref.get("hits"),
+                      pref.get("saved_prefill_tokens"),
+                      pref.get("hit_rate"),
+                      pref.get("affinity_routed")))
         for node, row in sorted(report.get("nodes", {}).items(),
                                 key=lambda kv: int(kv[0])):
             print(f"  node {node}: leased={row.get('leased')} "
@@ -221,6 +245,14 @@ def _slo_main(argv: List[str]) -> int:
         for prop in report.get("proposals", []):
             print(f"  proposal: {prop.get('direction')} "
                   f"({prop.get('reason')}) [{prop.get('trace_id')}]")
+        pref = report.get("prefix") or {}
+        if pref:
+            print("prefix: hits=%s saved_tokens=%s hit_rate=%s "
+                  "affinity_routed=%s" % (
+                      pref.get("hits"),
+                      pref.get("saved_prefill_tokens"),
+                      pref.get("hit_rate"),
+                      pref.get("affinity_routed")))
     else:
         ledger = report.get("ledger", {})
         print("slot-seconds ledger (%s runs, %.3f slot-s, coverage "
@@ -230,6 +262,13 @@ def _slo_main(argv: List[str]) -> int:
         for cls, row in ledger.get("buckets", {}).items():
             print(f"  {cls:>14}: {row['seconds']:>10.3f}s "
                   f"({row['fraction'] * 100:.1f}%)")
+        pref = ledger.get("prefix") or {}
+        if pref:
+            print("  prefix: hits=%s misses=%s evictions=%s "
+                  "saved_tokens=%s" % (
+                      pref.get("hits"), pref.get("misses"),
+                      pref.get("evictions"),
+                      pref.get("saved_prefill_tokens")))
         for v in report.get("violations", []):
             print(f"  VIOLATION {v['slo']}: observed={v['observed']} "
                   f"target={v['target']} burn={v['burn_rate']} "

@@ -31,10 +31,14 @@ import numpy as np
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.serving.kv_cache import (
     KVCacheSpec,
+    copy_page_to_pool,
+    copy_page_to_slot,
     init_kv_cache,
+    init_prefix_pool,
     migrate_slots_host,
     serve_shardings,
 )
+from dlrover_tpu.serving.prefix_index import PrefixIndex
 from dlrover_tpu.telemetry import (
     EventKind,
     SpanName,
@@ -57,15 +61,23 @@ class ServeProgram:
     decode: Callable
     prefill: Callable
     mesh: Any
-    shardings: Dict[str, Any]  # {"params": ..., "cache": ...}
+    shardings: Dict[str, Any]  # {"params": ..., "cache"[, "prefix"]: ...}
     spec: KVCacheSpec
     config: Any
     strategy: Any
     prefill_chunk: int
+    # prefix-pool page copies (None when the pool is off): ONE compiled
+    # program each, reused for every hit length — the indices are
+    # traced scalars, so an H-page hit is H calls, zero recompiles
+    admit_copy: Optional[Callable] = None
+    publish_copy: Optional[Callable] = None
 
     def compiled_cache_size(self) -> int:
         total = 0
-        for fn in (self.decode, self.prefill):
+        for fn in (self.decode, self.prefill, self.admit_copy,
+                   self.publish_copy):
+            if fn is None:
+                continue
             inner = getattr(fn, "__wrapped__", fn)
             size = getattr(inner, "_cache_size", None)
             if callable(size):
@@ -108,6 +120,7 @@ class ServeEngine:
                  prefill_chunk: Optional[int] = None,
                  kv_precision: Optional[str] = None,
                  max_seq: int = 0, page_size: int = 16,
+                 prefix_pool_pages: Optional[int] = None,
                  devices=None):
         from dlrover_tpu.parallel.strategy import Strategy
         from dlrover_tpu.serving.kv_cache import resolve_kv_precision
@@ -126,6 +139,8 @@ class ServeEngine:
         self.prefill_chunk = _fit_prefill_chunk(
             int(_resolve_knob(prefill_chunk, "serve_prefill_chunk",
                               32)), self._pool_depth)
+        self.prefix_pool_pages = max(0, int(_resolve_knob(
+            prefix_pool_pages, "serve_prefix_pool_pages", 0)))
         self._devices = list(devices) if devices is not None else None
         self._initial_devices: Optional[int] = None
         self._programs: "collections.OrderedDict[str, ServeProgram]" = (
@@ -136,6 +151,10 @@ class ServeEngine:
         self.program: Optional[ServeProgram] = None
         self.params = None
         self.cache = None
+        # shared prefix pool: device pages + the host radix index that
+        # owns their meaning (None while the knob is 0)
+        self.pool = None
+        self.prefix_index: Optional[PrefixIndex] = None
 
     # -- program cache -------------------------------------------------------
 
@@ -144,6 +163,7 @@ class ServeEngine:
             self._config, num_slots=self.serve_slots,
             max_seq=self._max_seq, page_size=self._page_size,
             precision=self.kv_precision,
+            prefix_pool_pages=self.prefix_pool_pages,
         )
 
     def _resolved_strategy(self, num_devices: int):
@@ -159,6 +179,7 @@ class ServeEngine:
             + f"|pc={self.prefill_chunk}"
             + f"|mesh={mesh_axes_key(strategy.mesh)}"
             + f"|kvp={self.kv_precision}"
+            + f"|ppp={self.prefix_pool_pages}"
         )
 
     def _build(self, devices: Optional[list]) -> ServeProgram:
@@ -228,6 +249,30 @@ class ServeEngine:
             out_shardings=(shardings["cache"], replicated),
             donate_argnums=(1,),
         )
+        admit_copy = publish_copy = None
+        if spec.prefix_pool_pages > 0:
+            def admit_fn(cache, pool, slot, dst_start, src_page):
+                return copy_page_to_slot(cache, pool, slot, dst_start,
+                                         src_page, spec)
+
+            def publish_fn(pool, cache, slot, src_start, dst_page):
+                return copy_page_to_pool(pool, cache, slot, src_start,
+                                         dst_page, spec)
+
+            admit_copy = jax.jit(
+                admit_fn,
+                in_shardings=(shardings["cache"], shardings["prefix"],
+                              replicated, replicated, replicated),
+                out_shardings=shardings["cache"],
+                donate_argnums=(0,),
+            )
+            publish_copy = jax.jit(
+                publish_fn,
+                in_shardings=(shardings["prefix"], shardings["cache"],
+                              replicated, replicated, replicated),
+                out_shardings=shardings["prefix"],
+                donate_argnums=(0,),
+            )
         logger.info(
             "serve program compiled: %d devices, slots=%d chunk=%d "
             "kv=%s mesh=%s", len(devices), spec.num_slots,
@@ -238,6 +283,7 @@ class ServeEngine:
             decode=decode, prefill=prefill, mesh=mesh,
             shardings=shardings, spec=spec, config=config,
             strategy=strategy, prefill_chunk=self.prefill_chunk,
+            admit_copy=admit_copy, publish_copy=publish_copy,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -254,6 +300,7 @@ class ServeEngine:
         self.cache = jax.device_put(
             _host_zero_cache(self.program.spec),
             self.program.shardings["cache"])
+        self.reset_prefix()
         jax.block_until_ready(self.params)
 
     def fresh_cache(self):
@@ -262,6 +309,22 @@ class ServeEngine:
         return jax.device_put(
             _host_zero_cache(self.program.spec),
             self.program.shardings["cache"])
+
+    def reset_prefix(self):
+        """(Re)build an EMPTY prefix pool + index for the active
+        program — prepare, a pool-knob retune, and bench legs that
+        want identical cold-pool starting lines all land here."""
+        import jax
+
+        spec = self.program.spec
+        if spec.prefix_pool_pages <= 0:
+            self.pool = None
+            self.prefix_index = None
+            return
+        self.pool = jax.device_put(
+            _host_zero_pool(spec), self.program.shardings["prefix"])
+        self.prefix_index = PrefixIndex(
+            spec.page_size, spec.prefix_pool_pages)
 
     # -- promotion (checkpoint -> serving, no cold start) --------------------
 
@@ -340,21 +403,26 @@ class ServeEngine:
 
     def prewarm(self, devices=None, serve_slots: Optional[int] = None,
                 prefill_chunk: Optional[int] = None,
+                prefix_pool_pages: Optional[int] = None,
                 execute: bool = True) -> bool:
         """Standby-compile the program for a topology or knob set we
         may swap to, executing one dummy decode step AND one dummy
-        prefill chunk (jit is lazy) — so the live resize / retune that
-        follows pays ZERO recompiles. Does not switch the active
+        prefill chunk (plus one admit/publish page copy when the
+        prefix pool is on — jit is lazy) — so the live resize / retune
+        that follows pays ZERO recompiles. Does not switch the active
         program. Returns True when a compile happened."""
         import jax
         import jax.numpy as jnp
 
         prev_slots, prev_chunk = self.serve_slots, self.prefill_chunk
+        prev_ppp = self.prefix_pool_pages
         if serve_slots is not None:
             self.serve_slots = max(1, int(serve_slots))
         if prefill_chunk is not None:
             self.prefill_chunk = _fit_prefill_chunk(
                 int(prefill_chunk), self._pool_depth)
+        if prefix_pool_pages is not None:
+            self.prefix_pool_pages = max(0, int(prefix_pool_pages))
         try:
             before = self.compile_count
             program = self._build(
@@ -375,6 +443,17 @@ class ServeEngine:
                 cache, _ll = program.prefill(
                     params, cache, chunk, jnp.int32(0), jnp.int32(0),
                     jnp.int32(1))
+                if program.admit_copy is not None:
+                    pool = jax.device_put(
+                        _host_zero_pool(program.spec),
+                        program.shardings["prefix"])
+                    cache = program.admit_copy(
+                        cache, pool, jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0))
+                    pool = program.publish_copy(
+                        pool, cache, jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0))
+                    jax.block_until_ready(pool)
                 jax.block_until_ready(cache)
                 logger.info("prewarmed standby serve program (%d "
                             "devices, slots=%d)", len(
@@ -382,6 +461,7 @@ class ServeEngine:
         finally:
             self.serve_slots = prev_slots
             self.prefill_chunk = prev_chunk
+            self.prefix_pool_pages = prev_ppp
         return compiled
 
     def snapshot(self):
@@ -391,9 +471,14 @@ class ServeEngine:
         restarting from their prompts."""
         from dlrover_tpu.checkpoint import HostSnapshot
 
-        return HostSnapshot.take(
-            {"params": self.params, "cache": self.cache},
-            kind="serving")
+        tree = {"params": self.params, "cache": self.cache}
+        if self.pool is not None:
+            # the prefix pool rides the resize with the slot pages: the
+            # host index stays valid (it names pool page ids, and every
+            # page's bytes survive the reshard), so pinned in-flight
+            # hits and future matches carry straight across
+            tree["prefix"] = self.pool
+        return HostSnapshot.take(tree, kind="serving")
 
     def live_resize(self, devices=None, snapshot=None,
                     reason: str = "") -> int:
@@ -414,11 +499,26 @@ class ServeEngine:
             self._devices = list(devices) if devices is not None else None
             compiles_before = self.compile_count
             self.program = self._build(self._devices)
-            state = snapshot.restore({
+            targets = {
                 "params": self.program.shardings["params"],
                 "cache": self.program.shardings["cache"],
-            })
+            }
+            snap_tree = getattr(snapshot, "tree", None) or {}
+            carry_pool = ("prefix" in snap_tree
+                          and "prefix" in self.program.shardings)
+            if carry_pool:
+                targets["prefix"] = self.program.shardings["prefix"]
+            state = snapshot.restore(targets)
             self.params, self.cache = state["params"], state["cache"]
+            if carry_pool:
+                self.pool = state["prefix"]
+            elif self.program.spec.prefix_pool_pages > 0:
+                # a snapshot without pool pages (e.g. taken before the
+                # knob turned on) cannot carry the index: rebuild clean
+                self.reset_prefix()
+            else:
+                self.pool = None
+                self.prefix_index = None
             jax.block_until_ready(self.cache)
         n = self.program.mesh.devices.size
         recompiled = self.compile_count - compiles_before
@@ -442,15 +542,27 @@ class ServeEngine:
 
     def retune(self, serve_slots: Optional[int] = None,
                prefill_chunk: Optional[int] = None,
+               prefix_pool_pages: Optional[int] = None,
                slot_map: Optional[Dict[int, int]] = None) -> int:
         """Apply optimizer-chosen serve knobs on the current world
         through the program cache (drain first — the caller owns the
         window). A slot-count change repacks live slots host-side via
         ``slot_map`` (old -> new); prefill_chunk swaps are pure program
-        swaps. Failure restores the previous knobs and re-raises."""
+        swaps. Failure restores the previous knobs and re-raises.
+
+        Prefix-pool discipline: a POOL-SIZE change rebuilds the pool
+        empty (page ids mean nothing across capacities) and a
+        PREFILL-CHUNK change flushes the index — published page bytes
+        depend on the chunk windows that computed them, so pages
+        published under the old grain would break the bitwise-
+        continuation oracle under the new one. A slot-only retune
+        carries pool and index untouched (the pool has no slot
+        dimension). Flush/rebuild cannot dangle refcounts: in-flight
+        handles hold the orphaned nodes and release into them."""
         import jax
 
         prev_slots, prev_chunk = self.serve_slots, self.prefill_chunk
+        prev_ppp = self.prefix_pool_pages
         prev_program = self.program
         old_spec = self.program.spec if self.program else None
         try:
@@ -459,8 +571,13 @@ class ServeEngine:
             if prefill_chunk is not None:
                 self.prefill_chunk = _fit_prefill_chunk(
                     int(prefill_chunk), self._pool_depth)
+            if prefix_pool_pages is not None:
+                self.prefix_pool_pages = max(0, int(prefix_pool_pages))
             compiles_before = self.compile_count
             new_program = self._build(self._devices)
+            chunk_changed = (prev_program is not None
+                             and new_program.prefill_chunk
+                             != prev_program.prefill_chunk)
             if old_spec is not None and new_program.spec == old_spec:
                 # a pure PROGRAM swap (chunk-only retune): the pool
                 # spec, shardings and devices are unchanged, so the
@@ -468,6 +585,8 @@ class ServeEngine:
                 # the new program — no host round-trip of the whole
                 # state inside the serving drain
                 self.program = new_program
+                if chunk_changed and self.prefix_index is not None:
+                    self.prefix_index.flush()
                 return self.compile_count - compiles_before
             host = jax.device_get(
                 {"params": self.params, "cache": self.cache})
@@ -483,10 +602,15 @@ class ServeEngine:
             self.cache = jax.device_put(
                 cache_host, self.program.shardings["cache"])
             jax.block_until_ready(self.cache)
+            if self.prefix_pool_pages != prev_ppp:
+                self.reset_prefix()
+            elif chunk_changed and self.prefix_index is not None:
+                self.prefix_index.flush()
             return self.compile_count - compiles_before
         except Exception:
             self.serve_slots = prev_slots
             self.prefill_chunk = prev_chunk
+            self.prefix_pool_pages = prev_ppp
             # the ACTIVE program too, not just the knobs: _build may
             # have swapped it before the device_put failed (OOM on a
             # wider pool) — leaving the new-spec program over the
@@ -495,6 +619,100 @@ class ServeEngine:
             # _ensure_prepared
             self.program = prev_program
             raise
+
+    # -- shared prefix pool (radix-indexed KV reuse, copy-on-admit) ----------
+
+    def prefix_enabled(self) -> bool:
+        return (self.program is not None
+                and self.program.spec.prefix_pool_pages > 0
+                and self.pool is not None
+                and self.prefix_index is not None)
+
+    def _prefix_align(self) -> int:
+        """Matched prefixes round DOWN to this token grain —
+        lcm(page_size, prefill_chunk) — so the unmatched tail's chunk
+        windows start at the SAME multiples of the chunk a full
+        prefill uses: the reused continuation is then the same
+        compiled invocations over the same bytes, which is what makes
+        it bitwise on f32/bf16 pools (and keeps every padded write
+        window inside the pool — the dynamic_update_slice clamp
+        hazard ``_fit_prefill_chunk`` documents cannot arise)."""
+        import math as _math
+
+        pg = self.program.spec.page_size
+        c = self.program.prefill_chunk
+        return pg * c // _math.gcd(pg, c)
+
+    def prefix_match(self, prompt: List[int]):
+        """Walk the index for the longest usable prefix of ``prompt``.
+        Returns ``(matched_tokens, handle)`` with the matched chain
+        PINNED, or ``(0, None)``. The match is capped strictly below
+        ``len(prompt)`` — a final prefill chunk must always run (its
+        last logits seed the first generated token)."""
+        if not self.prefix_enabled():
+            return 0, None
+        align = self._prefix_align()
+        pg = self.program.spec.page_size
+        cap_tokens = ((len(prompt) - 1) // align) * align
+        if cap_tokens <= 0:
+            return 0, None
+        handle = self.prefix_index.match(
+            prompt, max_pages=cap_tokens // pg,
+            align_pages=align // pg)
+        if handle is None:
+            return 0, None
+        return handle.tokens, handle
+
+    def prefix_admit(self, slot: int, handle) -> None:
+        """Copy the matched pool pages into the slot's leading rows —
+        H pages = H calls of ONE compiled copy program."""
+        import jax.numpy as jnp
+
+        program = self.program
+        pg = program.spec.page_size
+        for i, page_id in enumerate(handle.pages):
+            self.cache = program.admit_copy(
+                self.cache, self.pool, jnp.int32(slot),
+                jnp.int32(i * pg), jnp.int32(page_id))
+
+    def prefix_publish(self, slot: int, prompt: List[int]):
+        """Index + copy the full pages of a COMPLETED prefill into the
+        pool (pages already present are skipped; a full pool skips the
+        rest — logged/counted, never raised). Returns
+        ``(pages_published, pages_evicted)``."""
+        import jax.numpy as jnp
+
+        if not self.prefix_enabled():
+            return 0, 0
+        program = self.program
+        pg = program.spec.page_size
+        evict_before = self.prefix_index.evictions
+        new_pages = self.prefix_index.publish(prompt)
+        for idx, page_id in new_pages:
+            self.pool = program.publish_copy(
+                self.pool, self.cache, jnp.int32(slot),
+                jnp.int32(idx * pg), jnp.int32(page_id))
+        return (len(new_pages),
+                self.prefix_index.evictions - evict_before)
+
+    def prefix_release(self, handle) -> None:
+        """Unpin a hit's pages (idempotent; survives flush/rebuild)."""
+        if self.prefix_index is not None:
+            self.prefix_index.release(handle)
+        elif handle is not None:
+            handle.released = True
+
+    def prefix_stats(self) -> Dict[str, Any]:
+        """Cumulative pool counters + current occupancy (empty when
+        the pool is off) — the SERVE_END summary and the hit-rate the
+        config report feeds the optimizer's pricing."""
+        if self.prefix_index is None:
+            return {}
+        out = dict(self.prefix_index.stats())
+        out["pool_bytes"] = self.program.spec.prefix_pool_bytes()
+        out["used_bytes"] = (out["used_pages"]
+                             * self.program.spec.prefix_page_bytes())
+        return out
 
 
 def _host_zero_cache(spec: KVCacheSpec):
@@ -505,6 +723,16 @@ def _host_zero_cache(spec: KVCacheSpec):
     return jax.tree.map(
         lambda a: np.zeros(a.shape, a.dtype),
         jax.eval_shape(lambda: init_kv_cache(spec)),
+    )
+
+
+def _host_zero_pool(spec: KVCacheSpec):
+    """Zero-filled host prefix pool (the ``_host_zero_cache`` twin)."""
+    import jax
+
+    return jax.tree.map(
+        lambda a: np.zeros(a.shape, a.dtype),
+        jax.eval_shape(lambda: init_prefix_pool(spec)),
     )
 
 
@@ -535,6 +763,11 @@ class ServeRequestState:
     # local-queue submissions stamp their enqueue time so the worker
     # can report queue-wait without a router (bench/local mode)
     t_submit: Optional[float] = None
+    # prompt tokens whose KV pages came from the shared prefix pool
+    # (copy-on-admit) instead of prefill, and the pin over those pages
+    # — held admit -> completion, released idempotently
+    prefix_hit_tokens: int = 0
+    prefix_handle: Any = None
 
 
 @dataclass
@@ -634,6 +867,26 @@ class ServeExecutor:
         self._h_prefill_e2e = reg.histogram(
             tm.SERVE_PREFILL_TIME, buckets=LATENCY_BUCKETS,
             help="admit -> prompt fully prefilled wall seconds")
+        # shared prefix pool counters/gauges (flat at zero while the
+        # pool knob is off — the registry costs nothing for them)
+        self._c_phits = reg.counter(
+            tm.SERVE_PREFIX_HITS,
+            help="admissions whose leading pages came from the pool")
+        self._c_pmisses = reg.counter(
+            tm.SERVE_PREFIX_MISSES,
+            help="admissions that walked the index and found nothing")
+        self._c_pevict = reg.counter(
+            tm.SERVE_PREFIX_EVICTIONS,
+            help="pool pages LRU-evicted to make room for a publish")
+        self._c_psaved = reg.counter(
+            tm.SERVE_PREFIX_SAVED_TOKENS,
+            help="prefill tokens skipped via copy-on-admit")
+        self._g_pool_used = reg.gauge(
+            tm.SERVE_PREFIX_POOL_USED_PAGES,
+            help="prefix-pool pages currently indexed")
+        self._g_pool_bytes = reg.gauge(
+            tm.SERVE_PREFIX_POOL_BYTES,
+            help="prefix-pool device residency (the HBM-gate charge)")
         # SLO-plane node reporting: serve workers ride the SAME
         # NodeRuntimeReport path training workers do, so the master's
         # /metrics carries {node=} serving gauges and the straggler
@@ -685,10 +938,12 @@ class ServeExecutor:
 
     def request_retune(self, serve_slots: Optional[int] = None,
                        prefill_chunk: Optional[int] = None,
+                       prefix_pool_pages: Optional[int] = None,
                        plan_id: str = "", prewarm: bool = False):
         self._retune_request = {
             "serve_slots": serve_slots,
             "prefill_chunk": prefill_chunk,
+            "prefix_pool_pages": prefix_pool_pages,
             "plan_id": plan_id,
             "prewarm": bool(prewarm),
         }
@@ -770,12 +1025,37 @@ class ServeExecutor:
                 )
                 self._complete(state, error_code="SERVE_REQUEST_EVICTED")
                 continue
+            matched, handle = self._engine.prefix_match(state.prompt)
+            if handle is not None:
+                # copy-on-admit: matched pages land in the slot's
+                # leading rows NOW, so the prefill tick below starts at
+                # the unmatched tail — same chunk windows a full
+                # prefill would run from that cursor (bitwise)
+                self._engine.prefix_admit(slot, handle)
+                state.cursor = matched
+                state.prefix_hit_tokens = matched
+                state.prefix_handle = handle
+                self._c_phits.inc()
+                self._c_psaved.inc(matched)
+                emit_event(
+                    EventKind.SERVE_PREFIX_HIT,
+                    trace_id=state.trace_id,
+                    request_id=state.request_id, slot=slot,
+                    hit_tokens=matched,
+                    prompt_tokens=len(state.prompt),
+                )
+            elif self._engine.prefix_enabled():
+                self._c_pmisses.inc()
             self._slots[slot] = state
             self._c_admitted.inc()
             if not free:
                 break
         self._g_occupancy.set(
             sum(1 for r in self._slots if r is not None))
+        if self._engine.prefix_enabled():
+            stats = self._engine.prefix_stats()
+            self._g_pool_used.set(stats.get("used_pages", 0))
+            self._g_pool_bytes.set(stats.get("used_bytes", 0))
 
     def _prefill_tick(self):
         """Dispatch at most ONE chunk per admitting slot, so prefill
@@ -807,6 +1087,20 @@ class ServeExecutor:
                 prompt_tokens=len(state.prompt),
             )
             if state.cursor >= len(state.prompt):
+                # a completed prefill publishes its full pages into
+                # the prefix pool BEFORE the decode stream can touch
+                # the slot (decode appends rows past the prompt; the
+                # published pages must be pure prefill output)
+                published, evicted = self._engine.prefix_publish(
+                    slot, state.prompt)
+                if evicted:
+                    self._c_pevict.inc(evicted)
+                    emit_event(
+                        EventKind.SERVE_PREFIX_EVICTED,
+                        trace_id=state.trace_id,
+                        request_id=state.request_id,
+                        pages=evicted,
+                    )
                 # final chunk: its last logits seed the first token —
                 # the one host sync admission pays (TTFT is measured
                 # here, which is exactly what it means)
@@ -837,6 +1131,11 @@ class ServeExecutor:
 
     def _complete(self, state: ServeRequestState, error_code: str = ""):
         now = time.monotonic()
+        # the pin over the hit's pool pages ends with the request
+        # (idempotent — a pool flush/rebuild in between is harmless)
+        if state.prefix_handle is not None:
+            self._engine.prefix_release(state.prefix_handle)
+            state.prefix_handle = None
         record = {
             "request_id": state.request_id,
             "tokens": list(state.generated),
@@ -844,6 +1143,7 @@ class ServeExecutor:
                        if state.t_first_token else None),
             "e2e_s": round(now - state.t_admit, 6),
             "error_code": error_code,
+            "prefix_hit_tokens": int(state.prefix_hit_tokens),
         }
         emit_event(
             EventKind.SERVE_REQUEST_DONE,
@@ -989,6 +1289,7 @@ class ServeExecutor:
         self._retune_request = None
         new_slots = req.get("serve_slots")
         new_chunk = req.get("prefill_chunk")
+        new_ppp = req.get("prefix_pool_pages")
         plan_id = req.get("plan_id", "")
         if new_chunk is not None:
             fitted = _fit_prefill_chunk(int(new_chunk),
@@ -1044,7 +1345,8 @@ class ServeExecutor:
             # compiles
             try:
                 self._engine.prewarm(serve_slots=new_slots,
-                                     prefill_chunk=new_chunk)
+                                     prefill_chunk=new_chunk,
+                                     prefix_pool_pages=new_ppp)
             except Exception:  # noqa: BLE001 — prewarm is an
                 # optimization; the retune still decides the outcome
                 logger.warning("serve prewarm failed", exc_info=True)
@@ -1052,6 +1354,7 @@ class ServeExecutor:
             self._engine.retune(
                 serve_slots=new_slots,
                 prefill_chunk=req.get("prefill_chunk"),
+                prefix_pool_pages=new_ppp,
                 slot_map=slot_map)
         except Exception:  # noqa: BLE001 — a bad plan must not kill
             # serving; the engine restored the previous knobs
@@ -1089,6 +1392,13 @@ class ServeExecutor:
                 self._client, "report_serve_config"):
             return
         program = self._engine.program
+        stats = self._engine.prefix_stats()
+        looked = stats.get("hits", 0) + stats.get("misses", 0)
+        # -1 = "no observation yet": the optimizer then falls back to
+        # the serve_prefix_expected_hit_rate prior instead of pricing
+        # a cold pool as worthless forever
+        hit_rate = (stats["hits"] / looked if stats and looked
+                    else -1.0)
         try:
             self._client.report_serve_config(
                 world=int(program.mesh.devices.size),
@@ -1099,6 +1409,9 @@ class ServeExecutor:
                 num_layers=int(program.spec.num_layers),
                 kv_heads=int(program.spec.num_kv_heads),
                 head_dim=int(program.spec.head_dim),
+                prefix_pool_pages=int(program.spec.prefix_pool_pages),
+                page_size=int(program.spec.page_size),
+                prefix_hit_rate=float(hit_rate),
                 plan_id=plan_id, apply_failed=bool(apply_failed),
             )
         except Exception:  # noqa: BLE001 — a dead master must not
@@ -1122,12 +1435,17 @@ class ServeExecutor:
         plan_id = getattr(cfg, "plan_id", "") or ""
         slots = int(getattr(cfg, "serve_slots", 0) or 0)
         chunk = int(getattr(cfg, "serve_prefill_chunk", 0) or 0)
+        # the pool knob's leave-unchanged sentinel is -1 (0 is a real
+        # value: pool off), unlike its 0-sentinel siblings
+        ppp = int(getattr(cfg, "serve_prefix_pool_pages", -1))
         if not plan_id or plan_id == self._seen_plan \
-                or not (slots or chunk):
+                or not (slots or chunk or ppp >= 0):
             return
         self._seen_plan = plan_id
         self.request_retune(serve_slots=slots or None,
                             prefill_chunk=chunk or None,
+                            prefix_pool_pages=(ppp if ppp >= 0
+                                               else None),
                             plan_id=plan_id,
                             prewarm=bool(getattr(cfg, "prewarm", True)))
 
@@ -1250,7 +1568,8 @@ class ServeExecutor:
                    slot_ledger={k: round(v, 6)
                                 for k, v in self._ledger.items()},
                    slot_seconds=round(self._slot_seconds, 6),
-                   serve_wall_s=round(self._serve_wall, 6))
+                   serve_wall_s=round(self._serve_wall, 6),
+                   prefix=self._engine.prefix_stats() or None)
         if self._report_hook is not None:
             try:
                 self._report_hook.flush(

@@ -112,6 +112,12 @@ class KVCacheSpec:
     pages_per_slot: int = 8
     # "f32" | "bf16" | "int8" (see resolve_kv_precision)
     precision: str = "f32"
+    # shared prefix pool, in pages (0 = off): a device-resident pool of
+    # refcounted KV pages BESIDE the slot pool, indexed host-side by
+    # serving.prefix_index — admission copies matched pages into the
+    # slot's contiguous range (copy-on-admit), so the decode read stays
+    # gather-free and the slot programs never see the pool
+    prefix_pool_pages: int = 0
 
     @property
     def max_seq(self) -> int:
@@ -136,13 +142,29 @@ class KVCacheSpec:
         return int(2 * elems  # K and V
                    * kv_bytes_per_elem(self.precision, self.head_dim))
 
+    def prefix_page_bytes(self) -> int:
+        """Residency of ONE prefix-pool page (K+V for every layer),
+        priced by the SAME ``kv_bytes_per_elem`` formula the slot pool,
+        the HBM feasibility gate and the planner's decode term share."""
+        from dlrover_tpu.parallel.planner import kv_bytes_per_elem
+
+        elems = (self.num_layers * self.page_size
+                 * self.num_kv_heads * self.head_dim)
+        return int(2 * elems  # K and V
+                   * kv_bytes_per_elem(self.precision, self.head_dim))
+
+    def prefix_pool_bytes(self) -> int:
+        return self.prefix_page_bytes() * self.prefix_pool_pages
+
     def total_bytes(self) -> int:
-        return self.bytes_per_slot() * self.num_slots
+        return (self.bytes_per_slot() * self.num_slots
+                + self.prefix_pool_bytes())
 
     @classmethod
     def from_model(cls, config, num_slots: int, max_seq: int = 0,
                    page_size: int = 16,
-                   precision: Optional[str] = None) -> "KVCacheSpec":
+                   precision: Optional[str] = None,
+                   prefix_pool_pages: int = 0) -> "KVCacheSpec":
         """Derive the pool geometry from a model config (LlamaConfig-
         shaped). ``max_seq`` rounds UP to a whole number of pages."""
         want = int(max_seq or config.max_seq_len)
@@ -155,6 +177,7 @@ class KVCacheSpec:
             page_size=int(page_size),
             pages_per_slot=pages,
             precision=resolve_kv_precision(precision),
+            prefix_pool_pages=max(0, int(prefix_pool_pages)),
         )
 
     def with_slots(self, num_slots: int) -> "KVCacheSpec":
@@ -192,6 +215,78 @@ def init_kv_cache(spec: KVCacheSpec) -> Dict[str, Any]:
         cache["k_scale"] = jnp.ones((l, s, t, kv, nb), jnp.float32)
         cache["v_scale"] = jnp.ones((l, s, t, kv, nb), jnp.float32)
     return cache
+
+
+def init_prefix_pool(spec: KVCacheSpec) -> Dict[str, Any]:
+    """The shared prefix pool pytree — a flat array of
+    ``prefix_pool_pages`` KV pages (K+V for every layer per page; the
+    host-side ``PrefixIndex`` decides what each page means). Leaves:
+
+      k, v             [L, P, page_size, KV, HD]  (store dtype)
+      k_scale, v_scale [L, P, page_size, KV, NB]  f32 (int8 only)
+
+    Zero-filled; a page is never matched before it is published, so
+    stale bytes need no invalidation pass on page-id reuse (the index
+    removes an evicted node from the trie FIRST)."""
+    l, p = spec.num_layers, spec.prefix_pool_pages
+    pg, kv, hd = spec.page_size, spec.num_kv_heads, spec.head_dim
+    pool: Dict[str, Any] = {
+        "k": jnp.zeros((l, p, pg, kv, hd), store_dtype(spec)),
+        "v": jnp.zeros((l, p, pg, kv, hd), store_dtype(spec)),
+    }
+    if spec.precision == "int8":
+        nb = spec.scale_blocks
+        pool["k_scale"] = jnp.ones((l, p, pg, kv, nb), jnp.float32)
+        pool["v_scale"] = jnp.ones((l, p, pg, kv, nb), jnp.float32)
+    return pool
+
+
+# -- page copies between the prefix pool and the slot pool --------------------
+#
+# Copy-on-admit: a hit COPIES the matched pool pages into the slot's
+# contiguous page range, so the decode read stays a plain slice of the
+# slot's own rows (gather-free) and the decode/prefill programs never
+# change shape — zero recompiles, one compiled copy program for every
+# hit length (H pages = H calls of the same program with traced
+# indices; every window is page-aligned and inside the pool, so the
+# ``dynamic_update_slice`` clamp hazard cannot bite).
+
+
+def copy_page_to_slot(cache: Dict[str, Any], pool: Dict[str, Any],
+                      slot, dst_start, src_page,
+                      spec: KVCacheSpec) -> Dict[str, Any]:
+    """One pool page -> the slot rows ``[dst_start, dst_start+page)``.
+    Pure; jitted by the engine with the cache donated."""
+    import jax.lax as lax
+
+    out = dict(cache)
+    for name in pool:
+        leaf = pool[name]
+        l, _, pg, kvh, last = leaf.shape
+        page = lax.dynamic_slice(
+            leaf, (0, src_page, 0, 0, 0), (l, 1, pg, kvh, last))
+        out[name] = lax.dynamic_update_slice(
+            cache[name], page, (0, slot, dst_start, 0, 0))
+    return out
+
+
+def copy_page_to_pool(pool: Dict[str, Any], cache: Dict[str, Any],
+                      slot, src_start, dst_page,
+                      spec: KVCacheSpec) -> Dict[str, Any]:
+    """The slot rows ``[src_start, src_start+page)`` -> one pool page
+    (publish after a completed prefill). Pure; pool donated."""
+    import jax.lax as lax
+
+    out = dict(pool)
+    for name in pool:
+        leaf = cache[name]
+        l, _, _, kvh, last = leaf.shape
+        pg = spec.page_size
+        page = lax.dynamic_slice(
+            leaf, (0, slot, src_start, 0, 0), (l, 1, pg, kvh, last))
+        out[name] = lax.dynamic_update_slice(
+            pool[name], page, (0, dst_page, 0, 0, 0))
+    return out
 
 
 # -- encode/decode at the page boundary --------------------------------------
@@ -241,19 +336,28 @@ def kv_cache_rules(base_rule_set: str = "llama") -> ShardingRules:
         (r"cache/(k|v)_scale$",
          (None, ("data", "fsdp"), None, "tensor", None)),
         (r"cache/length$", (("data", "fsdp"),)),
+        # prefix pool [L, P, page, KV, HD]: heads follow the slot pool
+        # onto the model axis; the PAGE dimension replicates — any data
+        # shard's slot may admit any page, and replication is what
+        # makes the per-device HBM charge the conservative, undivided
+        # pool_bytes the feasibility gate prices
+        (r"prefix/(k|v)(_scale)?$", (None, None, None, "tensor", None)),
         *base.rules,
     ], default=base.default)
 
 
 def serve_shardings(mesh, spec: KVCacheSpec, params_abstract,
                     base_rule_set: str = "llama"):
-    """NamedShardings for the joint ``{"params", "cache"}`` tree a
-    serve program runs over."""
+    """NamedShardings for the joint ``{"params", "cache"[, "prefix"]}``
+    tree a serve program runs over."""
     rules = kv_cache_rules(base_rule_set)
     abstract = {
         "params": params_abstract,
         "cache": jax.eval_shape(lambda: init_kv_cache(spec)),
     }
+    if spec.prefix_pool_pages > 0:
+        abstract["prefix"] = jax.eval_shape(
+            lambda: init_prefix_pool(spec))
     return rules.tree_shardings(mesh, abstract)
 
 

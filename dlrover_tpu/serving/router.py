@@ -120,6 +120,19 @@ class RequestRouter:
         # gauges/ledger must not rescan every tracked request under
         # the lock on the serving hot path
         self._live_counts = {"queued": 0, "leased": 0, "done": 0}
+        # prefix-hit ledger (worker-reported at completion) + the soft
+        # session-affinity map: prefix key -> the node whose pool
+        # first served it. SOFT: correctness never depends on routing
+        # (a worker without the pages exact-misses and prefills), so
+        # pass 2 of lease() fills spare capacity FIFO from anywhere —
+        # affinity can never starve a request.
+        self._n_prefix_hits = 0
+        self._n_prefix_hit_tokens = 0
+        self._n_affinity_routed = 0
+        self._prefix_home: Dict[tuple, int] = {}
+        self._prefix_home_cap = 4096
+        self._affinity = bool(getattr(
+            get_context(), "serve_prefix_affinity", True))
         reg = get_registry()
         self._c_submitted = reg.counter(
             tm.SERVE_REQUESTS_SUBMITTED,
@@ -153,6 +166,10 @@ class RequestRouter:
         self._h_tokens = reg.histogram(
             tm.SERVE_TOKENS_PER_REQUEST, buckets=COUNT_BUCKETS,
             help="tokens generated per completed request")
+        self._c_affinity = reg.counter(
+            tm.SERVE_PREFIX_AFFINITY_ROUTED,
+            help="requests leased to the node already homing their "
+                 "prefix pages")
 
     # -- the three verbs -----------------------------------------------------
 
@@ -183,6 +200,54 @@ class RequestRouter:
             )
             return rid
 
+    # prefix-key grain for session affinity: enough leading tokens to
+    # separate system prompts, few enough that a shared header still
+    # collides into ONE home. Routing is advisory — the worker's radix
+    # index does the exact-token comparison that decides a hit.
+    _PREFIX_KEY_TOKENS = 16
+
+    @classmethod
+    def _prefix_key(cls, prompt: List[int]) -> tuple:
+        return tuple(int(t) for t in prompt[:cls._PREFIX_KEY_TOKENS])
+
+    def _select_for_lease(self, node_id: int,
+                          want: int) -> List[ServeRequest]:
+        """Pop up to ``want`` queued requests for ``node_id``. Pass 1
+        (affinity on): FIFO over requests homed on this node or not
+        yet homed (claiming a home as it goes); pass 2 fills any spare
+        capacity FIFO regardless of home, so affinity skews placement
+        but can never starve the queue or idle a worker."""
+        want = max(0, int(want))
+        if not self._affinity:
+            out = []
+            while self._queue and len(out) < want:
+                out.append(self._queue.popleft())
+            return out
+        selected: List[ServeRequest] = []
+        rest: List[ServeRequest] = []
+        for req in self._queue:
+            if len(selected) < want:
+                key = self._prefix_key(req.prompt)
+                home = self._prefix_home.get(key)
+                if home is None or home == int(node_id):
+                    if home == int(node_id):
+                        self._n_affinity_routed += 1
+                        self._c_affinity.inc()
+                    self._prefix_home[key] = int(node_id)
+                    while len(self._prefix_home) > self._prefix_home_cap:
+                        self._prefix_home.pop(
+                            next(iter(self._prefix_home)))
+                    selected.append(req)
+                    continue
+            rest.append(req)
+        while rest and len(selected) < want:
+            # spare capacity: take foreign-homed work FIFO (the home
+            # map is NOT rewritten — a capacity steal must not flap
+            # the affinity of a busy prefix)
+            selected.append(rest.pop(0))
+        self._queue = deque(rest)
+        return selected
+
     def lease(self, node_id: int, max_requests: int) -> List[Dict]:
         self.scan_expired_once()
         out = []
@@ -190,8 +255,7 @@ class RequestRouter:
         with self._lock, span(SpanName.SERVE_LEASE, node=int(node_id)):
             now = time.time()
             self._node_touch[int(node_id)] = now
-            while self._queue and len(out) < max(0, int(max_requests)):
-                req = self._queue.popleft()
+            for req in self._select_for_lease(node_id, max_requests):
                 req.state = "leased"
                 self._live_counts["queued"] -= 1
                 self._live_counts["leased"] += 1
@@ -219,7 +283,8 @@ class RequestRouter:
     def complete(self, node_id: int, request_id: str,
                  tokens: List[int], ttft_s: Optional[float] = None,
                  e2e_s: Optional[float] = None,
-                 error_code: str = "") -> bool:
+                 error_code: str = "",
+                 prefix_hit_tokens: int = 0) -> bool:
         with self._lock, span(SpanName.SERVE_COMPLETE,
                               node=int(node_id)):
             self._node_touch[int(node_id)] = time.time()
@@ -246,6 +311,9 @@ class RequestRouter:
             self._n_completed += 1
             if error_code == "SERVE_REQUEST_EVICTED":
                 self._n_evicted += 1
+            if prefix_hit_tokens and int(prefix_hit_tokens) > 0:
+                self._n_prefix_hits += 1
+                self._n_prefix_hit_tokens += int(prefix_hit_tokens)
             self._done_order.append(req.request_id)
             while len(self._done_order) > self._done_retention_cap:
                 if self._requests.pop(self._done_order.popleft(),
@@ -428,4 +496,21 @@ class RequestRouter:
                 },
                 "nodes": {str(n): v
                           for n, v in sorted(per_node.items())},
+                "prefix": self._prefix_summary_locked(),
             }
+
+    def _prefix_summary_locked(self) -> Dict[str, Any]:
+        done = max(0, self._n_completed - self._n_evicted)
+        return {
+            "hits": self._n_prefix_hits,
+            "saved_prefill_tokens": self._n_prefix_hit_tokens,
+            "hit_rate": (round(self._n_prefix_hits / done, 4)
+                         if done else 0.0),
+            "affinity_routed": self._n_affinity_routed,
+        }
+
+    def prefix_summary(self) -> Dict[str, Any]:
+        """The prefix-hit ledger alone (the ``serve slo`` view rides
+        it next to the SLO verdicts)."""
+        with self._lock:
+            return self._prefix_summary_locked()

@@ -273,9 +273,29 @@ def derive_slot_ledger(events: List[Dict]) -> Dict:
         except (TypeError, ValueError):
             pass
     covered = sum(seconds.values())
+    # the prefix-cache columns ride the same SERVE_END records (the
+    # engine stamps its pool stats beside the slot ledger): sum the
+    # superseding record of each executor, absent on pre-prefix
+    # timelines and when the pool is off
+    prefix = {"hits": 0, "misses": 0, "evictions": 0,
+              "saved_prefill_tokens": 0}
+    prefix_runs = 0
+    for rec in latest.values():
+        stats = rec.get("prefix")
+        if not isinstance(stats, dict):
+            continue
+        prefix_runs += 1
+        for src, dst in (("hits", "hits"), ("misses", "misses"),
+                         ("evictions", "evictions"),
+                         ("saved_tokens", "saved_prefill_tokens")):
+            try:
+                prefix[dst] += int(stats.get(src, 0) or 0)
+            except (TypeError, ValueError):
+                continue
     return {
         "metric": "serve_slot_seconds",
         "runs": len(latest),
+        "prefix": prefix if prefix_runs else None,
         "slot_seconds": round(slot_seconds, 3),
         "buckets": {
             k: {

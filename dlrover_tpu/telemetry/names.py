@@ -287,6 +287,19 @@ SERVE_PREFILL_TIME = "dlrover_serve_prefill_seconds"
 # it takes explicit count-scale buckets (metrics.COUNT_BUCKETS); the
 # registry refuses duration buckets on a non-``_seconds`` histogram
 SERVE_TOKENS_PER_REQUEST = "dlrover_serve_tokens_per_request"
+# worker-side shared prefix pool (radix-indexed KV reuse, copy-on-
+# admit): hit/miss on admission, pages LRU-evicted from the pool,
+# prefill tokens NOT recomputed because their pages were copied from
+# the pool, and the pool occupancy gauges the HBM gate prices
+SERVE_PREFIX_HITS = "dlrover_serve_prefix_hits_total"
+SERVE_PREFIX_MISSES = "dlrover_serve_prefix_misses_total"
+SERVE_PREFIX_EVICTIONS = "dlrover_serve_prefix_evictions_total"
+SERVE_PREFIX_SAVED_TOKENS = "dlrover_serve_prefix_saved_prefill_tokens_total"
+SERVE_PREFIX_POOL_USED_PAGES = "dlrover_serve_prefix_pool_used_pages"
+SERVE_PREFIX_POOL_BYTES = "dlrover_serve_prefix_pool_bytes"
+# master-side router: requests leased to the worker whose pool already
+# holds their prefix pages (soft session affinity)
+SERVE_PREFIX_AFFINITY_ROUTED = "dlrover_serve_prefix_affinity_routed_total"
 
 # -- serving SLO plane (dlrover_tpu/serving/slo.py + master/monitor/
 # serve_slo.py) ---------------------------------------------------------------
@@ -427,6 +440,13 @@ class EventKind:
     SERVE_PREFILL_CHUNK = "serve_prefill_chunk"
     SERVE_FIRST_TOKEN = "serve_first_token"
     SERVE_REQUEST_DONE = "serve_request_done"
+    # shared prefix pool: a request admitted with matched pages copied
+    # from the pool (carries hit_tokens — the prefill it skipped), and
+    # a page LRU-evicted to make room for a publish. Both are INFO
+    # edges of normal operation (a full pool degrades to miss-and-
+    # prefill, never an error), so neither is DLR008 error-coded.
+    SERVE_PREFIX_HIT = "serve_prefix_hit"
+    SERVE_PREFIX_EVICTED = "serve_prefix_evicted"
     # serving SLO plane: a declared SLO target violated for the
     # confirmation windows (failure-class — carries an error code and
     # the burn-rate evidence; DLR008), its recovery, and the scale

@@ -492,7 +492,8 @@ class OptimizerPlanHook(TrainHook):
             return
         if (
             (getattr(cfg, "serve_slots", 0)
-             or getattr(cfg, "serve_prefill_chunk", 0))
+             or getattr(cfg, "serve_prefill_chunk", 0)
+             or getattr(cfg, "serve_prefix_pool_pages", -1) >= 0)
             and not cfg.steps_per_call and not cfg.mesh_shape
             and cfg.train_window < 0
             and not getattr(cfg, "dispatch_chunks", 0)

@@ -315,6 +315,10 @@ class TestGPT2Pipelined:
         np.testing.assert_allclose(np.asarray(piped), np.asarray(plain),
                                    rtol=2e-4, atol=2e-4)
 
+    # budget triage (PR 16): pp-rule composition stays pinned tier-1 by
+    # the llama/neox/glm pipelined tests and gpt2's apply-level parity;
+    # this trains run rides slow
+    @pytest.mark.slow
     def test_trains_with_gpt2_pp_rules_on_mesh(self):
         import optax
 

@@ -35,6 +35,10 @@ class TestBert:
         assert not np.allclose(np.asarray(full[:, 0]),
                                np.asarray(masked[:, 0]))
 
+    # budget triage (PR 16): bert forward/masking are pinned by the
+    # cheaper parity units; convergence representatives (llama/gpt2)
+    # stay tier-1 — this overfit run rides slow
+    @pytest.mark.slow
     def test_mlm_overfits_tiny_batch(self):
         cfg = bert.bert_tiny()
         rng = np.random.RandomState(0)
@@ -197,6 +201,10 @@ class TestBertPipelined:
         np.testing.assert_allclose(np.asarray(seq_p), np.asarray(seq),
                                    rtol=2e-4, atol=2e-4)
 
+    # budget triage (PR 16): the pipeline engine is model-agnostic and
+    # stays pinned tier-1 by the llama/neox/glm pp tests; bert's mask
+    # plumbing by its apply-level parity — this trains run rides slow
+    @pytest.mark.slow
     def test_trains_with_bert_pp_rules_on_mesh(self):
         from dlrover_tpu.models.losses import masked_lm_loss
 

@@ -106,6 +106,10 @@ class TestGPTNeoX:
                                    np.asarray(out_dense),
                                    atol=3e-5, rtol=3e-5)
 
+    # budget triage (PR 16): neox parity stays pinned tier-1 by
+    # test_packed_segments_equal_separate_documents; the overfit
+    # convergence run rides slow
+    @pytest.mark.slow
     def test_overfits_tiny_batch_sharded(self):
         cfg = gpt_neox.neox_tiny()
         rng = np.random.RandomState(0)
@@ -218,6 +222,10 @@ class TestGLM:
         np.testing.assert_allclose(np.asarray(out_a[0, :6]),
                                    np.asarray(out_b[0, :6]), rtol=1e-5)
 
+    # budget triage (PR 16): GLM's prefix behavior stays pinned tier-1
+    # by the ring-vs-dense and packed-segments parities; the overfit
+    # convergence run rides slow
+    @pytest.mark.slow
     def test_overfits_prefix_batch_sharded(self):
         cfg = glm.glm_tiny()
         rng = np.random.RandomState(0)

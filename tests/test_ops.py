@@ -378,6 +378,10 @@ class TestRingAttentionPacked:
             jax.device_get(out), jax.device_get(ref), atol=2e-5, rtol=2e-5
         )
 
+    # budget triage (PR 16): packed-ring bwd stays pinned tier-1 by
+    # test_pallas_kernel_inside_packed_ring and the model-level
+    # packed-segments parities; the standalone grad check rides slow
+    @pytest.mark.slow
     def test_differentiable(self):
         mesh = MeshPlan(data=2, seq=4).build()
         q, k, v, seg = self._case(b=2, s=64)
@@ -472,6 +476,10 @@ class TestRingAttention:
             jax.device_get(out), jax.device_get(ref), atol=2e-5, rtol=2e-5
         )
 
+    # budget triage (PR 16): ring grads stay pinned tier-1 by
+    # test_gqa_ring_gradients_match_reference and
+    # test_ring_bwd_tiles_reach_the_kernel; this one rides slow
+    @pytest.mark.slow
     def test_differentiable(self):
         mesh = MeshPlan(seq=4).build()
         q, k, v = _qkv(b=1, h=1, s=64, d=32)
@@ -613,6 +621,10 @@ class TestRingAttention:
             jax.device_get(out), jax.device_get(ref), atol=2e-5, rtol=2e-5
         )
 
+    # budget triage (PR 16): the model-level GLM gate
+    # test_prefix_lm_seq_parallel_ring_matches_dense stays tier-1;
+    # the op-level decomposition check rides slow
+    @pytest.mark.slow
     def test_prefix_lm_ring_matches_dense_reference(self):
         """GLM's prefix-LM mask decomposed over the ring: past shards
         fully visible, diagonal runs the locally-shifted prefix
@@ -1150,6 +1162,12 @@ class TestMoEGroupedEP:
         assert float(m["dropped_frac"]) == 0.0
         assert m["expert_load"].shape == (self.E,)
 
+    # budget triage (PR 16): the grouped_ep bwd stays pinned tier-1 by
+    # test_fp8_matches_qdq_oracle_bitwise_fwd_bwd (bitwise fwd+bwd),
+    # the fwd einsum oracle [top_k=2], skewed dropless routing and
+    # test_llama_grouped_ep_trains; the heaviest bf16 grads-vs-einsum
+    # oracle rides the slow tier with its top_k=1 sibling
+    @pytest.mark.slow
     def test_grads_match_oracle(self):
         """The custom VJP composes with the all_to_alls: d(params) and
         d(x) equal the einsum oracle's (top_k=2, the stricter case —

@@ -65,6 +65,10 @@ def _telemetry_on():
 
 
 class TestRingAllToAll:
+    # budget triage (PR 16): the primitive is exercised tier-1 through
+    # the grouped_ep dropless/skew tests and the chunked-dispatch
+    # oracle; the standalone lax parity check rides slow
+    @pytest.mark.slow
     def test_matches_lax_all_to_all_and_differentiates(self):
         """The ppermute-ring decomposition IS an all_to_all: same
         blocks, and its transpose runs the mirrored ring (grads flow).

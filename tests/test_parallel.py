@@ -191,6 +191,10 @@ class TestShardedFlashAttention:
             losses[flash] = float(jax.device_get(metrics["loss"]))
         assert abs(losses[True] - losses[False]) < 2e-3, losses
 
+    # budget triage (PR 16): segment masking is pinned at the ops level
+    # and mesh composition by the unsegmented sharded test; the
+    # segmented-under-mesh cross product rides slow
+    @pytest.mark.slow
     def test_segmented_flash_under_mesh_matches_reference_path(self):
         """Packed sequences on the production multi-chip path: llama with
         segment_ids + use_flash under a 2x2x2 mesh must route the

@@ -356,6 +356,10 @@ class TestUnevenStages:
             g_plain, g_uneven,
         )
 
+    # budget triage (PR 16): the uneven-stage oracle
+    # (test_uneven_gradients_match) and the elastic shrink wedge stay
+    # tier-1; the sharded-mesh cross product rides slow
+    @pytest.mark.slow
     def test_uneven_on_sharded_mesh(self):
         """Uneven depths through the full accelerate() path on the pipe
         mesh, driven from the Strategy (knob survives JSON round-trip)."""

@@ -277,16 +277,29 @@ class TestRequestsCliAgreement:
         time.sleep(0.05)
         sv.request_router.scan_expired_once()  # both leases expire
         sv.get(comm.ServeLeaseRequest(node_id=1, max_requests=3))
-        for rid in rids:
+        from dlrover_tpu.telemetry.events import emit_event
+
+        for i, rid in enumerate(rids):
+            # the re-leased worker's pool hits on the later two (the
+            # first cold-published); its admit path emits the HIT edge
+            # the forensic prefix columns count
+            hit = 8 if i > 0 else 0
+            if hit:
+                emit_event(EventKind.SERVE_PREFIX_HIT,
+                           request_id=rid, hit_tokens=hit,
+                           prompt_tokens=12)
             sv.report(comm.ServeResult(
                 node_id=1, request_id=rid, tokens=[1, 2],
-                ttft_s=0.01, e2e_s=0.02))
+                ttft_s=0.01, e2e_s=0.02, prefix_hit_tokens=hit))
         # the stale twin double-completes one — must not count twice
+        # (nor double its prefix-hit tokens)
         sv.report(comm.ServeResult(node_id=0, request_id=rids[0],
-                                   tokens=[1, 2]))
-        live = json.loads(sv.get(
-            comm.ServeReportRequest()).report_json)["requests"]
-        forensic = _forensic_report(events_path)["requests"]
+                                   tokens=[1, 2], prefix_hit_tokens=8))
+        full_live = json.loads(sv.get(
+            comm.ServeReportRequest()).report_json)
+        live = full_live["requests"]
+        full_forensic = _forensic_report(events_path)
+        forensic = full_forensic["requests"]
         for key in ("submitted", "completed", "evicted",
                     "leases_expired"):
             assert forensic[key] == live[key], (key, live, forensic)
@@ -294,6 +307,14 @@ class TestRequestsCliAgreement:
         assert forensic["completed"] == 3
         assert forensic["evicted"] == 0
         assert forensic["leases_expired"] == 2
+        # prefix-column agreement: router-ledger hits (accepted
+        # completions carrying hit tokens) == worker HIT edges
+        assert full_live["prefix"]["hits"] == 2
+        assert full_live["prefix"]["saved_prefill_tokens"] == 16
+        assert full_forensic["prefix"]["hits"] \
+            == full_live["prefix"]["hits"]
+        assert full_forensic["prefix"]["saved_prefill_tokens"] \
+            == full_live["prefix"]["saved_prefill_tokens"]
 
 
 # -- the SLO verdict engine ---------------------------------------------------

@@ -584,23 +584,29 @@ class TestServeResizeWedge:
         every request completes, continuations bitwise-identical to a
         resize-free serve of the same workload, zero recompiles on the
         prewarmed survivor topology, and the mttr/goodput derivations
-        see the serving_resize scenario."""
+        see the serving_resize scenario. The workers run the PREFIX
+        POOL (shared 16-token head across the workload) against a
+        pool-FREE baseline — the bitwise gate then also pins reuse ==
+        full prefill across the live resize, and the prefix columns
+        must agree live-vs-forensic."""
         events_path = str(tmp_path / "events.jsonl")
         monkeypatch.setenv("DLROVER_TPU_EVENTS_FILE", events_path)
-        prompts = {f"r{i}": _prompt(6, seed=20 + i) for i in range(10)}
+        shared = _prompt(16, seed=7)
+        prompts = {f"r{i}": shared + _prompt(4, seed=20 + i)
+                   for i in range(10)}
 
-        def build_worker():
+        def build_worker(pool_pages=8):
             eng = ServeEngine(
                 TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
                                         rule_set="llama"),
                 serve_slots=4, prefill_chunk=4, max_seq=32,
-                page_size=8,
+                page_size=8, prefix_pool_pages=pool_pages,
             )
             eng.prepare(llama.init(jax.random.PRNGKey(0), TINY))
             return eng
 
-        # resize-free baseline (local queue): the ground-truth tokens
-        base_eng = build_worker()
+        # resize-free pool-FREE baseline (local queue): ground truth
+        base_eng = build_worker(pool_pages=0)
         base = ServeExecutor(base_eng, serve_window=1)
         for rid, p in prompts.items():
             base.submit(p, max_new_tokens=6, request_id=rid)
@@ -679,6 +685,14 @@ class TestServeResizeWedge:
             live = json.loads(buf.getvalue())
             assert live["requests"]["completed"] == 10
 
+            # the prefix columns: the shared head hits once each
+            # worker's first completion publishes it, and the hit
+            # totals survive the live resize
+            live_prefix = live.get("prefix") or {}
+            assert live_prefix.get("hits", 0) >= 1, live
+            assert live_prefix["saved_prefill_tokens"] \
+                == 16 * live_prefix["hits"]
+
             records = read_events(events_path)
             begun = [r for r in records
                      if r["kind"] == EventKind.SERVE_RESIZE_BEGIN]
@@ -700,6 +714,11 @@ class TestServeResizeWedge:
             forensic = json.loads(buf.getvalue())
             assert forensic["resizes"][-1]["world_to"] == 4
             assert forensic["leases_expired"] == 0
+            # live-vs-forensic agreement extends to the prefix
+            # columns: router-ledger hits == worker HIT edges
+            assert forensic["prefix"]["hits"] == live_prefix["hits"]
+            assert forensic["prefix"]["saved_prefill_tokens"] \
+                == live_prefix["saved_prefill_tokens"]
 
             # mttr derives the serving_resize scenario from the same
             # timeline; goodput books it as reshard-class downtime
@@ -944,3 +963,431 @@ class TestServeReplanE2E:
             assert serve_dec and serve_dec[0]["applied"]
         finally:
             master.stop()
+
+# -- the shared prefix pool (ISSUE 16) ----------------------------------------
+
+
+from dlrover_tpu.serving.prefix_index import PrefixIndex  # noqa: E402
+
+
+class TestPrefixIndex:
+    """Host-side radix-index semantics: exact-token matching, LRU
+    eviction that never touches a pinned chain, full-pool degradation
+    to miss-and-prefill, idempotent release across flush."""
+
+    def test_match_is_exact_tokens_and_page_grain(self):
+        ix = PrefixIndex(page_size=4, num_pages=8)
+        ix.publish(list(range(12)))  # 3 pages
+        assert ix.used_pages == 3
+        # full-page exact match only: 11 tokens -> 2 whole pages
+        h = ix.match(list(range(11)))
+        assert h.tokens == 8 and len(h.pages) == 2
+        ix.release(h)
+        # one differing token inside the first page -> no hash
+        # shortcut, the walk misses at the literal comparison
+        assert ix.match([0, 1, 2, 99, 4, 5, 6, 7]) is None
+        assert ix.misses == 1
+
+    def test_match_caps_and_aligns_before_pinning(self):
+        ix = PrefixIndex(page_size=4, num_pages=8)
+        ix.publish(list(range(16)))  # 4 pages
+        h = ix.match(list(range(16)), max_pages=3, align_pages=2)
+        # capped to 3 then aligned DOWN to 2 pages; only those pinned
+        assert len(h.pages) == 2
+        assert all(n.refcount == 1 for n in h.nodes)
+        unpinned = ix.match(list(range(16)))  # pins all 4
+        assert [n.refcount for n in unpinned.nodes] == [2, 2, 1, 1]
+        ix.release(h)
+        ix.release(unpinned)
+
+    def test_pinned_chains_never_evicted_lru_picks_oldest(self):
+        ix = PrefixIndex(page_size=2, num_pages=2)
+        ix.publish([1, 1])
+        ix.publish([2, 2])
+        pin = ix.match([1, 1])  # pins page for [1,1]
+        # pool full; publishing a third chunk must evict [2,2] (the
+        # only refcount-0 leaf), never the pinned [1,1]
+        out = ix.publish([3, 3])
+        assert len(out) == 1
+        assert ix.evictions == 1
+        assert ix.match([2, 2]) is None  # evicted -> exact miss
+        got = ix.match([1, 1])
+        assert got is not None  # pinned chain survived
+        ix.release(pin)
+        ix.release(got)
+
+    def test_evicted_page_reuse_cannot_stale_match(self):
+        """The page id freed by eviction is re-published under NEW
+        tokens; a request for the OLD tokens misses (trie removal
+        precedes reuse) and re-verifies by publishing afresh."""
+        ix = PrefixIndex(page_size=2, num_pages=1)
+        ix.publish([7, 7])
+        assert ix.publish([8, 8])  # evicts [7,7], reuses its page
+        assert ix.match([7, 7]) is None  # never a stale hit
+        again = ix.publish([7, 7])  # the next miss re-publishes
+        assert len(again) == 1
+        assert ix.match([8, 8]) is None  # and [8,8] was the victim
+
+    def test_full_pool_of_pinned_pages_degrades_never_raises(self):
+        ix = PrefixIndex(page_size=2, num_pages=2)
+        ix.publish([1, 1, 2, 2])
+        pin = ix.match([1, 1, 2, 2])
+        # every page pinned: publish skips, counted, no exception
+        assert ix.publish([3, 3, 4, 4]) == []
+        assert ix.publish_skipped == 1
+        ix.release(pin)
+
+    def test_interior_node_with_children_is_not_a_victim(self):
+        ix = PrefixIndex(page_size=2, num_pages=2)
+        ix.publish([1, 1, 2, 2])  # chain: [1,1] -> [2,2]
+        # only the CHILDLESS tail is evictable — evicting the parent
+        # would orphan the child and break "whole chain present"
+        out = ix.publish([3, 3])
+        assert len(out) == 1
+        assert ix.match([1, 1]) is not None  # parent survived
+
+    def test_release_is_idempotent_and_survives_flush(self):
+        ix = PrefixIndex(page_size=2, num_pages=4)
+        ix.publish([1, 1])
+        h = ix.match([1, 1])
+        ix.flush()
+        assert ix.used_pages == 0
+        ix.publish([9, 9])
+        fresh = ix.match([9, 9])
+        ix.release(h)  # orphaned nodes absorb it
+        ix.release(h)  # idempotent
+        assert fresh.nodes[0].refcount == 1  # fresh pin untouched
+        ix.release(fresh)
+        # stats survive the flush (they describe the process)
+        assert ix.hits == 2 and ix.published == 2
+
+
+@pytest.fixture(scope="module")
+def prefix_engine(tiny_params):
+    eng = ServeEngine(
+        TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                rule_set="llama"),
+        serve_slots=4, prefill_chunk=8, max_seq=48, page_size=8,
+        prefix_pool_pages=12,
+    )
+    eng.prepare(tiny_params)
+    return eng
+
+
+def _serve_locally(eng, jobs, tag):
+    """Serve ``jobs`` ([(rid, prompt, max_new)]) on a fresh slot pool
+    (the prefix pool is NOT reset — legs seed it deliberately)."""
+    eng.cache = eng.fresh_cache()
+    ex = ServeExecutor(eng, serve_window=1)
+    for rid, prompt, max_new in jobs:
+        ex.submit(prompt, max_new_tokens=max_new,
+                  request_id=f"{tag}-{rid}")
+    return {r["request_id"].split("-", 1)[1]: r for r in ex.serve()}
+
+
+class TestPrefixReuseBitwise:
+    """THE tentpole oracle: a prefix-reused continuation is BITWISE
+    equal to the full prefill on the f32 pool, at every hit-length
+    class — 0, partial-chunk, chunk-exact, and full-prompt (capped
+    strictly below the prompt so the final chunk still seeds the
+    first token)."""
+
+    def test_bitwise_at_every_hit_length(self, engine, prefix_engine,
+                                         tiny_params):
+        seed_prompt = _prompt(40, seed=77)
+        # hit-length cases against a pool seeded with seed_prompt:
+        #  q0:  shares <1 page            -> hit 0
+        #  q16: shares 20 tokens          -> partial page rounds DOWN to 16
+        #  q24: shares 24 (3 exact pages) -> hit 24
+        #  qfp: the seed prompt itself    -> hit 32 (cap < len(prompt))
+        cases = {
+            "q0": (seed_prompt[:4] + _prompt(8, seed=78), 0),
+            "q16": (seed_prompt[:20] + _prompt(4, seed=79), 16),
+            "q24": (seed_prompt[:24] + _prompt(8, seed=80), 24),
+            "qfp": (list(seed_prompt), 32),
+        }
+        # seed the pool (published at the final prefill chunk)
+        _serve_locally(prefix_engine, [("seed", seed_prompt, 2)], "s")
+        assert prefix_engine.prefix_index.used_pages == 5
+
+        jobs = [(rid, p, 4) for rid, (p, _) in cases.items()]
+        on = _serve_locally(prefix_engine, jobs, "on")
+        off = _serve_locally(engine, jobs, "off")
+        for rid, (_, want_hit) in cases.items():
+            assert on[rid]["prefix_hit_tokens"] == want_hit, rid
+            assert on[rid]["tokens"] == off[rid]["tokens"], rid
+        assert all(off[r]["prefix_hit_tokens"] == 0 for r in off)
+
+    def test_int8_pool_reuse_token_identical_admission(self,
+                                                      tiny_params):
+        """int8 pools: the pool stores the QUANTIZED page bytes +
+        scales the publishing slot computed, and admission copies them
+        back verbatim — so the reused continuation sees bit-identical
+        cache state to a same-engine full prefill. (Cross-engine
+        logits may differ at quantization boundaries; the documented
+        int8 caveat in docs/serving.md. Here both legs run one
+        engine.)"""
+        eng = ServeEngine(
+            TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                    rule_set="llama"),
+            serve_slots=2, prefill_chunk=8, max_seq=48, page_size=8,
+            kv_precision="int8", prefix_pool_pages=8,
+        )
+        eng.prepare(tiny_params)
+        seed_prompt = _prompt(32, seed=81)
+        # leg 1: pool empty -> full prefill (and it publishes)
+        first = _serve_locally(eng, [("a", seed_prompt, 4)], "l1")
+        assert first["a"]["prefix_hit_tokens"] == 0
+        # leg 2: same prompt -> 24-token hit, quantized pages copied
+        second = _serve_locally(eng, [("a", seed_prompt, 4)], "l2")
+        assert second["a"]["prefix_hit_tokens"] == 24
+        assert second["a"]["tokens"] == first["a"]["tokens"]
+
+
+class TestPrefixPoolLifecycle:
+    """Retune/resize discipline: slot-only retunes carry the pool,
+    chunk changes flush the index (page bytes depend on the chunk
+    windows), pool-width changes rebuild, and eviction pressure under
+    a tiny pool stays a logged degradation."""
+
+    def test_retune_carry_flush_rebuild(self, tiny_params):
+        eng = ServeEngine(
+            TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                    rule_set="llama"),
+            serve_slots=4, prefill_chunk=8, max_seq=48, page_size=8,
+            prefix_pool_pages=8,
+        )
+        eng.prepare(tiny_params)
+        p = _prompt(24, seed=90)
+        _serve_locally(eng, [("seed", p, 2)], "s")
+        assert eng.prefix_index.used_pages == 3
+
+        # slot-only retune: pool and index carry (no slot dimension)
+        eng.retune(serve_slots=6, slot_map={})
+        got, h = eng.prefix_match(p + _prompt(8, seed=91))
+        assert got == 24 and h is not None
+        eng.prefix_release(h)
+
+        # chunk change: index flushed (stats survive), pool pages
+        # unreachable; a released pre-flush handle dangles nothing
+        hits_before = eng.prefix_index.hits
+        eng.retune(prefill_chunk=4)
+        assert eng.prefix_index.used_pages == 0
+        assert eng.prefix_index.hits == hits_before
+        eng.prefix_release(h)  # idempotent, post-flush
+
+        # pool-width change: rebuilt empty at the new capacity
+        eng.retune(prefix_pool_pages=4)
+        assert eng.prefix_index.capacity == 4
+        assert eng.prefix_index.used_pages == 0
+        # pool off: the engine reports disabled and matches miss
+        eng.retune(prefix_pool_pages=0)
+        assert not eng.prefix_enabled()
+        assert eng.prefix_match(p) == (0, None)
+
+    def test_eviction_pressure_end_to_end(self, tiny_params):
+        """A pool smaller than the working set: victims are LRU,
+        every re-use after eviction is a clean miss-and-prefill, and
+        completions stay bitwise against a pool-free engine."""
+        eng = ServeEngine(
+            TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                    rule_set="llama"),
+            serve_slots=2, prefill_chunk=8, max_seq=48, page_size=8,
+            prefix_pool_pages=3,
+        )
+        eng.prepare(tiny_params)
+        off = ServeEngine(
+            TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                    rule_set="llama"),
+            serve_slots=2, prefill_chunk=8, max_seq=48, page_size=8,
+        )
+        off.prepare(tiny_params)
+        # three distinct 24-token prompts = 9 pages over a 3-page pool
+        prompts = {f"p{i}": _prompt(24, seed=95 + i) for i in range(3)}
+        jobs = [(rid, p, 3) for rid, p in prompts.items()]
+        a = _serve_locally(eng, jobs, "w1")
+        b = _serve_locally(eng, jobs, "w2")
+        want = _serve_locally(off, jobs, "off")
+        for rid in prompts:
+            assert a[rid]["tokens"] == want[rid]["tokens"], rid
+            assert b[rid]["tokens"] == want[rid]["tokens"], rid
+        st = eng.prefix_stats()
+        assert st["evictions"] > 0
+        assert st["used_pages"] <= 3
+
+
+class TestPrefixRouterAffinity:
+    def test_soft_affinity_homes_without_starvation(self):
+        r = RequestRouter(lease_timeout_secs=120.0)
+        shared = list(range(100, 116))  # >= the 16-token prefix key
+        a_ids = [r.submit(shared + [i], 4, request_id=f"a{i}")
+                 for i in range(4)]
+        b_ids = [r.submit(list(range(200, 216)) + [i], 4,
+                          request_id=f"b{i}") for i in range(2)]
+        # node 0 leases first: claims the shared-prefix home
+        first = [q["request_id"] for q in r.lease(0, 2)]
+        assert first == a_ids[:2]
+        # node 1: pass 1 skips node-0-homed requests, claims the B
+        # prefix; pass 2 fills spare capacity FIFO (no starvation)
+        second = [q["request_id"] for q in r.lease(1, 3)]
+        assert second[:2] == b_ids
+        assert second[2] == a_ids[2]  # capacity steal, FIFO
+        # node 0 returns: the remaining A request is homed here
+        third = [q["request_id"] for q in r.lease(0, 4)]
+        assert third == [a_ids[3]]
+        summary = r.prefix_summary()
+        assert summary["affinity_routed"] >= 1
+        # hit accounting rides complete(); conservation holds
+        for n, rid in [(0, a_ids[0]), (0, a_ids[1]), (1, b_ids[0]),
+                       (1, b_ids[1]), (1, a_ids[2]), (0, a_ids[3])]:
+            r.complete(n, rid, [1, 2], ttft_s=0.1, e2e_s=0.2,
+                       prefix_hit_tokens=16 if rid[0] == "a" else 0)
+        summary = r.prefix_summary()
+        assert summary["hits"] == 4
+        assert summary["saved_prefill_tokens"] == 64
+        assert summary["hit_rate"] == pytest.approx(4 / 6, abs=1e-3)
+        rep = r.report()["requests"]
+        assert rep["completed"] == 6 and rep["leased"] == 0
+
+    def test_affinity_disabled_keeps_pure_fifo(self, monkeypatch):
+        monkeypatch.setattr(get_context(), "serve_prefix_affinity",
+                            False)
+        r = RequestRouter()
+        shared = list(range(16))
+        rids = [r.submit(shared + [i], 2) for i in range(3)]
+        assert [q["request_id"] for q in r.lease(1, 1)] == rids[:1]
+        assert [q["request_id"] for q in r.lease(0, 2)] == rids[1:]
+
+
+class TestPrefixPlannerPricing:
+    SPEC = planner.ModelSpec(
+        param_count=7e9, num_layers=8, hidden_size=64, seq_len=128,
+        global_batch=1, num_heads=4, kv_heads=2)
+
+    def test_hit_rate_discount_raises_tokens_per_s(self):
+        off = planner.estimate_decode(self.SPEC, 8, 16, 8, 64)
+        on = planner.estimate_decode(
+            self.SPEC, 8, 16, 8, 64, prefix_pool_pages=16,
+            page_size=8, prefix_hit_rate=0.8)
+        assert on["tokens_per_s"] > off["tokens_per_s"]
+        assert on["breakdown"]["prefix_hit_rate"] == 0.8
+        # zero observed/expected hits -> the pool is pure cost, the
+        # throughput term must NOT move (the optimizer's churn
+        # tie-break then keeps the knob off)
+        cold = planner.estimate_decode(
+            self.SPEC, 8, 16, 8, 64, prefix_pool_pages=16,
+            page_size=8, prefix_hit_rate=0.0)
+        assert cold["tokens_per_s"] == off["tokens_per_s"]
+
+    def test_discount_capped_by_pool_token_coverage(self):
+        small = planner.estimate_decode(
+            self.SPEC, 8, 16, 8, 64, prefix_pool_pages=1,
+            page_size=8, prefix_hit_rate=1.0)
+        big = planner.estimate_decode(
+            self.SPEC, 8, 16, 8, 64, prefix_pool_pages=16,
+            page_size=8, prefix_hit_rate=1.0)
+        assert big["tokens_per_s"] > small["tokens_per_s"]
+
+    def test_pool_bytes_charged_undivided_per_device(self):
+        est = planner.estimate_decode(
+            self.SPEC, 8, 16, 8, 64, prefix_pool_pages=16,
+            page_size=8, prefix_hit_rate=0.5)
+        pool = planner.serve_prefix_pool_bytes(self.SPEC, 16, 8)
+        assert pool > 0
+        assert est["breakdown"]["prefix_pool_bytes"] == pool
+        assert est["cache_bytes_per_device"] == pytest.approx(
+            est["cache_bytes"] / 8 + pool)
+        # the same byte formula as the device-side spec
+        spec = KVCacheSpec(num_layers=8, num_kv_heads=2, head_dim=16,
+                           num_slots=16, page_size=8,
+                           prefix_pool_pages=16)
+        assert pool == spec.prefix_pool_bytes()
+
+
+class TestPrefixKnobFamily:
+    def test_optimizer_chooses_pool_with_prior_and_geometry(
+            self, monkeypatch):
+        monkeypatch.setattr(get_context(),
+                            "serve_prefix_expected_hit_rate", 0.8)
+        published = []
+        opt = _optimizer(publish=published.append)
+        opt.update_model_info(comm.ModelInfo(
+            num_params=7_000_000_000, hidden_size=64, num_layers=2,
+            seq_len=128))
+        opt.update_serving_config(_serve_report(
+            num_layers=2, kv_heads=2, head_dim=16,
+            prefix_pool_pages=0, page_size=8))
+        assert published
+        cfg = published[-1]
+        assert cfg.serve_prefix_pool_pages > 0
+        last = [d for d in opt.decisions()
+                if d["trigger"].startswith("serve:")][-1]
+        assert last["chosen"]["prefix_pool_pages"] \
+            == cfg.serve_prefix_pool_pages
+        assert "|ppp=" in last["chosen"]["key"]
+
+    def test_without_evidence_pool_stays_off(self, monkeypatch):
+        monkeypatch.setattr(get_context(),
+                            "serve_prefix_expected_hit_rate", 0.0)
+        published = []
+        opt = _optimizer(publish=published.append)
+        opt.update_model_info(comm.ModelInfo(
+            num_params=7_000_000_000, hidden_size=64, num_layers=2,
+            seq_len=128))
+        opt.update_serving_config(_serve_report(
+            num_layers=2, kv_heads=2, head_dim=16,
+            prefix_pool_pages=0, page_size=8))
+        # whatever else the plan tunes, the pool knob is the
+        # leave-unchanged sentinel: no evidence, no pool
+        assert all(p.serve_prefix_pool_pages == -1 for p in published)
+
+    def test_observed_hit_rate_overrides_the_prior(self, monkeypatch):
+        """A worker reporting hit_rate=0 beats an optimistic prior:
+        with zero observed benefit every pool width ties and the churn
+        tie-break refuses to GROW the pool — the plan leaves the knob
+        at its unchanged sentinel."""
+        monkeypatch.setattr(get_context(),
+                            "serve_prefix_expected_hit_rate", 0.9)
+        published = []
+        opt = _optimizer(publish=published.append)
+        opt.update_model_info(comm.ModelInfo(
+            num_params=7_000_000_000, hidden_size=64, num_layers=2,
+            seq_len=128))
+        opt.update_serving_config(_serve_report(
+            num_layers=2, kv_heads=2, head_dim=16,
+            prefix_pool_pages=24, page_size=8, prefix_hit_rate=0.0))
+        assert all(p.serve_prefix_pool_pages == -1 for p in published)
+
+    def test_hbm_gate_charges_pool_undivided(self, monkeypatch):
+        """A budget that fits every slot pool (divided by world) but
+        not the UNDIVIDED prefix pool: pool candidates are memory-
+        rejected with their page count on the decision trail."""
+        spec = planner.ModelSpec(
+            param_count=10_000, num_layers=2, hidden_size=64,
+            seq_len=128, global_batch=1, num_heads=4, kv_heads=2)
+        slot_worst = planner.serve_cache_bytes(spec, 16, 64) / 8
+        budget = slot_worst * 1.5
+        monkeypatch.setattr(get_context(), "device_hbm_budget_bytes",
+                            budget)
+        monkeypatch.setattr(get_context(),
+                            "serve_prefix_expected_hit_rate", 0.8)
+        opt = _optimizer()
+        opt.update_model_info(comm.ModelInfo(
+            num_params=10_000, hidden_size=64, num_layers=2,
+            seq_len=128))
+        opt.update_serving_config(_serve_report(
+            num_layers=2, kv_heads=2, head_dim=16,
+            prefix_pool_pages=0, page_size=8))
+        last = [d for d in opt.decisions()
+                if d["trigger"].startswith("serve:")][-1]
+        rejected = last["memory_rejected"]
+        assert any(r.get("prefix_pool_pages", 0) > 0
+                   for r in rejected)
+        # and anything chosen fits WITH its pool charge
+        chosen = last.get("chosen")
+        if chosen:
+            pool = planner.serve_prefix_pool_bytes(
+                spec, chosen["prefix_pool_pages"], 8)
+            slot = planner.serve_cache_bytes(
+                spec, chosen["serve_slots"], 64) / 8
+            assert slot + pool <= budget
