@@ -149,16 +149,23 @@ class IndexShardingClient(ShardingClient):
         self._indices: Deque[int] = deque()
 
     def fetch_record_index(self) -> Optional[int]:
-        with self._lock:
-            if not self._indices:
-                shard = self.fetch_shard()
-                if shard is None:
-                    return None
+        # the get_task RPC must happen OUTSIDE the lock: a slow/dead
+        # master would otherwise hold the index queue hostage for the
+        # full rpc timeout while every other consumer thread stalls
+        # behind the lock. Two threads refilling concurrently is fine —
+        # both shards land in the deque and each index is popped once.
+        while True:
+            with self._lock:
+                if self._indices:
+                    return self._indices.popleft()
+            shard = self.fetch_shard()
+            if shard is None:
+                return None
+            with self._lock:
                 if shard.record_indices:
                     self._indices.extend(shard.record_indices)
                 else:
                     self._indices.extend(range(shard.start, shard.end))
-            return self._indices.popleft()
 
     def record_indices(self) -> Iterator[int]:
         while True:

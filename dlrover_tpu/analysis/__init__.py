@@ -6,11 +6,21 @@ Two passes, one gate (ISSUE 2):
   distributed-correctness pitfalls (RPCs without deadlines, swallowed
   exceptions on failover paths, non-daemon control threads, host
   impurity inside jit, shared mutable defaults).
+* ``concurrency`` — the whole-package lock-discipline pass (ISSUE 17):
+  inferred guard discipline per class (DLR010 mixed-guard access), a
+  cross-class lock-acquisition graph with cycle detection (DLR011
+  lock-order inversion), and blocking-calls-under-lock (DLR009 —
+  sleeps, joins, un-timed queue ops, RPC verbs, device syncs, listener
+  iteration). ``# guarded-by:`` annotations declare external
+  discipline; ``# dlrlint: disable=DLR0xx <reason>`` suppresses inline
+  (a reason-less disable is itself DLR012).
 * ``graph_lint`` — SPMD lint of the lowered/compiled train step via the
   same ``accelerate()``/AOT path production uses: host callbacks,
   recompile hazards, dtype drift, dropped donation, silently replicated
-  params, and the planner-vs-HLO collective byte audit
-  (``parallel.planner.predicted_collective_bytes``).
+  params, the planner-vs-HLO collective byte audit
+  (``parallel.planner.predicted_collective_bytes``), and the serving
+  program audit (G110 gather-free KV reads + donation/weak-type checks
+  on the compiled decode/prefill/page-copy programs).
 
 Run it: ``python -m dlrover_tpu.analysis`` (alias: ``tpulint``,
 ``tpurun lint``). Keep it green: ``tests/test_lint_clean.py`` runs the

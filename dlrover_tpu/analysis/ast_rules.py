@@ -30,7 +30,11 @@ import ast
 import os
 from typing import Dict, List, Optional, Set
 
-from dlrover_tpu.analysis.findings import Finding
+from dlrover_tpu.analysis.findings import (
+    Finding,
+    apply_suppressions,
+    scan_suppressions,
+)
 
 LOG_METHODS_OK = {"exception", "error", "warning", "critical", "info",
                   "debug", "log", "print_exc"}
@@ -482,7 +486,8 @@ class _Linter(ast.NodeVisitor):
 
 
 ALL_AST_RULES = ("DLR001", "DLR002", "DLR003", "DLR004", "DLR005",
-                 "DLR006", "DLR007", "DLR008")
+                 "DLR006", "DLR007", "DLR008", "DLR009", "DLR010",
+                 "DLR011", "DLR012")
 
 RULE_DOCS: Dict[str, str] = {
     "DLR001": "gRPC invocation without a timeout= deadline",
@@ -498,13 +503,27 @@ RULE_DOCS: Dict[str, str] = {
     "DLR008": "failure-class event emitted without a non-empty "
               "error_code (unclassifiable by the MTTR/goodput "
               "derivations)",
+    "DLR009": "blocking call (RPC, sleep, un-timed join/queue op, "
+              "device sync, listener iteration) inside a held-lock "
+              "region",
+    "DLR010": "instance attribute written under a lock in one method "
+              "but accessed lock-free in another (mixed guard "
+              "discipline)",
+    "DLR011": "lock-order inversion: the package lock-acquisition "
+              "graph contains a cycle (or a non-reentrant Lock is "
+              "re-acquired while held)",
+    "DLR012": "`# dlrlint: disable=` without a reason — suppressions "
+              "must justify themselves",
 }
 
 
 def lint_source(
-    source: str, path: str, rules: Optional[Set[str]] = None
+    source: str, path: str, rules: Optional[Set[str]] = None,
+    counters: Optional[Dict[str, int]] = None,
 ) -> List[Finding]:
-    """Run every (or the selected) AST rule over one file's source."""
+    """Run every (or the selected) AST rule over one file's source.
+    ``counters`` (optional) accrues per-rule inline-suppression counts
+    for the CLI summary."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -514,12 +533,18 @@ def lint_source(
         )]
     linter = _Linter(path, tree, enabled=rules)
     linter.visit(tree)
-    linter.findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
-    return linter.findings
+    findings = apply_suppressions(
+        linter.findings, scan_suppressions(source), counters=counters)
+    if rules is not None:
+        findings = [f for f in findings
+                    if f.rule_id in rules or f.rule_id == "DLR012"]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings
 
 
 def lint_paths(
-    paths: List[str], root: str, rules: Optional[Set[str]] = None
+    paths: List[str], root: str, rules: Optional[Set[str]] = None,
+    counters: Optional[Dict[str, int]] = None,
 ) -> List[Finding]:
     """Lint every ``.py`` file under ``paths``; finding paths are
     reported relative to ``root`` so baseline keys are checkout-stable."""
@@ -544,7 +569,8 @@ def lint_paths(
             rel = os.path.relpath(os.path.abspath(fname),
                                   os.path.abspath(root))
             findings.extend(
-                lint_source(src, rel.replace(os.sep, "/"), rules=rules)
+                lint_source(src, rel.replace(os.sep, "/"), rules=rules,
+                            counters=counters)
             )
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
     return findings

@@ -18,8 +18,44 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+
+def _changed_files(root: str, ref: str) -> Optional[List[str]]:
+    """Existing ``.py`` files changed vs ``ref`` (committed or not).
+    ``ref`` = "<merge-base>" resolves the merge-base with main. Returns
+    None when git cannot answer (not a checkout, unknown ref)."""
+    def _git(*argv: str) -> Optional[str]:
+        try:
+            out = subprocess.run(
+                ("git", "-C", root) + argv, capture_output=True,
+                text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return out.stdout if out.returncode == 0 else None
+
+    if ref == "<merge-base>":
+        base = None
+        for main in ("origin/main", "main", "origin/master", "master"):
+            base = _git("merge-base", "HEAD", main)
+            if base is not None:
+                break
+        if base is None:
+            return None
+        ref = base.strip()
+    diff = _git("diff", "--name-only", ref)
+    if diff is None:
+        return None
+    files = []
+    for rel in diff.splitlines():
+        if not rel.endswith(".py"):
+            continue
+        full = os.path.join(root, rel)
+        if os.path.exists(full):
+            files.append(full)
+    return files
 
 
 def _ensure_cpu_mesh_env():
@@ -59,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "four MoE dispatch compiles, ~20s saved)")
     p.add_argument("--rules", default="",
                    help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--changed", nargs="?", const="<merge-base>",
+                   default=None, metavar="REF",
+                   help="incremental mode: AST+concurrency rules only "
+                        "on .py files changed vs REF (default: the "
+                        "merge-base with main); the graph/audit suite "
+                        "and the stale-entry ratchet are skipped — a "
+                        "sub-second pre-commit loop, not the CI gate")
     p.add_argument("--tol", type=float, default=0.0,
                    help="override the G106 collective-audit tolerance "
                         "factor")
@@ -81,38 +124,73 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     rules = set(r.strip() for r in args.rules.split(",") if r.strip()) \
         or None
-    if args.write_baseline and (rules or args.paths or args.graph_only):
+    if args.write_baseline and (rules or args.paths or args.graph_only
+                                or args.changed is not None):
         # the baseline is the FULL AST allowlist: regenerating it from a
         # rule subset or a path subset would silently drop every other
         # entry, and --graph-only has no baseline to write at all
         print("--write-baseline regenerates the whole allowlist: run it "
-              "without --rules/--graph-only and without explicit paths",
+              "without --rules/--graph-only/--changed and without "
+              "explicit paths",
               file=sys.stderr)
         return 2
+    changed_mode = args.changed is not None
+    if changed_mode:
+        changed = _changed_files(root, args.changed)
+        if changed is None:
+            print("--changed: git could not resolve the diff ref; "
+                  "run the full lint instead", file=sys.stderr)
+            return 2
+        # same scope as the full run: the package, not tests/tools —
+        # the incremental loop must never be stricter than the gate
+        changed = [f for f in changed
+                   if f.startswith(pkg_dir + os.sep)]
+        if not changed:
+            print("0 changed .py files; nothing to lint")
+            return 0
     # a --rules subset naming no DLR/G rule makes the matching pass a
     # guaranteed no-op; skip it (the graph pass costs five compiles)
     run_ast = not args.graph_only and (
         rules is None or any(r.startswith("DLR") for r in rules)
     )
-    run_graph = not args.ast_only and (
+    run_graph = not args.ast_only and not changed_mode and (
         rules is None or any(r.startswith("G") for r in rules)
     )
 
     all_findings = []
     stale: List[str] = []
+    suppressed: Dict[str, int] = {}
 
     if run_ast:
-        paths = args.paths or [pkg_dir]
-        ast_findings = ast_rules.lint_paths(paths, root=root, rules=rules)
+        from dlrover_tpu.analysis import concurrency
+
+        if changed_mode:
+            paths = changed
+        else:
+            paths = args.paths or [pkg_dir]
+        ast_findings = ast_rules.lint_paths(
+            paths, root=root, rules=rules, counters=suppressed)
+        # the concurrency pass shares the findings/baseline currency;
+        # in --changed mode its lock graph spans only the changed
+        # files (documented trade for the sub-second loop)
+        ast_findings.extend(concurrency.lint_paths_concurrency(
+            paths, root=root, rules=rules, counters=suppressed))
+        ast_findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
         baseline = fmod.Baseline.load(baseline_path)
         new, stale = baseline.filter(ast_findings)
-        if args.paths or rules is not None:
-            # partial scope (explicit paths / a rule subset): entries for
-            # the unscanned remainder naturally consume no budget — that
-            # is not staleness, so the ratchet only runs full-scope
+        if args.paths or rules is not None or changed_mode:
+            # partial scope (explicit paths / a rule subset / changed
+            # files): entries for the unscanned remainder naturally
+            # consume no budget — that is not staleness, so the
+            # ratchet only runs full-scope
             stale = []
         if args.write_baseline:
-            fmod.Baseline.from_findings(ast_findings).save(baseline_path)
+            fresh = fmod.Baseline.from_findings(ast_findings)
+            # per-entry rationale survives a regeneration for keys
+            # that still exist
+            fresh.notes = {k: v for k, v in baseline.notes.items()
+                           if k in fresh.entries}
+            fresh.save(baseline_path)
             print(f"wrote {baseline_path} with "
                   f"{len(ast_findings)} entries")
             return 0
@@ -160,6 +238,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                         family, exc_info=True)
                     print(f"quantization drift probe ({family}) "
                           f"skipped: {type(e).__name__}: {e}")
+        # serving-program audit: decode/prefill/page-copy compiled
+        # programs checked for the gather-free KV read invariant
+        # (G110) plus donation (G105) and weak-type hazards (G103)
+        if not args.no_moe_audit and (
+                rules is None
+                or {"G110", "G105", "G103"}.intersection(rules)):
+            try:
+                reports.extend(graph_lint.serving_program_audit(
+                    rules=rules))
+            except Exception as e:  # noqa: BLE001
+                import logging
+
+                logging.getLogger("dlrover_tpu.analysis").warning(
+                    "serving program audit skipped", exc_info=True)
+                print(f"serving program audit skipped: "
+                      f"{type(e).__name__}: {e}")
         for rep in reports:
             all_findings.extend(rep.findings)
 
@@ -167,6 +261,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps({
             "findings": [f.__dict__ for f in all_findings],
             "stale_baseline_keys": stale,
+            "suppressed": suppressed,
             "graph_reports": [
                 {
                     "label": r.label,
@@ -191,9 +286,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         for key in stale:
             print(f"stale baseline entry (site fixed — remove it): {key}")
         n = len(all_findings)
+        supp_note = ""
+        if suppressed:
+            total = sum(suppressed.values())
+            detail = ", ".join(f"{k}×{suppressed[k]}"
+                               for k in sorted(suppressed))
+            supp_note = (f", {total} inline-suppressed ({detail})")
         print(f"{n} finding{'s' if n != 1 else ''} outside the baseline"
               + (f", {len(stale)} stale baseline entries" if stale
-                 else ""))
+                 else "") + supp_note)
     if stale and not all_findings:
         # ratchet down: fixing a site must shrink the allowlist in the
         # same change, or the key masks the next regression there

@@ -1,0 +1,1047 @@
+"""Concurrency lint: inferred lock discipline over the control plane.
+
+The master/agent/telemetry/serving control plane is the least-verified
+code in the repo precisely because its bugs are not unit-testable: a
+listener fired under the detector lock deadlocks only when the listener
+re-enters, a gauge stored outside the lock loses only under a racing
+rotation, a lock-order inversion hangs only when two threads interleave
+just so. The review logs of PRs 6-15 show the same three bug classes
+hand-found over and over. This pass makes them machine-checked:
+
+  DLR009 blocking-call-under-lock   a held-lock region performs an
+         unbounded wait: an RPC through a gRPC stub / ``MasterClient``,
+         ``time.sleep``, ``Thread.join()`` without a timeout,
+         ``queue.get/put`` without a timeout, ``jax.device_get`` /
+         ``device_put`` (a device sync), or iterates a user-registered
+         listener/callback/hook collection (the PR 7 deadlock class:
+         an arbitrary callback runs with the lock held and may
+         re-enter it).
+  DLR010 mixed-guard-attribute      an instance attribute is written
+         inside ``with self._lock:`` in one method but read or written
+         lock-free in another: either the lock is not actually the
+         guard (delete it) or the lock-free access is a race. Declared
+         intent escapes the inference with a ``# guarded-by:``
+         annotation on the attribute (see below).
+  DLR011 lock-order-inversion       the whole-package lock-acquisition
+         graph (lock A held while acquiring B => edge A->B, including
+         acquisitions reached through method calls resolved one level
+         deep) contains a cycle — two threads taking the same pair of
+         locks in opposite orders deadlock; re-acquiring a non-reentrant
+         ``threading.Lock`` you already hold (a self-edge) deadlocks a
+         single thread.
+
+The inference is deliberately syntactic, like ``ast_rules``: it
+over-approximates in ways the checked-in ``baseline.json`` ratchet
+absorbs (with per-entry rationale in the baseline's ``notes``) and
+under-approximates in ways the fixtures in
+``tests/test_concurrency_lint.py`` pin.
+
+What counts as a lock
+---------------------
+An attribute (or module-level name) is treated as a lock when it is
+assigned ``threading.Lock()`` / ``RLock()`` / ``Condition()`` /
+``Semaphore()`` anywhere in the class/module, or when its name looks
+lock-like (``_lock``, ``lock``, ``_mutex``, ``_cond`` ...) and it is
+used as a context manager. A ``with`` on anything else (files, meshes,
+trace scopes) is not a lock region.
+
+Held-region inference
+---------------------
+A method body is ``with self._lock:``-held where the with-statement
+says so. Additionally, a *helper* method that is only ever called from
+held regions of its own class (the ``def _flag(self): ... # lock
+held`` convention) is inferred held, to a fixpoint — so the classic
+``observe() -> _judge() -> _flag()`` chain does not read as lock-free
+access. A method called from both held and unheld sites stays unheld
+(the unheld call path is real). Nested ``def``/``lambda`` bodies are
+never held by the enclosing ``with`` (they run later, on whatever
+thread calls them).
+
+Annotations and suppressions
+----------------------------
+``# guarded-by: <lock>`` on a line mentioning ``self.<attr>`` declares
+the attribute's guard discipline explicitly and exempts it from DLR010
+inference (the declared intent is trusted; use it for
+publish-once-then-read-only fields and single-writer counters).
+``# dlrlint: disable=DLR0xx <reason>`` on the reported line suppresses
+any DLR rule — the reason is MANDATORY; a bare disable is itself a
+finding (DLR012) so suppressions cannot rot invisibly, and suppressed
+counts surface in the CLI summary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis.findings import (
+    Finding,
+    apply_suppressions,
+    scan_suppressions,
+)
+
+CONCURRENCY_RULES = ("DLR009", "DLR010", "DLR011")
+
+# lock-like attribute/name spelling: the fallback when the assignment
+# is not visible (injected locks, inherited attributes)
+_LOCKY_NAME = re.compile(r"(?:^|_)(?:lock|locks|mutex|cond|condition)$")
+# threading constructors that create a lock-like object, mapped to
+# reentrancy: an RLock (and a Condition, which wraps an RLock by
+# default) may be re-acquired by its holder; a plain Lock may not
+_LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "rlock",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "JoinableQueue", "deque"}
+# receivers whose method calls are RPC verbs (DLR009): the gRPC stub /
+# MasterClient naming convention the whole control plane follows
+_RPC_RECEIVER = re.compile(r"(?:client|stub)$", re.IGNORECASE)
+# receiver names that look like bounded queues for .get/.put checks
+_QUEUE_NAME = re.compile(r"(?:^|_)(?:queue|q)$")
+# iterating one of these under a lock = firing arbitrary user callbacks
+# with the lock held (the PR 7 verdict-listener deadlock class)
+_CALLBACK_NAME = re.compile(r"(?:listener|callback|hook|subscriber)s?$")
+_GUARDED_BY = re.compile(r"#\s*guarded-by:\s*(\S+)")
+
+# methods whose lock-free attribute access is construction/teardown,
+# not a race: the object is not yet (or no longer) shared
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    # positional args count: `q.get(False)` is non-blocking and
+    # `q.get(True, 5)` / `t.join(5)` carry the timeout positionally —
+    # the caller has made a blocking decision either way
+    return bool(call.args) or any(
+        kw.arg in ("timeout", "block", None) for kw in call.keywords)
+
+
+@dataclass
+class _LockRef:
+    """One acquisition target. ``key`` is the graph identity
+    (``Class.attr`` / ``module.py:NAME``); '' = anonymous (a lock
+    passed as an argument): the region still counts as held for
+    DLR009/DLR010, but it cannot take part in the order graph."""
+
+    key: str
+    kind: str  # "lock" | "rlock" | "unknown"
+    line: int
+
+
+@dataclass
+class _Site:
+    line: int
+    scope: str
+
+
+@dataclass
+class _MethodInfo:
+    name: str
+    scope: str  # Class.method (baseline scope key)
+    # direct acquisitions anywhere in the body: (key, kind, line)
+    acquires: List[Tuple[str, str, int]] = field(default_factory=list)
+    # syntactically nested acquisitions: (held_key, acquired_key, line)
+    nested: List[Tuple[str, str, int]] = field(default_factory=list)
+    # blocking sites: (description, fixit, line, syntactically_held)
+    blocking: List[Tuple[str, str, int, bool]] = field(
+        default_factory=list)
+    # self-attr accesses: (attr, is_write, line, syntactically_held)
+    attr_access: List[Tuple[str, bool, int, bool]] = field(
+        default_factory=list)
+    # intra-class calls: (method_name, line, held_keys or None)
+    self_calls: List[Tuple[str, int, Optional[Tuple[str, ...]]]] = field(
+        default_factory=list)
+    # calls through typed attributes: (attr, method, line, held_keys)
+    attr_calls: List[
+        Tuple[str, str, int, Optional[Tuple[str, ...]]]
+    ] = field(default_factory=list)
+    # non-reentrant self-acquire: (key, line) — an immediate deadlock
+    self_deadlocks: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    path: str
+    bases: List[str] = field(default_factory=list)
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    queue_attrs: Set[str] = field(default_factory=set)
+    guarded: Set[str] = field(default_factory=set)
+    methods: Dict[str, _MethodInfo] = field(default_factory=dict)
+    # filled by the held-method fixpoint: method -> held lock keys
+    held_methods: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+
+@dataclass
+class FileSummary:
+    """Everything the cross-file DLR011 pass needs from one file."""
+
+    path: str
+    classes: List[_ClassInfo] = field(default_factory=list)
+    findings: List[Finding] = field(default_factory=list)
+    # inline-suppression table for anchoring DLR011 suppressions
+    suppressions: Dict[int, Tuple[Set[str], str]] = field(
+        default_factory=dict)
+
+
+class _ClassScan(ast.NodeVisitor):
+    """First pass over one class body: which attributes are locks,
+    queues, or constructed from a known class (for one-level call
+    resolution)."""
+
+    def __init__(self, info: _ClassInfo):
+        self.info = info
+        # current method's annotated parameters: name -> bare type
+        self._param_types: Dict[str, str] = {}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._in_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._in_func(node)
+
+    def _in_func(self, node):
+        saved = self._param_types
+        self._param_types = {}
+        for arg in (node.args.posonlyargs + node.args.args
+                    + node.args.kwonlyargs):
+            if arg.annotation is not None:
+                ann = _dotted(arg.annotation).rsplit(".", 1)[-1]
+                if ann and ann[0].isupper():
+                    self._param_types[arg.arg] = ann
+        self.generic_visit(node)
+        self._param_types = saved
+
+    def visit_Assign(self, node: ast.Assign):
+        self._record(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record([node.target], node.value)
+        self.generic_visit(node)
+
+    def _record(self, targets, value):
+        ctor = ""
+        if isinstance(value, ast.Call):
+            ctor = _dotted(value.func).rsplit(".", 1)[-1]
+        elif isinstance(value, ast.Name):
+            # self._store = store, with `store: NodeRuntimeStore`
+            # annotated on the enclosing signature
+            ctor = self._param_types.get(value.id, "")
+            if ctor:
+                for tgt in targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        self.info.attr_types.setdefault(tgt.attr, ctor)
+            return
+        if not ctor:
+            return
+        for tgt in targets:
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            if ctor in _LOCK_CTORS:
+                self.info.lock_attrs[tgt.attr] = _LOCK_CTORS[ctor]
+            elif ctor in _QUEUE_CTORS:
+                self.info.queue_attrs.add(tgt.attr)
+            elif ctor[0].isupper():
+                self.info.attr_types[tgt.attr] = ctor
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method walk with a with-lock stack. Nested function/lambda
+    bodies reset the stack (they execute later, unheld)."""
+
+    def __init__(self, cls: _ClassInfo, method: _MethodInfo,
+                 module_locks: Dict[str, str], path: str):
+        self.cls = cls
+        self.m = method
+        self.module_locks = module_locks
+        self.path = path
+        self.held: List[_LockRef] = []
+
+    # -- lock resolution -----------------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[_LockRef]:
+        """A with-item's context expression -> lock ref, or None when
+        it is not a lock (a file, a mesh, a span)."""
+        line = getattr(expr, "lineno", 0)
+        # unwrap `with self._lock as l:` handled by caller (item.context_expr)
+        name = _dotted(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        last = parts[-1]
+        if parts[0] == "self" and len(parts) == 2:
+            kind = self.cls.lock_attrs.get(last)
+            if kind is None and not _LOCKY_NAME.search(last):
+                return None
+            return _LockRef(f"{self.cls.name}.{last}", kind or "unknown",
+                            line)
+        if parts[0] == "self" and len(parts) == 3:
+            # with self._store._lock: — resolve through the attr's type
+            owner = self.cls.attr_types.get(parts[1])
+            kind_known = owner is None  # kind resolved later, globally
+            if not _LOCKY_NAME.search(last):
+                return None
+            if owner:
+                return _LockRef(f"{owner}.{last}", "unknown", line)
+            return _LockRef("", "unknown", line)
+        if len(parts) == 1:
+            kind = self.module_locks.get(last)
+            if kind is not None:
+                return _LockRef(f"{os.path.basename(self.path)}:{last}",
+                                kind, line)
+            if _LOCKY_NAME.search(last):
+                # a lock passed as an argument / bound locally: held
+                # region without a graph identity
+                return _LockRef("", "unknown", line)
+            return None
+        # dotted module-level (Other._LOCK) or unknown receiver
+        if _LOCKY_NAME.search(last):
+            return _LockRef("", "unknown", line)
+        return None
+
+    # -- with statements -----------------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._with(node)
+
+    def _with(self, node):
+        entered = 0
+        for item in node.items:
+            ref = self._resolve_lock(item.context_expr)
+            if ref is None:
+                continue
+            already = [h for h in self.held if h.key and h.key == ref.key]
+            if already:
+                # re-acquiring a held lock: reentrant (RLock/Condition)
+                # is fine; a plain Lock deadlocks this very thread. An
+                # unknown kind is assumed reentrant (no false alarm on
+                # an injected lock we cannot see the constructor of).
+                kind = ref.kind if ref.kind != "unknown" else \
+                    already[0].kind
+                if kind == "lock":
+                    self.m.self_deadlocks.append((ref.key, ref.line))
+                continue  # not a new node on the held stack
+            if ref.key:
+                self.m.acquires.append((ref.key, ref.kind, ref.line))
+                for h in self.held:
+                    if h.key and h.key != ref.key:
+                        self.m.nested.append((h.key, ref.key, ref.line))
+            self.held.append(ref)
+            entered += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(entered):
+            self.held.pop()
+
+    # -- nested defs don't inherit the held stack ----------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._nested(node)
+
+    def _nested(self, node):
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    # -- attribute accesses --------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and node.attr not in self.cls.lock_attrs):
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.m.attr_access.append(
+                (node.attr, is_write, node.lineno, bool(self.held)))
+        self.generic_visit(node)
+
+    # -- blocking calls + call graph -----------------------------------------
+
+    def _held_keys(self) -> Optional[Tuple[str, ...]]:
+        if not self.held:
+            return None
+        return tuple(h.key for h in self.held if h.key)
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        parts = name.split(".") if name else []
+        # record intra-class / typed-attr calls for one-level resolution
+        if parts and parts[0] == "self":
+            keys = self._held_keys()
+            if len(parts) == 2:
+                self.m.self_calls.append((parts[1], node.lineno, keys))
+            elif len(parts) == 3 and parts[1] in self.cls.attr_types:
+                self.m.attr_calls.append(
+                    (parts[1], parts[2], node.lineno, keys))
+        self._check_blocking(node, name, parts)
+        self.generic_visit(node)
+
+    def _blocked(self, node: ast.AST, desc: str, fixit: str):
+        self.m.blocking.append(
+            (desc, fixit, getattr(node, "lineno", 0), bool(self.held)))
+
+    def _check_blocking(self, node: ast.Call, name: str,
+                        parts: List[str]):
+        last = parts[-1] if parts else (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else "")
+        if not last:
+            return
+        if last == "sleep" and (len(parts) < 2 or parts[-2] in
+                                ("time", "self")):
+            self._blocked(
+                node, "time.sleep() parks the thread with the lock "
+                      "held — every peer path that needs the lock "
+                      "stalls for the full sleep",
+                "sleep outside the locked region (snapshot state under "
+                "the lock, wait after releasing it)")
+            return
+        if (last == "join" and isinstance(node.func, ast.Attribute)
+                and not node.args and not _has_timeout(node)
+                and not isinstance(node.func.value, ast.Constant)):
+            self._blocked(
+                node, "Thread.join() without a timeout under a lock: "
+                      "if the joined thread needs this lock to exit, "
+                      "this is a deadlock, not a wait",
+                "join outside the lock, or pass timeout= and handle "
+                "the still-alive case")
+            return
+        if last in ("get", "put") and isinstance(node.func,
+                                                 ast.Attribute):
+            recv = ".".join(parts[:-1])
+            recv_last = parts[-2] if len(parts) >= 2 else ""
+            is_q = (_QUEUE_NAME.search(recv_last) is not None
+                    or (recv.startswith("self.")
+                        and recv_last in self.cls.queue_attrs))
+            block_false = any(
+                kw.arg == "block"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+                for kw in node.keywords)
+            # queue.get(block, timeout): a positional 2nd arg is the
+            # timeout; `get(True, 5)` is bounded
+            positional_timeout = last == "get" and len(node.args) >= 2
+            if is_q and not _has_timeout(node) and not block_false \
+                    and not positional_timeout:
+                self._blocked(
+                    node, f"`{name}(...)` without a timeout under a "
+                          f"lock blocks until a peer makes progress — "
+                          f"and the peer may need this lock to do so",
+                    "pass timeout= (handle Empty/Full), or move the "
+                    "queue operation outside the locked region")
+            return
+        if last in ("device_get", "device_put", "block_until_ready"):
+            self._blocked(
+                node, f"`{name or last}(...)` under a lock blocks the "
+                      f"holder on the device dispatch queue — host "
+                      f"threads serialize behind a device sync",
+                "materialize device values before taking the lock; "
+                "hold the lock only for the host-state update")
+            return
+        if (len(parts) >= 2 and parts[-2] not in ("self",)
+                and _RPC_RECEIVER.search(parts[-2])):
+            self._blocked(
+                node, f"RPC `{name}(...)` under a lock: the call "
+                      f"blocks on a remote peer (dead peer = full "
+                      f"rpc timeout) while every local path that "
+                      f"needs the lock stalls behind it",
+                "snapshot what the RPC needs under the lock, release, "
+                "then call; re-take the lock to store the result")
+            return
+        if (len(parts) >= 3 and parts[0] == "self"
+                and _RPC_RECEIVER.search(parts[1])):
+            self._blocked(
+                node, f"RPC `{name}(...)` under a lock: the call "
+                      f"blocks on a remote peer (dead peer = full "
+                      f"rpc timeout) while every local path that "
+                      f"needs the lock stalls behind it",
+                "snapshot what the RPC needs under the lock, release, "
+                "then call; re-take the lock to store the result")
+
+    # -- listener iteration under a lock -------------------------------------
+
+    def visit_For(self, node: ast.For):
+        tgt = node.iter
+        # unwrap trivial copies: list(xs)/tuple(xs)/sorted(xs) — the
+        # copy does not help if the loop STILL runs under the lock
+        if (isinstance(tgt, ast.Call)
+                and _dotted(tgt.func) in ("list", "tuple", "sorted")
+                and tgt.args):
+            tgt = tgt.args[0]
+        name = _dotted(tgt)
+        last = name.rsplit(".", 1)[-1] if name else ""
+        if last and _CALLBACK_NAME.search(last):
+            self.m.blocking.append((
+                f"iterating `{name}` fires user-registered callbacks "
+                f"with the lock held: a slow callback blocks every "
+                f"peer, a re-entrant one deadlocks (the PR 7 "
+                f"verdict-listener class)",
+                "snapshot the collection under the lock and fire the "
+                "callbacks after releasing it (the _drain_notices "
+                "pattern)",
+                node.lineno, bool(self.held)))
+        self.generic_visit(node)
+
+
+# -- per-file analysis -------------------------------------------------------
+
+
+def _module_locks(tree: ast.Module) -> Dict[str, str]:
+    locks: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            ctor = _dotted(node.value.func).rsplit(".", 1)[-1]
+            if ctor in _LOCK_CTORS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        locks[tgt.id] = _LOCK_CTORS[ctor]
+    return locks
+
+
+def _guarded_attrs(source: str) -> Set[str]:
+    """Attributes declared via ``# guarded-by:`` anywhere in the file.
+    The annotation is per-line: every ``self.<attr>`` mentioned on a
+    line carrying the annotation is declared."""
+    out: Set[str] = set()
+    for line in source.splitlines():
+        if _GUARDED_BY.search(line):
+            out.update(re.findall(r"self\.(\w+)", line))
+    return out
+
+
+def analyze_source(source: str, path: str) -> FileSummary:
+    """Per-file pass: build the class/method tables and the DLR009
+    findings (which need no cross-file knowledge). DLR010/DLR011 run
+    in ``finalize`` once every file's summary exists (held-method
+    inference wants the full class; the order graph wants the whole
+    package)."""
+    summary = FileSummary(path=path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return summary  # ast_rules already reports DLR000
+    summary.suppressions = scan_suppressions(source)
+    module_locks = _module_locks(tree)
+    guarded = _guarded_attrs(source)
+
+    def scan_class(node: ast.ClassDef, prefix: str = ""):
+        info = _ClassInfo(name=prefix + node.name, path=path,
+                          guarded=set(guarded))
+        info.bases = [b for b in
+                      (_dotted(x).rsplit(".", 1)[-1] for x in node.bases)
+                      if b and b[0].isupper()]
+        _ClassScan(info).visit(node)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                m = _MethodInfo(
+                    name=stmt.name,
+                    scope=f"{info.name}.{stmt.name}")
+                ms = _MethodScan(info, m, module_locks, path)
+                for sub in stmt.body:
+                    ms.visit(sub)
+                info.methods[stmt.name] = m
+            elif isinstance(stmt, ast.ClassDef):
+                scan_class(stmt, prefix=info.name + ".")
+        summary.classes.append(info)
+
+    # module-level functions get a pseudo-class so module locks still
+    # produce held regions and graph edges
+    pseudo = _ClassInfo(name=f"<{os.path.basename(path)}>", path=path,
+                        guarded=set(guarded))
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            scan_class(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            m = _MethodInfo(name=node.name, scope=node.name)
+            ms = _MethodScan(pseudo, m, module_locks, path)
+            for sub in node.body:
+                ms.visit(sub)
+            pseudo.methods[node.name] = m
+    if pseudo.methods:
+        summary.classes.append(pseudo)
+    return summary
+
+
+def _infer_held_methods(
+    cls: _ClassInfo,
+    extra_sites: Optional[
+        Dict[str, List[Tuple[str, Optional[Tuple[str, ...]]]]]] = None,
+) -> None:
+    """Fixpoint: a method every one of whose call sites is held
+    (syntactically, or inside an already-held method) — with at least
+    one such site — is itself held, under the union of the callers'
+    lock keys. Methods with an unheld call site, or never called
+    intra-class (entry points), stay unheld. ``extra_sites`` carries
+    call sites observed in SUBCLASSES (``self._helper()`` under the
+    subclass's with-lock resolving to an inherited method): a held
+    subclass site supports the inference, an unheld one vetoes it."""
+    # collect intra-class call sites per callee
+    sites: Dict[str, List[Tuple[str, Optional[Tuple[str, ...]]]]] = {}
+    for m in cls.methods.values():
+        for callee, _line, keys in m.self_calls:
+            if callee in cls.methods:
+                sites.setdefault(callee, []).append((m.name, keys))
+    for callee, entries in (extra_sites or {}).items():
+        sites.setdefault(callee, []).extend(entries)
+    held: Dict[str, Tuple[str, ...]] = {}
+    for _ in range(len(cls.methods) + 1):
+        changed = False
+        for name, callers in sites.items():
+            if name in held:
+                continue
+            keys: Set[str] = set()
+            ok = bool(callers)
+            for caller, call_keys in callers:
+                if call_keys is not None:
+                    keys.update(call_keys)
+                elif caller in held and caller != name:
+                    keys.update(held[caller])
+                else:
+                    ok = False
+                    break
+            if ok:
+                held[name] = tuple(sorted(keys))
+                changed = True
+        if not changed:
+            break
+    cls.held_methods = held
+
+
+def _method_held(cls: _ClassInfo, m: _MethodInfo) -> bool:
+    return m.name in cls.held_methods
+
+
+def _emit_dlr009(cls: _ClassInfo, summary: FileSummary) -> None:
+    for m in cls.methods.values():
+        body_held = _method_held(cls, m)
+        for desc, fixit, line, held in m.blocking:
+            if not (held or body_held):
+                continue
+            via = "" if held else (
+                " (lock held by every caller of this helper)")
+            summary.findings.append(Finding(
+                rule_id="DLR009", path=summary.path, line=line,
+                message=desc + via, fixit=fixit, scope=m.scope))
+
+
+def _emit_dlr010(cls: _ClassInfo, summary: FileSummary) -> None:
+    # attr -> accesses folded over every method, with method-held
+    # overlay applied
+    locked_writes: Dict[str, List[Tuple[str, int]]] = {}
+    unlocked: Dict[str, List[Tuple[str, bool, int]]] = {}
+    for m in cls.methods.values():
+        body_held = _method_held(cls, m)
+        for attr, is_write, line, held in m.attr_access:
+            if attr in cls.guarded:
+                continue
+            if held or body_held:
+                if is_write:
+                    locked_writes.setdefault(attr, []).append(
+                        (m.name, line))
+            elif m.name not in _EXEMPT_METHODS:
+                unlocked.setdefault(attr, []).append(
+                    (m.name, is_write, line))
+    for attr, writes in sorted(locked_writes.items()):
+        frees = unlocked.get(attr, [])
+        write_methods = {m for m, _ in writes}
+        # "written under a lock in one method, touched lock-free in
+        # ANOTHER": a single method mixing with itself is not this rule
+        offending = [(m, w, ln) for m, w, ln in frees
+                     if any(m != mw for mw in write_methods)]
+        if not offending:
+            continue
+        first = min(offending, key=lambda t: t[2])
+        methods = sorted({m for m, _, _ in offending})
+        kinds = "write" if any(w for _, w, _ in offending) else "read"
+        summary.findings.append(Finding(
+            rule_id="DLR010", path=summary.path, line=first[2],
+            message=f"`self.{attr}` is written under the lock in "
+                    f"`{sorted(write_methods)[0]}` but accessed "
+                    f"lock-free ({kinds}) in "
+                    f"{', '.join('`%s`' % m for m in methods[:3])}"
+                    + (f" (+{len(methods) - 3} more)"
+                       if len(methods) > 3 else "")
+                    + ": either the lock is not the guard or the "
+                      "lock-free access is a race",
+            fixit="take the lock at the lock-free site, or declare "
+                  "the discipline with a `# guarded-by: <lock>` "
+                  "annotation where the attribute is initialized",
+            scope=f"{cls.name}.{attr}"))
+
+
+# -- the cross-file order graph (DLR011) -------------------------------------
+
+
+@dataclass
+class LockGraph:
+    """Directed lock-acquisition graph with witness sites per edge."""
+
+    edges: Dict[Tuple[str, str], List[_Site]] = field(
+        default_factory=dict)
+    kinds: Dict[str, str] = field(default_factory=dict)
+
+    def add(self, a: str, b: str, path: str, line: int, scope: str):
+        if a == b:
+            return
+        self.edges.setdefault((a, b), []).append(
+            _Site(line=line, scope=f"{path}::{scope}"))
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles, smallest first — found via SCC then a
+        bounded DFS inside each nontrivial component."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        sccs = _tarjan(adj)
+        out: List[List[str]] = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            start = min(comp)
+            cyc = _find_cycle(start, adj, comp_set)
+            if cyc:
+                out.append(cyc)
+        return out
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str):
+        # iterative Tarjan (control-plane files nest deep enough that
+        # recursion limits are a real hazard in a lint pass)
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(comp))
+
+    for v in sorted(adj):
+        if v not in index:
+            strong(v)
+    return sccs
+
+
+def _find_cycle(start: str, adj: Dict[str, Set[str]],
+                comp: Set[str]) -> List[str]:
+    """One elementary cycle through ``start`` inside its SCC (BFS back
+    to start gives a shortest one — the most readable witness)."""
+    from collections import deque
+
+    prev: Dict[str, str] = {}
+    dq = deque([start])
+    seen = {start}
+    while dq:
+        v = dq.popleft()
+        for w in sorted(adj.get(v, ())):
+            if w not in comp:
+                continue
+            if w == start:
+                cyc = [v]
+                while cyc[-1] != start:
+                    cyc.append(prev[cyc[-1]])
+                cyc.reverse()
+                return cyc
+            if w not in seen:
+                seen.add(w)
+                prev[w] = v
+                dq.append(w)
+    return []
+
+
+def build_lock_graph(summaries: List[FileSummary]) -> LockGraph:
+    graph = LockGraph()
+    # global tables: lock kinds + class name -> info (ambiguous bare
+    # names are dropped: a wrong resolution could fabricate a cycle)
+    by_name: Dict[str, Optional[_ClassInfo]] = {}
+    for s in summaries:
+        for cls in s.classes:
+            bare = cls.name.rsplit(".", 1)[-1]
+            by_name[bare] = None if bare in by_name else cls
+            for attr, kind in cls.lock_attrs.items():
+                graph.kinds[f"{cls.name}.{attr}"] = kind
+    # cross-hierarchy call sites, one level of inheritance each way:
+    # up — `get_comm_world` holds the subclass lock and calls the
+    # base's `_check_rdzv_completed`, so the base helper's guard
+    # discipline is visible only through its subclasses; down — the
+    # base's `join_rendezvous` calls `self._on_join()` under lock and
+    # a subclass OVERRIDES the hook, so the override inherits the
+    # base's (held) call sites
+    inherited_sites: Dict[str, Dict[
+        str, List[Tuple[_ClassInfo, str,
+                        Optional[Tuple[str, ...]]]]]] = {}
+    for s in summaries:
+        for cls in s.classes:
+            for base_name in cls.bases:
+                base = by_name.get(base_name)
+                if base is None:
+                    continue
+                for m in cls.methods.values():
+                    for callee, _line, keys in m.self_calls:
+                        if (callee in base.methods
+                                and callee not in cls.methods):
+                            inherited_sites.setdefault(
+                                base.name, {}).setdefault(
+                                callee, []).append((cls, m.name, keys))
+                for bm in base.methods.values():
+                    for callee, _line, keys in bm.self_calls:
+                        if callee in cls.methods:
+                            inherited_sites.setdefault(
+                                cls.name, {}).setdefault(
+                                callee, []).append(
+                                (base, bm.name, keys))
+    # two passes: an inherited call site inside a caller that is
+    # ITSELF only inferred held (not syntactically) resolves against
+    # the caller class's first-pass held map
+    for _ in range(2):
+        for s in summaries:
+            for cls in s.classes:
+                extra: Dict[str, List[
+                    Tuple[str, Optional[Tuple[str, ...]]]]] = {}
+                for callee, entries in inherited_sites.get(
+                        cls.name, {}).items():
+                    extra[callee] = [
+                        (f"<{c.name}.{meth}>",
+                         keys if keys is not None
+                         else c.held_methods.get(meth))
+                        for c, meth, keys in entries]
+                _infer_held_methods(cls, extra)
+    for s in summaries:
+        for cls in s.classes:
+            for m in cls.methods.values():
+                # syntactic nesting
+                for a, b, line in m.nested:
+                    graph.add(a, b, s.path, line, m.scope)
+                # a held helper's direct acquisitions nest under every
+                # lock its callers hold
+                held_keys = cls.held_methods.get(m.name, ())
+                for key, _kind, line in m.acquires:
+                    for h in held_keys:
+                        graph.add(h, key, s.path, line, m.scope)
+                # one-level call resolution: held call -> callee's
+                # direct acquisitions
+                for callee, line, keys in m.self_calls:
+                    keys = keys if keys is not None else held_keys
+                    target = cls.methods.get(callee)
+                    if target is None:
+                        for bname in cls.bases:
+                            b = by_name.get(bname)
+                            if b is not None and callee in b.methods:
+                                target = b.methods[callee]
+                                break
+                    if not keys or target is None:
+                        continue
+                    for bkey, _k, _ln in target.acquires:
+                        for h in keys:
+                            graph.add(h, bkey, s.path, line, m.scope)
+                for attr, meth, line, keys in m.attr_calls:
+                    keys = keys if keys is not None else held_keys
+                    if not keys:
+                        continue
+                    owner = by_name.get(cls.attr_types.get(attr, ""))
+                    if owner is None:
+                        continue
+                    target = owner.methods.get(meth)
+                    if target is None:
+                        continue
+                    for bkey, _k, _ln in target.acquires:
+                        for h in keys:
+                            graph.add(h, bkey, s.path, line, m.scope)
+    return graph
+
+
+def lock_order_findings(graph: LockGraph,
+                        summaries: List[FileSummary]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cyc in graph.cycles():
+        # witness: the edge out of the smallest node (stable anchor)
+        pairs = list(zip(cyc, cyc[1:] + cyc[:1]))
+        sites = graph.edges.get(pairs[0], [])
+        anchor = sites[0] if sites else _Site(0, "")
+        path, _, scope = anchor.scope.partition("::")
+        order = " -> ".join(cyc + [cyc[0]])
+        detail = "; ".join(
+            f"{a}->{b} at "
+            + (f"{graph.edges[(a, b)][0].scope.replace('::', ':')}"
+               f":{graph.edges[(a, b)][0].line}"
+               if graph.edges.get((a, b)) else "?")
+            for a, b in pairs)
+        findings.append(Finding(
+            rule_id="DLR011", path=path or "<package>",
+            line=anchor.line,
+            message=f"lock-order inversion: {order} — two threads "
+                    f"taking these locks in opposite orders deadlock "
+                    f"[{detail}]",
+            fixit="impose one global order (acquire the cycle's locks "
+                  "in a fixed sequence everywhere), or restructure so "
+                  "one side snapshots under its lock and calls out "
+                  "lock-free",
+            scope=scope.split("::")[-1] if scope else ""))
+    # non-reentrant self-acquire: a cycle of length one
+    for s in summaries:
+        for cls in s.classes:
+            for m in cls.methods.values():
+                for key, line in m.self_deadlocks:
+                    findings.append(Finding(
+                        rule_id="DLR011", path=s.path, line=line,
+                        message=f"`{key}` is a non-reentrant Lock "
+                                f"re-acquired while already held: "
+                                f"this thread deadlocks itself",
+                        fixit="use threading.RLock, or split the "
+                              "method so the locked region is entered "
+                              "once",
+                        scope=m.scope))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
+
+
+# -- entry points ------------------------------------------------------------
+
+
+def lint_paths_concurrency(
+    paths: List[str], root: str,
+    rules: Optional[Set[str]] = None,
+    counters: Optional[Dict[str, int]] = None,
+) -> List[Finding]:
+    """Run DLR009/DLR010/DLR011 over every ``.py`` file under
+    ``paths``. DLR011's graph spans exactly the files scanned — the
+    full package in the default/tier-1 run; in ``--changed`` mode the
+    graph (and so cycle detection) is limited to the changed files,
+    which is the documented trade for the sub-second loop."""
+    on = set(rules) if rules is not None else set(CONCURRENCY_RULES)
+    if not on.intersection(CONCURRENCY_RULES):
+        return []
+    summaries: List[FileSummary] = []
+    for path in paths:
+        files: List[str] = []
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git"))
+                files.extend(
+                    os.path.join(dirpath, f) for f in sorted(filenames)
+                    if f.endswith(".py"))
+        elif path.endswith(".py"):
+            files.append(path)
+        for fname in files:
+            with open(fname, encoding="utf-8") as fh:
+                src = fh.read()
+            rel = os.path.relpath(os.path.abspath(fname),
+                                  os.path.abspath(root))
+            summaries.append(analyze_source(src, rel.replace(os.sep,
+                                                             "/")))
+    graph = build_lock_graph(summaries)  # also runs held inference
+    findings: List[Finding] = []
+    for s in summaries:
+        for cls in s.classes:
+            if "DLR009" in on:
+                _emit_dlr009(cls, s)
+            if "DLR010" in on:
+                _emit_dlr010(cls, s)
+        findings.extend(s.findings)
+    if "DLR011" in on:
+        findings.extend(lock_order_findings(graph, summaries))
+    # inline suppressions (per anchor file's table)
+    by_path: Dict[str, Dict[int, Tuple[Set[str], str]]] = {
+        s.path: s.suppressions for s in summaries}
+    kept: List[Finding] = []
+    for f in findings:
+        table = by_path.get(f.path, {})
+        out = apply_suppressions([f], table, counters=counters)
+        kept.extend(out)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return kept
+
+
+def lint_source_concurrency(
+    source: str, path: str,
+    rules: Optional[Set[str]] = None,
+    counters: Optional[Dict[str, int]] = None,
+) -> List[Finding]:
+    """Single-source convenience for fixtures: the per-file rules plus
+    a lock graph built from this file alone."""
+    on = set(rules) if rules is not None else set(CONCURRENCY_RULES)
+    summary = analyze_source(source, path)
+    graph = build_lock_graph([summary])
+    findings: List[Finding] = []
+    for cls in summary.classes:
+        if "DLR009" in on:
+            _emit_dlr009(cls, summary)
+        if "DLR010" in on:
+            _emit_dlr010(cls, summary)
+    findings.extend(summary.findings)
+    if "DLR011" in on:
+        findings.extend(lock_order_findings(graph, [summary]))
+    findings = apply_suppressions(findings, summary.suppressions,
+                                  counters=counters)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings

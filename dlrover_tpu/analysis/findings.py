@@ -18,10 +18,62 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 BASELINE_VERSION = 1
+
+# ``# dlrlint: disable=DLR009 <reason>`` — the reason is mandatory; a
+# bare disable still suppresses (so the site does not double-report)
+# but is itself a DLR012 finding, keeping suppressions reviewable.
+_SUPPRESS = re.compile(
+    r"#\s*dlrlint:\s*disable=([A-Z0-9,\s]+?)(?:\s+([^\s].*))?$")
+
+
+def scan_suppressions(source: str) -> Dict[int, Tuple[Set[str], str]]:
+    """Per-line inline-suppression table: line -> (rule ids, reason)."""
+    table: Dict[int, Tuple[Set[str], str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        table[lineno] = (rules, (m.group(2) or "").strip())
+    return table
+
+
+def apply_suppressions(
+    findings: List["Finding"],
+    table: Dict[int, Tuple[Set[str], str]],
+    counters: Optional[Dict[str, int]] = None,
+) -> List["Finding"]:
+    """Drop findings whose anchor line carries a matching disable
+    comment; emit a DLR012 finding for every bare (reason-less)
+    disable that actually suppressed something. ``counters`` (if
+    given) accrues suppressed counts per rule id for the CLI summary.
+    """
+    kept: List[Finding] = []
+    bare_hits: Dict[int, Finding] = {}
+    for f in findings:
+        entry = table.get(f.line)
+        if entry and f.rule_id in entry[0]:
+            if counters is not None:
+                counters[f.rule_id] = counters.get(f.rule_id, 0) + 1
+            if not entry[1] and f.line not in bare_hits:
+                bare_hits[f.line] = Finding(
+                    rule_id="DLR012", path=f.path, line=f.line,
+                    message=f"dlrlint disable of {f.rule_id} without "
+                            f"a reason: suppressions must say why or "
+                            f"they rot invisibly",
+                    fixit="append the justification: "
+                          "`# dlrlint: disable="
+                          f"{f.rule_id} <why this site is safe>`",
+                    scope=f.scope)
+            continue
+        kept.append(f)
+    kept.extend(bare_hits.values())
+    return kept
 
 
 @dataclass(frozen=True)
@@ -51,6 +103,10 @@ class Baseline:
     """Allowlist of pre-existing findings, keyed scope-wise with counts."""
 
     entries: Dict[str, int] = field(default_factory=dict)
+    # per-entry rationale (key -> why this legacy site is tolerated);
+    # purely documentary — the ratchet ignores it, load/save round-trip
+    # it, and --write-baseline preserves notes for surviving keys
+    notes: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
     def load(cls, path: str) -> "Baseline":
@@ -64,13 +120,18 @@ class Baseline:
                 f"this linter writes version {BASELINE_VERSION} "
                 f"(regenerate with --write-baseline)"
             )
-        return cls(entries=dict(data.get("entries", {})))
+        return cls(entries=dict(data.get("entries", {})),
+                   notes=dict(data.get("notes", {})))
 
     def save(self, path: str):
         payload = {
             "version": BASELINE_VERSION,
             "entries": {k: self.entries[k] for k in sorted(self.entries)},
         }
+        notes = {k: self.notes[k] for k in sorted(self.notes)
+                 if k in self.entries}
+        if notes:
+            payload["notes"] = notes
         with open(path, "w") as fh:
             json.dump(payload, fh, indent=2, sort_keys=False)
             fh.write("\n")

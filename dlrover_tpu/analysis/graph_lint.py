@@ -43,7 +43,7 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger("analysis.graph")
 
 ALL_GRAPH_RULES = ("G101", "G102", "G103", "G104", "G105", "G106",
-                   "G107", "G108", "G109")
+                   "G107", "G108", "G109", "G110")
 
 GRAPH_RULE_DOCS: Dict[str, str] = {
     "G101": "params the strategy shards are replicated in the compiled "
@@ -65,6 +65,9 @@ GRAPH_RULE_DOCS: Dict[str, str] = {
     "G109": "a quantized program's output drifts from its bf16 twin "
             "beyond the ratcheted per-model baseline (numerics "
             "regression)",
+    "G110": "a gather on the KV read path of a compiled serving "
+            "program (decode/prefill/page-copy must read the pool "
+            "with slices, never a gather over pages)",
 }
 
 # G108: collectives below this output size are not worth overlapping
@@ -1188,4 +1191,159 @@ def moe_dispatch_audit(
             audit_tol=audit_tol,
             label=f"llama_tiny_moe[{dispatch}]",
         ))
+    return reports
+
+
+# -- G110: the serving-program audit ----------------------------------------
+
+_HLO_DEF_RE = re.compile(r"%([\w.\-]+)\s*=\s*\(?\s*(\w+)\[([\d,]*)\]")
+
+
+def check_kv_read_gather(optimized_hlo: str,
+                         path: str = "<serve>",
+                         min_rank: int = 4) -> List[Finding]:
+    """No ``gather`` whose operand is a KV pool tensor (rank >=
+    ``min_rank``) may survive compilation of a serving program.
+
+    The slot-major pool exists so decode reads K/V with contiguous
+    (dynamic-)slices; a gather over pages re-materializes the page
+    table indirection on device — per-token random access at HBM
+    latency on the hottest serving loop. Rank separates the pool
+    (``[L, S, T, KV, HD]`` and its scale leaves, rank 4-5) from the
+    benign rank-2 table gathers every program legitimately contains
+    (token embeddings ``[V, D]``, rotary tables): firing on those
+    would make the rule all-noise. The scan covers every computation
+    body, so gathers fused into fusion computations are seen too."""
+    # name -> rank, from every instruction definition in the module
+    ranks: Dict[str, int] = {}
+    for m in _HLO_DEF_RE.finditer(optimized_hlo):
+        dims = m.group(3)
+        ranks[m.group(1)] = len(dims.split(",")) if dims else 0
+    findings: List[Finding] = []
+    # first operand, either inline-typed (`gather(f32[2,8,..]{..} %x,`)
+    # or bare (`gather(%x,`); the lookbehind keeps `all-gather(` — a
+    # *collective*, not an indexed read — out of scope
+    gather_re = re.compile(
+        r"(?<![\w-])gather\(\s*(?:(\w+)\[([\d,]*)\]\S*\s+)?%([\w.\-]+)")
+    for line in optimized_hlo.splitlines():
+        gm = gather_re.search(line)
+        if gm is None:
+            continue
+        operand = gm.group(3)
+        if gm.group(1) is not None:
+            # operand written inline with a shape: count its dims
+            rank = len(gm.group(2).split(",")) if gm.group(2) else 0
+        else:
+            rank = ranks.get(operand, 0)
+        if rank >= min_rank:
+            findings.append(Finding(
+                rule_id="G110", path=path, line=0,
+                message=f"compiled program gathers from rank-{rank} "
+                        f"operand `%{operand}`: a gather over the KV "
+                        f"pool puts per-token random access on the "
+                        f"decode hot path (the slot-major layout "
+                        f"exists so reads are contiguous slices)",
+                fixit="index pages with lax.dynamic_slice / "
+                      "dynamic_update_slice keyed by slot+position; "
+                      "keep page indirection on the host (the router "
+                      "picks the slot, the program slices it)",
+            ))
+    return findings
+
+
+def serving_program_audit(
+    rules: Optional[Set[str]] = None,
+    num_slots: int = 4,
+    max_seq: int = 64,
+    prefill_chunk: int = 16,
+) -> List[GraphLintReport]:
+    """Compile the four serving programs exactly as ``ServeEngine.
+    _compile`` does — ``decode_step`` / ``prefill_chunk`` with the
+    cache donated, the prefix page copies with their destination
+    donated — and lint each: the gather-free KV read invariant (G110),
+    donation actually applied (G105: losing it doubles pool residency
+    per dispatch), and weak-type scalar args (G103: a python-int slot
+    id would recompile per slot). No mesh/shardings needed: the
+    invariants are layout properties of the single-device program, and
+    GSPMD only partitions the same op stream."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving.kv_cache import (
+        KVCacheSpec,
+        copy_page_to_slot,
+        copy_page_to_pool,
+        init_kv_cache,
+        init_prefix_pool,
+    )
+
+    config = llama.llama_tiny(param_dtype=jnp.bfloat16,
+                              compute_dtype=jnp.bfloat16)
+    spec = KVCacheSpec.from_model(
+        config, num_slots=num_slots, max_seq=max_seq,
+        prefix_pool_pages=4)
+    params_abs = jax.eval_shape(
+        lambda r: llama.init(r, config), jax.random.PRNGKey(0))
+    cache_abs = jax.eval_shape(lambda: init_kv_cache(spec))
+    pool_abs = jax.eval_shape(lambda: init_prefix_pool(spec))
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+
+    def decode_fn(params, cache, tokens, active):
+        return llama.decode_step(params, cache, tokens, active,
+                                 config, spec)
+
+    def prefill_fn(params, cache, tokens, slot, start, n_valid):
+        return llama.prefill_chunk(params, cache, tokens, slot, start,
+                                   n_valid, config, spec)
+
+    def admit_fn(cache, pool, slot, dst_start, src_page):
+        return copy_page_to_slot(cache, pool, slot, dst_start,
+                                 src_page, spec)
+
+    def publish_fn(pool, cache, slot, src_start, dst_page):
+        return copy_page_to_pool(pool, cache, slot, src_start,
+                                 dst_page, spec)
+
+    programs = [
+        ("serve_decode",
+         jax.jit(decode_fn, donate_argnums=(1,)),
+         (params_abs, cache_abs, i32(num_slots),
+          jax.ShapeDtypeStruct((num_slots,), jnp.bool_)),
+         len(jax.tree.leaves(cache_abs))),
+        ("serve_prefill",
+         jax.jit(prefill_fn, donate_argnums=(1,)),
+         (params_abs, cache_abs, i32(prefill_chunk), i32(), i32(),
+          i32()),
+         len(jax.tree.leaves(cache_abs))),
+        ("serve_admit_copy",
+         jax.jit(admit_fn, donate_argnums=(0,)),
+         (cache_abs, pool_abs, i32(), i32(), i32()),
+         len(jax.tree.leaves(cache_abs))),
+        ("serve_publish_copy",
+         jax.jit(publish_fn, donate_argnums=(0,)),
+         (pool_abs, cache_abs, i32(), i32(), i32()),
+         len(jax.tree.leaves(pool_abs))),
+    ]
+    on = set(rules) if rules is not None else set(ALL_GRAPH_RULES)
+    reports = []
+    for label, fn, abstract_args, n_donated in programs:
+        t0 = time.time()
+        lowered = fn.lower(*abstract_args)
+        compiled = lowered.compile()
+        hlo = compiled.as_text()
+        report = GraphLintReport(label=label)
+        if "G110" in on:
+            report.findings.extend(
+                check_kv_read_gather(hlo, path=label))
+        if "G105" in on:
+            report.findings.extend(check_donation(
+                hlo, n_donated, path=label))
+        if "G103" in on:
+            report.findings.extend(check_weak_type_inputs(
+                getattr(lowered, "args_info", None), path=label))
+        report.build_seconds = time.time() - t0
+        logger.info("serving audit %s: %d findings, %.1fs",
+                    label, len(report.findings), report.build_seconds)
+        reports.append(report)
     return reports

@@ -106,7 +106,8 @@ class HangingDetector:
     def start(self):
         if not self._monitor_enabled or self._thread is not None:
             return
-        self._last_normal = time.time()
+        with self._lock:
+            self._last_normal = time.time()
         self._thread = threading.Thread(
             target=self._watch, name="hang-detector", daemon=True
         )
@@ -127,9 +128,16 @@ class HangingDetector:
 
     def _watch(self):
         while not self._stopped.wait(self._interval):
-            gap = self.seconds_since_progress()
-            if gap > self._timeout and not self.hang_detected:
-                self.hang_detected = True
+            # check-and-set atomically with the gap read: a report_normal
+            # racing between the read and the set would otherwise leave a
+            # stale hang_detected=True (and a spurious on_hang) for a job
+            # that just made progress
+            with self._lock:
+                gap = time.time() - self._last_normal
+                fire = gap > self._timeout and not self.hang_detected
+                if fire:
+                    self.hang_detected = True
+            if fire:
                 logger.warning("no training progress for %.0fs", gap)
                 if self._on_hang is not None:
                     try:

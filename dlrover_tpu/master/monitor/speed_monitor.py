@@ -82,11 +82,13 @@ class SpeedMonitor:
 
     @property
     def completed_global_step(self) -> int:
-        return self._global_step
+        with self._lock:
+            return self._global_step
 
     @property
     def sample_count(self) -> int:
-        return self._sample_count
+        with self._lock:
+            return self._sample_count
 
     def running_speed(self) -> float:
         """steps/s over the recorded window (0 if not enough samples)."""

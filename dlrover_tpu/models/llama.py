@@ -863,15 +863,16 @@ def _kv_write_token(k_l, scale_l, new_kv, pos, active, spec):
 
     s = k_l.shape[0]
     idx = jnp.arange(s)
-    pos_c = jnp.clip(pos, 0, k_l.shape[1] - 1)
+    t = k_l.shape[1]
     vals, scales = encode_kv(new_kv, spec)
-    gate = active[:, None, None]
-    old = k_l[idx, pos_c]
-    k_l = k_l.at[idx, pos_c].set(jnp.where(gate, vals, old))
+    # masked scatter by index redirection: an inactive slot's row index
+    # is pushed out of bounds, and mode="drop" discards the update —
+    # no gather of the old row just to feed a where() (the gather-free
+    # decode invariant, G110: per-slot random reads belong to the host)
+    row = jnp.where(active, jnp.clip(pos, 0, t - 1), t)
+    k_l = k_l.at[idx, row].set(vals, mode="drop")
     if scales is not None and scale_l is not None:
-        old_s = scale_l[idx, pos_c]
-        scale_l = scale_l.at[idx, pos_c].set(
-            jnp.where(gate, scales, old_s))
+        scale_l = scale_l.at[idx, row].set(scales, mode="drop")
     return k_l, scale_l
 
 

@@ -525,6 +525,26 @@ def aot_compile_train_step(
         except Exception:  # noqa: BLE001
             logger.warning(
                 "grad drift probe skipped", exc_info=True)
+        # the concurrency pass rides the same flag: the artifact this
+        # proof blesses is deployed by the very control plane DLR009-011
+        # guard, and the whole-package pass costs ~1s next to the
+        # compiles above. Findings are baseline-filtered like tpulint's.
+        try:
+            import os as _os
+
+            import dlrover_tpu as _pkg
+            from dlrover_tpu.analysis import concurrency as _conc
+            from dlrover_tpu.analysis import findings as _fmod
+
+            _pkg_dir = _os.path.dirname(_os.path.abspath(_pkg.__file__))
+            _base = _fmod.Baseline.load(_os.path.join(
+                _pkg_dir, "analysis", "baseline.json"))
+            _new, _ = _base.filter(_conc.lint_paths_concurrency(
+                [_pkg_dir], root=_os.path.dirname(_pkg_dir)))
+            report.lint_findings = list(report.lint_findings) + _new
+        except Exception:  # noqa: BLE001 — same contract as the
+            # drift probes: skip, never kill the fit-proof
+            logger.warning("concurrency lint skipped", exc_info=True)
         for f in report.lint_findings:
             logger.warning("graph lint: %s", f.render())
     logger.info("AOT report: %s", report.to_json())
@@ -587,8 +607,9 @@ def main(argv: Optional[list] = None) -> int:
                         "even)")
     p.add_argument("--lint", action="store_true",
                    help="run the SPMD graph lint (dlrover_tpu.analysis) "
-                        "over the compiled artifact; findings print and "
-                        "flip the exit code")
+                        "over the compiled artifact, plus the "
+                        "concurrency pass (DLR009-011) over the control "
+                        "plane; findings print and flip the exit code")
     args = p.parse_args(argv)
 
     jax.config.update("jax_platforms", "cpu")  # AOT needs no devices

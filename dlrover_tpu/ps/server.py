@@ -157,8 +157,9 @@ class PsShardServer:
                     self._params[name] = np.array(arr, copy=True)
                     self._slots[name] = self._opt.init_slots(self._params[name])
                     created.append(name)
+            version = self._version
         return wire.pack_frame({"ok": True, "created": created,
-                                "version": self._version})
+                                "version": version})
 
     def _do_pull(self, meta) -> bytes:
         names = meta.get("names")
@@ -229,8 +230,10 @@ class PsShardServer:
             for name in self._params:
                 if name not in self._slots:
                     self._slots[name] = self._opt.init_slots(self._params[name])
-        return wire.pack_frame({"ok": True, "version": self._version,
-                                "num_params": len(self._params)})
+            version = self._version
+            num_params = len(self._params)
+        return wire.pack_frame({"ok": True, "version": version,
+                                "num_params": num_params})
 
     # -- lifecycle ---------------------------------------------------------
 

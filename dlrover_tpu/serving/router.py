@@ -418,7 +418,8 @@ class RequestRouter:
             logger.error("request conservation violated: %d lost", lost)
 
     def dropped(self) -> int:
-        return self._n_dropped
+        with self._lock:
+            return self._n_dropped
 
     def queue_depth(self) -> int:
         with self._lock:

@@ -2,6 +2,7 @@
 case per rule id), SPMD graph-lint fixtures, the four-dispatch MoE
 collective audit, and the cost-model perturbation regression."""
 
+import os
 import textwrap
 
 import jax
@@ -676,3 +677,80 @@ class TestPlannerBytesConsistency:
         assert pred["moe_dispatch"] > 0
         assert score.breakdown["moe_disp_comm_s"] == pytest.approx(
             pred["moe_dispatch"] / dev.ici_bw)
+
+
+# -- CLI: concurrency pass + suppression plumbing ---------------------------
+
+
+class TestCliConcurrencySurface:
+    FIXTURE = textwrap.dedent("""
+        import threading, time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self):
+                with self._lock:
+                    time.sleep(1.0){note}
+    """)
+
+    def test_concurrency_finding_flows_through_cli(self, tmp_path,
+                                                   capsys):
+        from dlrover_tpu.analysis import cli
+
+        bad = tmp_path / "locked_sleep.py"
+        bad.write_text(self.FIXTURE.format(note=""))
+        rc = cli.main([str(bad), "--ast-only",
+                       "--baseline", str(tmp_path / "nb.json")])
+        assert rc == 1
+        assert "DLR009" in capsys.readouterr().out
+
+    def test_suppressed_counts_in_text_summary(self, tmp_path, capsys):
+        from dlrover_tpu.analysis import cli
+
+        ok = tmp_path / "suppressed.py"
+        ok.write_text(self.FIXTURE.format(
+            note="  # dlrlint: disable=DLR009 paced by master"))
+        rc = cli.main([str(ok), "--ast-only",
+                       "--baseline", str(tmp_path / "nb.json")])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1 inline-suppressed (DLR009" in out
+
+    def test_suppressed_counts_in_json_output(self, tmp_path, capsys):
+        import json as _json
+
+        from dlrover_tpu.analysis import cli
+
+        ok = tmp_path / "suppressed.py"
+        ok.write_text(self.FIXTURE.format(
+            note="  # dlrlint: disable=DLR009 paced by master"))
+        rc = cli.main([str(ok), "--ast-only", "--json",
+                       "--baseline", str(tmp_path / "nb.json")])
+        data = _json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["suppressed"] == {"DLR009": 1}
+
+    def test_changed_with_unresolvable_ref_exits_2(self, capsys):
+        from dlrover_tpu.analysis import cli
+
+        rc = cli.main(["--changed=no-such-ref-zzz", "--ast-only"])
+        assert rc == 2
+        assert "git could not resolve" in capsys.readouterr().err
+
+    def test_changed_scopes_to_the_package(self, monkeypatch, capsys):
+        # a diff touching only tests/ must not make the incremental
+        # loop stricter than the full gate (which lints the package)
+        import dlrover_tpu
+        from dlrover_tpu.analysis import cli
+
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(dlrover_tpu.__file__)))
+        monkeypatch.setattr(
+            cli, "_changed_files",
+            lambda _root, _ref: [os.path.join(root, "tests",
+                                              "test_aot.py")])
+        rc = cli.main(["--changed=HEAD", "--ast-only"])
+        assert rc == 0
+        assert "0 changed .py files" in capsys.readouterr().out

@@ -1,0 +1,897 @@
+"""Concurrency lint (ISSUE 17): inferred lock discipline (DLR010),
+the cross-class lock-order graph (DLR011), blocking-calls-under-lock
+(DLR009), inline suppressions with mandatory reasons (DLR012), and the
+gather-free serving invariant (G110) — plus regression pins for the
+runtime races the new pass caught at introduction (sharding client RPC
+under lock, hang-detector lost update, torn monitor/PS/router reads).
+"""
+
+import json
+import textwrap
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.analysis.concurrency import (
+    analyze_source,
+    build_lock_graph,
+    lint_source_concurrency,
+    lock_order_findings,
+)
+from dlrover_tpu.analysis.findings import (
+    Baseline,
+    apply_suppressions,
+    scan_suppressions,
+)
+
+
+def _lint(src, rules=None, counters=None):
+    return lint_source_concurrency(
+        textwrap.dedent(src), "fixture.py", rules=rules,
+        counters=counters)
+
+
+def _ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# -- DLR009: blocking call under a lock --------------------------------------
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_fires(self):
+        fs = _lint("""
+            import threading, time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        time.sleep(1.0)
+        """)
+        assert _ids(fs) == ["DLR009"]
+        assert "time.sleep" in fs[0].message
+
+    def test_sleep_outside_lock_clean(self):
+        fs = _lint("""
+            import threading, time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(1.0)
+        """)
+        assert fs == []
+
+    def test_rpc_stub_verb_under_lock_fires(self):
+        fs = _lint("""
+            import threading
+
+            class W:
+                def __init__(self, client):
+                    self._lock = threading.Lock()
+                    self._client = client
+
+                def ask(self):
+                    with self._lock:
+                        return self._client.get_task("ds")
+        """)
+        assert _ids(fs) == ["DLR009"]
+
+    def test_queue_get_without_timeout_fires_with_timeout_clean(self):
+        src = """
+            import queue, threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._queue = queue.Queue()
+
+                def pull(self):
+                    with self._lock:
+                        return self._queue.get({})
+        """
+        assert _ids(_lint(src.format(""))) == ["DLR009"]
+        assert _lint(src.format("timeout=1.0")) == []
+        assert _lint(src.format("False")) == []  # block=False positional
+
+    def test_thread_join_without_timeout_fires(self):
+        fs = _lint("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=print)
+
+                def stop(self):
+                    with self._lock:
+                        self._thread.join()
+        """)
+        assert _ids(fs) == ["DLR009"]
+
+    def test_listener_iteration_under_lock_fires(self):
+        # the PR 7 deadlock class: callbacks invoked while holding the
+        # lock re-enter and deadlock; copying the list doesn't help if
+        # the loop body still runs under the lock
+        fs = _lint("""
+            import threading
+
+            class Bus:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._listeners = []
+
+                def fire(self, ev):
+                    with self._lock:
+                        for cb in list(self._listeners):
+                            cb(ev)
+        """)
+        assert _ids(fs) == ["DLR009"]
+
+    def test_inferred_held_helper_fires(self):
+        # the helper never takes the lock syntactically, but its only
+        # call site holds it — the blocking call is still under a lock
+        fs = _lint("""
+            import threading, time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _helper(self):
+                    time.sleep(0.5)
+
+                def run(self):
+                    with self._lock:
+                        self._helper()
+        """)
+        assert _ids(fs) == ["DLR009"]
+        assert "every caller" in fs[0].message
+
+    def test_unheld_call_site_vetoes_inference(self):
+        fs = _lint("""
+            import threading, time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _helper(self):
+                    time.sleep(0.5)
+
+                def run(self):
+                    with self._lock:
+                        self._helper()
+
+                def bare(self):
+                    self._helper()
+        """)
+        assert fs == []
+
+    def test_lock_passed_as_argument_guards_region(self):
+        # an argument lock has no graph identity but the held region
+        # is real: blocking inside it still fires
+        fs = _lint("""
+            import time
+
+            def flush(lock, buf):
+                with lock:
+                    time.sleep(0.1)
+        """)
+        assert _ids(fs) == ["DLR009"]
+
+
+# -- DLR010: mixed-guard attribute access ------------------------------------
+
+
+class TestMixedGuard:
+    FIRING = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def inc(self):
+                with self._lock:
+                    self._n += 1
+
+            def read(self):
+                return self._n
+    """
+
+    def test_locked_write_lockfree_read_fires(self):
+        fs = _lint(self.FIRING)
+        assert _ids(fs) == ["DLR010"]
+        assert fs[0].scope == "Counter._n"  # stable baseline key
+
+    def test_locked_everywhere_clean(self):
+        fs = _lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+        """)
+        assert fs == []
+
+    def test_init_write_is_exempt(self):
+        # __init__ publishes the object before any thread can race;
+        # only the lock-free read in a NON-exempt method fires
+        fs = _lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._v = 0
+
+                def set(self, v):
+                    with self._lock:
+                        self._v = v
+        """)
+        assert fs == []
+
+    def test_guarded_by_annotation_exempts(self):
+        fs = _lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0  # guarded-by: external serialization
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+
+                def read(self):
+                    return self._n
+        """)
+        assert fs == []
+
+    def test_same_method_mixing_does_not_fire(self):
+        # "written under the lock in one method, touched lock-free in
+        # ANOTHER" — a single method mixing with itself is not DLR010
+        fs = _lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._v = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._v += 1
+                    return self._v
+        """)
+        assert fs == []
+
+    def test_inherited_helper_called_under_subclass_lock(self):
+        # base helper writes lock-free but is only ever called from
+        # the subclass's locked method: the inheritance-aware
+        # inference must not flag it
+        fs = _lint("""
+            import threading
+
+            class Base:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}
+
+                def _apply(self, k, v):
+                    self._state[k] = v
+
+            class Impl(Base):
+                def put(self, k, v):
+                    with self._lock:
+                        self._apply(k, v)
+
+                def get(self, k):
+                    with self._lock:
+                        return self._state.get(k)
+        """)
+        assert fs == []
+
+
+# -- DLR011: lock-order graph ------------------------------------------------
+
+
+class TestLockOrderGraph:
+    def test_two_lock_inversion_fires(self):
+        fs = _lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        assert _ids(fs) == ["DLR011"]
+        assert "inversion" in fs[0].message
+
+    def test_consistent_order_clean(self):
+        fs = _lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def three(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert fs == []
+
+    def test_three_lock_cycle_fires(self):
+        fs = _lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._c = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def bc(self):
+                    with self._b:
+                        with self._c:
+                            pass
+
+                def ca(self):
+                    with self._c:
+                        with self._a:
+                            pass
+        """)
+        assert _ids(fs) == ["DLR011"]
+        # the witness names all three locks
+        assert fs[0].message.count("->") >= 3
+
+    def test_call_resolved_acquisition(self):
+        # outer holds x and calls a helper that takes y: the x->y edge
+        # is reached through the method call, one level deep
+        fs = _lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._x = threading.Lock()
+                    self._y = threading.Lock()
+
+                def helper(self):
+                    with self._y:
+                        pass
+
+                def outer(self):
+                    with self._x:
+                        self.helper()
+
+                def rev(self):
+                    with self._y:
+                        with self._x:
+                            pass
+        """)
+        assert _ids(fs) == ["DLR011"]
+
+    def test_cross_class_inversion(self):
+        # the graph spans classes: A holds its lock and calls into B;
+        # B's own method takes the locks in the opposite order through
+        # a typed attribute
+        fs = _lint("""
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def write(self, k):
+                    with self._lock:
+                        pass
+
+            class Manager:
+                def __init__(self, store: Store):
+                    self._lock = threading.Lock()
+                    self._store = store
+
+                def update(self, k):
+                    with self._lock:
+                        self._store.write(k)
+
+            class Reporter:
+                def __init__(self, mgr: Manager, store: Store):
+                    self._mgr = mgr
+                    self._store = store
+
+                def snapshot(self):
+                    with self._store._lock:
+                        with self._mgr._lock:
+                            pass
+        """)
+        assert "DLR011" in _ids(fs)
+
+    def test_with_multi_item_ordering(self):
+        # `with a, b:` acquires left-to-right; the reversed pair in
+        # another method is an inversion
+        fs = _lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a, self._b:
+                        pass
+
+                def two(self):
+                    with self._b, self._a:
+                        pass
+        """)
+        assert _ids(fs) == ["DLR011"]
+
+    def test_nonreentrant_self_reacquire_fires(self):
+        fs = _lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """)
+        assert _ids(fs) == ["DLR011"]
+        assert "re-acquired" in fs[0].message
+
+    def test_rlock_reentry_clean(self):
+        fs = _lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """)
+        assert fs == []
+
+    def test_graph_edges_have_witness_sites(self):
+        summary = analyze_source(textwrap.dedent("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """), "w.py")
+        graph = build_lock_graph([summary])
+        assert ("S._a", "S._b") in graph.edges
+        sites = graph.edges[("S._a", "S._b")]
+        assert sites and sites[0].scope.startswith("w.py::")
+        assert lock_order_findings(graph, [summary]) == []
+
+
+# -- DLR012: inline suppressions ---------------------------------------------
+
+
+class TestSuppressions:
+    SRC = """
+        import threading, time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self):
+                with self._lock:
+                    time.sleep(1.0)  # dlrlint: disable=DLR009{reason}
+    """
+
+    def test_reasoned_disable_suppresses_silently(self):
+        counters = {}
+        fs = _lint(self.SRC.format(reason=" startup backoff is "
+                                          "master-paced"),
+                   counters=counters)
+        assert fs == []
+        assert counters == {"DLR009": 1}
+
+    def test_bare_disable_suppresses_but_is_itself_a_finding(self):
+        counters = {}
+        fs = _lint(self.SRC.format(reason=""), counters=counters)
+        assert _ids(fs) == ["DLR012"]
+        assert "reason" in fs[0].message
+        assert counters.get("DLR009") == 1
+
+    def test_disable_for_other_rule_does_not_suppress(self):
+        fs = _lint(self.SRC.format(reason="").replace(
+            "DLR009", "DLR010"))
+        assert _ids(fs) == ["DLR009"]
+
+    def test_scan_table_parses_rules_and_reason(self):
+        table = scan_suppressions(
+            "x = 1  # dlrlint: disable=DLR002,DLR009 known-benign\n")
+        assert table == {1: ({"DLR002", "DLR009"}, "known-benign")}
+
+    def test_apply_counts_per_rule(self):
+        from dlrover_tpu.analysis.findings import Finding
+
+        fs = [Finding("DLR009", "p.py", 3, "m"),
+              Finding("DLR009", "p.py", 3, "m2"),
+              Finding("DLR010", "p.py", 9, "m3")]
+        counters = {}
+        kept = apply_suppressions(
+            fs, {3: ({"DLR009"}, "why")}, counters=counters)
+        assert [f.rule_id for f in kept] == ["DLR010"]
+        assert counters == {"DLR009": 2}
+
+
+# -- baseline: ratchet + notes -----------------------------------------------
+
+
+class TestBaselineRatchetForNewRules:
+    def test_stale_concurrency_entry_reported(self):
+        base = Baseline(entries={"DLR010::gone.py::C._n": 1})
+        new, stale = base.filter([])
+        assert new == [] and stale == ["DLR010::gone.py::C._n"]
+
+    def test_covered_finding_consumes_budget(self):
+        fs = _lint(TestMixedGuard.FIRING)
+        base = Baseline.from_findings(fs)
+        new, stale = base.filter(fs)
+        assert new == [] and stale == []
+        # a SECOND violation in the same scope exceeds the budget
+        new, _ = base.filter(fs + fs)
+        assert len(new) == 1
+
+    def test_notes_round_trip_and_survive_regeneration(self, tmp_path):
+        fs = _lint(TestMixedGuard.FIRING)
+        base = Baseline.from_findings(fs)
+        key = fs[0].baseline_key
+        base.notes[key] = "legacy: external serialization via agent"
+        p = str(tmp_path / "b.json")
+        base.save(p)
+        loaded = Baseline.load(p)
+        assert loaded.notes == {key: "legacy: external serialization "
+                                     "via agent"}
+        with open(p) as fh:
+            data = json.load(fh)
+        assert data["version"] == 1 and key in data["notes"]
+        # notes for keys no longer in entries are dropped on save
+        base.entries = {}
+        base.save(p)
+        assert Baseline.load(p).notes == {}
+
+
+# -- G110: gather-free serving programs --------------------------------------
+
+
+class TestKVReadGather:
+    def test_rank4_gather_fires_rank2_clean(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.analysis import graph_lint
+
+        idx = jax.ShapeDtypeStruct((3,), jnp.int32)
+        pool = jax.ShapeDtypeStruct((2, 8, 16, 4, 32), jnp.bfloat16)
+        hlo = jax.jit(
+            lambda p, i: jnp.take(p, i, axis=1)
+        ).lower(pool, idx).compile().as_text()
+        fired = graph_lint.check_kv_read_gather(hlo, path="<probe>")
+        assert len(fired) == 1 and fired[0].rule_id == "G110"
+
+        emb = jax.ShapeDtypeStruct((1024, 64), jnp.float32)
+        hlo2 = jax.jit(
+            lambda e, i: jnp.take(e, i, axis=0)
+        ).lower(emb, idx).compile().as_text()
+        assert graph_lint.check_kv_read_gather(hlo2, path="<p>") == []
+
+    def test_all_gather_collective_not_matched(self):
+        from dlrover_tpu.analysis import graph_lint
+
+        hlo = ("  %ag = f32[8,2,3,4,5] all-gather(f32[1,2,3,4,5] %p0)"
+               ", dimensions={0}")
+        assert graph_lint.check_kv_read_gather(hlo, path="<p>") == []
+
+    def test_serving_programs_gather_free_at_head(self):
+        # the four compiled serving programs (decode / prefill / the
+        # two page copies) carry the invariant the slot-major pool
+        # exists for: KV reads are contiguous slices, not gathers
+        from dlrover_tpu.analysis import graph_lint
+
+        reports = graph_lint.serving_program_audit()
+        labels = {r.label for r in reports}
+        assert labels == {"serve_decode", "serve_prefill",
+                          "serve_admit_copy", "serve_publish_copy"}
+        bad = [f.render() for r in reports for f in r.findings]
+        assert bad == [], "\n".join(bad)
+
+
+# -- regression pins for the races the new pass caught -----------------------
+
+
+class _ScriptedMaster:
+    """Stand-in master client: scripted get_task responses, and an
+    assertion hook that observes the sharding client's lock DURING the
+    RPC (the DLR009 fix: the RPC must run lock-free)."""
+
+    def __init__(self, tasks):
+        self._tasks = list(tasks)
+        self.lock_to_watch = None
+        self.lock_was_free = []
+
+    def report_dataset_shard_params(self, **kw):
+        pass
+
+    def get_task(self, dataset_name):
+        if self.lock_to_watch is not None:
+            free = self.lock_to_watch.acquire(blocking=False)
+            if free:
+                self.lock_to_watch.release()
+            self.lock_was_free.append(free)
+        if not self._tasks:
+            return None
+        return self._tasks.pop(0)
+
+
+def _task(task_id, start, end, indices=None):
+    from dlrover_tpu.common import comm
+
+    return comm.Task(task_id=task_id,
+                     shard=comm.Shard(name="s", start=start, end=end,
+                                      record_indices=indices))
+
+
+class TestShardingClientLockFreeRPC:
+    def _client(self, tasks):
+        from dlrover_tpu.agent.sharding_client import IndexShardingClient
+
+        master = _ScriptedMaster(tasks)
+        c = IndexShardingClient(master, "ds", batch_size=2,
+                                dataset_size=8)
+        master.lock_to_watch = c._lock
+        return c, master
+
+    def test_get_task_rpc_runs_outside_the_lock(self):
+        c, master = self._client([_task(0, 0, 4)])
+        assert [c.fetch_record_index() for _ in range(4)] == [0, 1, 2, 3]
+        assert master.lock_was_free == [True]
+
+    def test_streams_across_shards_and_exhausts(self):
+        c, _ = self._client([_task(0, 0, 2), _task(1, 2, 4, [7, 9])])
+        assert list(c.record_indices()) == [0, 1, 7, 9]
+        assert c.fetch_record_index() is None
+
+    def test_empty_shard_does_not_crash(self):
+        # pre-fix code popped from the just-extended (empty) deque and
+        # raised IndexError on a zero-record shard
+        c, _ = self._client([_task(0, 3, 3), _task(1, 5, 6)])
+        assert c.fetch_record_index() == 5
+
+
+class TestHangDetectorAtomicCheckAndSet:
+    def test_hang_fires_once_and_callback_runs_lock_free(self):
+        from dlrover_tpu.diagnosis.hang_detector import HangingDetector
+
+        fired = threading.Event()
+        seen = {}
+
+        def on_hang(gap):
+            # the DLR009 half of the fix: the escalation callback (a
+            # report RPC in production) must not run under the lock
+            free = det._lock.acquire(blocking=False)
+            if free:
+                det._lock.release()
+            seen["lock_free"] = free
+            seen["gap"] = gap
+            fired.set()
+
+        det = HangingDetector(timeout_secs=0.05,
+                              check_interval_secs=0.01,
+                              on_hang=on_hang)
+        det.start()
+        try:
+            assert fired.wait(5.0), "hang never detected"
+            assert det.hang_detected
+            assert seen["lock_free"] is True
+            assert seen["gap"] > 0.05
+        finally:
+            det.stop()
+        det.report_normal()
+        assert not det.hang_detected
+
+    def test_report_normal_racing_watch_leaves_no_stale_flag(self):
+        # the lost update the lint caught: _watch read the gap, then a
+        # report_normal landed, then _watch set hang_detected anyway.
+        # With check-and-set under the lock, a post-progress snapshot
+        # can never see (fresh progress, hang_detected=True).
+        from dlrover_tpu.diagnosis.hang_detector import HangingDetector
+
+        det = HangingDetector(timeout_secs=0.02,
+                              check_interval_secs=0.001, monitor=True)
+        det.start()
+        try:
+            deadline = time.time() + 1.0
+            while time.time() < deadline:
+                det.report_normal()
+                with det._lock:
+                    stale = (det.hang_detected
+                             and time.time() - det._last_normal
+                             <= det._timeout)
+                assert not stale
+        finally:
+            det.stop()
+
+
+class _RecordingLock:
+    """Context-manager shim around a real lock that counts entries."""
+
+    def __init__(self):
+        self._inner = threading.Lock()
+        self.entries = 0
+
+    def __enter__(self):
+        self._inner.acquire()
+        self.entries += 1
+        return self
+
+    def __exit__(self, *exc):
+        self._inner.release()
+
+    def acquire(self, *a, **kw):
+        return self._inner.acquire(*a, **kw)
+
+    def release(self):
+        self._inner.release()
+
+
+class TestTornReadPins:
+    def test_speed_monitor_properties_take_the_lock(self):
+        from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+        sm = SpeedMonitor()
+        sm.collect_global_step(5, timestamp=time.time())
+        rec = _RecordingLock()
+        sm._lock = rec
+        assert sm.completed_global_step == 5
+        assert sm.sample_count == 1
+        assert rec.entries == 2
+
+    def test_router_dropped_takes_the_lock(self):
+        from dlrover_tpu.serving.router import RequestRouter
+
+        r = RequestRouter(lease_timeout_secs=10.0)
+        rec = _RecordingLock()
+        r._lock = rec
+        assert r.dropped() == 0
+        assert rec.entries == 1
+
+    def test_ps_reply_version_captured_under_the_lock(self):
+        # simulate the race the lint flagged: a push lands the instant
+        # the init lock is released. The init reply must carry the
+        # version observed INSIDE its critical section, not whatever
+        # the racing writer left behind.
+        from dlrover_tpu.common import tensor_codec as wire
+        from dlrover_tpu.ps.server import PsShardServer
+
+        server = PsShardServer(shard_id=0)
+
+        class BumpOnExit:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __enter__(self):
+                self._inner.acquire()
+                return self
+
+            def __exit__(self, *exc):
+                server._version += 1000  # the racing push
+                self._inner.release()
+
+        server._lock = BumpOnExit(threading.Lock())
+        import numpy as np
+
+        reply = server._do_init({}, {"w": np.zeros(2, np.float32)})
+        meta, _ = wire.unpack_frame(reply)
+        assert meta["ok"] and meta["version"] == 0
+
+
+# -- whole-package invariants ------------------------------------------------
+
+
+class TestPackageLevel:
+    def test_concurrency_rules_registered(self):
+        from dlrover_tpu.analysis.ast_rules import (
+            ALL_AST_RULES,
+            RULE_DOCS,
+        )
+
+        for rid in ("DLR009", "DLR010", "DLR011", "DLR012"):
+            assert rid in ALL_AST_RULES and rid in RULE_DOCS
+
+    def test_rules_subset_runs_only_requested(self):
+        fs = _lint("""
+            import threading, time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def run(self):
+                    with self._lock:
+                        self._n += 1
+                        time.sleep(1.0)
+
+                def read(self):
+                    return self._n
+        """, rules={"DLR010"})
+        assert _ids(fs) == ["DLR010"]
+
+    def test_package_scan_is_fast_and_clean(self):
+        import os
+
+        import dlrover_tpu
+        from dlrover_tpu.analysis.concurrency import (
+            lint_paths_concurrency,
+        )
+
+        pkg = os.path.dirname(os.path.abspath(dlrover_tpu.__file__))
+        t0 = time.monotonic()
+        fs = lint_paths_concurrency([pkg], root=os.path.dirname(pkg))
+        dt = time.monotonic() - t0
+        assert fs == [], "\n".join(f.render() for f in fs)
+        assert dt < 10.0, f"concurrency pass took {dt:.1f}s"

@@ -11,6 +11,7 @@ import textwrap
 import dlrover_tpu
 from dlrover_tpu.analysis import cli
 from dlrover_tpu.analysis.ast_rules import lint_paths
+from dlrover_tpu.analysis.concurrency import lint_paths_concurrency
 from dlrover_tpu.analysis.findings import Baseline
 
 PKG_DIR = os.path.dirname(os.path.abspath(dlrover_tpu.__file__))
@@ -21,6 +22,7 @@ BASELINE = os.path.join(PKG_DIR, "analysis", "baseline.json")
 class TestRepoLintClean:
     def test_no_findings_outside_baseline_and_no_stale_entries(self):
         findings = lint_paths([PKG_DIR], root=ROOT)
+        findings.extend(lint_paths_concurrency([PKG_DIR], root=ROOT))
         baseline = Baseline.load(BASELINE)
         new, stale = baseline.filter(findings)
         assert new == [], "new lint findings (fix or baseline them):\n" \
