@@ -97,12 +97,16 @@ class MasterClient:
     def serve_complete(self, request_id: str, tokens,
                        ttft_s=None, e2e_s=None,
                        error_code: str = "",
-                       prefix_hit_tokens: int = 0) -> comm.Response:
+                       prefix_hit_tokens: int = 0,
+                       spec_drafted_tokens: int = 0,
+                       spec_accepted_tokens: int = 0) -> comm.Response:
         return self._channel.report(comm.ServeResult(
             node_id=self.node_id, request_id=request_id,
             tokens=[int(t) for t in tokens or []],
             ttft_s=ttft_s, e2e_s=e2e_s, error_code=error_code,
             prefix_hit_tokens=int(prefix_hit_tokens or 0),
+            spec_drafted_tokens=int(spec_drafted_tokens or 0),
+            spec_accepted_tokens=int(spec_accepted_tokens or 0),
         ))
 
     def serve_touch(self) -> comm.Response:

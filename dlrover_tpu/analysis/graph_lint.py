@@ -1256,16 +1256,20 @@ def serving_program_audit(
     num_slots: int = 4,
     max_seq: int = 64,
     prefill_chunk: int = 16,
+    spec_draft_len: int = 4,
 ) -> List[GraphLintReport]:
-    """Compile the four serving programs exactly as ``ServeEngine.
-    _compile`` does — ``decode_step`` / ``prefill_chunk`` with the
-    cache donated, the prefix page copies with their destination
-    donated — and lint each: the gather-free KV read invariant (G110),
-    donation actually applied (G105: losing it doubles pool residency
-    per dispatch), and weak-type scalar args (G103: a python-int slot
-    id would recompile per slot). No mesh/shardings needed: the
-    invariants are layout properties of the single-device program, and
-    GSPMD only partitions the same op stream."""
+    """Compile the five serving programs exactly as ``ServeEngine.
+    _compile`` does — ``decode_step`` / ``prefill_chunk`` (with the
+    on-device first-token argmax) / speculative ``verify_step`` with
+    the cache donated, the prefix page copies with their destination
+    donated — and lint each: the gather-free KV read invariant (G110:
+    for ``verify_step`` this covers the masked multi-token KV append,
+    whose ``mode="drop"`` scatter rows must not reintroduce a pool
+    gather), donation actually applied (G105: losing it doubles pool
+    residency per dispatch), and weak-type scalar args (G103: a
+    python-int slot id would recompile per slot). No mesh/shardings
+    needed: the invariants are layout properties of the single-device
+    program, and GSPMD only partitions the same op stream."""
     import jax
     import jax.numpy as jnp
 
@@ -1294,8 +1298,14 @@ def serving_program_audit(
                                  config, spec)
 
     def prefill_fn(params, cache, tokens, slot, start, n_valid):
-        return llama.prefill_chunk(params, cache, tokens, slot, start,
-                                   n_valid, config, spec)
+        cache, last_logits = llama.prefill_chunk(
+            params, cache, tokens, slot, start, n_valid, config, spec)
+        first = jnp.argmax(last_logits).astype(jnp.int32)
+        return cache, last_logits, first
+
+    def verify_fn(params, cache, tokens, active, n_draft):
+        return llama.verify_step(params, cache, tokens, active,
+                                 n_draft, config, spec)
 
     def admit_fn(cache, pool, slot, dst_start, src_page):
         return copy_page_to_slot(cache, pool, slot, dst_start,
@@ -1315,6 +1325,12 @@ def serving_program_audit(
          jax.jit(prefill_fn, donate_argnums=(1,)),
          (params_abs, cache_abs, i32(prefill_chunk), i32(), i32(),
           i32()),
+         len(jax.tree.leaves(cache_abs))),
+        ("serve_verify",
+         jax.jit(verify_fn, donate_argnums=(1,)),
+         (params_abs, cache_abs, i32(num_slots, spec_draft_len + 1),
+          jax.ShapeDtypeStruct((num_slots,), jnp.bool_),
+          i32(num_slots)),
          len(jax.tree.leaves(cache_abs))),
         ("serve_admit_copy",
          jax.jit(admit_fn, donate_argnums=(0,)),

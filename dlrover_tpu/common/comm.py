@@ -326,6 +326,11 @@ class NodeRuntimeReport:
     serve_queue_len: Optional[float] = None
     serve_slot_occupancy: Optional[float] = None
     serve_slots: Optional[float] = None
+    # speculative decode: cumulative drafted/accepted totals — the
+    # master diffs consecutive reports into a windowed acceptance-rate
+    # gauge (None while K=0 or on training reports)
+    serve_spec_drafted_total: Optional[float] = None
+    serve_spec_accepted_total: Optional[float] = None
 
 
 @message
@@ -439,6 +444,9 @@ class ParallelConfig:
     # shared prefix pool pages. 0 is a REAL value here (pool off), so
     # the leave-unchanged sentinel is -1, unlike its 0-sentinel siblings
     serve_prefix_pool_pages: int = -1
+    # speculative draft length K. 0 is a REAL value (spec off), so the
+    # leave-unchanged sentinel is -1 like the pool knob
+    serve_spec_draft_len: int = -1
     # optimizer decision identity: the worker echoes plan_id back in its
     # TrainerConfigReport ack, and every OPTIMIZER_* event on both sides
     # carries trace_id so the decision trail merges per incident
@@ -640,6 +648,11 @@ class ServeResult:
     # shared prefix pool instead of prefilled (0 = miss or pool off) —
     # the router's saved-token ledger input
     prefix_hit_tokens: int = 0
+    # speculative decode: draft tokens this request proposed into
+    # verify steps and the subset accepted (drafted - accepted =
+    # wasted) — the router's conservation-checked spec ledger input
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
 
 @message
@@ -686,6 +699,12 @@ class ServeConfigReport:
     prefix_pool_pages: int = 0
     page_size: int = 0
     prefix_hit_rate: float = -1.0
+    # speculative decode actually running (draft length K; 0 = off)
+    # and the acceptance rate this worker has OBSERVED (-1 = no draft
+    # yet): the optimizer prices K ONLY from evidence — zero evidence
+    # prices every K>0 at exactly 1.0x (no assumed speedup)
+    spec_draft_len: int = 0
+    spec_accept_rate: float = -1.0
     plan_id: str = ""
     apply_failed: bool = False
 

@@ -275,6 +275,18 @@ class Context:
         # the optimizer only spends pool HBM once traffic proves
         # prefix sharing — or an operator declares it)
         self.serve_prefix_expected_hit_rate = 0.0
+        # speculative decode (self-drafting: host n-gram prompt-lookup
+        # proposer + one batched multi-token verify step; bitwise
+        # identical to plain greedy at every acceptance pattern —
+        # docs/serving.md "Speculative decoding"). Master switch: when
+        # False the draft length is pinned to 0 everywhere and the
+        # optimizer refuses to enumerate K.
+        self.serve_spec_enabled = True
+        # draft tokens verified per slot per step (K; 0 = off). K is
+        # static per compiled program — the optimizer retunes it live
+        # from the OBSERVED acceptance rate through the program cache
+        # (a pure program swap: zero recompiles once prewarmed).
+        self.serve_spec_draft_len = 0
         # master-side: a leased request whose worker has not touched
         # the router for this long is re-leased to a live worker
         # (the shard-timeout machinery re-pointed at requests)

@@ -69,6 +69,12 @@ class NodeSample:
     serve_queue_len: Optional[float] = None
     serve_slot_occupancy: Optional[float] = None
     serve_slots: Optional[float] = None
+    # speculative decode: cumulative drafted/accepted totals and the
+    # WINDOWED acceptance rate diffed from them (None until a window
+    # with drafts — absent, never a fake 0)
+    serve_spec_drafted_total: Optional[float] = None
+    serve_spec_accepted_total: Optional[float] = None
+    serve_spec_accept_rate: Optional[float] = None
     overflow: bool = False
 
 
@@ -164,6 +170,24 @@ class NodeRuntimeStore:
                 if prev_tokens is not None and dt > 0 \
                         and tokens_total >= prev_tokens:
                     tokens_per_s = (tokens_total - prev_tokens) / dt
+            # speculative decode: the windowed acceptance rate from
+            # the cumulative drafted/accepted diffs — a regression is
+            # visible the window it happens, not diluted by lifetime
+            # totals
+            spec_drafted = opt(getattr(
+                report, "serve_spec_drafted_total", None))
+            spec_accepted = opt(getattr(
+                report, "serve_spec_accepted_total", None))
+            spec_rate = None
+            if spec_drafted is not None and spec_accepted is not None \
+                    and state.samples:
+                prev = state.samples[-1]
+                pd = prev.serve_spec_drafted_total
+                pa = prev.serve_spec_accepted_total
+                if pd is not None and pa is not None \
+                        and spec_drafted > pd and spec_accepted >= pa:
+                    spec_rate = (spec_accepted - pa) / (spec_drafted
+                                                        - pd)
             sample = NodeSample(
                 ts=ts,
                 step=int(report.step),
@@ -197,6 +221,9 @@ class NodeRuntimeStore:
                 serve_slot_occupancy=opt(getattr(
                     report, "serve_slot_occupancy", None)),
                 serve_slots=opt(getattr(report, "serve_slots", None)),
+                serve_spec_drafted_total=spec_drafted,
+                serve_spec_accepted_total=spec_accepted,
+                serve_spec_accept_rate=spec_rate,
                 overflow=bool(of50 or of95),
             )
             state.samples.append(sample)
@@ -287,6 +314,8 @@ class NodeRuntimeStore:
              "per-serve-node slots holding a live request"),
             (tm.NODE_SERVE_SLOTS, s.serve_slots,
              "per-serve-node compiled slot-batch width"),
+            (tm.NODE_SERVE_SPEC_ACCEPT_RATE, s.serve_spec_accept_rate,
+             "per-serve-node windowed speculative acceptance rate"),
         )
         for name, value, help_text in optional:
             if value is not None:

@@ -446,6 +446,12 @@ class RuntimeOptimizer:
                 # feeds the pricing when >= 0
                 "prefix_hit_rate": float(getattr(
                     report, "prefix_hit_rate", -1.0)),
+                "spec_draft_len": int(getattr(
+                    report, "spec_draft_len", 0) or 0),
+                # the observed acceptance rate: pricing evidence only
+                # (like the hit rate, it drifts — never a trigger)
+                "spec_accept_rate": float(getattr(
+                    report, "spec_accept_rate", -1.0)),
             }
             if report.plan_id:
                 self._record_applied(report)
@@ -468,7 +474,8 @@ class RuntimeOptimizer:
             changed = adopted and (prev is None or any(
                 prev.get(k) != cfg[k]
                 for k in ("world", "serve_slots", "prefill_chunk",
-                          "kv_precision", "prefix_pool_pages")))
+                          "kv_precision", "prefix_pool_pages",
+                          "spec_draft_len")))
         if changed and not report.plan_id:
             # an ack's config echo is the plan we just published —
             # re-planning on it would chase our own tail
@@ -510,10 +517,20 @@ class RuntimeOptimizer:
                 if 0 <= p <= 4096})
         else:
             pool_opts = [ppp]
+        # speculative draft lengths: 0 (off), current, and the small
+        # powers of two the verify step's compute trade favors — but
+        # ONLY under the serve_spec_enabled master switch (disabled =
+        # the current K alone, so a hand-set K is left untouched but
+        # never enumerated away from)
+        sk = max(0, int(cfg.get("spec_draft_len", 0) or 0))
+        if bool(getattr(get_context(), "serve_spec_enabled", True)):
+            spec_opts = sorted({0, sk, 2, 4, 8})
+        else:
+            spec_opts = [sk]
         return [{"serve_slots": s, "prefill_chunk": c,
-                 "prefix_pool_pages": p}
+                 "prefix_pool_pages": p, "spec_draft_len": k}
                 for s in slot_opts for c in chunk_opts
-                for p in pool_opts]
+                for p in pool_opts for k in spec_opts]
 
     def _serve_spec(self, cfg: Optional[Dict] = None):
         """A ModelSpec for the decode pricing. The KV-pool geometry
@@ -609,6 +626,13 @@ class RuntimeOptimizer:
                                 get_context(),
                                 "serve_prefix_expected_hit_rate",
                                 0.0) or 0.0))
+                # the acceptance rate has NO prior knob: with no
+                # observation every K>0 prices at exactly 1.0x inside
+                # estimate_decode, so spec stays off until traffic
+                # proves drafts land — evidence-only, stricter than
+                # the prefix discount (a wrong prior here would cost
+                # real compute every step, not just idle pool HBM)
+                accept_rate = float(cfg.get("spec_accept_rate", -1.0))
                 current = estimate_decode(
                     spec, world, cfg["serve_slots"],
                     cfg["prefill_chunk"], max_seq, kvp,
@@ -616,7 +640,10 @@ class RuntimeOptimizer:
                     prefix_pool_pages=max(
                         0, cfg.get("prefix_pool_pages", 0)),
                     page_size=page_size or 16,
-                    prefix_hit_rate=hit_rate)
+                    prefix_hit_rate=hit_rate,
+                    spec_draft_len=max(
+                        0, cfg.get("spec_draft_len", 0)),
+                    spec_accept_rate=accept_rate)
                 priced, memory_rejected = [], []
                 for cand in self._serve_candidates(cfg):
                     pool = serve_cache_bytes(
@@ -645,10 +672,13 @@ class RuntimeOptimizer:
                         device=self._device,
                         prefix_pool_pages=cand["prefix_pool_pages"],
                         page_size=page_size or 16,
-                        prefix_hit_rate=hit_rate)
+                        prefix_hit_rate=hit_rate,
+                        spec_draft_len=cand["spec_draft_len"],
+                        spec_accept_rate=accept_rate)
                     key = (f"serve|slots={cand['serve_slots']}"
                            f"|pc={cand['prefill_chunk']}"
-                           f"|ppp={cand['prefix_pool_pages']}")
+                           f"|ppp={cand['prefix_pool_pages']}"
+                           f"|spec={cand['spec_draft_len']}")
                     if key in self._failed_keys:
                         continue
                     priced.append({
@@ -679,7 +709,9 @@ class RuntimeOptimizer:
                             + (c["prefill_chunk"]
                                != cfg["prefill_chunk"])
                             + (c["prefix_pool_pages"]
-                               != cfg.get("prefix_pool_pages", 0)))
+                               != cfg.get("prefix_pool_pages", 0))
+                            + (c["spec_draft_len"]
+                               != cfg.get("spec_draft_len", 0)))
 
                 priced.sort(key=lambda c: (-c["tokens_per_s"],
                                            churn(c), c["serve_slots"]))
@@ -692,14 +724,18 @@ class RuntimeOptimizer:
                     best["serve_slots"] == cfg["serve_slots"]
                     and best["prefill_chunk"] == cfg["prefill_chunk"]
                     and best["prefix_pool_pages"]
-                    == cfg.get("prefix_pool_pages", 0))
+                    == cfg.get("prefix_pool_pages", 0)
+                    and best["spec_draft_len"]
+                    == cfg.get("spec_draft_len", 0))
                 pending_training = (
                     self._pending is not None
                     and not getattr(self._pending, "serve_slots", 0)
                     and not getattr(self._pending,
                                     "serve_prefill_chunk", 0)
                     and getattr(self._pending,
-                                "serve_prefix_pool_pages", -1) < 0)
+                                "serve_prefix_pool_pages", -1) < 0
+                    and getattr(self._pending,
+                                "serve_spec_draft_len", -1) < 0)
                 if unchanged:
                     self._reject(decision, "already_optimal")
                 elif pending_training:
@@ -747,6 +783,11 @@ class RuntimeOptimizer:
                 if best["prefix_pool_pages"]
                 != cfg.get("prefix_pool_pages", 0)
                 else -1),
+            serve_spec_draft_len=(
+                best["spec_draft_len"]
+                if best["spec_draft_len"]
+                != cfg.get("spec_draft_len", 0)
+                else -1),
             plan_id=plan_id,
             trace_id=decision.trace_id,
             predicted_speedup=round(best["speedup"], 3),
@@ -760,6 +801,7 @@ class RuntimeOptimizer:
             knob_serve_slots=best["serve_slots"],
             knob_serve_prefill_chunk=best["prefill_chunk"],
             knob_serve_prefix_pool_pages=best["prefix_pool_pages"],
+            knob_serve_spec_draft_len=best["spec_draft_len"],
         )
         logger.info("replan(%s): chose %s (predicted %.2fx tokens/s, "
                     "plan %s)", decision.trigger, best["key"],

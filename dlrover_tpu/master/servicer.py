@@ -301,6 +301,10 @@ class MasterServicer:
             error_code=req.error_code,
             prefix_hit_tokens=int(getattr(req, "prefix_hit_tokens", 0)
                                   or 0),
+            spec_drafted_tokens=int(
+                getattr(req, "spec_drafted_tokens", 0) or 0),
+            spec_accepted_tokens=int(
+                getattr(req, "spec_accepted_tokens", 0) or 0),
         )
         return comm.Response(success=ok)
 

@@ -902,6 +902,61 @@ def _paged_attention(q, k_l, ks_l, v_l, vs_l, pos, spec, config):
     return out.reshape(s, h * hd).astype(config.compute_dtype)
 
 
+def _kv_write_tokens(k_l, scale_l, new_kv, pos, valid, spec):
+    """Masked MULTI-token append: write up to ``K+1`` tokens' K (or V)
+    per slot at rows ``pos[s] .. pos[s]+K``, guarded by ``valid
+    [S, K+1]`` — the speculative-verify generalization of
+    ``_kv_write_token``. Same discipline: masked scatter by index
+    redirection (an invalid row index is pushed out of bounds and
+    ``mode="drop"`` discards it), so there is no gather of old rows to
+    feed a ``where()`` and the G110 gather-free invariant holds. Rows
+    past the pool end are also dropped (the host caps draft lengths so
+    this only guards against a buggy caller, not silent clamping)."""
+    from dlrover_tpu.serving.kv_cache import encode_kv
+
+    s, k1 = new_kv.shape[0], new_kv.shape[1]
+    t = k_l.shape[1]
+    idx = jnp.arange(s)[:, None]
+    vals, scales = encode_kv(new_kv, spec)
+    rows_raw = pos[:, None] + jnp.arange(k1)[None, :]   # [S, K+1]
+    ok = valid & (rows_raw < t)
+    rows = jnp.where(ok, jnp.clip(rows_raw, 0, t - 1), t)
+    k_l = k_l.at[idx, rows].set(vals, mode="drop")
+    if scales is not None and scale_l is not None:
+        scale_l = scale_l.at[idx, rows].set(scales, mode="drop")
+    return k_l, scale_l
+
+
+def _verify_attention(q, k_l, ks_l, v_l, vs_l, pos, spec, config):
+    """Speculative-verify attention: ``q [S, K+1, H, HD]`` — every
+    slot's current token plus its drafts — against each slot's own
+    pages, causal mask ``t <= pos[s] + i``. The batched-over-slots
+    generalization of ``_chunk_attention`` (same grouped einsum, f32
+    logits, ``finfo.min`` mask, f32 softmax), which is what makes the
+    verified positions compute-per-position identical to the decode
+    path — the per-row parity the bitwise acceptance contract rests
+    on."""
+    from dlrover_tpu.serving.kv_cache import decode_kv
+
+    s, k1, h, hd = q.shape
+    kvh = k_l.shape[2]
+    t = k_l.shape[1]
+    group = h // kvh
+    k = decode_kv(k_l, ks_l, spec)      # [S, T, KV, HD] f32
+    v = decode_kv(v_l, vs_l, spec)
+    qg = q.reshape(s, k1, kvh, group, hd)
+    logits = jnp.einsum(
+        "sikgd,stkd->sikgt", qg, k, preferred_element_type=jnp.float32
+    ) * (1.0 / (hd ** 0.5))
+    mask = (jnp.arange(t)[None, None, :]
+            <= (pos[:, None] + jnp.arange(k1)[None, :])[:, :, None])
+    logits = jnp.where(mask[:, :, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("sikgt,stkd->sikgd", probs.astype(v.dtype), v)
+    return out.reshape(s, k1, h * hd).astype(config.compute_dtype)
+
+
 def _chunk_attention(q, k_slot, ks_slot, v_slot, vs_slot, start, spec,
                      config):
     """Prefill-chunk attention: chunk queries ``[C, H, HD]`` against
@@ -1002,6 +1057,100 @@ def decode_step(params, cache, tokens, active, config: LlamaConfig,
     length = jnp.where(active, pos + 1, pos).astype(jnp.int32)
     return next_tokens, logits, _rebuild_cache(cache, k, ks, v, vs,
                                                length)
+
+
+def verify_step(params, cache, tokens, active, n_draft,
+                config: LlamaConfig, spec):
+    """One speculative-decode VERIFY step for every slot at once: run
+    the model over each slot's current token plus up to K drafted
+    continuations in one batched call, greedily accept the longest
+    matching draft prefix on device, and commit exactly the accepted
+    tokens' KV.
+
+    ``tokens [S, K+1] int32``: column 0 is the slot's current token
+    (what ``decode_step`` would be fed), columns 1..K are host-drafted
+    candidates for the following positions. ``n_draft [S] int32``: how
+    many of the K draft columns are real for each slot (mixed-K slots
+    in ONE compiled program — K is static, validity is data).
+    ``active [S] bool``: as in ``decode_step``.
+
+    Acceptance contract (greedy, bitwise): with ``g[i] = argmax`` of
+    the logits at position ``pos+i``, the accepted length ``a`` is the
+    longest prefix with ``tokens[i+1] == g[i]`` for ``i < a``. The
+    slot emits ``a+1`` tokens — ``g[0..a]``: the accepted drafts plus
+    the bonus token the last verified position predicts — and its next
+    current token is ``g[a]``. Since ``g[0]`` is computed over exactly
+    the context ``decode_step`` would see, and each accepted draft
+    equals the token greedy decode would have produced, the emitted
+    stream is token-for-token what plain greedy decode emits at EVERY
+    acceptance pattern (induction over accepted prefixes; per-position
+    compute parity is ``_verify_attention``'s contract).
+
+    Rollback is a cursor rewind, not a wipe: rejected positions
+    ``pos+a+1 .. pos+n_draft`` hold garbage K/V rows, but every
+    attention mask is position-bounded by the committed length and
+    future writes land in order, overwriting them before they could
+    ever be read.
+
+    Returns ``(greedy [S, K+1], accepted [S], next_tokens [S],
+    cache)`` — the host reads ``greedy[:, :accepted+1]`` once per
+    verify step, amortized over up to K+1 emitted tokens.
+    """
+    c = config
+    if c.num_experts > 0:
+        raise NotImplementedError(
+            "verify_step serves dense llama configs; MoE decode "
+            "dispatch is not built yet (ROADMAP item 3)")
+    s, k1 = tokens.shape
+    pos = cache["length"]               # first position this step writes
+    offs = jnp.arange(k1)
+    valid = active[:, None] & (offs[None, :] <= n_draft[:, None])
+    positions = pos[:, None] + offs[None, :]        # [S, K+1]
+    x = params["embed_tokens"]["embedding"][tokens].astype(c.compute_dtype)
+
+    def block(x_in, xs):
+        layer, k_l, ks_l, v_l, vs_l = xs
+        layer = cast_floats(layer, c.compute_dtype)
+        h, kvh, hd = c.num_heads, c.num_kv_heads, c.head_dim
+        attn_in = _rms_norm(x_in, layer["input_norm"]["scale"], c.rms_eps)
+        q = (attn_in @ layer["q_proj"]["kernel"]).reshape(s, k1, h, hd)
+        k_new = (attn_in @ layer["k_proj"]["kernel"]).reshape(
+            s, k1, kvh, hd)
+        v_new = (attn_in @ layer["v_proj"]["kernel"]).reshape(
+            s, k1, kvh, hd)
+        q = _rope(q, positions, c.rope_theta)
+        k_new = _rope(k_new, positions, c.rope_theta)
+        k_l, ks_l = _kv_write_tokens(k_l, ks_l, k_new, pos, valid, spec)
+        v_l, vs_l = _kv_write_tokens(v_l, vs_l, v_new, pos, valid, spec)
+        attn = _verify_attention(q, k_l, ks_l, v_l, vs_l, pos, spec, c)
+        x_mid = x_in + attn @ layer["o_proj"]["kernel"]
+        ffn_in = _rms_norm(x_mid, layer["post_norm"]["scale"], c.rms_eps)
+        gate = jax.nn.silu(ffn_in @ layer["gate_proj"]["kernel"])
+        up = ffn_in @ layer["up_proj"]["kernel"]
+        ffn = (gate * up) @ layer["down_proj"]["kernel"]
+        return x_mid + ffn, (k_l, ks_l, v_l, vs_l)
+
+    k, ks, v, vs = _cache_xs(cache)
+    xs = (params["layers"], k, ks, v, vs)
+    x, (k, ks, v, vs) = lax.scan(block, x, xs)
+    x = _rms_norm(x, params["norm"]["scale"], c.rms_eps)
+    logits = (x @ params["lm_head"]["kernel"].astype(c.compute_dtype))
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
+    if k1 > 1:
+        match = ((tokens[:, 1:] == greedy[:, :-1])
+                 & (jnp.arange(1, k1)[None, :] <= n_draft[:, None]))
+        accepted = jnp.cumprod(
+            match.astype(jnp.int32), axis=1).sum(axis=1)
+    else:
+        accepted = jnp.zeros((s,), jnp.int32)
+    accepted = jnp.where(active, accepted, 0).astype(jnp.int32)
+    # rank-2 take_along_axis: a benign table gather, not a pool gather
+    next_tokens = jnp.take_along_axis(
+        greedy, accepted[:, None], axis=1)[:, 0].astype(jnp.int32)
+    length = jnp.where(active, pos + accepted + 1, pos).astype(jnp.int32)
+    return greedy, accepted, next_tokens, _rebuild_cache(
+        cache, k, ks, v, vs, length)
 
 
 def prefill_chunk(params, cache, tokens, slot, start, n_valid,

@@ -1002,6 +1002,8 @@ def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
                     prefix_pool_pages: int = 0,
                     page_size: int = 16,
                     prefix_hit_rate: float = 0.0,
+                    spec_draft_len: int = 0,
+                    spec_accept_rate: float = -1.0,
                     device: Optional[DeviceSpec] = None) -> Dict:
     """Price one serving config: predicted decode-step seconds and
     tokens/second, with the breakdown the decision trail shows.
@@ -1021,6 +1023,15 @@ def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
                      pool discounts it by the expected hit rate
                      (matched tokens are page COPIES, priced as one
                      dispatch per page instead of a chunk prefill).
+      speculative decode (``spec_draft_len`` K > 0): a verify step
+                     emits 1 + rate*K expected tokens but computes
+                     K+1 positions — the FLOPs term scales by K+1
+                     while the memory terms stay per-step, so the
+                     trade is real, not assumed. Priced ONLY from an
+                     observed ``spec_accept_rate`` in [0, 1]: with no
+                     evidence (rate < 0) the estimate is EXACTLY the
+                     K=0 estimate — 1.0x, no speculative speedup
+                     assumed (the prefix-discount discipline).
 
     Returns {"step_s", "tokens_per_s", "cache_bytes",
     "cache_bytes_per_device", "breakdown"}. ``tokens_per_s`` is
@@ -1071,11 +1082,33 @@ def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
                              + copy_s_per_req)
     avg_new = max(1.0, max_seq / 4.0)
     prefill_amort_s = prefill_s_per_req / avg_new / slots
-    step_s = max(kv_read_s + weight_read_s + prefill_amort_s,
-                 flops_s, dispatch_s)
+    # speculative decode: evidence-gated. k stays 0 unless BOTH the
+    # knob is on and an acceptance rate was observed, so the no-spec /
+    # no-evidence estimate below is byte-identical to today's — the
+    # "zero evidence prices at exactly 1.0x" contract the optimizer
+    # and its tests pin.
+    k = max(0, int(spec_draft_len))
+    rate = float(spec_accept_rate)
+    if k > 0 and 0.0 <= rate <= 1.0:
+        # expected emitted tokens per verify step (greedy acceptance
+        # of an i.i.d.-approximated draft stream: 1 + rate*K is the
+        # linear lower bound of the geometric sum — conservative)
+        expected_tokens = 1.0 + rate * k
+        # the verify step runs K+1 positions: FLOPs scale, the KV and
+        # weight reads stay one pass per step (slot-major pool reads
+        # the same pages; weights are read once per step regardless)
+        spec_flops_s = flops_s * (k + 1)
+        step_s = max(kv_read_s + weight_read_s + prefill_amort_s,
+                     spec_flops_s, dispatch_s)
+        tokens_per_s = slots * expected_tokens / step_s
+    else:
+        expected_tokens = 1.0
+        step_s = max(kv_read_s + weight_read_s + prefill_amort_s,
+                     flops_s, dispatch_s)
+        tokens_per_s = slots / step_s
     return {
         "step_s": step_s,
-        "tokens_per_s": slots / step_s,
+        "tokens_per_s": tokens_per_s,
         "cache_bytes": cache_bytes,
         "cache_bytes_per_device": cache_bytes / n + pool_bytes,
         "breakdown": {
@@ -1086,6 +1119,10 @@ def estimate_decode(m: ModelSpec, num_devices: int, serve_slots: int,
             "prefill_amort_s": prefill_amort_s,
             "prefix_pool_bytes": pool_bytes,
             "prefix_hit_rate": hit_rate,
+            "spec_draft_len": k,
+            "spec_accept_rate": (rate if 0.0 <= rate <= 1.0
+                                 else -1.0),
+            "spec_expected_tokens_per_step": expected_tokens,
             # channel-resolved, exactly as the terms above priced it —
             # the decision trail must show the number that was USED
             "kv_bytes_per_elem": kv_bytes_per_elem(

@@ -38,6 +38,10 @@ def _serve_main(argv: List[str]) -> int:
                    help="shared prefix-cache pool width in pages "
                         "(0 disables; default: "
                         "serve_prefix_pool_pages knob)")
+    p.add_argument("--spec_draft_len", type=int, default=None,
+                   help="speculative-decode draft length K "
+                        "(0 disables; default: "
+                        "serve_spec_draft_len knob)")
     p.add_argument("--seed", type=int, default=0,
                    help="weight init seed of the demo model")
     args = p.parse_args(argv)
@@ -58,6 +62,7 @@ def _serve_main(argv: List[str]) -> int:
         serve_slots=args.slots, prefill_chunk=args.prefill_chunk,
         kv_precision=args.kv_precision, max_seq=args.max_seq,
         prefix_pool_pages=args.prefix_pool_pages,
+        spec_draft_len=args.spec_draft_len,
     )
     engine.prepare(params)
     client = MasterClient(args.addr, node_id=args.node_id)
@@ -118,6 +123,29 @@ def _forensic_report(events_path: str) -> dict:
                 int(r.get("pages", 0) or 0) for r in records
                 if r.get("kind") == EventKind.SERVE_PREFIX_EVICTED),
         },
+        # the speculative-decode columns ride the router's accepted
+        # COMPLETED edges (worker DONE twins would double-count), so
+        # the sums here must equal the live spec_summary()'s totals
+        # and wasted stays derived, never separately accumulated
+        "spec": _spec_forensic(records),
+    }
+
+
+def _spec_forensic(records) -> dict:
+    from dlrover_tpu.telemetry.names import EventKind
+
+    drafted = accepted = 0
+    for r in records:
+        if r.get("kind") != EventKind.SERVE_REQUEST_COMPLETED:
+            continue
+        drafted += int(r.get("spec_drafted") or 0)
+        accepted += int(r.get("spec_accepted") or 0)
+    return {
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
+        "wasted_tokens": drafted - accepted,
+        "accept_rate": (round(accepted / drafted, 4)
+                        if drafted else -1.0),
     }
 
 

@@ -39,6 +39,7 @@ from dlrover_tpu.serving.kv_cache import (
     serve_shardings,
 )
 from dlrover_tpu.serving.prefix_index import PrefixIndex
+from dlrover_tpu.serving.spec_decode import NgramProposer
 from dlrover_tpu.telemetry import (
     EventKind,
     SpanName,
@@ -71,11 +72,17 @@ class ServeProgram:
     # traced scalars, so an H-page hit is H calls, zero recompiles
     admit_copy: Optional[Callable] = None
     publish_copy: Optional[Callable] = None
+    # speculative decode: the batched K-position verify program and
+    # the draft length K it was compiled for (None/0 = spec off). K is
+    # STATIC per program — mixed per-slot draft lengths ride the
+    # n_draft valid mask, so steady state never recompiles.
+    verify: Optional[Callable] = None
+    spec_k: int = 0
 
     def compiled_cache_size(self) -> int:
         total = 0
         for fn in (self.decode, self.prefill, self.admit_copy,
-                   self.publish_copy):
+                   self.publish_copy, self.verify):
             if fn is None:
                 continue
             inner = getattr(fn, "__wrapped__", fn)
@@ -121,6 +128,7 @@ class ServeEngine:
                  kv_precision: Optional[str] = None,
                  max_seq: int = 0, page_size: int = 16,
                  prefix_pool_pages: Optional[int] = None,
+                 spec_draft_len: Optional[int] = None,
                  devices=None):
         from dlrover_tpu.parallel.strategy import Strategy
         from dlrover_tpu.serving.kv_cache import resolve_kv_precision
@@ -141,6 +149,15 @@ class ServeEngine:
                               32)), self._pool_depth)
         self.prefix_pool_pages = max(0, int(_resolve_knob(
             prefix_pool_pages, "serve_prefix_pool_pages", 0)))
+        # serve_spec_enabled is the master switch: when off, the draft
+        # length is pinned to 0 no matter what the knob/optimizer says
+        # (the optimizer also refuses to enumerate K under the same
+        # gate, but the engine enforces it locally)
+        self.spec_enabled = bool(_resolve_knob(
+            None, "serve_spec_enabled", True))
+        self.spec_draft_len = (max(0, int(_resolve_knob(
+            spec_draft_len, "serve_spec_draft_len", 0)))
+            if self.spec_enabled else 0)
         self._devices = list(devices) if devices is not None else None
         self._initial_devices: Optional[int] = None
         self._programs: "collections.OrderedDict[str, ServeProgram]" = (
@@ -180,6 +197,7 @@ class ServeEngine:
             + f"|mesh={mesh_axes_key(strategy.mesh)}"
             + f"|kvp={self.kv_precision}"
             + f"|ppp={self.prefix_pool_pages}"
+            + f"|spec={self.spec_draft_len}"
         )
 
     def _build(self, devices: Optional[list]) -> ServeProgram:
@@ -212,6 +230,7 @@ class ServeEngine:
 
     def _compile(self, devices: list, strategy) -> ServeProgram:
         import jax
+        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
 
         from dlrover_tpu.models import llama
@@ -231,8 +250,15 @@ class ServeEngine:
                                      config, spec)
 
         def prefill_fn(params, cache, tokens, slot, start, n_valid):
-            return llama.prefill_chunk(params, cache, tokens, slot,
-                                       start, n_valid, config, spec)
+            cache, last_logits = llama.prefill_chunk(
+                params, cache, tokens, slot, start, n_valid, config,
+                spec)
+            # the final chunk's first generated token comes out ON
+            # DEVICE: the executor folds it straight into the decode
+            # batch, so admission never pays a blocking host argmax
+            # sync over the vocab-sized logits
+            first = jnp.argmax(last_logits).astype(jnp.int32)
+            return cache, last_logits, first
 
         decode = jax.jit(
             decode_fn,
@@ -246,9 +272,27 @@ class ServeEngine:
             in_shardings=(shardings["params"], shardings["cache"],
                           replicated, replicated, replicated,
                           replicated),
-            out_shardings=(shardings["cache"], replicated),
+            out_shardings=(shardings["cache"], replicated,
+                           replicated),
             donate_argnums=(1,),
         )
+        verify = None
+        spec_k = int(self.spec_draft_len)
+        if spec_k > 0:
+            def verify_fn(params, cache, tokens, active, n_draft):
+                return llama.verify_step(params, cache, tokens,
+                                         active, n_draft, config,
+                                         spec)
+
+            verify = jax.jit(
+                verify_fn,
+                in_shardings=(shardings["params"],
+                              shardings["cache"], replicated,
+                              replicated, replicated),
+                out_shardings=(replicated, replicated, replicated,
+                               shardings["cache"]),
+                donate_argnums=(1,),
+            )
         admit_copy = publish_copy = None
         if spec.prefix_pool_pages > 0:
             def admit_fn(cache, pool, slot, dst_start, src_page):
@@ -275,8 +319,8 @@ class ServeEngine:
             )
         logger.info(
             "serve program compiled: %d devices, slots=%d chunk=%d "
-            "kv=%s mesh=%s", len(devices), spec.num_slots,
-            self.prefill_chunk, spec.precision,
+            "kv=%s spec_k=%d mesh=%s", len(devices), spec.num_slots,
+            self.prefill_chunk, spec.precision, spec_k,
             dict(zip(mesh.axis_names, mesh.devices.shape)),
         )
         return ServeProgram(
@@ -284,6 +328,7 @@ class ServeEngine:
             shardings=shardings, spec=spec, config=config,
             strategy=strategy, prefill_chunk=self.prefill_chunk,
             admit_copy=admit_copy, publish_copy=publish_copy,
+            verify=verify, spec_k=spec_k,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -404,6 +449,7 @@ class ServeEngine:
     def prewarm(self, devices=None, serve_slots: Optional[int] = None,
                 prefill_chunk: Optional[int] = None,
                 prefix_pool_pages: Optional[int] = None,
+                spec_draft_len: Optional[int] = None,
                 execute: bool = True) -> bool:
         """Standby-compile the program for a topology or knob set we
         may swap to, executing one dummy decode step AND one dummy
@@ -416,6 +462,7 @@ class ServeEngine:
 
         prev_slots, prev_chunk = self.serve_slots, self.prefill_chunk
         prev_ppp = self.prefix_pool_pages
+        prev_spec_k = self.spec_draft_len
         if serve_slots is not None:
             self.serve_slots = max(1, int(serve_slots))
         if prefill_chunk is not None:
@@ -423,6 +470,9 @@ class ServeEngine:
                 int(prefill_chunk), self._pool_depth)
         if prefix_pool_pages is not None:
             self.prefix_pool_pages = max(0, int(prefix_pool_pages))
+        if spec_draft_len is not None:
+            self.spec_draft_len = (max(0, int(spec_draft_len))
+                                   if self.spec_enabled else 0)
         try:
             before = self.compile_count
             program = self._build(
@@ -440,9 +490,15 @@ class ServeEngine:
                 _nt, _lg, cache = program.decode(
                     params, cache, tokens, active)
                 chunk = jnp.zeros((program.prefill_chunk,), jnp.int32)
-                cache, _ll = program.prefill(
+                cache, _ll, _ft = program.prefill(
                     params, cache, chunk, jnp.int32(0), jnp.int32(0),
                     jnp.int32(1))
+                if program.verify is not None:
+                    draft = jnp.zeros((s, program.spec_k + 1),
+                                      jnp.int32)
+                    n_draft = jnp.zeros((s,), jnp.int32)
+                    _g, _a, _nt, cache = program.verify(
+                        params, cache, draft, active, n_draft)
                 if program.admit_copy is not None:
                     pool = jax.device_put(
                         _host_zero_pool(program.spec),
@@ -462,6 +518,7 @@ class ServeEngine:
             self.serve_slots = prev_slots
             self.prefill_chunk = prev_chunk
             self.prefix_pool_pages = prev_ppp
+            self.spec_draft_len = prev_spec_k
         return compiled
 
     def snapshot(self):
@@ -543,6 +600,7 @@ class ServeEngine:
     def retune(self, serve_slots: Optional[int] = None,
                prefill_chunk: Optional[int] = None,
                prefix_pool_pages: Optional[int] = None,
+               spec_draft_len: Optional[int] = None,
                slot_map: Optional[Dict[int, int]] = None) -> int:
         """Apply optimizer-chosen serve knobs on the current world
         through the program cache (drain first — the caller owns the
@@ -563,6 +621,7 @@ class ServeEngine:
 
         prev_slots, prev_chunk = self.serve_slots, self.prefill_chunk
         prev_ppp = self.prefix_pool_pages
+        prev_spec_k = self.spec_draft_len
         prev_program = self.program
         old_spec = self.program.spec if self.program else None
         try:
@@ -573,6 +632,13 @@ class ServeEngine:
                     int(prefill_chunk), self._pool_depth)
             if prefix_pool_pages is not None:
                 self.prefix_pool_pages = max(0, int(prefix_pool_pages))
+            if spec_draft_len is not None:
+                # a K-only retune is the cheapest knob in the family:
+                # K lives in the PROGRAM (tokens shape), not the
+                # KVCacheSpec, so the pure-swap fast path below
+                # applies — live params and pages stay put
+                self.spec_draft_len = (max(0, int(spec_draft_len))
+                                       if self.spec_enabled else 0)
             compiles_before = self.compile_count
             new_program = self._build(self._devices)
             chunk_changed = (prev_program is not None
@@ -611,6 +677,7 @@ class ServeEngine:
             self.serve_slots = prev_slots
             self.prefill_chunk = prev_chunk
             self.prefix_pool_pages = prev_ppp
+            self.spec_draft_len = prev_spec_k
             # the ACTIVE program too, not just the knobs: _build may
             # have swapped it before the device_put failed (OOM on a
             # wider pool) — leaving the new-spec program over the
@@ -768,12 +835,24 @@ class ServeRequestState:
     # — held admit -> completion, released idempotently
     prefix_hit_tokens: int = 0
     prefix_handle: Any = None
+    # speculative decode: the per-request draft proposer (host-only
+    # suffix index — it moves with the state object across slot
+    # remaps) and the request's drafted/accepted ledger columns.
+    # drafted - accepted = wasted by construction, checked end to end.
+    draft_state: Any = None
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
 
 @dataclass
 class _InflightDecode:
     tokens: Any                       # device [S] next-token array
     owners: Dict[int, str]            # slot -> request_id at dispatch
+    # slots whose FIRST token (the on-device prefill argmax) rides
+    # this entry: materialization appends it, stamps TTFT and runs
+    # finish detection — the host sync admission used to pay moved
+    # behind the window
+    firsts: Optional[Dict[int, str]] = None
 
 
 class ServeExecutor:
@@ -798,7 +877,8 @@ class ServeExecutor:
                  serve_window: Optional[int] = None,
                  eos_id: int = -1, max_new_default: int = 16,
                  plan_poll_secs: Optional[float] = None,
-                 registry=None, report_hook=None):
+                 registry=None, report_hook=None,
+                 spec_proposer: Optional[Callable] = None):
         from dlrover_tpu.common.config import get_context
 
         ctx = get_context()
@@ -829,6 +909,14 @@ class ServeExecutor:
         self.completed: List[Dict[str, Any]] = []
         self.decode_steps = 0
         self._local_id_seq = 0
+        # speculative decode: a factory producing one proposer PER
+        # REQUEST (tests inject deterministic 0%/100%/alternating
+        # proposers through it; default is the n-gram prompt-lookup
+        # index). Worker-lifetime drafted/accepted totals feed the
+        # acceptance-rate gauge and the config report's observed rate.
+        self._spec_proposer_factory = spec_proposer
+        self._spec_drafted_total = 0
+        self._spec_accepted_total = 0
         self._serve_seq = next(_serve_seq)
         # slot-time ledger: every slot-second of the serve loop is
         # charged to exactly ONE class (decode / prefill /
@@ -887,6 +975,26 @@ class ServeExecutor:
         self._g_pool_bytes = reg.gauge(
             tm.SERVE_PREFIX_POOL_BYTES,
             help="prefix-pool device residency (the HBM-gate charge)")
+        # speculative-decode ledger counters (flat at zero while K=0):
+        # drafted = accepted + wasted at every grain — per request,
+        # per worker, per router job
+        self._c_spec_steps = reg.counter(
+            tm.SERVE_SPEC_VERIFY_STEPS,
+            help="batched multi-token verify steps dispatched")
+        self._c_spec_drafted = reg.counter(
+            tm.SERVE_SPEC_DRAFTED,
+            help="draft tokens proposed into verify steps")
+        self._c_spec_accepted = reg.counter(
+            tm.SERVE_SPEC_ACCEPTED,
+            help="draft tokens accepted (matched the greedy argmax)")
+        self._c_spec_wasted = reg.counter(
+            tm.SERVE_SPEC_WASTED,
+            help="draft tokens rejected by verify (computed, unused)")
+        self._g_spec_rate = reg.gauge(
+            tm.SERVE_SPEC_ACCEPT_RATE,
+            help="accepted/drafted over this worker's lifetime "
+                 "(-1 until the first draft)")
+        self._g_spec_rate.set(-1.0)
         # SLO-plane node reporting: serve workers ride the SAME
         # NodeRuntimeReport path training workers do, so the master's
         # /metrics carries {node=} serving gauges and the straggler
@@ -939,11 +1047,13 @@ class ServeExecutor:
     def request_retune(self, serve_slots: Optional[int] = None,
                        prefill_chunk: Optional[int] = None,
                        prefix_pool_pages: Optional[int] = None,
+                       spec_draft_len: Optional[int] = None,
                        plan_id: str = "", prewarm: bool = False):
         self._retune_request = {
             "serve_slots": serve_slots,
             "prefill_chunk": prefill_chunk,
             "prefix_pool_pages": prefix_pool_pages,
+            "spec_draft_len": spec_draft_len,
             "plan_id": plan_id,
             "prewarm": bool(prewarm),
         }
@@ -1060,11 +1170,11 @@ class ServeExecutor:
     def _prefill_tick(self):
         """Dispatch at most ONE chunk per admitting slot, so prefill
         interleaves with the decode stream instead of stalling it."""
-        import jax
         import jax.numpy as jnp
 
         program = self._engine.program
         c = program.prefill_chunk
+        firsts: Dict[int, str] = {}
         for slot, state in enumerate(self._slots):
             if state is None or state.cursor >= len(state.prompt) \
                     or self._active_host[slot]:
@@ -1074,10 +1184,11 @@ class ServeExecutor:
             padded = np.zeros((c,), np.int32)
             padded[:n_valid] = chunk
             with span(SpanName.SERVE_PREFILL, slot=slot):
-                self._engine.cache, last_logits = program.prefill(
-                    self._engine.params, self._engine.cache,
-                    jnp.asarray(padded), jnp.int32(slot),
-                    jnp.int32(state.cursor), jnp.int32(n_valid))
+                self._engine.cache, _last_logits, first_tok = (
+                    program.prefill(
+                        self._engine.params, self._engine.cache,
+                        jnp.asarray(padded), jnp.int32(slot),
+                        jnp.int32(state.cursor), jnp.int32(n_valid)))
             self._c_prefill.inc()
             state.cursor += n_valid
             emit_event(
@@ -1101,27 +1212,19 @@ class ServeExecutor:
                         request_id=state.request_id,
                         pages=evicted,
                     )
-                # final chunk: its last logits seed the first token —
-                # the one host sync admission pays (TTFT is measured
-                # here, which is exactly what it means)
-                first = int(np.argmax(jax.device_get(last_logits)))
-                state.t_first_token = time.monotonic()
-                self._h_prefill_e2e.observe(
-                    state.t_first_token - state.t_admit)
-                emit_event(
-                    EventKind.SERVE_FIRST_TOKEN,
-                    trace_id=state.trace_id,
-                    request_id=state.request_id, slot=slot,
-                    ttft_s=round(state.t_first_token - state.t_admit,
-                                 6),
-                )
-                state.generated.append(first)
-                self._tokens = self._tokens.at[slot].set(first)
-                if self._finished(state):
-                    self._retire(slot)
-                    continue
+                # final chunk: the first token stays ON DEVICE — it
+                # lands in the slot's decode-batch row and a firsts
+                # window entry carries its identity, so admission no
+                # longer blocks on a host argmax sync. TTFT/finish
+                # detection happen at materialization (the same lag
+                # eos detection already has in the decode stream).
+                self._tokens = self._tokens.at[slot].set(first_tok)
+                firsts[slot] = state.request_id
                 self._active_host[slot] = True
                 self._active = jnp.asarray(self._active_host)
+        if firsts:
+            self._window.append(_InflightDecode(
+                tokens=self._tokens, owners={}, firsts=firsts))
 
     def _finished(self, state: ServeRequestState) -> bool:
         if len(state.generated) >= state.max_new_tokens:
@@ -1144,6 +1247,8 @@ class ServeExecutor:
             "e2e_s": round(now - state.t_admit, 6),
             "error_code": error_code,
             "prefix_hit_tokens": int(state.prefix_hit_tokens),
+            "spec_drafted_tokens": int(state.spec_drafted_tokens),
+            "spec_accepted_tokens": int(state.spec_accepted_tokens),
         }
         emit_event(
             EventKind.SERVE_REQUEST_DONE,
@@ -1244,6 +1349,27 @@ class ServeExecutor:
 
         entry = self._window.popleft()
         host = np.asarray(jax.device_get(entry.tokens))
+        for slot, rid in (entry.firsts or {}).items():
+            state = self._slots[slot]
+            if state is None or state.request_id != rid:
+                continue
+            state.generated.append(int(host[slot]))
+            # TTFT means "first token host-visible": stamped here,
+            # where a client could first read it, not at dispatch
+            state.t_first_token = time.monotonic()
+            self._h_prefill_e2e.observe(
+                state.t_first_token - state.t_admit)
+            emit_event(
+                EventKind.SERVE_FIRST_TOKEN,
+                trace_id=state.trace_id,
+                request_id=state.request_id, slot=slot,
+                ttft_s=round(state.t_first_token - state.t_admit, 6),
+            )
+            if self._finished(state):
+                # later entries' tokens for this slot fail the owner
+                # guard once retired — the decode step that ran past
+                # a one-token request is discarded, never emitted
+                self._retire(slot)
         for slot, rid in entry.owners.items():
             state = self._slots[slot]
             if state is None or state.request_id != rid:
@@ -1257,6 +1383,109 @@ class ServeExecutor:
     def _drain_window(self):
         while self._window:
             self._materialize_oldest()
+
+    # -- speculative decode (n-gram draft + batched verify) ------------------
+
+    def _spec_step(self):
+        """ONE verify step for the whole batch: propose up to K draft
+        tokens per active slot from its own history (host n-gram
+        index), run the compiled ``verify_step`` over K+1 positions,
+        then commit the accepted prefix — emitted text is bitwise the
+        plain-greedy stream at every acceptance pattern, and the one
+        host sync this loop pays per step is amortized over up to K+1
+        tokens (the window is firsts-only in spec mode; the caller
+        drained it, so host history is current when proposing)."""
+        import jax
+        import jax.numpy as jnp
+
+        program = self._engine.program
+        k = program.spec_k
+        s = program.spec.num_slots
+        tokens_h = np.zeros((s, k + 1), np.int32)
+        n_draft_h = np.zeros((s,), np.int32)
+        owners: Dict[int, str] = {}
+        for slot, state in enumerate(self._slots):
+            if state is None or not self._active_host[slot]:
+                continue
+            owners[slot] = state.request_id
+            tokens_h[slot, 0] = state.generated[-1]
+            # the verify step emits up to n+1 tokens: cap the draft so
+            # the commit can never run past max_new_tokens (eos inside
+            # the accepted prefix truncates host-side below)
+            budget = min(k, state.max_new_tokens
+                         - len(state.generated) - 1)
+            if budget <= 0:
+                continue
+            if state.draft_state is None:
+                factory = self._spec_proposer_factory
+                state.draft_state = (factory() if factory is not None
+                                     else NgramProposer())
+            draft = state.draft_state.propose(
+                state.prompt + state.generated, budget)[:budget]
+            if draft:
+                n = len(draft)
+                tokens_h[slot, 1:1 + n] = draft
+                n_draft_h[slot] = n
+        try:
+            with span(SpanName.SERVE_DECODE, step=self.decode_steps):
+                greedy_d, accepted_d, next_d, self._engine.cache = (
+                    program.verify(
+                        self._engine.params, self._engine.cache,
+                        jnp.asarray(tokens_h), self._active,
+                        jnp.asarray(n_draft_h)))
+        except Exception:  # noqa: BLE001 — a failed verify step must
+            # not kill serving OR charge the ledger: nothing was
+            # committed (the raise happens before buffers are donated
+            # to a successfully launched program), so the draft credit
+            # is restored by simply not counting it, and the batch
+            # falls back to ONE plain decode step — bitwise the same
+            # stream, minus the speculation
+            logger.warning("verify step failed; falling back to a "
+                           "plain decode step", exc_info=True)
+            next_tokens, _lg, self._engine.cache = (
+                self._engine.program.decode(
+                    self._engine.params, self._engine.cache,
+                    self._tokens, self._active))
+            self._tokens = next_tokens
+            host = np.asarray(jax.device_get(next_tokens))
+            for slot, rid in owners.items():
+                state = self._slots[slot]
+                if state is None or state.request_id != rid:
+                    continue
+                state.generated.append(int(host[slot]))
+                if self._finished(state):
+                    self._retire(slot)
+            return
+        self._tokens = next_d
+        greedy_h, accepted_h = jax.device_get((greedy_d, accepted_d))
+        greedy_h = np.asarray(greedy_h)
+        accepted_h = np.asarray(accepted_h)
+        self._c_spec_steps.inc()
+        for slot, rid in owners.items():
+            state = self._slots[slot]
+            if state is None or state.request_id != rid:
+                continue
+            drafted = int(n_draft_h[slot])
+            accepted = min(int(accepted_h[slot]), drafted)
+            state.spec_drafted_tokens += drafted
+            state.spec_accepted_tokens += accepted
+            self._spec_drafted_total += drafted
+            self._spec_accepted_total += accepted
+            if drafted:
+                self._c_spec_drafted.inc(drafted)
+                self._c_spec_accepted.inc(accepted)
+                self._c_spec_wasted.inc(drafted - accepted)
+            # commit greedy[0..accepted] — exactly what plain greedy
+            # would emit next — truncating at eos/max_new exactly
+            # where the serial stream would have stopped
+            for i in range(accepted + 1):
+                state.generated.append(int(greedy_h[slot, i]))
+                if self._finished(state):
+                    self._retire(slot)
+                    break
+        if self._spec_drafted_total:
+            self._g_spec_rate.set(self._spec_accepted_total
+                                  / self._spec_drafted_total)
 
     def _apply_resize(self):
         self._resize_requested = False
@@ -1290,6 +1519,7 @@ class ServeExecutor:
         new_slots = req.get("serve_slots")
         new_chunk = req.get("prefill_chunk")
         new_ppp = req.get("prefix_pool_pages")
+        new_spec_k = req.get("spec_draft_len")
         plan_id = req.get("plan_id", "")
         if new_chunk is not None:
             fitted = _fit_prefill_chunk(int(new_chunk),
@@ -1346,7 +1576,8 @@ class ServeExecutor:
             try:
                 self._engine.prewarm(serve_slots=new_slots,
                                      prefill_chunk=new_chunk,
-                                     prefix_pool_pages=new_ppp)
+                                     prefix_pool_pages=new_ppp,
+                                     spec_draft_len=new_spec_k)
             except Exception:  # noqa: BLE001 — prewarm is an
                 # optimization; the retune still decides the outcome
                 logger.warning("serve prewarm failed", exc_info=True)
@@ -1355,6 +1586,7 @@ class ServeExecutor:
                 serve_slots=new_slots,
                 prefill_chunk=req.get("prefill_chunk"),
                 prefix_pool_pages=new_ppp,
+                spec_draft_len=new_spec_k,
                 slot_map=slot_map)
         except Exception:  # noqa: BLE001 — a bad plan must not kill
             # serving; the engine restored the previous knobs
@@ -1412,6 +1644,13 @@ class ServeExecutor:
                 prefix_pool_pages=int(program.spec.prefix_pool_pages),
                 page_size=int(program.spec.page_size),
                 prefix_hit_rate=float(hit_rate),
+                spec_draft_len=int(program.spec_k),
+                # -1 = "no draft observed yet": the optimizer prices
+                # K>0 only from EVIDENCE (zero evidence = exactly 1.0x,
+                # the prefix-discount discipline)
+                spec_accept_rate=float(
+                    self._spec_accepted_total / self._spec_drafted_total
+                    if self._spec_drafted_total else -1.0),
                 plan_id=plan_id, apply_failed=bool(apply_failed),
             )
         except Exception:  # noqa: BLE001 — a dead master must not
@@ -1435,17 +1674,20 @@ class ServeExecutor:
         plan_id = getattr(cfg, "plan_id", "") or ""
         slots = int(getattr(cfg, "serve_slots", 0) or 0)
         chunk = int(getattr(cfg, "serve_prefill_chunk", 0) or 0)
-        # the pool knob's leave-unchanged sentinel is -1 (0 is a real
-        # value: pool off), unlike its 0-sentinel siblings
+        # the pool and draft-length knobs' leave-unchanged sentinel is
+        # -1 (0 is a real value: pool/spec off), unlike their
+        # 0-sentinel siblings
         ppp = int(getattr(cfg, "serve_prefix_pool_pages", -1))
+        sk = int(getattr(cfg, "serve_spec_draft_len", -1))
         if not plan_id or plan_id == self._seen_plan \
-                or not (slots or chunk or ppp >= 0):
+                or not (slots or chunk or ppp >= 0 or sk >= 0):
             return
         self._seen_plan = plan_id
         self.request_retune(serve_slots=slots or None,
                             prefill_chunk=chunk or None,
                             prefix_pool_pages=(ppp if ppp >= 0
                                                else None),
+                            spec_draft_len=(sk if sk >= 0 else None),
                             plan_id=plan_id,
                             prewarm=bool(getattr(cfg, "prewarm", True)))
 
@@ -1474,6 +1716,7 @@ class ServeExecutor:
                    slots=self._engine.program.spec.num_slots,
                    prefill_chunk=self._engine.program.prefill_chunk,
                    kv_precision=self._engine.program.spec.precision,
+                   spec_draft_len=self._engine.program.spec_k,
                    serve_seq=self._serve_seq)
         steps = 0
         idle_polls = 0
@@ -1526,23 +1769,34 @@ class ServeExecutor:
                 continue
             idle_polls = 0
             t0 = time.monotonic()
-            owners = {
-                i: r.request_id for i, r in enumerate(self._slots)
-                if r is not None and self._active_host[i]
-            }
-            with span(SpanName.SERVE_DECODE, step=self.decode_steps):
-                next_tokens, _logits, self._engine.cache = (
-                    self._engine.program.decode(
-                        self._engine.params, self._engine.cache,
-                        self._tokens, self._active))
-            self._tokens = next_tokens
+            if self._engine.program.verify is not None:
+                # spec mode is SERIAL: the proposer needs current host
+                # history before drafting, so the window (firsts-only
+                # here) drains first and the verify step's host sync
+                # is the price — amortized over up to K+1 tokens/slot
+                self._drain_window()
+                if not any(self._active_host):
+                    continue  # the drain retired the last active slot
+                self._spec_step()
+            else:
+                owners = {
+                    i: r.request_id for i, r in enumerate(self._slots)
+                    if r is not None and self._active_host[i]
+                }
+                with span(SpanName.SERVE_DECODE,
+                          step=self.decode_steps):
+                    next_tokens, _logits, self._engine.cache = (
+                        self._engine.program.decode(
+                            self._engine.params, self._engine.cache,
+                            self._tokens, self._active))
+                self._tokens = next_tokens
+                self._window.append(
+                    _InflightDecode(tokens=next_tokens, owners=owners))
+                while len(self._window) > self._window_cap:
+                    self._materialize_oldest()
             self._c_decode.inc()
             self.decode_steps += 1
             steps += 1
-            self._window.append(
-                _InflightDecode(tokens=next_tokens, owners=owners))
-            while len(self._window) > self._window_cap:
-                self._materialize_oldest()
             self._h_step.observe(time.monotonic() - t0)
             if self._report_hook is not None:
                 try:
@@ -1569,7 +1823,12 @@ class ServeExecutor:
                                 for k, v in self._ledger.items()},
                    slot_seconds=round(self._slot_seconds, 6),
                    serve_wall_s=round(self._serve_wall, 6),
-                   prefix=self._engine.prefix_stats() or None)
+                   prefix=self._engine.prefix_stats() or None,
+                   spec=({"drafted": self._spec_drafted_total,
+                          "accepted": self._spec_accepted_total,
+                          "wasted": (self._spec_drafted_total
+                                     - self._spec_accepted_total)}
+                         if self._spec_drafted_total else None))
         if self._report_hook is not None:
             try:
                 self._report_hook.flush(

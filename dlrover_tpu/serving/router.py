@@ -75,6 +75,10 @@ class ServeRequest:
     ttft_s: Optional[float] = None
     e2e_s: Optional[float] = None
     error_code: str = ""
+    # speculative-decode ledger columns, worker-reported at
+    # completion: drafted - accepted = wasted per request
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
     def wire(self) -> Dict[str, Any]:
         return {
@@ -129,6 +133,13 @@ class RequestRouter:
         self._n_prefix_hits = 0
         self._n_prefix_hit_tokens = 0
         self._n_affinity_routed = 0
+        # speculative-decode ledger (worker-reported at completion):
+        # drafted = accepted + wasted — wasted is DERIVED, never
+        # accumulated separately, so the conservation identity holds
+        # by construction at the job grain and the per-request columns
+        # must sum to it (what the conservation test pins)
+        self._n_spec_drafted = 0
+        self._n_spec_accepted = 0
         self._prefix_home: Dict[tuple, int] = {}
         self._prefix_home_cap = 4096
         self._affinity = bool(getattr(
@@ -284,7 +295,9 @@ class RequestRouter:
                  tokens: List[int], ttft_s: Optional[float] = None,
                  e2e_s: Optional[float] = None,
                  error_code: str = "",
-                 prefix_hit_tokens: int = 0) -> bool:
+                 prefix_hit_tokens: int = 0,
+                 spec_drafted_tokens: int = 0,
+                 spec_accepted_tokens: int = 0) -> bool:
         with self._lock, span(SpanName.SERVE_COMPLETE,
                               node=int(node_id)):
             self._node_touch[int(node_id)] = time.time()
@@ -314,6 +327,19 @@ class RequestRouter:
             if prefix_hit_tokens and int(prefix_hit_tokens) > 0:
                 self._n_prefix_hits += 1
                 self._n_prefix_hit_tokens += int(prefix_hit_tokens)
+            # spec columns accumulate INSIDE the done-guard, like the
+            # counters above: a re-leased twin's duplicate completion
+            # (the guard's False branch) must not double-charge the
+            # ledger, and a worker whose verify step failed reported
+            # ZERO drafted for those steps — its draft credit was
+            # restored at the source, so conservation holds here too
+            drafted = max(0, int(spec_drafted_tokens or 0))
+            accepted = min(max(0, int(spec_accepted_tokens or 0)),
+                           drafted)
+            req.spec_drafted_tokens = drafted
+            req.spec_accepted_tokens = accepted
+            self._n_spec_drafted += drafted
+            self._n_spec_accepted += accepted
             self._done_order.append(req.request_id)
             while len(self._done_order) > self._done_retention_cap:
                 if self._requests.pop(self._done_order.popleft(),
@@ -341,6 +367,8 @@ class RequestRouter:
                 ttft_s=ttft_s, e2e_s=e2e_s,
                 tpot_s=round(tpot, 6) if tpot is not None else None,
                 completed_error_code=error_code or None,
+                spec_drafted=drafted or None,
+                spec_accepted=accepted if drafted else None,
             )
             return True
 
@@ -498,6 +526,7 @@ class RequestRouter:
                 "nodes": {str(n): v
                           for n, v in sorted(per_node.items())},
                 "prefix": self._prefix_summary_locked(),
+                "spec": self._spec_summary_locked(),
             }
 
     def _prefix_summary_locked(self) -> Dict[str, Any]:
@@ -515,3 +544,22 @@ class RequestRouter:
         it next to the SLO verdicts)."""
         with self._lock:
             return self._prefix_summary_locked()
+
+    def _spec_summary_locked(self) -> Dict[str, Any]:
+        drafted = self._n_spec_drafted
+        accepted = self._n_spec_accepted
+        return {
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted,
+            # derived, so drafted = accepted + wasted by construction
+            # at the job grain; the retained per-request columns must
+            # sum to these totals (the conservation test's check)
+            "wasted_tokens": drafted - accepted,
+            "accept_rate": (round(accepted / drafted, 4)
+                            if drafted else -1.0),
+        }
+
+    def spec_summary(self) -> Dict[str, Any]:
+        """The speculative-decode ledger alone."""
+        with self._lock:
+            return self._spec_summary_locked()

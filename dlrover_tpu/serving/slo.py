@@ -64,6 +64,8 @@ class ServeRuntimeReportHook:
         self._c_decode = reg.counter(tm.SERVE_DECODE_STEPS)
         self._c_tokens = reg.counter(tm.SERVE_TOKENS)
         self._g_occupancy = reg.gauge(tm.SERVE_SLOT_OCCUPANCY)
+        self._c_spec_drafted = reg.counter(tm.SERVE_SPEC_DRAFTED)
+        self._c_spec_accepted = reg.counter(tm.SERVE_SPEC_ACCEPTED)
         self._c_sent = get_registry().counter(
             tm.NODE_RUNTIME_REPORTS,
             help="node runtime snapshots pushed to the master")
@@ -120,6 +122,14 @@ class ServeRuntimeReportHook:
             serve_queue_len=float(queue_len),
             serve_slot_occupancy=float(self._g_occupancy.value),
             serve_slots=float(slots),
+            # cumulative spec totals: the master diffs consecutive
+            # reports into a WINDOWED acceptance rate, so a regression
+            # shows up immediately instead of being averaged away by
+            # the worker's lifetime totals
+            serve_spec_drafted_total=float(
+                self._c_spec_drafted.value),
+            serve_spec_accepted_total=float(
+                self._c_spec_accepted.value),
         )
         if self._sender is None or not self._sender.is_alive():
             self._sender = threading.Thread(

@@ -300,6 +300,15 @@ SERVE_PREFIX_POOL_BYTES = "dlrover_serve_prefix_pool_bytes"
 # master-side router: requests leased to the worker whose pool already
 # holds their prefix pages (soft session affinity)
 SERVE_PREFIX_AFFINITY_ROUTED = "dlrover_serve_prefix_affinity_routed_total"
+# speculative decode (n-gram draft + batched multi-token verify):
+# drafted = accepted + wasted at every grain — the conservation the
+# router ledger checks. The accept-rate gauge is -1 until the first
+# draft (no-evidence sentinel, mirrors the prefix hit-rate prior).
+SERVE_SPEC_VERIFY_STEPS = "dlrover_serve_spec_verify_steps_total"
+SERVE_SPEC_DRAFTED = "dlrover_serve_spec_drafted_tokens_total"
+SERVE_SPEC_ACCEPTED = "dlrover_serve_spec_accepted_tokens_total"
+SERVE_SPEC_WASTED = "dlrover_serve_spec_wasted_tokens_total"
+SERVE_SPEC_ACCEPT_RATE = "dlrover_serve_spec_accept_rate"
 
 # -- serving SLO plane (dlrover_tpu/serving/slo.py + master/monitor/
 # serve_slo.py) ---------------------------------------------------------------
@@ -313,6 +322,7 @@ NODE_SERVE_SLOT_OCCUPANCY = "dlrover_node_serve_slot_occupancy"
 NODE_SERVE_QUEUE_LEN = "dlrover_node_serve_queue_len"
 NODE_SERVE_SLOTS = "dlrover_node_serve_slots"
 NODE_SERVE_STEPS_TOTAL = "dlrover_node_serve_decode_steps_total"
+NODE_SERVE_SPEC_ACCEPT_RATE = "dlrover_node_serve_spec_accept_rate"
 # master-side SLO verdict engine: violations flagged / recovered after
 # multi-window burn-rate confirmation, plus the current burn rate per
 # declared target (labeled {slo="<target>"}; burn > 1 = out of SLO)

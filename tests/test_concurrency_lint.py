@@ -647,17 +647,46 @@ class TestKVReadGather:
         assert graph_lint.check_kv_read_gather(hlo, path="<p>") == []
 
     def test_serving_programs_gather_free_at_head(self):
-        # the four compiled serving programs (decode / prefill / the
-        # two page copies) carry the invariant the slot-major pool
-        # exists for: KV reads are contiguous slices, not gathers
+        # the five compiled serving programs (decode / prefill / the
+        # speculative verify / the two page copies) carry the
+        # invariant the slot-major pool exists for: KV reads are
+        # contiguous slices, not gathers — and verify's masked
+        # multi-token append must not reintroduce one either
         from dlrover_tpu.analysis import graph_lint
 
         reports = graph_lint.serving_program_audit()
         labels = {r.label for r in reports}
         assert labels == {"serve_decode", "serve_prefill",
-                          "serve_admit_copy", "serve_publish_copy"}
+                          "serve_verify", "serve_admit_copy",
+                          "serve_publish_copy"}
         bad = [f.render() for r in reports for f in r.findings]
         assert bad == [], "\n".join(bad)
+
+    def test_committed_verify_append_fixture_is_gather_free(self):
+        # the masked multi-token KV append (speculative verify's
+        # scatter: index-redirection + mode="drop", int8 so both the
+        # payload and scale scatters are present) compiled in
+        # isolation and COMMITTED — the pin survives compiler/version
+        # drift because the artifact can't drift, and documents what
+        # "G110-clean append" looks like in optimized HLO
+        import os
+
+        from dlrover_tpu.analysis import graph_lint
+
+        path = os.path.join(os.path.dirname(__file__), "testdata",
+                            "g110_verify_append.hlo")
+        with open(path) as fh:
+            hlo = fh.read()
+        assert "scatter" in hlo  # the append really is in there
+        assert graph_lint.check_kv_read_gather(
+            hlo, path="g110_verify_append.hlo") == []
+        # sanity that the rule still has teeth against this exact
+        # module shape: splice in a rank-4 pool gather and it fires
+        poisoned = hlo + ("\n  %bad = s8[4,64,2,8] gather("
+                          "s8[4,64,2,8]{3,2,1,0} %param.0, "
+                          "s32[3]{0} %idx)\n")
+        fired = graph_lint.check_kv_read_gather(poisoned, path="<p>")
+        assert len(fired) == 1 and fired[0].rule_id == "G110"
 
 
 # -- regression pins for the races the new pass caught -----------------------

@@ -1,0 +1,661 @@
+"""Speculative decoding (ISSUE 18): n-gram self-drafting + batched
+multi-token verify.
+
+Tier-1 core: the bitwise-to-greedy oracle at every acceptance pattern
+(0%, 100%, alternating, per-slot mixed K — drafts are injected, so
+each pattern is forced, not hoped for), on f32 AND int8 pools, with
+the prefix pool on, and across a live slot resize mid-stream; the
+zero-steady-state-recompile pin (>= 32 verify steps, and across a
+live K retune applied prewarm-then-swap); the drafted = accepted +
+wasted conservation ledger from per-record counts through the router
+totals to the event forensics; the failed-verify credit restore; the
+planner's evidence-only pricing (zero evidence == exactly the K=0
+estimate); and the optimizer's K enumeration under the master switch.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel import planner
+from dlrover_tpu.parallel.mesh import MeshPlan
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.serving.engine import ServeEngine, ServeExecutor
+from dlrover_tpu.serving.router import RequestRouter
+from dlrover_tpu.serving.spec_decode import NgramProposer
+from dlrover_tpu.telemetry import EventKind, recent_events
+from dlrover_tpu.telemetry.events import clear_ring
+from dlrover_tpu.telemetry.metrics import process_registry
+from dlrover_tpu.telemetry import names as tm
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    ctx = get_context()
+    prev = ctx.telemetry_enabled
+    ctx.telemetry_enabled = True
+    yield
+    ctx.telemetry_enabled = prev
+
+
+TINY = llama.llama_tiny()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init(jax.random.PRNGKey(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def plain_engine(tiny_params):
+    eng = ServeEngine(
+        TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                rule_set="llama"),
+        serve_slots=4, prefill_chunk=8, max_seq=48, page_size=8,
+    )
+    eng.prepare(tiny_params)
+    return eng
+
+
+@pytest.fixture(scope="module")
+def spec_engine(tiny_params):
+    eng = ServeEngine(
+        TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                rule_set="llama"),
+        serve_slots=4, prefill_chunk=8, max_seq=48, page_size=8,
+        spec_draft_len=4,
+    )
+    eng.prepare(tiny_params)
+    return eng
+
+
+def _prompt(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [int(t) for t in rng.randint(0, TINY.vocab_size, size=(n,))]
+
+
+def _jobs(n=4, max_new=10, seed0=50, plen=6):
+    return [(f"r{i}", _prompt(plen, seed=seed0 + i), max_new)
+            for i in range(n)]
+
+
+def _serve(eng, jobs, proposer=None):
+    """Serve ``jobs`` ([(rid, prompt, max_new)]) on a fresh slot pool;
+    returns {rid: record}."""
+    eng.cache = eng.fresh_cache()
+    ex = ServeExecutor(eng, serve_window=1, spec_proposer=proposer)
+    for rid, prompt, max_new in jobs:
+        ex.submit(prompt, max_new_tokens=max_new, request_id=rid)
+    return {r["request_id"]: r for r in ex.serve()}, ex
+
+
+# -- injectable proposers: each forces one acceptance pattern -----------------
+
+
+class _OracleProposer:
+    """Drafts the TRUE greedy continuation (from a reference serve) —
+    forces 100% acceptance."""
+
+    def __init__(self, refs):
+        # {prompt tuple -> full reference token list}
+        self._refs = dict(refs)
+
+    def _stream(self, history):
+        for p, stream in self._refs.items():
+            if len(history) >= len(p) and tuple(history[:len(p)]) == p:
+                return stream, len(history) - len(p)
+        return None, 0
+
+    def propose(self, history, k):
+        stream, done = self._stream(history)
+        if stream is None:
+            return []
+        return list(stream[done:done + k])
+
+
+class _WrongProposer(_OracleProposer):
+    """Drafts provably-wrong tokens (true-next + 1 mod vocab) —
+    forces 0% acceptance while still paying full drafts."""
+
+    def propose(self, history, k):
+        return [(t + 1) % TINY.vocab_size
+                for t in super().propose(history, k)]
+
+
+class _AlternatingProposer(_OracleProposer):
+    """Oracle on even calls, wrong on odd — acceptance flips every
+    verify step."""
+
+    def __init__(self, refs):
+        super().__init__(refs)
+        self._n = 0
+
+    def propose(self, history, k):
+        right = super().propose(history, k)
+        self._n += 1
+        if self._n % 2:
+            return right
+        return [(t + 1) % TINY.vocab_size for t in right]
+
+
+class _MixedProposer(_OracleProposer):
+    """Per-slot mixed K in ONE program: full-K oracle drafts for some
+    prompts, shorter drafts for others, nothing for the rest."""
+
+    def __init__(self, refs, full, short):
+        super().__init__(refs)
+        self._full = {tuple(p) for p in full}
+        self._short = {tuple(p) for p in short}
+
+    def propose(self, history, k):
+        stream, done = self._stream(history)
+        if stream is None:
+            return []
+        for p in self._full:
+            if tuple(history[:len(p)]) == p:
+                return list(stream[done:done + k])
+        for p in self._short:
+            if tuple(history[:len(p)]) == p:
+                return list(stream[done:done + max(1, k // 2)])
+        return []
+
+
+# -- the host-side n-gram proposer --------------------------------------------
+
+
+class TestNgramProposer:
+    def test_longest_ngram_wins_and_self_match_falls_back(self):
+        p = NgramProposer()
+        # suffix [5,6,7] re-occurs at 0: continuation is h[3:6]
+        h = [5, 6, 7, 9, 5, 6, 7]
+        assert p.propose(h, 3) == [9, 5, 6]
+
+    def test_no_match_returns_empty_and_k0_is_empty(self):
+        p = NgramProposer()
+        assert p.propose([1, 2, 3, 4], 2) == []
+        assert p.propose([1, 2, 1], 0) == []
+
+    def test_incremental_sync_sees_new_tokens(self):
+        p = NgramProposer()
+        h = [3, 4, 5]
+        assert p.propose(h, 2) == []
+        h = h + [8, 3, 4]
+        # suffix [3,4] matched at 0 -> continuation [5,8]
+        assert p.propose(h, 2) == [5, 8]
+
+    def test_draft_never_exceeds_k(self):
+        p = NgramProposer()
+        h = [1, 2, 9, 9, 9, 1, 2]
+        got = p.propose(h, 3)
+        assert got == [9, 9, 9]
+        assert p.propose(h, 1) == [9]
+
+    def test_periodic_tail_extends_to_full_k(self):
+        # A period-d loop near the tail must draft k tokens, not d:
+        # the match at distance d is extended periodically instead of
+        # truncating where the literal continuation hits end-of-history.
+        p = NgramProposer()
+        assert p.propose([7, 7, 7, 7], 4) == [7, 7, 7, 7]
+        q = NgramProposer()
+        assert q.propose([3, 8, 3, 8, 3, 8], 5) == [3, 8, 3, 8, 3]
+
+
+# -- THE oracle: bitwise-to-greedy at every acceptance pattern ----------------
+
+
+class TestBitwiseParity:
+    def _reference(self, plain_engine, jobs):
+        got, _ = _serve(plain_engine, jobs)
+        return {rid: r["tokens"] for rid, r in got.items()}
+
+    def test_forced_acceptance_patterns_bitwise(self, plain_engine,
+                                                spec_engine):
+        jobs = _jobs(4, max_new=10)
+        expect = self._reference(plain_engine, jobs)
+        refs = {tuple(p): expect[rid] for rid, p, _ in jobs}
+        legs = {
+            "ngram": None,  # natural self-drafting
+            "all-wrong": lambda: _WrongProposer(refs),
+            "oracle": lambda: _OracleProposer(refs),
+            "alternating": lambda: _AlternatingProposer(refs),
+        }
+        for name, factory in legs.items():
+            got, _ = _serve(spec_engine, jobs, proposer=factory)
+            for rid, _, _ in jobs:
+                assert got[rid]["tokens"] == expect[rid], (name, rid)
+                d = got[rid]["spec_drafted_tokens"]
+                a = got[rid]["spec_accepted_tokens"]
+                assert 0 <= a <= d, (name, rid)
+                if name == "oracle":
+                    assert d > 0 and a == d, (rid, d, a)
+                if name == "all-wrong":
+                    assert d > 0 and a == 0, (rid, d, a)
+
+    def test_per_slot_mixed_draft_lengths_bitwise(self, plain_engine,
+                                                  spec_engine):
+        jobs = _jobs(4, max_new=10)
+        expect = self._reference(plain_engine, jobs)
+        refs = {tuple(p): expect[rid] for rid, p, _ in jobs}
+        full = [jobs[0][1]]
+        short = [jobs[1][1]]  # jobs 2,3 draft nothing -> n_draft 0
+        got, _ = _serve(
+            spec_engine, jobs,
+            proposer=lambda: _MixedProposer(refs, full, short))
+        for rid, _, _ in jobs:
+            assert got[rid]["tokens"] == expect[rid], rid
+        assert got["r0"]["spec_drafted_tokens"] \
+            > got["r1"]["spec_drafted_tokens"] > 0
+        assert got["r2"]["spec_drafted_tokens"] == 0
+        assert got["r3"]["spec_drafted_tokens"] == 0
+
+    def test_int8_pool_bitwise(self, tiny_params):
+        kw = dict(
+            strategy=Strategy(mesh=MeshPlan(data=-1),
+                              rule_set="llama"),
+            serve_slots=2, prefill_chunk=8, max_seq=48, page_size=8,
+            kv_precision="int8",
+        )
+        plain = ServeEngine(TINY, **kw)
+        plain.prepare(tiny_params)
+        spec = ServeEngine(TINY, spec_draft_len=3, **kw)
+        spec.prepare(tiny_params)
+        jobs = _jobs(3, max_new=8, seed0=90)
+        expect, _ = _serve(plain, jobs)
+        got, _ = _serve(spec, jobs)
+        for rid, _, _ in jobs:
+            assert got[rid]["tokens"] == expect[rid]["tokens"], rid
+
+    def test_prefix_pool_reuse_composes_bitwise(self, plain_engine,
+                                                tiny_params):
+        eng = ServeEngine(
+            TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                    rule_set="llama"),
+            serve_slots=4, prefill_chunk=8, max_seq=48, page_size=8,
+            prefix_pool_pages=8, spec_draft_len=4,
+        )
+        eng.prepare(tiny_params)
+        seed_prompt = _prompt(24, seed=70)
+        _serve(eng, [("seed", seed_prompt, 2)])
+        # the query reuses seeded pages AND speculates — both on
+        ref, _ = _serve(plain_engine, [("q", seed_prompt, 6)])
+        got, _ = _serve(eng, [("q", seed_prompt, 6)])
+        assert got["q"]["prefix_hit_tokens"] > 0
+        assert got["q"]["tokens"] == ref["q"]["tokens"]
+
+    def test_live_slot_resize_mid_stream_bitwise(self, plain_engine,
+                                                 spec_engine):
+        jobs = _jobs(3, max_new=12, seed0=120)
+        expect = self._reference(plain_engine, jobs)
+        spec_engine.cache = spec_engine.fresh_cache()
+        ex = ServeExecutor(spec_engine, serve_window=1)
+        for rid, prompt, max_new in jobs:
+            ex.submit(prompt, max_new_tokens=max_new, request_id=rid)
+        ex.serve(max_steps=2, until_idle=False)
+        ex.request_retune(serve_slots=8)
+        done = {r["request_id"]: r for r in ex.serve()}
+        assert spec_engine.program.spec.num_slots == 8
+        for rid, _, _ in jobs:
+            assert done[rid]["tokens"] == expect[rid], rid
+        # restore the module engine's canonical knobs
+        ex.request_retune(serve_slots=4)
+        ex._drain_window()
+        ex._apply_retune()
+        assert spec_engine.program.spec.num_slots == 4
+
+
+# -- zero steady-state recompiles ---------------------------------------------
+
+
+class TestZeroRecompile:
+    def test_32_step_pin_across_every_acceptance_pattern(
+            self, plain_engine, spec_engine):
+        jobs = [("pin", _prompt(6, seed=200), 36)]
+        expect, _ = _serve(plain_engine, jobs)
+        refs = {tuple(jobs[0][1]): expect["pin"]["tokens"]}
+        _serve(spec_engine, jobs)  # warm every program once
+        compiles = spec_engine.compile_count
+        cache_size = spec_engine.program.compiled_cache_size()
+        # all-wrong drafting = 1 token/verify-step = the most steps
+        got, ex = _serve(spec_engine, jobs,
+                         proposer=lambda: _WrongProposer(refs))
+        assert got["pin"]["tokens"] == expect["pin"]["tokens"]
+        assert ex.decode_steps >= 32
+        # and a 100%-acceptance leg reuses the same program too
+        got2, _ = _serve(spec_engine, jobs,
+                         proposer=lambda: _OracleProposer(refs))
+        assert got2["pin"]["tokens"] == expect["pin"]["tokens"]
+        assert spec_engine.compile_count == compiles
+        assert spec_engine.program.compiled_cache_size() == cache_size
+
+    def test_live_k_retune_prewarm_then_zero_compile_swap(
+            self, plain_engine, spec_engine, tiny_params):
+        jobs = _jobs(2, max_new=8, seed0=140)
+        expect = {rid: r["tokens"]
+                  for rid, r in _serve(plain_engine, jobs)[0].items()}
+        # standby compile of the K=2 program is allowed...
+        spec_engine.prewarm(spec_draft_len=2)
+        compiles = spec_engine.compile_count
+        # ...the live apply must be a pure program swap
+        recompiled = spec_engine.retune(spec_draft_len=2, slot_map={})
+        assert recompiled == 0
+        assert spec_engine.program.spec_k == 2
+        got, _ = _serve(spec_engine, jobs)
+        for rid, _, _ in jobs:
+            assert got[rid]["tokens"] == expect[rid], rid
+        assert spec_engine.compile_count == compiles
+        # restore the module engine's canonical K (cached: no compile)
+        assert spec_engine.retune(spec_draft_len=4, slot_map={}) == 0
+        assert spec_engine.program.spec_k == 4
+
+    def test_executor_retune_path_applies_k_with_negative_ack_guard(
+            self, spec_engine):
+        """The plan path: request_retune(spec_draft_len=...) applies at
+        the drained boundary through the same prewarm-protected swap."""
+        spec_engine.cache = spec_engine.fresh_cache()
+        ex = ServeExecutor(spec_engine, serve_window=1)
+        ex._ensure_prepared()
+        spec_engine.prewarm(spec_draft_len=3)
+        compiles = spec_engine.compile_count
+        ex.request_retune(spec_draft_len=3, plan_id="k3")
+        ex._apply_retune()
+        assert spec_engine.program.spec_k == 3
+        assert spec_engine.compile_count == compiles
+        ex.request_retune(spec_draft_len=4)  # restore module knobs
+        ex._apply_retune()
+        assert spec_engine.program.spec_k == 4
+
+
+# -- conservation: drafted = accepted + wasted, everywhere --------------------
+
+
+class TestSpecLedger:
+    def test_per_record_and_registry_conservation(self, spec_engine):
+        reg = process_registry()
+        d0 = reg.counter(tm.SERVE_SPEC_DRAFTED).value
+        a0 = reg.counter(tm.SERVE_SPEC_ACCEPTED).value
+        w0 = reg.counter(tm.SERVE_SPEC_WASTED).value
+        # repetitive prompts so natural n-gram drafting fires
+        jobs = [(f"p{i}", [7, 8, 9] * 4, 10) for i in range(3)]
+        got, ex = _serve(spec_engine, jobs)
+        drafted = sum(r["spec_drafted_tokens"] for r in got.values())
+        accepted = sum(r["spec_accepted_tokens"] for r in got.values())
+        assert drafted > 0
+        for r in got.values():
+            assert 0 <= r["spec_accepted_tokens"] \
+                <= r["spec_drafted_tokens"]
+        # registry counters tie out against the records exactly
+        assert reg.counter(tm.SERVE_SPEC_DRAFTED).value - d0 == drafted
+        assert reg.counter(tm.SERVE_SPEC_ACCEPTED).value - a0 \
+            == accepted
+        assert reg.counter(tm.SERVE_SPEC_WASTED).value - w0 \
+            == drafted - accepted
+        assert ex._spec_drafted_total == drafted
+        assert ex._spec_accepted_total == accepted
+
+    def test_router_totals_live_and_forensic_agree(self):
+        clear_ring()
+        r = RequestRouter(lease_timeout_secs=120.0)
+        counts = [(12, 7), (4, 0), (9, 9)]
+        rids = [r.submit([1, 2, 3], 4) for _ in counts]
+        r.lease(0, len(counts))
+        for rid, (d, a) in zip(rids, counts):
+            assert r.complete(0, rid, [5, 6], spec_drafted_tokens=d,
+                              spec_accepted_tokens=a)
+        spec = r.report()["spec"]
+        want_d = sum(d for d, _ in counts)
+        want_a = sum(a for _, a in counts)
+        assert spec["drafted_tokens"] == want_d
+        assert spec["accepted_tokens"] == want_a
+        assert spec["wasted_tokens"] == want_d - want_a
+        assert spec["accept_rate"] == round(want_a / want_d, 4)
+        assert r.spec_summary() == spec
+        # forensic: the completion events carry the same columns
+        evs = [e for e in recent_events()
+               if e["kind"] == EventKind.SERVE_REQUEST_COMPLETED]
+        assert sum(e.get("spec_drafted") or 0 for e in evs) == want_d
+        assert sum(e.get("spec_accepted") or 0 for e in evs) == want_a
+        # the `tpurun requests --events` aggregation must render the
+        # exact live block (wasted derived, -1.0 on zero evidence)
+        from dlrover_tpu.serving.cli import _spec_forensic
+        assert _spec_forensic(recent_events()) == spec
+        assert _spec_forensic([]) == {
+            "drafted_tokens": 0, "accepted_tokens": 0,
+            "wasted_tokens": 0, "accept_rate": -1.0}
+
+    def test_releases_twin_cannot_double_charge(self):
+        r = RequestRouter(lease_timeout_secs=0.01)
+        rid = r.submit([1, 2], 4)
+        r.lease(0, 1)
+        time.sleep(0.05)
+        assert r.scan_expired_once() == [rid]
+        r.lease(1, 1)  # the re-leased twin
+        assert r.complete(0, rid, [5], spec_drafted_tokens=6,
+                          spec_accepted_tokens=3)
+        # the twin's late completion is deduped: the ledger must not
+        # double-count its drafts
+        assert not r.complete(1, rid, [5], spec_drafted_tokens=6,
+                              spec_accepted_tokens=3)
+        spec = r.spec_summary()
+        assert spec["drafted_tokens"] == 6
+        assert spec["accepted_tokens"] == 3
+
+    def test_negative_and_overshoot_reports_are_clamped(self):
+        r = RequestRouter()
+        rid = r.submit([1], 2)
+        r.lease(0, 1)
+        r.complete(0, rid, [9], spec_drafted_tokens=-5,
+                   spec_accepted_tokens=12)
+        spec = r.spec_summary()
+        assert spec["drafted_tokens"] == 0
+        assert spec["accepted_tokens"] == 0
+        assert spec["accept_rate"] == -1.0  # no evidence, not 0/0
+
+    def test_failed_verify_restores_draft_credit(self, plain_engine,
+                                                 spec_engine):
+        """A verify dispatch that raises must not charge the ledger
+        (nothing committed) and must not kill serving: the batch falls
+        back to one plain decode step, bitwise the same stream."""
+        jobs = _jobs(2, max_new=8, seed0=160)
+        expect = {rid: r["tokens"]
+                  for rid, r in _serve(plain_engine, jobs)[0].items()}
+        refs = {tuple(p): expect[rid] for rid, p, _ in jobs}
+        spec_engine.cache = spec_engine.fresh_cache()
+        ex = ServeExecutor(spec_engine, serve_window=1,
+                           spec_proposer=lambda: _OracleProposer(refs))
+        for rid, prompt, max_new in jobs:
+            ex.submit(prompt, max_new_tokens=max_new, request_id=rid)
+        program = spec_engine.program
+        orig, calls = program.verify, []
+
+        def flaky(*args):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("injected verify failure")
+            return orig(*args)
+
+        program.verify = flaky
+        try:
+            got = {r["request_id"]: r for r in ex.serve()}
+        finally:
+            program.verify = orig
+        for rid, _, _ in jobs:
+            assert got[rid]["tokens"] == expect[rid], rid
+        assert len(calls) >= 2  # failed once, then kept speculating
+        drafted = sum(r["spec_drafted_tokens"] for r in got.values())
+        accepted = sum(r["spec_accepted_tokens"] for r in got.values())
+        # the oracle drafts ALWAYS land: with the failed step charged,
+        # drafted would exceed accepted — credit restore keeps them
+        # equal (and the recovered steps did speculate)
+        assert drafted > 0 and accepted == drafted
+
+
+# -- planner pricing: evidence-only -------------------------------------------
+
+
+class TestSpecPlannerPricing:
+    def test_zero_evidence_is_exactly_the_k0_estimate(self):
+        m = planner.model_spec_from_llama(TINY, global_batch=1)
+        base = planner.estimate_decode(m, 8, 4, 8, 64)
+        noev = planner.estimate_decode(m, 8, 4, 8, 64,
+                                       spec_draft_len=4,
+                                       spec_accept_rate=-1.0)
+        assert noev["tokens_per_s"] == base["tokens_per_s"]
+        assert noev["step_s"] == base["step_s"]
+        assert noev["breakdown"]["spec_expected_tokens_per_step"] == 1.0
+        assert noev["breakdown"]["spec_accept_rate"] == -1.0
+
+    def test_monotone_in_observed_rate(self):
+        m = planner.model_spec_from_llama(TINY, global_batch=1)
+        prev = None
+        for rate in (0.0, 0.3, 0.6, 0.9):
+            est = planner.estimate_decode(m, 8, 4, 8, 64,
+                                          spec_draft_len=4,
+                                          spec_accept_rate=rate)
+            bd = est["breakdown"]
+            assert bd["spec_expected_tokens_per_step"] \
+                == pytest.approx(1.0 + rate * 4)
+            if prev is not None:
+                assert est["tokens_per_s"] > prev
+            prev = est["tokens_per_s"]
+
+    def test_zero_rate_never_beats_k0(self):
+        # rate 0: every draft wasted — (K+1)x flops for 1 token/step
+        m = planner.model_spec_from_llama(TINY, global_batch=1)
+        base = planner.estimate_decode(m, 8, 4, 8, 64)
+        zero = planner.estimate_decode(m, 8, 4, 8, 64,
+                                       spec_draft_len=4,
+                                       spec_accept_rate=0.0)
+        assert zero["tokens_per_s"] <= base["tokens_per_s"]
+
+
+# -- the optimizer knob family ------------------------------------------------
+
+
+def _optimizer(publish=None):
+    from dlrover_tpu.master.monitor.node_series import NodeRuntimeStore
+    from dlrover_tpu.master.optimizer import RuntimeOptimizer
+
+    opt = RuntimeOptimizer(NodeRuntimeStore(), publish=publish,
+                           cooldown_secs=0.0)
+    opt.update_model_info(comm.ModelInfo(
+        num_params=7_000_000_000, hidden_size=8 * 128, num_layers=32,
+        seq_len=128))
+    return opt
+
+
+def _serve_report(**kw):
+    base = dict(node_id=0, world=8, serve_slots=4, prefill_chunk=16,
+                kv_precision="bf16", max_seq=128, num_layers=32,
+                kv_heads=8, head_dim=128, page_size=16)
+    base.update(kw)
+    return comm.ServeConfigReport(**base)
+
+
+class TestSpecKnobFamily:
+    def test_zero_evidence_never_turns_spec_on(self):
+        published = []
+        opt = _optimizer(publish=published.append)
+        opt.update_serving_config(_serve_report(
+            spec_draft_len=0, spec_accept_rate=-1.0))
+        if published:
+            # other knobs may move; spec must publish leave-unchanged
+            assert published[-1].serve_spec_draft_len == -1
+
+    def test_observed_acceptance_chooses_nonzero_k(self):
+        published = []
+        opt = _optimizer(publish=published.append)
+        opt.update_serving_config(_serve_report(
+            spec_draft_len=0, spec_accept_rate=0.7))
+        dec = [d for d in opt.decisions()
+               if d["trigger"].startswith("serve:")][-1]
+        assert dec["outcome"] == "chosen"
+        chosen = dec["chosen"]
+        assert chosen["spec_draft_len"] > 0
+        assert "|spec=" in chosen["key"]
+        assert published[-1].serve_spec_draft_len \
+            == chosen["spec_draft_len"]
+
+    def test_master_switch_freezes_enumeration(self, monkeypatch):
+        monkeypatch.setattr(get_context(), "serve_spec_enabled", False)
+        opt = _optimizer()
+        cands = opt._serve_candidates({
+            "serve_slots": 4, "prefill_chunk": 8, "max_seq": 48,
+            "kv_precision": "f32", "world": 8, "node_id": 0,
+            "spec_draft_len": 0})
+        assert all(c["spec_draft_len"] == 0 for c in cands)
+
+    def test_enumeration_covers_the_k_ladder(self):
+        opt = _optimizer()
+        cands = opt._serve_candidates({
+            "serve_slots": 4, "prefill_chunk": 8, "max_seq": 48,
+            "kv_precision": "f32", "world": 8, "node_id": 0,
+            "spec_draft_len": 0})
+        assert {c["spec_draft_len"] for c in cands} == {0, 2, 4, 8}
+
+    def test_engine_master_switch_pins_k_to_zero(self, monkeypatch):
+        monkeypatch.setattr(get_context(), "serve_spec_enabled", False)
+        eng = ServeEngine(
+            TINY, strategy=Strategy(mesh=MeshPlan(data=-1),
+                                    rule_set="llama"),
+            serve_slots=2, prefill_chunk=8, max_seq=48, page_size=8,
+            spec_draft_len=4,
+        )
+        assert eng.spec_draft_len == 0  # no verify program will build
+
+
+# -- the windowed acceptance gauge on the node series -------------------------
+
+
+BOUNDS = [0.001, 0.005, 0.01, 0.05, 0.1, 1.0]
+
+
+def _spec_node_report(node, steps, drafted, accepted):
+    counts = [0] * (len(BOUNDS) + 1)
+    counts[1] = steps
+    return comm.NodeRuntimeReport(
+        node_id=node, node_type="serve", timestamp=time.time(),
+        step=int(steps), steps_total=float(steps), bounds=BOUNDS,
+        step_time_counts=counts, serve_tokens_total=float(steps),
+        serve_slots=4.0, rss_mb=1.0,
+        serve_spec_drafted_total=float(drafted),
+        serve_spec_accepted_total=float(accepted),
+    )
+
+
+class TestSpecNodeSeries:
+    def test_windowed_rate_diffs_cumulative_totals(self):
+        from dlrover_tpu.master.monitor.node_series import (
+            NodeRuntimeStore,
+        )
+
+        process_registry().reset()
+        store = NodeRuntimeStore()
+        store.ingest(_spec_node_report(3, 10, drafted=40, accepted=30))
+        reg = process_registry()
+        labels = {"node": "3"}
+        # one sample: no window yet — absent, not zero
+        assert reg.get(tm.NODE_SERVE_SPEC_ACCEPT_RATE,
+                       labels=labels) is None
+        # window 2: +60 drafted, +15 accepted -> 0.25 (NOT the
+        # lifetime 45/100 — a regression shows immediately)
+        store.ingest(_spec_node_report(3, 20, drafted=100, accepted=45))
+        g = reg.get(tm.NODE_SERVE_SPEC_ACCEPT_RATE, labels=labels)
+        assert g is not None and g.value == pytest.approx(0.25)
+
+    def test_non_spec_nodes_export_no_rate(self):
+        from dlrover_tpu.master.monitor.node_series import (
+            NodeRuntimeStore,
+        )
+
+        process_registry().reset()
+        store = NodeRuntimeStore()
+        store.ingest(_spec_node_report(4, 10, drafted=0, accepted=0))
+        store.ingest(_spec_node_report(4, 20, drafted=0, accepted=0))
+        assert process_registry().get(
+            tm.NODE_SERVE_SPEC_ACCEPT_RATE,
+            labels={"node": "4"}) is None
