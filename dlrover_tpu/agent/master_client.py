@@ -342,11 +342,24 @@ class MasterClient:
 
     def get_recovery_plan(self) -> dict:
         """Owner -> ordered live replica holders: the peer-rebuild map a
-        recovering worker streams its state from."""
+        recovering worker streams its state from (plus the master's
+        ``predicted_mttr`` rung prices for this node)."""
         import json
 
         resp = self._channel.get(comm.RecoveryPlanRequest(
             node_id=self.node_id))
+        try:
+            return json.loads(resp.report_json or "{}")
+        except ValueError:
+            return {}
+
+    def get_readiness(self, node_id: int = -1) -> dict:
+        """The recovery-readiness report: durability posture, per-node
+        blast-radius verdicts, and the predicted-MTTR-per-rung table
+        (``tpurun readiness --addr``'s live view)."""
+        import json
+
+        resp = self._channel.get(comm.ReadinessRequest(node_id=node_id))
         try:
             return json.loads(resp.report_json or "{}")
         except ValueError:

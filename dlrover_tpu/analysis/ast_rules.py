@@ -76,6 +76,7 @@ FAILURE_EVENT_ATTRS = {
     "SERVE_LEASE_EXPIRED", "SERVE_SLO_VIOLATION",
     "REPLICA_PUSH_FAILED", "REPLICA_PLAN_DEGRADED",
     "REPLICA_HOLDER_LOST", "PEER_REBUILD_FALLBACK",
+    "DIAG_DURABILITY", "READINESS_DEGRADED",
 }
 FAILURE_EVENT_VALUES = {
     "nonfinite_step", "worker_failed", "hang_detected",
@@ -85,6 +86,7 @@ FAILURE_EVENT_VALUES = {
     "serve_lease_expired", "serve_slo_violation",
     "replica_push_failed", "replica_plan_degraded",
     "replica_holder_lost", "peer_rebuild_fallback",
+    "diag_durability", "readiness_degraded",
 }
 
 

@@ -528,6 +528,12 @@ class SnapshotReplicator:
         self._channel, self._close_channels = replica_channel_factory()
         self.last_pushed_step = -1
         self.last_plan: Optional[Dict[str, Any]] = None
+        # last completed cycle's wall/bytes, re-reported with every
+        # endpoint registration: the master's readiness auditor
+        # calibrates the rebuild transfer term from them (a push
+        # streams the same bytes a rebuild fetches back)
+        self.last_push_seconds = 0.0
+        self.last_push_bytes = 0.0
         # maintenance/chaos pause: submissions are dropped (counted)
         # while True — the "expired cadence" failure mode on demand
         self.paused = False
@@ -566,6 +572,8 @@ class SnapshotReplicator:
                 budget_mb=self.store.budget_bytes / (1024 * 1024),
                 snapshot_mb=float(snapshot_mb),
                 step=int(self.last_pushed_step),
+                push_seconds=float(self.last_push_seconds),
+                push_bytes=float(self.last_push_bytes),
             )
         except Exception as e:  # noqa: BLE001 — a briefly-away master
             # only delays the plan; the next cycle re-registers
@@ -641,8 +649,15 @@ class SnapshotReplicator:
             if self._push_to_peer(addr, frames):
                 pushed_peers.append(int(peer.get("node_id", -1)))
         self.last_pushed_step = step
-        self._register_endpoint(snapshot_mb=nbytes / (1024 * 1024))
         push_s = time.monotonic() - t0
+        # bytes per PEER-stream: the calibration wants the one-holder
+        # transfer a rebuild fetch would repeat, so a k-peer cycle's
+        # wall is paired with a single peer's worth of frame bytes
+        frame_bytes = sum(len(f) for f in frames)
+        if pushed_peers and frame_bytes > 0:
+            self.last_push_seconds = push_s
+            self.last_push_bytes = float(frame_bytes)
+        self._register_endpoint(snapshot_mb=nbytes / (1024 * 1024))
         self._c_pushes.inc()
         self._h_push.observe(push_s)
         # bytes actually SHIPPED: zero peers reached = zero bytes (a

@@ -523,6 +523,12 @@ class ReplicaEndpointReport:
     snapshot_mb: float = 0.0
     step: int = -1  # newest replicated (committed) step, -1 = none yet
     timestamp: float = 0.0
+    # last completed push cycle's wall seconds / bytes shipped: the
+    # readiness auditor's continuous link-bandwidth calibration (a push
+    # streams exactly the bytes a rebuild fetches back, over the same
+    # RPC path). 0 = no completed cycle yet.
+    push_seconds: float = 0.0
+    push_bytes: float = 0.0
 
 
 @message
@@ -561,7 +567,20 @@ class ReplicaPlan:
 class RecoveryPlanRequest:
     """Rebuilding worker -> master: map every owner's snapshot regions
     to live replica holders (answered with a DiagnosisReport JSON
-    blob: {"owners": {owner: [endpoints...]}, "replicas": k})."""
+    blob: {"owners": {owner: [endpoints...]}, "replicas": k,
+    "predicted_mttr": {rung: seconds} — the priced recovery ladder
+    the worker's rung choice consults)."""
+
+    node_id: int = -1
+
+
+@message
+class ReadinessRequest:
+    """Operator/CLI -> master: the recovery-readiness report — the
+    durability audit's posture, per-node blast-radius verdicts and
+    predicted-MTTR-per-rung table, and the pricer's calibration state
+    (answered with a DiagnosisReport JSON blob; `tpurun readiness`'s
+    live view)."""
 
     node_id: int = -1
 

@@ -105,6 +105,19 @@ class Context:
         # a stale peer snapshot older than the newest checkpoint
         # falls back to storage
         self.peer_restore = True
+        # recovery-readiness plane (master/monitor/readiness.py,
+        # docs/operations.md "Reading a readiness report"): wall
+        # seconds between durability-audit sweeps of the replica
+        # directory against the stores' live inventories (0 = the
+        # continuous audit is off; forced sweeps — the RPC's refresh,
+        # tests — still run)
+        self.readiness_sweep_secs = 30.0
+        # staleness allowance: a replica group whose committed step
+        # trails the owner's reported step by more than this factor
+        # times the master-computed cadence is STALE (coverage a
+        # rebuild would roll the job back past one cadence is not
+        # durability)
+        self.readiness_stale_factor = 2.0
         # what to do on a non-finite step after reporting the failure:
         # "halt" | "rollback" (restore last checkpoint) | "ignore"
         self.on_nonfinite = "halt"

@@ -104,6 +104,11 @@ class LocalJobMaster:
                 # plus the scale policy's idle watch
                 self.servicer.serve_slo.evaluate()
                 self.servicer.serving_scale_policy.tick()
+                # the durability audit (self-paced to
+                # readiness_sweep_secs): directory assignments vs live
+                # store inventories -> coverage/staleness/budget
+                # verdicts + priced blast-radius gauges
+                self.servicer.readiness_auditor.sweep()
             except Exception:  # noqa: BLE001 — stats must not kill serving
                 logger.exception("runtime stats collection failed")
 

@@ -243,6 +243,11 @@ class Decision:
     # the INPUT-BOUND gate's evidence when it rejected this pass's
     # program plan (which node is starved, by how much over peers)
     input_bound: Optional[Dict] = None
+    # the readiness auditor's verdict evidence when a ``durability:``
+    # trigger fired this pass (which owner is at risk, which coverage /
+    # staleness / budget dimension failed) — `tpurun plan` shows WHY a
+    # placement replan was asked for, not just that one was
+    durability: Optional[Dict] = None
     # the chosen candidate's knob-tuple key (blacklist identity on a
     # failed apply); not part of the reported dict
     chosen_key: str = ""
@@ -267,6 +272,8 @@ class Decision:
             "memory_rejected": list(self.memory_rejected),
             "input_bound": (dict(self.input_bound)
                             if self.input_bound else None),
+            "durability": (dict(self.durability)
+                           if self.durability else None),
         }
 
 
@@ -305,6 +312,11 @@ class RuntimeOptimizer:
         self._input_bound_gate = bool(
             getattr(ctx, "replan_input_bound_gate", True))
         self._mesh_candidates = mesh_candidates
+        # supplies the readiness auditor's verdict evidence for a node
+        # (wired by the servicer) so durability-triggered decisions
+        # carry WHY placement must change, not just the trigger string
+        self._durability_evidence_fn: Optional[
+            Callable[[int], Optional[Dict]]] = None
         self._lock = threading.RLock()
         self._running: Optional[RunningConfig] = None
         # last reported world PER NODE (the world-change trigger input)
@@ -820,6 +832,29 @@ class RuntimeOptimizer:
         else:
             self.replan(f"{verdict}:{node_id}")
 
+    def set_durability_evidence_fn(
+            self, fn: Callable[[int], Optional[Dict]]) -> None:
+        """Wire the readiness auditor's per-node verdict lookup in."""
+        self._durability_evidence_fn = fn
+
+    def _durability_evidence(self, trigger: str) -> Optional[Dict]:
+        """The at-risk owner's audit evidence for a ``durability:N``
+        trigger (None for every other trigger, or when the verdict
+        already cleared by the time the pass runs)."""
+        if (self._durability_evidence_fn is None
+                or not trigger.startswith("durability:")):
+            return None
+        try:
+            node_id = int(trigger.split(":", 1)[1])
+        except (TypeError, ValueError):
+            return None
+        try:
+            return self._durability_evidence_fn(node_id)
+        except Exception:  # noqa: BLE001 — evidence is garnish, the
+            # replan itself must still run
+            logger.exception("durability evidence lookup failed")
+            return None
+
     # -- calibration ---------------------------------------------------------
 
     def _ensure_calibrator(self) -> Optional[CostCalibrator]:
@@ -1171,6 +1206,7 @@ class RuntimeOptimizer:
     def _replan_locked(self, trigger: str, run: RunningConfig,
                        tid: str) -> Optional[Decision]:
         self._c_replans.inc()
+        durability_ev = self._durability_evidence(trigger)
         corrections = self.calibrate() or (
             self._calibrator.corrections.to_dict()
             if self._calibrator is not None else {}
@@ -1227,6 +1263,7 @@ class RuntimeOptimizer:
                     current_predicted_s=current_s,
                     corrections=corrections,
                     memory_rejected=memory_rejected,
+                    durability=durability_ev,
                 )
                 self._reject(decision, "memory_infeasible:all")
                 self._decisions.append(decision)
@@ -1242,6 +1279,7 @@ class RuntimeOptimizer:
             current=run.to_dict(), current_predicted_s=current_s,
             candidates=table, corrections=corrections,
             memory_rejected=memory_rejected,
+            durability=durability_ev,
         )
         best = candidates[0]
         decision.predicted_speedup = best.speedup

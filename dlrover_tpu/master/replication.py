@@ -64,12 +64,19 @@ class ReplicaDirectory:
 
     def register(self, node_id: int, addr: str, budget_mb: float,
                  snapshot_mb: float, step: int,
-                 ts: Optional[float] = None):
+                 ts: Optional[float] = None,
+                 push_seconds: float = 0.0, push_bytes: float = 0.0):
         with self._lock:
             self._nodes[int(node_id)] = {
                 "addr": addr, "budget_mb": float(budget_mb),
                 "snapshot_mb": float(snapshot_mb), "step": int(step),
                 "ts": float(ts if ts is not None else time.time()),
+                # last completed push cycle's wall/bytes: the readiness
+                # auditor's continuous calibration of the rebuild
+                # transfer path (a push frames+streams the same bytes a
+                # rebuild fetches back, over the same RPC path)
+                "push_seconds": float(push_seconds),
+                "push_bytes": float(push_bytes),
             }
             # a re-registering node is alive again, whatever we thought
             self._failed.discard(int(node_id))
@@ -145,10 +152,16 @@ class ReplicaDirectory:
             k = min(int(requested), max(0, len(lenders) - 1),
                     len(live) - 1)
             reason = ""
+            load = {n: 0.0 for n in lenders}
+            assignments: Dict[int, List[int]] = {}
             while k > 0:
                 load = {n: 0.0 for n in lenders}
+                assignments = {
+                    owner: hrw_peers(owner, lenders, k)
+                    for owner in group
+                }
                 for owner in group:
-                    for peer in hrw_peers(owner, lenders, k):
+                    for peer in assignments[owner]:
                         load[peer] += share_mb.get(owner, 0.0)
                 over = [
                     n for n in lenders
@@ -164,13 +177,26 @@ class ReplicaDirectory:
                     f"assigned {load[worst]:.0f} MB at k={k}"
                 )
                 k -= 1
+            if k == 0:
+                assignments = {owner: [] for owner in group}
+                load = {n: 0.0 for n in lenders}
             degraded = k < int(requested)
             # "live" is the PEER-holder candidate pool: only nodes
-            # that lend DRAM (plan_for draws assignments from it)
+            # that lend DRAM (plan_for draws assignments from it).
+            # "assignments"/"load"/"headroom_mb" are the ADMITTED
+            # plan's facts — what the readiness gauges and the
+            # durability audit sweep against.
             return {"replicas": k, "requested": int(requested),
                     "group": group, "live": lenders,
                     "degraded": degraded,
-                    "reason": reason if degraded else ""}
+                    "reason": reason if degraded else "",
+                    "assignments": assignments,
+                    "load": load,
+                    "headroom_mb": {
+                        n: self._nodes[n]["budget_mb"] - load[n]
+                        for n in lenders
+                        if self._nodes[n]["budget_mb"] > 0
+                    }}
 
     def plan_for(self, node_id: int, requested: int) -> Dict[str, Any]:
         admitted = self.admitted_replicas(requested)

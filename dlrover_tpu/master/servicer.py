@@ -93,6 +93,26 @@ class MasterServicer:
         self.replica_directory = ReplicaDirectory()
         self.straggler_detector.add_verdict_listener(
             self.replica_directory.on_verdict)
+        # the recovery-readiness plane: continuous durability audit of
+        # the directory against live store inventories, blast-radius
+        # verdicts with predicted-MTTR-per-rung pricing. Its durability
+        # verdicts feed the SAME optimizer listener path the straggler
+        # detector uses, so a coverage loss triggers a replica-aware
+        # re-plan under the verdict's incident trace id.
+        from dlrover_tpu.master.monitor.readiness import ReadinessAuditor
+
+        self.readiness_auditor = ReadinessAuditor(
+            self.replica_directory,
+            cadence_fn=self._replica_cadence_steps,
+            replicas_fn=self._configured_replicas,
+        )
+        self.readiness_auditor.add_verdict_listener(
+            self.runtime_optimizer.on_verdict)
+        self.runtime_optimizer.set_durability_evidence_fn(
+            lambda node_id: (
+                v.to_dict()
+                if (v := self.readiness_auditor.verdicts().get(node_id))
+                else None))
         # the serving request plane: the PR 9 dispatch ledger
         # generalized into a request router (enqueue/lease/complete,
         # dead-worker re-lease, per-request latency accounting)
@@ -151,6 +171,7 @@ class MasterServicer:
             comm.DataShardRequest: self._get_data_report,
             comm.ReplicaPlanRequest: self._get_replica_plan,
             comm.RecoveryPlanRequest: self._get_recovery_plan,
+            comm.ReadinessRequest: self._get_readiness,
             comm.ServeLeaseRequest: self._serve_lease,
             comm.ServeReportRequest: self._get_serve_report,
             comm.ServeSLORequest: self._get_serve_slo,
@@ -462,6 +483,8 @@ class MasterServicer:
         self.replica_directory.register(
             req.node_id, req.addr, req.budget_mb, req.snapshot_mb,
             req.step, ts=req.timestamp or time.time(),
+            push_seconds=float(getattr(req, "push_seconds", 0.0) or 0.0),
+            push_bytes=float(getattr(req, "push_bytes", 0.0) or 0.0),
         )
         return comm.Response(success=True)
 
@@ -527,7 +550,22 @@ class MasterServicer:
 
         plan = self.replica_directory.recovery_plan(
             self._configured_replicas(), for_node=req.node_id)
+        # attach the priced ladder for the requesting node so the rung
+        # it walks is the predicted-MTTR choice, not a fixed order
+        plan["predicted_mttr"] = (
+            self.readiness_auditor.predicted_mttr_table(req.node_id))
         return comm.DiagnosisReport(report_json=_json.dumps(plan))
+
+    def _get_readiness(self, req: comm.ReadinessRequest):
+        import json as _json
+
+        report = self.readiness_auditor.report()
+        if req.node_id >= 0:
+            report["nodes"] = {
+                k: v for k, v in report.get("nodes", {}).items()
+                if k == str(req.node_id)
+            }
+        return comm.DiagnosisReport(report_json=_json.dumps(report))
 
     def _report_failure(self, req: comm.NodeFailure):
         self._c_failure_reports.inc()

@@ -23,6 +23,13 @@
            recoveries, per-node consumption rates — live (--addr,
            DataShardRequest RPC) or forensically from a timeline's
            DATA_* events (--events)
+  readiness
+           the recovery-readiness plane: cluster posture, per-node
+           durability verdicts (coverage / staleness / budget), and
+           the priced recovery ladder (predicted MTTR per rung) —
+           live (--addr, ReadinessRequest RPC) or forensically from
+           a timeline's DIAG_DURABILITY / READINESS_* events
+           (--events)
   events   pretty-print a timeline (newest last)
   metrics  dump Prometheus exposition: a live endpoint via --addr, or
            this process's registry (useful under ``tpurun metrics``)
@@ -58,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
     mttr.add_argument("--target", type=float, default=90.0,
                       help="MTTR target seconds for vs_baseline "
                            "(default 90)")
+    mttr.add_argument("--predict", action="store_true",
+                      help="per-incident predicted-vs-realized MTTR "
+                           "columns (recovery events stamped by the "
+                           "priced ladder) instead of the aggregate "
+                           "report")
 
     gp = sub.add_parser(
         "goodput", help="derive the goodput/badput ledger from an "
@@ -121,6 +133,20 @@ def build_parser() -> argparse.ArgumentParser:
     dt.add_argument("--dataset", default="",
                     help="only this dataset ('' = all)")
     dt.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
+    rd = sub.add_parser(
+        "readiness", help="recovery-readiness plane: posture, "
+                          "per-node durability verdicts, priced "
+                          "recovery ladder")
+    rd.add_argument("--addr", default="",
+                    help="query a live master at host:port")
+    rd.add_argument("--events", default="",
+                    help="derive forensically from a timeline JSONL "
+                         "(default: the configured events sink)")
+    rd.add_argument("--node", type=int, default=-1,
+                    help="only this node's blast radius (live view)")
+    rd.add_argument("--json", action="store_true",
                     help="machine-readable output")
 
     ev = sub.add_parser("events", help="print a timeline")
@@ -583,6 +609,77 @@ def _cmd_data(args) -> int:
     return 0
 
 
+def _cmd_readiness(args) -> int:
+    """Live (ReadinessRequest RPC) or forensic (timeline replay)
+    readiness report. Both views quote the same posture and at-risk
+    node set — the tier-1 CLI gate pins their agreement across a
+    flag -> clear cycle."""
+    if args.addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(args.addr)
+        try:
+            report = client.get_readiness(node_id=args.node)
+        finally:
+            client.close()
+        report["source"] = args.addr
+    else:
+        from dlrover_tpu.telemetry import events as events_mod
+        from dlrover_tpu.telemetry.readiness import readiness_view
+
+        path = _resolve_events_path(args.events)
+        if not path:
+            print("readiness: no master --addr and no timeline (pass "
+                  "--events or set DLROVER_TPU_EVENTS_FILE)",
+                  file=sys.stderr)
+            return 2
+        report = readiness_view(events_mod.read_events(path))
+        report["source"] = path
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    posture = report.get("posture", "ready")
+    at_risk = report.get("at_risk") or {}
+    print(f"posture: {posture.upper()}"
+          + (f" ({len(at_risk)} node(s) at risk)" if at_risk else ""))
+    for node, v in sorted(at_risk.items()):
+        print(f"AT RISK node {node}: {v.get('error_code', '')} "
+              f"[{v.get('trace_id', '')}] evidence={v.get('evidence')}")
+    # live view extras: per-node blast radius + calibration
+    for node, d in sorted((report.get("nodes") or {}).items()):
+        if not d.get("owner"):
+            continue
+        table = d.get("predicted_mttr") or {}
+        rungs = " ".join(
+            f"{r}={table[r]}s" for r in
+            ("live_reshard", "peer_rebuild", "storage_restore", "init")
+            if r in table)
+        print(f"node {node}: regions={d.get('regions_mb')}MB "
+              f"holders={d.get('holders')} "
+              f"coverage={'ok' if d.get('coverage_ok') else 'LOST'} "
+              f"staleness={d.get('staleness_steps')} "
+              f"best_rung={d.get('best_rung')} {rungs}")
+    admitted = report.get("admitted") or {}
+    if admitted.get("requested"):
+        print(f"replicas: admitted k={admitted.get('replicas')} of "
+              f"requested {admitted.get('requested')}"
+              + (f" ({admitted.get('reason')})"
+                 if admitted.get("reason") else ""))
+    cal = report.get("calibration") or {}
+    if cal:
+        print(f"calibration: link_bw={cal.get('link_bw_bytes_per_s')} "
+              f"put_bw={cal.get('put_bw_bytes_per_s')} "
+              f"observations={cal.get('observations')}")
+    sweep = report.get("last_sweep")
+    if sweep:
+        print(f"last sweep: {sweep}")
+    if not at_risk:
+        print("durability: every owner's regions covered"
+              + ("" if report.get("nodes") or report.get("sweep_events")
+                 else " (no readiness records)"))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -595,6 +692,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "attribution":
         return _cmd_attribution(args)
 
+    if args.cmd == "readiness":
+        return _cmd_readiness(args)
+
     if args.cmd == "mttr":
         from dlrover_tpu.telemetry import events as events_mod
         from dlrover_tpu.telemetry.mttr import mttr_report
@@ -605,7 +705,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "DLROVER_TPU_EVENTS_FILE)", file=sys.stderr)
             return 2
         records = events_mod.read_events(path)
-        report = mttr_report(records, target_s=args.target)
+        if args.predict:
+            from dlrover_tpu.telemetry.readiness import predict_report
+
+            report = predict_report(records)
+        else:
+            report = mttr_report(records, target_s=args.target)
         line = json.dumps(report)
         print(line)
         if args.out:

@@ -185,6 +185,41 @@ def _model_flops_column(ordered: List[Dict],
     }
 
 
+def _durability_column(ordered: List[Dict], t1: float) -> Optional[Dict]:
+    """The durability-at-risk goodput column: wall seconds the cluster
+    ran with an owner's replica coverage degraded (the readiness
+    auditor's READINESS_DEGRADED -> READINESS_RESTORED spans). A
+    COLUMN, not a wall bucket — the job keeps training while at risk
+    (no downtime to charge), so it reports how much of the wall clock
+    was spent one failure away from a slow rung rather than
+    re-partitioning it. None when no degraded edge exists (the plane
+    off, or never at risk)."""
+    total = 0.0
+    spells = 0
+    open_ts: Optional[float] = None
+    seen = False
+    for rec in ordered:
+        kind = rec.get("kind")
+        ts = rec.get("ts")
+        if ts is None:
+            continue
+        if kind == EventKind.READINESS_DEGRADED:
+            seen = True
+            if open_ts is None:
+                open_ts = float(ts)
+        elif kind == EventKind.READINESS_RESTORED and open_ts is not None:
+            total += max(0.0, float(ts) - open_ts)
+            spells += 1
+            open_ts = None
+    if not seen:
+        return None
+    if open_ts is not None:
+        # still degraded at the end of the timeline: at-risk until t1
+        total += max(0.0, t1 - open_ts)
+        spells += 1
+    return {"seconds": round(total, 3), "spells": spells}
+
+
 def _input_wait_column(ordered: List[Dict],
                        productive_s: float) -> Optional[Dict]:
     """The input-wait goodput column: host seconds the workers spent
@@ -413,6 +448,11 @@ def derive_goodput(events: List[Dict]) -> Dict:
     input_wait = _input_wait_column(ordered, productive)
     if input_wait is not None:
         detail["input_wait"] = input_wait
+    # durability-at-risk column: only when a degraded edge exists
+    # (absent-not-zero; overlaps the productive span, never a bucket)
+    durability = _durability_column(ordered, t1)
+    if durability is not None:
+        detail["durability_at_risk"] = durability
     return {
         "metric": "goodput_fraction",
         "value": round(productive / wall, 4),

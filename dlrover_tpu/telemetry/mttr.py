@@ -67,6 +67,14 @@ _PAIRINGS = {
     # itself (serving_resize) which it usually contains
     EventKind.SERVE_SLO_VIOLATION: (
         {EventKind.SERVE_SLO_RECOVERED}, "serving_scale"),
+    # the durability audit's cluster posture edge: some node's owner
+    # regions at risk (coverage / staleness / budget) -> all clear.
+    # Degraded-but-alive like serving_scale — training continues, so
+    # goodput surfaces it as an overlap COLUMN, never a wall bucket —
+    # but the interval is exactly the exposure window an operator is
+    # judged on, so the recovery report prices it like any incident.
+    EventKind.READINESS_DEGRADED: (
+        {EventKind.READINESS_RESTORED}, "durability_at_risk"),
 }
 
 

@@ -73,6 +73,43 @@ REPLICA_FETCH_RETRIES = "dlrover_replica_fetch_retries_total"
 PEER_REBUILD_TIME = "dlrover_peer_rebuild_seconds"
 PEER_REBUILD_BYTES = "dlrover_peer_rebuild_bytes_fetched_total"
 
+# -- recovery readiness (continuous durability audit) --------------------------
+# Master-side auditor (master/monitor/readiness.py): the
+# ReplicaDirectory's assignments swept against live store inventory()
+# facts. Per-node gauges are {node=}-labeled, absent-not-zero, and
+# retracted when the node leaves the directory.
+
+# 1 = every owner region of this node is held by >= k live, fresh,
+# crc-committed holders; 0 = at risk (the DIAG_DURABILITY verdict
+# carries the evidence). Absent until the first sweep sees the node.
+READINESS_COVERAGE = "dlrover_readiness_owner_coverage"
+# how many steps the node's newest fully-held replica group trails its
+# reported step (fresh means <= stale_factor x the master cadence)
+READINESS_STALENESS = "dlrover_readiness_staleness_steps"
+# the priced recovery ladder: predicted MTTR of rung {rung=} for node
+# {node=}, seconds (calibrated decomposition, EMA-corrected against
+# realized incidents)
+READINESS_PREDICTED_MTTR = "dlrover_readiness_predicted_mttr_seconds"
+# best survivable rung index for the node (0=live_reshard,
+# 1=peer_rebuild, 2=storage_restore, 3=init)
+READINESS_BEST_RUNG = "dlrover_readiness_best_rung"
+# audit sweeps completed, and the wall seconds one sweep costs
+READINESS_SWEEPS = "dlrover_readiness_sweeps_total"
+READINESS_SWEEP_TIME = "dlrover_readiness_sweep_seconds"
+# durability verdicts flagged by the auditor (clears ride the shared
+# DIAG_RECOVERIES counter like every other diagnosis verdict)
+DIAG_DURABILITY_FLAGS = "dlrover_diagnosis_durability_total"
+
+# ReplicaDirectory admission facts as labeled gauges (previously
+# event-only): per-holder assigned replica load and remaining budget
+# headroom in MB ({node=}; headroom absent when the holder is
+# uncapped), plus the plan-wide admitted k and how far below the
+# requested k the budget degraded it
+REPLICA_HOLDER_LOAD_MB = "dlrover_replica_holder_load_mb"
+REPLICA_HOLDER_HEADROOM_MB = "dlrover_replica_holder_headroom_mb"
+REPLICA_ASSIGNED_K = "dlrover_replica_assigned_k"
+REPLICA_DEGRADED_K = "dlrover_replica_degraded_k"
+
 # -- rpc client ---------------------------------------------------------------
 
 # transient-RPC retries taken by the client channel (the retry budget
@@ -399,6 +436,20 @@ class EventKind:
     DIAG_STRAGGLER = "diag_straggler"
     DIAG_NODE_HANG = "diag_node_hang"
     DIAG_RECOVERED = "diag_recovered"
+    # recovery-readiness plane (master/monitor/readiness.py).
+    # DIAG_DURABILITY (failure-class, DLR008) flags ONE node whose
+    # owner regions fail the durability audit — coverage lost,
+    # replicas stale past the cadence allowance, or budget-degraded k
+    # — with the sweep's evidence attached; cleared by DIAG_RECOVERED
+    # (was=durability) once a later sweep passes.
+    # READINESS_DEGRADED -> READINESS_RESTORED bracket the CLUSTER
+    # posture edge (any node at risk -> none), the mttr
+    # "durability_at_risk" scenario. READINESS_SWEEP summarizes a
+    # sweep's verdict table, emitted only when the posture changes.
+    DIAG_DURABILITY = "diag_durability"
+    READINESS_DEGRADED = "readiness_degraded"
+    READINESS_RESTORED = "readiness_restored"
+    READINESS_SWEEP = "readiness_sweep"
     # runtime optimization loop. Master side: one REPLAN per evaluated
     # trigger (candidate table attached), then CHOSEN (plan published to
     # workers) or REJECTED (hysteresis / cooldown-dedup / already

@@ -437,6 +437,17 @@ class ElasticTrainer:
         }
         if not owners or not any(owners.values()):
             return None
+        # the master's priced recovery ladder (readiness auditor): the
+        # predicted MTTR of each rung, calibrated from realized
+        # incidents and push-cycle bandwidth. Absent on old masters —
+        # every priced decision below degrades to the ladder order.
+        mttr_table: Dict[str, float] = {}
+        for rung, secs in (plan.get("predicted_mttr") or {}).items():
+            try:
+                mttr_table[str(rung)] = float(secs)
+            except (TypeError, ValueError):
+                continue
+        predicted_s = mttr_table.get("peer_rebuild")
         announce_long_phase(600.0)  # rebuild window: not a hang
         abstract = jax.eval_shape(
             lambda r: self._result.init_fn(r), self._rng
@@ -482,14 +493,43 @@ class ElasticTrainer:
                         "checkpoint step %d; restoring from storage",
                         peek_step, ckpt_step)
                     return None
+                # priced-rung gate: when an equally fresh checkpoint
+                # exists AND the master's calibrated ladder prices the
+                # storage restore cheaper than the peer fetch (e.g. a
+                # local NVMe cache vs a congested link), take the
+                # cheaper rung — the ladder order is a prior, the
+                # price is evidence
+                storage_pred = mttr_table.get("storage_restore")
+                if (ckpt_step is not None
+                        and int(ckpt_step) >= peek_step
+                        and predicted_s is not None
+                        and storage_pred is not None
+                        and storage_pred < predicted_s):
+                    emit_event(EventKind.PEER_REBUILD_FALLBACK,
+                               error_code="MTTR_PRICED_OUT",
+                               rung="storage_restore",
+                               predicted_mttr_s=round(storage_pred, 3),
+                               peer_predicted_mttr_s=round(
+                                   predicted_s, 3))
+                    logger.info(
+                        "storage restore priced at %.2fs beats peer "
+                        "rebuild at %.2fs for step %d; taking the "
+                        "storage rung", storage_pred, predicted_s,
+                        peek_step)
+                    return None
             # the failure edge opens only once the gates passed and a
             # transfer actually begins: a by-design degradation (stale
             # replica, nothing reachable) must not strand an unpaired
             # PEER_REBUILD_BEGIN that the MTTR derivation would report
             # as an unrecovered incident
+            begin_fields: Dict[str, Any] = {}
+            if predicted_s is not None:
+                begin_fields["predicted_mttr_s"] = round(predicted_s, 3)
+                begin_fields["rung"] = "peer_rebuild"
             emit_event(EventKind.PEER_REBUILD_BEGIN,
                        step=int(peek_step), owners=sorted(owners),
-                       holders=sum(len(v) for v in owners.values()))
+                       holders=sum(len(v) for v in owners.values()),
+                       **begin_fields)
             leaves, meta, step, wire_bytes = repl.fetch_tree(
                 flat, owners, channel_factory,
                 inventories=inventories)
@@ -530,11 +570,20 @@ class ElasticTrainer:
             tm.PEER_REBUILD_BYTES,
             help="bytes streamed out of peer DRAM during rebuilds",
         ).inc(wire_bytes)
+        # predicted-vs-realized stamped on the recovery event itself:
+        # the readiness plane EMA-corrects its pricer against exactly
+        # this pair, and `tpurun mttr --predict` reports the ratio
+        done_fields: Dict[str, Any] = {
+            "realized_mttr_s": round(fetch_s + put_s, 3),
+            "rung": "peer_rebuild",
+        }
+        if predicted_s is not None:
+            done_fields["predicted_mttr_s"] = round(predicted_s, 3)
         emit_event(EventKind.PEER_REBUILD_DONE, step=int(step),
                    fetch_seconds=round(fetch_s, 3),
                    put_seconds=round(put_s, 3),
                    bytes_from_peers=int(wire_bytes), storage_bytes=0,
-                   owners=sorted(owners))
+                   owners=sorted(owners), **done_fields)
         logger.info(
             "peer rebuild: restored step %d from surviving peers' DRAM "
             "(%.1f MB over the wire in %.2fs, device_put %.2fs, zero "

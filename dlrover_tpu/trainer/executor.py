@@ -814,6 +814,7 @@ class TrainExecutor:
                 failover_client=failover_client,
                 on_reshard=(self.request_live_reshard
                             if self._live_recovery else None),
+                mttr_table_fn=self._readiness_mttr_table,
             )
         self.state: Any = None
         self.eval_metrics: Dict[str, Any] = {}
@@ -951,6 +952,37 @@ class TrainExecutor:
     def request_restart(self):
         """Membership changed: finish the current step, then rebuild."""
         self._restart_requested = True
+
+    def _readiness_mttr_table(self) -> Dict[str, float]:
+        """The master's predicted-MTTR ladder for THIS node (the
+        readiness auditor's calibrated blast-radius pricing), consumed
+        by the failover monitor so classify_recovery picks the priced
+        rung. Empty dict = master without a readiness plane = unpriced."""
+        if self._master_client is None or not hasattr(
+            self._master_client, "get_readiness"
+        ):
+            return {}
+        try:
+            report = self._master_client.get_readiness(
+                node_id=getattr(self._master_client, "node_id", -1))
+        except Exception:  # noqa: BLE001 — unpriced beats blocked
+            logger.warning("readiness fetch failed; recovery stays unpriced",
+                           exc_info=True)
+            return {}
+        node = str(getattr(self._master_client, "node_id", -1))
+        nodes = report.get("nodes") or {}
+        per_node = nodes.get(node) or {}
+        table = per_node.get("predicted_mttr")
+        if not table:
+            # never swept under this id: any swept node's ladder is a
+            # better price than none (pricer state is cluster-wide)
+            for detail in nodes.values():
+                if detail.get("predicted_mttr"):
+                    table = detail["predicted_mttr"]
+                    break
+        if not isinstance(table, dict):
+            return {}
+        return {str(k): float(v) for k, v in table.items()}
 
     def request_live_reshard(self, devices=None):
         """A SURVIVABLE world change (peer lost with a viable survivor

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.telemetry.names import EventKind
@@ -57,6 +57,7 @@ def classify_recovery(
     self_affected: bool = False,
     host_healthy: bool = True,
     world_viable: bool = True,
+    mttr_table: Optional[Dict[str, float]] = None,
 ) -> str:
     """Pick the cheapest recovery rung that is actually safe.
 
@@ -69,12 +70,38 @@ def classify_recovery(
     ``world_viable``: the post-event world still satisfies min_nodes /
     node_unit (the master's rendezvous constraints) — without a viable
     survivor world there is nothing to reshard onto.
+
+    ``mttr_table``: the master's predicted-MTTR-per-rung prices (the
+    readiness auditor's calibrated ladder, attached to recovery plans).
+    When present, the safety-admissible default of LIVE_RESHARD is
+    additionally PRICED: if a restart-class rung (peer_rebuild /
+    storage_restore) predicts strictly cheaper than the live reshard —
+    e.g. a huge mesh whose drain + recompile dwarfs a tiny peer fetch —
+    the decision takes the cheaper rung. Absent or unpriced tables keep
+    today's ladder order, so the pricing can only ever move a decision
+    on evidence.
     """
     if not host_healthy:
         return RecoveryDecision.POD_RESTART
     if self_affected:
         return RecoveryDecision.PROCESS_RESTART
     if event_kind in _SURVIVABLE_KINDS and world_viable:
+        if mttr_table:
+            from dlrover_tpu.telemetry.readiness import (
+                RUNG_LIVE_RESHARD,
+                RUNG_PEER_REBUILD,
+                RUNG_STORAGE_RESTORE,
+            )
+
+            live = mttr_table.get(RUNG_LIVE_RESHARD)
+            restart_prices = [
+                mttr_table[r]
+                for r in (RUNG_PEER_REBUILD, RUNG_STORAGE_RESTORE)
+                if mttr_table.get(r) is not None
+            ]
+            if (live is not None and restart_prices
+                    and min(restart_prices) < float(live)):
+                return RecoveryDecision.PROCESS_RESTART
         return RecoveryDecision.LIVE_RESHARD
     return RecoveryDecision.PROCESS_RESTART
 
@@ -136,9 +163,13 @@ class TrainingFailover:
         failover_client: Optional[FailoverClient] = None,
         poll_interval: float = 5.0,
         on_reshard: Optional[Callable[[], None]] = None,
+        mttr_table_fn: Optional[Callable[[], Dict[str, float]]] = None,
     ):
         self._client = master_client
         self._on_change = on_change
+        # supplies the master's predicted-MTTR ladder at decision time
+        # (None = unpriced: classify by safety ladder order alone)
+        self._mttr_table_fn = mttr_table_fn
         # the live fast path: survivable membership changes (nodes
         # waiting at the rendezvous while this process is healthy) go
         # here instead of on_change, so the executor reshards in place.
@@ -195,8 +226,18 @@ class TrainingFailover:
                 if what:
                     if self._failover is not None:
                         self._failover.sync_to_global()
+                    table = None
+                    if what == "rdzv" and self._mttr_table_fn is not None:
+                        try:
+                            table = self._mttr_table_fn()
+                        except Exception:  # noqa: BLE001 — stay unpriced
+                            logger.warning(
+                                "mttr table lookup failed; classifying "
+                                "unpriced", exc_info=True)
+                            table = None
                     decision = (
-                        classify_recovery(EventKind.RDZV_JOIN)
+                        classify_recovery(
+                            EventKind.RDZV_JOIN, mttr_table=table)
                         if what == "rdzv"
                         else RecoveryDecision.PROCESS_RESTART
                     )

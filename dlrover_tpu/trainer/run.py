@@ -213,12 +213,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return serving_main(argv)
     if argv and argv[0] in ("metrics", "mttr", "goodput", "diagnose",
-                            "plan", "attribution", "data", "events",
-                            "trace", "cache"):
+                            "plan", "attribution", "data", "readiness",
+                            "events", "trace", "cache"):
         # `tpurun metrics [--addr host:port]` / `tpurun mttr ...` /
         # `tpurun goodput` / `tpurun diagnose` / `tpurun plan` /
-        # `tpurun attribution` / `tpurun data` / `tpurun cache` — the
-        # observability CLI (docs/observability.md)
+        # `tpurun attribution` / `tpurun data` / `tpurun readiness` /
+        # `tpurun cache` — the observability CLI
+        # (docs/observability.md)
         from dlrover_tpu.telemetry.cli import main as telemetry_main
 
         return telemetry_main(argv)

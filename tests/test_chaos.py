@@ -411,6 +411,20 @@ def test_peer_rebuild_after_sigkill_is_bitwise_and_storage_free(
     assert done, "no peer_rebuild_done edge in the timeline"
     assert done[-1]["storage_bytes"] == 0
     assert done[-1]["bytes_from_peers"] > 0
+
+    # -- the rung the worker walked was PRICED: both the prediction it
+    # fetched with the recovery plan and the realized fetch+put cost
+    # are stamped on the recovery event, and the prediction is within
+    # 2x of reality either way (the readiness acceptance pin — the
+    # link_bw term is calibrated from the replicator's own push cycles
+    # over this same localhost RPC path)
+    predicted = done[-1].get("predicted_mttr_s")
+    realized = done[-1].get("realized_mttr_s")
+    assert predicted is not None and predicted > 0, done[-1]
+    assert realized is not None and realized > 0, done[-1]
+    assert done[-1].get("rung") == "peer_rebuild"
+    assert predicted <= 2.0 * realized + 0.05, (predicted, realized)
+    assert realized <= 2.0 * predicted + 0.05, (predicted, realized)
     assert not [r for r in timeline if r["kind"] == "ckpt_restore"], (
         "the recovery path touched storage")
 
